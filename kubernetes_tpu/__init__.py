@@ -1,0 +1,28 @@
+"""kubernetes_tpu — a TPU-native container-orchestration control plane.
+
+A brand-new framework with the capabilities of Kubernetes (reference:
+AndreKapraty/kubernetes, ~v1.26), re-designed TPU-first: the control plane
+(store, API server, informers, controllers) is classic systems code, while the
+scheduler's Filter/Score/Assign hot path is a batched JAX/XLA program that
+schedules MANY pods per step on TPU instead of one pod per loop iteration.
+
+Package map (see SURVEY.md for the reference analysis this is built to):
+  api/         - object model: Pod/Node/..., quantities, label selectors
+                 (reference: staging/src/k8s.io/api + apimachinery)
+  store/       - versioned in-memory MVCC store with watch
+                 (reference: etcd + staging/src/k8s.io/apiserver/pkg/storage)
+  apiserver/   - REST+watch server over the store
+  client/      - reflector / informer / lister / workqueue / leader election
+                 (reference: staging/src/k8s.io/client-go)
+  scheduler/   - queue, cache, framework extension points, pure-python plugins
+                 (reference: pkg/scheduler)
+  ops/         - snapshot->tensor flattener, vmapped predicates/scores, kernels
+  models/      - batched assignment solvers (greedy, auction/sinkhorn)
+  parallel/    - device mesh + shard_map sharding of the node axis
+  controllers/ - replicaset/deployment/... reconcilers (reference: pkg/controller)
+  kubelet/     - hollow node agent (reference: pkg/kubelet + kubemark)
+  proxy/       - service->endpoint dataplane simulation (reference: pkg/proxy)
+  cli/         - kubectl-equivalent CLI
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,4 @@
+"""Object model: JSON-shaped objects, quantities, labels, resource accounting."""
+
+from . import labels, meta, quantity, resources  # noqa: F401
+from .meta import Obj  # noqa: F401

@@ -1,0 +1,101 @@
+"""Label sets and selectors.
+
+Reference semantics: staging/src/k8s.io/apimachinery/pkg/labels/selector.go
+(operators In/NotIn/Exists/DoesNotExist/Gt/Lt) and
+pkg/apis/meta/v1 LabelSelector (matchLabels + matchExpressions), converted via
+LabelSelectorAsSelector (apimachinery/pkg/apis/meta/v1/helpers.go).
+
+A selector is compiled once into a list of requirement tuples and evaluated
+against plain dict label sets.  The TPU flattener further compiles selectors
+into hashed-vocabulary integer arrays (ops/flatten.py); this module is the
+scalar truth.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable
+
+# Operator constants mirror metav1.LabelSelectorOperator / selection.Operator.
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+
+@dataclass(frozen=True, slots=True)
+class Requirement:
+    key: str
+    operator: str
+    values: tuple[str, ...] = ()
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        has = self.key in labels
+        if self.operator == EXISTS:
+            return has
+        if self.operator == DOES_NOT_EXIST:
+            return not has
+        if self.operator == IN:
+            return has and labels[self.key] in self.values
+        if self.operator == NOT_IN:
+            # NotIn matches when the key is absent OR value not in set
+            # (matches reference labels.Requirement.Matches).
+            return not has or labels[self.key] not in self.values
+        if self.operator in (GT, LT):
+            if not has:
+                return False
+            try:
+                lhs = int(labels[self.key])
+                rhs = int(self.values[0])
+            except (ValueError, IndexError):
+                return False
+            return lhs > rhs if self.operator == GT else lhs < rhs
+        raise ValueError(f"unknown operator {self.operator!r}")
+
+
+@dataclass(frozen=True, slots=True)
+class Selector:
+    """Compiled selector: conjunction of requirements. Empty selects everything."""
+
+    requirements: tuple[Requirement, ...] = ()
+    # A LabelSelector of `None` in the API means "match nothing"; we encode that
+    # with match_nothing=True (reference: LabelSelectorAsSelector(nil) -> Nothing()).
+    match_nothing: bool = False
+
+    def matches(self, labels: dict[str, str] | None) -> bool:
+        if self.match_nothing:
+            return False
+        labels = labels or {}
+        return all(r.matches(labels) for r in self.requirements)
+
+    def is_empty(self) -> bool:
+        return not self.match_nothing and not self.requirements
+
+
+EVERYTHING = Selector()
+NOTHING = Selector(match_nothing=True)
+
+
+def selector_from_dict(spec: dict | None) -> Selector:
+    """Compile a metav1.LabelSelector JSON dict into a Selector.
+
+    None -> NOTHING; {} -> EVERYTHING (matches reference helpers.go semantics).
+    """
+    if spec is None:
+        return NOTHING
+    reqs: list[Requirement] = []
+    for k, v in sorted((spec.get("matchLabels") or {}).items()):
+        reqs.append(Requirement(k, IN, (v,)))
+    for expr in spec.get("matchExpressions") or ():
+        op = expr["operator"]
+        values = tuple(expr.get("values") or ())
+        reqs.append(Requirement(expr["key"], op, values))
+    return Selector(tuple(reqs))
+
+
+def selector_from_match_labels(match_labels: dict[str, str] | None) -> Selector:
+    if match_labels is None:
+        return NOTHING
+    return Selector(tuple(Requirement(k, IN, (v,)) for k, v in sorted(match_labels.items())))

@@ -1,0 +1,72 @@
+"""Resource quantity parsing/formatting.
+
+Reference semantics: staging/src/k8s.io/apimachinery/pkg/api/resource/quantity.go
+(suffix grammar at suffix.go) — decimal SI (n, u, m, "", k, M, G, T, P, E) and
+binary (Ki, Mi, Gi, Ti, Pi, Ei) suffixes, plus scientific notation.
+
+The scheduler never works with arbitrary-precision quantities: like the
+reference's framework.Resource (pkg/scheduler/framework/types.go:426), we
+canonicalize at the edge:
+  cpu               -> integer millicores  (parse_cpu_milli)
+  memory/storage    -> integer bytes       (parse_mem_bytes)
+  everything else   -> integer base units
+so the TPU flattener only ever sees int64/float32 arrays.
+"""
+
+from __future__ import annotations
+
+import re
+
+_BIN = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60}
+_DEC = {
+    "n": 10**-9, "u": 10**-6, "m": 10**-3, "": 1,
+    "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15, "E": 10**18,
+}
+
+_QTY_RE = re.compile(
+    r"^(?P<sign>[+-]?)(?P<num>\d+(?:\.\d*)?|\.\d+)"
+    r"(?:(?P<suffix>[numkMGTPE]|[KMGTPE]i)|[eE](?P<exp>[+-]?\d+))?$"
+)
+
+
+def parse_quantity(s: str | int | float) -> float:
+    """Parse a Kubernetes quantity string into a float of base units."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    s = s.strip()
+    m = _QTY_RE.match(s)
+    if not m:
+        raise ValueError(f"invalid quantity: {s!r}")
+    value = float(m.group("num"))
+    if m.group("sign") == "-":
+        value = -value
+    suffix = m.group("suffix")
+    if suffix:
+        value *= _BIN[suffix] if suffix in _BIN else _DEC[suffix]
+    elif m.group("exp") is not None:
+        value *= 10.0 ** int(m.group("exp"))
+    return value
+
+
+def parse_cpu_milli(s: str | int | float) -> int:
+    """CPU quantity -> integer millicores ("100m" -> 100, "2" -> 2000)."""
+    return round(parse_quantity(s) * 1000)
+
+
+def parse_mem_bytes(s: str | int | float) -> int:
+    """Memory/storage quantity -> integer bytes ("64Mi" -> 67108864)."""
+    return round(parse_quantity(s))
+
+
+def format_cpu_milli(milli: int) -> str:
+    if milli % 1000 == 0:
+        return str(milli // 1000)
+    return f"{milli}m"
+
+
+def format_mem_bytes(n: int) -> str:
+    for suf in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
+        d = _BIN[suf]
+        if n >= d and n % d == 0:
+            return f"{n // d}{suf}"
+    return str(n)

@@ -1,0 +1,126 @@
+"""Pod/Node resource accounting.
+
+Reference semantics:
+  pkg/scheduler/framework/types.go:426  (Resource: MilliCPU/Memory/
+    EphemeralStorage/AllowedPodNumber/ScalarResources)
+  pkg/scheduler/framework/plugins/noderesources/fit.go:160
+    (computePodResourceRequest: sum containers, max with initContainers,
+     add pod overhead)
+  pkg/api/v1/pod util + scheduler GetNonzeroRequests (non-zero defaults:
+    100m CPU / 200Mi memory for pods that request nothing, used only by
+    scoring so empty pods still spread).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from .quantity import parse_cpu_milli, parse_mem_bytes, parse_quantity
+
+# Well-known resource names (reference: v1.ResourceCPU etc.)
+CPU = "cpu"
+MEMORY = "memory"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+PODS = "pods"
+
+# Scoring defaults for pods with no requests
+# (reference: pkg/scheduler/util/non_zero.go DefaultMilliCPURequest/DefaultMemoryRequest).
+DEFAULT_MILLI_CPU_REQUEST = 100
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
+
+
+@dataclass(slots=True)
+class Resource:
+    """Canonical integer resource vector (framework/types.go:426)."""
+
+    milli_cpu: int = 0
+    memory: int = 0
+    ephemeral_storage: int = 0
+    allowed_pod_number: int = 0
+    scalar: dict[str, float] = field(default_factory=dict)
+
+    def add(self, other: "Resource") -> None:
+        self.milli_cpu += other.milli_cpu
+        self.memory += other.memory
+        self.ephemeral_storage += other.ephemeral_storage
+        for k, v in other.scalar.items():
+            self.scalar[k] = self.scalar.get(k, 0) + v
+
+    def sub(self, other: "Resource") -> None:
+        self.milli_cpu -= other.milli_cpu
+        self.memory -= other.memory
+        self.ephemeral_storage -= other.ephemeral_storage
+        for k, v in other.scalar.items():
+            self.scalar[k] = self.scalar.get(k, 0) - v
+
+    def set_max(self, other: "Resource") -> None:
+        self.milli_cpu = max(self.milli_cpu, other.milli_cpu)
+        self.memory = max(self.memory, other.memory)
+        self.ephemeral_storage = max(self.ephemeral_storage, other.ephemeral_storage)
+        for k, v in other.scalar.items():
+            self.scalar[k] = max(self.scalar.get(k, 0), v)
+
+    def clone(self) -> "Resource":
+        return Resource(self.milli_cpu, self.memory, self.ephemeral_storage,
+                        self.allowed_pod_number, dict(self.scalar))
+
+
+def _parse_resource_list(rl: dict[str, Any] | None) -> Resource:
+    r = Resource()
+    for k, v in (rl or {}).items():
+        if k == CPU:
+            r.milli_cpu = parse_cpu_milli(v)
+        elif k == MEMORY:
+            r.memory = parse_mem_bytes(v)
+        elif k == EPHEMERAL_STORAGE:
+            r.ephemeral_storage = parse_mem_bytes(v)
+        elif k == PODS:
+            r.allowed_pod_number = int(parse_quantity(v))
+        else:
+            r.scalar[k] = parse_quantity(v)
+    return r
+
+
+def pod_request(pod: dict) -> Resource:
+    """computePodResourceRequest (noderesources/fit.go:160): sum of container
+    requests, component-wise max with each initContainer, plus pod overhead."""
+    spec = pod.get("spec") or {}
+    total = Resource()
+    for c in spec.get("containers") or ():
+        total.add(_parse_resource_list((c.get("resources") or {}).get("requests")))
+    for c in spec.get("initContainers") or ():
+        total.set_max(_parse_resource_list((c.get("resources") or {}).get("requests")))
+    if spec.get("overhead"):
+        total.add(_parse_resource_list(spec["overhead"]))
+    return total
+
+
+def pod_request_nonzero(pod: dict) -> Resource:
+    """Like pod_request but with scoring defaults applied (non_zero.go)."""
+    r = pod_request(pod)
+    if r.milli_cpu == 0:
+        r.milli_cpu = DEFAULT_MILLI_CPU_REQUEST
+    if r.memory == 0:
+        r.memory = DEFAULT_MEMORY_REQUEST
+    return r
+
+
+def node_allocatable(node: dict) -> Resource:
+    status = node.get("status") or {}
+    rl = status.get("allocatable") or status.get("capacity")
+    r = _parse_resource_list(rl)
+    if r.allowed_pod_number == 0:
+        r.allowed_pod_number = 110  # kubelet default max-pods
+    return r
+
+
+def make_resource_list(cpu_milli: int = 0, mem: int = 0, pods: int = 110,
+                       ephemeral: int = 0, **scalar: float) -> dict[str, str]:
+    """Convenience builder for node capacity/allocatable dicts (tests/benches)."""
+    rl = {CPU: f"{cpu_milli}m", MEMORY: str(mem), PODS: str(pods)}
+    if ephemeral:
+        rl[EPHEMERAL_STORAGE] = str(ephemeral)
+    for k, v in scalar.items():
+        rl[k] = str(v)
+    return rl

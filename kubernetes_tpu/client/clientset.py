@@ -1,0 +1,151 @@
+"""Client interface to the API.
+
+Reference: staging/src/k8s.io/client-go (typed clientset).  Two
+implementations share one interface:
+
+  LocalClient - in-process, directly over store.MemoryStore.  This is what
+      integration tests and scheduler_perf use (the reference does the same:
+      its integration harness runs an in-process apiserver,
+      test/integration/framework/test_server.go:62).
+  HTTPClient  - over the REST apiserver (apiserver/server.py), for
+      multi-process deployments.  (added by apiserver module)
+
+All methods deal in JSON-shaped dict objects (api.meta.Obj).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..api import meta
+from ..api.meta import Obj
+from ..store import kv
+from ..store.kv import MemoryStore, Watch
+
+# Canonical resource names (plural, lowercase — like REST paths).
+PODS = "pods"
+NODES = "nodes"
+SERVICES = "services"
+ENDPOINTS = "endpoints"
+EVENTS = "events"
+LEASES = "leases"
+REPLICASETS = "replicasets"
+DEPLOYMENTS = "deployments"
+JOBS = "jobs"
+NAMESPACES = "namespaces"
+CONFIGMAPS = "configmaps"
+SECRETS = "secrets"
+PVCS = "persistentvolumeclaims"
+PVS = "persistentvolumes"
+PDBS = "poddisruptionbudgets"
+PODGROUPS = "podgroups"
+STATEFULSETS = "statefulsets"
+DAEMONSETS = "daemonsets"
+REPLICATIONCONTROLLERS = "replicationcontrollers"
+PRIORITYCLASSES = "priorityclasses"
+STORAGECLASSES = "storageclasses"
+CSINODES = "csinodes"
+
+
+class Client:
+    """Abstract client; see LocalClient."""
+
+    def create(self, resource: str, obj: Obj) -> Obj:
+        raise NotImplementedError
+
+    def get(self, resource: str, namespace: str, name: str) -> Obj:
+        raise NotImplementedError
+
+    def update(self, resource: str, obj: Obj) -> Obj:
+        raise NotImplementedError
+
+    def guaranteed_update(self, resource: str, namespace: str, name: str,
+                          fn: Callable[[Obj], Obj]) -> Obj:
+        raise NotImplementedError
+
+    def delete(self, resource: str, namespace: str, name: str) -> Obj:
+        raise NotImplementedError
+
+    def list(self, resource: str, namespace: str | None = None) -> tuple[list[Obj], int]:
+        raise NotImplementedError
+
+    def watch(self, resource: str, since_rv: int = 0) -> Watch:
+        raise NotImplementedError
+
+    # -- conveniences used across the tree --------------------------------
+
+    def bind(self, pod: Obj, node_name: str) -> Obj:
+        """POST pods/{name}/binding equivalent: set spec.nodeName atomically.
+
+        Reference: pkg/registry/core/pod/storage BindingREST — fails if the
+        pod is already bound (the scheduler relies on this for correctness
+        under races).
+        """
+        ns, nm = meta.namespace(pod), meta.name(pod)
+
+        def apply(cur: Obj) -> Obj:
+            if cur["spec"].get("nodeName"):
+                raise kv.ConflictError(
+                    f"pod {ns}/{nm} is already bound to {cur['spec']['nodeName']!r}")
+            cur["spec"]["nodeName"] = node_name
+            conds = cur.setdefault("status", {}).setdefault("conditions", [])
+            conds.append({"type": "PodScheduled", "status": "True"})
+            return cur
+
+        return self.guaranteed_update(PODS, ns, nm, apply)
+
+    def update_status(self, resource: str, obj: Obj) -> Obj:
+        """Status-subresource write: merge .status only."""
+        status = obj.get("status") or {}
+
+        def apply(cur: Obj) -> Obj:
+            cur["status"] = status
+            return cur
+
+        return self.guaranteed_update(resource, meta.namespace(obj), meta.name(obj), apply)
+
+    def create_event(self, regarding: Obj, reason: str, message: str,
+                     type_: str = "Normal") -> None:
+        """Fire-and-forget Event (reference: events broadcaster -> apiserver)."""
+        import time as _t
+        ev = meta.new_object("Event", f"{meta.name(regarding)}.{int(_t.time()*1e6):x}",
+                             meta.namespace(regarding) or "default")
+        ev.update({
+            "type": type_, "reason": reason, "message": message,
+            "involvedObject": {"kind": regarding.get("kind"),
+                               "namespace": meta.namespace(regarding),
+                               "name": meta.name(regarding), "uid": meta.uid(regarding)},
+        })
+        try:
+            self.create(EVENTS, ev)
+        except kv.StoreError:
+            pass
+
+
+class LocalClient(Client):
+    """Direct in-process client over a MemoryStore."""
+
+    def __init__(self, store: MemoryStore):
+        self.store = store
+
+    def create(self, resource: str, obj: Obj) -> Obj:
+        return self.store.create(resource, obj)
+
+    def get(self, resource: str, namespace: str, name: str) -> Obj:
+        return self.store.get(resource, namespace, name)
+
+    def update(self, resource: str, obj: Obj) -> Obj:
+        return self.store.update(resource, obj)
+
+    def guaranteed_update(self, resource: str, namespace: str, name: str,
+                          fn: Callable[[Obj], Obj]) -> Obj:
+        return self.store.guaranteed_update(resource, namespace, name, fn)
+
+    def delete(self, resource: str, namespace: str, name: str) -> Obj:
+        return self.store.delete(resource, namespace, name)
+
+    def list(self, resource: str, namespace: str | None = None) -> tuple[list[Obj], int]:
+        return self.store.list(resource, namespace)
+
+    def watch(self, resource: str, since_rv: int = 0) -> Watch:
+        return self.store.watch(resource, since_rv)

@@ -1,0 +1,194 @@
+"""Reflector + shared informer + lister.
+
+Reference semantics:
+  staging/src/k8s.io/client-go/tools/cache/reflector.go:256 (ListAndWatch:
+    list -> sync handlers -> watch from list rv; on "too old" -> relist)
+  tools/cache/shared_informer.go (one informer per resource shared by all
+    consumers; handlers receive add/update/delete in event order)
+  tools/cache/thread_safe_store.go (indexer) + listers
+
+Differences from the reference, on purpose:
+  * No DeltaFIFO: our store's Watch already delivers a linearized, complete
+    event stream per resource (same lock that orders writes orders events),
+    so the informer thread applies events straight to the indexer and calls
+    handlers synchronously on that single thread.  This preserves the only
+    property consumers rely on — per-resource events are delivered in order,
+    and the indexer is updated before handlers run.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable
+
+from ..api import meta
+from ..api.meta import Obj
+from ..store import kv
+from .clientset import Client
+
+logger = logging.getLogger(__name__)
+
+EventHandler = Callable[[str, Obj, Obj | None], None]
+# signature: (event_type, obj, old_obj_or_None)
+
+
+class Informer:
+    """List+watch one resource into an in-memory indexer; fan out to handlers."""
+
+    def __init__(self, client: Client, resource: str):
+        self.client = client
+        self.resource = resource
+        self._lock = threading.RLock()
+        self._indexer: dict[str, Obj] = {}
+        self._handlers: list[EventHandler] = []
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lister ----------------------------------------------------------
+
+    def get(self, namespace: str, name: str) -> Obj | None:
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace else name
+            return self._indexer.get(key)
+
+    def get_by_key(self, key: str) -> Obj | None:
+        with self._lock:
+            return self._indexer.get(key)
+
+    def list(self, namespace: str | None = None) -> list[Obj]:
+        with self._lock:
+            if namespace:
+                prefix = namespace + "/"
+                return [o for k, o in self._indexer.items() if k.startswith(prefix)]
+            return list(self._indexer.values())
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._indexer.keys())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._indexer)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def add_event_handler(self, handler: EventHandler) -> None:
+        """Register a handler. If already synced, replays adds (shared_informer
+        semantics: late handlers get a full resync of existing objects)."""
+        with self._lock:
+            self._handlers.append(handler)
+            if self._synced.is_set():
+                for obj in self._indexer.values():
+                    handler(kv.ADDED, obj, None)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"informer-{self.resource}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait_for_cache_sync(self, timeout: float = 30.0) -> bool:
+        return self._synced.wait(timeout)
+
+    @property
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    # -- reflector loop --------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._list_and_watch()
+            except kv.TooOldError:
+                logger.info("informer %s: watch too old, relisting", self.resource)
+                continue
+            except Exception:  # pragma: no cover - defensive, crash-only restart
+                logger.exception("informer %s: list/watch failed, retrying", self.resource)
+                self._stop.wait(1.0)
+
+    def _list_and_watch(self) -> None:
+        items, rv = self.client.list(self.resource)
+        fresh = {meta.namespaced_name(o): o for o in items}
+        with self._lock:
+            old = self._indexer
+            self._indexer = fresh
+            # Replace semantics: diff old vs new and emit synthetic events
+            # (DeltaFIFO Replace -> Sync/Delete).
+            for key, obj in fresh.items():
+                prev = old.get(key)
+                if prev is None:
+                    self._dispatch(kv.ADDED, obj, None)
+                elif meta.resource_version(prev) != meta.resource_version(obj):
+                    self._dispatch(kv.MODIFIED, obj, prev)
+            for key, prev in old.items():
+                if key not in fresh:
+                    self._dispatch(kv.DELETED, prev, None)
+        self._synced.set()
+
+        w = self.client.watch(self.resource, since_rv=rv)
+        try:
+            while not self._stop.is_set():
+                ev = w.next(timeout=0.5)
+                if ev is None:
+                    if w.stopped:
+                        return
+                    continue
+                with self._lock:
+                    key = meta.namespaced_name(ev.object)
+                    if ev.type == kv.DELETED:
+                        old_obj = self._indexer.pop(key, None)
+                        self._dispatch(kv.DELETED, ev.object, old_obj)
+                    else:
+                        prev = self._indexer.get(key)
+                        self._indexer[key] = ev.object
+                        self._dispatch(kv.MODIFIED if prev is not None else kv.ADDED,
+                                       ev.object, prev)
+        finally:
+            w.stop()
+
+    def _dispatch(self, type_: str, obj: Obj, old: Obj | None) -> None:
+        for h in self._handlers:
+            try:
+                h(type_, obj, old)
+            except Exception:  # pragma: no cover
+                logger.exception("informer %s: handler error on %s", self.resource, type_)
+
+
+class SharedInformerFactory:
+    """One Informer per resource, shared (client-go informers.SharedInformerFactory)."""
+
+    def __init__(self, client: Client):
+        self.client = client
+        self._lock = threading.Lock()
+        self._informers: dict[str, Informer] = {}
+
+    def informer(self, resource: str) -> Informer:
+        with self._lock:
+            inf = self._informers.get(resource)
+            if inf is None:
+                inf = self._informers[resource] = Informer(self.client, resource)
+            return inf
+
+    def start(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.start()
+
+    def wait_for_cache_sync(self, timeout: float = 30.0) -> bool:
+        with self._lock:
+            informers = list(self._informers.values())
+        return all(inf.wait_for_cache_sync(timeout) for inf in informers)
+
+    def stop(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.stop()

@@ -1,0 +1,267 @@
+"""Versioned in-memory MVCC store with watch — the etcd3-equivalent.
+
+Reference semantics this reproduces (not the implementation):
+  staging/src/k8s.io/apiserver/pkg/storage/interfaces.go:159 (storage.Interface)
+  staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go:154,331,526,798
+    (Create / GuaranteedUpdate CAS / GetList / Watch)
+  staging/src/k8s.io/apiserver/pkg/storage/cacher/ (watch ring buffer,
+    "too old resource version" -> client relists)
+
+Design:
+  * single monotonically-increasing int64 revision shared by all resources
+    (like etcd's store revision); every write stamps the object's
+    metadata.resourceVersion with it.
+  * per-resource maps keyed by "ns/name".
+  * optimistic concurrency: update/delete take an expected resourceVersion and
+    raise ConflictError on mismatch (the CAS txn in etcd3/store.go:331).
+  * watch: per-watcher unbounded-ish queue fed synchronously under the write
+    lock (so event order == revision order); a bounded history ring lets
+    watchers resume from a recent revision, older resumes raise TooOldError
+    which informers answer by re-listing (reflector.go:256 semantics).
+
+Thread-safe; all blocking happens in Watch.next(), never under the lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Iterator
+
+from ..api import meta
+from ..api.meta import Obj
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
+ERROR = "ERROR"
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFoundError(StoreError):
+    pass
+
+
+class AlreadyExistsError(StoreError):
+    pass
+
+
+class ConflictError(StoreError):
+    """resourceVersion mismatch — caller should re-get and retry."""
+
+
+class TooOldError(StoreError):
+    """Requested watch revision has been compacted — caller must re-list."""
+
+
+class WatchEvent:
+    __slots__ = ("type", "object", "revision")
+
+    def __init__(self, type_: str, obj: Obj, revision: int):
+        self.type = type_
+        self.object = obj
+        self.revision = revision
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"WatchEvent({self.type}, rv={self.revision}, {meta.namespaced_name(self.object)})"
+
+
+class Watch:
+    """A single watch stream. Iterate or call next(timeout)."""
+
+    def __init__(self, store: "MemoryStore", resource: str):
+        self._store = store
+        self._resource = resource
+        self._cond = threading.Condition()
+        self._queue: deque[WatchEvent] = deque()
+        self._stopped = False
+
+    def _push(self, ev: WatchEvent) -> None:
+        with self._cond:
+            if not self._stopped:
+                self._queue.append(ev)
+                self._cond.notify()
+
+    def next(self, timeout: float | None = None) -> WatchEvent | None:
+        with self._cond:
+            if not self._queue and not self._stopped:
+                self._cond.wait(timeout)
+            if self._queue:
+                return self._queue.popleft()
+            return None
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._store._remove_watch(self._resource, self)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            ev = self.next()
+            if ev is None:
+                return
+            yield ev
+
+
+class MemoryStore:
+    """The cluster store. One instance == one 'etcd'."""
+
+    def __init__(self, history: int = 100_000):
+        self._lock = threading.RLock()
+        self._rev = 0
+        # resource -> {"ns/name": obj}
+        self._data: dict[str, dict[str, Obj]] = {}
+        # resource -> ring of WatchEvent for resumable watches
+        self._history: dict[str, deque[WatchEvent]] = {}
+        self._history_len = history
+        # resource -> oldest revision still in history (compaction floor)
+        self._watchers: dict[str, list[Watch]] = {}
+
+    # -- internals -------------------------------------------------------
+
+    def _table(self, resource: str) -> dict[str, Obj]:
+        return self._data.setdefault(resource, {})
+
+    def _emit(self, resource: str, type_: str, obj: Obj) -> None:
+        ev = WatchEvent(type_, obj, self._rev)
+        hist = self._history.setdefault(resource, deque(maxlen=self._history_len))
+        hist.append(ev)
+        for w in self._watchers.get(resource, ()):  # synchronous, ordered
+            w._push(ev)
+
+    def _remove_watch(self, resource: str, w: Watch) -> None:
+        with self._lock:
+            try:
+                self._watchers.get(resource, []).remove(w)
+            except ValueError:
+                pass
+
+    @staticmethod
+    def _key(obj_or_ns: Obj | str, nm: str | None = None) -> str:
+        if isinstance(obj_or_ns, dict):
+            return meta.namespaced_name(obj_or_ns)
+        return f"{obj_or_ns}/{nm}" if obj_or_ns else (nm or "")
+
+    # -- storage.Interface -----------------------------------------------
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._rev
+
+    def create(self, resource: str, obj: Obj) -> Obj:
+        with self._lock:
+            key = meta.namespaced_name(obj)
+            table = self._table(resource)
+            if key in table:
+                raise AlreadyExistsError(f"{resource} {key!r} already exists")
+            obj = meta.deep_copy(obj)
+            meta.finalize_new(obj)
+            self._rev += 1
+            meta.set_resource_version(obj, self._rev)
+            table[key] = obj
+            self._emit(resource, ADDED, obj)
+            return meta.deep_copy(obj)
+
+    def get(self, resource: str, namespace: str, name: str) -> Obj:
+        with self._lock:
+            table = self._table(resource)
+            key = self._key(namespace, name)
+            if key not in table:
+                raise NotFoundError(f"{resource} {key!r} not found")
+            return meta.deep_copy(table[key])
+
+    def update(self, resource: str, obj: Obj, expect_rv: int | None = None) -> Obj:
+        """CAS update: expect_rv defaults to the object's own resourceVersion."""
+        with self._lock:
+            table = self._table(resource)
+            key = meta.namespaced_name(obj)
+            if key not in table:
+                raise NotFoundError(f"{resource} {key!r} not found")
+            cur = table[key]
+            want = expect_rv if expect_rv is not None else meta.resource_version(obj)
+            if want and want != meta.resource_version(cur):
+                raise ConflictError(
+                    f"{resource} {key!r}: rv {want} != current {meta.resource_version(cur)}")
+            obj = meta.deep_copy(obj)
+            obj["metadata"]["uid"] = meta.uid(cur) or meta.uid(obj)
+            obj["metadata"].setdefault("creationTimestamp", meta.creation_timestamp(cur))
+            self._rev += 1
+            meta.set_resource_version(obj, self._rev)
+            table[key] = obj
+            self._emit(resource, MODIFIED, obj)
+            return meta.deep_copy(obj)
+
+    def guaranteed_update(self, resource: str, namespace: str, name: str,
+                          fn: Callable[[Obj], Obj], max_retries: int = 16) -> Obj:
+        """GuaranteedUpdate (etcd3/store.go:331): get -> transform -> CAS, retry on conflict."""
+        for _ in range(max_retries):
+            cur = self.get(resource, namespace, name)
+            updated = fn(meta.deep_copy(cur))
+            try:
+                return self.update(resource, updated, expect_rv=meta.resource_version(cur))
+            except ConflictError:
+                continue
+        raise ConflictError(f"{resource} {namespace}/{name}: too many CAS retries")
+
+    def delete(self, resource: str, namespace: str, name: str,
+               expect_rv: int | None = None) -> Obj:
+        with self._lock:
+            table = self._table(resource)
+            key = self._key(namespace, name)
+            if key not in table:
+                raise NotFoundError(f"{resource} {key!r} not found")
+            cur = table[key]
+            if expect_rv is not None and expect_rv != meta.resource_version(cur):
+                raise ConflictError(f"{resource} {key!r}: stale delete")
+            del table[key]
+            self._rev += 1
+            tomb = meta.deep_copy(cur)
+            meta.set_resource_version(tomb, self._rev)
+            self._emit(resource, DELETED, tomb)
+            return tomb
+
+    def list(self, resource: str, namespace: str | None = None) -> tuple[list[Obj], int]:
+        """GetList (etcd3/store.go:526): returns (items, list revision)."""
+        with self._lock:
+            table = self._table(resource)
+            if namespace:
+                prefix = namespace + "/"
+                items = [meta.deep_copy(o) for k, o in table.items() if k.startswith(prefix)]
+            else:
+                items = [meta.deep_copy(o) for o in table.values()]
+            return items, self._rev
+
+    def count(self, resource: str) -> int:
+        with self._lock:
+            return len(self._table(resource))
+
+    def watch(self, resource: str, since_rv: int = 0) -> Watch:
+        """Open a watch delivering every event with revision > since_rv.
+
+        since_rv=0 means "from now".  Raises TooOldError if since_rv predates
+        the retained history (client must re-list, reflector.go semantics).
+        """
+        with self._lock:
+            w = Watch(self, resource)
+            hist = self._history.get(resource)
+            if since_rv and hist:
+                # If the ring is full, events older than hist[0] were dropped;
+                # we can only guarantee completeness for since_rv at or past
+                # hist[0].revision - 1 (conservative, like etcd compaction).
+                if len(hist) == hist.maxlen and since_rv < hist[0].revision - 1:
+                    raise TooOldError(f"watch {resource} from rv {since_rv}: compacted")
+                for ev in hist:
+                    if ev.revision > since_rv:
+                        w._push(ev)
+            self._watchers.setdefault(resource, []).append(w)
+            return w

@@ -1,0 +1,128 @@
+"""Tests for api/: quantities, labels, resource accounting.
+
+Mirrors the reference's table-driven unit style
+(apimachinery/pkg/api/resource/quantity_test.go, labels/selector_test.go).
+"""
+
+import pytest
+
+from kubernetes_tpu.api import labels as lbl
+from kubernetes_tpu.api import resources as res
+from kubernetes_tpu.api.quantity import (
+    format_cpu_milli, format_mem_bytes, parse_cpu_milli, parse_mem_bytes, parse_quantity,
+)
+
+
+class TestQuantity:
+    @pytest.mark.parametrize("s,expected", [
+        ("100m", 0.1), ("1", 1.0), ("2.5", 2.5), ("1k", 1000.0),
+        ("64Mi", 64 * 2**20), ("1Gi", 2**30), ("1G", 1e9),
+        ("500n", 5e-7), ("12e3", 12000.0), ("1E2", 100.0),
+        ("-5m", -0.005), (250, 250.0), (0.5, 0.5),
+    ])
+    def test_parse(self, s, expected):
+        assert parse_quantity(s) == pytest.approx(expected)
+
+    def test_cpu_milli(self):
+        assert parse_cpu_milli("100m") == 100
+        assert parse_cpu_milli("2") == 2000
+        assert parse_cpu_milli("1.5") == 1500
+
+    def test_mem_bytes(self):
+        assert parse_mem_bytes("64Mi") == 64 * 2**20
+        assert parse_mem_bytes("1000") == 1000
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+        with pytest.raises(ValueError):
+            parse_quantity("1Zi")
+
+    def test_format_roundtrip(self):
+        assert format_cpu_milli(1500) == "1500m"
+        assert format_cpu_milli(2000) == "2"
+        assert format_mem_bytes(64 * 2**20) == "64Mi"
+        assert format_mem_bytes(1001) == "1001"
+
+
+class TestSelectors:
+    def test_match_labels(self):
+        s = lbl.selector_from_dict({"matchLabels": {"app": "web"}})
+        assert s.matches({"app": "web", "tier": "fe"})
+        assert not s.matches({"app": "db"})
+        assert not s.matches({})
+
+    def test_nil_selector_matches_nothing(self):
+        assert not lbl.selector_from_dict(None).matches({"a": "b"})
+
+    def test_empty_selector_matches_everything(self):
+        assert lbl.selector_from_dict({}).matches({"a": "b"})
+        assert lbl.selector_from_dict({}).matches({})
+
+    @pytest.mark.parametrize("op,values,labels,want", [
+        ("In", ["a", "b"], {"k": "a"}, True),
+        ("In", ["a", "b"], {"k": "c"}, False),
+        ("In", ["a"], {}, False),
+        ("NotIn", ["a"], {"k": "b"}, True),
+        ("NotIn", ["a"], {}, True),   # absent key matches NotIn
+        ("NotIn", ["a"], {"k": "a"}, False),
+        ("Exists", [], {"k": "x"}, True),
+        ("Exists", [], {}, False),
+        ("DoesNotExist", [], {}, True),
+        ("DoesNotExist", [], {"k": "x"}, False),
+        ("Gt", ["5"], {"k": "7"}, True),
+        ("Gt", ["5"], {"k": "3"}, False),
+        ("Lt", ["5"], {"k": "3"}, True),
+        ("Gt", ["5"], {"k": "abc"}, False),
+    ])
+    def test_operators(self, op, values, labels, want):
+        s = lbl.selector_from_dict(
+            {"matchExpressions": [{"key": "k", "operator": op, "values": values}]})
+        assert s.matches(labels) is want
+
+
+def mkpod(containers=None, init=None, overhead=None):
+    pod = {"metadata": {"name": "p", "namespace": "d"},
+           "spec": {"containers": containers or []}}
+    if init:
+        pod["spec"]["initContainers"] = init
+    if overhead:
+        pod["spec"]["overhead"] = overhead
+    return pod
+
+
+def ctr(cpu=None, mem=None, **scalar):
+    req = {}
+    if cpu is not None:
+        req["cpu"] = cpu
+    if mem is not None:
+        req["memory"] = mem
+    req.update(scalar)
+    return {"name": "c", "resources": {"requests": req}}
+
+
+class TestPodRequest:
+    def test_sum_containers(self):
+        r = res.pod_request(mkpod([ctr("100m", "64Mi"), ctr("200m", "128Mi")]))
+        assert r.milli_cpu == 300
+        assert r.memory == 192 * 2**20
+
+    def test_init_container_max(self):
+        # max(init) vs sum(containers), per fit.go:160
+        r = res.pod_request(mkpod([ctr("100m")], init=[ctr("500m")]))
+        assert r.milli_cpu == 500
+        r = res.pod_request(mkpod([ctr("100m"), ctr("200m")], init=[ctr("250m")]))
+        assert r.milli_cpu == 300
+
+    def test_overhead(self):
+        r = res.pod_request(mkpod([ctr("100m")], overhead={"cpu": "50m"}))
+        assert r.milli_cpu == 150
+
+    def test_scalar_resources(self):
+        r = res.pod_request(mkpod([ctr("1", **{"google.com/tpu": "4"})]))
+        assert r.scalar["google.com/tpu"] == 4
+
+    def test_nonzero_defaults(self):
+        r = res.pod_request_nonzero(mkpod([ctr()]))
+        assert r.milli_cpu == res.DEFAULT_MILLI_CPU_REQUEST
+        assert r.memory == res.DEFAULT_MEMORY_REQUEST

@@ -1,0 +1,216 @@
+"""Tests for store/kv.py: CRUD, CAS, watch, compaction, and for
+client/informer.py + workqueue.py over the store."""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import Informer, LocalClient, RateLimitingQueue, WorkQueue
+from kubernetes_tpu.store import kv
+
+
+def pod(name, ns="default", **extra):
+    o = meta.new_object("Pod", name, ns)
+    o["spec"] = extra.get("spec", {})
+    return o
+
+
+class TestStoreCRUD:
+    def test_create_get(self):
+        s = kv.MemoryStore()
+        s.create("pods", pod("a"))
+        got = s.get("pods", "default", "a")
+        assert meta.name(got) == "a"
+        assert meta.uid(got)
+        assert meta.resource_version(got) == 1
+
+    def test_create_duplicate(self):
+        s = kv.MemoryStore()
+        s.create("pods", pod("a"))
+        with pytest.raises(kv.AlreadyExistsError):
+            s.create("pods", pod("a"))
+
+    def test_get_missing(self):
+        s = kv.MemoryStore()
+        with pytest.raises(kv.NotFoundError):
+            s.get("pods", "default", "zzz")
+
+    def test_update_cas_conflict(self):
+        s = kv.MemoryStore()
+        created = s.create("pods", pod("a"))
+        stale = meta.deep_copy(created)
+        created["spec"]["nodeName"] = "n1"
+        s.update("pods", created)
+        stale["spec"]["nodeName"] = "n2"
+        with pytest.raises(kv.ConflictError):
+            s.update("pods", stale)
+
+    def test_guaranteed_update_retries(self):
+        s = kv.MemoryStore()
+        s.create("pods", pod("a"))
+        calls = []
+
+        def bump(o):
+            if not calls:
+                # interleave a conflicting write on first attempt
+                s.guaranteed_update("pods", "default", "a",
+                                    lambda x: ({**x, "spec": {"x": 1}}))
+            calls.append(1)
+            o["spec"]["nodeName"] = "n1"
+            return o
+
+        out = s.guaranteed_update("pods", "default", "a", bump)
+        assert out["spec"]["nodeName"] == "n1"
+        assert len(calls) == 2  # retried once
+
+    def test_delete_and_list(self):
+        s = kv.MemoryStore()
+        s.create("pods", pod("a"))
+        s.create("pods", pod("b", ns="kube-system"))
+        items, rv = s.list("pods")
+        assert len(items) == 2 and rv == 2
+        items, _ = s.list("pods", namespace="default")
+        assert [meta.name(o) for o in items] == ["a"]
+        s.delete("pods", "default", "a")
+        with pytest.raises(kv.NotFoundError):
+            s.get("pods", "default", "a")
+
+    def test_revisions_are_global(self):
+        s = kv.MemoryStore()
+        s.create("pods", pod("a"))
+        s.create("nodes", meta.new_object("Node", "n1", None))
+        assert s.revision == 2
+
+
+class TestWatch:
+    def test_watch_from_now(self):
+        s = kv.MemoryStore()
+        w = s.watch("pods")
+        s.create("pods", pod("a"))
+        ev = w.next(timeout=1)
+        assert ev.type == kv.ADDED and meta.name(ev.object) == "a"
+
+    def test_watch_replay_from_rv(self):
+        s = kv.MemoryStore()
+        s.create("pods", pod("a"))
+        s.create("pods", pod("b"))
+        _, rv = s.list("pods")
+        s.create("pods", pod("c"))
+        w = s.watch("pods", since_rv=rv)
+        ev = w.next(timeout=1)
+        assert meta.name(ev.object) == "c"
+
+    def test_watch_ordering_and_types(self):
+        s = kv.MemoryStore()
+        w = s.watch("pods")
+        p = s.create("pods", pod("a"))
+        p["spec"]["nodeName"] = "n"
+        s.update("pods", p)
+        s.delete("pods", "default", "a")
+        types = [w.next(timeout=1).type for _ in range(3)]
+        assert types == [kv.ADDED, kv.MODIFIED, kv.DELETED]
+
+    def test_watch_compaction(self):
+        s = kv.MemoryStore(history=4)
+        for i in range(10):
+            s.create("pods", pod(f"p{i}"))
+        with pytest.raises(kv.TooOldError):
+            s.watch("pods", since_rv=1)
+
+    def test_watch_isolated_per_resource(self):
+        s = kv.MemoryStore()
+        w = s.watch("nodes")
+        s.create("pods", pod("a"))
+        assert w.next(timeout=0.1) is None
+
+
+class TestInformer:
+    def test_sync_and_events(self):
+        s = kv.MemoryStore()
+        s.create("pods", pod("pre"))
+        client = LocalClient(s)
+        inf = Informer(client, "pods")
+        events = []
+        inf.add_event_handler(lambda t, o, old: events.append((t, meta.name(o))))
+        inf.start()
+        assert inf.wait_for_cache_sync(5)
+        assert inf.get("default", "pre") is not None
+
+        s.create("pods", pod("live"))
+        deadline = time.time() + 5
+        while len(events) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert ("ADDED", "pre") in events and ("ADDED", "live") in events
+        assert len(inf.list()) == 2
+        inf.stop()
+
+    def test_late_handler_gets_replay(self):
+        s = kv.MemoryStore()
+        s.create("pods", pod("a"))
+        inf = Informer(LocalClient(s), "pods")
+        inf.start()
+        assert inf.wait_for_cache_sync(5)
+        events = []
+        inf.add_event_handler(lambda t, o, old: events.append(t))
+        assert events == ["ADDED"]
+        inf.stop()
+
+    def test_update_delivers_old_object(self):
+        s = kv.MemoryStore()
+        p = s.create("pods", pod("a"))
+        inf = Informer(LocalClient(s), "pods")
+        inf.start()
+        inf.wait_for_cache_sync(5)
+        seen = []
+        inf.add_event_handler(lambda t, o, old: seen.append((t, old)))
+        p["spec"]["nodeName"] = "n1"
+        s.update("pods", p)
+        deadline = time.time() + 5
+        while len(seen) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        t, old = seen[-1]
+        assert t == kv.MODIFIED and old is not None and old["spec"].get("nodeName") is None
+        inf.stop()
+
+
+class TestWorkQueue:
+    def test_dedup(self):
+        q = WorkQueue()
+        q.add("a"); q.add("a"); q.add("b")
+        assert len(q) == 2
+
+    def test_readd_while_processing(self):
+        q = WorkQueue()
+        q.add("a")
+        item, _ = q.get()
+        q.add("a")          # re-added while in flight
+        assert len(q) == 0  # not queued yet
+        q.done(item)
+        assert len(q) == 1  # requeued on done
+
+    def test_shutdown(self):
+        q = WorkQueue()
+        results = []
+        t = threading.Thread(target=lambda: results.append(q.get()))
+        t.start()
+        q.shut_down()
+        t.join(2)
+        assert results == [(None, True)]
+
+    def test_rate_limited_backoff_growth(self):
+        q = RateLimitingQueue()
+        d1 = q.rate_limiter.when("x")
+        d2 = q.rate_limiter.when("x")
+        assert d2 == 2 * d1
+        q.forget("x")
+        assert q.rate_limiter.when("x") == d1
+        q.shut_down()
+
+    def test_add_after(self):
+        q = RateLimitingQueue()
+        q.add_after("x", 0.05)
+        item, shutdown = q.get(timeout=2)
+        assert item == "x" and not shutdown
+        q.shut_down()
