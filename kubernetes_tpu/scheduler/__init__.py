@@ -1,0 +1,33 @@
+"""TPU-native scheduler (reference: pkg/scheduler)."""
+
+from __future__ import annotations
+
+from ..client.clientset import Client
+from ..client.informer import SharedInformerFactory
+from .cache import Cache, Snapshot
+from .framework import CycleState, Framework, Handle
+from .plugins import DEFAULT_PLUGINS, DEFAULT_SCORE_WEIGHTS, build_default_plugins
+from .queue import SchedulingQueue
+from .scheduler import BatchBackend, Profile, Scheduler
+from .types import FitError, NodeInfo, PodInfo, QueuedPodInfo, Status
+
+
+def new_default_framework(client: Client, informer_factory=None,
+                          profile_name: str = "default-scheduler",
+                          enabled: list[str] | None = None,
+                          plugin_args: dict | None = None,
+                          score_weights: dict[str, int] | None = None) -> Framework:
+    handle = Handle(client=client, informer_factory=informer_factory)
+    plugins = build_default_plugins(handle, enabled, plugin_args)
+    return Framework(profile_name, plugins,
+                     score_weights=score_weights or DEFAULT_SCORE_WEIGHTS,
+                     handle=handle)
+
+
+def new_scheduler(client: Client, informer_factory: SharedInformerFactory,
+                  profiles: dict[str, Profile] | None = None) -> Scheduler:
+    """scheduler.New (scheduler.go:239) with default profile."""
+    if profiles is None:
+        fw = new_default_framework(client, informer_factory)
+        profiles = {"default-scheduler": Profile(fw)}
+    return Scheduler(client, informer_factory, profiles)

@@ -1,0 +1,270 @@
+"""Scheduler cache: authoritative in-memory cluster mirror with the
+assume/confirm lifecycle and incremental snapshotting.
+
+Reference semantics:
+  pkg/scheduler/internal/cache/interface.go:59-104 (Cache contract)
+  pkg/scheduler/internal/cache/cache.go:197 (UpdateSnapshot: generation-based
+    delta copy — only NodeInfos whose generation advanced since the last
+    snapshot are re-cloned)
+  pkg/scheduler/internal/cache/snapshot.go:29-43 (Snapshot: ordered node list
+    + affinity sublists + usedPVCSet; implements SharedLister)
+
+The assume/confirm protocol is what lets scheduling run ahead of the
+apiserver: `assume` optimistically adds the pod to the target node before the
+Binding write lands; the informer's Add event later *confirms* it; `forget`
+rolls it back on bind failure.  The TPU batch path relies on this exactly as
+the per-pod path does — each assignment out of a batch is assumed
+individually so failure handling stays per-pod.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Iterable
+
+from ..api import meta
+from ..api.meta import Obj
+from .types import NodeInfo, PodInfo, _generation
+
+logger = logging.getLogger(__name__)
+
+
+class Snapshot:
+    """Immutable per-cycle view of the cluster (snapshot.go:29).
+
+    `generation` is the max NodeInfo generation included; UpdateSnapshot uses
+    it to copy only dirty nodes.  The TPU flattener keys its dirty-row
+    re-encode off per-node generations too (ops/flatten.py).
+    """
+
+    def __init__(self) -> None:
+        self.node_info_map: dict[str, NodeInfo] = {}
+        self.node_info_list: list[NodeInfo] = []
+        self.have_pods_with_affinity_list: list[NodeInfo] = []
+        self.have_pods_with_required_anti_affinity_list: list[NodeInfo] = []
+        self.used_pvc_set: set[str] = set()
+        self.generation: int = 0
+
+    # SharedLister surface (framework.SharedLister)
+    def get(self, node_name: str) -> NodeInfo | None:
+        return self.node_info_map.get(node_name)
+
+    def list(self) -> list[NodeInfo]:
+        return self.node_info_list
+
+    def __len__(self) -> int:
+        return len(self.node_info_list)
+
+
+class _PodState:
+    __slots__ = ("pod", "assumed", "binding_finished", "deadline")
+
+    def __init__(self, pod: Obj, assumed: bool = False):
+        self.pod = pod
+        self.assumed = assumed
+        self.binding_finished = False
+        self.deadline: float | None = None
+
+
+class Cache:
+    """scheduler cache (cache.go)."""
+
+    def __init__(self, ttl: float = 0.0):
+        self._lock = threading.RLock()
+        self._nodes: dict[str, NodeInfo] = {}
+        self._pod_states: dict[str, _PodState] = {}
+        self._assumed_pods: set[str] = set()
+        self._ttl = ttl  # 0 = assumed pods never expire (reference default, scheduler.go:54)
+
+    # -- pods ------------------------------------------------------------
+
+    def assume_pod(self, pod: Obj) -> None:
+        key = meta.namespaced_name(pod)
+        with self._lock:
+            if key in self._pod_states:
+                raise ValueError(f"pod {key} already in cache")
+            self._add_pod_to_node(pod)
+            ps = _PodState(pod, assumed=True)
+            self._pod_states[key] = ps
+            self._assumed_pods.add(key)
+
+    def finish_binding(self, pod: Obj) -> None:
+        key = meta.namespaced_name(pod)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps and ps.assumed:
+                ps.binding_finished = True
+                if self._ttl > 0:
+                    ps.deadline = time.monotonic() + self._ttl
+
+    def forget_pod(self, pod: Obj) -> None:
+        key = meta.namespaced_name(pod)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps is None:
+                return
+            if not ps.assumed:
+                raise ValueError(f"pod {key} is not assumed; cannot forget")
+            self._remove_pod_from_node(ps.pod)
+            del self._pod_states[key]
+            self._assumed_pods.discard(key)
+
+    def add_pod(self, pod: Obj) -> None:
+        """Informer confirm: pod observed bound via watch."""
+        key = meta.namespaced_name(pod)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps is not None and ps.assumed:
+                # confirmation of an assumed pod
+                if meta.pod_node_name(ps.pod) != meta.pod_node_name(pod):
+                    # scheduled somewhere else than assumed: fix up
+                    self._remove_pod_from_node(ps.pod)
+                    self._add_pod_to_node(pod)
+                self._pod_states[key] = _PodState(pod)
+                self._assumed_pods.discard(key)
+            elif ps is None:
+                self._add_pod_to_node(pod)
+                self._pod_states[key] = _PodState(pod)
+            else:
+                # duplicate add — treat as update
+                self._remove_pod_from_node(ps.pod)
+                self._add_pod_to_node(pod)
+                self._pod_states[key] = _PodState(pod)
+
+    def update_pod(self, old: Obj, new: Obj) -> None:
+        key = meta.namespaced_name(new)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps is None:
+                self.add_pod(new)
+                return
+            self._remove_pod_from_node(ps.pod)
+            self._add_pod_to_node(new)
+            self._pod_states[key] = _PodState(new)
+            self._assumed_pods.discard(key)
+
+    def remove_pod(self, pod: Obj) -> None:
+        key = meta.namespaced_name(pod)
+        with self._lock:
+            ps = self._pod_states.get(key)
+            if ps is None:
+                return
+            self._remove_pod_from_node(ps.pod)
+            del self._pod_states[key]
+            self._assumed_pods.discard(key)
+
+    def is_assumed_pod(self, pod: Obj) -> bool:
+        with self._lock:
+            return meta.namespaced_name(pod) in self._assumed_pods
+
+    def get_pod(self, pod: Obj) -> Obj | None:
+        with self._lock:
+            ps = self._pod_states.get(meta.namespaced_name(pod))
+            return ps.pod if ps else None
+
+    def assumed_pod_count(self) -> int:
+        with self._lock:
+            return len(self._assumed_pods)
+
+    def _add_pod_to_node(self, pod: Obj) -> None:
+        node_name = meta.pod_node_name(pod)
+        if not node_name:
+            return
+        ni = self._nodes.get(node_name)
+        if ni is None:
+            # pod bound to a node we haven't seen yet: create placeholder
+            # (reference keeps imaginary nodes for this case)
+            ni = self._nodes[node_name] = NodeInfo()
+        ni.add_pod(PodInfo(pod))
+
+    def _remove_pod_from_node(self, pod: Obj) -> None:
+        node_name = meta.pod_node_name(pod)
+        ni = self._nodes.get(node_name)
+        if ni is not None:
+            ni.remove_pod(pod)
+            if ni.node is None and not ni.pods:
+                del self._nodes[node_name]
+
+    # -- nodes -----------------------------------------------------------
+
+    def add_node(self, node: Obj) -> None:
+        name = meta.name(node)
+        with self._lock:
+            ni = self._nodes.get(name)
+            if ni is None:
+                ni = self._nodes[name] = NodeInfo()
+            ni.set_node(node)
+
+    def update_node(self, node: Obj) -> None:
+        self.add_node(node)
+
+    def remove_node(self, node: Obj) -> None:
+        name = meta.name(node)
+        with self._lock:
+            ni = self._nodes.get(name)
+            if ni is None:
+                return
+            if ni.pods:
+                # keep NodeInfo for remaining (possibly assumed) pods
+                ni.node = None
+                ni.generation = next(_generation)
+            else:
+                del self._nodes[name]
+
+    def node_count(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    def pod_count(self) -> int:
+        with self._lock:
+            return sum(len(ni.pods) for ni in self._nodes.values())
+
+    # -- snapshot --------------------------------------------------------
+
+    def update_snapshot(self, snapshot: Snapshot) -> Snapshot:
+        """Incremental snapshot refresh (cache.go:197).
+
+        Copies only NodeInfos whose generation advanced past the snapshot's;
+        rebuilds the ordered lists only when membership or affinity-list
+        composition changed.
+        """
+        with self._lock:
+            changed = False
+            max_gen = snapshot.generation
+            for name, ni in self._nodes.items():
+                if ni.node is None:
+                    continue  # placeholder for orphaned assumed pods
+                if ni.generation > snapshot.generation:
+                    snapshot.node_info_map[name] = ni.clone()
+                    changed = True
+                    if ni.generation > max_gen:
+                        max_gen = ni.generation
+            # removals
+            live = {n for n, ni in self._nodes.items() if ni.node is not None}
+            if len(snapshot.node_info_map) != len(live):
+                for name in list(snapshot.node_info_map):
+                    if name not in live:
+                        del snapshot.node_info_map[name]
+                changed = True
+            snapshot.generation = max_gen
+            if changed:
+                snapshot.node_info_list = list(snapshot.node_info_map.values())
+                snapshot.have_pods_with_affinity_list = [
+                    ni for ni in snapshot.node_info_list if ni.pods_with_affinity]
+                snapshot.have_pods_with_required_anti_affinity_list = [
+                    ni for ni in snapshot.node_info_list
+                    if ni.pods_with_required_anti_affinity]
+                snapshot.used_pvc_set = {
+                    pvc for ni in snapshot.node_info_list for pvc in ni.pvc_ref_counts}
+            return snapshot
+
+    def dump(self) -> dict:
+        """Debug dump (internal/cache/debugger semantics)."""
+        with self._lock:
+            return {
+                "nodes": {n: len(ni.pods) for n, ni in self._nodes.items()},
+                "assumed_pods": sorted(self._assumed_pods),
+                "pod_count": self.pod_count(),
+            }

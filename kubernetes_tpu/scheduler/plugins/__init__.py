@@ -1,0 +1,74 @@
+"""In-tree plugins + registry.
+
+Reference: pkg/scheduler/framework/plugins/registry.go:47-80 (all plugins),
+apis/config/v1/default_plugins.go:28-56 (default enablement + weights).
+"""
+
+from __future__ import annotations
+
+from ..framework import Handle, Plugin, Registry
+from .defaultbinder import DefaultBinder
+from .interpodaffinity import InterPodAffinity
+from .nodebasic import (
+    ImageLocality, NodeAffinity, NodeName, NodePorts, NodeUnschedulable,
+    TaintToleration,
+)
+from .noderesources import NodeResourcesBalancedAllocation, NodeResourcesFit
+from .podtopologyspread import PodTopologySpread
+from .queuesort import PrioritySort
+
+# default score weights (default_plugins.go: NodeResourcesBalancedAllocation 1,
+# ImageLocality 1, InterPodAffinity 1, NodeResourcesFit 1, NodeAffinity 1,
+# PodTopologySpread 2, TaintToleration 1)
+DEFAULT_SCORE_WEIGHTS = {
+    "NodeResourcesBalancedAllocation": 1,
+    "ImageLocality": 1,
+    "InterPodAffinity": 1,
+    "NodeResourcesFit": 1,
+    "NodeAffinity": 1,
+    "PodTopologySpread": 2,
+    "TaintToleration": 1,
+}
+
+
+def in_tree_registry() -> Registry:
+    """Name -> factory(args, handle) (runtime/registry.go)."""
+    return {
+        "PrioritySort": lambda args, h: PrioritySort(),
+        "NodeName": lambda args, h: NodeName(),
+        "NodePorts": lambda args, h: NodePorts(),
+        "NodeUnschedulable": lambda args, h: NodeUnschedulable(),
+        "NodeAffinity": lambda args, h: NodeAffinity(),
+        "TaintToleration": lambda args, h: TaintToleration(),
+        "ImageLocality": lambda args, h: ImageLocality(),
+        "NodeResourcesFit": lambda args, h: NodeResourcesFit(**(args or {})),
+        "NodeResourcesBalancedAllocation":
+            lambda args, h: NodeResourcesBalancedAllocation(**(args or {})),
+        "PodTopologySpread": lambda args, h: PodTopologySpread(),
+        "InterPodAffinity": lambda args, h: InterPodAffinity(),
+        "DefaultBinder": lambda args, h: DefaultBinder(h.client),
+    }
+
+
+DEFAULT_PLUGINS = [
+    "PrioritySort",
+    "NodeUnschedulable",
+    "NodeName",
+    "NodePorts",
+    "NodeAffinity",
+    "NodeResourcesFit",
+    "TaintToleration",
+    "PodTopologySpread",
+    "InterPodAffinity",
+    "NodeResourcesBalancedAllocation",
+    "ImageLocality",
+    "DefaultBinder",
+]
+
+
+def build_default_plugins(handle: Handle, enabled: list[str] | None = None,
+                          plugin_args: dict[str, dict] | None = None) -> list[Plugin]:
+    registry = in_tree_registry()
+    plugin_args = plugin_args or {}
+    return [registry[name](plugin_args.get(name), handle)
+            for name in (enabled or DEFAULT_PLUGINS)]

@@ -1,0 +1,348 @@
+"""Scheduling queue: activeQ / backoffQ / unschedulablePods.
+
+Reference semantics: pkg/scheduler/internal/queue/scheduling_queue.go
+  PriorityQueue (:140-181): three tiers —
+    activeQ            heap ordered by the QueueSort plugin (priority, FIFO ties)
+    podBackoffQ        heap ordered by backoff expiry
+    unschedulablePods  parking lot, re-activated by cluster events that a
+                       pod's failed plugins registered for (EventsToRegister)
+  flushBackoffQCompleted (:440)  every 1 s
+  flushUnschedulablePodsLeftover (:471)  every 30 s, pods parked > 5 min
+  MoveAllToActiveOrBackoffQueue + moveRequestCycle race guard: an event that
+    arrives while a pod is mid-cycle must not strand it in unschedulable.
+  PodNominator: bookkeeping of preemption-nominated pods per node.
+
+Pop() additionally supports pop_batch(max_n) — the TPU batch path drains up
+to K pods at once; this is the only queue-surface addition vs the reference.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Iterable
+
+from ..api import meta
+from ..api.meta import Obj
+from .types import ClusterEvent, PodInfo, QueuedPodInfo
+
+DEFAULT_POD_INITIAL_BACKOFF = 1.0     # scheduler.go:188
+DEFAULT_POD_MAX_BACKOFF = 10.0        # scheduler.go:193
+DEFAULT_UNSCHEDULABLE_TIMEOUT = 300.0  # flushUnschedulablePodsLeftover
+
+
+def default_sort_key(qpi: QueuedPodInfo) -> tuple:
+    """PrioritySort plugin order: higher .spec.priority first, then FIFO."""
+    return (-qpi.pod_info.priority, qpi.timestamp)
+
+
+class _Heap:
+    """Heap with lazy deletion keyed by pod key (internal/heap/heap.go)."""
+
+    def __init__(self, key_fn: Callable[[QueuedPodInfo], tuple]):
+        self._key_fn = key_fn
+        self._heap: list[tuple[tuple, int, QueuedPodInfo]] = []
+        self._entries: dict[str, QueuedPodInfo] = {}
+        self._counter = itertools.count()
+
+    def push(self, qpi: QueuedPodInfo) -> None:
+        self._entries[qpi.key] = qpi
+        heapq.heappush(self._heap, (self._key_fn(qpi), next(self._counter), qpi))
+
+    def pop(self) -> QueuedPodInfo | None:
+        while self._heap:
+            _, _, qpi = heapq.heappop(self._heap)
+            if self._entries.get(qpi.key) is qpi:
+                del self._entries[qpi.key]
+                return qpi
+        return None
+
+    def peek(self) -> QueuedPodInfo | None:
+        while self._heap:
+            _, _, qpi = self._heap[0]
+            if self._entries.get(qpi.key) is qpi:
+                return qpi
+            heapq.heappop(self._heap)
+        return None
+
+    def remove(self, key: str) -> QueuedPodInfo | None:
+        return self._entries.pop(key, None)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def items(self) -> list[QueuedPodInfo]:
+        return list(self._entries.values())
+
+
+class PodNominator:
+    """Nominated-pod bookkeeping (scheduling_queue.go nominator)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._node_to_pods: dict[str, dict[str, PodInfo]] = {}
+        self._pod_to_node: dict[str, str] = {}
+
+    def add_nominated_pod(self, pi: PodInfo, node_name: str | None = None) -> None:
+        node = node_name or pi.nominated_node_name
+        if not node:
+            return
+        with self._lock:
+            self.delete_nominated_pod_if_exists(pi.pod)
+            self._node_to_pods.setdefault(node, {})[pi.key] = pi
+            self._pod_to_node[pi.key] = node
+
+    def delete_nominated_pod_if_exists(self, pod: Obj) -> None:
+        key = meta.namespaced_name(pod)
+        with self._lock:
+            node = self._pod_to_node.pop(key, None)
+            if node:
+                pods = self._node_to_pods.get(node)
+                if pods:
+                    pods.pop(key, None)
+                    if not pods:
+                        del self._node_to_pods[node]
+
+    def nominated_pods_for_node(self, node_name: str) -> list[PodInfo]:
+        with self._lock:
+            return list(self._node_to_pods.get(node_name, {}).values())
+
+
+class SchedulingQueue:
+    """The 3-tier priority queue."""
+
+    def __init__(
+        self,
+        sort_key: Callable[[QueuedPodInfo], tuple] = default_sort_key,
+        pod_initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
+        pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+        unschedulable_timeout: float = DEFAULT_UNSCHEDULABLE_TIMEOUT,
+        cluster_event_map: dict[str, list[ClusterEvent]] | None = None,
+    ):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._active = _Heap(sort_key)
+        self._backoff = _Heap(lambda q: (self._backoff_expiry(q),))
+        self._unschedulable: dict[str, QueuedPodInfo] = {}
+        self._initial_backoff = pod_initial_backoff
+        self._max_backoff = pod_max_backoff
+        self._unschedulable_timeout = unschedulable_timeout
+        # plugin name -> events it re-queues on (from EnqueueExtensions)
+        self._cluster_event_map = cluster_event_map or {}
+        self.nominator = PodNominator()
+        self._scheduling_cycle = 0
+        self._move_request_cycle = -1
+        self._closed = False
+        self._flush_thread: threading.Thread | None = None
+
+    # -- backoff ---------------------------------------------------------
+
+    def _backoff_duration(self, qpi: QueuedPodInfo) -> float:
+        d = self._initial_backoff
+        for _ in range(qpi.attempts - 1):
+            d *= 2
+            if d >= self._max_backoff:
+                return self._max_backoff
+        return d
+
+    def _backoff_expiry(self, qpi: QueuedPodInfo) -> float:
+        return qpi.timestamp + self._backoff_duration(qpi)
+
+    def _is_backing_off(self, qpi: QueuedPodInfo) -> bool:
+        return qpi.attempts > 0 and self._backoff_expiry(qpi) > time.monotonic()
+
+    # -- add/pop ---------------------------------------------------------
+
+    def add(self, pod: Obj) -> None:
+        qpi = QueuedPodInfo(PodInfo(pod))
+        with self._cond:
+            self._backoff.remove(qpi.key)
+            self._unschedulable.pop(qpi.key, None)
+            self._active.push(qpi)
+            self.nominator.add_nominated_pod(qpi.pod_info)
+            self._cond.notify()
+
+    def scheduling_cycle(self) -> int:
+        with self._lock:
+            return self._scheduling_cycle
+
+    def pop(self, timeout: float | None = None) -> QueuedPodInfo | None:
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not len(self._active) and not self._closed:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            if self._closed and not len(self._active):
+                return None
+            qpi = self._active.pop()
+            if qpi is not None:
+                qpi.attempts += 1
+                self._scheduling_cycle += 1
+            return qpi
+
+    def pop_batch(self, max_n: int, timeout: float | None = None) -> list[QueuedPodInfo]:
+        """Drain up to max_n pods for a TPU batch. Blocks for the first pod
+        only; the rest are taken non-blocking so latency stays bounded."""
+        first = self.pop(timeout)
+        if first is None:
+            return []
+        batch = [first]
+        with self._cond:
+            while len(batch) < max_n:
+                qpi = self._active.pop()
+                if qpi is None:
+                    break
+                qpi.attempts += 1
+                self._scheduling_cycle += 1
+                batch.append(qpi)
+        return batch
+
+    def add_unschedulable_if_not_present(self, qpi: QueuedPodInfo,
+                                         pod_scheduling_cycle: int) -> None:
+        """Park a pod that failed scheduling (scheduling_queue.go:374).
+
+        If a move request raced with this pod's cycle, send it to backoff/
+        active instead of the parking lot (the moveRequestCycle guard).
+        """
+        with self._cond:
+            key = qpi.key
+            if key in self._active or key in self._backoff or key in self._unschedulable:
+                return
+            qpi.timestamp = time.monotonic()
+            self.nominator.add_nominated_pod(qpi.pod_info)
+            if self._move_request_cycle >= pod_scheduling_cycle:
+                self._backoff.push(qpi)
+            else:
+                self._unschedulable[key] = qpi
+
+    def update(self, old: Obj, new: Obj) -> None:
+        """Pod updated while pending: refresh in place; an update that could
+        make it schedulable moves it out of unschedulable (simplified
+        updatePodMayBeMakeSchedulable)."""
+        key = meta.namespaced_name(new)
+        with self._cond:
+            qpi = self._unschedulable.get(key)
+            if qpi is not None:
+                qpi.pod_info.update(new)
+                del self._unschedulable[key]
+                if self._is_backing_off(qpi):
+                    self._backoff.push(qpi)
+                else:
+                    self._active.push(qpi)
+                    self._cond.notify()
+                return
+            if key in self._active:
+                q = self._active.remove(key)
+                q.pod_info.update(new)
+                self._active.push(q)
+            elif key in self._backoff:
+                q = self._backoff.remove(key)
+                q.pod_info.update(new)
+                self._backoff.push(q)
+
+    def delete(self, pod: Obj) -> None:
+        key = meta.namespaced_name(pod)
+        with self._cond:
+            self._active.remove(key)
+            self._backoff.remove(key)
+            self._unschedulable.pop(key, None)
+            self.nominator.delete_nominated_pod_if_exists(pod)
+
+    # -- event-driven requeue -------------------------------------------
+
+    def _pod_matches_event(self, qpi: QueuedPodInfo, event: ClusterEvent) -> bool:
+        if event == ClusterEvent("*", "*"):
+            return True
+        if not qpi.unschedulable_plugins:
+            return True
+        for plugin in qpi.unschedulable_plugins:
+            for ev in self._cluster_event_map.get(plugin, ()):
+                if ev.match(event):
+                    return True
+        return False
+
+    def move_all_to_active_or_backoff(self, event: ClusterEvent) -> None:
+        """MoveAllToActiveOrBackoffQueue: cluster changed — unpark pods whose
+        failure could be resolved by `event`."""
+        with self._cond:
+            moved = []
+            for key, qpi in list(self._unschedulable.items()):
+                if self._pod_matches_event(qpi, event):
+                    moved.append(key)
+                    if self._is_backing_off(qpi):
+                        self._backoff.push(qpi)
+                    else:
+                        self._active.push(qpi)
+            for key in moved:
+                del self._unschedulable[key]
+            self._move_request_cycle = self._scheduling_cycle
+            if moved:
+                self._cond.notify_all()
+
+    def assigned_pod_added(self, pod: Obj) -> None:
+        """A pod got bound: affinity-failed pods may now fit (simplified
+        AssignedPodAdded — we move pods failed on InterPodAffinity)."""
+        self.move_all_to_active_or_backoff(ClusterEvent("AssignedPod", "Add"))
+
+    # -- flush loops (Run, :298) ----------------------------------------
+
+    def run(self) -> None:
+        if self._flush_thread is not None:
+            return
+        self._flush_thread = threading.Thread(
+            target=self._flush_loop, name="queue-flush", daemon=True)
+        self._flush_thread.start()
+
+    def _flush_loop(self) -> None:
+        last_unsched_flush = time.monotonic()
+        while not self._closed:
+            time.sleep(0.2)  # reference: 1s backoff flush; we poll faster
+            with self._cond:
+                now = time.monotonic()
+                notified = False
+                while True:
+                    head = self._backoff.peek()
+                    if head is None or self._backoff_expiry(head) > now:
+                        break
+                    self._active.push(self._backoff.pop())
+                    notified = True
+                if now - last_unsched_flush > 5.0:
+                    last_unsched_flush = now
+                    for key, qpi in list(self._unschedulable.items()):
+                        if now - qpi.timestamp > self._unschedulable_timeout:
+                            del self._unschedulable[key]
+                            if self._is_backing_off(qpi):
+                                self._backoff.push(qpi)
+                            else:
+                                self._active.push(qpi)
+                                notified = True
+                if notified:
+                    self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- introspection ---------------------------------------------------
+
+    def pending_pods(self) -> tuple[list[Obj], str]:
+        with self._lock:
+            active = [q.pod for q in self._active.items()]
+            backoff = [q.pod for q in self._backoff.items()]
+            unsched = [q.pod for q in self._unschedulable.values()]
+        summary = (f"activeQ:{len(active)} backoffQ:{len(backoff)} "
+                   f"unschedulable:{len(unsched)}")
+        return active + backoff + unsched, summary
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {"active": len(self._active), "backoff": len(self._backoff),
+                    "unschedulable": len(self._unschedulable)}

@@ -1,0 +1,523 @@
+"""Scheduler core: cache + queue + profiles + the scheduling pipeline.
+
+Reference: pkg/scheduler/scheduler.go (Scheduler/New/Run),
+pkg/scheduler/schedule_one.go (the whole per-pod pipeline: schedulingCycle
+:116, bindingCycle :223, schedulePod :372, findNodesThatFitPod :425,
+numFeasibleNodesToFind :585, prioritizeNodes :671, selectHost :777, assume
+:802, bind :824, handleSchedulingFailure :873), and
+pkg/scheduler/eventhandlers.go:249 (informer wiring).
+
+Two execution modes share every correctness-critical piece (cache
+assume/confirm, queue backoff/requeue, Reserve/Permit/bind, failure
+handling):
+
+  per-pod  - faithful scheduleOne: one pod per cycle, Filter/Score over
+             nodes in Python.  The oracle and fallback.
+  batch    - TPU path: pop_batch(K) drains up to K pods, ships them through
+             a BatchBackend (ops/backend.py) that computes feasibility masks,
+             scores and a conflict-free assignment for the whole batch on
+             device, then each assignment is assumed/reserved/bound
+             individually so failure semantics stay per-pod.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence
+
+from ..api import meta
+from ..api.meta import Obj
+from ..client.clientset import Client, NODES, PODS
+from ..client.informer import SharedInformerFactory
+from ..store import kv
+from .cache import Cache, Snapshot
+from .framework import CycleState, Framework, Handle
+from .queue import SchedulingQueue
+from .types import (
+    ERROR, SUCCESS, UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE, WAIT,
+    ClusterEvent, Diagnosis, FitError, NodeInfo, PodInfo, QueuedPodInfo, Status,
+    is_success,
+)
+
+logger = logging.getLogger(__name__)
+
+# numFeasibleNodesToFind (schedule_one.go:54-59)
+MIN_FEASIBLE_NODES_TO_FIND = 100
+MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5
+
+
+class SchedulerMetrics:
+    """Counter bundle (pkg/scheduler/metrics/metrics.go, minimal)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.schedule_attempts = {"scheduled": 0, "unschedulable": 0, "error": 0}
+        self.scheduling_latency_sum = 0.0
+        self.scheduling_latencies: list[float] = []
+        self.preemption_attempts = 0
+
+    def observe_attempt(self, result: str, latency: float) -> None:
+        with self.lock:
+            self.schedule_attempts[result] = self.schedule_attempts.get(result, 0) + 1
+            self.scheduling_latency_sum += latency
+            self.scheduling_latencies.append(latency)
+
+
+class BatchBackend:
+    """Contract for the TPU batch path (implemented by ops/backend.py).
+
+    assign() must account for intra-batch resource consumption: if two pods
+    in the batch fit the same node only serially, the returned assignment
+    reflects the running-sum constraint (SURVEY.md §7 hard part #1).
+    """
+
+    def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot
+               ) -> list[tuple[int | None, Status | None]]:
+        """Returns, per pod (same order): (node_index or None, status)."""
+        raise NotImplementedError
+
+    def node_name(self, idx: int) -> str:
+        raise NotImplementedError
+
+
+class Profile:
+    __slots__ = ("framework", "percentage_of_nodes_to_score", "batch_backend",
+                 "batch_size")
+
+    def __init__(self, framework: Framework,
+                 percentage_of_nodes_to_score: int = 0,
+                 batch_backend: BatchBackend | None = None,
+                 batch_size: int = 256):
+        self.framework = framework
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.batch_backend = batch_backend
+        self.batch_size = batch_size
+
+
+class Scheduler:
+    """The scheduler (scheduler.go:62)."""
+
+    def __init__(self, client: Client,
+                 informer_factory: SharedInformerFactory,
+                 profiles: dict[str, Profile],
+                 next_start_node_index_random: bool = False):
+        self.client = client
+        self.informer_factory = informer_factory
+        self.profiles = profiles
+        self.cache = Cache()
+        self.metrics = SchedulerMetrics()
+        # union of all profiles' event maps gates unschedulable requeue
+        event_map: dict[str, list[ClusterEvent]] = {}
+        for p in profiles.values():
+            event_map.update(p.framework.cluster_event_map())
+        default_fw = next(iter(profiles.values())).framework
+        sort_key = (default_fw.queue_sort.sort_key if default_fw.queue_sort
+                    else None)
+        self.queue = SchedulingQueue(
+            sort_key=sort_key or (lambda q: (-q.pod_info.priority, q.timestamp)),
+            cluster_event_map=event_map)
+        for p in profiles.values():
+            p.framework.handle.nominator = self.queue.nominator
+        self._stop = threading.Event()
+        self._binder_pool = ThreadPoolExecutor(max_workers=16,
+                                               thread_name_prefix="bind")
+        self._next_start_node_index = 0
+        self._threads: list[threading.Thread] = []
+        self._wire_event_handlers()
+
+    # -- event handlers (eventhandlers.go:249) ---------------------------
+
+    def _wire_event_handlers(self) -> None:
+        pods = self.informer_factory.informer(PODS)
+        nodes = self.informer_factory.informer(NODES)
+        pods.add_event_handler(self._on_pod_event)
+        nodes.add_event_handler(self._on_node_event)
+
+    def _responsible_for(self, pod: Obj) -> bool:
+        name = (pod.get("spec") or {}).get("schedulerName", "default-scheduler")
+        return name in self.profiles
+
+    def _on_pod_event(self, type_: str, pod: Obj, old: Obj | None) -> None:
+        bound = bool(meta.pod_node_name(pod))
+        if type_ == kv.ADDED:
+            if bound:
+                self.cache.add_pod(pod)
+                self.queue.assigned_pod_added(pod)
+            elif self._responsible_for(pod):
+                self.queue.add(pod)
+        elif type_ == kv.MODIFIED:
+            was_bound = bool(old and meta.pod_node_name(old))
+            if bound or was_bound:
+                if was_bound:
+                    self.cache.update_pod(old, pod)
+                else:
+                    self.cache.add_pod(pod)
+                    self.queue.delete(pod)
+                    self.queue.assigned_pod_added(pod)
+                if meta.pod_is_terminal(pod):
+                    # terminal pods free resources
+                    self.cache.remove_pod(pod)
+                    self.queue.move_all_to_active_or_backoff(
+                        ClusterEvent("AssignedPod", "Delete"))
+            elif self._responsible_for(pod):
+                if old is not None:
+                    self.queue.update(old, pod)
+                else:
+                    self.queue.add(pod)
+        elif type_ == kv.DELETED:
+            if bound:
+                self.cache.remove_pod(pod)
+                self.queue.move_all_to_active_or_backoff(
+                    ClusterEvent("AssignedPod", "Delete"))
+            else:
+                self.queue.delete(pod)
+
+    def _on_node_event(self, type_: str, node: Obj, old: Obj | None) -> None:
+        if type_ == kv.ADDED:
+            self.cache.add_node(node)
+            self.queue.move_all_to_active_or_backoff(ClusterEvent("Node", "Add"))
+        elif type_ == kv.MODIFIED:
+            self.cache.update_node(node)
+            self.queue.move_all_to_active_or_backoff(ClusterEvent("Node", "Update"))
+        elif type_ == kv.DELETED:
+            self.cache.remove_node(node)
+            self.queue.move_all_to_active_or_backoff(ClusterEvent("Node", "Delete"))
+
+    # -- run loops (scheduler.go:341) ------------------------------------
+
+    def run(self) -> None:
+        """Start background scheduling (returns immediately)."""
+        self.queue.run()
+        t = threading.Thread(target=self._loop, name="sched-loop", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        self._binder_pool.shutdown(wait=False)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.schedule_step(timeout=0.5)
+
+    def schedule_step(self, timeout: float | None = None) -> int:
+        """One scheduling iteration; returns number of pods processed.
+        Batch mode if any profile has a batch backend; else per-pod."""
+        batch_profile = next((p for p in self.profiles.values()
+                              if p.batch_backend is not None), None)
+        if batch_profile is not None:
+            batch = self.queue.pop_batch(batch_profile.batch_size, timeout)
+            if not batch:
+                return 0
+            # route: pods of other profiles go through per-pod path
+            mine = [q for q in batch
+                    if self._profile_for(q.pod) is batch_profile]
+            others = [q for q in batch if self._profile_for(q.pod) is not batch_profile]
+            if mine:
+                self.schedule_batch(batch_profile, mine)
+            for q in others:
+                self.schedule_one(q)
+            return len(batch)
+        qpi = self.queue.pop(timeout)
+        if qpi is None:
+            return 0
+        self.schedule_one(qpi)
+        return 1
+
+    def _profile_for(self, pod: Obj) -> Profile | None:
+        name = (pod.get("spec") or {}).get("schedulerName", "default-scheduler")
+        return self.profiles.get(name)
+
+    # -- per-pod pipeline (schedule_one.go:63) ---------------------------
+
+    def schedule_one(self, qpi: QueuedPodInfo) -> None:
+        pod = qpi.pod
+        profile = self._profile_for(pod)
+        if profile is None:
+            logger.error("no profile for pod %s", qpi.key)
+            return
+        fw = profile.framework
+        if self._skip_schedule(pod):
+            return
+        start = time.monotonic()
+        state = CycleState()
+        cycle = self.queue.scheduling_cycle()
+        try:
+            node_name = self._scheduling_cycle(fw, profile, state, qpi)
+        except FitError as fe:
+            self._handle_failure(fw, qpi, Status(UNSCHEDULABLE, fe.message()),
+                                 cycle, fe.diagnosis.unschedulable_plugins, start)
+            return
+        except Exception as e:  # pragma: no cover
+            logger.exception("scheduling cycle error for %s", qpi.key)
+            self._handle_failure(fw, qpi, Status(ERROR, str(e)), cycle, set(), start)
+            return
+        if node_name is None:
+            return  # failure already handled (reserve/permit path)
+        # async binding cycle (schedule_one.go:100)
+        self._binder_pool.submit(self._binding_cycle, fw, state, qpi,
+                                 node_name, cycle, start)
+
+    def _skip_schedule(self, pod: Obj) -> bool:
+        # schedule_one.go skipPodSchedule: deleted or assumed-and-updated
+        if meta.deletion_timestamp(pod) is not None:
+            return True
+        if meta.pod_node_name(pod):
+            return True
+        return False
+
+    def _scheduling_cycle(self, fw: Framework, profile: Profile,
+                          state: CycleState, qpi: QueuedPodInfo) -> str | None:
+        """Everything up to (and including) Reserve+Permit. Returns the chosen
+        node or raises FitError; returns None if failure was handled inline."""
+        pod_info = qpi.pod_info
+        snapshot = Snapshot() if not hasattr(self, "_snapshot") else self._snapshot
+        self._snapshot = self.cache.update_snapshot(snapshot)
+        node_name = self._schedule_pod(fw, profile, state, pod_info, self._snapshot)
+
+        # assume (schedule_one.go:802): optimistic cache commit
+        assumed = meta.deep_copy(pod_info.pod)
+        assumed["spec"]["nodeName"] = node_name
+        self.cache.assume_pod(assumed)
+
+        s = fw.run_reserve_plugins(state, pod_info, node_name)
+        if not is_success(s):
+            self.cache.forget_pod(assumed)
+            self._handle_failure(fw, qpi, s, self.queue.scheduling_cycle(),
+                                 {s.plugin} if s.plugin else set(), time.monotonic())
+            return None
+        s = fw.run_permit_plugins(state, pod_info, node_name)
+        if s is not None and s.is_wait():
+            return node_name  # binding cycle will WaitOnPermit
+        if not is_success(s):
+            fw.run_unreserve_plugins(state, pod_info, node_name)
+            self.cache.forget_pod(assumed)
+            self._handle_failure(fw, qpi, s, self.queue.scheduling_cycle(),
+                                 {s.plugin} if s.plugin else set(), time.monotonic())
+            return None
+        return node_name
+
+    def _schedule_pod(self, fw: Framework, profile: Profile, state: CycleState,
+                      pod_info: PodInfo, snapshot: Snapshot) -> str:
+        """schedulePod (schedule_one.go:372): PreFilter -> Filter -> PreScore
+        -> Score -> selectHost. Raises FitError when nothing fits."""
+        if len(snapshot) == 0:
+            raise FitError(pod_info.pod, 0, Diagnosis(pre_filter_msg="no nodes available"))
+        feasible, diagnosis = self._find_nodes_that_fit(fw, profile, state,
+                                                        pod_info, snapshot)
+        if not feasible:
+            raise FitError(pod_info.pod, len(snapshot), diagnosis)
+        if len(feasible) == 1:
+            return feasible[0].name
+        s = fw.run_pre_score_plugins(state, pod_info, feasible)
+        if not is_success(s):
+            raise RuntimeError(f"PreScore failed: {s.message()}")
+        scores, s = fw.run_score_plugins(state, pod_info, feasible)
+        if not is_success(s):
+            raise RuntimeError(f"Score failed: {s.message()}")
+        return self._select_host(scores)
+
+    def _find_nodes_that_fit(self, fw: Framework, profile: Profile,
+                             state: CycleState, pod_info: PodInfo,
+                             snapshot: Snapshot
+                             ) -> tuple[list[NodeInfo], Diagnosis]:
+        """findNodesThatFitPod (schedule_one.go:425) with adaptive sampling
+        (:585) and round-robin start index (:541)."""
+        diagnosis = Diagnosis()
+        result, s = fw.run_pre_filter_plugins(state, pod_info, snapshot)
+        if s is not None and not s.is_success():
+            if s.is_rejected():
+                diagnosis.pre_filter_msg = s.message()
+                diagnosis.unschedulable_plugins.add(s.plugin)
+                return [], diagnosis
+            raise RuntimeError(f"PreFilter failed: {s.message()}")
+
+        # nominated node gets first shot (schedule_one.go:437)
+        if pod_info.nominated_node_name:
+            ni = snapshot.get(pod_info.nominated_node_name)
+            if ni is not None:
+                st = fw.run_filter_plugins_with_nominated_pods(state, pod_info, ni)
+                if is_success(st):
+                    return [ni], diagnosis
+
+        all_nodes = snapshot.list()
+        if result is not None and not result.all_nodes():
+            nodes = [snapshot.get(n) for n in result.node_names]
+            nodes = [n for n in nodes if n is not None]
+        else:
+            nodes = all_nodes
+        num_to_find = self._num_feasible_nodes_to_find(
+            profile.percentage_of_nodes_to_score, len(nodes))
+
+        feasible: list[NodeInfo] = []
+        start = self._next_start_node_index % max(len(nodes), 1)
+        checked = 0
+        for i in range(len(nodes)):
+            ni = nodes[(start + i) % len(nodes)]
+            checked += 1
+            st = fw.run_filter_plugins_with_nominated_pods(state, pod_info, ni)
+            if is_success(st):
+                feasible.append(ni)
+                if len(feasible) >= num_to_find:
+                    break
+            else:
+                diagnosis.node_to_status[ni.name] = st
+                if st.plugin:
+                    diagnosis.unschedulable_plugins.add(st.plugin)
+        self._next_start_node_index = (start + checked) % max(len(nodes), 1)
+        return feasible, diagnosis
+
+    @staticmethod
+    def _num_feasible_nodes_to_find(percentage: int, num_nodes: int) -> int:
+        if num_nodes < MIN_FEASIBLE_NODES_TO_FIND:
+            return num_nodes
+        p = percentage
+        if p <= 0:
+            p = int(50 - num_nodes / 125)
+            if p < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+                p = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+        if p >= 100:
+            return num_nodes
+        return max(num_nodes * p // 100, MIN_FEASIBLE_NODES_TO_FIND)
+
+    @staticmethod
+    def _select_host(scores: dict[str, int]) -> str:
+        """selectHost (schedule_one.go:777): max score, random tie-break via
+        reservoir sampling. We take the first max (deterministic) — same
+        contract, reproducible."""
+        best, best_score = None, None
+        for name, sc in scores.items():
+            if best_score is None or sc > best_score:
+                best, best_score = name, sc
+        return best
+
+    # -- binding cycle (schedule_one.go:223) -----------------------------
+
+    def _binding_cycle(self, fw: Framework, state: CycleState,
+                       qpi: QueuedPodInfo, node_name: str, cycle: int,
+                       start: float) -> None:
+        pod_info = qpi.pod_info
+        assumed = meta.deep_copy(pod_info.pod)
+        assumed["spec"]["nodeName"] = node_name
+        try:
+            s = fw.wait_on_permit(pod_info)
+            if not is_success(s):
+                self._bind_failure(fw, state, qpi, assumed, node_name, s, cycle)
+                return
+            s = fw.run_pre_bind_plugins(state, pod_info, node_name)
+            if not is_success(s):
+                self._bind_failure(fw, state, qpi, assumed, node_name, s, cycle)
+                return
+            s = fw.run_bind_plugins(state, pod_info, node_name)
+            if not is_success(s):
+                self._bind_failure(fw, state, qpi, assumed, node_name, s, cycle)
+                return
+            self.cache.finish_binding(assumed)
+            fw.run_post_bind_plugins(state, pod_info, node_name)
+            self.metrics.observe_attempt("scheduled", time.monotonic() - start)
+            self.client.create_event(pod_info.pod, "Scheduled",
+                                     f"Successfully assigned {qpi.key} to {node_name}")
+        except Exception as e:  # pragma: no cover
+            logger.exception("binding cycle error for %s", qpi.key)
+            self._bind_failure(fw, state, qpi, assumed, node_name,
+                               Status(ERROR, str(e)), cycle)
+
+    def _bind_failure(self, fw: Framework, state: CycleState, qpi: QueuedPodInfo,
+                      assumed: Obj, node_name: str, s: Status, cycle: int) -> None:
+        """schedule_one.go:229-258: Forget + unreserve + requeue + move event."""
+        fw.run_unreserve_plugins(state, qpi.pod_info, node_name)
+        try:
+            self.cache.forget_pod(assumed)
+        except ValueError:
+            pass
+        self.queue.move_all_to_active_or_backoff(ClusterEvent("AssignedPod", "Delete"))
+        self._handle_failure(fw, qpi, s, cycle,
+                             {s.plugin} if s.plugin else set(), time.monotonic())
+
+    # -- failure handling (schedule_one.go:873) --------------------------
+
+    def _handle_failure(self, fw: Framework, qpi: QueuedPodInfo, s: Status,
+                        cycle: int, plugins: set[str], start: float) -> None:
+        qpi.unschedulable_plugins = plugins
+        result = "unschedulable" if s.code in (
+            UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE) else "error"
+        self.metrics.observe_attempt(result, time.monotonic() - start)
+        # re-fetch: pod may have been updated/deleted meanwhile
+        try:
+            current = self.client.get(PODS, meta.namespace(qpi.pod), meta.name(qpi.pod))
+        except kv.NotFoundError:
+            return
+        if meta.pod_node_name(current):
+            return  # got bound elsewhere
+        qpi.pod_info.update(current)
+        self.queue.add_unschedulable_if_not_present(qpi, cycle)
+        self.client.create_event(qpi.pod, "FailedScheduling", s.message(),
+                                 type_="Warning")
+        # patch status condition (schedule_one.go:918)
+        try:
+            def patch(p: Obj) -> Obj:
+                conds = p.setdefault("status", {}).setdefault("conditions", [])
+                conds[:] = [c for c in conds if c.get("type") != "PodScheduled"]
+                conds.append({"type": "PodScheduled", "status": "False",
+                              "reason": "Unschedulable", "message": s.message()})
+                return p
+            self.client.guaranteed_update(PODS, meta.namespace(qpi.pod),
+                                          meta.name(qpi.pod), patch)
+        except kv.StoreError:
+            pass
+
+    # -- batch pipeline (TPU path; no reference equivalent) --------------
+
+    def schedule_batch(self, profile: Profile, batch: list[QueuedPodInfo]) -> None:
+        """Schedule a whole batch through the TPU backend.
+
+        The backend returns a conflict-free assignment (intra-batch resource
+        accounting is its job); each returned assignment then goes through
+        the same assume -> Reserve -> Permit -> bind tail as the per-pod
+        path, so cache/queue/failure semantics are identical."""
+        fw = profile.framework
+        backend = profile.batch_backend
+        cycle = self.queue.scheduling_cycle()
+        start = time.monotonic()
+        live = [q for q in batch if not self._skip_schedule(q.pod)]
+        if not live:
+            return
+        snapshot = Snapshot() if not hasattr(self, "_snapshot") else self._snapshot
+        self._snapshot = self.cache.update_snapshot(snapshot)
+        results = backend.assign([q.pod_info for q in live], self._snapshot)
+        for qpi, (node_idx, s) in zip(live, results):
+            if node_idx is None:
+                st = s or Status(UNSCHEDULABLE, "no feasible node (batch)")
+                self._handle_failure(fw, qpi, st, cycle,
+                                     {st.plugin} if st.plugin else set(), start)
+                continue
+            node_name = backend.node_name(node_idx)
+            state = CycleState()
+            pod_info = qpi.pod_info
+            assumed = meta.deep_copy(pod_info.pod)
+            assumed["spec"]["nodeName"] = node_name
+            try:
+                self.cache.assume_pod(assumed)
+            except ValueError as e:
+                self._handle_failure(fw, qpi, Status(ERROR, str(e)), cycle,
+                                     set(), start)
+                continue
+            st = fw.run_reserve_plugins(state, pod_info, node_name)
+            if not is_success(st):
+                self.cache.forget_pod(assumed)
+                self._handle_failure(fw, qpi, st, cycle,
+                                     {st.plugin} if st.plugin else set(), start)
+                continue
+            st = fw.run_permit_plugins(state, pod_info, node_name)
+            if st is not None and not (st.is_success() or st.is_wait()):
+                fw.run_unreserve_plugins(state, pod_info, node_name)
+                self.cache.forget_pod(assumed)
+                self._handle_failure(fw, qpi, st, cycle,
+                                     {st.plugin} if st.plugin else set(), start)
+                continue
+            self._binder_pool.submit(self._binding_cycle, fw, state, qpi,
+                                     node_name, cycle, start)

@@ -1,0 +1,403 @@
+"""Scheduler core types.
+
+Reference: pkg/scheduler/framework/types.go
+  NodeInfo  (types.go:375) - per-node aggregate the filters/scores read
+  Resource  (types.go:426) - canonical resource vector (api/resources.py)
+  PodInfo              - pod + precomputed affinity terms
+  QueuedPodInfo        - queue bookkeeping (attempts, timestamps)
+  ClusterEvent         - event descriptors for requeue gating
+and framework status codes (framework/interface.go Status/Code).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..api import meta
+from ..api.labels import Selector, selector_from_dict
+from ..api.meta import Obj
+from ..api.resources import (
+    Resource, node_allocatable, pod_request, pod_request_nonzero,
+)
+
+# --- Status codes (framework/interface.go:84-120) -------------------------
+
+SUCCESS = 0
+ERROR = 1
+UNSCHEDULABLE = 2
+UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+WAIT = 4
+SKIP = 5
+
+_CODE_NAMES = {
+    SUCCESS: "Success", ERROR: "Error", UNSCHEDULABLE: "Unschedulable",
+    UNSCHEDULABLE_AND_UNRESOLVABLE: "UnschedulableAndUnresolvable",
+    WAIT: "Wait", SKIP: "Skip",
+}
+
+
+class Status:
+    """Plugin status. None is treated as Success everywhere (like the reference)."""
+
+    __slots__ = ("code", "reasons", "plugin")
+
+    def __init__(self, code: int = SUCCESS, *reasons: str, plugin: str = ""):
+        self.code = code
+        self.reasons = list(reasons)
+        self.plugin = plugin
+
+    def is_success(self) -> bool:
+        return self.code == SUCCESS
+
+    def is_skip(self) -> bool:
+        return self.code == SKIP
+
+    def is_wait(self) -> bool:
+        return self.code == WAIT
+
+    def is_rejected(self) -> bool:
+        return self.code in (UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE)
+
+    def message(self) -> str:
+        return "; ".join(self.reasons)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Status({_CODE_NAMES[self.code]}, {self.reasons}, plugin={self.plugin})"
+
+
+def status_code(s: Status | None) -> int:
+    return SUCCESS if s is None else s.code
+
+
+def is_success(s: Status | None) -> bool:
+    return s is None or s.is_success()
+
+
+# --- Cluster events (framework/types.go ClusterEvent) ---------------------
+# Resource|ActionType strings used by EventsToRegister/queue gating.
+
+@dataclass(frozen=True, slots=True)
+class ClusterEvent:
+    resource: str   # "Pod", "Node", "PersistentVolumeClaim", ..., "*"
+    action: str     # "Add", "Update", "Delete", "UpdateNodeLabel", ..., "*"
+
+    def match(self, other: "ClusterEvent") -> bool:
+        return ((self.resource == "*" or self.resource == other.resource)
+                and (self.action == "*" or self.action == other.action
+                     or other.action.startswith(self.action)))
+
+
+EVENT_WILDCARD = ClusterEvent("*", "*")
+POD_ADD = ClusterEvent("Pod", "Add")
+POD_UPDATE = ClusterEvent("Pod", "Update")
+ASSIGNED_POD_ADD = ClusterEvent("AssignedPod", "Add")
+ASSIGNED_POD_UPDATE = ClusterEvent("AssignedPod", "Update")
+ASSIGNED_POD_DELETE = ClusterEvent("AssignedPod", "Delete")
+NODE_ADD = ClusterEvent("Node", "Add")
+NODE_UPDATE = ClusterEvent("Node", "Update")
+NODE_DELETE = ClusterEvent("Node", "Delete")
+PVC_ADD = ClusterEvent("PersistentVolumeClaim", "Add")
+
+
+# --- Affinity terms -------------------------------------------------------
+
+@dataclass(slots=True)
+class AffinityTerm:
+    """A compiled v1.PodAffinityTerm (framework/types.go AffinityTerm)."""
+
+    selector: Selector
+    topology_key: str
+    namespaces: frozenset[str]
+    weight: int = 0  # for preferred terms
+
+    def matches(self, pod: Obj, pod_labels: dict[str, str]) -> bool:
+        return meta.namespace(pod) in self.namespaces and self.selector.matches(pod_labels)
+
+
+def _compile_terms(terms: list[Obj] | None, default_ns: str,
+                   weighted: bool = False) -> list[AffinityTerm]:
+    out: list[AffinityTerm] = []
+    for t in terms or ():
+        w = 0
+        if weighted:
+            w = t.get("weight", 0)
+            t = t.get("podAffinityTerm") or {}
+        namespaces = frozenset(t.get("namespaces") or [default_ns])
+        out.append(AffinityTerm(
+            selector=selector_from_dict(t.get("labelSelector")),
+            topology_key=t.get("topologyKey", ""),
+            namespaces=namespaces,
+            weight=w,
+        ))
+    return out
+
+
+# --- PodInfo --------------------------------------------------------------
+
+class PodInfo:
+    """Pod plus precomputed scheduling attributes (framework/types.go PodInfo).
+
+    Everything the hot path needs is parsed exactly once here: resource
+    requests, affinity terms with compiled selectors, tolerations, host
+    ports, topology-spread constraints.  The TPU flattener (ops/flatten.py)
+    reads these, never the raw dict.
+    """
+
+    __slots__ = (
+        "pod", "key", "uid", "labels", "priority", "request", "request_nonzero",
+        "required_affinity_terms", "required_anti_affinity_terms",
+        "preferred_affinity_terms", "preferred_anti_affinity_terms",
+        "tolerations", "node_selector", "node_affinity_required",
+        "node_affinity_preferred", "host_ports", "topology_spread_constraints",
+        "scheduler_name", "nominated_node_name",
+    )
+
+    def __init__(self, pod: Obj):
+        self.update(pod)
+
+    def update(self, pod: Obj) -> None:
+        spec = pod.get("spec") or {}
+        self.pod = pod
+        self.key = meta.namespaced_name(pod)
+        self.uid = meta.uid(pod)
+        self.labels = meta.labels(pod)
+        self.priority = spec.get("priority") or 0
+        self.request = pod_request(pod)
+        self.request_nonzero = pod_request_nonzero(pod)
+        self.scheduler_name = spec.get("schedulerName", "default-scheduler")
+        self.nominated_node_name = (pod.get("status") or {}).get("nominatedNodeName", "")
+
+        ns = meta.namespace(pod)
+        affinity = spec.get("affinity") or {}
+        pa = affinity.get("podAffinity") or {}
+        paa = affinity.get("podAntiAffinity") or {}
+        self.required_affinity_terms = _compile_terms(
+            pa.get("requiredDuringSchedulingIgnoredDuringExecution"), ns)
+        self.required_anti_affinity_terms = _compile_terms(
+            paa.get("requiredDuringSchedulingIgnoredDuringExecution"), ns)
+        self.preferred_affinity_terms = _compile_terms(
+            pa.get("preferredDuringSchedulingIgnoredDuringExecution"), ns, weighted=True)
+        self.preferred_anti_affinity_terms = _compile_terms(
+            paa.get("preferredDuringSchedulingIgnoredDuringExecution"), ns, weighted=True)
+
+        na = affinity.get("nodeAffinity") or {}
+        self.node_selector = spec.get("nodeSelector") or {}
+        req = na.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+        self.node_affinity_required = [
+            _compile_node_selector_term(t) for t in req.get("nodeSelectorTerms") or ()]
+        self.node_affinity_preferred = [
+            (p.get("weight", 0), _compile_node_selector_term(p.get("preference") or {}))
+            for p in na.get("preferredDuringSchedulingIgnoredDuringExecution") or ()]
+
+        self.tolerations = spec.get("tolerations") or []
+        self.host_ports = _collect_host_ports(spec)
+        self.topology_spread_constraints = spec.get("topologySpreadConstraints") or []
+
+    def has_required_anti_affinity(self) -> bool:
+        return bool(self.required_anti_affinity_terms)
+
+    def has_affinity(self) -> bool:
+        return bool(self.required_affinity_terms or self.required_anti_affinity_terms
+                    or self.preferred_affinity_terms or self.preferred_anti_affinity_terms)
+
+
+def _compile_node_selector_term(term: Obj) -> tuple[Selector, Selector]:
+    """A NodeSelectorTerm = (matchExpressions on labels, matchFields on metadata.name)."""
+    lab = Selector(tuple(
+        _req_from_expr(e) for e in term.get("matchExpressions") or ()))
+    fields = Selector(tuple(
+        _req_from_expr(e) for e in term.get("matchFields") or ()))
+    return lab, fields
+
+
+def _req_from_expr(e: Obj):
+    from ..api.labels import Requirement
+    return Requirement(e["key"], e["operator"], tuple(e.get("values") or ()))
+
+
+def node_selector_terms_match(terms: list[tuple[Selector, Selector]], node: Obj) -> bool:
+    """OR over terms, AND within a term (nodeaffinity.go semantics).
+    Empty terms list means no restriction."""
+    if not terms:
+        return True
+    node_labels = meta.labels(node)
+    node_fields = {"metadata.name": meta.name(node)}
+    for lab, fields in terms:
+        if lab.matches(node_labels) and fields.matches(node_fields):
+            return True
+    return False
+
+
+def _collect_host_ports(spec: Obj) -> list[tuple[str, str, int]]:
+    """[(protocol, hostIP, hostPort)] for all containers."""
+    out = []
+    for c in itertools.chain(spec.get("containers") or (), spec.get("initContainers") or ()):
+        for p in c.get("ports") or ():
+            hp = p.get("hostPort", 0)
+            if hp:
+                out.append((p.get("protocol", "TCP"), p.get("hostIP", "0.0.0.0"), hp))
+    return out
+
+
+# --- NodeInfo -------------------------------------------------------------
+
+_generation = itertools.count(1)
+
+
+class NodeInfo:
+    """Aggregated per-node state (framework/types.go:375).
+
+    Tracks requested/non-zero-requested resources incrementally as pods are
+    added/removed, the host-port set, affinity pod sublists, and image states.
+    `generation` bumps on every mutation — the cache's incremental snapshot
+    (cache.py) and the TPU flattener's dirty-row re-encode key off it.
+    """
+
+    __slots__ = ("node", "pods", "pods_with_affinity", "pods_with_required_anti_affinity",
+                 "requested", "non_zero_requested", "allocatable", "used_ports",
+                 "image_sizes", "pvc_ref_counts", "generation")
+
+    def __init__(self, node: Obj | None = None):
+        self.node = node
+        self.pods: list[PodInfo] = []
+        self.pods_with_affinity: list[PodInfo] = []
+        self.pods_with_required_anti_affinity: list[PodInfo] = []
+        self.requested = Resource()
+        self.non_zero_requested = Resource()
+        self.allocatable = node_allocatable(node) if node else Resource()
+        self.used_ports: set[tuple[str, str, int]] = set()
+        self.image_sizes: dict[str, int] = {}
+        self.pvc_ref_counts: dict[str, int] = {}
+        self.generation = next(_generation)
+        if node is not None:
+            for img in (node.get("status") or {}).get("images") or ():
+                size = img.get("sizeBytes", 0)
+                for name in img.get("names") or ():
+                    self.image_sizes[name] = size
+
+    @property
+    def name(self) -> str:
+        return meta.name(self.node) if self.node else ""
+
+    def set_node(self, node: Obj) -> None:
+        self.node = node
+        self.allocatable = node_allocatable(node)
+        self.image_sizes = {}
+        for img in (node.get("status") or {}).get("images") or ():
+            size = img.get("sizeBytes", 0)
+            for name in img.get("names") or ():
+                self.image_sizes[name] = size
+        self.generation = next(_generation)
+
+    def add_pod(self, pi: PodInfo) -> None:
+        self.pods.append(pi)
+        if pi.has_affinity():
+            self.pods_with_affinity.append(pi)
+        if pi.has_required_anti_affinity():
+            self.pods_with_required_anti_affinity.append(pi)
+        self.requested.add(pi.request)
+        self.non_zero_requested.add(pi.request_nonzero)
+        self.used_ports.update(pi.host_ports)
+        for v in (pi.pod.get("spec") or {}).get("volumes") or ():
+            pvc = (v.get("persistentVolumeClaim") or {}).get("claimName")
+            if pvc:
+                key = f"{meta.namespace(pi.pod)}/{pvc}"
+                self.pvc_ref_counts[key] = self.pvc_ref_counts.get(key, 0) + 1
+        self.generation = next(_generation)
+
+    def remove_pod(self, pod: Obj) -> bool:
+        key = meta.namespaced_name(pod)
+        removed: PodInfo | None = None
+        for i, pi in enumerate(self.pods):
+            if pi.key == key:
+                removed = pi
+                del self.pods[i]
+                break
+        if removed is None:
+            return False
+        for lst in (self.pods_with_affinity, self.pods_with_required_anti_affinity):
+            for i, pi in enumerate(lst):
+                if pi.key == key:
+                    del lst[i]
+                    break
+        self.requested.sub(removed.request)
+        self.non_zero_requested.sub(removed.request_nonzero)
+        self.used_ports.difference_update(removed.host_ports)
+        for v in (removed.pod.get("spec") or {}).get("volumes") or ():
+            pvc = (v.get("persistentVolumeClaim") or {}).get("claimName")
+            if pvc:
+                k = f"{meta.namespace(removed.pod)}/{pvc}"
+                n = self.pvc_ref_counts.get(k, 0) - 1
+                if n <= 0:
+                    self.pvc_ref_counts.pop(k, None)
+                else:
+                    self.pvc_ref_counts[k] = n
+        self.generation = next(_generation)
+        return True
+
+    def clone(self) -> "NodeInfo":
+        c = NodeInfo.__new__(NodeInfo)
+        c.node = self.node
+        c.pods = list(self.pods)
+        c.pods_with_affinity = list(self.pods_with_affinity)
+        c.pods_with_required_anti_affinity = list(self.pods_with_required_anti_affinity)
+        c.requested = self.requested.clone()
+        c.non_zero_requested = self.non_zero_requested.clone()
+        c.allocatable = self.allocatable
+        c.used_ports = set(self.used_ports)
+        c.image_sizes = dict(self.image_sizes)
+        c.pvc_ref_counts = dict(self.pvc_ref_counts)
+        c.generation = self.generation
+        return c
+
+
+# --- queue bookkeeping ----------------------------------------------------
+
+@dataclass(slots=True)
+class QueuedPodInfo:
+    """Queue wrapper (framework/types.go QueuedPodInfo)."""
+
+    pod_info: PodInfo
+    timestamp: float = field(default_factory=time.monotonic)
+    initial_attempt_timestamp: float = field(default_factory=time.monotonic)
+    attempts: int = 0
+    unschedulable_plugins: set[str] = field(default_factory=set)
+    gated: bool = False
+
+    @property
+    def pod(self) -> Obj:
+        return self.pod_info.pod
+
+    @property
+    def key(self) -> str:
+        return self.pod_info.key
+
+
+@dataclass(slots=True)
+class Diagnosis:
+    """Why scheduling failed (framework/types.go Diagnosis)."""
+
+    node_to_status: dict[str, Status] = field(default_factory=dict)
+    unschedulable_plugins: set[str] = field(default_factory=set)
+    pre_filter_msg: str = ""
+
+
+class FitError(Exception):
+    """No node fits (framework/types.go FitError)."""
+
+    def __init__(self, pod: Obj, num_all_nodes: int, diagnosis: Diagnosis):
+        self.pod = pod
+        self.num_all_nodes = num_all_nodes
+        self.diagnosis = diagnosis
+        super().__init__(self.message())
+
+    def message(self) -> str:
+        reasons: dict[str, int] = {}
+        for s in self.diagnosis.node_to_status.values():
+            for r in s.reasons or [_CODE_NAMES[s.code]]:
+                reasons[r] = reasons.get(r, 0) + 1
+        detail = "; ".join(f"{n} {r}" for r, n in sorted(reasons.items()))
+        return (f"0/{self.num_all_nodes} nodes are available: {detail or self.diagnosis.pre_filter_msg}")

@@ -1,0 +1,3 @@
+"""Testing kit (reference: pkg/scheduler/testing)."""
+
+from .wrappers import NodeWrapper, PodWrapper, make_node, make_pod  # noqa: F401

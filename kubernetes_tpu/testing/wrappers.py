@@ -1,0 +1,165 @@
+"""Fluent test-object builders.
+
+Reference: pkg/scheduler/testing/wrappers.go (MakePod().Name("p").Req(...)...)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..api import meta
+from ..api.meta import Obj
+from ..api.resources import make_resource_list
+
+
+class PodWrapper:
+    def __init__(self, name: str, namespace: str = "default"):
+        self.obj: Obj = meta.new_object("Pod", name, namespace)
+        self.obj["spec"] = {"containers": [], "schedulerName": "default-scheduler"}
+        self.obj["status"] = {}
+
+    def req(self, cpu: str | None = None, mem: str | None = None,
+            **scalar: str) -> "PodWrapper":
+        requests: dict[str, Any] = {}
+        if cpu is not None:
+            requests["cpu"] = cpu
+        if mem is not None:
+            requests["memory"] = mem
+        requests.update(scalar)
+        self.obj["spec"]["containers"].append(
+            {"name": f"c{len(self.obj['spec']['containers'])}",
+             "image": "img", "resources": {"requests": requests}})
+        return self
+
+    def container(self, image: str) -> "PodWrapper":
+        self.obj["spec"]["containers"].append(
+            {"name": f"c{len(self.obj['spec']['containers'])}", "image": image})
+        return self
+
+    def priority(self, p: int) -> "PodWrapper":
+        self.obj["spec"]["priority"] = p
+        return self
+
+    def node(self, name: str) -> "PodWrapper":
+        self.obj["spec"]["nodeName"] = name
+        return self
+
+    def scheduler(self, name: str) -> "PodWrapper":
+        self.obj["spec"]["schedulerName"] = name
+        return self
+
+    def labels(self, **kv: str) -> "PodWrapper":
+        self.obj["metadata"].setdefault("labels", {}).update(kv)
+        return self
+
+    def node_selector(self, **kv: str) -> "PodWrapper":
+        self.obj["spec"].setdefault("nodeSelector", {}).update(kv)
+        return self
+
+    def node_affinity_in(self, key: str, values: list[str]) -> "PodWrapper":
+        terms = (self.obj["spec"].setdefault("affinity", {})
+                 .setdefault("nodeAffinity", {})
+                 .setdefault("requiredDuringSchedulingIgnoredDuringExecution", {})
+                 .setdefault("nodeSelectorTerms", []))
+        terms.append({"matchExpressions": [
+            {"key": key, "operator": "In", "values": values}]})
+        return self
+
+    def pod_affinity(self, topology_key: str, match_labels: dict[str, str],
+                     anti: bool = False, preferred_weight: int | None = None
+                     ) -> "PodWrapper":
+        kind = "podAntiAffinity" if anti else "podAffinity"
+        aff = self.obj["spec"].setdefault("affinity", {}).setdefault(kind, {})
+        term = {"topologyKey": topology_key,
+                "labelSelector": {"matchLabels": match_labels}}
+        if preferred_weight is None:
+            aff.setdefault("requiredDuringSchedulingIgnoredDuringExecution",
+                           []).append(term)
+        else:
+            aff.setdefault("preferredDuringSchedulingIgnoredDuringExecution",
+                           []).append({"weight": preferred_weight,
+                                       "podAffinityTerm": term})
+        return self
+
+    def topology_spread(self, topology_key: str, max_skew: int = 1,
+                        when: str = "DoNotSchedule",
+                        match_labels: dict[str, str] | None = None) -> "PodWrapper":
+        self.obj["spec"].setdefault("topologySpreadConstraints", []).append({
+            "maxSkew": max_skew, "topologyKey": topology_key,
+            "whenUnsatisfiable": when,
+            "labelSelector": {"matchLabels": match_labels or meta.labels(self.obj)},
+        })
+        return self
+
+    def toleration(self, key: str, value: str = "", effect: str = "",
+                   operator: str = "Equal") -> "PodWrapper":
+        tol: dict[str, Any] = {"key": key, "operator": operator}
+        if value:
+            tol["value"] = value
+        if effect:
+            tol["effect"] = effect
+        self.obj["spec"].setdefault("tolerations", []).append(tol)
+        return self
+
+    def host_port(self, port: int, protocol: str = "TCP") -> "PodWrapper":
+        if not self.obj["spec"]["containers"]:
+            self.container("img")
+        self.obj["spec"]["containers"][0].setdefault("ports", []).append(
+            {"containerPort": port, "hostPort": port, "protocol": protocol})
+        return self
+
+    def build(self) -> Obj:
+        if not self.obj["spec"]["containers"]:
+            self.container("img")
+        return self.obj
+
+
+class NodeWrapper:
+    def __init__(self, name: str):
+        self.obj: Obj = meta.new_object("Node", name, None)
+        self.obj["spec"] = {}
+        self.obj["status"] = {
+            "allocatable": make_resource_list(cpu_milli=4000, mem=16 * 2**30),
+            "capacity": make_resource_list(cpu_milli=4000, mem=16 * 2**30),
+        }
+
+    def capacity(self, cpu: str = "4", mem: str = "16Gi", pods: int = 110,
+                 **scalar: str) -> "NodeWrapper":
+        rl: dict[str, Any] = {"cpu": cpu, "memory": mem, "pods": str(pods)}
+        rl.update(scalar)
+        self.obj["status"]["allocatable"] = rl
+        self.obj["status"]["capacity"] = dict(rl)
+        return self
+
+    def labels(self, **kv: str) -> "NodeWrapper":
+        self.obj["metadata"].setdefault("labels", {}).update(kv)
+        return self
+
+    def zone(self, zone: str) -> "NodeWrapper":
+        return self.labels(**{"topology.kubernetes.io/zone": zone})
+
+    def taint(self, key: str, value: str = "", effect: str = "NoSchedule"
+              ) -> "NodeWrapper":
+        self.obj["spec"].setdefault("taints", []).append(
+            {"key": key, "value": value, "effect": effect})
+        return self
+
+    def unschedulable(self) -> "NodeWrapper":
+        self.obj["spec"]["unschedulable"] = True
+        return self
+
+    def image(self, name: str, size: int) -> "NodeWrapper":
+        self.obj["status"].setdefault("images", []).append(
+            {"names": [name], "sizeBytes": size})
+        return self
+
+    def build(self) -> Obj:
+        return self.obj
+
+
+def make_pod(name: str, namespace: str = "default") -> PodWrapper:
+    return PodWrapper(name, namespace)
+
+
+def make_node(name: str) -> NodeWrapper:
+    return NodeWrapper(name)

@@ -1,0 +1,172 @@
+"""Integration tests: full store -> informer -> scheduler -> bind loop.
+
+Mirrors the reference's test/integration/scheduler/ suites: real (in-process)
+store, real informers, real scheduler; no kubelet — pods are just bound.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.scheduler import new_scheduler
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+@pytest.fixture
+def cluster():
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    sched = new_scheduler(client, factory)
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    yield store, client, sched
+    sched.stop()
+    factory.stop()
+
+
+def wait_for(predicate, timeout=10.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def pod_bound(client, name, ns="default"):
+    def check():
+        p = client.get(PODS, ns, name)
+        return bool(meta.pod_node_name(p))
+    return check
+
+
+class TestBasicScheduling:
+    def test_single_pod_binds(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").build())
+        client.create(PODS, make_pod("p1").req(cpu="100m").build())
+        assert wait_for(pod_bound(client, "p1"))
+        assert meta.pod_node_name(client.get(PODS, "default", "p1")) == "n1"
+
+    def test_spreads_by_least_allocated(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="2", mem="4Gi").build())
+        client.create(NODES, make_node("n2").capacity(cpu="2", mem="4Gi").build())
+        for i in range(4):
+            client.create(PODS, make_pod(f"p{i}").req(cpu="500m", mem="512Mi").build())
+        assert wait_for(lambda: all(pod_bound(client, f"p{i}")() for i in range(4)))
+        nodes = {meta.pod_node_name(client.get(PODS, "default", f"p{i}"))
+                 for i in range(4)}
+        assert nodes == {"n1", "n2"}  # least-allocated spreads across both
+
+    def test_unschedulable_then_node_arrives(self, cluster):
+        store, client, sched = cluster
+        client.create(PODS, make_pod("p1").req(cpu="1").build())
+        time.sleep(0.3)
+        p = client.get(PODS, "default", "p1")
+        assert not meta.pod_node_name(p)
+        conds = (p.get("status") or {}).get("conditions") or []
+        assert any(c.get("reason") == "Unschedulable" for c in conds)
+        # node arrives -> queue moves pod back -> binds
+        client.create(NODES, make_node("n1").build())
+        assert wait_for(pod_bound(client, "p1"))
+
+    def test_resource_exhaustion(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        client.create(PODS, make_pod("big1").req(cpu="800m").build())
+        assert wait_for(pod_bound(client, "big1"))
+        client.create(PODS, make_pod("big2").req(cpu="800m").build())
+        time.sleep(0.3)
+        assert not meta.pod_node_name(client.get(PODS, "default", "big2"))
+
+    def test_released_resources_reusable(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        client.create(PODS, make_pod("a").req(cpu="800m").build())
+        assert wait_for(pod_bound(client, "a"))
+        client.create(PODS, make_pod("b").req(cpu="800m").build())
+        time.sleep(0.2)
+        client.delete(PODS, "default", "a")  # frees resources
+        assert wait_for(pod_bound(client, "b"))
+
+    def test_node_selector_respected(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").labels(disk="hdd").build())
+        client.create(NODES, make_node("n2").labels(disk="ssd").build())
+        client.create(PODS, make_pod("p").node_selector(disk="ssd").build())
+        assert wait_for(pod_bound(client, "p"))
+        assert meta.pod_node_name(client.get(PODS, "default", "p")) == "n2"
+
+    def test_taints_respected(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").taint("dedicated", "db").build())
+        client.create(NODES, make_node("n2").build())
+        client.create(PODS, make_pod("p").build())
+        assert wait_for(pod_bound(client, "p"))
+        assert meta.pod_node_name(client.get(PODS, "default", "p")) == "n2"
+
+    def test_priority_order(self, cluster):
+        """Higher-priority pod pops first when both are pending."""
+        store, client, sched = cluster
+        client.create(PODS, make_pod("low").priority(1).req(cpu="800m").build())
+        client.create(PODS, make_pod("high").priority(100).req(cpu="800m").build())
+        time.sleep(0.3)
+        # one node with room for exactly one pod
+        client.create(NODES, make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        assert wait_for(pod_bound(client, "high"))
+        time.sleep(0.2)
+        assert not meta.pod_node_name(client.get(PODS, "default", "low"))
+
+    def test_anti_affinity_spread(self, cluster):
+        store, client, sched = cluster
+        for n in ("n1", "n2", "n3"):
+            client.create(NODES, make_node(n).labels(
+                **{"kubernetes.io/hostname": n}).build())
+        for i in range(3):
+            client.create(PODS, make_pod(f"p{i}").labels(app="web").pod_affinity(
+                "kubernetes.io/hostname", {"app": "web"}, anti=True).build())
+        assert wait_for(lambda: all(pod_bound(client, f"p{i}")() for i in range(3)),
+                        timeout=15)
+        nodes = [meta.pod_node_name(client.get(PODS, "default", f"p{i}"))
+                 for i in range(3)]
+        assert len(set(nodes)) == 3  # all on distinct hosts
+
+    def test_topology_spread(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("a1").zone("a").build())
+        client.create(NODES, make_node("b1").zone("b").build())
+        for i in range(4):
+            client.create(PODS, make_pod(f"p{i}").labels(app="web").topology_spread(
+                "topology.kubernetes.io/zone", max_skew=1,
+                match_labels={"app": "web"}).build())
+        assert wait_for(lambda: all(pod_bound(client, f"p{i}")() for i in range(4)),
+                        timeout=15)
+        zones = {}
+        for i in range(4):
+            n = meta.pod_node_name(client.get(PODS, "default", f"p{i}"))
+            zone = "a" if n.startswith("a") else "b"
+            zones[zone] = zones.get(zone, 0) + 1
+        assert zones == {"a": 2, "b": 2}
+
+    def test_metrics_recorded(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").build())
+        client.create(PODS, make_pod("p1").build())
+        assert wait_for(pod_bound(client, "p1"))
+        assert wait_for(
+            lambda: sched.metrics.schedule_attempts.get("scheduled", 0) >= 1)
+
+    def test_cache_confirms_assumed_pod(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").build())
+        client.create(PODS, make_pod("p1").build())
+        assert wait_for(pod_bound(client, "p1"))
+        assert wait_for(lambda: sched.cache.assumed_pod_count() == 0)
+        assert sched.cache.pod_count() == 1

@@ -1,0 +1,268 @@
+"""Unit tests for the pure-python (oracle) plugins.
+
+Style mirrors the reference's per-plugin table-driven tests
+(plugins/*/filtering_test.go, scoring_test.go).
+"""
+
+import pytest
+
+from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler.framework import CycleState
+from kubernetes_tpu.scheduler.plugins.interpodaffinity import InterPodAffinity
+from kubernetes_tpu.scheduler.plugins.nodebasic import (
+    NodeAffinity, NodeName, NodePorts, NodeUnschedulable, TaintToleration,
+)
+from kubernetes_tpu.scheduler.plugins.noderesources import (
+    NodeResourcesBalancedAllocation, NodeResourcesFit, insufficient_resources,
+)
+from kubernetes_tpu.scheduler.plugins.podtopologyspread import PodTopologySpread
+from kubernetes_tpu.scheduler.types import NodeInfo, PodInfo
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def ni(node, pods=()):
+    info = NodeInfo(node)
+    for p in pods:
+        info.add_pod(PodInfo(p))
+    return info
+
+
+def snapshot_of(*node_infos):
+    s = Snapshot()
+    for n in node_infos:
+        s.node_info_map[n.name] = n
+    s.node_info_list = list(node_infos)
+    s.have_pods_with_affinity_list = [n for n in node_infos if n.pods_with_affinity]
+    s.have_pods_with_required_anti_affinity_list = [
+        n for n in node_infos if n.pods_with_required_anti_affinity]
+    return s
+
+
+class TestNodeResourcesFit:
+    def test_fits(self):
+        node = ni(make_node("n1").capacity(cpu="2", mem="4Gi").build())
+        pod = PodInfo(make_pod("p").req(cpu="1", mem="1Gi").build())
+        assert insufficient_resources(pod, node) == []
+
+    def test_insufficient_cpu(self):
+        node = ni(make_node("n1").capacity(cpu="1", mem="4Gi").build())
+        pod = PodInfo(make_pod("p").req(cpu="2").build())
+        assert "Insufficient cpu" in insufficient_resources(pod, node)
+
+    def test_accounts_existing_pods(self):
+        existing = make_pod("e").req(cpu="1500m").node("n1").build()
+        node = ni(make_node("n1").capacity(cpu="2").build(), [existing])
+        pod = PodInfo(make_pod("p").req(cpu="1").build())
+        assert "Insufficient cpu" in insufficient_resources(pod, node)
+
+    def test_too_many_pods(self):
+        node_obj = make_node("n1").capacity(cpu="4", mem="4Gi", pods=1).build()
+        existing = make_pod("e").node("n1").build()
+        node = ni(node_obj, [existing])
+        pod = PodInfo(make_pod("p").build())
+        assert "Too many pods" in insufficient_resources(pod, node)
+
+    def test_scalar_resources(self):
+        node = ni(make_node("n1").capacity(cpu="4", **{"google.com/tpu": "4"}).build())
+        ok = PodInfo(make_pod("p").req(cpu="1", **{"google.com/tpu": "4"}).build())
+        too_much = PodInfo(make_pod("p2").req(**{"google.com/tpu": "8"}).build())
+        assert insufficient_resources(ok, node) == []
+        assert "Insufficient google.com/tpu" in insufficient_resources(too_much, node)
+
+    def test_least_allocated_score(self):
+        plugin = NodeResourcesFit()
+        empty = ni(make_node("n1").capacity(cpu="2", mem="4Gi").build())
+        busy = ni(make_node("n2").capacity(cpu="2", mem="4Gi").build(),
+                  [make_pod("e").req(cpu="1", mem="2Gi").node("n2").build()])
+        pod = PodInfo(make_pod("p").req(cpu="500m", mem="1Gi").build())
+        s_empty, _ = plugin.score(CycleState(), pod, empty)
+        s_busy, _ = plugin.score(CycleState(), pod, busy)
+        assert s_empty > s_busy
+
+    def test_most_allocated_score(self):
+        plugin = NodeResourcesFit(strategy="MostAllocated")
+        empty = ni(make_node("n1").capacity(cpu="2", mem="4Gi").build())
+        busy = ni(make_node("n2").capacity(cpu="2", mem="4Gi").build(),
+                  [make_pod("e").req(cpu="1", mem="2Gi").node("n2").build()])
+        pod = PodInfo(make_pod("p").req(cpu="500m", mem="1Gi").build())
+        s_empty, _ = plugin.score(CycleState(), pod, empty)
+        s_busy, _ = plugin.score(CycleState(), pod, busy)
+        assert s_busy > s_empty
+
+
+class TestBalancedAllocation:
+    def test_balanced_beats_skewed(self):
+        plugin = NodeResourcesBalancedAllocation()
+        balanced = ni(make_node("n1").capacity(cpu="2", mem="4Gi").build(),
+                      [make_pod("e1").req(cpu="1", mem="2Gi").node("n1").build()])
+        skewed = ni(make_node("n2").capacity(cpu="2", mem="4Gi").build(),
+                    [make_pod("e2").req(cpu="1800m", mem="256Mi").node("n2").build()])
+        pod = PodInfo(make_pod("p").req(cpu="100m", mem="128Mi").build())
+        s_bal, _ = plugin.score(CycleState(), pod, balanced)
+        s_skew, _ = plugin.score(CycleState(), pod, skewed)
+        assert s_bal > s_skew
+
+
+class TestSimpleFilters:
+    def test_node_name(self):
+        p = PodInfo(make_pod("p").node("n1").build())
+        assert NodeName().filter(CycleState(), p, ni(make_node("n1").build())) is None
+        assert NodeName().filter(CycleState(), p,
+                                 ni(make_node("n2").build())) is not None
+
+    def test_node_unschedulable(self):
+        p = PodInfo(make_pod("p").build())
+        plugin = NodeUnschedulable()
+        assert plugin.filter(CycleState(), p, ni(make_node("n").build())) is None
+        assert plugin.filter(CycleState(), p,
+                             ni(make_node("n").unschedulable().build())) is not None
+        tolerant = PodInfo(make_pod("p2").toleration(
+            "node.kubernetes.io/unschedulable", operator="Exists",
+            effect="NoSchedule").build())
+        assert plugin.filter(CycleState(), tolerant,
+                             ni(make_node("n").unschedulable().build())) is None
+
+    def test_node_ports_conflict(self):
+        plugin = NodePorts()
+        p = PodInfo(make_pod("p").host_port(8080).build())
+        free = ni(make_node("n").build())
+        taken = ni(make_node("n2").build(),
+                   [make_pod("e").host_port(8080).node("n2").build()])
+        assert plugin.filter(CycleState(), p, free) is None
+        assert plugin.filter(CycleState(), p, taken) is not None
+
+    def test_node_selector(self):
+        plugin = NodeAffinity()
+        p = PodInfo(make_pod("p").node_selector(disk="ssd").build())
+        ssd = ni(make_node("n1").labels(disk="ssd").build())
+        hdd = ni(make_node("n2").labels(disk="hdd").build())
+        assert plugin.filter(CycleState(), p, ssd) is None
+        assert plugin.filter(CycleState(), p, hdd) is not None
+
+    def test_node_affinity_required(self):
+        plugin = NodeAffinity()
+        p = PodInfo(make_pod("p").node_affinity_in("zone", ["a", "b"]).build())
+        in_zone = ni(make_node("n1").labels(zone="a").build())
+        out_zone = ni(make_node("n2").labels(zone="c").build())
+        assert plugin.filter(CycleState(), p, in_zone) is None
+        assert plugin.filter(CycleState(), p, out_zone) is not None
+
+    def test_taint_toleration(self):
+        plugin = TaintToleration()
+        tainted = ni(make_node("n").taint("dedicated", "gpu").build())
+        p = PodInfo(make_pod("p").build())
+        tol = PodInfo(make_pod("p2").toleration("dedicated", "gpu",
+                                                "NoSchedule").build())
+        assert plugin.filter(CycleState(), p, tainted) is not None
+        assert plugin.filter(CycleState(), tol, tainted) is None
+
+
+class TestPodTopologySpread:
+    def _setup(self):
+        # 2 zones; zone a has 2 matching pods, zone b has 0
+        n1 = ni(make_node("n1").zone("a").build(),
+                [make_pod("e1").labels(app="web").node("n1").build(),
+                 make_pod("e2").labels(app="web").node("n1").build()])
+        n2 = ni(make_node("n2").zone("b").build())
+        return n1, n2
+
+    def test_filter_skew(self):
+        n1, n2 = self._setup()
+        snap = snapshot_of(n1, n2)
+        plugin = PodTopologySpread()
+        pod = PodInfo(make_pod("p").labels(app="web").topology_spread(
+            "topology.kubernetes.io/zone", max_skew=1,
+            match_labels={"app": "web"}).build())
+        state = CycleState()
+        _, s = plugin.pre_filter(state, pod, snap)
+        assert s is None
+        # zone a: 2 existing + 1 self - min(0) = 3 > 1 -> reject
+        assert plugin.filter(state, pod, n1) is not None
+        # zone b: 0 + 1 - 0 = 1 <= 1 -> allow
+        assert plugin.filter(state, pod, n2) is None
+
+    def test_score_prefers_empty_zone(self):
+        n1, n2 = self._setup()
+        plugin = PodTopologySpread()
+        pod = PodInfo(make_pod("p").labels(app="web").topology_spread(
+            "topology.kubernetes.io/zone", when="ScheduleAnyway",
+            match_labels={"app": "web"}).build())
+        state = CycleState()
+        assert plugin.pre_score(state, pod, [n1, n2]) is None
+        s1, _ = plugin.score(state, pod, n1)
+        s2, _ = plugin.score(state, pod, n2)
+        scores = {"n1": s1, "n2": s2}
+        plugin.normalize_scores(state, pod, scores)
+        assert scores["n2"] > scores["n1"]
+
+
+class TestInterPodAffinity:
+    def test_anti_affinity_rejects(self):
+        # existing pod with anti-affinity against app=web on hostname
+        existing = (make_pod("e").labels(app="web").node("n1")
+                    .pod_affinity("kubernetes.io/hostname", {"app": "web"},
+                                  anti=True).build())
+        n1 = ni(make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build(),
+                [existing])
+        n2 = ni(make_node("n2").labels(**{"kubernetes.io/hostname": "n2"}).build())
+        snap = snapshot_of(n1, n2)
+        plugin = InterPodAffinity()
+        pod = PodInfo(make_pod("p").labels(app="web").build())
+        state = CycleState()
+        _, s = plugin.pre_filter(state, pod, snap)
+        assert s is None
+        assert plugin.filter(state, pod, n1) is not None  # existing anti matches
+        assert plugin.filter(state, pod, n2) is None
+
+    def test_incoming_anti_affinity(self):
+        existing = make_pod("e").labels(app="web").node("n1").build()
+        n1 = ni(make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build(),
+                [existing])
+        n2 = ni(make_node("n2").labels(**{"kubernetes.io/hostname": "n2"}).build())
+        snap = snapshot_of(n1, n2)
+        plugin = InterPodAffinity()
+        pod = PodInfo(make_pod("p").labels(app="web").pod_affinity(
+            "kubernetes.io/hostname", {"app": "web"}, anti=True).build())
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        assert plugin.filter(state, pod, n1) is not None
+        assert plugin.filter(state, pod, n2) is None
+
+    def test_affinity_requires_match(self):
+        existing = make_pod("e").labels(app="db").node("n1").build()
+        n1 = ni(make_node("n1").zone("a").build(), [existing])
+        n2 = ni(make_node("n2").zone("b").build())
+        snap = snapshot_of(n1, n2)
+        plugin = InterPodAffinity()
+        pod = PodInfo(make_pod("p").pod_affinity(
+            "topology.kubernetes.io/zone", {"app": "db"}).build())
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        assert plugin.filter(state, pod, n1) is None   # zone a has app=db
+        assert plugin.filter(state, pod, n2) is not None
+
+    def test_self_affinity_bootstrap(self):
+        # first pod of a self-affine group must schedule somewhere
+        n1 = ni(make_node("n1").zone("a").build())
+        snap = snapshot_of(n1)
+        plugin = InterPodAffinity()
+        pod = PodInfo(make_pod("p").labels(app="web").pod_affinity(
+            "topology.kubernetes.io/zone", {"app": "web"}).build())
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        assert plugin.filter(state, pod, n1) is None
+
+    def test_preferred_affinity_scoring(self):
+        existing = make_pod("e").labels(app="cache").node("n1").build()
+        n1 = ni(make_node("n1").zone("a").build(), [existing])
+        n2 = ni(make_node("n2").zone("b").build())
+        plugin = InterPodAffinity()
+        pod = PodInfo(make_pod("p").pod_affinity(
+            "topology.kubernetes.io/zone", {"app": "cache"},
+            preferred_weight=10).build())
+        state = CycleState()
+        s = plugin.pre_score(state, pod, [n1, n2])
+        assert s is None
+        s1, _ = plugin.score(state, pod, n1)
+        s2, _ = plugin.score(state, pod, n2)
+        assert s1 > s2
