@@ -1,0 +1,3 @@
+"""Batched assignment solvers (greedy scan; auction/sinkhorn to follow)."""
+
+from .assign import build_assign_fn  # noqa: F401

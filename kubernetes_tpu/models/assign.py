@@ -1,0 +1,220 @@
+"""Batched pod->node assignment on device.
+
+This is the TPU replacement for the reference's HOT LOOPS (SURVEY.md §3.1):
+  findNodesThatPassFilters (schedule_one.go:512)  -> feasibility masks
+  RunScorePlugins          (runtime/framework.go:903) -> score matrix
+  selectHost               (schedule_one.go:777)  -> masked argmax
+  + the implicit cache.assume() between per-pod cycles -> in-scan running
+    sums (resources, pod counts, host ports, topology/affinity domain
+    counts), which is what makes a batch of K pods produce the same
+    placements the reference produces scheduling them one at a time
+    (SURVEY.md §7 hard part #1).
+
+Structure:
+  static phase (vectorized over P x N, MXU matmuls):
+      label-selector any-of groups   einsum('pgl,nl->pgn')
+      forbidden labels / keys        matmul
+      untolerated-taint counts       matmul
+      (these mirror NodeAffinity / NodeUnschedulable / TaintToleration /
+       NodeName filters)
+  scan phase (lax.scan over the P pods in queue order):
+      NodeResourcesFit mask from running used/npods sums
+      NodePorts conflict from running port mask
+      PodTopologySpread / InterPodAffinity from running domain counts
+      LeastAllocated + BalancedAllocation + spread/affinity scores
+      masked argmax -> placement -> state update
+
+All shapes are static (derived from flatten.Caps), so one compilation
+serves every batch; arrays are padded and masked.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.flatten import (
+    C_AFFINITY, C_ANTI_AFFINITY, C_NONE, C_PREF_AFFINITY, C_SPREAD_HARD,
+    C_SPREAD_SCORE, CORE_R, Caps,
+)
+
+NEG = -1e9
+
+
+def _static_mask_and_score(node: dict, pod: dict) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Vectorized P x N feasibility independent of in-batch placements.
+
+    Returns (sel_mask, static_mask, static_score):
+      sel_mask    - node-affinity/selector-only eligibility (used for the
+                    spread min-match domain set, which the reference computes
+                    over affinity-eligible nodes only, filtering.go:261)
+      static_mask - sel_mask AND taints AND nodeName pin AND validity
+      static_score- PreferNoSchedule taint score contribution (0..100)
+    """
+    valid = node["valid"][None, :]                        # [1,N]
+    label = node["label_mask"]                            # [N,L]
+    keym = node["key_mask"]                               # [N,KL]
+
+    # any-of label groups: group satisfied if node has >=1 of its ids
+    hits = jnp.einsum("pgl,nl->pgn", pod["sel_any"], label)
+    group_ok = (hits > 0) | (pod["sel_any_active"][:, :, None] == 0)
+    sel_ok = jnp.all(group_ok, axis=1)                    # [P,N]
+    khits = jnp.einsum("pgk,nk->pgn", pod["key_any"], keym)
+    kgroup_ok = (khits > 0) | (pod["key_any_active"][:, :, None] == 0)
+    sel_ok &= jnp.all(kgroup_ok, axis=1)
+    sel_ok &= (pod["sel_forb"] @ label.T) == 0            # NotIn
+    sel_ok &= (pod["key_forb"] @ keym.T) == 0             # DoesNotExist
+    sel_mask = sel_ok & valid
+
+    # taints (TaintToleration + NodeUnschedulable-as-taint)
+    hard = (pod["untol_hard"] @ node["taint_mask"].T) == 0
+    # spec.nodeName pin
+    n_idx = jnp.arange(label.shape[0])[None, :]
+    pin = (pod["node_row"][:, None] < 0) | (n_idx == pod["node_row"][:, None])
+
+    static_mask = sel_mask & hard & pin
+
+    prefer_cnt = pod["untol_prefer"] @ node["taint_mask"].T   # [P,N]
+    mx = jnp.max(jnp.where(static_mask, prefer_cnt, 0.0), axis=1, keepdims=True)
+    static_score = jnp.where(mx > 0, (mx - prefer_cnt) * 100.0 / jnp.maximum(mx, 1.0), 100.0)
+    return sel_mask, static_mask, static_score
+
+
+def _resource_fit(req: jnp.ndarray, alloc: jnp.ndarray, used: jnp.ndarray,
+                  npods: jnp.ndarray, maxpods: jnp.ndarray) -> jnp.ndarray:
+    """NodeResourcesFit (fit.go:253) for one pod against all nodes: [N]."""
+    fits = jnp.all(req[None, :] <= alloc - used, axis=1)
+    return fits & (npods + 1.0 <= maxpods)
+
+
+def _fit_scores(req_nz: jnp.ndarray, alloc: jnp.ndarray, used_nz: jnp.ndarray
+                ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """LeastAllocated + BalancedAllocation over cpu/mem dims: ([N],[N])."""
+    a = alloc[:, :2]
+    u = (used_nz[:, :2] + req_nz[None, :2])
+    util = jnp.where(a > 0, jnp.minimum(u / jnp.maximum(a, 1.0), 1.0), 1.0)
+    least = jnp.mean((1.0 - util), axis=1) * 100.0
+    mean = jnp.mean(util, axis=1, keepdims=True)
+    std = jnp.sqrt(jnp.mean((util - mean) ** 2, axis=1))
+    balanced = (1.0 - std) * 100.0
+    return least, balanced
+
+
+def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None):
+    """Compile the batched assignment for the given static capacities.
+
+    Returns fn(node_arrays, pod_arrays) -> (assignments i32[P], used, npods)
+    where assignments[p] is the node row or -1.
+    """
+    w = {"fit": 1.0, "balanced": 1.0, "spread": 2.0, "affinity": 1.0,
+         "taint": 1.0, **(weights or {})}
+
+    @jax.jit
+    def assign(node: dict, pod: dict) -> dict[str, jnp.ndarray]:
+        sel_mask, static_mask, static_score = _static_mask_and_score(node, pod)
+
+        alloc = node["alloc"]
+        dom_sg = node["dom_sg"]          # [SG,N]
+        dom_asg = node["dom_asg"]        # [ASG,N]
+        n_iota = jnp.arange(alloc.shape[0])
+
+        def step(carry, xs):
+            used, used_nz, npods, ports, cd_sg, cd_asg = carry
+            (req, req_nz, p_valid, p_ports, p_sel_mask, p_static_mask,
+             p_static_score, c_kind, c_sg, c_maxskew, c_selfmatch, c_weight,
+             inc_sg, inc_asg, match_asg) = xs
+
+            mask = p_static_mask
+            mask &= _resource_fit(req, alloc, used, npods, node["maxpods"])
+            mask &= (ports @ p_ports) == 0                     # NodePorts
+
+            # existing pods' (and earlier batch pods') anti-affinity
+            # blocked[n] = any asg matching this pod with count>0 in n's domain
+            adom = jnp.clip(dom_asg, 0)                        # [ASG,N]
+            acnt = jnp.take_along_axis(cd_asg, adom, axis=1)   # [ASG,N]
+            acnt = jnp.where(dom_asg >= 0, acnt, 0.0)
+            blocked = (match_asg[:, None] * (acnt > 0)).sum(0) > 0
+            mask &= ~blocked
+
+            score = w["fit"] * 0.0
+            least, balanced = _fit_scores(req_nz, alloc, used_nz)
+            score = w["fit"] * least + w["balanced"] * balanced
+            score = score + w["taint"] * p_static_score
+
+            # constraints (unrolled over C; all kinds computed, selected by mask)
+            for c in range(caps.c_cap):
+                kind = c_kind[c]
+                sg = jnp.clip(c_sg[c], 0)
+                dom = dom_sg[sg]                               # [N]
+                cnt_row = cd_sg[sg]                            # [D]
+                gathered = jnp.where(dom >= 0, cnt_row[jnp.clip(dom, 0)], 0.0)
+                has_dom = dom >= 0
+                active = kind != C_NONE
+
+                # min over domains present among sel-eligible nodes
+                elig = p_sel_mask & has_dom
+                minmatch = jnp.min(jnp.where(elig, gathered, jnp.inf))
+                minmatch = jnp.where(jnp.isfinite(minmatch), minmatch, 0.0)
+                total = jnp.sum(cnt_row)
+
+                spread_ok = (gathered + c_selfmatch[c] - minmatch) <= c_maxskew[c]
+                spread_ok &= has_dom
+                aff_ok = (gathered > 0) | ((total == 0) & (c_selfmatch[c] > 0))
+                aff_ok &= has_dom
+                anti_ok = jnp.where(has_dom, gathered == 0, True)
+
+                ok = jnp.where(kind == C_SPREAD_HARD, spread_ok,
+                               jnp.where(kind == C_AFFINITY, aff_ok,
+                                         jnp.where(kind == C_ANTI_AFFINITY,
+                                                   anti_ok, True)))
+                mask &= ok | ~active
+
+                # score kinds: fewer matches better for spread; weighted count
+                # for preferred affinity (sign carried by weight)
+                smx = jnp.max(jnp.where(mask, gathered, 0.0))
+                smn = jnp.min(jnp.where(mask, gathered, jnp.inf))
+                smn = jnp.where(jnp.isfinite(smn), smn, 0.0)
+                rng = jnp.maximum(smx - smn, 1.0)
+                spread_score = (smx - gathered) * 100.0 / rng
+                score += jnp.where(kind == C_SPREAD_SCORE,
+                                   w["spread"] * spread_score, 0.0)
+                score += jnp.where(kind == C_PREF_AFFINITY,
+                                   w["affinity"] * c_weight[c] * gathered, 0.0)
+
+            feasible = mask & p_valid
+            any_ok = jnp.any(feasible)
+            j = jnp.argmax(jnp.where(feasible, score, NEG))
+            j = jnp.where(any_ok, j, -1)
+
+            # state updates (the in-batch assume())
+            place = (n_iota == j) & any_ok                     # [N]
+            placef = place.astype(jnp.float32)
+            used = used + placef[:, None] * req[None, :]
+            used_nz = used_nz + placef[:, None] * req_nz[None, :]
+            npods = npods + placef
+            ports = jnp.minimum(ports + placef[:, None] * p_ports[None, :], 1.0)
+
+            jj = jnp.clip(j, 0)
+            d_sg = dom_sg[:, jj]                               # [SG]
+            upd_sg = inc_sg * (d_sg >= 0) * any_ok
+            cd_sg = cd_sg.at[jnp.arange(caps.sg_cap), jnp.clip(d_sg, 0)].add(upd_sg)
+            d_asg = dom_asg[:, jj]
+            upd_asg = inc_asg * (d_asg >= 0) * any_ok
+            cd_asg = cd_asg.at[jnp.arange(caps.asg_cap), jnp.clip(d_asg, 0)].add(upd_asg)
+
+            return (used, used_nz, npods, ports, cd_sg, cd_asg), j
+
+        xs = (pod["req"], pod["req_nz"], pod["p_valid"], pod["ports"],
+              sel_mask, static_mask, static_score,
+              pod["c_kind"], pod["c_sg"], pod["c_maxskew"], pod["c_selfmatch"],
+              pod["c_weight"], pod["inc_sg"], pod["inc_asg"], pod["match_asg"])
+        carry0 = (node["used"], node["used_nz"], node["npods"], node["port_mask"],
+                  node["cd_sg"], node["cd_asg"])
+        carry, assignments = jax.lax.scan(step, carry0, xs)
+        return {"assignments": assignments, "used": carry[0], "npods": carry[2]}
+
+    return assign

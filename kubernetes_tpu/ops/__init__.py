@@ -1,0 +1,3 @@
+"""Tensorization + device-side predicates for the TPU scheduling path."""
+
+from .flatten import BatchEncoder, Caps, ClusterTensors, PodBatch  # noqa: F401

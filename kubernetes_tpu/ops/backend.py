@@ -1,0 +1,130 @@
+"""TPUBatchBackend — the bridge between the scheduler and the device kernel.
+
+This is the in-process equivalent of the BASELINE north star's
+`TPUBatchAssign` plugin + gRPC shim (the shim's wire form lives in
+apiserver/batch_service.py): it drains a batch from the queue (done by
+scheduler.schedule_batch), flattens the snapshot delta into tensors
+(ops/flatten.py), runs feasibility+score+assignment on device
+(models/assign.py), and hands back per-pod placements that the scheduler
+feeds through the ordinary assume/Reserve/Permit/bind tail.
+
+Escape hatch: pods whose constraints exceed the tensor encoding (vocab
+overflow, Gt/Lt node affinity, nominated preemption, ...) come back with a
+SKIP status and the scheduler routes them through the per-pod oracle path —
+wrong answers are structurally impossible, only coverage varies.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from ..models.assign import build_assign_fn
+from ..scheduler.cache import Snapshot
+from ..scheduler.scheduler import BatchBackend
+from ..scheduler.types import SKIP, UNSCHEDULABLE, PodInfo, Status
+from .flatten import BatchEncoder, Caps, ClusterTensors, VocabFullError
+
+logger = logging.getLogger(__name__)
+
+ESCAPE_STATUS_CODE = SKIP  # scheduler routes SKIP results to schedule_one
+
+
+class TPUBatchBackend(BatchBackend):
+    def __init__(self, caps: Caps | None = None, batch_size: int = 256,
+                 weights: dict[str, float] | None = None):
+        self.caps = caps or Caps()
+        self.batch_size = batch_size
+        self.tensors = ClusterTensors(self.caps)
+        self.encoder = BatchEncoder(self.tensors, batch_size)
+        self._assign = build_assign_fn(self.caps, weights)
+        self._device_node: dict | None = None
+        self._device_version = -1
+        self._lock = threading.Lock()
+
+    # -- BatchBackend ----------------------------------------------------
+
+    def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot
+               ) -> list[tuple[int | None, Status | None]]:
+        import jax.numpy as jnp
+
+        with self._lock:
+            try:
+                self.tensors.update_from_snapshot(snapshot)
+                batch = self.encoder.encode(list(pod_infos))
+            except VocabFullError as e:
+                logger.warning("tensorization overflow (%s); whole batch -> oracle path", e)
+                return [(None, Status(SKIP, str(e)))] * len(pod_infos)
+
+            cd_sg, cd_asg = self.tensors.domain_base_counts()
+            if self._device_version != self.tensors.version:
+                t = self.tensors
+                self._device_node = {
+                    "alloc": jnp.asarray(t.alloc),
+                    "maxpods": jnp.asarray(t.maxpods),
+                    "valid": jnp.asarray(t.valid),
+                    "taint_mask": jnp.asarray(t.taint_mask),
+                    "label_mask": jnp.asarray(t.label_mask),
+                    "key_mask": jnp.asarray(t.key_mask),
+                    "dom_sg": jnp.asarray(t.dom_sg),
+                    "dom_asg": jnp.asarray(t.dom_asg),
+                }
+                self._device_version = self.tensors.version
+            node = dict(self._device_node)
+            # dynamic state always re-uploaded: the snapshot is authoritative
+            # (it already includes pods assumed by previous batches)
+            node["used"] = jnp.asarray(self.tensors.used)
+            node["used_nz"] = jnp.asarray(self.tensors.used_nz)
+            node["npods"] = jnp.asarray(self.tensors.npods)
+            node["port_mask"] = jnp.asarray(self.tensors.port_mask)
+            node["cd_sg"] = jnp.asarray(cd_sg)
+            node["cd_asg"] = jnp.asarray(cd_asg)
+
+            pod = {
+                "req": jnp.asarray(batch.req),
+                "req_nz": jnp.asarray(batch.req_nz),
+                "p_valid": jnp.asarray(batch.p_valid),
+                "untol_hard": jnp.asarray(batch.untol_hard),
+                "untol_prefer": jnp.asarray(batch.untol_prefer),
+                "sel_any": jnp.asarray(batch.sel_any),
+                "sel_any_active": jnp.asarray(batch.sel_any_active),
+                "sel_forb": jnp.asarray(batch.sel_forb),
+                "key_any": jnp.asarray(batch.key_any),
+                "key_any_active": jnp.asarray(batch.key_any_active),
+                "key_forb": jnp.asarray(batch.key_forb),
+                "ports": jnp.asarray(batch.ports),
+                "node_row": jnp.asarray(batch.node_row),
+                "c_kind": jnp.asarray(batch.c_kind),
+                "c_sg": jnp.asarray(batch.c_sg),
+                "c_maxskew": jnp.asarray(batch.c_maxskew),
+                "c_selfmatch": jnp.asarray(batch.c_selfmatch),
+                "c_weight": jnp.asarray(batch.c_weight),
+                "inc_sg": jnp.asarray(batch.inc_sg),
+                "inc_asg": jnp.asarray(batch.inc_asg),
+                "match_asg": jnp.asarray(batch.match_asg),
+            }
+            out = self._assign(node, pod)
+            assignments = np.asarray(out["assignments"])
+
+        escapes = set(batch.escape)
+        results: list[tuple[int | None, Status | None]] = []
+        for i in range(len(pod_infos)):
+            if i >= self.batch_size or i in escapes:
+                results.append((None, Status(SKIP, "escape to per-pod path")))
+                continue
+            row = int(assignments[i])
+            if row < 0:
+                results.append((None, Status(
+                    UNSCHEDULABLE, "no feasible node (TPU batch filter)")))
+            else:
+                results.append((row, None))
+        return results
+
+    def node_name(self, idx: int) -> str:
+        name = self.tensors.node_name(idx)
+        if name is None:
+            raise KeyError(f"no node at row {idx}")
+        return name

@@ -1,0 +1,585 @@
+"""Snapshot -> tensor flattener (the tensorization source for the TPU path).
+
+Reference semantics being tensorized:
+  NodeInfo/Resource aggregates   pkg/scheduler/framework/types.go:375,426
+  incremental snapshotting       internal/cache/cache.go:197 (generation diff)
+  NodeResourcesFit               plugins/noderesources/fit.go:253
+  TaintToleration / NodeAffinity / NodePorts / NodeUnschedulable
+  PodTopologySpread match counts filtering.go:40-51 (per-(key,value) counts)
+  InterPodAffinity count maps    filtering.go:90-230
+
+Scheme (see SURVEY.md §7 step 1):
+  * All categorical data (label key=value pairs, label keys, taints, host
+    ports, scalar resource names) goes through capped vocabularies ->
+    integer ids -> dense 0/1 masks.  Vocab caps are static so jitted shapes
+    never change; overflow routes the affected pod to the per-pod oracle
+    path (the escape hatch) rather than producing wrong answers.
+  * Node rows re-encode ONLY when their NodeInfo generation advanced
+    (mirrors UpdateSnapshot's delta copy).  Rows are reused via a free list,
+    so the node axis is stable across batches and padded to n_cap.
+  * Topology-sensitive constraints (spread / pod (anti-)affinity) compile
+    to "selector groups": a (topology_key, selector, namespaces) triple.
+    Per node we maintain cnt[sg, row] = matching pods on that node; per
+    batch the per-domain base counts are one bincount away.  The greedy
+    assignment scan (models/assign.py) then updates these counts on device
+    as it places pods, which is what gives the batch the same semantics as
+    the reference's one-pod-at-a-time loop with assume() in between
+    (SURVEY.md §7 hard part #1).
+
+Everything here is host-side numpy; device arrays are built/updated by
+ops/backend.py from these buffers.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..api import meta
+from ..api.labels import EXISTS, GT, IN, LT, NOT_IN, DOES_NOT_EXIST, Selector
+from ..api.meta import Obj
+from ..scheduler.cache import Snapshot
+from ..scheduler.plugins.nodebasic import toleration_tolerates_taint
+from ..scheduler.types import NodeInfo, PodInfo
+
+logger = logging.getLogger(__name__)
+
+# resource slot layout: [cpu_milli, memory, ephemeral] + scalar slots
+CORE_R = 3
+
+# constraint kinds (c_kind)
+C_NONE = 0
+C_SPREAD_HARD = 1      # DoNotSchedule topology spread
+C_AFFINITY = 2         # required pod affinity term
+C_ANTI_AFFINITY = 3    # required pod anti-affinity term
+C_SPREAD_SCORE = 4     # ScheduleAnyway topology spread
+C_PREF_AFFINITY = 5    # preferred pod (anti-)affinity, weight signed
+
+UNSCHEDULABLE_TAINT = ("node.kubernetes.io/unschedulable", "", "NoSchedule")
+
+
+class VocabFullError(Exception):
+    pass
+
+
+class Vocab:
+    """String-ish -> dense id with a hard cap (static shapes for jit)."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.ids: dict = {}
+        self.items: list = []
+
+    def get(self, key, create: bool = True) -> int | None:
+        idx = self.ids.get(key)
+        if idx is None and create:
+            if len(self.items) >= self.cap:
+                raise VocabFullError(f"vocab cap {self.cap} exceeded by {key!r}")
+            idx = len(self.items)
+            self.ids[key] = idx
+            self.items.append(key)
+        return idx
+
+    def lookup(self, key) -> int | None:
+        return self.ids.get(key)
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+
+@dataclass
+class SelectorGroup:
+    """(topology_key, selector, namespaces) — the unit of count bookkeeping."""
+
+    topology_key: str
+    selector: Selector
+    namespaces: frozenset[str]
+
+    def key(self):
+        return (self.topology_key, self.selector, self.namespaces)
+
+    def matches_pod(self, pi: PodInfo) -> bool:
+        return (meta.namespace(pi.pod) in self.namespaces
+                and self.selector.matches(pi.labels))
+
+
+@dataclass
+class Caps:
+    """Static tensor capacities. All jitted shapes derive from these."""
+
+    n_cap: int = 1024          # node rows
+    l_cap: int = 512           # label (key,value) vocab
+    kl_cap: int = 128          # label key vocab
+    t_cap: int = 32            # taint vocab
+    pt_cap: int = 32           # host-port vocab
+    s_cap: int = 5             # scalar resource slots
+    sg_cap: int = 16           # selector groups (spread/affinity counts)
+    asg_cap: int = 16          # anti-affinity groups of existing pods
+    g_cap: int = 4             # any-of label groups per pod (node selector)
+    kg_cap: int = 2            # any-of key groups per pod (Exists)
+    c_cap: int = 6             # constraints per pod
+
+    @property
+    def r(self) -> int:
+        return CORE_R + self.s_cap
+
+
+class ClusterTensors:
+    """Host mirror of the snapshot as SoA numpy arrays, incrementally updated."""
+
+    def __init__(self, caps: Caps | None = None):
+        self.caps = caps or Caps()
+        c = self.caps
+        self.alloc = np.zeros((c.n_cap, c.r), np.float32)
+        self.used = np.zeros((c.n_cap, c.r), np.float32)
+        self.used_nz = np.zeros((c.n_cap, c.r), np.float32)
+        self.npods = np.zeros(c.n_cap, np.float32)
+        self.maxpods = np.zeros(c.n_cap, np.float32)
+        self.valid = np.zeros(c.n_cap, bool)
+        self.taint_mask = np.zeros((c.n_cap, c.t_cap), np.float32)
+        self.label_mask = np.zeros((c.n_cap, c.l_cap), np.float32)
+        self.key_mask = np.zeros((c.n_cap, c.kl_cap), np.float32)
+        self.port_mask = np.zeros((c.n_cap, c.pt_cap), np.float32)
+        # selector-group machinery
+        self.dom_sg = np.full((c.sg_cap, c.n_cap), -1, np.int32)
+        self.cnt_sg = np.zeros((c.sg_cap, c.n_cap), np.float32)
+        self.dom_asg = np.full((c.asg_cap, c.n_cap), -1, np.int32)
+        self.cnt_asg = np.zeros((c.asg_cap, c.n_cap), np.float32)
+
+        self.scalar_vocab = Vocab(c.s_cap)
+        self.label_vocab = Vocab(c.l_cap)
+        self.key_vocab = Vocab(c.kl_cap)
+        self.taint_vocab = Vocab(c.t_cap)   # entries: (key, value, effect)
+        self.port_vocab = Vocab(c.pt_cap)   # entries: (protocol, port)
+        self.domain_vocabs: dict[str, Vocab] = {}  # topo key -> value vocab
+
+        self.sgs: list[SelectorGroup] = []
+        self._sg_ids: dict = {}
+        self.asgs: list[SelectorGroup] = []
+        self._asg_ids: dict = {}
+
+        self.row_of: dict[str, int] = {}
+        self.node_infos: list[NodeInfo | None] = [None] * c.n_cap
+        self.gen = np.zeros(c.n_cap, np.int64)
+        self._free = list(range(c.n_cap - 1, -1, -1))
+        self.version = 0  # bumps on every host-array mutation
+
+    # -- vocab helpers ---------------------------------------------------
+
+    def domain_id(self, topo_key: str, value: str) -> int:
+        vocab = self.domain_vocabs.get(topo_key)
+        if vocab is None:
+            vocab = self.domain_vocabs[topo_key] = Vocab(self.caps.n_cap)
+        return vocab.get(value)
+
+    def register_sg(self, group: SelectorGroup) -> int | None:
+        """Returns sg index, backfilling counts for all live rows.
+        None if the registry is full (escape hatch)."""
+        idx = self._sg_ids.get(group.key())
+        if idx is not None:
+            return idx
+        if len(self.sgs) >= self.caps.sg_cap:
+            return None
+        idx = len(self.sgs)
+        self.sgs.append(group)
+        self._sg_ids[group.key()] = idx
+        for row, ni in enumerate(self.node_infos):
+            if ni is not None and self.valid[row]:
+                self._encode_sg_row(idx, row, ni)
+        self.version += 1
+        return idx
+
+    def register_asg(self, group: SelectorGroup) -> int | None:
+        idx = self._asg_ids.get(group.key())
+        if idx is not None:
+            return idx
+        if len(self.asgs) >= self.caps.asg_cap:
+            return None
+        idx = len(self.asgs)
+        self.asgs.append(group)
+        self._asg_ids[group.key()] = idx
+        for row, ni in enumerate(self.node_infos):
+            if ni is not None and self.valid[row]:
+                self._encode_asg_row(idx, row, ni)
+        self.version += 1
+        return idx
+
+    # -- node encoding ---------------------------------------------------
+
+    def update_from_snapshot(self, snapshot: Snapshot) -> bool:
+        """Incremental refresh; returns True if anything changed."""
+        changed = False
+        live = set()
+        for ni in snapshot.node_info_list:
+            live.add(ni.name)
+            row = self.row_of.get(ni.name)
+            if row is None:
+                if not self._free:
+                    raise VocabFullError(
+                        f"node capacity {self.caps.n_cap} exceeded")
+                row = self._free.pop()
+                self.row_of[ni.name] = row
+                self.gen[row] = -1
+            if self.gen[row] != ni.generation:
+                self._encode_node(row, ni)
+                self.gen[row] = ni.generation
+                changed = True
+        for name in list(self.row_of):
+            if name not in live:
+                row = self.row_of.pop(name)
+                self.valid[row] = False
+                self.node_infos[row] = None
+                self._free.append(row)
+                changed = True
+        if changed:
+            self.version += 1
+        return changed
+
+    def _encode_resource(self, out: np.ndarray, res) -> None:
+        out[0] = res.milli_cpu
+        out[1] = res.memory
+        out[2] = res.ephemeral_storage
+        out[CORE_R:] = 0.0
+        for name, v in res.scalar.items():
+            try:
+                out[CORE_R + self.scalar_vocab.get(name)] = v
+            except VocabFullError:
+                raise
+
+    def _encode_node(self, row: int, ni: NodeInfo) -> None:
+        c = self.caps
+        node = ni.node
+        self.node_infos[row] = ni
+        self.valid[row] = True
+        self._encode_resource(self.alloc[row], ni.allocatable)
+        self._encode_resource(self.used[row], ni.requested)
+        self._encode_resource(self.used_nz[row], ni.non_zero_requested)
+        self.npods[row] = len(ni.pods)
+        self.maxpods[row] = ni.allocatable.allowed_pod_number
+
+        # taints (+ unschedulable as a synthetic NoSchedule taint)
+        self.taint_mask[row] = 0.0
+        taints = list((node.get("spec") or {}).get("taints") or ())
+        if (node.get("spec") or {}).get("unschedulable"):
+            taints.append({"key": UNSCHEDULABLE_TAINT[0],
+                           "value": UNSCHEDULABLE_TAINT[1],
+                           "effect": UNSCHEDULABLE_TAINT[2]})
+        for t in taints:
+            tid = self.taint_vocab.get(
+                (t.get("key", ""), t.get("value", ""), t.get("effect", "")))
+            self.taint_mask[row, tid] = 1.0
+
+        # labels
+        self.label_mask[row] = 0.0
+        self.key_mask[row] = 0.0
+        labels = meta.labels(node)
+        for k, v in labels.items():
+            self.label_mask[row, self.label_vocab.get((k, v))] = 1.0
+            self.key_mask[row, self.key_vocab.get(k)] = 1.0
+        # metadata.name as a pseudo-label for matchFields support
+        self.label_mask[row, self.label_vocab.get(("metadata.name", ni.name))] = 1.0
+
+        # host ports in use
+        self.port_mask[row] = 0.0
+        for proto, _ip, port in ni.used_ports:
+            self.port_mask[row, self.port_vocab.get((proto, port))] = 1.0
+
+        # selector groups
+        for sg_idx in range(len(self.sgs)):
+            self._encode_sg_row(sg_idx, row, ni)
+        for asg_idx in range(len(self.asgs)):
+            self._encode_asg_row(asg_idx, row, ni)
+
+    def _encode_sg_row(self, sg_idx: int, row: int, ni: NodeInfo) -> None:
+        sg = self.sgs[sg_idx]
+        labels = meta.labels(ni.node) if ni.node else {}
+        val = labels.get(sg.topology_key)
+        self.dom_sg[sg_idx, row] = (self.domain_id(sg.topology_key, val)
+                                    if val is not None else -1)
+        self.cnt_sg[sg_idx, row] = sum(
+            1 for pi in ni.pods
+            if not meta.deletion_timestamp(pi.pod) and sg.matches_pod(pi))
+
+    def _encode_asg_row(self, asg_idx: int, row: int, ni: NodeInfo) -> None:
+        asg = self.asgs[asg_idx]
+        labels = meta.labels(ni.node) if ni.node else {}
+        val = labels.get(asg.topology_key)
+        self.dom_asg[asg_idx, row] = (self.domain_id(asg.topology_key, val)
+                                      if val is not None else -1)
+        # count pods on this node carrying an anti-affinity term == this group
+        n = 0
+        for pi in ni.pods_with_required_anti_affinity:
+            for term in pi.required_anti_affinity_terms:
+                if (term.topology_key == asg.topology_key
+                        and term.selector == asg.selector
+                        and term.namespaces == asg.namespaces):
+                    n += 1
+        self.cnt_asg[asg_idx, row] = n
+
+    # -- per-batch domain base counts ------------------------------------
+
+    def domain_base_counts(self) -> tuple[np.ndarray, np.ndarray]:
+        """cd0_sg[SG, n_cap], cd0_asg[ASG, n_cap]: per-domain counts
+        (domain ids index into the n_cap-sized axis; counts of matching pods
+        aggregated from per-node counts via bincount)."""
+        c = self.caps
+        cd_sg = np.zeros((c.sg_cap, c.n_cap), np.float32)
+        for i in range(len(self.sgs)):
+            m = self.valid & (self.dom_sg[i] >= 0)
+            if m.any():
+                bc = np.bincount(self.dom_sg[i][m], weights=self.cnt_sg[i][m],
+                                 minlength=c.n_cap)
+                cd_sg[i] = bc[:c.n_cap]
+        cd_asg = np.zeros((c.asg_cap, c.n_cap), np.float32)
+        for i in range(len(self.asgs)):
+            m = self.valid & (self.dom_asg[i] >= 0)
+            if m.any():
+                bc = np.bincount(self.dom_asg[i][m], weights=self.cnt_asg[i][m],
+                                 minlength=c.n_cap)
+            else:
+                bc = np.zeros(c.n_cap, np.float32)
+            cd_asg[i] = bc[:c.n_cap]
+        return cd_sg, cd_asg
+
+    def node_name(self, row: int) -> str | None:
+        ni = self.node_infos[row]
+        return ni.name if ni is not None else None
+
+
+@dataclass
+class PodBatch:
+    """Encoded pod-side tensors for one batch (P = p_cap, padded)."""
+
+    p_cap: int
+    req: np.ndarray            # f32[P, R]
+    req_nz: np.ndarray         # f32[P, R]  (non-zero defaults, for scoring)
+    p_valid: np.ndarray        # bool[P]
+    untol_hard: np.ndarray     # f32[P, T]  1 = taint t blocks this pod
+    untol_prefer: np.ndarray   # f32[P, T]  1 = PreferNoSchedule taint not tolerated
+    sel_any: np.ndarray        # f32[P, G, L] any-of label groups
+    sel_any_active: np.ndarray  # f32[P, G]
+    sel_forb: np.ndarray       # f32[P, L]  forbidden label ids (NotIn)
+    key_any: np.ndarray        # f32[P, KG, KL] Exists groups
+    key_any_active: np.ndarray  # f32[P, KG]
+    key_forb: np.ndarray       # f32[P, KL] DoesNotExist
+    ports: np.ndarray          # f32[P, PT] requested host ports
+    node_row: np.ndarray       # i32[P] pinned node row (spec.nodeName) or -1
+    c_kind: np.ndarray         # i32[P, C]
+    c_sg: np.ndarray           # i32[P, C] selector-group index
+    c_maxskew: np.ndarray      # f32[P, C]
+    c_selfmatch: np.ndarray    # f32[P, C]
+    c_weight: np.ndarray       # f32[P, C] (preferred terms; signed)
+    inc_sg: np.ndarray         # f32[P, SG]  assigning pod p bumps sg counts
+    inc_asg: np.ndarray        # f32[P, ASG] pod carries this anti group
+    match_asg: np.ndarray      # f32[P, ASG] pod's labels match this anti group
+    escape: list[int] = field(default_factory=list)  # batch positions for oracle path
+
+
+class BatchEncoder:
+    """Encodes a list of PodInfos against a ClusterTensors instance."""
+
+    def __init__(self, tensors: ClusterTensors, p_cap: int):
+        self.t = tensors
+        self.p_cap = p_cap
+
+    def encode(self, pod_infos: list[PodInfo]) -> PodBatch:
+        t, c = self.t, self.t.caps
+        P = self.p_cap
+        b = PodBatch(
+            p_cap=P,
+            req=np.zeros((P, c.r), np.float32),
+            req_nz=np.zeros((P, c.r), np.float32),
+            p_valid=np.zeros(P, bool),
+            untol_hard=np.zeros((P, c.t_cap), np.float32),
+            untol_prefer=np.zeros((P, c.t_cap), np.float32),
+            sel_any=np.zeros((P, c.g_cap, c.l_cap), np.float32),
+            sel_any_active=np.zeros((P, c.g_cap), np.float32),
+            sel_forb=np.zeros((P, c.l_cap), np.float32),
+            key_any=np.zeros((P, c.kg_cap, c.kl_cap), np.float32),
+            key_any_active=np.zeros((P, c.kg_cap), np.float32),
+            key_forb=np.zeros((P, c.kl_cap), np.float32),
+            ports=np.zeros((P, c.pt_cap), np.float32),
+            node_row=np.full(P, -1, np.int32),
+            c_kind=np.zeros((P, c.c_cap), np.int32),
+            c_sg=np.full((P, c.c_cap), -1, np.int32),
+            c_maxskew=np.zeros((P, c.c_cap), np.float32),
+            c_selfmatch=np.zeros((P, c.c_cap), np.float32),
+            c_weight=np.zeros((P, c.c_cap), np.float32),
+            inc_sg=np.zeros((P, c.sg_cap), np.float32),
+            inc_asg=np.zeros((P, c.asg_cap), np.float32),
+            match_asg=np.zeros((P, c.asg_cap), np.float32),
+        )
+        for i, pi in enumerate(pod_infos[:P]):
+            try:
+                ok = self._encode_pod(b, i, pi)
+            except VocabFullError:
+                ok = False
+            if ok:
+                b.p_valid[i] = True
+            else:
+                b.escape.append(i)
+        # cross-pod: inc/match rows vs ALL registered groups
+        for i, pi in enumerate(pod_infos[:P]):
+            if not b.p_valid[i]:
+                continue
+            for sg_idx, sg in enumerate(t.sgs):
+                if sg.matches_pod(pi):
+                    b.inc_sg[i, sg_idx] = 1.0
+            for asg_idx, asg in enumerate(t.asgs):
+                if asg.matches_pod(pi):
+                    b.match_asg[i, asg_idx] = 1.0
+                for term in pi.required_anti_affinity_terms:
+                    if (term.topology_key == asg.topology_key
+                            and term.selector == asg.selector
+                            and term.namespaces == asg.namespaces):
+                        b.inc_asg[i, asg_idx] += 1.0
+        return b
+
+    # returns False -> escape to oracle path
+    def _encode_pod(self, b: PodBatch, i: int, pi: PodInfo) -> bool:
+        t, c = self.t, self.t.caps
+        if pi.nominated_node_name:
+            return False  # preemption nominations go through the per-pod path
+        self.t._encode_resource(b.req[i], pi.request)
+        self.t._encode_resource(b.req_nz[i], pi.request_nonzero)
+
+        # taints: mark every vocab taint this pod does NOT tolerate
+        for tid, (key, value, effect) in enumerate(t.taint_vocab.items):
+            taint = {"key": key, "value": value, "effect": effect}
+            tolerated = any(toleration_tolerates_taint(tol, taint)
+                            for tol in pi.tolerations)
+            if not tolerated:
+                if effect in ("NoSchedule", "NoExecute"):
+                    b.untol_hard[i, tid] = 1.0
+                elif effect == "PreferNoSchedule":
+                    b.untol_prefer[i, tid] = 1.0
+
+        # spec.nodeName pin
+        want = (pi.pod.get("spec") or {}).get("nodeName")
+        if want:
+            row = t.row_of.get(want)
+            if row is None:
+                return False
+            b.node_row[i] = row
+
+        # node selector + required node affinity -> any-of groups / forbidden
+        groups: list[list[int]] = []
+        key_groups: list[list[int]] = []
+        for k, v in pi.node_selector.items():
+            lid = t.label_vocab.lookup((k, v))
+            if lid is None:
+                # no node has this label -> nothing can match; encode an
+                # impossible group (empty any-of)
+                groups.append([])
+            else:
+                groups.append([lid])
+        if pi.node_affinity_required:
+            enc = self._encode_affinity_terms(pi.node_affinity_required,
+                                              groups, key_groups, b, i)
+            if not enc:
+                return False
+        if len(groups) > c.g_cap or len(key_groups) > c.kg_cap:
+            return False
+        for g, ids in enumerate(groups):
+            b.sel_any_active[i, g] = 1.0
+            for lid in ids:
+                b.sel_any[i, g, lid] = 1.0
+        for g, ids in enumerate(key_groups):
+            b.key_any_active[i, g] = 1.0
+            for kid in ids:
+                b.key_any[i, g, kid] = 1.0
+        if pi.node_affinity_preferred:
+            return False  # node-affinity scoring: oracle path (rare)
+
+        # host ports
+        for proto, ip, port in pi.host_ports:
+            if ip not in ("0.0.0.0", "", None):
+                return False  # per-IP port semantics: oracle path
+            b.ports[i, t.port_vocab.get((proto, port))] = 1.0
+
+        # constraints
+        ci = 0
+
+        def add_constraint(kind, sg_idx, maxskew=0.0, selfmatch=0.0, weight=0.0):
+            nonlocal ci
+            if ci >= c.c_cap or sg_idx is None:
+                raise VocabFullError("constraint capacity")
+            b.c_kind[i, ci] = kind
+            b.c_sg[i, ci] = sg_idx
+            b.c_maxskew[i, ci] = maxskew
+            b.c_selfmatch[i, ci] = selfmatch
+            b.c_weight[i, ci] = weight
+            ci += 1
+
+        from ..api.labels import selector_from_dict
+        ns = meta.namespace(pi.pod)
+        for tsc in pi.topology_spread_constraints:
+            sel = selector_from_dict(tsc.get("labelSelector"))
+            sg = SelectorGroup(tsc["topologyKey"], sel, frozenset([ns]))
+            kind = (C_SPREAD_HARD
+                    if tsc.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"
+                    else C_SPREAD_SCORE)
+            add_constraint(kind, t.register_sg(sg),
+                           maxskew=tsc.get("maxSkew", 1),
+                           selfmatch=1.0 if sel.matches(pi.labels) else 0.0)
+        for term in pi.required_affinity_terms:
+            sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
+            add_constraint(C_AFFINITY, t.register_sg(sg),
+                           selfmatch=1.0 if sg.matches_pod(pi) else 0.0)
+        for term in pi.required_anti_affinity_terms:
+            sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
+            add_constraint(C_ANTI_AFFINITY, t.register_sg(sg))
+            if t.register_asg(sg) is None:
+                return False
+        for term in pi.preferred_affinity_terms:
+            sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
+            add_constraint(C_PREF_AFFINITY, t.register_sg(sg),
+                           weight=float(term.weight))
+        for term in pi.preferred_anti_affinity_terms:
+            sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
+            add_constraint(C_PREF_AFFINITY, t.register_sg(sg),
+                           weight=-float(term.weight))
+        return True
+
+    def _encode_affinity_terms(self, terms, groups, key_groups, b, i) -> bool:
+        """Required node-affinity terms (OR over terms, AND within).
+
+        Encodable cases:
+          - single term: each requirement becomes its own group
+          - multiple terms, each a single positive requirement: union group
+        """
+        t = self.t
+        if len(terms) == 1:
+            lab, fields = terms[0]
+            for req in (*lab.requirements, *fields.requirements):
+                if req.operator == IN:
+                    ids = [t.label_vocab.lookup((req.key, v)) for v in req.values]
+                    groups.append([x for x in ids if x is not None])
+                elif req.operator == EXISTS:
+                    kid = t.key_vocab.lookup(req.key)
+                    key_groups.append([kid] if kid is not None else [])
+                elif req.operator == NOT_IN:
+                    for v in req.values:
+                        lid = t.label_vocab.lookup((req.key, v))
+                        if lid is not None:
+                            b.sel_forb[i, lid] = 1.0
+                elif req.operator == DOES_NOT_EXIST:
+                    kid = t.key_vocab.lookup(req.key)
+                    if kid is not None:
+                        b.key_forb[i, kid] = 1.0
+                else:  # Gt/Lt
+                    return False
+            return True
+        union: list[int] = []
+        for lab, fields in terms:
+            reqs = (*lab.requirements, *fields.requirements)
+            if len(reqs) != 1 or reqs[0].operator != IN:
+                return False
+            for v in reqs[0].values:
+                lid = t.label_vocab.lookup((reqs[0].key, v))
+                if lid is not None:
+                    union.append(lid)
+        groups.append(union)
+        return True

@@ -491,6 +491,10 @@ class Scheduler:
         results = backend.assign([q.pod_info for q in live], self._snapshot)
         for qpi, (node_idx, s) in zip(live, results):
             if node_idx is None:
+                if s is not None and s.is_skip():
+                    # constraint not tensor-encodable: per-pod oracle path
+                    self.schedule_one(qpi)
+                    continue
                 st = s or Status(UNSCHEDULABLE, "no feasible node (batch)")
                 self._handle_failure(fw, qpi, st, cycle,
                                      {st.plugin} if st.plugin else set(), start)

@@ -1,0 +1,305 @@
+"""TPU batch path tests: tensorization, kernel semantics, and parity with
+the pure-python oracle plugins (SURVEY.md §7 step 2: "Property-test each
+against a scalar Python oracle").
+
+Runs on CPU with 8 virtual devices (tests/conftest.py).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.ops.backend import TPUBatchBackend
+from kubernetes_tpu.ops.flatten import Caps
+from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler.types import PodInfo
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def snapshot_from(nodes, bound_pods=()):
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in bound_pods:
+        cache.add_pod(p)
+    return cache.update_snapshot(Snapshot())
+
+
+def small_caps(**kw):
+    defaults = dict(n_cap=16, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                    s_cap=2, sg_cap=8, asg_cap=8)
+    defaults.update(kw)
+    return Caps(**defaults)
+
+
+def run_assign(backend, pods, snapshot):
+    infos = [PodInfo(p) for p in pods]
+    results = backend.assign(infos, snapshot)
+    return [backend.node_name(r[0]) if r[0] is not None else (r[1].code if r[1] else None)
+            for r in results]
+
+
+class TestResourceFit:
+    def test_basic_fit_and_overflow(self):
+        nodes = [make_node("n1").capacity(cpu="1", mem="2Gi").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        pods = [make_pod(f"p{i}").req(cpu="600m").build() for i in range(3)]
+        out = run_assign(backend, pods, snap)
+        # only one 600m pod fits on a 1-cpu node; intra-batch running sum
+        # must reject the second and third
+        assert out[0] == "n1"
+        assert out[1] != "n1" and out[2] != "n1"
+
+    def test_spreads_across_nodes(self):
+        nodes = [make_node(f"n{i}").capacity(cpu="1", mem="2Gi").build()
+                 for i in range(4)]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        pods = [make_pod(f"p{i}").req(cpu="600m").build() for i in range(4)]
+        out = run_assign(backend, pods, snap)
+        assert sorted(out) == ["n0", "n1", "n2", "n3"]  # least-allocated spread
+
+    def test_respects_existing_usage(self):
+        busy = make_pod("e").req(cpu="900m").node("n1").build()
+        nodes = [make_node("n1").capacity(cpu="1").build(),
+                 make_node("n2").capacity(cpu="1").build()]
+        snap = snapshot_from(nodes, [busy])
+        backend = TPUBatchBackend(small_caps(), batch_size=2)
+        out = run_assign(backend, [make_pod("p").req(cpu="500m").build()], snap)
+        assert out[0] == "n2"
+
+    def test_pod_count_limit(self):
+        nodes = [make_node("n1").capacity(cpu="8", mem="8Gi", pods=2).build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        pods = [make_pod(f"p{i}").req(cpu="100m").build() for i in range(3)]
+        out = run_assign(backend, pods, snap)
+        assert out[0] == "n1" and out[1] == "n1"
+        assert out[2] != "n1"
+
+    def test_scalar_resources(self):
+        nodes = [make_node("n1").capacity(cpu="8", **{"google.com/tpu": "4"}).build(),
+                 make_node("n2").capacity(cpu="8").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=2)
+        pods = [make_pod("p").req(cpu="1", **{"google.com/tpu": "4"}).build(),
+                make_pod("q").req(cpu="1", **{"google.com/tpu": "4"}).build()]
+        out = run_assign(backend, pods, snap)
+        assert out[0] == "n1"
+        assert out[1] != "n1" and out[1] != "n2"  # tpu exhausted by first pod
+
+
+class TestSelectorsAndTaints:
+    def test_node_selector(self):
+        nodes = [make_node("n1").labels(disk="hdd").build(),
+                 make_node("n2").labels(disk="ssd").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=2)
+        out = run_assign(backend,
+                         [make_pod("p").node_selector(disk="ssd").build()], snap)
+        assert out[0] == "n2"
+
+    def test_node_affinity_in(self):
+        nodes = [make_node("n1").labels(zone="a").build(),
+                 make_node("n2").labels(zone="b").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=2)
+        out = run_assign(
+            backend, [make_pod("p").node_affinity_in("zone", ["b", "c"]).build()],
+            snap)
+        assert out[0] == "n2"
+
+    def test_taints(self):
+        nodes = [make_node("n1").taint("dedicated", "db").build(),
+                 make_node("n2").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=2)
+        out = run_assign(backend, [make_pod("p").build()], snap)
+        assert out[0] == "n2"
+        out = run_assign(backend, [
+            make_pod("q").toleration("dedicated", "db", "NoSchedule").build()], snap)
+        assert out[0] in ("n1", "n2")
+
+    def test_unschedulable_node(self):
+        nodes = [make_node("n1").unschedulable().build(),
+                 make_node("n2").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=1)
+        out = run_assign(backend, [make_pod("p").build()], snap)
+        assert out[0] == "n2"
+
+    def test_node_name_pin(self):
+        nodes = [make_node("n1").build(), make_node("n2").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=1)
+        out = run_assign(backend, [make_pod("p").node("n2").build()], snap)
+        assert out[0] == "n2"
+
+    def test_host_port_conflict_intra_batch(self):
+        nodes = [make_node("n1").build(), make_node("n2").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=3)
+        pods = [make_pod(f"p{i}").host_port(8080).build() for i in range(3)]
+        out = run_assign(backend, pods, snap)
+        assert {out[0], out[1]} == {"n1", "n2"}
+        assert out[2] not in ("n1", "n2")  # both ports taken within the batch
+
+
+class TestTopologyAndAffinity:
+    def test_spread_hard_intra_batch(self):
+        nodes = [make_node("a1").zone("a").build(),
+                 make_node("b1").zone("b").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        pods = [make_pod(f"p{i}").labels(app="web").topology_spread(
+            "topology.kubernetes.io/zone", max_skew=1,
+            match_labels={"app": "web"}).build() for i in range(4)]
+        out = run_assign(backend, pods, snap)
+        zones = sorted("a" if n.startswith("a") else "b" for n in out)
+        assert zones == ["a", "a", "b", "b"]  # max skew 1 forces 2/2
+
+    def test_anti_affinity_intra_batch(self):
+        nodes = [make_node(f"n{i}").labels(
+            **{"kubernetes.io/hostname": f"n{i}"}).build() for i in range(3)]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=3)
+        pods = [make_pod(f"p{i}").labels(app="web").pod_affinity(
+            "kubernetes.io/hostname", {"app": "web"}, anti=True).build()
+            for i in range(3)]
+        out = run_assign(backend, pods, snap)
+        assert len(set(out)) == 3  # all distinct hosts
+
+    def test_anti_affinity_vs_existing(self):
+        existing = (make_pod("e").labels(app="web").node("n1").build())
+        nodes = [make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build(),
+                 make_node("n2").labels(**{"kubernetes.io/hostname": "n2"}).build()]
+        snap = snapshot_from(nodes, [existing])
+        backend = TPUBatchBackend(small_caps(), batch_size=1)
+        pods = [make_pod("p").labels(app="web").pod_affinity(
+            "kubernetes.io/hostname", {"app": "web"}, anti=True).build()]
+        out = run_assign(backend, pods, snap)
+        assert out[0] == "n2"
+
+    def test_existing_pod_anti_affinity_blocks_incoming(self):
+        # existing pod has anti-affinity against app=web; incoming app=web pod
+        # must avoid its node
+        existing = (make_pod("e").labels(app="web").node("n1")
+                    .pod_affinity("kubernetes.io/hostname", {"app": "web"},
+                                  anti=True).build())
+        nodes = [make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build(),
+                 make_node("n2").labels(**{"kubernetes.io/hostname": "n2"}).build()]
+        snap = snapshot_from(nodes, [existing])
+        backend = TPUBatchBackend(small_caps(), batch_size=1)
+        out = run_assign(backend,
+                         [make_pod("p").labels(app="web").build()], snap)
+        assert out[0] == "n2"
+
+    def test_required_affinity_colocates(self):
+        existing = make_pod("e").labels(app="db").node("n1").build()
+        nodes = [make_node("n1").zone("a").build(),
+                 make_node("n2").zone("b").build()]
+        snap = snapshot_from(nodes, [existing])
+        backend = TPUBatchBackend(small_caps(), batch_size=1)
+        pods = [make_pod("p").pod_affinity(
+            "topology.kubernetes.io/zone", {"app": "db"}).build()]
+        out = run_assign(backend, pods, snap)
+        assert out[0] == "n1"
+
+    def test_affinity_bootstrap(self):
+        nodes = [make_node("n1").zone("a").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=1)
+        pods = [make_pod("p").labels(app="web").pod_affinity(
+            "topology.kubernetes.io/zone", {"app": "web"}).build()]
+        out = run_assign(backend, pods, snap)
+        assert out[0] == "n1"
+
+    def test_affinity_chain_within_batch(self):
+        # second batch pod has affinity to the first batch pod's labels
+        nodes = [make_node("n1").zone("a").build(),
+                 make_node("n2").zone("b").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=2)
+        pods = [make_pod("lead").labels(app="db").build(),
+                make_pod("follow").pod_affinity(
+                    "topology.kubernetes.io/zone", {"app": "db"}).build()]
+        out = run_assign(backend, pods, snap)
+        lead_zone = "a" if out[0] == "n1" else "b"
+        follow_zone = "a" if out[1] == "n1" else "b"
+        assert lead_zone == follow_zone
+
+    def test_preferred_affinity_scores(self):
+        existing = make_pod("e").labels(app="cache").node("n1").build()
+        nodes = [make_node("n1").zone("a").build(),
+                 make_node("n2").zone("b").build()]
+        snap = snapshot_from(nodes, [existing])
+        backend = TPUBatchBackend(small_caps(), batch_size=1,
+                                  weights={"affinity": 1000.0})
+        pods = [make_pod("p").pod_affinity(
+            "topology.kubernetes.io/zone", {"app": "cache"},
+            preferred_weight=10).build()]
+        out = run_assign(backend, pods, snap)
+        assert out[0] == "n1"
+
+
+class TestEscapeHatch:
+    def test_gt_operator_escapes(self):
+        nodes = [make_node("n1").build()]
+        snap = snapshot_from(nodes)
+        backend = TPUBatchBackend(small_caps(), batch_size=1)
+        pod = make_pod("p").build()
+        pod["spec"]["affinity"] = {"nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [
+                    {"key": "cpu-count", "operator": "Gt", "values": ["4"]}]}]}}}
+        infos = [PodInfo(pod)]
+        results = backend.assign(infos, snap)
+        assert results[0][0] is None
+        assert results[0][1].is_skip()
+
+
+class TestOracleParity:
+    """Randomized parity: batch path placements must be feasible per the
+    oracle plugins, and unschedulable verdicts must agree."""
+
+    def test_random_resource_workloads(self):
+        rng = random.Random(42)
+        from kubernetes_tpu.scheduler.framework import CycleState
+        from kubernetes_tpu.scheduler.plugins.noderesources import (
+            insufficient_resources,
+        )
+        for trial in range(5):
+            nodes = [make_node(f"n{i}").capacity(
+                cpu=f"{rng.randint(1, 8)}", mem=f"{rng.randint(2, 16)}Gi").build()
+                for i in range(8)]
+            snap = snapshot_from(nodes)
+            backend = TPUBatchBackend(small_caps(), batch_size=16)
+            pods = [make_pod(f"t{trial}p{i}").req(
+                cpu=f"{rng.randint(100, 2000)}m",
+                mem=f"{rng.randint(128, 4096)}Mi").build() for i in range(16)]
+            infos = [PodInfo(p) for p in pods]
+            results = backend.assign(infos, snap)
+
+            # replay placements through the oracle, in order
+            cache = Cache()
+            for n in nodes:
+                cache.add_node(n)
+            snap2 = cache.update_snapshot(Snapshot())
+            for pi, (row, status) in zip(infos, results):
+                if row is not None:
+                    name = backend.node_name(row)
+                    ni = snap2.get(name)
+                    assert insufficient_resources(pi, ni) == [], \
+                        f"oracle rejects batch placement of {pi.key} on {name}"
+                    bound = dict(pi.pod)
+                    bound["spec"] = dict(pi.pod["spec"], nodeName=name)
+                    cache.add_pod(bound)
+                    snap2 = cache.update_snapshot(snap2)
+                else:
+                    # batch says unschedulable: oracle must agree on every node
+                    assert status is not None
+                    for ni in snap2.list():
+                        assert insufficient_resources(pi, ni), \
+                            f"oracle would place {pi.key} on {ni.name} but batch refused"
