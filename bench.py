@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Benchmark entry point (driver contract: ONE JSON line on stdout).
+
+Runs the scheduler_perf SchedulingBasic workload (reference:
+test/integration/scheduler_perf, 5000 nodes / 5000 pods scale from
+config/performance-config.yaml) through the FULL pipeline — store -> watch
+-> informers -> queue -> TPU batch Filter/Score/Assign -> assume -> bind —
+and reports end-to-end scheduling throughput.
+
+Baseline: the reference tree publishes no absolute numbers (BASELINE.md);
+upstream Kubernetes scheduler_perf results for the 5k-node SchedulingBasic
+tier sit around ~300 pods/s steady-state on a large single box (public
+perf-dash data; the in-tree comment scheduler_perf_test.go:956 notes a
+~10 pods/s worst case).  vs_baseline uses 300 pods/s as the reference
+point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_PODS_PER_SEC = 300.0
+
+N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
+N_PODS = int(os.environ.get("BENCH_PODS", "5000"))
+BATCH = int(os.environ.get("BENCH_BATCH", "512"))
+
+
+def main() -> None:
+    from kubernetes_tpu.ops.flatten import Caps
+    from kubernetes_tpu.perf import load_workloads, run_named_workload
+
+    import copy
+    cfg = copy.deepcopy(load_workloads()["SchedulingBasicLarge"])
+    for op in cfg["workloadTemplate"]:
+        if op["opcode"] == "createNodes":
+            op["count"] = N_NODES
+        elif op["opcode"] == "createPods":
+            op["count"] = N_PODS
+        elif op["opcode"] == "barrier":
+            op["timeout"] = 900.0
+
+    caps = Caps(n_cap=max(1024, 1 << (N_NODES + 512).bit_length()),
+                l_cap=256, kl_cap=64, t_cap=16, pt_cap=16, s_cap=3,
+                sg_cap=16, asg_cap=16)
+    t0 = time.monotonic()
+    summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
+                                        batch_size=BATCH)
+    wall = time.monotonic() - t0
+    if not stats.get("barrier_ok", False):
+        print(json.dumps({"metric": "scheduler_perf_throughput",
+                          "value": 0.0, "unit": "pods/s",
+                          "vs_baseline": 0.0,
+                          "error": "pods left unscheduled",
+                          "detail": summary.to_dict()}))
+        sys.exit(1)
+    value = summary.average
+    print(json.dumps({
+        "metric": "scheduler_perf_throughput",
+        "value": round(value, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(value / BASELINE_PODS_PER_SEC, 2),
+        "detail": {"nodes": N_NODES, "pods": N_PODS, "batch": BATCH,
+                   "wall_s": round(wall, 1), **summary.to_dict()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

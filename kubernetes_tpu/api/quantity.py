@@ -15,6 +15,7 @@ so the TPU flattener only ever sees int64/float32 arrays.
 
 from __future__ import annotations
 
+import functools
 import re
 
 _BIN = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60}
@@ -30,9 +31,16 @@ _QTY_RE = re.compile(
 
 
 def parse_quantity(s: str | int | float) -> float:
-    """Parse a Kubernetes quantity string into a float of base units."""
+    """Parse a Kubernetes quantity string into a float of base units.
+    Cached: workloads reuse a handful of distinct quantity strings, and
+    this sits on the PodInfo hot path."""
     if isinstance(s, (int, float)):
         return float(s)
+    return _parse_quantity_str(s)
+
+
+@functools.lru_cache(maxsize=4096)
+def _parse_quantity_str(s: str) -> float:
     s = s.strip()
     m = _QTY_RE.match(s)
     if not m:

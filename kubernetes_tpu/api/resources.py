@@ -13,6 +13,7 @@ Reference semantics:
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -67,6 +68,23 @@ class Resource:
 
 
 def _parse_resource_list(rl: dict[str, Any] | None) -> Resource:
+    """Cached on the (sorted items) tuple: benchmark/real workloads repeat a
+    small set of request shapes, and this is the PodInfo hot path."""
+    if not rl:
+        return Resource()
+    try:
+        key = tuple(sorted(rl.items()))
+    except TypeError:
+        return _parse_resource_list_uncached(rl)
+    return _parse_resource_list_cached(key).clone()
+
+
+@functools.lru_cache(maxsize=4096)
+def _parse_resource_list_cached(items: tuple) -> Resource:
+    return _parse_resource_list_uncached(dict(items))
+
+
+def _parse_resource_list_uncached(rl: dict[str, Any]) -> Resource:
     r = Resource()
     for k, v in (rl or {}).items():
         if k == CPU:

@@ -106,7 +106,10 @@ class Client:
 
     def create_event(self, regarding: Obj, reason: str, message: str,
                      type_: str = "Normal") -> None:
-        """Fire-and-forget Event (reference: events broadcaster -> apiserver)."""
+        """Fire-and-forget Event via a background broadcaster thread
+        (reference: record.EventBroadcaster buffers and writes async; events
+        must never sit on the scheduling/binding critical path).  Overflow
+        drops events, like the broadcaster's bounded queue."""
         import time as _t
         ev = meta.new_object("Event", f"{meta.name(regarding)}.{int(_t.time()*1e6):x}",
                              meta.namespace(regarding) or "default")
@@ -116,10 +119,33 @@ class Client:
                                "namespace": meta.namespace(regarding),
                                "name": meta.name(regarding), "uid": meta.uid(regarding)},
         })
+        self._event_sink(ev)
+
+    _event_init_lock = __import__("threading").Lock()
+
+    def _event_sink(self, ev: Obj) -> None:
+        import queue as _q
+        import threading
+        if getattr(self, "_event_queue", None) is None:
+            with Client._event_init_lock:
+                if getattr(self, "_event_queue", None) is None:
+                    q: "_q.Queue" = _q.Queue(maxsize=10_000)
+
+                    def drain() -> None:
+                        while True:
+                            item = q.get()
+                            try:
+                                self.create(EVENTS, item)
+                            except kv.StoreError:
+                                pass
+
+                    threading.Thread(target=drain, name="event-broadcaster",
+                                     daemon=True).start()
+                    self._event_queue = q
         try:
-            self.create(EVENTS, ev)
-        except kv.StoreError:
-            pass
+            self._event_queue.put_nowait(ev)
+        except _q.Full:
+            pass  # queue full: drop (bounded broadcaster semantics)
 
 
 class LocalClient(Client):

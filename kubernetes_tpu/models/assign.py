@@ -24,6 +24,14 @@ Structure:
       LeastAllocated + BalancedAllocation + spread/affinity scores
       masked argmax -> placement -> state update
 
+Multi-chip: the node axis shards across a jax Mesh (parallel/mesh.py wraps
+this in shard_map).  Every cross-node reduction goes through the _Comm
+layer: max/min/sum become pmax/pmin/psum over ICI, the argmax becomes a
+per-shard top-1 + all_gather + global pick, and the domain-count updates are
+replicated via a psum of the winning shard's domain ids.  That is the
+"shard the long axis, per-core top-k, global reduce" recipe from SURVEY.md
+§5 (long-context analog).
+
 All shapes are static (derived from flatten.Caps), so one compilation
 serves every batch; arrays are padded and masked.
 """
@@ -36,6 +44,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..ops.flatten import (
     C_AFFINITY, C_ANTI_AFFINITY, C_NONE, C_PREF_AFFINITY, C_SPREAD_HARD,
@@ -45,7 +54,57 @@ from ..ops.flatten import (
 NEG = -1e9
 
 
-def _static_mask_and_score(node: dict, pod: dict) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+class _Comm:
+    """Reduction layer: local ops when axis_name is None, ICI collectives
+    inside shard_map otherwise."""
+
+    def __init__(self, axis_name: str | None):
+        self.axis = axis_name
+
+    def max(self, x):
+        m = jnp.max(x)
+        return lax.pmax(m, self.axis) if self.axis else m
+
+    def min(self, x):
+        m = jnp.min(x)
+        return lax.pmin(m, self.axis) if self.axis else m
+
+    def sum(self, x):
+        s = jnp.sum(x)
+        return lax.psum(s, self.axis) if self.axis else s
+
+    def rowmax(self, x, mask, fill):
+        """max over the node axis (last) of a [P,N] array under mask."""
+        m = jnp.max(jnp.where(mask, x, fill), axis=-1, keepdims=True)
+        return lax.pmax(m, self.axis) if self.axis else m
+
+    def argmax(self, score, n_loc: int):
+        """Global argmax over the (possibly sharded) node axis.
+        Returns (j_global, best_score)."""
+        local_best = jnp.max(score)
+        local_idx = jnp.argmax(score)
+        if not self.axis:
+            return local_idx, local_best
+        best_all = lax.all_gather(local_best, self.axis)   # [S]
+        idx_all = lax.all_gather(local_idx, self.axis)     # [S]
+        shard = jnp.argmax(best_all)
+        return shard * n_loc + idx_all[shard], best_all[shard]
+
+    def my_offset(self, n_loc: int):
+        if not self.axis:
+            return 0
+        return lax.axis_index(self.axis) * n_loc
+
+    def replicate_from_owner(self, value, owner_mask, sentinel_shift=1):
+        """All shards learn `value` (int array) held by the shard where
+        owner_mask is True; value entries may be -1 (encoded via +shift)."""
+        if not self.axis:
+            return value
+        enc = (value + sentinel_shift) * owner_mask.astype(value.dtype)
+        return lax.psum(enc, self.axis) - sentinel_shift
+
+
+def _static_mask_and_score(node: dict, pod: dict, comm: _Comm, offset):
     """Vectorized P x N feasibility independent of in-batch placements.
 
     Returns (sel_mask, static_mask, static_score):
@@ -72,14 +131,14 @@ def _static_mask_and_score(node: dict, pod: dict) -> tuple[jnp.ndarray, jnp.ndar
 
     # taints (TaintToleration + NodeUnschedulable-as-taint)
     hard = (pod["untol_hard"] @ node["taint_mask"].T) == 0
-    # spec.nodeName pin
-    n_idx = jnp.arange(label.shape[0])[None, :]
+    # spec.nodeName pin (node_row is a GLOBAL row index)
+    n_idx = offset + jnp.arange(label.shape[0])[None, :]
     pin = (pod["node_row"][:, None] < 0) | (n_idx == pod["node_row"][:, None])
 
     static_mask = sel_mask & hard & pin
 
     prefer_cnt = pod["untol_prefer"] @ node["taint_mask"].T   # [P,N]
-    mx = jnp.max(jnp.where(static_mask, prefer_cnt, 0.0), axis=1, keepdims=True)
+    mx = comm.rowmax(prefer_cnt, static_mask, 0.0)
     static_score = jnp.where(mx > 0, (mx - prefer_cnt) * 100.0 / jnp.maximum(mx, 1.0), 100.0)
     return sel_mask, static_mask, static_score
 
@@ -104,23 +163,24 @@ def _fit_scores(req_nz: jnp.ndarray, alloc: jnp.ndarray, used_nz: jnp.ndarray
     return least, balanced
 
 
-def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None):
-    """Compile the batched assignment for the given static capacities.
-
-    Returns fn(node_arrays, pod_arrays) -> (assignments i32[P], used, npods)
-    where assignments[p] is the node row or -1.
-    """
+def make_assign_core(caps: Caps, weights: dict[str, float] | None = None,
+                     axis_name: str | None = None):
+    """The assignment program body.  Call under jit (single device) or
+    inside shard_map with the node axis sharded (parallel/mesh.py)."""
     w = {"fit": 1.0, "balanced": 1.0, "spread": 2.0, "affinity": 1.0,
          "taint": 1.0, **(weights or {})}
+    comm = _Comm(axis_name)
 
-    @jax.jit
     def assign(node: dict, pod: dict) -> dict[str, jnp.ndarray]:
-        sel_mask, static_mask, static_score = _static_mask_and_score(node, pod)
+        n_loc = node["alloc"].shape[0]
+        offset = comm.my_offset(n_loc)
+        sel_mask, static_mask, static_score = _static_mask_and_score(
+            node, pod, comm, offset)
 
         alloc = node["alloc"]
-        dom_sg = node["dom_sg"]          # [SG,N]
+        dom_sg = node["dom_sg"]          # [SG,N]  (N = local shard)
         dom_asg = node["dom_asg"]        # [ASG,N]
-        n_iota = jnp.arange(alloc.shape[0])
+        n_iota = jnp.arange(n_loc)
 
         def step(carry, xs):
             used, used_nz, npods, ports, cd_sg, cd_asg = carry
@@ -140,7 +200,6 @@ def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None):
             blocked = (match_asg[:, None] * (acnt > 0)).sum(0) > 0
             mask &= ~blocked
 
-            score = w["fit"] * 0.0
             least, balanced = _fit_scores(req_nz, alloc, used_nz)
             score = w["fit"] * least + w["balanced"] * balanced
             score = score + w["taint"] * p_static_score
@@ -150,16 +209,16 @@ def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None):
                 kind = c_kind[c]
                 sg = jnp.clip(c_sg[c], 0)
                 dom = dom_sg[sg]                               # [N]
-                cnt_row = cd_sg[sg]                            # [D]
+                cnt_row = cd_sg[sg]                            # [D] (replicated)
                 gathered = jnp.where(dom >= 0, cnt_row[jnp.clip(dom, 0)], 0.0)
                 has_dom = dom >= 0
                 active = kind != C_NONE
 
                 # min over domains present among sel-eligible nodes
                 elig = p_sel_mask & has_dom
-                minmatch = jnp.min(jnp.where(elig, gathered, jnp.inf))
+                minmatch = comm.min(jnp.where(elig, gathered, jnp.inf))
                 minmatch = jnp.where(jnp.isfinite(minmatch), minmatch, 0.0)
-                total = jnp.sum(cnt_row)
+                total = jnp.sum(cnt_row)  # cd replicated: no psum needed
 
                 spread_ok = (gathered + c_selfmatch[c] - minmatch) <= c_maxskew[c]
                 spread_ok &= has_dom
@@ -175,8 +234,8 @@ def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None):
 
                 # score kinds: fewer matches better for spread; weighted count
                 # for preferred affinity (sign carried by weight)
-                smx = jnp.max(jnp.where(mask, gathered, 0.0))
-                smn = jnp.min(jnp.where(mask, gathered, jnp.inf))
+                smx = comm.max(jnp.where(mask, gathered, 0.0))
+                smn = comm.min(jnp.where(mask, gathered, jnp.inf))
                 smn = jnp.where(jnp.isfinite(smn), smn, 0.0)
                 rng = jnp.maximum(smx - smn, 1.0)
                 spread_score = (smx - gathered) * 100.0 / rng
@@ -186,27 +245,30 @@ def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None):
                                    w["affinity"] * c_weight[c] * gathered, 0.0)
 
             feasible = mask & p_valid
-            any_ok = jnp.any(feasible)
-            j = jnp.argmax(jnp.where(feasible, score, NEG))
-            j = jnp.where(any_ok, j, -1)
+            any_ok = comm.sum(feasible.astype(jnp.int32)) > 0
+            j_global, _best = comm.argmax(jnp.where(feasible, score, NEG), n_loc)
+            j_global = jnp.where(any_ok, j_global, -1)
 
-            # state updates (the in-batch assume())
-            place = (n_iota == j) & any_ok                     # [N]
+            # state updates (the in-batch assume()); local one-hot
+            local_j = j_global - offset
+            place = (n_iota == local_j) & any_ok               # [N] local
             placef = place.astype(jnp.float32)
             used = used + placef[:, None] * req[None, :]
             used_nz = used_nz + placef[:, None] * req_nz[None, :]
             npods = npods + placef
             ports = jnp.minimum(ports + placef[:, None] * p_ports[None, :], 1.0)
 
-            jj = jnp.clip(j, 0)
-            d_sg = dom_sg[:, jj]                               # [SG]
+            # winning node's domain ids, replicated to all shards
+            mine = (local_j >= 0) & (local_j < n_loc) & any_ok
+            jj = jnp.clip(local_j, 0, n_loc - 1)
+            d_sg = comm.replicate_from_owner(dom_sg[:, jj], mine)   # [SG]
+            d_asg = comm.replicate_from_owner(dom_asg[:, jj], mine)
             upd_sg = inc_sg * (d_sg >= 0) * any_ok
             cd_sg = cd_sg.at[jnp.arange(caps.sg_cap), jnp.clip(d_sg, 0)].add(upd_sg)
-            d_asg = dom_asg[:, jj]
             upd_asg = inc_asg * (d_asg >= 0) * any_ok
             cd_asg = cd_asg.at[jnp.arange(caps.asg_cap), jnp.clip(d_asg, 0)].add(upd_asg)
 
-            return (used, used_nz, npods, ports, cd_sg, cd_asg), j
+            return (used, used_nz, npods, ports, cd_sg, cd_asg), j_global
 
         xs = (pod["req"], pod["req_nz"], pod["p_valid"], pod["ports"],
               sel_mask, static_mask, static_score,
@@ -214,7 +276,12 @@ def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None):
               pod["c_weight"], pod["inc_sg"], pod["inc_asg"], pod["match_asg"])
         carry0 = (node["used"], node["used_nz"], node["npods"], node["port_mask"],
                   node["cd_sg"], node["cd_asg"])
-        carry, assignments = jax.lax.scan(step, carry0, xs)
+        carry, assignments = lax.scan(step, carry0, xs)
         return {"assignments": assignments, "used": carry[0], "npods": carry[2]}
 
     return assign
+
+
+def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None):
+    """Single-device jitted assignment: fn(node, pod) -> dict."""
+    return jax.jit(make_assign_core(caps, weights, axis_name=None))

@@ -60,7 +60,7 @@ class TPUBatchBackend(BatchBackend):
                 return [(None, Status(SKIP, str(e)))] * len(pod_infos)
 
             cd_sg, cd_asg = self.tensors.domain_base_counts()
-            if self._device_version != self.tensors.version:
+            if self._device_version != self.tensors.static_version:
                 t = self.tensors
                 self._device_node = {
                     "alloc": jnp.asarray(t.alloc),
@@ -72,7 +72,7 @@ class TPUBatchBackend(BatchBackend):
                     "dom_sg": jnp.asarray(t.dom_sg),
                     "dom_asg": jnp.asarray(t.dom_asg),
                 }
-                self._device_version = self.tensors.version
+                self._device_version = self.tensors.static_version
             node = dict(self._device_node)
             # dynamic state always re-uploaded: the snapshot is authoritative
             # (it already includes pods assumed by previous batches)

@@ -164,9 +164,43 @@ class ClusterTensors:
         self.node_infos: list[NodeInfo | None] = [None] * c.n_cap
         self.gen = np.zeros(c.n_cap, np.int64)
         self._free = list(range(c.n_cap - 1, -1, -1))
-        self.version = 0  # bumps on every host-array mutation
+        # static_version tracks arrays that rarely change (labels, taints,
+        # alloc, domains); the device cache keys off it so binding a pod —
+        # which dirties used/npods only — doesn't trigger a multi-MB
+        # re-upload of the label/key masks every batch.
+        self.version = 0         # any host-array mutation
+        self.static_version = 0  # label/key/taint/alloc/dom/valid mutations
 
     # -- vocab helpers ---------------------------------------------------
+
+    def ensure_label_id(self, pair: tuple[str, str]) -> int:
+        """Get-or-create a (key,value) label id, backfilling the node column
+        for all live rows on creation."""
+        lid = self.label_vocab.lookup(pair)
+        if lid is not None:
+            return lid
+        lid = self.label_vocab.get(pair)
+        k, v = pair
+        for row, ni in enumerate(self.node_infos):
+            if ni is not None and self.valid[row] and ni.node is not None:
+                if meta.labels(ni.node).get(k) == v:
+                    self.label_mask[row, lid] = 1.0
+        self.version += 1
+        self.static_version += 1
+        return lid
+
+    def ensure_key_id(self, key: str) -> int:
+        kid = self.key_vocab.lookup(key)
+        if kid is not None:
+            return kid
+        kid = self.key_vocab.get(key)
+        for row, ni in enumerate(self.node_infos):
+            if ni is not None and self.valid[row] and ni.node is not None:
+                if key in meta.labels(ni.node):
+                    self.key_mask[row, kid] = 1.0
+        self.version += 1
+        self.static_version += 1
+        return kid
 
     def domain_id(self, topo_key: str, value: str) -> int:
         vocab = self.domain_vocabs.get(topo_key)
@@ -189,6 +223,7 @@ class ClusterTensors:
             if ni is not None and self.valid[row]:
                 self._encode_sg_row(idx, row, ni)
         self.version += 1
+        self.static_version += 1  # dom_sg rows changed
         return idx
 
     def register_asg(self, group: SelectorGroup) -> int | None:
@@ -204,6 +239,7 @@ class ClusterTensors:
             if ni is not None and self.valid[row]:
                 self._encode_asg_row(idx, row, ni)
         self.version += 1
+        self.static_version += 1  # dom_asg rows changed
         return idx
 
     # -- node encoding ---------------------------------------------------
@@ -232,6 +268,7 @@ class ClusterTensors:
                 self.valid[row] = False
                 self.node_infos[row] = None
                 self._free.append(row)
+                self.static_version += 1
                 changed = True
         if changed:
             self.version += 1
@@ -252,15 +289,24 @@ class ClusterTensors:
         c = self.caps
         node = ni.node
         self.node_infos[row] = ni
-        self.valid[row] = True
-        self._encode_resource(self.alloc[row], ni.allocatable)
+
+        # ---- dynamic fields (change on every bind; cheap to upload) ----
         self._encode_resource(self.used[row], ni.requested)
         self._encode_resource(self.used_nz[row], ni.non_zero_requested)
         self.npods[row] = len(ni.pods)
-        self.maxpods[row] = ni.allocatable.allowed_pod_number
+        self.port_mask[row] = 0.0
+        for proto, _ip, port in ni.used_ports:
+            self.port_mask[row, self.port_vocab.get((proto, port))] = 1.0
+        for sg_idx in range(len(self.sgs)):
+            self._encode_sg_row(sg_idx, row, ni)
+        for asg_idx in range(len(self.asgs)):
+            self._encode_asg_row(asg_idx, row, ni)
 
-        # taints (+ unschedulable as a synthetic NoSchedule taint)
-        self.taint_mask[row] = 0.0
+        # ---- static fields (labels/taints/alloc; compare before write so
+        # routine pod-bind dirtying never bumps static_version) ----
+        alloc_new = np.zeros(c.r, np.float32)
+        self._encode_resource(alloc_new, ni.allocatable)
+        taint_new = np.zeros(c.t_cap, np.float32)
         taints = list((node.get("spec") or {}).get("taints") or ())
         if (node.get("spec") or {}).get("unschedulable"):
             taints.append({"key": UNSCHEDULABLE_TAINT[0],
@@ -269,28 +315,38 @@ class ClusterTensors:
         for t in taints:
             tid = self.taint_vocab.get(
                 (t.get("key", ""), t.get("value", ""), t.get("effect", "")))
-            self.taint_mask[row, tid] = 1.0
-
-        # labels
-        self.label_mask[row] = 0.0
-        self.key_mask[row] = 0.0
+            taint_new[tid] = 1.0
+        # labels — vocab ids are created by POD-side references only (a
+        # per-node-unique label like kubernetes.io/hostname would otherwise
+        # grow the vocab O(N)); node rows just set bits for known ids, and
+        # ensure_label_id/ensure_key_id backfill columns when a pod first
+        # references a label.
+        label_new = np.zeros(c.l_cap, np.float32)
+        key_new = np.zeros(c.kl_cap, np.float32)
         labels = meta.labels(node)
         for k, v in labels.items():
-            self.label_mask[row, self.label_vocab.get((k, v))] = 1.0
-            self.key_mask[row, self.key_vocab.get(k)] = 1.0
-        # metadata.name as a pseudo-label for matchFields support
-        self.label_mask[row, self.label_vocab.get(("metadata.name", ni.name))] = 1.0
+            lid = self.label_vocab.lookup((k, v))
+            if lid is not None:
+                label_new[lid] = 1.0
+            kid = self.key_vocab.lookup(k)
+            if kid is not None:
+                key_new[kid] = 1.0
 
-        # host ports in use
-        self.port_mask[row] = 0.0
-        for proto, _ip, port in ni.used_ports:
-            self.port_mask[row, self.port_vocab.get((proto, port))] = 1.0
-
-        # selector groups
-        for sg_idx in range(len(self.sgs)):
-            self._encode_sg_row(sg_idx, row, ni)
-        for asg_idx in range(len(self.asgs)):
-            self._encode_asg_row(asg_idx, row, ni)
+        static_changed = (
+            not self.valid[row]
+            or self.maxpods[row] != ni.allocatable.allowed_pod_number
+            or not np.array_equal(self.alloc[row], alloc_new)
+            or not np.array_equal(self.taint_mask[row], taint_new)
+            or not np.array_equal(self.label_mask[row], label_new)
+            or not np.array_equal(self.key_mask[row], key_new))
+        if static_changed:
+            self.valid[row] = True
+            self.alloc[row] = alloc_new
+            self.maxpods[row] = ni.allocatable.allowed_pod_number
+            self.taint_mask[row] = taint_new
+            self.label_mask[row] = label_new
+            self.key_mask[row] = key_new
+            self.static_version += 1
 
     def _encode_sg_row(self, sg_idx: int, row: int, ni: NodeInfo) -> None:
         sg = self.sgs[sg_idx]
@@ -468,13 +524,7 @@ class BatchEncoder:
         groups: list[list[int]] = []
         key_groups: list[list[int]] = []
         for k, v in pi.node_selector.items():
-            lid = t.label_vocab.lookup((k, v))
-            if lid is None:
-                # no node has this label -> nothing can match; encode an
-                # impossible group (empty any-of)
-                groups.append([])
-            else:
-                groups.append([lid])
+            groups.append([t.ensure_label_id((k, v))])
         if pi.node_affinity_required:
             enc = self._encode_affinity_terms(pi.node_affinity_required,
                                               groups, key_groups, b, i)
@@ -551,35 +601,30 @@ class BatchEncoder:
           - multiple terms, each a single positive requirement: union group
         """
         t = self.t
+        if any(fields.requirements for _, fields in terms):
+            return False  # matchFields (metadata.name): oracle path
         if len(terms) == 1:
             lab, fields = terms[0]
-            for req in (*lab.requirements, *fields.requirements):
+            for req in lab.requirements:
                 if req.operator == IN:
-                    ids = [t.label_vocab.lookup((req.key, v)) for v in req.values]
-                    groups.append([x for x in ids if x is not None])
+                    groups.append([t.ensure_label_id((req.key, v))
+                                   for v in req.values])
                 elif req.operator == EXISTS:
-                    kid = t.key_vocab.lookup(req.key)
-                    key_groups.append([kid] if kid is not None else [])
+                    key_groups.append([t.ensure_key_id(req.key)])
                 elif req.operator == NOT_IN:
                     for v in req.values:
-                        lid = t.label_vocab.lookup((req.key, v))
-                        if lid is not None:
-                            b.sel_forb[i, lid] = 1.0
+                        b.sel_forb[i, t.ensure_label_id((req.key, v))] = 1.0
                 elif req.operator == DOES_NOT_EXIST:
-                    kid = t.key_vocab.lookup(req.key)
-                    if kid is not None:
-                        b.key_forb[i, kid] = 1.0
+                    b.key_forb[i, t.ensure_key_id(req.key)] = 1.0
                 else:  # Gt/Lt
                     return False
             return True
         union: list[int] = []
         for lab, fields in terms:
-            reqs = (*lab.requirements, *fields.requirements)
+            reqs = lab.requirements
             if len(reqs) != 1 or reqs[0].operator != IN:
                 return False
             for v in reqs[0].values:
-                lid = t.label_vocab.lookup((reqs[0].key, v))
-                if lid is not None:
-                    union.append(lid)
+                union.append(t.ensure_label_id((reqs[0].key, v)))
         groups.append(union)
         return True

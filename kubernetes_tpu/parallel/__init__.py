@@ -1,0 +1,3 @@
+"""Device mesh + sharded hot path."""
+
+from .mesh import NODE_AXIS, build_sharded_assign_fn, make_mesh  # noqa: F401

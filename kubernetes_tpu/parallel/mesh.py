@@ -1,0 +1,71 @@
+"""Device-mesh sharding of the scheduler hot path.
+
+The node axis is the "long axis" of this workload (100k+ nodes); it shards
+across TPU cores the way sequence parallelism shards tokens (SURVEY.md §5):
+each core owns a contiguous slab of node rows, computes local feasibility +
+scores, and placement is a per-core top-1 + all_gather + global pick.  The
+running-sum state (used/npods/ports) lives sharded; the small domain-count
+tables (cd_sg/cd_asg) are replicated and kept coherent with a psum of the
+winning shard's domain ids.  All collectives are XLA ICI collectives — no
+NCCL on TPU (reference's comm backbone analysis: SURVEY.md §2.6).
+
+Multi-host: jax.distributed.initialize() + the same Mesh spanning all
+processes gives DCN+ICI automatically; nothing here changes.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.assign import make_assign_core
+from ..ops.flatten import Caps
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis,))
+
+
+def node_specs(axis: str = NODE_AXIS) -> dict:
+    """PartitionSpec per node-side array (the real tp-style shardings)."""
+    return {
+        "alloc": P(axis, None), "used": P(axis, None), "used_nz": P(axis, None),
+        "npods": P(axis), "maxpods": P(axis), "valid": P(axis),
+        "taint_mask": P(axis, None), "label_mask": P(axis, None),
+        "key_mask": P(axis, None), "port_mask": P(axis, None),
+        "dom_sg": P(None, axis), "dom_asg": P(None, axis),
+        # per-domain count tables are small and replicated
+        "cd_sg": P(), "cd_asg": P(),
+    }
+
+
+def pod_specs() -> dict:
+    """Pod-side arrays are replicated (the batch is small)."""
+    keys = ["req", "req_nz", "p_valid", "untol_hard", "untol_prefer",
+            "sel_any", "sel_any_active", "sel_forb", "key_any",
+            "key_any_active", "key_forb", "ports", "node_row", "c_kind",
+            "c_sg", "c_maxskew", "c_selfmatch", "c_weight", "inc_sg",
+            "inc_asg", "match_asg"]
+    return {k: P() for k in keys}
+
+
+def build_sharded_assign_fn(caps: Caps, mesh: Mesh,
+                            weights: dict[str, float] | None = None,
+                            axis: str = NODE_AXIS):
+    """shard_map'd assignment over the node axis. caps.n_cap must divide
+    evenly by the mesh size."""
+    n_shards = mesh.devices.size
+    if caps.n_cap % n_shards != 0:
+        raise ValueError(f"n_cap {caps.n_cap} not divisible by {n_shards} devices")
+    core = make_assign_core(caps, weights, axis_name=axis)
+    fn = jax.shard_map(
+        core, mesh=mesh,
+        in_specs=(node_specs(axis), pod_specs()),
+        out_specs={"assignments": P(), "used": P(axis, None), "npods": P(axis)},
+        check_vma=False,
+    )
+    return jax.jit(fn)

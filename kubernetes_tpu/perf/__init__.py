@@ -1,0 +1,19 @@
+"""scheduler_perf harness (reference: test/integration/scheduler_perf)."""
+
+import os
+
+import yaml
+
+from .scheduler_perf import (  # noqa: F401
+    PerfCluster, ThroughputCollector, ThroughputSummary, run_named_workload,
+    run_workload, setup_cluster, wait_for_pods_scheduled,
+)
+
+_CONFIG = os.path.join(os.path.dirname(__file__), "config",
+                       "performance-config.yaml")
+
+
+def load_workloads(path: str | None = None) -> dict[str, dict]:
+    with open(path or _CONFIG) as f:
+        entries = yaml.safe_load(f)
+    return {e["name"]: e for e in entries}

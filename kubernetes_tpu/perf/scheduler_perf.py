@@ -1,0 +1,252 @@
+"""scheduler_perf — the reference's scale benchmark harness, ported.
+
+Reference: test/integration/scheduler_perf/
+  scheduler_perf_test.go:57-63  workload opcodes: createNodes / createPods /
+                                churn / barrier / sleep
+  util.go:79  mustSetupScheduler (in-proc apiserver + real scheduler)
+  util.go:288-355  throughputCollector: samples scheduled-pod count at a
+                   fixed window -> SchedulingThroughput Average/PercNN
+  config/performance-config.yaml  workload definitions
+
+Workloads are YAML/dict configs of the same shape:
+
+  name: SchedulingBasic
+  workloadTemplate:
+    - opcode: createNodes
+      count: 500
+    - opcode: createPods
+      count: 500
+      podTemplate: {...}         # optional; default is a small-request pod
+    - opcode: barrier            # wait until all pending pods scheduled
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..api import meta
+from ..client import LocalClient, SharedInformerFactory
+from ..client.clientset import NODES, PODS
+from ..scheduler import Profile, Scheduler, new_default_framework, new_scheduler
+from ..store import kv
+from ..testing import make_node, make_pod
+
+DEFAULT_SAMPLE_INTERVAL = 1.0  # util.go: 1s window
+
+
+@dataclass
+class ThroughputSummary:
+    average: float = 0.0
+    perc50: float = 0.0
+    perc90: float = 0.0
+    perc99: float = 0.0
+    total_pods: int = 0
+    duration: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {"Average": round(self.average, 1), "Perc50": round(self.perc50, 1),
+                "Perc90": round(self.perc90, 1), "Perc99": round(self.perc99, 1),
+                "TotalPods": self.total_pods,
+                "DurationSeconds": round(self.duration, 2)}
+
+
+class ThroughputCollector:
+    """Samples scheduled-pod deltas per window (util.go:288-355)."""
+
+    def __init__(self, store: kv.MemoryStore, interval: float = DEFAULT_SAMPLE_INTERVAL):
+        self.store = store
+        self.interval = interval
+        self.samples: list[float] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._start_time = 0.0
+        self._start_count = 0
+
+    def _scheduled_count(self) -> int:
+        items, _ = self.store.list(PODS)
+        return sum(1 for p in items if meta.pod_node_name(p))
+
+    def start(self) -> None:
+        self._start_time = time.monotonic()
+        self._start_count = self._scheduled_count()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        last = self._start_count
+        while not self._stop.wait(self.interval):
+            cur = self._scheduled_count()
+            self.samples.append((cur - last) / self.interval)
+            last = cur
+
+    def stop(self) -> ThroughputSummary:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(2.0)
+        end = time.monotonic()
+        total = self._scheduled_count() - self._start_count
+        dur = max(end - self._start_time, 1e-9)
+        s = ThroughputSummary(total_pods=total, duration=dur,
+                              average=total / dur)
+        if self.samples:
+            xs = sorted(self.samples)
+            def perc(p: float) -> float:
+                return xs[min(int(len(xs) * p), len(xs) - 1)]
+            s.perc50, s.perc90, s.perc99 = perc(0.50), perc(0.90), perc(0.99)
+        return s
+
+
+@dataclass
+class PerfCluster:
+    store: kv.MemoryStore
+    client: LocalClient
+    factory: SharedInformerFactory
+    scheduler: Scheduler
+
+    def shutdown(self) -> None:
+        self.scheduler.stop()
+        self.factory.stop()
+
+
+def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
+                  store: kv.MemoryStore | None = None) -> PerfCluster:
+    """mustSetupScheduler (util.go:79): in-proc everything, no kubelet."""
+    store = store or kv.MemoryStore(history=1_000_000)
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    if tpu:
+        from ..ops.backend import TPUBatchBackend
+        from ..ops.flatten import Caps
+        backend = TPUBatchBackend(caps or Caps(), batch_size=batch_size)
+        fw = new_default_framework(client, factory)
+        profiles = {"default-scheduler": Profile(
+            fw, batch_backend=backend, batch_size=batch_size)}
+        sched = Scheduler(client, factory, profiles)
+    else:
+        sched = new_scheduler(client, factory)
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    return PerfCluster(store, client, factory, sched)
+
+
+# -- workload ops (scheduler_perf_test.go opcodes) -------------------------
+
+def _default_pod(i: int, params: dict) -> dict:
+    w = make_pod(params.get("podNamePrefix", "pod-") + str(i),
+                 params.get("namespace", "default"))
+    tmpl = params.get("podTemplate") or {}
+    if tmpl:
+        pod = meta.deep_copy(w.build())
+        spec = meta.deep_copy(tmpl.get("spec") or {})
+        pod["spec"].update(spec)
+        if "metadata" in tmpl:
+            md = meta.deep_copy(tmpl["metadata"])
+            name = pod["metadata"]["name"]
+            ns = pod["metadata"]["namespace"]
+            pod["metadata"].update(md)
+            pod["metadata"]["name"] = name
+            pod["metadata"]["namespace"] = ns
+        return pod
+    return w.req(cpu=params.get("cpu", "100m"),
+                 mem=params.get("memory", "128Mi")).build()
+
+
+def _default_node(i: int, params: dict) -> dict:
+    w = make_node(params.get("nodeNamePrefix", "node-") + str(i))
+    w.capacity(cpu=params.get("cpu", "32"), mem=params.get("memory", "256Gi"),
+               pods=params.get("pods", 110))
+    labels = dict(params.get("labels") or {})
+    if params.get("zones"):
+        zones = params["zones"]
+        labels["topology.kubernetes.io/zone"] = zones[i % len(zones)]
+    labels.setdefault("kubernetes.io/hostname", meta.name(w.obj))
+    w.labels(**labels)
+    return w.build()
+
+
+def wait_for_pods_scheduled(cluster: PerfCluster, want: int,
+                            timeout: float = 600.0, namespace=None) -> bool:
+    """barrier opcode: wait until `want` pods have nodeName set."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        items, _ = cluster.store.list(PODS, namespace)
+        n = sum(1 for p in items if meta.pod_node_name(p))
+        if n >= want:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def run_workload(cluster: PerfCluster, ops: list[dict],
+                 collector: ThroughputCollector | None = None) -> dict:
+    """Execute a workloadTemplate op list. Returns op stats."""
+    created_pods = 0
+    created_nodes = 0
+    stats: dict[str, Any] = {}
+    churn_stop: list[threading.Event] = []
+    for op in ops:
+        opcode = op["opcode"]
+        if opcode == "createNodes":
+            count = op["count"]
+            for i in range(count):
+                cluster.client.create(NODES, _default_node(created_nodes + i, op))
+            created_nodes += count
+        elif opcode == "createPods":
+            count = op["count"]
+            for i in range(count):
+                cluster.client.create(PODS, _default_pod(created_pods + i, op))
+            created_pods += count
+        elif opcode == "barrier":
+            want = op.get("count", created_pods)
+            ok = wait_for_pods_scheduled(cluster, want,
+                                         timeout=op.get("timeout", 600.0))
+            stats["barrier_ok"] = ok
+        elif opcode == "sleep":
+            time.sleep(op.get("duration", 1.0))
+        elif opcode == "churn":
+            # background create/delete loop (scheduler_perf churn op)
+            ev = threading.Event()
+            churn_stop.append(ev)
+            interval = op.get("intervalMilliseconds", 500) / 1000.0
+
+            def churn_loop(ev=ev, interval=interval, op=op):
+                i = 0
+                while not ev.wait(interval):
+                    name = f"churn-{i}"
+                    try:
+                        cluster.client.create(
+                            PODS, make_pod(name, "churn").req(cpu="1m").build())
+                        cluster.client.delete(PODS, "churn", name)
+                    except kv.StoreError:
+                        pass
+                    i += 1
+
+            threading.Thread(target=churn_loop, daemon=True).start()
+        else:
+            raise ValueError(f"unknown opcode {opcode!r}")
+    for ev in churn_stop:
+        ev.set()
+    stats["created_pods"] = created_pods
+    stats["created_nodes"] = created_nodes
+    return stats
+
+
+def run_named_workload(config: dict, tpu: bool = False, caps=None,
+                       batch_size: int = 512) -> tuple[ThroughputSummary, dict]:
+    """Run one workload config end to end; returns (throughput, stats)."""
+    cluster = setup_cluster(tpu=tpu, caps=caps, batch_size=batch_size)
+    collector = ThroughputCollector(cluster.store)
+    try:
+        ops = config["workloadTemplate"]
+        t0 = time.monotonic()
+        collector.start()
+        stats = run_workload(cluster, ops, collector)
+        summary = collector.stop()
+        stats["wall"] = time.monotonic() - t0
+        return summary, stats
+    finally:
+        cluster.shutdown()

@@ -20,6 +20,13 @@ Design:
     which informers answer by re-listing (reflector.go:256 semantics).
 
 Thread-safe; all blocking happens in Watch.next(), never under the lock.
+
+Object-sharing contract (same as client-go's informer cache): objects
+RETURNED by get/list/watch are shared references and MUST NOT be mutated by
+callers — mutate a deep copy and write it back.  Inbound objects on
+create/update are deep-copied by the store, so the stored state is always
+private.  This removes a deep copy from every read, which profiling shows
+dominates end-to-end scheduling throughput.
 """
 
 from __future__ import annotations
@@ -170,7 +177,7 @@ class MemoryStore:
             meta.set_resource_version(obj, self._rev)
             table[key] = obj
             self._emit(resource, ADDED, obj)
-            return meta.deep_copy(obj)
+            return obj
 
     def get(self, resource: str, namespace: str, name: str) -> Obj:
         with self._lock:
@@ -178,7 +185,7 @@ class MemoryStore:
             key = self._key(namespace, name)
             if key not in table:
                 raise NotFoundError(f"{resource} {key!r} not found")
-            return meta.deep_copy(table[key])
+            return table[key]
 
     def update(self, resource: str, obj: Obj, expect_rv: int | None = None) -> Obj:
         """CAS update: expect_rv defaults to the object's own resourceVersion."""
@@ -199,7 +206,7 @@ class MemoryStore:
             meta.set_resource_version(obj, self._rev)
             table[key] = obj
             self._emit(resource, MODIFIED, obj)
-            return meta.deep_copy(obj)
+            return obj
 
     def guaranteed_update(self, resource: str, namespace: str, name: str,
                           fn: Callable[[Obj], Obj], max_retries: int = 16) -> Obj:
@@ -225,7 +232,10 @@ class MemoryStore:
                 raise ConflictError(f"{resource} {key!r}: stale delete")
             del table[key]
             self._rev += 1
-            tomb = meta.deep_copy(cur)
+            # tombstone: shallow copy with fresh metadata (readers may still
+            # hold the stored object; never mutate it in place)
+            tomb = dict(cur)
+            tomb["metadata"] = dict(cur["metadata"])
             meta.set_resource_version(tomb, self._rev)
             self._emit(resource, DELETED, tomb)
             return tomb
@@ -236,9 +246,9 @@ class MemoryStore:
             table = self._table(resource)
             if namespace:
                 prefix = namespace + "/"
-                items = [meta.deep_copy(o) for k, o in table.items() if k.startswith(prefix)]
+                items = [o for k, o in table.items() if k.startswith(prefix)]
             else:
-                items = [meta.deep_copy(o) for o in table.values()]
+                items = list(table.values())
             return items, self._rev
 
     def count(self, resource: str) -> int:

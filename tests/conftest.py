@@ -1,13 +1,19 @@
 """Test configuration.
 
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding
-(mesh/shard_map over the node axis) is exercised without TPU hardware,
-per the driver contract.  Must be set before jax is imported anywhere.
+(mesh/shard_map over the node axis) is exercised without TPU hardware, per
+the driver contract.  The environment pins the real TPU platform via a
+sitecustomize (JAX_PLATFORMS=axon), so env vars alone don't stick — we
+override through jax.config before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,46 @@
+"""scheduler_perf harness tests: small-scale runs of each workload on both
+the per-pod (oracle) and TPU batch paths, asserting all pods schedule."""
+
+import copy
+
+import pytest
+
+from kubernetes_tpu.ops.flatten import Caps
+from kubernetes_tpu.perf import load_workloads, run_named_workload
+
+
+def scale_down(config, nodes, pods):
+    cfg = copy.deepcopy(config)
+    for op in cfg["workloadTemplate"]:
+        if op["opcode"] == "createNodes":
+            op["count"] = nodes
+        elif op["opcode"] == "createPods":
+            op["count"] = pods
+        elif op["opcode"] == "barrier":
+            op["timeout"] = 60.0
+    return cfg
+
+
+CAPS = Caps(n_cap=64, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8, s_cap=2,
+            sg_cap=8, asg_cap=8)
+
+
+@pytest.mark.parametrize("tpu", [False, True], ids=["per-pod", "tpu-batch"])
+@pytest.mark.parametrize("name", ["SchedulingBasic", "TopologySpreading",
+                                  "SchedulingPodAntiAffinity"])
+def test_workloads_small(name, tpu):
+    cfg = load_workloads()[name]
+    n_pods = 40 if name != "SchedulingPodAntiAffinity" else 30
+    cfg = scale_down(cfg, nodes=40, pods=n_pods)
+    summary, stats = run_named_workload(cfg, tpu=tpu, caps=CAPS, batch_size=16)
+    assert stats["barrier_ok"], f"{name} (tpu={tpu}): pods left unscheduled"
+    assert summary.total_pods == n_pods
+    assert summary.average > 0
+
+
+def test_throughput_summary_shape():
+    cfg = scale_down(load_workloads()["SchedulingBasic"], 10, 10)
+    summary, _ = run_named_workload(cfg, tpu=False)
+    d = summary.to_dict()
+    assert {"Average", "Perc50", "Perc90", "Perc99", "TotalPods",
+            "DurationSeconds"} <= set(d)

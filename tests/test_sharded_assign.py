@@ -1,0 +1,106 @@
+"""Sharded (multi-device) assignment must agree with the single-device path.
+
+Runs on the 8 virtual CPU devices from conftest.py — the same mechanism the
+driver's dryrun_multichip check uses.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_tpu.models.assign import build_assign_fn
+from kubernetes_tpu.ops.backend import TPUBatchBackend
+from kubernetes_tpu.ops.flatten import BatchEncoder, Caps, ClusterTensors
+from kubernetes_tpu.parallel.mesh import build_sharded_assign_fn, make_mesh
+from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler.types import PodInfo
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def build_inputs(caps, nodes, pods, batch_size):
+    import jax.numpy as jnp
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    snap = cache.update_snapshot(Snapshot())
+    tensors = ClusterTensors(caps)
+    tensors.update_from_snapshot(snap)
+    enc = BatchEncoder(tensors, batch_size)
+    batch = enc.encode([PodInfo(p) for p in pods])
+    cd_sg, cd_asg = tensors.domain_base_counts()
+    node_arrays = {
+        "alloc": jnp.asarray(tensors.alloc), "used": jnp.asarray(tensors.used),
+        "used_nz": jnp.asarray(tensors.used_nz),
+        "npods": jnp.asarray(tensors.npods),
+        "maxpods": jnp.asarray(tensors.maxpods),
+        "valid": jnp.asarray(tensors.valid),
+        "taint_mask": jnp.asarray(tensors.taint_mask),
+        "label_mask": jnp.asarray(tensors.label_mask),
+        "key_mask": jnp.asarray(tensors.key_mask),
+        "port_mask": jnp.asarray(tensors.port_mask),
+        "dom_sg": jnp.asarray(tensors.dom_sg),
+        "dom_asg": jnp.asarray(tensors.dom_asg),
+        "cd_sg": jnp.asarray(cd_sg), "cd_asg": jnp.asarray(cd_asg),
+    }
+    pod_arrays = {k: jnp.asarray(getattr(batch, k)) for k in [
+        "req", "req_nz", "p_valid", "untol_hard", "untol_prefer", "sel_any",
+        "sel_any_active", "sel_forb", "key_any", "key_any_active", "key_forb",
+        "ports", "node_row", "c_kind", "c_sg", "c_maxskew", "c_selfmatch",
+        "c_weight", "inc_sg", "inc_asg", "match_asg"]}
+    return tensors, node_arrays, pod_arrays
+
+
+@pytest.fixture(scope="module")
+def caps():
+    return Caps(n_cap=32, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                s_cap=2, sg_cap=8, asg_cap=8)
+
+
+def workload():
+    nodes = ([make_node(f"a{i}").zone("a").labels(
+        **{"kubernetes.io/hostname": f"a{i}"}).capacity(cpu="2", mem="4Gi").build()
+        for i in range(8)]
+        + [make_node(f"b{i}").zone("b").labels(
+            **{"kubernetes.io/hostname": f"b{i}"}).capacity(cpu="2", mem="4Gi").build()
+           for i in range(8)])
+    pods = (
+        [make_pod(f"web{i}").labels(app="web").req(cpu="500m", mem="512Mi")
+         .topology_spread("topology.kubernetes.io/zone", max_skew=1,
+                          match_labels={"app": "web"}).build() for i in range(6)]
+        + [make_pod(f"solo{i}").labels(app="solo").req(cpu="250m")
+           .pod_affinity("kubernetes.io/hostname", {"app": "solo"}, anti=True)
+           .build() for i in range(4)]
+        + [make_pod(f"plain{i}").req(cpu="100m", mem="128Mi").build()
+           for i in range(6)])
+    return nodes, pods
+
+
+class TestShardedParity:
+    def test_eight_device_matches_single(self, caps):
+        assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+        nodes, pods = workload()
+        tensors, node_arrays, pod_arrays = build_inputs(caps, nodes, pods, 16)
+
+        single = build_assign_fn(caps)
+        out1 = np.asarray(single(node_arrays, pod_arrays)["assignments"])
+
+        mesh = make_mesh()
+        sharded = build_sharded_assign_fn(caps, mesh)
+        out8 = np.asarray(sharded(node_arrays, pod_arrays)["assignments"])
+
+        assert np.array_equal(out1, out8), f"single={out1} sharded={out8}"
+
+    def test_sharded_respects_constraints(self, caps):
+        nodes, pods = workload()
+        tensors, node_arrays, pod_arrays = build_inputs(caps, nodes, pods, 16)
+        mesh = make_mesh()
+        sharded = build_sharded_assign_fn(caps, mesh)
+        out = np.asarray(sharded(node_arrays, pod_arrays)["assignments"])
+        names = [tensors.node_name(r) if r >= 0 else None for r in out]
+        # anti-affinity pods (positions 6..9) all on distinct hosts
+        solo = names[6:10]
+        assert None not in solo and len(set(solo)) == 4
+        # spread pods (0..5) split 3/3 across zones
+        zones = ["a" if n.startswith("a") else "b" for n in names[:6]]
+        assert zones.count("a") == 3 and zones.count("b") == 3

@@ -38,11 +38,13 @@ class TestStoreCRUD:
             s.get("pods", "default", "zzz")
 
     def test_update_cas_conflict(self):
+        # store contract: never mutate returned objects; copy first
         s = kv.MemoryStore()
         created = s.create("pods", pod("a"))
         stale = meta.deep_copy(created)
-        created["spec"]["nodeName"] = "n1"
-        s.update("pods", created)
+        fresh = meta.deep_copy(created)
+        fresh["spec"]["nodeName"] = "n1"
+        s.update("pods", fresh)
         stale["spec"]["nodeName"] = "n2"
         with pytest.raises(kv.ConflictError):
             s.update("pods", stale)
@@ -105,7 +107,7 @@ class TestWatch:
     def test_watch_ordering_and_types(self):
         s = kv.MemoryStore()
         w = s.watch("pods")
-        p = s.create("pods", pod("a"))
+        p = meta.deep_copy(s.create("pods", pod("a")))
         p["spec"]["nodeName"] = "n"
         s.update("pods", p)
         s.delete("pods", "default", "a")
@@ -159,7 +161,7 @@ class TestInformer:
 
     def test_update_delivers_old_object(self):
         s = kv.MemoryStore()
-        p = s.create("pods", pod("a"))
+        p = meta.deep_copy(s.create("pods", pod("a")))
         inf = Informer(LocalClient(s), "pods")
         inf.start()
         inf.wait_for_cache_sync(5)
