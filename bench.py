@@ -43,8 +43,9 @@ def main() -> None:
         elif op["opcode"] == "barrier":
             op["timeout"] = 900.0
 
-    caps = Caps(n_cap=max(1024, 1 << (N_NODES + 512).bit_length()),
-                l_cap=256, kl_cap=64, t_cap=16, pt_cap=16, s_cap=3,
+    n_cap = max(1024, -(-int(N_NODES * 1.1) // 256) * 256)  # ~10% headroom
+    caps = Caps(n_cap=n_cap,
+                l_cap=256, kl_cap=62, t_cap=16, pt_cap=16, s_cap=3,
                 sg_cap=16, asg_cap=16)
     t0 = time.monotonic()
     summary, stats = run_named_workload(cfg, tpu=True, caps=caps,

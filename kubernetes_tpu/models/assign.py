@@ -3,37 +3,49 @@
 This is the TPU replacement for the reference's HOT LOOPS (SURVEY.md §3.1):
   findNodesThatPassFilters (schedule_one.go:512)  -> feasibility masks
   RunScorePlugins          (runtime/framework.go:903) -> score matrix
-  selectHost               (schedule_one.go:777)  -> masked argmax
-  + the implicit cache.assume() between per-pod cycles -> in-scan running
-    sums (resources, pod counts, host ports, topology/affinity domain
-    counts), which is what makes a batch of K pods produce the same
-    placements the reference produces scheduling them one at a time
-    (SURVEY.md §7 hard part #1).
+  selectHost               (schedule_one.go:777)  -> masked argmax (the
+      reference breaks score ties by reservoir sampling; we break them with
+      fixed pseudo-random noise, which also de-correlates claims)
+  + the implicit cache.assume() between per-pod cycles -> running aggregate
+    state (resources, pod counts, host ports, topology/affinity domain
+    counts) updated as placements commit (SURVEY.md §7 hard part #1).
 
-Structure:
-  static phase (vectorized over P x N, MXU matmuls):
-      label-selector any-of groups   einsum('pgl,nl->pgn')
-      forbidden labels / keys        matmul
-      untolerated-taint counts       matmul
-      (these mirror NodeAffinity / NodeUnschedulable / TaintToleration /
-       NodeName filters)
-  scan phase (lax.scan over the P pods in queue order):
-      NodeResourcesFit mask from running used/npods sums
-      NodePorts conflict from running port mask
-      PodTopologySpread / InterPodAffinity from running domain counts
-      LeastAllocated + BalancedAllocation + spread/affinity scores
-      masked argmax -> placement -> state update
+Two solvers share the static phase:
+
+  WAVE (default, the TPU-native design): every pending pod claims its
+    argmax node simultaneously; conflicts are resolved in pod (queue) order
+    with [P,P] prefix matrices — earlier claimants' requests are
+    prefix-summed per node, and constraint-carrying claimants into the same
+    topology domain are serialized one-per-wave; losers retry next wave
+    against updated aggregates.  A batch converges in O(contention) waves
+    (typically 2-6), each wave a handful of [P,N] vectorized ops + small
+    [P,P] matmuls — no sequential scan, so device time is independent of
+    batch size for uncontended workloads.  Placements are feasible at
+    commit time exactly like the sequential path; *which* feasible node a
+    pod gets can differ from strict one-at-a-time order (the reference
+    itself is nondeterministic here: random tie-break + node sampling).
+
+  SCAN (mode="scan"): strict one-pod-at-a-time lax.scan, bit-faithful to
+    sequential semantics; used as the parity oracle and for tiny batches.
+
+Conservative wave-conflict rules (reject -> retry, never accept wrongly):
+  - resources/pod-count: prefix-sum of ALL earlier same-node claimants
+  - host ports: any earlier same-node claimant with overlapping ports
+  - spread/anti-affinity: any earlier claimant that increments the same
+    selector-group into the same topology domain
+  - affinity bootstrap (first pod of a self-affine group): any earlier
+    claimant incrementing the group anywhere
+  - existing-pod anti-affinity groups (asg): any earlier claimant carrying
+    a matching anti-term into the claimed domain
 
 Multi-chip: the node axis shards across a jax Mesh (parallel/mesh.py wraps
-this in shard_map).  Every cross-node reduction goes through the _Comm
-layer: max/min/sum become pmax/pmin/psum over ICI, the argmax becomes a
-per-shard top-1 + all_gather + global pick, and the domain-count updates are
-replicated via a psum of the winning shard's domain ids.  That is the
-"shard the long axis, per-core top-k, global reduce" recipe from SURVEY.md
-§5 (long-context analog).
+this in shard_map); cross-node reductions go through _Comm (pmax/pmin/psum
+over ICI), per-pod argmax is per-shard top-1 + all_gather + pick, and
+gathers by global node index are psum-of-owner.  All collectives are XLA
+ICI collectives — no NCCL on TPU (SURVEY.md §2.6).
 
-All shapes are static (derived from flatten.Caps), so one compilation
-serves every batch; arrays are padded and masked.
+All shapes are static (derived from flatten.Caps); one compile serves every
+batch.
 """
 
 from __future__ import annotations
@@ -52,6 +64,15 @@ from ..ops.flatten import (
 )
 
 NEG = -1e9
+TIE_NOISE = 1e-3  # breaks exact score ties only (real score deltas >> this)
+
+# Kernel feature flags.  The device endpoint has high per-op overhead, so
+# the backend compiles specialized variants: a batch with no selectors /
+# constraints / host ports (the common case) runs a kernel with those code
+# paths elided entirely.
+ALL_FEATURES = frozenset({"selectors", "ports", "constraints", "asg", "pin",
+                          "prefer"})
+PLAIN_FEATURES = frozenset()
 
 
 class _Comm:
@@ -61,50 +82,59 @@ class _Comm:
     def __init__(self, axis_name: str | None):
         self.axis = axis_name
 
-    def max(self, x):
-        m = jnp.max(x)
-        return lax.pmax(m, self.axis) if self.axis else m
+    def psum(self, x):
+        return lax.psum(x, self.axis) if self.axis else x
 
-    def min(self, x):
-        m = jnp.min(x)
-        return lax.pmin(m, self.axis) if self.axis else m
-
-    def sum(self, x):
-        s = jnp.sum(x)
-        return lax.psum(s, self.axis) if self.axis else s
+    def any_rows(self, m):
+        """any over the node axis of [P,N] bool -> [P]."""
+        a = jnp.any(m, axis=-1)
+        return self.psum(a.astype(jnp.int32)) > 0 if self.axis else a
 
     def rowmax(self, x, mask, fill):
-        """max over the node axis (last) of a [P,N] array under mask."""
         m = jnp.max(jnp.where(mask, x, fill), axis=-1, keepdims=True)
         return lax.pmax(m, self.axis) if self.axis else m
 
-    def argmax(self, score, n_loc: int):
-        """Global argmax over the (possibly sharded) node axis.
-        Returns (j_global, best_score)."""
-        local_best = jnp.max(score)
-        local_idx = jnp.argmax(score)
+    def rowmin(self, x, mask, fill):
+        m = jnp.min(jnp.where(mask, x, fill), axis=-1, keepdims=True)
+        return lax.pmin(m, self.axis) if self.axis else m
+
+    def row_argmax(self, score, n_loc: int):
+        """Per-row global argmax of [P,N(sharded)] -> global indices [P]."""
+        best = jnp.max(score, axis=-1)                       # [P]
+        idx = jnp.argmax(score, axis=-1)                     # [P]
         if not self.axis:
-            return local_idx, local_best
-        best_all = lax.all_gather(local_best, self.axis)   # [S]
-        idx_all = lax.all_gather(local_idx, self.axis)     # [S]
-        shard = jnp.argmax(best_all)
-        return shard * n_loc + idx_all[shard], best_all[shard]
+            return idx, best
+        best_all = lax.all_gather(best, self.axis)           # [S,P]
+        idx_all = lax.all_gather(idx, self.axis)             # [S,P]
+        shard = jnp.argmax(best_all, axis=0)                 # [P]
+        p_iota = jnp.arange(idx.shape[0])
+        j = shard * n_loc + idx_all[shard, p_iota]
+        return j, best_all[shard, p_iota]
 
     def my_offset(self, n_loc: int):
         if not self.axis:
             return 0
         return lax.axis_index(self.axis) * n_loc
 
-    def replicate_from_owner(self, value, owner_mask, sentinel_shift=1):
-        """All shards learn `value` (int array) held by the shard where
-        owner_mask is True; value entries may be -1 (encoded via +shift)."""
-        if not self.axis:
-            return value
-        enc = (value + sentinel_shift) * owner_mask.astype(value.dtype)
-        return lax.psum(enc, self.axis) - sentinel_shift
+    def gather_cols(self, arr, gidx, offset, n_loc: int, fill=0.0):
+        """arr[..., gidx] where gidx are GLOBAL node indices and arr holds
+        the local shard of the node axis (last dim).  Out-of-range gidx
+        (e.g. -1) yield `fill`."""
+        local = gidx - offset
+        inrange = (local >= 0) & (local < n_loc) & (gidx >= 0)
+        vals = jnp.take(arr, jnp.clip(local, 0, n_loc - 1), axis=-1)
+        vals = jnp.where(inrange, vals, 0)
+        if self.axis:
+            vals = lax.psum(vals, self.axis)
+        if fill != 0.0:
+            seen = inrange if not self.axis else (
+                lax.psum(inrange.astype(jnp.int32), self.axis) > 0)
+            vals = jnp.where(seen, vals, fill)
+        return vals
 
 
-def _static_mask_and_score(node: dict, pod: dict, comm: _Comm, offset):
+def _static_mask_and_score(node: dict, pod: dict, comm: _Comm, offset,
+                           features: frozenset = ALL_FEATURES):
     """Vectorized P x N feasibility independent of in-batch placements.
 
     Returns (sel_mask, static_mask, static_score):
@@ -117,69 +147,295 @@ def _static_mask_and_score(node: dict, pod: dict, comm: _Comm, offset):
     valid = node["valid"][None, :]                        # [1,N]
     label = node["label_mask"]                            # [N,L]
     keym = node["key_mask"]                               # [N,KL]
+    P = pod["req"].shape[0]
 
-    # any-of label groups: group satisfied if node has >=1 of its ids
-    hits = jnp.einsum("pgl,nl->pgn", pod["sel_any"], label)
-    group_ok = (hits > 0) | (pod["sel_any_active"][:, :, None] == 0)
-    sel_ok = jnp.all(group_ok, axis=1)                    # [P,N]
-    khits = jnp.einsum("pgk,nk->pgn", pod["key_any"], keym)
-    kgroup_ok = (khits > 0) | (pod["key_any_active"][:, :, None] == 0)
-    sel_ok &= jnp.all(kgroup_ok, axis=1)
-    sel_ok &= (pod["sel_forb"] @ label.T) == 0            # NotIn
-    sel_ok &= (pod["key_forb"] @ keym.T) == 0             # DoesNotExist
-    sel_mask = sel_ok & valid
+    if "selectors" in features:
+        hits = jnp.einsum("pgl,nl->pgn", pod["sel_any"], label)
+        group_ok = (hits > 0) | (pod["sel_any_active"][:, :, None] == 0)
+        sel_ok = jnp.all(group_ok, axis=1)                # [P,N]
+        khits = jnp.einsum("pgk,nk->pgn", pod["key_any"], keym)
+        kgroup_ok = (khits > 0) | (pod["key_any_active"][:, :, None] == 0)
+        sel_ok &= jnp.all(kgroup_ok, axis=1)
+        sel_ok &= (pod["sel_forb"] @ label.T) == 0        # NotIn
+        sel_ok &= (pod["key_forb"] @ keym.T) == 0         # DoesNotExist
+        sel_mask = sel_ok & valid
+    else:
+        sel_mask = jnp.broadcast_to(valid, (P, label.shape[0]))
 
-    # taints (TaintToleration + NodeUnschedulable-as-taint)
     hard = (pod["untol_hard"] @ node["taint_mask"].T) == 0
-    # spec.nodeName pin (node_row is a GLOBAL row index)
-    n_idx = offset + jnp.arange(label.shape[0])[None, :]
-    pin = (pod["node_row"][:, None] < 0) | (n_idx == pod["node_row"][:, None])
+    static_mask = sel_mask & hard
+    if "pin" in features:
+        n_idx = offset + jnp.arange(label.shape[0])[None, :]
+        pin = ((pod["node_row"][:, None] < 0)
+               | (n_idx == pod["node_row"][:, None]))
+        static_mask = static_mask & pin
 
-    static_mask = sel_mask & hard & pin
-
-    prefer_cnt = pod["untol_prefer"] @ node["taint_mask"].T   # [P,N]
-    mx = comm.rowmax(prefer_cnt, static_mask, 0.0)
-    static_score = jnp.where(mx > 0, (mx - prefer_cnt) * 100.0 / jnp.maximum(mx, 1.0), 100.0)
+    if "prefer" in features:
+        prefer_cnt = pod["untol_prefer"] @ node["taint_mask"].T   # [P,N]
+        mx = comm.rowmax(prefer_cnt, static_mask, 0.0)
+        static_score = jnp.where(
+            mx > 0, (mx - prefer_cnt) * 100.0 / jnp.maximum(mx, 1.0), 100.0)
+    else:
+        static_score = jnp.zeros((P, 1), jnp.float32)
     return sel_mask, static_mask, static_score
 
 
-def _resource_fit(req: jnp.ndarray, alloc: jnp.ndarray, used: jnp.ndarray,
-                  npods: jnp.ndarray, maxpods: jnp.ndarray) -> jnp.ndarray:
-    """NodeResourcesFit (fit.go:253) for one pod against all nodes: [N]."""
-    fits = jnp.all(req[None, :] <= alloc - used, axis=1)
-    return fits & (npods + 1.0 <= maxpods)
-
-
-def _fit_scores(req_nz: jnp.ndarray, alloc: jnp.ndarray, used_nz: jnp.ndarray
-                ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """LeastAllocated + BalancedAllocation over cpu/mem dims: ([N],[N])."""
-    a = alloc[:, :2]
-    u = (used_nz[:, :2] + req_nz[None, :2])
-    util = jnp.where(a > 0, jnp.minimum(u / jnp.maximum(a, 1.0), 1.0), 1.0)
-    least = jnp.mean((1.0 - util), axis=1) * 100.0
-    mean = jnp.mean(util, axis=1, keepdims=True)
-    std = jnp.sqrt(jnp.mean((util - mean) ** 2, axis=1))
-    balanced = (1.0 - std) * 100.0
+def _fit_scores_vec(req_nz, alloc, used_nz):
+    """LeastAllocated + BalancedAllocation over cpu/mem: [P,N] each.
+    Written as 2-D ops (never materializes [P,N,R]) because the device
+    endpoint prices ops by count/bytes, not FLOPs.  For exactly two
+    resources, std == |u_cpu - u_mem| / 2."""
+    utils = []
+    for r in range(2):
+        a = alloc[None, :, r]
+        u = used_nz[None, :, r] + req_nz[:, None, r]      # [P,N]
+        utils.append(jnp.where(a > 0, jnp.minimum(u / jnp.maximum(a, 1.0), 1.0), 1.0))
+    ucpu, umem = utils
+    least = (2.0 - ucpu - umem) * 50.0
+    balanced = (1.0 - jnp.abs(ucpu - umem) * 0.5) * 100.0
     return least, balanced
 
 
+HARD_KINDS_SERIAL = (C_SPREAD_HARD, C_ANTI_AFFINITY)
+
+
 def make_assign_core(caps: Caps, weights: dict[str, float] | None = None,
-                     axis_name: str | None = None):
-    """The assignment program body.  Call under jit (single device) or
-    inside shard_map with the node axis sharded (parallel/mesh.py)."""
+                     axis_name: str | None = None, mode: str = "wave",
+                     max_waves: int = 128,
+                     features: frozenset = ALL_FEATURES):
     w = {"fit": 1.0, "balanced": 1.0, "spread": 2.0, "affinity": 1.0,
          "taint": 1.0, **(weights or {})}
     comm = _Comm(axis_name)
+    if mode == "scan":
+        return _make_scan_core(caps, w, comm)
+    return _make_wave_core(caps, w, comm, max_waves, features)
+
+
+# ---------------------------------------------------------------------------
+# WAVE solver
+# ---------------------------------------------------------------------------
+
+def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
+                    features: frozenset = ALL_FEATURES):
+    f_ports = "ports" in features
+    f_cons = "constraints" in features
+    f_asg = "asg" in features
+
+    def assign(node: dict, pod: dict) -> dict[str, jnp.ndarray]:
+        n_loc = node["alloc"].shape[0]
+        P = pod["req"].shape[0]
+        offset = comm.my_offset(n_loc)
+        sel_mask, static_mask, static_score = _static_mask_and_score(
+            node, pod, comm, offset, features)
+        # deterministic tie-break noise keyed on (pod, GLOBAL node) so the
+        # result is identical regardless of how the node axis is sharded
+        # (reference: selectHost reservoir sample breaks ties randomly)
+        gn = (offset + jnp.arange(n_loc)).astype(jnp.float32)
+        pp = jnp.arange(P, dtype=jnp.float32)
+        h = jnp.sin(pp[:, None] * 12.9898 + gn[None, :] * 78.233) * 43758.5453
+        noise = (h - jnp.floor(h)) * TIE_NOISE
+        alloc = node["alloc"]
+        dom_sg, dom_asg = node["dom_sg"], node["dom_asg"]
+        req, req_nz = pod["req"], pod["req_nz"]
+        earlier = jnp.tril(jnp.ones((P, P), jnp.float32), k=-1)  # q<p
+        p_iota = jnp.arange(P)
+
+        def wave(state):
+            (used, used_nz, npods, ports, cd_sg, cd_asg,
+             assigned, active, _progress, wcount) = state
+
+            avail = alloc - used                              # [N,R]
+            # per-resource 2-D compares instead of one [P,N,R] broadcast
+            fit = (npods + 1.0 <= node["maxpods"])[None, :]
+            for r in range(caps.r):
+                fit &= req[:, None, r] <= avail[None, :, r]
+            mask = static_mask & fit
+            if f_ports:
+                mask &= (pod["ports"] @ ports.T) == 0         # [P,N]
+
+            if f_asg:
+                # existing anti-affinity groups block
+                adom = jnp.clip(dom_asg, 0)
+                acnt = jnp.take_along_axis(cd_asg, adom, axis=1)  # [ASG,N]
+                acnt = jnp.where(dom_asg >= 0, acnt, 0.0)
+                blocked = (pod["match_asg"] @ (acnt > 0).astype(jnp.float32)) > 0
+                mask &= ~blocked
+
+            least, balanced = _fit_scores_vec(req_nz, alloc, used_nz)
+            score = w["fit"] * least + w["balanced"] * balanced
+            score = score + w["taint"] * static_score
+
+            # constraints
+            boot_flags = []     # [P] per c: relies on bootstrap this wave
+            for c in range(caps.c_cap if f_cons else 0):
+                kind = pod["c_kind"][:, c]                    # [P]
+                sg = jnp.clip(pod["c_sg"][:, c], 0)
+                dom_rows = dom_sg[sg]                         # [P,N]
+                cnt_rows = cd_sg[sg]                          # [P,D]
+                gathered = jnp.where(
+                    dom_rows >= 0,
+                    jnp.take_along_axis(cnt_rows, jnp.clip(dom_rows, 0), axis=1),
+                    0.0)                                      # [P,N]
+                has_dom = dom_rows >= 0
+                active_c = (kind != C_NONE)[:, None]
+
+                elig = sel_mask & has_dom
+                minmatch = comm.rowmin(gathered, elig, jnp.inf)
+                minmatch = jnp.where(jnp.isfinite(minmatch), minmatch, 0.0)
+                total = jnp.sum(cnt_rows, axis=-1, keepdims=True)  # cd replicated
+
+                selfm = pod["c_selfmatch"][:, c:c + 1]
+                maxskew = pod["c_maxskew"][:, c:c + 1]
+                spread_ok = ((gathered + selfm - minmatch) <= maxskew) & has_dom
+                boot = (total[:, 0] == 0) & (selfm[:, 0] > 0)
+                aff_ok = ((gathered > 0) | boot[:, None]) & has_dom
+                anti_ok = jnp.where(has_dom, gathered == 0, True)
+
+                kindb = kind[:, None]
+                ok = jnp.where(kindb == C_SPREAD_HARD, spread_ok,
+                               jnp.where(kindb == C_AFFINITY, aff_ok,
+                                         jnp.where(kindb == C_ANTI_AFFINITY,
+                                                   anti_ok, True)))
+                mask &= ok | ~active_c
+
+                smx = comm.rowmax(gathered, mask, 0.0)
+                smn = comm.rowmin(gathered, mask, jnp.inf)
+                smn = jnp.where(jnp.isfinite(smn), smn, 0.0)
+                rng = jnp.maximum(smx - smn, 1.0)
+                spread_score = (smx - gathered) * 100.0 / rng
+                score += jnp.where(kindb == C_SPREAD_SCORE,
+                                   w["spread"] * spread_score, 0.0)
+                score += jnp.where(kindb == C_PREF_AFFINITY,
+                                   w["affinity"] * pod["c_weight"][:, c:c + 1]
+                                   * gathered, 0.0)
+                boot_flags.append((kind == C_AFFINITY) & boot)
+
+            feasible = mask & active[:, None]
+            has = comm.any_rows(feasible)                     # [P]
+            claims, _ = comm.row_argmax(
+                jnp.where(feasible, score + noise, NEG), n_loc)
+            claims = jnp.where(has, claims, -1)               # global idx
+
+            # ---- conflict resolution (pod/queue order) ----
+            # claims are GLOBAL indices: same-node is a [P,P] outer equality,
+            # no N-sized contraction needed
+            loc_claims = claims - offset
+            in_shard = (loc_claims >= 0) & (loc_claims < n_loc) & has
+            onehot = ((loc_claims[:, None] == jnp.arange(n_loc)[None, :])
+                      & in_shard[:, None]).astype(jnp.float32)  # [P,N] local
+            SN = ((claims[:, None] == claims[None, :])
+                  & has[:, None] & has[None, :]).astype(jnp.float32)
+            E = SN * earlier                                  # earlier same-node
+
+            prefR = E @ req                                   # [P,R]
+            prefN = jnp.sum(E, axis=1)                        # [P]
+            avail_claim = comm.gather_cols(avail.T, claims, offset, n_loc)
+            avail_claim = jnp.moveaxis(avail_claim, -1, 0)    # [P,R]
+            npods_claim = comm.gather_cols(npods, claims, offset, n_loc)
+            maxp_claim = comm.gather_cols(node["maxpods"], claims, offset, n_loc)
+            res_ok = jnp.all(req + prefR <= avail_claim, axis=-1)
+            res_ok &= (npods_claim + prefN + 1.0 <= maxp_claim)
+
+            if f_ports:
+                overlap = (pod["ports"] @ pod["ports"].T) > 0  # [P,P]
+                port_conf = jnp.sum(E * overlap, axis=1) > 0
+            else:
+                port_conf = jnp.zeros(P, bool)
+
+            conf = jnp.zeros(P, bool)
+            both = (has[:, None] & has[None, :]).astype(jnp.float32) * earlier
+            for c in range(caps.c_cap if f_cons else 0):
+                kind = pod["c_kind"][:, c]
+                sg = jnp.clip(pod["c_sg"][:, c], 0)
+                dom_rows = dom_sg[sg]                         # [P,N] local
+                Dpq = comm.gather_cols(dom_rows, claims, offset, n_loc,
+                                       fill=-1.0)             # [P,P]: dom of q's claim under p's sg
+                own = Dpq[p_iota, p_iota][:, None]            # [P,1] p's own domain
+                same_dom = (Dpq == own) & (own >= 0)
+                q_incs = pod["inc_sg"].T[sg]                  # [P,P]: inc of q for p's sg
+                serial = jnp.isin(kind, jnp.array(HARD_KINDS_SERIAL))
+                conf |= serial & (jnp.sum(both * same_dom * q_incs, axis=1) > 0)
+                # affinity bootstrap: serialize against any incrementing q
+                conf |= boot_flags[c] & (jnp.sum(both * q_incs, axis=1) > 0)
+            for a in range(caps.asg_cap if f_asg else 0):
+                dom_a = comm.gather_cols(dom_asg[a], claims, offset, n_loc,
+                                         fill=-1.0)           # [P]
+                same_a = (dom_a[:, None] == dom_a[None, :]) & (dom_a[:, None] >= 0)
+                conf |= (pod["match_asg"][:, a] > 0) & (
+                    jnp.sum(both * same_a * pod["inc_asg"][None, :, a], axis=1) > 0)
+
+            accept = has & active & res_ok & ~port_conf & ~conf
+
+            # ---- commit ----
+            acc_oh = onehot * accept[:, None]                 # [P,N] local rows
+            used = used + acc_oh.T @ req
+            used_nz = used_nz + acc_oh.T @ req_nz
+            npods = npods + jnp.sum(acc_oh, axis=0)
+            if f_ports:
+                ports = jnp.minimum(ports + acc_oh.T @ pod["ports"], 1.0)
+
+            if f_cons:
+                dom_acc = comm.gather_cols(dom_sg, claims, offset, n_loc,
+                                           fill=-1.0)         # [SG,P]
+                w_sg = (pod["inc_sg"].T * accept[None, :] * (dom_acc >= 0))
+                cd_sg = cd_sg.at[jnp.arange(caps.sg_cap)[:, None],
+                                 jnp.clip(dom_acc, 0).astype(jnp.int32)].add(w_sg)
+            if f_asg:
+                dom_acc_a = comm.gather_cols(dom_asg, claims, offset, n_loc,
+                                             fill=-1.0)       # [ASG,P]
+                w_asg = (pod["inc_asg"].T * accept[None, :] * (dom_acc_a >= 0))
+                cd_asg = cd_asg.at[jnp.arange(caps.asg_cap)[:, None],
+                                   jnp.clip(dom_acc_a, 0).astype(jnp.int32)].add(w_asg)
+
+            assigned = jnp.where(accept, claims, assigned)
+            progress = jnp.any(accept)
+            active = active & ~accept & progress  # no progress -> give up
+            return (used, used_nz, npods, ports, cd_sg, cd_asg,
+                    assigned, active, progress, wcount + 1)
+
+        def cond(state):
+            active = state[7]
+            wcount = state[9]
+            return jnp.any(active) & (wcount < max_waves)
+
+        P_assigned = jnp.full((P,), -1, jnp.int32)
+        state0 = (node["used"], node["used_nz"], node["npods"],
+                  node["port_mask"], node["cd_sg"], node["cd_asg"],
+                  P_assigned, pod["p_valid"], jnp.array(True), jnp.array(0))
+        state = lax.while_loop(cond, wave, state0)
+        return {"assignments": state[6], "waves": state[9],
+                "used": state[0], "used_nz": state[1], "npods": state[2],
+                "port_mask": state[3], "cd_sg": state[4], "cd_asg": state[5]}
+
+    return assign
+
+
+# ---------------------------------------------------------------------------
+# SCAN solver (strict sequential semantics; parity oracle)
+# ---------------------------------------------------------------------------
+
+def _make_scan_core(caps: Caps, w: dict, comm: _Comm):
+
+    def _resource_fit(req, alloc, used, npods, maxpods):
+        fits = jnp.all(req[None, :] <= alloc - used, axis=1)
+        return fits & (npods + 1.0 <= maxpods)
+
+    def _fit_scores(req_nz, alloc, used_nz):
+        a = alloc[:, :2]
+        u = (used_nz[:, :2] + req_nz[None, :2])
+        util = jnp.where(a > 0, jnp.minimum(u / jnp.maximum(a, 1.0), 1.0), 1.0)
+        least = jnp.mean((1.0 - util), axis=1) * 100.0
+        mean = jnp.mean(util, axis=1, keepdims=True)
+        std = jnp.sqrt(jnp.mean((util - mean) ** 2, axis=1))
+        return least, (1.0 - std) * 100.0
 
     def assign(node: dict, pod: dict) -> dict[str, jnp.ndarray]:
         n_loc = node["alloc"].shape[0]
         offset = comm.my_offset(n_loc)
         sel_mask, static_mask, static_score = _static_mask_and_score(
             node, pod, comm, offset)
-
         alloc = node["alloc"]
-        dom_sg = node["dom_sg"]          # [SG,N]  (N = local shard)
-        dom_asg = node["dom_asg"]        # [ASG,N]
+        dom_sg, dom_asg = node["dom_sg"], node["dom_asg"]
         n_iota = jnp.arange(n_loc)
 
         def step(carry, xs):
@@ -190,12 +446,10 @@ def make_assign_core(caps: Caps, weights: dict[str, float] | None = None,
 
             mask = p_static_mask
             mask &= _resource_fit(req, alloc, used, npods, node["maxpods"])
-            mask &= (ports @ p_ports) == 0                     # NodePorts
+            mask &= (ports @ p_ports) == 0
 
-            # existing pods' (and earlier batch pods') anti-affinity
-            # blocked[n] = any asg matching this pod with count>0 in n's domain
-            adom = jnp.clip(dom_asg, 0)                        # [ASG,N]
-            acnt = jnp.take_along_axis(cd_asg, adom, axis=1)   # [ASG,N]
+            adom = jnp.clip(dom_asg, 0)
+            acnt = jnp.take_along_axis(cd_asg, adom, axis=1)
             acnt = jnp.where(dom_asg >= 0, acnt, 0.0)
             blocked = (match_asg[:, None] * (acnt > 0)).sum(0) > 0
             mask &= ~blocked
@@ -204,21 +458,20 @@ def make_assign_core(caps: Caps, weights: dict[str, float] | None = None,
             score = w["fit"] * least + w["balanced"] * balanced
             score = score + w["taint"] * p_static_score
 
-            # constraints (unrolled over C; all kinds computed, selected by mask)
             for c in range(caps.c_cap):
                 kind = c_kind[c]
                 sg = jnp.clip(c_sg[c], 0)
-                dom = dom_sg[sg]                               # [N]
-                cnt_row = cd_sg[sg]                            # [D] (replicated)
+                dom = dom_sg[sg]
+                cnt_row = cd_sg[sg]
                 gathered = jnp.where(dom >= 0, cnt_row[jnp.clip(dom, 0)], 0.0)
                 has_dom = dom >= 0
                 active = kind != C_NONE
 
-                # min over domains present among sel-eligible nodes
                 elig = p_sel_mask & has_dom
-                minmatch = comm.min(jnp.where(elig, gathered, jnp.inf))
+                minmatch = jnp.min(jnp.where(elig, gathered, jnp.inf))
+                minmatch = lax.pmin(minmatch, comm.axis) if comm.axis else minmatch
                 minmatch = jnp.where(jnp.isfinite(minmatch), minmatch, 0.0)
-                total = jnp.sum(cnt_row)  # cd replicated: no psum needed
+                total = jnp.sum(cnt_row)
 
                 spread_ok = (gathered + c_selfmatch[c] - minmatch) <= c_maxskew[c]
                 spread_ok &= has_dom
@@ -232,10 +485,11 @@ def make_assign_core(caps: Caps, weights: dict[str, float] | None = None,
                                                    anti_ok, True)))
                 mask &= ok | ~active
 
-                # score kinds: fewer matches better for spread; weighted count
-                # for preferred affinity (sign carried by weight)
-                smx = comm.max(jnp.where(mask, gathered, 0.0))
-                smn = comm.min(jnp.where(mask, gathered, jnp.inf))
+                masked = jnp.where(mask, gathered, 0.0)
+                smx = jnp.max(masked)
+                smx = lax.pmax(smx, comm.axis) if comm.axis else smx
+                smn = jnp.min(jnp.where(mask, gathered, jnp.inf))
+                smn = lax.pmin(smn, comm.axis) if comm.axis else smn
                 smn = jnp.where(jnp.isfinite(smn), smn, 0.0)
                 rng = jnp.maximum(smx - smn, 1.0)
                 spread_score = (smx - gathered) * 100.0 / rng
@@ -245,24 +499,36 @@ def make_assign_core(caps: Caps, weights: dict[str, float] | None = None,
                                    w["affinity"] * c_weight[c] * gathered, 0.0)
 
             feasible = mask & p_valid
-            any_ok = comm.sum(feasible.astype(jnp.int32)) > 0
-            j_global, _best = comm.argmax(jnp.where(feasible, score, NEG), n_loc)
+            nfeas = jnp.sum(feasible.astype(jnp.int32))
+            nfeas = lax.psum(nfeas, comm.axis) if comm.axis else nfeas
+            any_ok = nfeas > 0
+            masked_score = jnp.where(feasible, score, NEG)
+            local_best = jnp.max(masked_score)
+            local_idx = jnp.argmax(masked_score)
+            if comm.axis:
+                best_all = lax.all_gather(local_best, comm.axis)
+                idx_all = lax.all_gather(local_idx, comm.axis)
+                shard = jnp.argmax(best_all)
+                j_global = shard * n_loc + idx_all[shard]
+            else:
+                j_global = local_idx
             j_global = jnp.where(any_ok, j_global, -1)
 
-            # state updates (the in-batch assume()); local one-hot
             local_j = j_global - offset
-            place = (n_iota == local_j) & any_ok               # [N] local
+            place = (n_iota == local_j) & any_ok
             placef = place.astype(jnp.float32)
             used = used + placef[:, None] * req[None, :]
             used_nz = used_nz + placef[:, None] * req_nz[None, :]
             npods = npods + placef
             ports = jnp.minimum(ports + placef[:, None] * p_ports[None, :], 1.0)
 
-            # winning node's domain ids, replicated to all shards
             mine = (local_j >= 0) & (local_j < n_loc) & any_ok
             jj = jnp.clip(local_j, 0, n_loc - 1)
-            d_sg = comm.replicate_from_owner(dom_sg[:, jj], mine)   # [SG]
-            d_asg = comm.replicate_from_owner(dom_asg[:, jj], mine)
+            d_sg = dom_sg[:, jj]
+            d_asg = dom_asg[:, jj]
+            if comm.axis:
+                d_sg = lax.psum((d_sg + 1) * mine.astype(jnp.int32), comm.axis) - 1
+                d_asg = lax.psum((d_asg + 1) * mine.astype(jnp.int32), comm.axis) - 1
             upd_sg = inc_sg * (d_sg >= 0) * any_ok
             cd_sg = cd_sg.at[jnp.arange(caps.sg_cap), jnp.clip(d_sg, 0)].add(upd_sg)
             upd_asg = inc_asg * (d_asg >= 0) * any_ok
@@ -282,6 +548,197 @@ def make_assign_core(caps: Caps, weights: dict[str, float] | None = None,
     return assign
 
 
-def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None):
+def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None,
+                    mode: str = "wave"):
     """Single-device jitted assignment: fn(node, pod) -> dict."""
-    return jax.jit(make_assign_core(caps, weights, axis_name=None))
+    return jax.jit(make_assign_core(caps, weights, axis_name=None, mode=mode))
+
+
+# ---------------------------------------------------------------------------
+# Packed transport + resident device state
+#
+# The axon/TPU transport has ~70ms fixed latency PER host->device buffer, so
+# the per-batch wire format is ONE 1-D f32 buffer: pod floats, pod ints
+# (bitcast), and a bounded row-patch section that reconciles external state
+# changes (deletes/forgets) into the device-resident aggregates.  This is
+# the in-process realization of the north star's "tensorized snapshot delta
+# over a gRPC shim" (BASELINE.json): the shim ships deltas, never the world.
+# ---------------------------------------------------------------------------
+
+STATE_KEYS = ("used", "used_nz", "npods", "port_mask", "cd_sg", "cd_asg")
+SEL_V = 8       # max ids per any-of label group (more -> escape hatch)
+FORB_V = 8      # max forbidden label ids per pod
+KEY_V = 4       # max ids per Exists key group
+
+
+class PackSpec:
+    """Offsets for the single packed pod+patch buffer."""
+
+    def __init__(self, caps: Caps, p_cap: int, k_cap: int):
+        assert caps.t_cap <= 31 and caps.pt_cap <= 31, "bitmask packing caps"
+        assert caps.sg_cap <= 31 and caps.asg_cap <= 31
+        assert caps.g_cap <= 31 and caps.kg_cap <= 31 and caps.kl_cap <= 62
+        self.caps, self.p_cap, self.k_cap = caps, p_cap, k_cap
+        C, G, KG = caps.c_cap, caps.g_cap, caps.kg_cap
+        self.f_f = 2 * caps.r + 3 * C
+        self.f_i = 12 + 2 * C + G * SEL_V + FORB_V + KG * KEY_V
+        self.f_patch = 2 * caps.r + 1 + caps.pt_cap
+        self.a = p_cap * self.f_f
+        self.b = p_cap * self.f_i
+        self.total = self.a + self.b + k_cap + k_cap * self.f_patch
+
+
+def _bits(mask_2d: np.ndarray) -> np.ndarray:
+    """[P,W<=31] 0/1 float -> int32 bitmask [P]."""
+    w = mask_2d.shape[1]
+    return (mask_2d.astype(np.int64) @ (1 << np.arange(w, dtype=np.int64))
+            ).astype(np.int32)
+
+
+def pack_pod_batch(batch, spec: PackSpec,
+                   patch_rows: np.ndarray | None = None,
+                   patch_vals: np.ndarray | None = None) -> np.ndarray:
+    """PodBatch (+ optional row patches) -> single 1-D f32 buffer."""
+    caps, P, K = spec.caps, spec.p_cap, spec.k_cap
+    C, G, KG = caps.c_cap, caps.g_cap, caps.kg_cap
+    pf = np.concatenate([batch.req, batch.req_nz, batch.c_maxskew,
+                         batch.c_selfmatch, batch.c_weight],
+                        axis=1).astype(np.float32)
+    pi = np.zeros((P, spec.f_i), np.int32)
+    pi[:, 0] = _bits(batch.untol_hard)
+    pi[:, 1] = _bits(batch.untol_prefer)
+    pi[:, 2] = _bits(batch.ports)
+    kf = batch.key_forb
+    pi[:, 3] = _bits(kf[:, :31])
+    pi[:, 4] = _bits(kf[:, 31:62]) if kf.shape[1] > 31 else 0
+    pi[:, 5] = _bits(np.minimum(batch.match_asg, 1))
+    pi[:, 6] = _bits(np.minimum(batch.inc_asg, 1))
+    pi[:, 7] = _bits(np.minimum(batch.inc_sg, 1))
+    pi[:, 8] = _bits(batch.sel_any_active)
+    pi[:, 9] = _bits(batch.key_any_active)
+    pi[:, 10] = batch.p_valid.astype(np.int32)
+    pi[:, 11] = batch.node_row
+    o = 12
+    pi[:, o:o + C] = batch.c_kind; o += C
+    pi[:, o:o + C] = batch.c_sg; o += C
+    pi[:, o:o + G * SEL_V] = batch.sel_ids.reshape(P, G * SEL_V); o += G * SEL_V
+    pi[:, o:o + FORB_V] = batch.sel_forb_ids; o += FORB_V
+    pi[:, o:o + KG * KEY_V] = batch.key_ids.reshape(P, KG * KEY_V)
+
+    rows = np.full(K, -1, np.int32)
+    vals = np.zeros((K, spec.f_patch), np.float32)
+    if patch_rows is not None and len(patch_rows):
+        n = min(len(patch_rows), K)
+        rows[:n] = patch_rows[:n]
+        vals[:n] = patch_vals[:n]
+    return np.concatenate([
+        pf.ravel(), pi.view(np.float32).ravel(),
+        rows.view(np.float32), vals.ravel()]).astype(np.float32)
+
+
+def _unpack(buf, spec: PackSpec, features: frozenset = ALL_FEATURES):
+    caps, P, K = spec.caps, spec.p_cap, spec.k_cap
+    C, G, KG = caps.c_cap, caps.g_cap, caps.kg_cap
+    R, L, KL = caps.r, caps.l_cap, caps.kl_cap
+    pf = buf[:spec.a].reshape(P, spec.f_f)
+    pi = lax.bitcast_convert_type(buf[spec.a:spec.a + spec.b],
+                                  jnp.int32).reshape(P, spec.f_i)
+    prow = lax.bitcast_convert_type(
+        buf[spec.a + spec.b:spec.a + spec.b + K], jnp.int32)
+    pval = buf[spec.a + spec.b + K:].reshape(K, spec.f_patch)
+
+    def unbits(word, width):
+        return ((word[:, None] >> jnp.arange(width)) & 1).astype(jnp.float32)
+
+    o = 12
+    c_kind = pi[:, o:o + C]; o += C
+    c_sg = pi[:, o:o + C]; o += C
+    sel_ids = pi[:, o:o + G * SEL_V].reshape(P, G, SEL_V); o += G * SEL_V
+    forb_ids = pi[:, o:o + FORB_V]; o += FORB_V
+    key_ids = pi[:, o:o + KG * KEY_V].reshape(P, KG, KEY_V)
+
+    if "selectors" in features:
+        lid = jnp.arange(L)
+        sel_any = ((sel_ids[:, :, :, None] == lid) &
+                   (sel_ids[:, :, :, None] >= 0)).any(2).astype(jnp.float32)
+        sel_forb = ((forb_ids[:, :, None] == lid) &
+                    (forb_ids[:, :, None] >= 0)).any(1).astype(jnp.float32)
+        kid = jnp.arange(KL)
+        key_any = ((key_ids[:, :, :, None] == kid) &
+                   (key_ids[:, :, :, None] >= 0)).any(2).astype(jnp.float32)
+        kf_bits = jnp.concatenate([unbits(pi[:, 3], min(KL, 31)),
+                                   unbits(pi[:, 4], max(KL - 31, 1))], axis=1)
+        key_forb = kf_bits[:, :KL]
+    else:
+        sel_any = jnp.zeros((P, G, L), jnp.float32)
+        sel_forb = jnp.zeros((P, L), jnp.float32)
+        key_any = jnp.zeros((P, KG, KL), jnp.float32)
+        key_forb = jnp.zeros((P, KL), jnp.float32)
+
+    pod = {
+        "req": pf[:, :R], "req_nz": pf[:, R:2 * R],
+        "c_maxskew": pf[:, 2 * R:2 * R + C],
+        "c_selfmatch": pf[:, 2 * R + C:2 * R + 2 * C],
+        "c_weight": pf[:, 2 * R + 2 * C:2 * R + 3 * C],
+        "untol_hard": unbits(pi[:, 0], caps.t_cap),
+        "untol_prefer": unbits(pi[:, 1], caps.t_cap),
+        "ports": unbits(pi[:, 2], caps.pt_cap),
+        "key_forb": key_forb,
+        "match_asg": unbits(pi[:, 5], caps.asg_cap),
+        "inc_asg": unbits(pi[:, 6], caps.asg_cap),
+        "inc_sg": unbits(pi[:, 7], caps.sg_cap),
+        "sel_any_active": unbits(pi[:, 8], caps.g_cap),
+        "key_any_active": unbits(pi[:, 9], caps.kg_cap),
+        "p_valid": pi[:, 10] > 0,
+        "node_row": pi[:, 11],
+        "c_kind": c_kind, "c_sg": c_sg,
+        "sel_any": sel_any, "sel_forb": sel_forb, "key_any": key_any,
+    }
+    return pod, prow, pval
+
+
+def _apply_patches(state: dict, prow, pval, caps: Caps):
+    """Overwrite patched node rows of the dynamic aggregates (prow=-1 no-op)."""
+    R, PT = caps.r, caps.pt_cap
+    n = state["used"].shape[0]
+    valid = (prow >= 0)
+    r = jnp.clip(prow, 0, n - 1)
+    vf = valid.astype(jnp.float32)[:, None]
+
+    def setrows(arr, new):
+        cur = arr[r]
+        return arr.at[r].add((new - cur) * vf)
+
+    state = dict(state)
+    state["used"] = setrows(state["used"], pval[:, :R])
+    state["used_nz"] = setrows(state["used_nz"], pval[:, R:2 * R])
+    npods_new = pval[:, 2 * R]
+    cur = state["npods"][r]
+    state["npods"] = state["npods"].at[r].add((npods_new - cur) * vf[:, 0])
+    state["port_mask"] = setrows(state["port_mask"], pval[:, 2 * R + 1:])
+    return state
+
+
+def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
+                           weights: dict[str, float] | None = None,
+                           features: frozenset = ALL_FEATURES):
+    """fn(state, static_node, buf) -> (new_state, assignments, waves).
+    `state` is device-resident and donated; `buf` is the single per-batch
+    upload produced by pack_pod_batch.  `features` selects a specialized
+    kernel variant (the backend keeps one per feature set and picks per
+    batch based on what the batch actually uses)."""
+    spec = PackSpec(caps, p_cap, k_cap)
+    core = _make_wave_core(caps, {"fit": 1.0, "balanced": 1.0, "spread": 2.0,
+                                  "affinity": 1.0, "taint": 1.0,
+                                  **(weights or {})}, _Comm(None), 128,
+                           features)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def fn(state, static_node, buf):
+        pod, prow, pval = _unpack(buf, spec, features)
+        state = _apply_patches(state, prow, pval, caps)
+        out = core({**static_node, **state}, pod)
+        new_state = {k: out[k] for k in STATE_KEYS}
+        return new_state, out["assignments"], out["waves"]
+
+    return fn, spec

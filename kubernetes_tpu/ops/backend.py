@@ -1,12 +1,26 @@
 """TPUBatchBackend — the bridge between the scheduler and the device kernel.
 
 This is the in-process equivalent of the BASELINE north star's
-`TPUBatchAssign` plugin + gRPC shim (the shim's wire form lives in
-apiserver/batch_service.py): it drains a batch from the queue (done by
-scheduler.schedule_batch), flattens the snapshot delta into tensors
+`TPUBatchAssign` plugin + gRPC shim: it drains a batch from the queue (done
+by scheduler.schedule_batch), flattens the snapshot delta into tensors
 (ops/flatten.py), runs feasibility+score+assignment on device
-(models/assign.py), and hands back per-pod placements that the scheduler
-feeds through the ordinary assume/Reserve/Permit/bind tail.
+(models/assign.py wave solver), and hands back per-pod placements that the
+scheduler feeds through the ordinary assume/Reserve/Permit/bind tail.
+
+Transport design (the TPU link has ~70ms fixed latency per transfer, so
+round trips are the budget — exactly the regime the north star's gRPC shim
+targets):
+  * node dynamic aggregates (used/npods/ports/domain counts) live ON DEVICE
+    between batches; the kernel returns the updated state and we donate it
+    back in — zero steady-state node-side traffic.
+  * a host numpy mirror replays the kernel's updates; each batch the
+    authoritative snapshot arrays are diffed against the mirror, and rows
+    changed by EXTERNAL events (pod deleted, bind failed/forgotten, node
+    resized) ride a bounded row-patch section of the single packed upload.
+    Mirror mismatch beyond the patch budget or domain-count divergence
+    falls back to a full dynamic refresh.
+  * the pod batch itself is ONE 1-D f32 buffer (ints bitcast), see
+    models/assign.PackSpec.
 
 Escape hatch: pods whose constraints exceed the tensor encoding (vocab
 overflow, Gt/Lt node affinity, nominated preemption, ...) come back with a
@@ -22,92 +36,172 @@ from typing import Sequence
 
 import numpy as np
 
-from ..models.assign import build_assign_fn
+from ..models.assign import (
+    ALL_FEATURES, PLAIN_FEATURES, STATE_KEYS, build_packed_assign_fn,
+    pack_pod_batch,
+)
 from ..scheduler.cache import Snapshot
 from ..scheduler.scheduler import BatchBackend
 from ..scheduler.types import SKIP, UNSCHEDULABLE, PodInfo, Status
-from .flatten import BatchEncoder, Caps, ClusterTensors, VocabFullError
+from .flatten import BatchEncoder, Caps, ClusterTensors, PodBatch, VocabFullError
 
 logger = logging.getLogger(__name__)
 
-ESCAPE_STATUS_CODE = SKIP  # scheduler routes SKIP results to schedule_one
+DYN_FIELDS = ("used", "used_nz", "npods", "port_mask")
 
 
 class TPUBatchBackend(BatchBackend):
     def __init__(self, caps: Caps | None = None, batch_size: int = 256,
-                 weights: dict[str, float] | None = None):
+                 weights: dict[str, float] | None = None, k_cap: int = 1024):
         self.caps = caps or Caps()
         self.batch_size = batch_size
         self.tensors = ClusterTensors(self.caps)
         self.encoder = BatchEncoder(self.tensors, batch_size)
-        self._assign = build_assign_fn(self.caps, weights)
-        self._device_node: dict | None = None
-        self._device_version = -1
+        self._fn, self._spec = build_packed_assign_fn(
+            self.caps, batch_size, k_cap, weights)
+        self._weights = weights
+        self._fn_plain = None  # built lazily on first plain batch
+        self._k_cap = k_cap
         self._lock = threading.Lock()
+        # device-resident state + host replay mirror
+        self._state = None          # dict of device arrays (STATE_KEYS)
+        self._static_node = None    # dict of device arrays (rarely changes)
+        self._static_version = -1
+        self._mirror: dict[str, np.ndarray] | None = None
+        self.stats = {"batches": 0, "full_refresh": 0, "patched_rows": 0,
+                      "waves": 0}
+
+    # -- device sync -----------------------------------------------------
+
+    def _upload_static(self) -> None:
+        import jax.numpy as jnp
+        t = self.tensors
+        self._static_node = {
+            "alloc": jnp.asarray(t.alloc), "maxpods": jnp.asarray(t.maxpods),
+            "valid": jnp.asarray(t.valid),
+            "taint_mask": jnp.asarray(t.taint_mask),
+            "label_mask": jnp.asarray(t.label_mask),
+            "key_mask": jnp.asarray(t.key_mask),
+            "dom_sg": jnp.asarray(t.dom_sg), "dom_asg": jnp.asarray(t.dom_asg),
+        }
+        self._static_version = t.static_version
+
+    def _full_refresh(self, cd_sg: np.ndarray, cd_asg: np.ndarray) -> None:
+        import jax.numpy as jnp
+        t = self.tensors
+        self._state = {
+            "used": jnp.asarray(t.used), "used_nz": jnp.asarray(t.used_nz),
+            "npods": jnp.asarray(t.npods),
+            "port_mask": jnp.asarray(t.port_mask),
+            "cd_sg": jnp.asarray(cd_sg), "cd_asg": jnp.asarray(cd_asg),
+        }
+        self._mirror = {
+            "used": t.used.copy(), "used_nz": t.used_nz.copy(),
+            "npods": t.npods.copy(), "port_mask": t.port_mask.copy(),
+            "cd_sg": cd_sg.copy(), "cd_asg": cd_asg.copy(),
+        }
+        self.stats["full_refresh"] += 1
+
+    def _diff_patches(self, dirty_rows) -> tuple[np.ndarray, np.ndarray] | None:
+        """Rows where authoritative != mirror. None -> too many (refresh)."""
+        t, m = self.tensors, self._mirror
+        rows = []
+        for r in dirty_rows:
+            if (not np.array_equal(t.used[r], m["used"][r])
+                    or not np.array_equal(t.used_nz[r], m["used_nz"][r])
+                    or t.npods[r] != m["npods"][r]
+                    or not np.array_equal(t.port_mask[r], m["port_mask"][r])):
+                rows.append(r)
+        if len(rows) > self._k_cap:
+            return None
+        if not rows:
+            return np.empty(0, np.int32), np.empty((0, self._spec.f_patch),
+                                                   np.float32)
+        rows_a = np.asarray(rows, np.int32)
+        vals = np.concatenate([
+            t.used[rows_a], t.used_nz[rows_a], t.npods[rows_a][:, None],
+            t.port_mask[rows_a]], axis=1).astype(np.float32)
+        # bring the mirror in line with what the device will hold
+        for f in DYN_FIELDS:
+            m[f][rows_a] = getattr(t, f)[rows_a]
+        return rows_a, vals
+
+    def _replay(self, batch: PodBatch, assignments: np.ndarray) -> None:
+        """Apply the kernel's commit rules to the host mirror."""
+        t, m = self.tensors, self._mirror
+        for p in range(min(len(assignments), self.batch_size)):
+            row = int(assignments[p])
+            if row < 0:
+                continue
+            m["used"][row] += batch.req[p]
+            m["used_nz"][row] += batch.req_nz[p]
+            m["npods"][row] += 1.0
+            np.minimum(m["port_mask"][row] + batch.ports[p], 1.0,
+                       out=m["port_mask"][row])
+            for sg in range(len(t.sgs)):
+                if batch.inc_sg[p, sg] > 0:
+                    d = t.dom_sg[sg, row]
+                    if d >= 0:
+                        m["cd_sg"][sg, d] += 1.0
+            for a in range(len(t.asgs)):
+                if batch.inc_asg[p, a] > 0:
+                    d = t.dom_asg[a, row]
+                    if d >= 0:
+                        m["cd_asg"][a, d] += 1.0
+
+    def _pick_variant(self, batch: PodBatch):
+        """The device endpoint has high per-op overhead, so batches that use
+        no selectors/constraints/ports/pins (the common case) run a kernel
+        with those code paths elided (models/assign PLAIN_FEATURES)."""
+        t = self.tensors
+        if (t.sgs or t.asgs or batch.c_kind.any() or batch.sel_any_active.any()
+                or batch.key_any_active.any() or batch.sel_forb.any()
+                or batch.key_forb.any() or batch.ports.any()
+                or batch.untol_prefer.any() or (batch.node_row >= 0).any()):
+            return self._fn
+        if self._fn_plain is None:
+            self._fn_plain, _ = build_packed_assign_fn(
+                self.caps, self.batch_size, self._k_cap, self._weights,
+                features=PLAIN_FEATURES)
+        self.stats["plain"] = self.stats.get("plain", 0) + 1
+        return self._fn_plain
 
     # -- BatchBackend ----------------------------------------------------
 
     def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot
                ) -> list[tuple[int | None, Status | None]]:
-        import jax.numpy as jnp
-
         with self._lock:
             try:
-                self.tensors.update_from_snapshot(snapshot)
+                dirty_rows = self.tensors.update_from_snapshot_tracked(snapshot)
                 batch = self.encoder.encode(list(pod_infos))
             except VocabFullError as e:
-                logger.warning("tensorization overflow (%s); whole batch -> oracle path", e)
+                logger.warning("tensorization overflow (%s); batch -> oracle path", e)
                 return [(None, Status(SKIP, str(e)))] * len(pod_infos)
 
-            cd_sg, cd_asg = self.tensors.domain_base_counts()
-            if self._device_version != self.tensors.static_version:
-                t = self.tensors
-                self._device_node = {
-                    "alloc": jnp.asarray(t.alloc),
-                    "maxpods": jnp.asarray(t.maxpods),
-                    "valid": jnp.asarray(t.valid),
-                    "taint_mask": jnp.asarray(t.taint_mask),
-                    "label_mask": jnp.asarray(t.label_mask),
-                    "key_mask": jnp.asarray(t.key_mask),
-                    "dom_sg": jnp.asarray(t.dom_sg),
-                    "dom_asg": jnp.asarray(t.dom_asg),
-                }
-                self._device_version = self.tensors.static_version
-            node = dict(self._device_node)
-            # dynamic state always re-uploaded: the snapshot is authoritative
-            # (it already includes pods assumed by previous batches)
-            node["used"] = jnp.asarray(self.tensors.used)
-            node["used_nz"] = jnp.asarray(self.tensors.used_nz)
-            node["npods"] = jnp.asarray(self.tensors.npods)
-            node["port_mask"] = jnp.asarray(self.tensors.port_mask)
-            node["cd_sg"] = jnp.asarray(cd_sg)
-            node["cd_asg"] = jnp.asarray(cd_asg)
+            if self._static_version != self.tensors.static_version:
+                self._upload_static()
 
-            pod = {
-                "req": jnp.asarray(batch.req),
-                "req_nz": jnp.asarray(batch.req_nz),
-                "p_valid": jnp.asarray(batch.p_valid),
-                "untol_hard": jnp.asarray(batch.untol_hard),
-                "untol_prefer": jnp.asarray(batch.untol_prefer),
-                "sel_any": jnp.asarray(batch.sel_any),
-                "sel_any_active": jnp.asarray(batch.sel_any_active),
-                "sel_forb": jnp.asarray(batch.sel_forb),
-                "key_any": jnp.asarray(batch.key_any),
-                "key_any_active": jnp.asarray(batch.key_any_active),
-                "key_forb": jnp.asarray(batch.key_forb),
-                "ports": jnp.asarray(batch.ports),
-                "node_row": jnp.asarray(batch.node_row),
-                "c_kind": jnp.asarray(batch.c_kind),
-                "c_sg": jnp.asarray(batch.c_sg),
-                "c_maxskew": jnp.asarray(batch.c_maxskew),
-                "c_selfmatch": jnp.asarray(batch.c_selfmatch),
-                "c_weight": jnp.asarray(batch.c_weight),
-                "inc_sg": jnp.asarray(batch.inc_sg),
-                "inc_asg": jnp.asarray(batch.inc_asg),
-                "match_asg": jnp.asarray(batch.match_asg),
-            }
-            out = self._assign(node, pod)
-            assignments = np.asarray(out["assignments"])
+            cd_sg, cd_asg = self.tensors.domain_base_counts()
+            patches = None
+            if self._state is not None:
+                if (np.array_equal(cd_sg, self._mirror["cd_sg"])
+                        and np.array_equal(cd_asg, self._mirror["cd_asg"])):
+                    patches = self._diff_patches(dirty_rows)
+            if self._state is None or patches is None:
+                self._full_refresh(cd_sg, cd_asg)
+                patches = (np.empty(0, np.int32),
+                           np.empty((0, self._spec.f_patch), np.float32))
+            self.stats["patched_rows"] += len(patches[0])
+
+            buf = pack_pod_batch(batch, self._spec, patches[0], patches[1])
+            import jax.numpy as jnp
+            fn = self._pick_variant(batch)
+            self._state, assignments_dev, waves = fn(
+                self._state, self._static_node, jnp.asarray(buf))
+            assignments = np.asarray(assignments_dev)
+            self.stats["batches"] += 1
+            self.stats["waves"] += int(waves)
+            self._replay(batch, assignments)
 
         escapes = set(batch.escape)
         results: list[tuple[int | None, Status | None]] = []

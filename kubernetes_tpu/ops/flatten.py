@@ -109,11 +109,13 @@ class SelectorGroup:
 class Caps:
     """Static tensor capacities. All jitted shapes derive from these."""
 
+    # caps marked (packed) are bounded by the bitmask wire format
+    # (models/assign.PackSpec): <=31 for one-word masks, kl_cap <= 62.
     n_cap: int = 1024          # node rows
     l_cap: int = 512           # label (key,value) vocab
-    kl_cap: int = 128          # label key vocab
-    t_cap: int = 32            # taint vocab
-    pt_cap: int = 32           # host-port vocab
+    kl_cap: int = 62           # label key vocab (packed)
+    t_cap: int = 31            # taint vocab (packed)
+    pt_cap: int = 31           # host-port vocab (packed)
     s_cap: int = 5             # scalar resource slots
     sg_cap: int = 16           # selector groups (spread/affinity counts)
     asg_cap: int = 16          # anti-affinity groups of existing pods
@@ -246,7 +248,11 @@ class ClusterTensors:
 
     def update_from_snapshot(self, snapshot: Snapshot) -> bool:
         """Incremental refresh; returns True if anything changed."""
-        changed = False
+        return bool(self.update_from_snapshot_tracked(snapshot))
+
+    def update_from_snapshot_tracked(self, snapshot: Snapshot) -> list[int]:
+        """Incremental refresh; returns the rows re-encoded this call."""
+        dirty: list[int] = []
         live = set()
         for ni in snapshot.node_info_list:
             live.add(ni.name)
@@ -261,7 +267,7 @@ class ClusterTensors:
             if self.gen[row] != ni.generation:
                 self._encode_node(row, ni)
                 self.gen[row] = ni.generation
-                changed = True
+                dirty.append(row)
         for name in list(self.row_of):
             if name not in live:
                 row = self.row_of.pop(name)
@@ -269,10 +275,10 @@ class ClusterTensors:
                 self.node_infos[row] = None
                 self._free.append(row)
                 self.static_version += 1
-                changed = True
-        if changed:
+                dirty.append(row)
+        if dirty:
             self.version += 1
-        return changed
+        return dirty
 
     def _encode_resource(self, out: np.ndarray, res) -> None:
         out[0] = res.milli_cpu
@@ -430,6 +436,11 @@ class PodBatch:
     inc_sg: np.ndarray         # f32[P, SG]  assigning pod p bumps sg counts
     inc_asg: np.ndarray        # f32[P, ASG] pod carries this anti group
     match_asg: np.ndarray      # f32[P, ASG] pod's labels match this anti group
+    # id-based duals of the dense selector arrays (for packed transport;
+    # -1 padded; see models/assign.PackSpec)
+    sel_ids: np.ndarray = None        # i32[P, G, 8]
+    sel_forb_ids: np.ndarray = None   # i32[P, 8]
+    key_ids: np.ndarray = None        # i32[P, KG, 4]
     escape: list[int] = field(default_factory=list)  # batch positions for oracle path
 
 
@@ -466,6 +477,9 @@ class BatchEncoder:
             inc_sg=np.zeros((P, c.sg_cap), np.float32),
             inc_asg=np.zeros((P, c.asg_cap), np.float32),
             match_asg=np.zeros((P, c.asg_cap), np.float32),
+            sel_ids=np.full((P, c.g_cap, 8), -1, np.int32),
+            sel_forb_ids=np.full((P, 8), -1, np.int32),
+            key_ids=np.full((P, c.kg_cap, 4), -1, np.int32),
         )
         for i, pi in enumerate(pod_infos[:P]):
             try:
@@ -492,6 +506,16 @@ class BatchEncoder:
                             and term.namespaces == asg.namespaces):
                         b.inc_asg[i, asg_idx] += 1.0
         return b
+
+    @staticmethod
+    def _push_id(arr: np.ndarray, i: int, lid: int) -> bool:
+        """Append lid into the -1-padded id row arr[i]; False if full."""
+        row = arr[i]
+        for v in range(row.shape[0]):
+            if row[v] < 0:
+                row[v] = lid
+                return True
+        return False
 
     # returns False -> escape to oracle path
     def _encode_pod(self, b: PodBatch, i: int, pi: PodInfo) -> bool:
@@ -533,13 +557,19 @@ class BatchEncoder:
         if len(groups) > c.g_cap or len(key_groups) > c.kg_cap:
             return False
         for g, ids in enumerate(groups):
+            if len(ids) > b.sel_ids.shape[2]:
+                return False  # any-of group too wide for packed transport
             b.sel_any_active[i, g] = 1.0
-            for lid in ids:
+            for v, lid in enumerate(ids):
                 b.sel_any[i, g, lid] = 1.0
+                b.sel_ids[i, g, v] = lid
         for g, ids in enumerate(key_groups):
+            if len(ids) > b.key_ids.shape[2]:
+                return False
             b.key_any_active[i, g] = 1.0
-            for kid in ids:
+            for v, kid in enumerate(ids):
                 b.key_any[i, g, kid] = 1.0
+                b.key_ids[i, g, v] = kid
         if pi.node_affinity_preferred:
             return False  # node-affinity scoring: oracle path (rare)
 
@@ -613,8 +643,12 @@ class BatchEncoder:
                     key_groups.append([t.ensure_key_id(req.key)])
                 elif req.operator == NOT_IN:
                     for v in req.values:
-                        b.sel_forb[i, t.ensure_label_id((req.key, v))] = 1.0
+                        lid = t.ensure_label_id((req.key, v))
+                        b.sel_forb[i, lid] = 1.0
+                        if not self._push_id(b.sel_forb_ids, i, lid):
+                            return False
                 elif req.operator == DOES_NOT_EXIST:
+                    # key_forb travels as a dense bitmask; no id list needed
                     b.key_forb[i, t.ensure_key_id(req.key)] = 1.0
                 else:  # Gt/Lt
                     return False

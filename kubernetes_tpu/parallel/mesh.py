@@ -65,7 +65,10 @@ def build_sharded_assign_fn(caps: Caps, mesh: Mesh,
     fn = jax.shard_map(
         core, mesh=mesh,
         in_specs=(node_specs(axis), pod_specs()),
-        out_specs={"assignments": P(), "used": P(axis, None), "npods": P(axis)},
+        out_specs={"assignments": P(), "waves": P(),
+                   "used": P(axis, None), "used_nz": P(axis, None),
+                   "npods": P(axis), "port_mask": P(axis, None),
+                   "cd_sg": P(), "cd_asg": P()},
         check_vma=False,
     )
     return jax.jit(fn)

@@ -282,7 +282,11 @@ class TestOracleParity:
             infos = [PodInfo(p) for p in pods]
             results = backend.assign(infos, snap)
 
-            # replay placements through the oracle, in order
+            # replay placements through the oracle (resource feasibility is
+            # additive, so replay order is irrelevant); refusals are checked
+            # against the FINAL state — the wave solver keeps a pod pending
+            # until a wave makes no progress, i.e. refusal means "infeasible
+            # given everything that got placed"
             cache = Cache()
             for n in nodes:
                 cache.add_node(n)
@@ -297,8 +301,8 @@ class TestOracleParity:
                     bound["spec"] = dict(pi.pod["spec"], nodeName=name)
                     cache.add_pod(bound)
                     snap2 = cache.update_snapshot(snap2)
-                else:
-                    # batch says unschedulable: oracle must agree on every node
+            for pi, (row, status) in zip(infos, results):
+                if row is None:
                     assert status is not None
                     for ni in snap2.list():
                         assert insufficient_resources(pi, ni), \
