@@ -298,6 +298,9 @@ class Framework:
                 self.bind.append(p)
             if isinstance(p, PostBindPlugin):
                 self.post_bind.append(p)
+        for p in plugins:  # late-bind plugins that need the framework itself
+            if hasattr(p, "set_framework"):
+                p.set_framework(self)
 
     def cluster_event_map(self) -> dict[str, list[ClusterEvent]]:
         return {p.name: p.events_to_register() for p in self.all_plugins}

@@ -7,7 +7,9 @@ apis/config/v1/default_plugins.go:28-56 (default enablement + weights).
 from __future__ import annotations
 
 from ..framework import Handle, Plugin, Registry
+from .coscheduling import Coscheduling
 from .defaultbinder import DefaultBinder
+from .defaultpreemption import DefaultPreemption
 from .interpodaffinity import InterPodAffinity
 from .nodebasic import (
     ImageLocality, NodeAffinity, NodeName, NodePorts, NodeUnschedulable,
@@ -47,6 +49,8 @@ def in_tree_registry() -> Registry:
         "PodTopologySpread": lambda args, h: PodTopologySpread(),
         "InterPodAffinity": lambda args, h: InterPodAffinity(),
         "DefaultBinder": lambda args, h: DefaultBinder(h.client),
+        "DefaultPreemption": lambda args, h: DefaultPreemption(h.client),
+        "Coscheduling": lambda args, h: Coscheduling(h.client, h),
     }
 
 
@@ -62,6 +66,7 @@ DEFAULT_PLUGINS = [
     "InterPodAffinity",
     "NodeResourcesBalancedAllocation",
     "ImageLocality",
+    "DefaultPreemption",
     "DefaultBinder",
 ]
 

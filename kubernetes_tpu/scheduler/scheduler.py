@@ -121,6 +121,10 @@ class Scheduler:
             cluster_event_map=event_map)
         for p in profiles.values():
             p.framework.handle.nominator = self.queue.nominator
+            for plugin in p.framework.post_filter:
+                if hasattr(plugin, "_snapshot_getter"):
+                    plugin._snapshot_getter = (
+                        lambda s=self: getattr(s, "_snapshot", None))
         self._stop = threading.Event()
         self._binder_pool = ThreadPoolExecutor(max_workers=16,
                                                thread_name_prefix="bind")
@@ -249,6 +253,13 @@ class Scheduler:
         try:
             node_name = self._scheduling_cycle(fw, profile, state, qpi)
         except FitError as fe:
+            # PostFilter: preemption (schedule_one.go:128 RunPostFilterPlugins)
+            nominated = None
+            if fw.post_filter:
+                nominated, _ps = fw.run_post_filter_plugins(
+                    state, qpi.pod_info, fe.diagnosis.node_to_status)
+                if nominated:
+                    self.queue.nominator.add_nominated_pod(qpi.pod_info, nominated)
             self._handle_failure(fw, qpi, Status(UNSCHEDULABLE, fe.message()),
                                  cycle, fe.diagnosis.unschedulable_plugins, start)
             return

@@ -1,0 +1,104 @@
+"""Gang-scheduling tests (BASELINE config #4: all-or-nothing binding)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODGROUPS, PODS
+from kubernetes_tpu.scheduler import Profile, Scheduler, new_default_framework
+from kubernetes_tpu.scheduler.plugins import DEFAULT_PLUGINS
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+GANG_PLUGINS = DEFAULT_PLUGINS[:-1] + ["Coscheduling", "DefaultBinder"]
+
+
+@pytest.fixture
+def cluster():
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    fw = new_default_framework(client, factory, enabled=GANG_PLUGINS)
+    sched = Scheduler(client, factory, {"default-scheduler": Profile(fw)})
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    yield store, client, sched
+    sched.stop()
+    factory.stop()
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def bound_count(client, group):
+    items, _ = client.list(PODS)
+    return sum(1 for p in items
+               if meta.labels(p).get("scheduling.x-k8s.io/pod-group") == group
+               and meta.pod_node_name(p))
+
+
+def make_group(client, name, min_member, timeout=5):
+    pg = meta.new_object("PodGroup", name, "default")
+    pg["spec"] = {"minMember": min_member, "scheduleTimeoutSeconds": timeout}
+    client.create(PODGROUPS, pg)
+
+
+def gang_pod(name, group, cpu="100m"):
+    return (make_pod(name).labels(**{"scheduling.x-k8s.io/pod-group": group})
+            .req(cpu=cpu).build())
+
+
+class TestCoscheduling:
+    def test_gang_binds_together(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="4", mem="8Gi").build())
+        make_group(client, "g1", 3)
+        for i in range(3):
+            client.create(PODS, gang_pod(f"g1-{i}", "g1"))
+        assert wait_for(lambda: bound_count(client, "g1") == 3, timeout=15)
+
+    def test_partial_gang_never_binds(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="4", mem="8Gi").build())
+        make_group(client, "g2", 3, timeout=1)
+        for i in range(2):  # only 2 of 3 members exist
+            client.create(PODS, gang_pod(f"g2-{i}", "g2"))
+        time.sleep(1.5)
+        assert bound_count(client, "g2") == 0
+
+    def test_gang_completes_when_member_arrives(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="4", mem="8Gi").build())
+        make_group(client, "g3", 3)
+        for i in range(2):
+            client.create(PODS, gang_pod(f"g3-{i}", "g3"))
+        time.sleep(0.4)
+        assert bound_count(client, "g3") == 0
+        client.create(PODS, gang_pod("g3-2", "g3"))
+        assert wait_for(lambda: bound_count(client, "g3") == 3, timeout=15)
+
+    def test_group_status_updated(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="4", mem="8Gi").build())
+        make_group(client, "g4", 2)
+        for i in range(2):
+            client.create(PODS, gang_pod(f"g4-{i}", "g4"))
+        assert wait_for(lambda: bound_count(client, "g4") == 2, timeout=15)
+        assert wait_for(lambda: (client.get(PODGROUPS, "default", "g4")
+                                 .get("status") or {}).get("phase") == "Scheduled")
+
+    def test_non_gang_pods_unaffected(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").build())
+        client.create(PODS, make_pod("plain").build())
+        assert wait_for(lambda: meta.pod_node_name(
+            client.get(PODS, "default", "plain")) == "n1")

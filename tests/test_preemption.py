@@ -1,0 +1,111 @@
+"""Preemption tests (reference: defaultpreemption/default_preemption_test.go
++ test/integration/scheduler preemption suites, reduced)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PDBS, PODS
+from kubernetes_tpu.scheduler import new_scheduler
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+@pytest.fixture
+def cluster():
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    sched = new_scheduler(client, factory)
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    yield store, client, sched
+    sched.stop()
+    factory.stop()
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def node_of(client, name):
+    try:
+        return meta.pod_node_name(client.get(PODS, "default", name)) or None
+    except kv.NotFoundError:
+        return None
+
+
+class TestPreemption:
+    def test_high_priority_preempts_low(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        client.create(PODS, make_pod("low").priority(1).req(cpu="800m").build())
+        assert wait_for(lambda: node_of(client, "low") == "n1")
+        client.create(PODS, make_pod("high").priority(100).req(cpu="800m").build())
+        # low gets evicted, high lands on n1
+        assert wait_for(lambda: node_of(client, "high") == "n1", timeout=20)
+        assert node_of(client, "low") is None
+
+    def test_no_preemption_of_equal_priority(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        client.create(PODS, make_pod("a").priority(50).req(cpu="800m").build())
+        assert wait_for(lambda: node_of(client, "a") == "n1")
+        client.create(PODS, make_pod("b").priority(50).req(cpu="800m").build())
+        time.sleep(0.5)
+        assert node_of(client, "a") == "n1"   # not evicted
+        assert node_of(client, "b") is None
+
+    def test_minimal_victim_set(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="2", mem="4Gi").build())
+        client.create(PODS, make_pod("small").priority(1).req(cpu="500m").build())
+        client.create(PODS, make_pod("big").priority(1).req(cpu="1200m").build())
+        assert wait_for(lambda: node_of(client, "small") == "n1"
+                        and node_of(client, "big") == "n1")
+        # needs 1 cpu; evicting just "big" suffices (reprieve spares "small")
+        client.create(PODS, make_pod("high").priority(100).req(cpu="1").build())
+        assert wait_for(lambda: node_of(client, "high") == "n1", timeout=20)
+        assert node_of(client, "small") == "n1"
+        assert node_of(client, "big") is None
+
+    def test_preemption_policy_never(self, cluster):
+        store, client, sched = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        client.create(PODS, make_pod("low").priority(1).req(cpu="800m").build())
+        assert wait_for(lambda: node_of(client, "low") == "n1")
+        p = make_pod("polite").priority(100).req(cpu="800m").build()
+        p["spec"]["preemptionPolicy"] = "Never"
+        client.create(PODS, p)
+        time.sleep(0.5)
+        assert node_of(client, "low") == "n1"
+        assert node_of(client, "polite") is None
+
+    def test_pdb_respected_in_candidate_ranking(self, cluster):
+        store, client, sched = cluster
+        # two nodes, each with one victim; n1's victim is PDB-protected
+        client.create(NODES, make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        client.create(NODES, make_node("n2").capacity(cpu="1", mem="2Gi").build())
+        v1 = make_pod("v1").priority(1).req(cpu="800m").labels(app="guarded").build()
+        v1["spec"]["nodeName"] = "n1"
+        client.create(PODS, v1)
+        v2 = make_pod("v2").priority(1).req(cpu="800m").labels(app="free").build()
+        v2["spec"]["nodeName"] = "n2"
+        client.create(PODS, v2)
+        pdb = meta.new_object("PodDisruptionBudget", "guard", "default")
+        pdb["spec"] = {"selector": {"matchLabels": {"app": "guarded"}}}
+        pdb["status"] = {"disruptionsAllowed": 0}
+        client.create(PDBS, pdb)
+        time.sleep(0.2)
+        client.create(PODS, make_pod("high").priority(100).req(cpu="800m").build())
+        assert wait_for(lambda: node_of(client, "high") == "n2", timeout=20)
+        assert node_of(client, "v1") == "n1"   # PDB-protected victim spared
+        assert node_of(client, "v2") is None
