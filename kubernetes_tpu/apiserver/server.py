@@ -1,0 +1,289 @@
+"""REST+watch API server over the store.
+
+Reference: the kube-apiserver serving stack, reduced to its load-bearing
+contract (SURVEY.md layers 4-5):
+  staging/src/k8s.io/apiserver/pkg/endpoints/installer.go:190 (routes)
+    GET    /api/v1/{resource}                       list (all namespaces)
+    GET    /api/v1/namespaces/{ns}/{resource}       list
+    GET    /api/v1/namespaces/{ns}/{resource}/{nm}  get
+    POST   /api/v1/namespaces/{ns}/{resource}       create
+    PUT    /api/v1/namespaces/{ns}/{resource}/{nm}  update (CAS -> 409)
+    DELETE /api/v1/namespaces/{ns}/{resource}/{nm}  delete
+    GET    ...?watch=true&resourceVersion=N         newline-delimited JSON
+                                                    event stream
+  plus /healthz /readyz /version /metrics, and a minimal handler chain
+  (request log -> authn stub -> admission hooks -> registry), mirroring
+  DefaultBuildHandlerChain (server/config.go:813) in shape.
+
+Cluster-scoped resources (nodes, ...) use ns="-" internally; the routes
+also accept /api/v1/{resource}/{name} for them.
+
+Errors are metav1.Status-shaped JSON with the right HTTP codes
+(404/409/410 Gone for compacted watches).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .. import __version__
+from ..api import meta
+from ..store import kv
+
+logger = logging.getLogger(__name__)
+
+CLUSTER_SCOPED = {"nodes", "persistentvolumes", "namespaces", "priorityclasses",
+                  "storageclasses", "csinodes"}
+
+# admission hook: fn(verb, resource, obj) -> obj (mutate) or raise AdmissionError
+AdmissionHook = "callable"
+
+
+class AdmissionError(Exception):
+    pass
+
+
+def status_error(code: int, reason: str, message: str) -> dict:
+    return {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": reason, "message": message, "code": code}
+
+
+class APIServer:
+    def __init__(self, store: kv.MemoryStore, host: str = "127.0.0.1",
+                 port: int = 0, token: str | None = None):
+        self.store = store
+        self.token = token
+        self.admission_hooks: list = []
+        self.metrics = {"requests_total": 0, "watch_streams": 0}
+        self._metrics_lock = threading.Lock()
+        handler = self._make_handler()
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="apiserver", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.httpd.server_address[0]}:{self.port}"
+
+    # -- request handling ------------------------------------------------
+
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through logging
+                logger.debug("apiserver: " + fmt, *args)
+
+            def _send_json(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authn(self) -> bool:
+                if server.token is None:
+                    return True
+                auth = self.headers.get("Authorization", "")
+                if auth == f"Bearer {server.token}":
+                    return True
+                self._send_json(401, status_error(401, "Unauthorized",
+                                                  "invalid bearer token"))
+                return False
+
+            def _route(self):
+                """-> (resource, ns, name, query) or None after writing error."""
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                q = parse_qs(u.query)
+                if not parts or parts[0] not in ("api",):
+                    return None, None, None, q, u.path
+                # /api/v1/...
+                rest = parts[2:] if len(parts) > 1 else []
+                ns = name = None
+                resource = None
+                if len(rest) >= 2 and rest[0] == "namespaces" and len(rest) >= 3:
+                    ns, resource = rest[1], rest[2]
+                    name = rest[3] if len(rest) > 3 else None
+                elif rest:
+                    resource = rest[0]
+                    name = rest[1] if len(rest) > 1 else None
+                return resource, ns, name, q, u.path
+
+            # ---- verbs ----
+
+            def do_GET(self):
+                with server._metrics_lock:
+                    server.metrics["requests_total"] += 1
+                if not self._authn():
+                    return
+                path = urlparse(self.path).path
+                if path == "/healthz" or path == "/readyz" or path == "/livez":
+                    self._send_json(200, {"status": "ok"})
+                    return
+                if path == "/version":
+                    self._send_json(200, {"gitVersion": f"v{__version__}",
+                                          "platform": "tpu"})
+                    return
+                if path == "/metrics":
+                    with server._metrics_lock:
+                        lines = [f"apiserver_{k} {v}"
+                                 for k, v in server.metrics.items()]
+                    body = ("\n".join(lines) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                resource, ns, name, q, _ = self._route()
+                if resource is None:
+                    self._send_json(404, status_error(404, "NotFound", path))
+                    return
+                try:
+                    if q.get("watch", ["false"])[0] == "true":
+                        self._serve_watch(resource, q)
+                    elif name is not None:
+                        obj = server.store.get(resource, ns or "", name)
+                        self._send_json(200, obj)
+                    else:
+                        items, rv = server.store.list(resource, ns)
+                        self._send_json(200, {
+                            "kind": "List", "apiVersion": "v1",
+                            "metadata": {"resourceVersion": str(rv)},
+                            "items": items})
+                except kv.NotFoundError as e:
+                    self._send_json(404, status_error(404, "NotFound", str(e)))
+                except kv.TooOldError as e:
+                    self._send_json(410, status_error(410, "Expired", str(e)))
+
+            def _serve_watch(self, resource: str, q) -> None:
+                since = int(q.get("resourceVersion", ["0"])[0] or 0)
+                w = server.store.watch(resource, since_rv=since)
+                with server._metrics_lock:
+                    server.metrics["watch_streams"] += 1
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while True:
+                        ev = w.next(timeout=5.0)
+                        if ev is None:
+                            if w.stopped:
+                                break
+                            payload = {"type": kv.BOOKMARK,
+                                       "object": {"metadata": {}}}
+                        else:
+                            payload = {"type": ev.type, "object": ev.object}
+                        data = (json.dumps(payload) + "\n").encode()
+                        self.wfile.write(f"{len(data):x}\r\n".encode()
+                                         + data + b"\r\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    w.stop()
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+                self.close_connection = True
+
+            def _read_body(self) -> dict | None:
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    return json.loads(self.rfile.read(length))
+                except (json.JSONDecodeError, ValueError):
+                    self._send_json(400, status_error(400, "BadRequest",
+                                                      "invalid JSON body"))
+                    return None
+
+            def _admit(self, verb: str, resource: str, obj: dict) -> dict | None:
+                for hook in server.admission_hooks:
+                    try:
+                        obj = hook(verb, resource, obj) or obj
+                    except AdmissionError as e:
+                        self._send_json(400, status_error(
+                            400, "AdmissionDenied", str(e)))
+                        return None
+                return obj
+
+            def do_POST(self):
+                with server._metrics_lock:
+                    server.metrics["requests_total"] += 1
+                if not self._authn():
+                    return
+                resource, ns, name, q, path = self._route()
+                if resource is None:
+                    self._send_json(404, status_error(404, "NotFound", path))
+                    return
+                obj = self._read_body()
+                if obj is None:
+                    return
+                if ns and "metadata" in obj:
+                    obj["metadata"].setdefault("namespace", ns)
+                obj = self._admit("CREATE", resource, obj)
+                if obj is None:
+                    return
+                try:
+                    self._send_json(201, server.store.create(resource, obj))
+                except kv.AlreadyExistsError as e:
+                    self._send_json(409, status_error(409, "AlreadyExists", str(e)))
+
+            def do_PUT(self):
+                with server._metrics_lock:
+                    server.metrics["requests_total"] += 1
+                if not self._authn():
+                    return
+                resource, ns, name, q, path = self._route()
+                if resource is None or name is None:
+                    self._send_json(404, status_error(404, "NotFound", path))
+                    return
+                obj = self._read_body()
+                if obj is None:
+                    return
+                obj = self._admit("UPDATE", resource, obj)
+                if obj is None:
+                    return
+                try:
+                    self._send_json(200, server.store.update(resource, obj))
+                except kv.NotFoundError as e:
+                    self._send_json(404, status_error(404, "NotFound", str(e)))
+                except kv.ConflictError as e:
+                    self._send_json(409, status_error(409, "Conflict", str(e)))
+
+            def do_DELETE(self):
+                with server._metrics_lock:
+                    server.metrics["requests_total"] += 1
+                if not self._authn():
+                    return
+                resource, ns, name, q, path = self._route()
+                if resource is None or name is None:
+                    self._send_json(404, status_error(404, "NotFound", path))
+                    return
+                try:
+                    self._send_json(200, server.store.delete(resource, ns or "", name))
+                except kv.NotFoundError as e:
+                    self._send_json(404, status_error(404, "NotFound", str(e)))
+
+        return Handler

@@ -1,0 +1,181 @@
+"""HTTP client speaking to the REST apiserver.
+
+Reference: staging/src/k8s.io/client-go rest.Client + the watch decoder
+(tools/watch). Implements the same Client interface as LocalClient, so
+informers/controllers/schedulers run identically in-process or over HTTP.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+from typing import Callable
+
+from ..api import meta
+from ..api.meta import Obj
+from ..store import kv
+from .clientset import Client
+
+_ERRORS = {404: kv.NotFoundError, 409: kv.ConflictError, 410: kv.TooOldError}
+
+
+class HTTPError(kv.StoreError):
+    def __init__(self, code: int, message: str):
+        self.code = code
+        super().__init__(f"HTTP {code}: {message}")
+
+
+def _raise_for(code: int, body: dict) -> None:
+    msg = body.get("message", "")
+    if body.get("reason") == "AlreadyExists":
+        raise kv.AlreadyExistsError(msg)
+    err = _ERRORS.get(code)
+    if err is not None:
+        raise err(msg)
+    raise HTTPError(code, msg)
+
+
+class HTTPWatch:
+    """Consumes the newline-delimited JSON watch stream; quacks like kv.Watch."""
+
+    def __init__(self, host: str, port: int, path: str,
+                 headers: dict[str, str]):
+        self._conn = http.client.HTTPConnection(host, port)
+        self._conn.request("GET", path, headers=headers)
+        self._resp = self._conn.getresponse()
+        if self._resp.status != 200:
+            body = json.loads(self._resp.read() or b"{}")
+            self._conn.close()
+            _raise_for(self._resp.status, body)
+        self._buf = b""
+        self._stopped = False
+        self._lock = threading.Lock()
+
+    def next(self, timeout: float | None = None):
+        if self._stopped:
+            return None
+        sock = self._resp.fp.raw._sock if hasattr(self._resp.fp, "raw") else None
+        try:
+            if sock is not None:
+                sock.settimeout(timeout)
+            while True:
+                line = self._resp.readline()
+                if not line:
+                    self._stopped = True
+                    return None
+                try:
+                    payload = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if payload.get("type") == kv.BOOKMARK:
+                    return None  # heartbeat; caller polls again
+                return kv.WatchEvent(
+                    payload["type"], payload["object"],
+                    meta.resource_version(payload["object"]))
+        except (TimeoutError, OSError):
+            if self._stopped:
+                return None
+            return None
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._stopped:
+                self._stopped = True
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+
+class HTTPClient(Client):
+    def __init__(self, host: str, port: int, token: str | None = None,
+                 cluster_scoped: frozenset[str] = frozenset(
+                     {"nodes", "persistentvolumes", "namespaces",
+                      "priorityclasses", "storageclasses", "csinodes"})):
+        self.host, self.port = host, port
+        self._headers = {"Content-Type": "application/json"}
+        if token:
+            self._headers["Authorization"] = f"Bearer {token}"
+        self._cluster_scoped = cluster_scoped
+        self._local = threading.local()
+
+    @classmethod
+    def from_url(cls, url: str, token: str | None = None) -> "HTTPClient":
+        hostport = url.split("//", 1)[-1].rstrip("/")
+        host, _, port = hostport.partition(":")
+        return cls(host, int(port or 80), token)
+
+    # -- plumbing --------------------------------------------------------
+
+    def _conn(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._local.conn = http.client.HTTPConnection(
+                self.host, self.port)
+        return conn
+
+    def _request(self, method: str, path: str, body: Obj | None = None) -> dict:
+        payload = json.dumps(body) if body is not None else None
+        for attempt in range(2):  # retry once on stale keep-alive conns
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=payload, headers=self._headers)
+                resp = conn.getresponse()
+                data = json.loads(resp.read() or b"{}")
+                break
+            except (http.client.HTTPException, OSError):
+                self._local.conn = None
+                if attempt:
+                    raise
+        if resp.status >= 400:
+            _raise_for(resp.status, data)
+        return data
+
+    def _path(self, resource: str, namespace: str | None = None,
+              name: str | None = None) -> str:
+        if resource in self._cluster_scoped or not namespace:
+            p = f"/api/v1/{resource}"
+        else:
+            p = f"/api/v1/namespaces/{namespace}/{resource}"
+        return p + (f"/{name}" if name else "")
+
+    # -- Client ----------------------------------------------------------
+
+    def create(self, resource: str, obj: Obj) -> Obj:
+        return self._request("POST", self._path(resource, meta.namespace(obj)),
+                             obj)
+
+    def get(self, resource: str, namespace: str, name: str) -> Obj:
+        return self._request("GET", self._path(resource, namespace, name))
+
+    def update(self, resource: str, obj: Obj) -> Obj:
+        return self._request(
+            "PUT", self._path(resource, meta.namespace(obj), meta.name(obj)),
+            obj)
+
+    def guaranteed_update(self, resource: str, namespace: str, name: str,
+                          fn: Callable[[Obj], Obj], max_retries: int = 16) -> Obj:
+        for _ in range(max_retries):
+            cur = self.get(resource, namespace, name)
+            try:
+                return self.update(resource, fn(meta.deep_copy(cur)))
+            except kv.ConflictError:
+                continue
+        raise kv.ConflictError(f"{resource} {namespace}/{name}: too many CAS retries")
+
+    def delete(self, resource: str, namespace: str, name: str) -> Obj:
+        return self._request("DELETE", self._path(resource, namespace, name))
+
+    def list(self, resource: str, namespace: str | None = None
+             ) -> tuple[list[Obj], int]:
+        data = self._request("GET", self._path(resource, namespace))
+        return data.get("items", []), int(data["metadata"]["resourceVersion"])
+
+    def watch(self, resource: str, since_rv: int = 0):
+        path = self._path(resource) + f"?watch=true&resourceVersion={since_rv}"
+        return HTTPWatch(self.host, self.port, path, self._headers)

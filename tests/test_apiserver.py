@@ -1,0 +1,153 @@
+"""API server + HTTP client tests, ending in a full scheduler-over-HTTP
+integration (informers watching via chunked streams)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import AdmissionError, APIServer
+from kubernetes_tpu.client import SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.client.http_client import HTTPClient
+from kubernetes_tpu.scheduler import new_scheduler
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+@pytest.fixture
+def api():
+    store = kv.MemoryStore()
+    server = APIServer(store).start()
+    client = HTTPClient("127.0.0.1", server.port)
+    yield store, server, client
+    server.stop()
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestREST:
+    def test_crud_roundtrip(self, api):
+        store, server, client = api
+        created = client.create(PODS, make_pod("p1").build())
+        assert meta.uid(created)
+        got = client.get(PODS, "default", "p1")
+        assert meta.name(got) == "p1"
+        got = meta.deep_copy(got)
+        got["spec"]["nodeName"] = "nx"
+        updated = client.update(PODS, got)
+        assert updated["spec"]["nodeName"] == "nx"
+        items, rv = client.list(PODS)
+        assert len(items) == 1 and rv >= 2
+        client.delete(PODS, "default", "p1")
+        with pytest.raises(kv.NotFoundError):
+            client.get(PODS, "default", "p1")
+
+    def test_cluster_scoped_nodes(self, api):
+        store, server, client = api
+        client.create(NODES, make_node("n1").build())
+        assert meta.name(client.get(NODES, "", "n1")) == "n1"
+        items, _ = client.list(NODES)
+        assert len(items) == 1
+
+    def test_conflict_on_stale_update(self, api):
+        store, server, client = api
+        created = client.create(PODS, make_pod("p").build())
+        stale = meta.deep_copy(created)
+        fresh = meta.deep_copy(created)
+        fresh["metadata"]["labels"] = {"v": "2"}
+        client.update(PODS, fresh)
+        stale["metadata"]["labels"] = {"v": "stale"}
+        with pytest.raises(kv.ConflictError):
+            client.update(PODS, stale)
+
+    def test_duplicate_create(self, api):
+        store, server, client = api
+        client.create(PODS, make_pod("p").build())
+        with pytest.raises(kv.AlreadyExistsError):
+            client.create(PODS, make_pod("p").build())
+
+    def test_watch_stream(self, api):
+        store, server, client = api
+        w = client.watch(PODS)
+        time.sleep(0.1)
+        client.create(PODS, make_pod("w1").build())
+        deadline = time.time() + 5
+        ev = None
+        while ev is None and time.time() < deadline:
+            ev = w.next(timeout=1.0)
+        assert ev is not None and ev.type == kv.ADDED
+        assert meta.name(ev.object) == "w1"
+        w.stop()
+
+    def test_watch_from_rv_replays(self, api):
+        store, server, client = api
+        client.create(PODS, make_pod("a").build())
+        _, rv = client.list(PODS)
+        client.create(PODS, make_pod("b").build())
+        w = client.watch(PODS, since_rv=rv)
+        ev = None
+        deadline = time.time() + 5
+        while ev is None and time.time() < deadline:
+            ev = w.next(timeout=1.0)
+        assert meta.name(ev.object) == "b"
+        w.stop()
+
+    def test_admission_hook(self, api):
+        store, server, client = api
+
+        def deny_bad(verb, resource, obj):
+            if meta.name(obj).startswith("bad"):
+                raise AdmissionError("name denied")
+            obj.setdefault("metadata", {}).setdefault(
+                "labels", {})["admitted"] = "yes"
+            return obj
+
+        server.admission_hooks.append(deny_bad)
+        ok = client.create(PODS, make_pod("good").build())
+        assert meta.labels(ok)["admitted"] == "yes"
+        with pytest.raises(kv.StoreError):
+            client.create(PODS, make_pod("bad").build())
+
+    def test_healthz_and_version(self, api):
+        store, server, client = api
+        assert client._request("GET", "/healthz")["status"] == "ok"
+        assert client._request("GET", "/version")["platform"] == "tpu"
+
+    def test_auth_token(self):
+        store = kv.MemoryStore()
+        server = APIServer(store, token="s3cret").start()
+        try:
+            anon = HTTPClient("127.0.0.1", server.port)
+            with pytest.raises(kv.StoreError):
+                anon.list(PODS)
+            authed = HTTPClient("127.0.0.1", server.port, token="s3cret")
+            assert authed.list(PODS)[0] == []
+        finally:
+            server.stop()
+
+
+class TestSchedulerOverHTTP:
+    def test_full_pipeline(self, api):
+        """informers -> reflector -> queue -> bind, all over real HTTP."""
+        store, server, client = api
+        factory = SharedInformerFactory(client)
+        sched = new_scheduler(client, factory)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        sched.run()
+        try:
+            client.create(NODES, make_node("n1").build())
+            client.create(PODS, make_pod("p1").req(cpu="100m").build())
+            assert wait_for(lambda: meta.pod_node_name(
+                client.get(PODS, "default", "p1")) == "n1", timeout=15)
+        finally:
+            sched.stop()
+            factory.stop()
