@@ -168,17 +168,23 @@ class SharedInformerFactory:
         self.client = client
         self._lock = threading.Lock()
         self._informers: dict[str, Informer] = {}
+        self._started = False
 
     def informer(self, resource: str) -> Informer:
         with self._lock:
             inf = self._informers.get(resource)
             if inf is None:
                 inf = self._informers[resource] = Informer(self.client, resource)
+                if self._started:
+                    # factory already running: late informers start eagerly
+                    # (client-go restarts the factory; we just start the one)
+                    inf.start()
             return inf
 
     def start(self) -> None:
         with self._lock:
             informers = list(self._informers.values())
+            self._started = True
         for inf in informers:
             inf.start()
 

@@ -1,0 +1,92 @@
+"""Controller skeleton: informer events -> rate-limited workqueue ->
+N sync workers -> idempotent sync(key).
+
+Reference: the canonical controller pattern (SURVEY.md §3.4):
+pkg/controller/replicaset/replica_set.go:528,533 (worker/processNextWorkItem)
+— informer handlers enqueue keys, workers pop, sync, forget on success /
+rate-limited requeue on error.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..api import meta
+from ..api.meta import Obj
+from ..client.clientset import Client
+from ..client.informer import SharedInformerFactory
+from ..client.workqueue import RateLimitingQueue
+
+logger = logging.getLogger(__name__)
+
+
+class Controller:
+    name = "controller"
+    workers = 2
+    max_requeues = 15
+
+    def __init__(self, client: Client, factory: SharedInformerFactory):
+        self.client = client
+        self.factory = factory
+        self.queue = RateLimitingQueue()
+        self._threads: list[threading.Thread] = []
+        self._stopped = threading.Event()
+
+    # subclasses wire informers in __init__ and implement sync()
+    def sync(self, key: str) -> None:
+        raise NotImplementedError
+
+    def enqueue(self, obj: Obj) -> None:
+        self.queue.add(meta.namespaced_name(obj))
+
+    def enqueue_key(self, key: str) -> None:
+        self.queue.add(key)
+
+    def run(self) -> None:
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"{self.name}-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.queue.shut_down()
+
+    def _worker(self) -> None:
+        while True:
+            key, shutdown = self.queue.get()
+            if shutdown:
+                return
+            try:
+                self.sync(key)
+            except Exception:  # noqa: BLE001 - controller must survive
+                if self.queue.rate_limiter.num_requeues(key) < self.max_requeues:
+                    logger.exception("%s: sync(%s) failed; requeueing",
+                                     self.name, key)
+                    self.queue.add_rate_limited(key)
+                else:
+                    logger.exception("%s: sync(%s) failed too often; dropping",
+                                     self.name, key)
+                    self.queue.forget(key)
+            else:
+                self.queue.forget(key)
+            finally:
+                self.queue.done(key)
+
+
+def split_key(key: str) -> tuple[str, str]:
+    ns, _, name = key.partition("/")
+    return (ns, name) if name else ("", ns)
+
+
+def owner_ref(obj: Obj, kind: str) -> Obj:
+    return {"apiVersion": "v1", "kind": kind, "name": meta.name(obj),
+            "uid": meta.uid(obj), "controller": True,
+            "blockOwnerDeletion": True}
+
+
+def is_owned_by(obj: Obj, owner: Obj) -> bool:
+    ref = meta.controller_ref(obj)
+    return ref is not None and ref.get("uid") == meta.uid(owner)

@@ -1,0 +1,78 @@
+"""Garbage collector: cascading deletion via ownerReferences.
+
+Reference: pkg/controller/garbagecollector/ — the dependency graph builder
+watches everything; when an owner disappears its dependents are deleted
+(background cascading).  Reduced: we track the (kind -> resource) pairs the
+framework serves, index dependents by owner uid, and delete orphans whose
+controller owner no longer exists.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import meta
+from ..api.meta import Obj
+from ..client.clientset import DEPLOYMENTS, JOBS, PODS, REPLICASETS
+from ..store import kv
+from .base import Controller, split_key
+
+logger = logging.getLogger(__name__)
+
+KIND_TO_RESOURCE = {"ReplicaSet": REPLICASETS, "Deployment": DEPLOYMENTS,
+                    "Job": JOBS, "Pod": PODS}
+WATCHED = [PODS, REPLICASETS, JOBS]
+
+
+class GarbageCollector(Controller):
+    name = "garbagecollector"
+
+    def __init__(self, client, factory):
+        super().__init__(client, factory)
+        self._informers = {}
+        for res in WATCHED:
+            inf = factory.informer(res)
+            self._informers[res] = inf
+            inf.add_event_handler(
+                lambda t, obj, old, res=res: self.enqueue_key(
+                    f"{res}|{meta.namespaced_name(obj)}"))
+        # owner kinds we must watch for deletions to re-check dependents
+        for res in (REPLICASETS, DEPLOYMENTS, JOBS):
+            factory.informer(res).add_event_handler(self._on_owner_event)
+
+    def _on_owner_event(self, type_: str, obj: Obj, old) -> None:
+        if type_ != kv.DELETED:
+            return
+        # owner gone: enqueue all dependents
+        uid = meta.uid(obj)
+        for res, inf in self._informers.items():
+            for dep in inf.list():
+                ref = meta.controller_ref(dep)
+                if ref and ref.get("uid") == uid:
+                    self.enqueue_key(f"{res}|{meta.namespaced_name(dep)}")
+
+    def sync(self, key: str) -> None:
+        res, _, nsname = key.partition("|")
+        ns, name = split_key(nsname)
+        inf = self._informers.get(res)
+        obj = inf.get(ns, name) if inf else None
+        if obj is None:
+            return
+        ref = meta.controller_ref(obj)
+        if ref is None:
+            return
+        owner_res = KIND_TO_RESOURCE.get(ref.get("kind"))
+        if owner_res is None:
+            return
+        owner_ns = ns if owner_res != "nodes" else ""
+        try:
+            owner = self.client.get(owner_res, owner_ns, ref["name"])
+            if meta.uid(owner) != ref.get("uid"):
+                raise kv.NotFoundError("uid mismatch (owner recreated)")
+        except kv.NotFoundError:
+            logger.info("gc: deleting orphan %s/%s (owner %s/%s gone)",
+                        res, nsname, ref.get("kind"), ref.get("name"))
+            try:
+                self.client.delete(res, ns, name)
+            except kv.NotFoundError:
+                pass

@@ -1,0 +1,105 @@
+"""Job controller.
+
+Reference: pkg/controller/job/ — syncJob: keep `parallelism` active pods
+until `completions` pods have Succeeded; failed pods are retried up to
+backoffLimit; on completion set the Complete condition, on exhaustion
+Failed.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+
+from ..api import meta
+from ..api.meta import Obj
+from ..client.clientset import JOBS, PODS
+from ..store import kv
+from .base import Controller, is_owned_by, owner_ref, split_key
+from .replicaset import pod_is_active
+
+logger = logging.getLogger(__name__)
+
+
+class JobController(Controller):
+    name = "job"
+
+    def __init__(self, client, factory):
+        super().__init__(client, factory)
+        self.job_informer = factory.informer(JOBS)
+        self.pod_informer = factory.informer(PODS)
+        self.job_informer.add_event_handler(
+            lambda t, obj, old: self.enqueue(obj))
+        self.pod_informer.add_event_handler(self._on_pod)
+
+    def _on_pod(self, type_, pod: Obj, old) -> None:
+        ref = meta.controller_ref(pod)
+        if ref and ref.get("kind") == "Job":
+            self.enqueue_key(f"{meta.namespace(pod)}/{ref['name']}")
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        job = self.job_informer.get(ns, name)
+        if job is None:
+            return
+        spec = job.get("spec") or {}
+        completions = spec.get("completions", 1)
+        parallelism = spec.get("parallelism", 1)
+        backoff_limit = spec.get("backoffLimit", 6)
+
+        owned = [p for p in self.pod_informer.list(ns) if is_owned_by(p, job)]
+        succeeded = sum(1 for p in owned
+                        if (p.get("status") or {}).get("phase") == "Succeeded")
+        failed = sum(1 for p in owned
+                     if (p.get("status") or {}).get("phase") == "Failed")
+        active = [p for p in owned if pod_is_active(p)]
+
+        conds = (job.get("status") or {}).get("conditions") or []
+        done = any(c.get("type") in ("Complete", "Failed") for c in conds)
+
+        if not done:
+            if succeeded >= completions:
+                conds = [{"type": "Complete", "status": "True"}]
+                for p in active:  # completions reached: reap stragglers
+                    try:
+                        self.client.delete(PODS, ns, meta.name(p))
+                    except kv.NotFoundError:
+                        pass
+                active = []
+            elif failed > backoff_limit:
+                conds = [{"type": "Failed", "status": "True",
+                          "reason": "BackoffLimitExceeded"}]
+            else:
+                want_active = min(parallelism, completions - succeeded)
+                for _ in range(want_active - len(active)):
+                    self._create_pod(job)
+
+        status = {"active": len(active), "succeeded": succeeded,
+                  "failed": failed, "conditions": conds}
+        if (job.get("status") or {}) != status:
+            def patch(o):
+                o["status"] = status
+                return o
+            try:
+                self.client.guaranteed_update(JOBS, ns, name, patch)
+            except kv.NotFoundError:
+                pass
+
+    def _create_pod(self, job: Obj) -> None:
+        tmpl = (job.get("spec") or {}).get("template") or {}
+        ns = meta.namespace(job)
+        pod = meta.new_object("Pod", f"{meta.name(job)}-{uuid.uuid4().hex[:5]}", ns)
+        tmpl_meta = tmpl.get("metadata") or {}
+        pod["metadata"]["labels"] = dict(tmpl_meta.get("labels") or {})
+        if tmpl_meta.get("annotations"):
+            pod["metadata"]["annotations"] = dict(tmpl_meta["annotations"])
+        pod["metadata"]["ownerReferences"] = [owner_ref(job, "Job")]
+        pod["spec"] = meta.deep_copy(tmpl.get("spec") or {"containers": [
+            {"name": "c0", "image": "img"}]})
+        pod["spec"].setdefault("restartPolicy", "Never")
+        pod["spec"].setdefault("schedulerName", "default-scheduler")
+        try:
+            self.client.create(PODS, pod)
+        except kv.AlreadyExistsError:
+            pass

@@ -1,0 +1,78 @@
+"""Controller manager: runs the controller fleet behind leader election.
+
+Reference: cmd/kube-controller-manager/app/controllermanager.go:425-467 —
+one process, shared informer factory, leader-elected, each controller with
+its own workqueue + workers.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..client.clientset import Client
+from ..client.informer import SharedInformerFactory
+from ..client.leaderelection import LeaderElector
+from .deployment import DeploymentController
+from .garbagecollector import GarbageCollector
+from .job import JobController
+from .nodelifecycle import NodeLifecycleController
+from .replicaset import ReplicaSetController
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "garbagecollector",
+                       "nodelifecycle")
+
+
+class ControllerManager:
+    def __init__(self, client: Client, factory: SharedInformerFactory,
+                 controllers: tuple[str, ...] = DEFAULT_CONTROLLERS,
+                 leader_elect: bool = False, identity: str | None = None):
+        self.client = client
+        self.factory = factory
+        self.controllers: dict[str, object] = {}
+        ctors = {
+            "deployment": DeploymentController,
+            "replicaset": ReplicaSetController,
+            "job": JobController,
+            "garbagecollector": GarbageCollector,
+            "nodelifecycle": NodeLifecycleController,
+        }
+        for name in controllers:
+            self.controllers[name] = ctors[name](client, factory)
+        self._elector: LeaderElector | None = None
+        self._leader_elect = leader_elect
+        self._identity = identity
+        self._running = False
+
+    def run(self) -> None:
+        if self._leader_elect:
+            self._elector = LeaderElector(
+                self.client, "kube-controller-manager",
+                identity=self._identity,
+                on_started_leading=self._start_all,
+                on_stopped_leading=self._stop_all)
+            self._elector.run()
+        else:
+            self._start_all()
+
+    def _start_all(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        for name, c in self.controllers.items():
+            logger.info("starting controller %s", name)
+            c.run()
+
+    def _stop_all(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        for c in self.controllers.values():
+            c.stop()
+
+    def stop(self) -> None:
+        if self._elector:
+            self._elector.stop()
+        self._stop_all()

@@ -1,0 +1,4 @@
+"""Node agent: hollow kubelet + fake CRI (reference: pkg/kubelet, kubemark)."""
+
+from .cri import FakeRuntimeService  # noqa: F401
+from .hollow import HollowKubelet, start_hollow_nodes  # noqa: F401

@@ -1,0 +1,124 @@
+"""Fake CRI runtime.
+
+Reference: the CRI contract (staging/src/k8s.io/cri-api/pkg/apis/runtime/v1/
+api.proto — RunPodSandbox/StopPodSandbox/RemovePodSandbox, CreateContainer/
+StartContainer/StopContainer/RemoveContainer, ListContainers, PullImage...)
+and the kubemark fake (pkg/kubelet/cri/remote/fake/): an in-process
+implementation that tracks sandbox/container state machines without running
+anything.  Method names follow the proto rpcs; this is the seam where a
+real gRPC runtime (containerd) would plug in.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+SANDBOX_READY = "SANDBOX_READY"
+SANDBOX_NOTREADY = "SANDBOX_NOTREADY"
+
+CREATED = "CONTAINER_CREATED"
+RUNNING = "CONTAINER_RUNNING"
+EXITED = "CONTAINER_EXITED"
+
+
+class FakeRuntimeService:
+    def __init__(self, start_latency: float = 0.0):
+        self._lock = threading.Lock()
+        self._sandboxes: dict[str, dict] = {}
+        self._containers: dict[str, dict] = {}
+        self._images: set[str] = set()
+        self.start_latency = start_latency
+
+    # -- RuntimeService --------------------------------------------------
+
+    def run_pod_sandbox(self, config: dict) -> str:
+        if self.start_latency:
+            time.sleep(self.start_latency)
+        sid = uuid.uuid4().hex[:12]
+        with self._lock:
+            self._sandboxes[sid] = {"id": sid, "state": SANDBOX_READY,
+                                    "config": config,
+                                    "createdAt": time.time()}
+        return sid
+
+    def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        with self._lock:
+            sb = self._sandboxes.get(sandbox_id)
+            if sb:
+                sb["state"] = SANDBOX_NOTREADY
+            for c in self._containers.values():
+                if c["sandboxId"] == sandbox_id and c["state"] == RUNNING:
+                    c["state"] = EXITED
+                    c["exitCode"] = 137
+
+    def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        with self._lock:
+            self._sandboxes.pop(sandbox_id, None)
+            self._containers = {cid: c for cid, c in self._containers.items()
+                                if c["sandboxId"] != sandbox_id}
+
+    def create_container(self, sandbox_id: str, config: dict) -> str:
+        cid = uuid.uuid4().hex[:12]
+        with self._lock:
+            self._containers[cid] = {
+                "id": cid, "sandboxId": sandbox_id, "state": CREATED,
+                "name": config.get("name", ""), "image": config.get("image", ""),
+                "config": config, "createdAt": time.time(), "exitCode": None,
+            }
+        return cid
+
+    def start_container(self, container_id: str) -> None:
+        if self.start_latency:
+            time.sleep(self.start_latency)
+        with self._lock:
+            c = self._containers[container_id]
+            c["state"] = RUNNING
+            c["startedAt"] = time.time()
+            # hollow semantics: a container may declare it exits by itself
+            run_for = (c["config"].get("annotations") or {}).get("hollow/run-seconds")
+            if run_for is not None:
+                c["exitAt"] = c["startedAt"] + float(run_for)
+                c["plannedExitCode"] = int(
+                    (c["config"].get("annotations") or {}).get("hollow/exit-code", 0))
+
+    def stop_container(self, container_id: str, timeout: float = 0) -> None:
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c and c["state"] == RUNNING:
+                c["state"] = EXITED
+                c["exitCode"] = 137
+
+    def remove_container(self, container_id: str) -> None:
+        with self._lock:
+            self._containers.pop(container_id, None)
+
+    def list_containers(self, sandbox_id: str | None = None) -> list[dict]:
+        with self._lock:
+            self._advance_clock()
+            return [dict(c) for c in self._containers.values()
+                    if sandbox_id is None or c["sandboxId"] == sandbox_id]
+
+    def pod_sandbox_status(self, sandbox_id: str) -> dict | None:
+        with self._lock:
+            sb = self._sandboxes.get(sandbox_id)
+            return dict(sb) if sb else None
+
+    def _advance_clock(self) -> None:
+        now = time.time()
+        for c in self._containers.values():
+            if c["state"] == RUNNING and c.get("exitAt") and now >= c["exitAt"]:
+                c["state"] = EXITED
+                c["exitCode"] = c.get("plannedExitCode", 0)
+
+    # -- ImageService ----------------------------------------------------
+
+    def pull_image(self, image: str) -> str:
+        with self._lock:
+            self._images.add(image)
+        return image
+
+    def list_images(self) -> list[str]:
+        with self._lock:
+            return sorted(self._images)

@@ -1,0 +1,216 @@
+"""Hollow kubelet — the node agent with a fake runtime.
+
+Reference: pkg/kubelet (syncLoop, kubelet.go:2019) driven through the
+kubemark hollow-node shape (pkg/kubemark/hollow_kubelet.go:87): real
+kubelet logic, fake CRI, fake cadvisor.  The loop here is event-driven off
+the pod informer (ADD/UPDATE/DELETE -> per-pod sync, kubelet
+syncLoopIteration) plus a PLEG-like relist that surfaces container exits
+(pkg/kubelet/pleg/generic.go), and a heartbeat loop renewing the node
+Lease + status (kubelet nodestatus + nodelease).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..api import meta
+from ..api.meta import Obj
+from ..api.resources import make_resource_list
+from ..client.clientset import LEASES, NODES, PODS, Client
+from ..client.informer import SharedInformerFactory
+from ..store import kv
+from .cri import EXITED, RUNNING, FakeRuntimeService
+
+logger = logging.getLogger(__name__)
+
+LEASE_NS = "kube-node-lease"
+
+
+class HollowKubelet:
+    def __init__(self, client: Client, factory: SharedInformerFactory,
+                 node_name: str, cpu: str = "32", memory: str = "256Gi",
+                 pods: int = 110, labels: dict[str, str] | None = None,
+                 heartbeat_interval: float = 10.0,
+                 runtime: FakeRuntimeService | None = None):
+        self.client = client
+        self.node_name = node_name
+        self.cpu, self.memory, self.max_pods = cpu, memory, pods
+        self.labels = labels or {}
+        self.heartbeat_interval = heartbeat_interval
+        self.runtime = runtime or FakeRuntimeService()
+        self.pod_informer = factory.informer(PODS)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # pod uid -> {"sandbox": id, "containers": {name: id}}
+        self._pod_state: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "HollowKubelet":
+        self._register_node()
+        self.pod_informer.add_event_handler(self._on_pod_event)
+        for target, name in ((self._heartbeat_loop, "heartbeat"),
+                             (self._pleg_loop, "pleg")):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"kubelet-{self.node_name}-{name}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- node registration + heartbeats ----------------------------------
+
+    def _register_node(self) -> None:
+        rl = make_resource_list(
+            cpu_milli=int(float(self.cpu) * 1000),
+            mem=self._mem_bytes(), pods=self.max_pods)
+        node = meta.new_object("Node", self.node_name, None)
+        node["metadata"]["labels"] = {
+            "kubernetes.io/hostname": self.node_name, **self.labels}
+        node["spec"] = {}
+        node["status"] = {
+            "capacity": rl, "allocatable": rl,
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "nodeInfo": {"kubeletVersion": "hollow", "architecture": "tpu"},
+            "lastHeartbeatTime": time.time(),
+        }
+        try:
+            self.client.create(NODES, node)
+        except kv.AlreadyExistsError:
+            pass
+        lease = meta.new_object("Lease", self.node_name, LEASE_NS)
+        lease["spec"] = {"holderIdentity": self.node_name,
+                         "renewTime": time.time(),
+                         "leaseDurationSeconds": 40}
+        try:
+            self.client.create(LEASES, lease)
+        except kv.AlreadyExistsError:
+            pass
+
+    def _mem_bytes(self) -> int:
+        from ..api.quantity import parse_mem_bytes
+        return parse_mem_bytes(self.memory)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            now = time.time()
+            try:
+                self.client.guaranteed_update(
+                    LEASES, LEASE_NS, self.node_name,
+                    lambda l: {**l, "spec": {**l.get("spec", {}),
+                                             "renewTime": now}})
+                self.client.guaranteed_update(
+                    NODES, "", self.node_name,
+                    lambda n: {**n, "status": {**n.get("status", {}),
+                                               "lastHeartbeatTime": now}})
+            except kv.StoreError:
+                pass
+
+    # -- pod sync (syncLoopIteration -> SyncPod) -------------------------
+
+    def _on_pod_event(self, type_: str, pod: Obj, old: Obj | None) -> None:
+        mine = meta.pod_node_name(pod) == self.node_name
+        was_mine = old is not None and meta.pod_node_name(old) == self.node_name
+        if not mine and not was_mine:
+            return
+        if type_ == kv.DELETED or not mine:
+            self._kill_pod(pod)
+        elif not meta.pod_is_terminal(pod):
+            self._sync_pod(pod)
+
+    def _sync_pod(self, pod: Obj) -> None:
+        """kuberuntime SyncPod (kuberuntime_manager.go:672): ensure sandbox,
+        start missing containers, then report status."""
+        uid = meta.uid(pod)
+        with self._lock:
+            st = self._pod_state.get(uid)
+            if st is None:
+                sandbox = self.runtime.run_pod_sandbox(
+                    {"name": meta.name(pod), "uid": uid})
+                st = self._pod_state[uid] = {"sandbox": sandbox, "containers": {}}
+            for c in (pod.get("spec") or {}).get("containers") or ():
+                if c["name"] in st["containers"]:
+                    continue
+                self.runtime.pull_image(c.get("image", ""))
+                cid = self.runtime.create_container(st["sandbox"], {
+                    "name": c["name"], "image": c.get("image", ""),
+                    "annotations": meta.annotations(pod)})
+                self.runtime.start_container(cid)
+                st["containers"][c["name"]] = cid
+        self._report_status(pod)
+
+    def _kill_pod(self, pod: Obj) -> None:
+        uid = meta.uid(pod)
+        with self._lock:
+            st = self._pod_state.pop(uid, None)
+        if st:
+            self.runtime.stop_pod_sandbox(st["sandbox"])
+            self.runtime.remove_pod_sandbox(st["sandbox"])
+
+    def _report_status(self, pod: Obj) -> None:
+        uid = meta.uid(pod)
+        with self._lock:
+            st = self._pod_state.get(uid)
+        if st is None:
+            return
+        containers = self.runtime.list_containers(st["sandbox"])
+        running = [c for c in containers if c["state"] == RUNNING]
+        exited = [c for c in containers if c["state"] == EXITED]
+        if containers and not running and exited:
+            failed = any(c.get("exitCode") not in (0, None) for c in exited)
+            phase = "Failed" if failed else "Succeeded"
+            ready = False
+        else:
+            phase = "Running"
+            ready = bool(running)
+        status = {
+            "phase": phase,
+            "conditions": [
+                {"type": "PodScheduled", "status": "True"},
+                {"type": "Ready", "status": "True" if ready else "False"},
+            ],
+            "containerStatuses": [
+                {"name": c["name"], "state": c["state"],
+                 "exitCode": c.get("exitCode")} for c in containers],
+            "hostIP": f"10.0.0.{abs(hash(self.node_name)) % 250 + 1}",
+            "podIP": f"10.{abs(hash(uid)) % 250}.{abs(hash(uid) >> 8) % 250}."
+                     f"{abs(hash(uid) >> 16) % 250 + 1}",
+        }
+        try:
+            def patch(p):
+                p.setdefault("status", {}).update(status)
+                return p
+            self.client.guaranteed_update(PODS, meta.namespace(pod),
+                                          meta.name(pod), patch)
+        except kv.StoreError:
+            pass
+
+    # -- PLEG: relist container states, surface exits --------------------
+
+    def _pleg_loop(self) -> None:
+        while not self._stop.wait(1.0):
+            with self._lock:
+                uids = list(self._pod_state)
+            for uid in uids:
+                pod = self._find_pod(uid)
+                if pod is not None and not meta.pod_is_terminal(pod):
+                    self._report_status(pod)
+
+    def _find_pod(self, uid: str) -> Obj | None:
+        for p in self.pod_informer.list():
+            if meta.uid(p) == uid:
+                return p
+        return None
+
+
+def start_hollow_nodes(client: Client, factory: SharedInformerFactory,
+                       count: int, prefix: str = "hollow-",
+                       **kwargs) -> list[HollowKubelet]:
+    """kubemark: register `count` hollow nodes against the control plane."""
+    return [HollowKubelet(client, factory, f"{prefix}{i}", **kwargs).start()
+            for i in range(count)]

@@ -1,0 +1,3 @@
+"""Service dataplane (reference: pkg/proxy)."""
+
+from .proxier import ServiceProxy  # noqa: F401

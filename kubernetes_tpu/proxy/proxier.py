@@ -1,0 +1,103 @@
+"""kube-proxy: Service -> Endpoint dataplane, simulated.
+
+Reference: pkg/proxy/iptables/proxier.go:775 (syncProxyRules: rebuild the
+full ruleset on every change, via change trackers in pkg/proxy/{service,
+endpoints}.go).  The dataplane here is a rule table instead of netfilter:
+each Service clusterIP:port maps to its backend endpoints, and route()
+performs the random-endpoint selection iptables' statistic module does.
+A real node agent would render self.rules into iptables-restore input —
+the shape of the table matches what syncProxyRules builds.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+
+from ..api import meta
+from ..api.meta import Obj
+from ..client.clientset import ENDPOINTS, SERVICES, Client
+from ..client.informer import SharedInformerFactory
+
+logger = logging.getLogger(__name__)
+
+
+class ServiceProxy:
+    def __init__(self, client: Client, factory: SharedInformerFactory,
+                 node_name: str = ""):
+        self.client = client
+        self.node_name = node_name
+        self.svc_informer = factory.informer(SERVICES)
+        self.ep_informer = factory.informer(ENDPOINTS)
+        self._lock = threading.Lock()
+        # (clusterIP, port, proto) -> {"service": ns/name, "backends": [(ip, port)]}
+        self.rules: dict[tuple[str, int, str], dict] = {}
+        self.sync_count = 0
+        self._pending = threading.Event()
+        self.svc_informer.add_event_handler(lambda *a: self._pending.set())
+        self.ep_informer.add_event_handler(lambda *a: self._pending.set())
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ServiceProxy":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"kube-proxy-{self.node_name}")
+        self._thread.start()
+        self._pending.set()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._pending.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self._pending.wait(timeout=1.0):
+                self._pending.clear()
+                try:
+                    self.sync_proxy_rules()
+                except Exception:  # noqa: BLE001
+                    logger.exception("syncProxyRules failed")
+
+    # syncProxyRules (iptables/proxier.go:775): full rebuild each sync
+    def sync_proxy_rules(self) -> None:
+        new_rules: dict[tuple[str, int, str], dict] = {}
+        eps_by_key = {meta.namespaced_name(ep): ep
+                      for ep in self.ep_informer.list()}
+        for svc in self.svc_informer.list():
+            spec = svc.get("spec") or {}
+            cluster_ip = spec.get("clusterIP")
+            if not cluster_ip or cluster_ip == "None":
+                continue
+            ep = eps_by_key.get(meta.namespaced_name(svc))
+            backends_by_portname: dict[str, list[tuple[str, int]]] = {}
+            for subset in (ep or {}).get("subsets") or ():
+                for port in subset.get("ports") or ():
+                    backends_by_portname.setdefault(port.get("name", ""), [])
+                    for addr in subset.get("addresses") or ():
+                        backends_by_portname[port.get("name", "")].append(
+                            (addr["ip"], port["port"]))
+            for p in spec.get("ports") or ():
+                key = (cluster_ip, p.get("port"), p.get("protocol", "TCP"))
+                new_rules[key] = {
+                    "service": meta.namespaced_name(svc),
+                    "backends": backends_by_portname.get(p.get("name", ""), []),
+                }
+        with self._lock:
+            self.rules = new_rules
+            self.sync_count += 1
+
+    # the dataplane lookup (what an iptables DNAT chain would do)
+    def route(self, cluster_ip: str, port: int, proto: str = "TCP",
+              rng: random.Random | None = None) -> tuple[str, int] | None:
+        with self._lock:
+            rule = self.rules.get((cluster_ip, port, proto))
+            if not rule or not rule["backends"]:
+                return None
+            return (rng or random).choice(rule["backends"])
+
+    def rule_table(self) -> dict:
+        with self._lock:
+            return {f"{ip}:{port}/{proto}": dict(r)
+                    for (ip, port, proto), r in self.rules.items()}

@@ -1,0 +1,189 @@
+"""Node-plane tests: hollow kubelet lifecycle, full Deployment->Running
+chain, Job completion via the fake runtime, endpoints + kube-proxy, node
+failure eviction. This is the closest analog of the reference's kubemark
+simulated-cluster tier (SURVEY.md §4.4)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import (
+    DEPLOYMENTS, ENDPOINTS, JOBS, NODES, PODS, SERVICES,
+)
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.kubelet import HollowKubelet, start_hollow_nodes
+from kubernetes_tpu.proxy import ServiceProxy
+from kubernetes_tpu.scheduler import new_scheduler
+from kubernetes_tpu.store import kv
+
+
+def wait_for(predicate, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture
+def full_cluster():
+    """Control plane + scheduler + controllers + 2 hollow nodes."""
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    sched = new_scheduler(client, factory)
+    mgr = ControllerManager(client, factory)
+    ep = EndpointsController(client, factory)
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    mgr.run()
+    ep.run()
+    kubelets = start_hollow_nodes(client, factory, 2, heartbeat_interval=0.5)
+    yield store, client, factory, kubelets
+    for k in kubelets:
+        k.stop()
+    ep.stop()
+    mgr.stop()
+    sched.stop()
+    factory.stop()
+
+
+def phase(client, ns, name):
+    try:
+        return (client.get(PODS, ns, name).get("status") or {}).get("phase")
+    except kv.NotFoundError:
+        return None
+
+
+class TestHollowKubelet:
+    def test_node_registers_with_capacity(self, full_cluster):
+        store, client, factory, kubelets = full_cluster
+        node = client.get(NODES, "", "hollow-0")
+        assert node["status"]["allocatable"]["cpu"] == "32000m"
+        assert any(c["type"] == "Ready" and c["status"] == "True"
+                   for c in node["status"]["conditions"])
+
+    def test_pod_runs_after_binding(self, full_cluster):
+        store, client, factory, kubelets = full_cluster
+        from kubernetes_tpu.testing import make_pod
+        client.create(PODS, make_pod("web").req(cpu="100m").build())
+        assert wait_for(lambda: phase(client, "default", "web") == "Running")
+        pod = client.get(PODS, "default", "web")
+        assert pod["status"].get("podIP")
+        assert any(c["type"] == "Ready" and c["status"] == "True"
+                   for c in pod["status"]["conditions"])
+
+    def test_deployment_to_running_chain(self, full_cluster):
+        """Deployment -> RS -> pods -> scheduled -> Running -> RS Ready."""
+        store, client, factory, kubelets = full_cluster
+        dep = meta.new_object("Deployment", "api", "default")
+        dep["spec"] = {"replicas": 3,
+                       "selector": {"matchLabels": {"app": "api"}},
+                       "template": {"metadata": {"labels": {"app": "api"}},
+                                    "spec": {"containers": [
+                                        {"name": "c0", "image": "img"}]}}}
+        client.create(DEPLOYMENTS, dep)
+
+        def ready():
+            d = client.get(DEPLOYMENTS, "default", "api")
+            return (d.get("status") or {}).get("readyReplicas") == 3
+        assert wait_for(ready, timeout=30)
+
+    def test_job_completes_via_runtime_exit(self, full_cluster):
+        store, client, factory, kubelets = full_cluster
+        job = meta.new_object("Job", "calc", "default")
+        job["spec"] = {
+            "completions": 1, "parallelism": 1,
+            "template": {
+                "metadata": {"annotations": {"hollow/run-seconds": "0.2"}},
+                "spec": {"containers": [{"name": "c0", "image": "worker"}]}}}
+        client.create(JOBS, job)
+        assert wait_for(lambda: any(
+            c.get("type") == "Complete"
+            for c in (client.get(JOBS, "default", "calc")
+                      .get("status") or {}).get("conditions", [])), timeout=30)
+
+    def test_pod_deletion_tears_down_sandbox(self, full_cluster):
+        store, client, factory, kubelets = full_cluster
+        from kubernetes_tpu.testing import make_pod
+        client.create(PODS, make_pod("gone").build())
+        assert wait_for(lambda: phase(client, "default", "gone") == "Running")
+        owner = next(k for k in kubelets
+                     if k.node_name == meta.pod_node_name(
+                         client.get(PODS, "default", "gone")))
+        client.delete(PODS, "default", "gone")
+        assert wait_for(lambda: not owner._pod_state)
+
+
+class TestServiceDataplane:
+    def test_endpoints_and_proxy(self, full_cluster):
+        store, client, factory, kubelets = full_cluster
+        from kubernetes_tpu.testing import make_pod
+        for i in range(2):
+            client.create(PODS, make_pod(f"be{i}").labels(app="svc").build())
+        assert wait_for(lambda: all(
+            phase(client, "default", f"be{i}") == "Running" for i in range(2)))
+        svc = meta.new_object("Service", "mysvc", "default")
+        svc["spec"] = {"clusterIP": "10.96.0.10", "selector": {"app": "svc"},
+                       "ports": [{"port": 80, "protocol": "TCP"}]}
+        client.create(SERVICES, svc)
+        def two_endpoints():
+            try:
+                ep = client.get(ENDPOINTS, "default", "mysvc")
+            except kv.NotFoundError:
+                return False
+            subsets = ep.get("subsets") or []
+            return bool(subsets) and len(subsets[0].get("addresses") or []) == 2
+
+        assert wait_for(two_endpoints, timeout=20)
+
+        proxy = ServiceProxy(client, factory, "hollow-0").start()
+        try:
+            assert wait_for(lambda: proxy.route("10.96.0.10", 80) is not None)
+            backend = proxy.route("10.96.0.10", 80)
+            ips = {a["ip"] for a in
+                   client.get(ENDPOINTS, "default", "mysvc")["subsets"][0]["addresses"]}
+            assert backend[0] in ips
+            assert proxy.route("10.96.0.99", 80) is None
+        finally:
+            proxy.stop()
+
+
+class TestNodeFailure:
+    def test_dead_node_pods_evicted_and_rescheduled(self):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        factory = SharedInformerFactory(client)
+        sched = new_scheduler(client, factory)
+        nlc = NodeLifecycleController(client, factory, grace_period=1.0,
+                                      tick=0.3)
+        factory.start()
+        factory.wait_for_cache_sync()
+        sched.run()
+        nlc.run()
+        kubelets = start_hollow_nodes(client, factory, 2,
+                                      heartbeat_interval=0.2)
+        try:
+            from kubernetes_tpu.testing import make_pod
+            client.create(PODS, make_pod("worker").build())
+            assert wait_for(lambda: phase(client, "default", "worker") == "Running")
+            victim_node = meta.pod_node_name(client.get(PODS, "default", "worker"))
+            victim = next(k for k in kubelets if k.node_name == victim_node)
+            victim.stop()  # heartbeats cease -> NotReady -> eviction
+            assert wait_for(lambda: phase(client, "default", "worker") is None,
+                            timeout=20)
+            node = client.get(NODES, "", victim_node)
+            assert any(c["type"] == "Ready" and c["status"] == "False"
+                       for c in node["status"]["conditions"])
+        finally:
+            for k in kubelets:
+                k.stop()
+            nlc.stop()
+            sched.stop()
+            factory.stop()
