@@ -1,0 +1,3 @@
+"""CLI (reference: staging/src/k8s.io/kubectl)."""
+
+from .kubectl import Kubectl, run  # noqa: F401

@@ -1,0 +1,389 @@
+"""kubectl-equivalent CLI.
+
+Reference: staging/src/k8s.io/kubectl/pkg/cmd/ (~40 cobra commands).  The
+load-bearing subset: get (table printers, -o json/yaml/wide), describe,
+create/apply/delete (-f YAML manifests, multi-doc), scale, cordon/
+uncordon, drain, top nodes, logs (hollow runtimes have none; prints
+container states), version.  Talks to the REST apiserver via HTTPClient
+(--server) so it works against a real multi-process cluster.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import yaml
+
+from .. import __version__
+from ..api import meta
+from ..client.clientset import NODES, PODS, Client
+from ..client.http_client import HTTPClient
+from ..store import kv
+
+# kind -> resource (for -f manifests); aliases for `get` etc.
+KIND_TO_RESOURCE = {
+    "Pod": "pods", "Node": "nodes", "Service": "services",
+    "Endpoints": "endpoints", "ReplicaSet": "replicasets",
+    "Deployment": "deployments", "Job": "jobs", "Namespace": "namespaces",
+    "ConfigMap": "configmaps", "Secret": "secrets", "Lease": "leases",
+    "PodGroup": "podgroups", "PodDisruptionBudget": "poddisruptionbudgets",
+    "Event": "events", "PriorityClass": "priorityclasses",
+}
+ALIASES = {
+    "po": "pods", "pod": "pods", "no": "nodes", "node": "nodes",
+    "svc": "services", "service": "services", "ep": "endpoints",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "deploy": "deployments", "deployment": "deployments", "job": "jobs",
+    "ns": "namespaces", "namespace": "namespaces", "cm": "configmaps",
+    "pg": "podgroups", "podgroup": "podgroups", "pdb": "poddisruptionbudgets",
+    "ev": "events", "event": "events", "lease": "leases", "pc": "priorityclasses",
+}
+
+
+def resolve_resource(arg: str) -> str:
+    return ALIASES.get(arg.lower(), arg.lower())
+
+
+def age(obj: dict) -> str:
+    ts = meta.creation_timestamp(obj)
+    if not ts:
+        return "<none>"
+    d = int(time.time() - ts)
+    if d < 120:
+        return f"{d}s"
+    if d < 7200:
+        return f"{d // 60}m"
+    if d < 172800:
+        return f"{d // 3600}h"
+    return f"{d // 86400}d"
+
+
+def pod_row(p: dict, wide: bool) -> list[str]:
+    status = p.get("status") or {}
+    phase = status.get("phase", "Pending")
+    total = len((p.get("spec") or {}).get("containers") or [])
+    run = sum(1 for c in status.get("containerStatuses") or ()
+              if c.get("state") == "CONTAINER_RUNNING")
+    row = [meta.name(p), f"{run}/{total}", phase, age(p)]
+    if wide:
+        row += [meta.pod_node_name(p) or "<none>", status.get("podIP", "<none>")]
+    return row
+
+
+def node_row(n: dict, wide: bool) -> list[str]:
+    conds = (n.get("status") or {}).get("conditions") or []
+    ready = any(c.get("type") == "Ready" and c.get("status") == "True"
+                for c in conds)
+    status = "Ready" if ready else "NotReady"
+    if (n.get("spec") or {}).get("unschedulable"):
+        status += ",SchedulingDisabled"
+    row = [meta.name(n), status, age(n)]
+    if wide:
+        alloc = (n.get("status") or {}).get("allocatable") or {}
+        row += [alloc.get("cpu", "?"), alloc.get("memory", "?")]
+    return row
+
+
+def generic_row(o: dict, wide: bool) -> list[str]:
+    status = o.get("status") or {}
+    extra = ""
+    if "replicas" in (o.get("spec") or {}):
+        extra = (f"{status.get('readyReplicas', 0)}/"
+                 f"{(o.get('spec') or {}).get('replicas', 0)}")
+    elif "conditions" in status:
+        extra = ",".join(c.get("type", "") for c in status["conditions"]
+                         if c.get("status") == "True") or "-"
+    return [meta.name(o), extra or "-", age(o)]
+
+
+PRINTERS = {
+    "pods": (["NAME", "READY", "STATUS", "AGE"],
+             ["NAME", "READY", "STATUS", "AGE", "NODE", "IP"], pod_row),
+    "nodes": (["NAME", "STATUS", "AGE"],
+              ["NAME", "STATUS", "AGE", "CPU", "MEMORY"], node_row),
+}
+
+
+def print_table(rows: list[list[str]], headers: list[str], out) -> None:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    out.write("  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip() + "\n")
+    for r in rows:
+        out.write("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip() + "\n")
+
+
+class Kubectl:
+    def __init__(self, client: Client, out=None):
+        self.client = client
+        self.out = out or sys.stdout
+
+    # -- get / describe --------------------------------------------------
+
+    def get(self, resource: str, name: str | None, namespace: str,
+            output: str | None) -> int:
+        resource = resolve_resource(resource)
+        if name:
+            try:
+                items = [self.client.get(resource, namespace, name)]
+            except kv.NotFoundError:
+                try:  # cluster-scoped fallback
+                    items = [self.client.get(resource, "", name)]
+                except kv.NotFoundError as e:
+                    self.out.write(f"Error: {e}\n")
+                    return 1
+        else:
+            ns = None if resource == "nodes" else namespace
+            items, _ = self.client.list(resource, ns)
+            items.sort(key=meta.name)
+        if output == "json":
+            self.out.write(json.dumps(items if not name else items[0],
+                                      indent=2, default=str) + "\n")
+            return 0
+        if output == "yaml":
+            self.out.write(yaml.safe_dump(items if not name else items[0]))
+            return 0
+        wide = output == "wide"
+        narrow_h, wide_h, rowfn = PRINTERS.get(
+            resource, (["NAME", "STATUS", "AGE"], ["NAME", "STATUS", "AGE"],
+                       generic_row))
+        headers = wide_h if wide else narrow_h
+        print_table([rowfn(o, wide) for o in items], headers, self.out)
+        return 0
+
+    def describe(self, resource: str, name: str, namespace: str) -> int:
+        resource = resolve_resource(resource)
+        try:
+            obj = self.client.get(resource, namespace, name)
+        except kv.NotFoundError:
+            try:
+                obj = self.client.get(resource, "", name)
+            except kv.NotFoundError as e:
+                self.out.write(f"Error: {e}\n")
+                return 1
+        self.out.write(yaml.safe_dump(obj))
+        # related events (describe shows them)
+        events, _ = self.client.list("events", namespace)
+        related = [e for e in events
+                   if (e.get("involvedObject") or {}).get("name") == name]
+        if related:
+            self.out.write("Events:\n")
+            for e in related[-10:]:
+                self.out.write(f"  {e.get('type')}\t{e.get('reason')}\t"
+                               f"{e.get('message')}\n")
+        return 0
+
+    # -- create / apply / delete ----------------------------------------
+
+    def _load_manifests(self, path: str) -> list[dict]:
+        with open(path) as f:
+            return [d for d in yaml.safe_load_all(f) if d]
+
+    def create(self, path: str, namespace: str) -> int:
+        for obj in self._load_manifests(path):
+            res = KIND_TO_RESOURCE.get(obj.get("kind", ""), "")
+            if not res:
+                self.out.write(f"error: unknown kind {obj.get('kind')}\n")
+                return 1
+            obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+            try:
+                created = self.client.create(res, obj)
+                self.out.write(f"{res}/{meta.name(created)} created\n")
+            except kv.AlreadyExistsError:
+                self.out.write(f"{res}/{meta.name(obj)} already exists\n")
+                return 1
+        return 0
+
+    def apply(self, path: str, namespace: str) -> int:
+        """create-or-update (server-side apply reduced to spec replace)."""
+        for obj in self._load_manifests(path):
+            res = KIND_TO_RESOURCE.get(obj.get("kind", ""), "")
+            if not res:
+                self.out.write(f"error: unknown kind {obj.get('kind')}\n")
+                return 1
+            obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+            ns, nm = meta.namespace(obj), meta.name(obj)
+            try:
+                self.client.create(res, obj)
+                self.out.write(f"{res}/{nm} created\n")
+            except kv.AlreadyExistsError:
+                def merge(cur, new=obj):
+                    for k in ("spec", "data", "subsets"):
+                        if k in new:
+                            cur[k] = new[k]
+                    md = cur["metadata"]
+                    for k in ("labels", "annotations"):
+                        if (new.get("metadata") or {}).get(k):
+                            md[k] = new["metadata"][k]
+                    return cur
+                self.client.guaranteed_update(res, ns, nm, merge)
+                self.out.write(f"{res}/{nm} configured\n")
+        return 0
+
+    def delete(self, resource: str, name: str, namespace: str) -> int:
+        resource = resolve_resource(resource)
+        try:
+            self.client.delete(resource, namespace, name)
+        except kv.NotFoundError:
+            try:
+                self.client.delete(resource, "", name)
+            except kv.NotFoundError as e:
+                self.out.write(f"Error: {e}\n")
+                return 1
+        self.out.write(f"{resource}/{name} deleted\n")
+        return 0
+
+    # -- scale / cordon / drain / top ------------------------------------
+
+    def scale(self, resource: str, name: str, namespace: str, replicas: int) -> int:
+        resource = resolve_resource(resource)
+
+        def patch(o):
+            o.setdefault("spec", {})["replicas"] = replicas
+            return o
+        try:
+            self.client.guaranteed_update(resource, namespace, name, patch)
+        except kv.NotFoundError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        self.out.write(f"{resource}/{name} scaled to {replicas}\n")
+        return 0
+
+    def cordon(self, node: str, on: bool = True) -> int:
+        def patch(n):
+            n.setdefault("spec", {})["unschedulable"] = on
+            if not on:
+                n["spec"].pop("unschedulable", None)
+            return n
+        try:
+            self.client.guaranteed_update(NODES, "", node, patch)
+        except kv.NotFoundError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        self.out.write(f"node/{node} {'cordoned' if on else 'uncordoned'}\n")
+        return 0
+
+    def drain(self, node: str) -> int:
+        rc = self.cordon(node, True)
+        if rc:
+            return rc
+        pods, _ = self.client.list(PODS)
+        for p in pods:
+            if meta.pod_node_name(p) == node:
+                try:
+                    self.client.delete(PODS, meta.namespace(p), meta.name(p))
+                    self.out.write(f"pod/{meta.name(p)} evicted\n")
+                except kv.NotFoundError:
+                    pass
+        return 0
+
+    def top_nodes(self) -> int:
+        from ..api.resources import node_allocatable, pod_request
+        nodes, _ = self.client.list(NODES)
+        pods, _ = self.client.list(PODS)
+        rows = []
+        for n in sorted(nodes, key=meta.name):
+            alloc = node_allocatable(n)
+            used_cpu = used_mem = 0
+            for p in pods:
+                if meta.pod_node_name(p) == meta.name(n):
+                    r = pod_request(p)
+                    used_cpu += r.milli_cpu
+                    used_mem += r.memory
+            cpu_pct = (100 * used_cpu // alloc.milli_cpu) if alloc.milli_cpu else 0
+            mem_pct = (100 * used_mem // alloc.memory) if alloc.memory else 0
+            rows.append([meta.name(n), f"{used_cpu}m", f"{cpu_pct}%",
+                         f"{used_mem // (1 << 20)}Mi", f"{mem_pct}%"])
+        print_table(rows, ["NAME", "CPU", "CPU%", "MEMORY", "MEMORY%"], self.out)
+        return 0
+
+    def logs(self, name: str, namespace: str) -> int:
+        try:
+            pod = self.client.get(PODS, namespace, name)
+        except kv.NotFoundError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        for c in (pod.get("status") or {}).get("containerStatuses") or ():
+            self.out.write(f"[{c.get('name')}] state={c.get('state')} "
+                           f"exitCode={c.get('exitCode')}\n")
+        return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="kubectl", description=__doc__)
+    ap.add_argument("--server", "-s", default="http://127.0.0.1:8080")
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--namespace", "-n", default="default")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-o", "--output", choices=["json", "yaml", "wide"])
+    d = sub.add_parser("describe")
+    d.add_argument("resource")
+    d.add_argument("name")
+    for verb in ("create", "apply"):
+        c = sub.add_parser(verb)
+        c.add_argument("-f", "--filename", required=True)
+    dl = sub.add_parser("delete")
+    dl.add_argument("resource")
+    dl.add_argument("name")
+    sc = sub.add_parser("scale")
+    sc.add_argument("resource")
+    sc.add_argument("name")
+    sc.add_argument("--replicas", type=int, required=True)
+    for verb in ("cordon", "uncordon", "drain"):
+        cn = sub.add_parser(verb)
+        cn.add_argument("node")
+    tp = sub.add_parser("top")
+    tp.add_argument("what", choices=["nodes"])
+    lg = sub.add_parser("logs")
+    lg.add_argument("name")
+    sub.add_parser("version")
+    return ap
+
+
+def run(argv: list[str] | None = None, client: Client | None = None,
+        out=None) -> int:
+    args = build_parser().parse_args(argv)
+    out = out or sys.stdout
+    if client is None:
+        client = HTTPClient.from_url(args.server, args.token)
+    k = Kubectl(client, out)
+    if args.cmd == "get":
+        return k.get(args.resource, args.name, args.namespace, args.output)
+    if args.cmd == "describe":
+        return k.describe(args.resource, args.name, args.namespace)
+    if args.cmd == "create":
+        return k.create(args.filename, args.namespace)
+    if args.cmd == "apply":
+        return k.apply(args.filename, args.namespace)
+    if args.cmd == "delete":
+        return k.delete(args.resource, args.name, args.namespace)
+    if args.cmd == "scale":
+        return k.scale(args.resource, args.name, args.namespace, args.replicas)
+    if args.cmd == "cordon":
+        return k.cordon(args.node, True)
+    if args.cmd == "uncordon":
+        return k.cordon(args.node, False)
+    if args.cmd == "drain":
+        return k.drain(args.node)
+    if args.cmd == "top":
+        return k.top_nodes()
+    if args.cmd == "logs":
+        return k.logs(args.name, args.namespace)
+    if args.cmd == "version":
+        out.write(f"kubectl-tpu v{__version__}\n")
+        return 0
+    return 1
+
+
+def main() -> None:  # console entry
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

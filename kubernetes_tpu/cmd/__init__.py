@@ -1,0 +1,5 @@
+"""Component entry points (reference: cmd/kube-* binaries).
+
+Each module is runnable: `python -m kubernetes_tpu.cmd.<component>`.
+See cmd/cluster.py for an all-in-one local cluster.
+"""

@@ -1,0 +1,35 @@
+"""kube-apiserver entry point (reference: cmd/kube-apiserver)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="tpu-apiserver")
+    ap.add_argument("--bind-address", default="127.0.0.1")
+    ap.add_argument("--secure-port", type=int, default=8080)
+    ap.add_argument("--token", default=None, help="static bearer token authn")
+    ap.add_argument("-v", "--verbosity", type=int, default=1)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
+
+    from ..apiserver import APIServer
+    from ..store import kv
+
+    store = kv.MemoryStore(history=1_000_000)
+    server = APIServer(store, host=args.bind_address, port=args.secure_port,
+                       token=args.token).start()
+    print(f"apiserver listening on {server.url}")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

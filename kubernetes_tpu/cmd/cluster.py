@@ -1,0 +1,77 @@
+"""All-in-one local cluster (reference: the 'hack/local-up-cluster.sh'
+developer experience + kubeadm's role as the bootstrap path).
+
+Starts apiserver + scheduler + controller-manager + N hollow nodes in one
+process, serving the REST API so kubectl and other processes can attach.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="tpu-cluster")
+    ap.add_argument("--secure-port", type=int, default=8080)
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--tpu-batch", action="store_true")
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("-v", "--verbosity", type=int, default=1)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
+
+    from ..apiserver import APIServer
+    from ..client.clientset import LocalClient
+    from ..client.informer import SharedInformerFactory
+    from ..controllers import ControllerManager
+    from ..controllers.endpoints import EndpointsController
+    from ..kubelet import start_hollow_nodes
+    from ..scheduler import Profile, Scheduler, new_default_framework
+    from ..store import kv
+
+    store = kv.MemoryStore(history=1_000_000)
+    server = APIServer(store, port=args.secure_port).start()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+
+    fw = new_default_framework(client, factory)
+    if args.tpu_batch:
+        from ..ops.backend import TPUBatchBackend
+        from ..ops.flatten import Caps
+        backend = TPUBatchBackend(Caps(n_cap=max(1024, args.nodes * 2)),
+                                  batch_size=args.batch_size)
+        profile = Profile(fw, batch_backend=backend, batch_size=args.batch_size)
+    else:
+        profile = Profile(fw)
+    sched = Scheduler(client, factory, {"default-scheduler": profile})
+    mgr = ControllerManager(client, factory)
+    endpoints = EndpointsController(client, factory)
+
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    mgr.run()
+    endpoints.run()
+    kubelets = start_hollow_nodes(client, factory, args.nodes)
+
+    print(f"cluster up: apiserver={server.url} nodes={args.nodes} "
+          f"scheduler={'tpu-batch' if args.tpu_batch else 'per-pod'}")
+    print(f"try: python -m kubernetes_tpu.cli.kubectl --server {server.url} "
+          f"get nodes")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    for k in kubelets:
+        k.stop()
+    endpoints.stop()
+    mgr.stop()
+    sched.stop()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

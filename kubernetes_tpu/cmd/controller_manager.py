@@ -1,0 +1,56 @@
+"""kube-controller-manager entry point (reference: cmd/kube-controller-manager)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="tpu-controller-manager")
+    ap.add_argument("--server", default="http://127.0.0.1:8080")
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--controllers", default="*",
+                    help="comma list or * (deployment,replicaset,job,"
+                         "garbagecollector,nodelifecycle,endpoints)")
+    ap.add_argument("-v", "--verbosity", type=int, default=1)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
+
+    from ..client.http_client import HTTPClient
+    from ..client.informer import SharedInformerFactory
+    from ..controllers import ControllerManager
+    from ..controllers.endpoints import EndpointsController
+    from ..controllers.manager import DEFAULT_CONTROLLERS
+
+    client = HTTPClient.from_url(args.server, args.token)
+    factory = SharedInformerFactory(client)
+    names = (DEFAULT_CONTROLLERS if args.controllers == "*"
+             else tuple(n for n in args.controllers.split(",")
+                        if n != "endpoints"))
+    mgr = ControllerManager(client, factory, controllers=names,
+                            leader_elect=args.leader_elect)
+    endpoints = (EndpointsController(client, factory)
+                 if args.controllers in ("*",) or "endpoints" in args.controllers
+                 else None)
+    factory.start()
+    factory.wait_for_cache_sync()
+    mgr.run()
+    if endpoints:
+        endpoints.run()
+    print(f"controller-manager running: {', '.join(names)}"
+          + (", endpoints" if endpoints else ""))
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    if endpoints:
+        endpoints.stop()
+    mgr.stop()
+
+
+if __name__ == "__main__":
+    main()

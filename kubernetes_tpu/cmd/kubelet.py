@@ -1,0 +1,53 @@
+"""kubelet entry point — hollow node(s) (reference: cmd/kubelet + cmd/kubemark)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import socket
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="tpu-kubelet")
+    ap.add_argument("--server", default="http://127.0.0.1:8080")
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--node-name", default=socket.gethostname())
+    ap.add_argument("--cpu", default="32")
+    ap.add_argument("--memory", default="256Gi")
+    ap.add_argument("--max-pods", type=int, default=110)
+    ap.add_argument("--hollow-nodes", type=int, default=0,
+                    help="kubemark mode: register N hollow nodes instead of one")
+    ap.add_argument("-v", "--verbosity", type=int, default=1)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
+
+    from ..client.http_client import HTTPClient
+    from ..client.informer import SharedInformerFactory
+    from ..kubelet import HollowKubelet, start_hollow_nodes
+
+    client = HTTPClient.from_url(args.server, args.token)
+    factory = SharedInformerFactory(client)
+    factory.start()
+    factory.wait_for_cache_sync()
+    if args.hollow_nodes:
+        kubelets = start_hollow_nodes(client, factory, args.hollow_nodes,
+                                      cpu=args.cpu, memory=args.memory,
+                                      pods=args.max_pods)
+        print(f"kubemark: {args.hollow_nodes} hollow nodes registered")
+    else:
+        kubelets = [HollowKubelet(client, factory, args.node_name,
+                                  cpu=args.cpu, memory=args.memory,
+                                  pods=args.max_pods).start()]
+        print(f"kubelet running as node {args.node_name}")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    for k in kubelets:
+        k.stop()
+
+
+if __name__ == "__main__":
+    main()

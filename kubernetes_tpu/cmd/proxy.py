@@ -1,0 +1,39 @@
+"""kube-proxy entry point (reference: cmd/kube-proxy)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import socket
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="tpu-proxy")
+    ap.add_argument("--server", default="http://127.0.0.1:8080")
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--node-name", default=socket.gethostname())
+    ap.add_argument("-v", "--verbosity", type=int, default=1)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
+
+    from ..client.http_client import HTTPClient
+    from ..client.informer import SharedInformerFactory
+    from ..proxy import ServiceProxy
+
+    client = HTTPClient.from_url(args.server, args.token)
+    factory = SharedInformerFactory(client)
+    factory.start()
+    factory.wait_for_cache_sync()
+    proxy = ServiceProxy(client, factory, args.node_name).start()
+    print(f"kube-proxy running on {args.node_name}")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    proxy.stop()
+
+
+if __name__ == "__main__":
+    main()

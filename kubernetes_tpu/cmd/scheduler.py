@@ -1,0 +1,66 @@
+"""kube-scheduler entry point (reference: cmd/kube-scheduler/app/server.go).
+
+Supports the `tpu-batch` profile: --tpu-batch enables the TPU batched
+Filter/Score/Assign backend for the default profile (the north star's
+TPUBatchAssign), with --batch-size and --node-capacity knobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="tpu-scheduler")
+    ap.add_argument("--server", default="http://127.0.0.1:8080")
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--tpu-batch", action="store_true",
+                    help="enable the TPU batch scheduling backend")
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--node-capacity", type=int, default=8192)
+    ap.add_argument("-v", "--verbosity", type=int, default=1)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
+
+    from ..client.http_client import HTTPClient
+    from ..client.informer import SharedInformerFactory
+    from ..client.leaderelection import LeaderElector
+    from ..scheduler import Profile, Scheduler, new_default_framework
+
+    client = HTTPClient.from_url(args.server, args.token)
+    factory = SharedInformerFactory(client)
+    fw = new_default_framework(client, factory)
+    if args.tpu_batch:
+        from ..ops.backend import TPUBatchBackend
+        from ..ops.flatten import Caps
+        backend = TPUBatchBackend(Caps(n_cap=args.node_capacity),
+                                  batch_size=args.batch_size)
+        profile = Profile(fw, batch_backend=backend, batch_size=args.batch_size)
+    else:
+        profile = Profile(fw)
+    sched = Scheduler(client, factory, {"default-scheduler": profile})
+    factory.start()
+    factory.wait_for_cache_sync()
+
+    stop = threading.Event()
+    if args.leader_elect:
+        elector = LeaderElector(client, "kube-scheduler",
+                                on_started_leading=sched.run,
+                                on_stopped_leading=stop.set)
+        elector.run()
+    else:
+        sched.run()
+    print("scheduler running"
+          + (" (tpu-batch profile)" if args.tpu_batch else " (per-pod)"))
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    sched.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""kubectl CLI tests (reference: kubectl command tests / test/cmd)."""
+
+import io
+import time
+
+import pytest
+import yaml
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.cli.kubectl import run
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import DEPLOYMENTS, NODES, PODS
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.scheduler import new_scheduler
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture
+def cluster():
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    sched = new_scheduler(client, factory)
+    mgr = ControllerManager(client, factory)
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    mgr.run()
+    yield client
+    mgr.stop()
+    sched.stop()
+    factory.stop()
+
+
+def kubectl(client, *argv) -> tuple[int, str]:
+    out = io.StringIO()
+    rc = run(list(argv), client=client, out=out)
+    return rc, out.getvalue()
+
+
+class TestKubectl:
+    def test_get_nodes_and_pods(self, cluster):
+        client = cluster
+        client.create(NODES, make_node("n1").build())
+        client.create(PODS, make_pod("p1").build())
+        assert wait_for(lambda: meta.pod_node_name(
+            client.get(PODS, "default", "p1")))
+        rc, out = kubectl(client, "get", "nodes")
+        assert rc == 0 and "n1" in out and "NAME" in out
+        rc, out = kubectl(client, "get", "pods", "-o", "wide")
+        assert rc == 0 and "p1" in out and "n1" in out
+
+    def test_get_json_and_yaml(self, cluster):
+        client = cluster
+        client.create(PODS, make_pod("p1").build())
+        rc, out = kubectl(client, "get", "po", "p1", "-o", "json")
+        assert rc == 0
+        import json
+        assert json.loads(out)["metadata"]["name"] == "p1"
+        rc, out = kubectl(client, "get", "po", "p1", "-o", "yaml")
+        assert yaml.safe_load(out)["metadata"]["name"] == "p1"
+
+    def test_create_apply_delete_manifest(self, cluster, tmp_path):
+        client = cluster
+        manifest = tmp_path / "dep.yaml"
+        manifest.write_text(yaml.safe_dump({
+            "apiVersion": "v1", "kind": "Deployment",
+            "metadata": {"name": "web"},
+            "spec": {"replicas": 2,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [
+                                      {"name": "c0", "image": "img:v1"}]}}},
+        }))
+        rc, out = kubectl(client, "create", "-f", str(manifest))
+        assert rc == 0 and "created" in out
+        assert wait_for(lambda: len(client.list(PODS, "default")[0]) == 2)
+        # apply an image change
+        doc = yaml.safe_load(manifest.read_text())
+        doc["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
+        manifest.write_text(yaml.safe_dump(doc))
+        rc, out = kubectl(client, "apply", "-f", str(manifest))
+        assert rc == 0 and "configured" in out
+        rc, out = kubectl(client, "delete", "deploy", "web")
+        assert rc == 0
+
+    def test_scale(self, cluster, tmp_path):
+        client = cluster
+        dep = meta.new_object("Deployment", "api", "default")
+        dep["spec"] = {"replicas": 1,
+                       "selector": {"matchLabels": {"app": "api"}},
+                       "template": {"metadata": {"labels": {"app": "api"}},
+                                    "spec": {"containers": [
+                                        {"name": "c0", "image": "i"}]}}}
+        client.create(DEPLOYMENTS, dep)
+        assert wait_for(lambda: len(client.list(PODS, "default")[0]) == 1)
+        rc, out = kubectl(client, "scale", "deploy", "api", "--replicas", "3")
+        assert rc == 0
+        assert wait_for(lambda: len(client.list(PODS, "default")[0]) == 3)
+
+    def test_cordon_drain(self, cluster):
+        client = cluster
+        client.create(NODES, make_node("n1").build())
+        client.create(NODES, make_node("n2").build())
+        client.create(PODS, make_pod("p1").build())
+        assert wait_for(lambda: meta.pod_node_name(
+            client.get(PODS, "default", "p1")))
+        victim = meta.pod_node_name(client.get(PODS, "default", "p1"))
+        rc, out = kubectl(client, "drain", victim)
+        assert rc == 0 and "evicted" in out
+        node = client.get(NODES, "", victim)
+        assert node["spec"].get("unschedulable") is True
+        rc, _ = kubectl(client, "uncordon", victim)
+        assert rc == 0
+        assert not client.get(NODES, "", victim)["spec"].get("unschedulable")
+
+    def test_top_nodes(self, cluster):
+        client = cluster
+        client.create(NODES, make_node("n1").capacity(cpu="2", mem="4Gi").build())
+        client.create(PODS, make_pod("p1").req(cpu="500m", mem="1Gi").build())
+        assert wait_for(lambda: meta.pod_node_name(
+            client.get(PODS, "default", "p1")))
+        rc, out = kubectl(client, "top", "nodes")
+        assert rc == 0 and "500m" in out and "25%" in out
+
+    def test_describe_shows_events(self, cluster):
+        client = cluster
+        client.create(NODES, make_node("n1").build())
+        client.create(PODS, make_pod("p1").build())
+        assert wait_for(lambda: meta.pod_node_name(
+            client.get(PODS, "default", "p1")))
+        assert wait_for(lambda: kubectl(client, "describe", "po", "p1")[1]
+                        .count("Scheduled") >= 1)
+
+    def test_version_and_errors(self, cluster):
+        client = cluster
+        rc, out = kubectl(client, "version")
+        assert rc == 0 and "kubectl-tpu" in out
+        rc, out = kubectl(client, "get", "pods", "nope")
+        assert rc == 1 and "Error" in out
+
+
+class TestKubectlOverHTTP:
+    def test_against_real_apiserver(self, tmp_path):
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client.http_client import HTTPClient
+        store = kv.MemoryStore()
+        server = APIServer(store).start()
+        try:
+            client = HTTPClient("127.0.0.1", server.port)
+            client.create(NODES, make_node("n1").build())
+            rc, out = kubectl(client, "get", "nodes")
+            assert rc == 0 and "n1" in out
+        finally:
+            server.stop()
