@@ -1,0 +1,3 @@
+"""Shared utilities."""
+
+from .fastcopy import deep_copy_json, is_native  # noqa: F401

@@ -1,0 +1,59 @@
+"""Deep copy for JSON-shaped objects: native extension with pure fallback.
+
+native/fastcopy builds `_fastcopy` (CPython C API); the store's write path
+(store/kv.py via api.meta.deep_copy) is the consumer.  Objects here are
+always dict/list/scalar trees, so the C path shares immutable scalars and
+skips deepcopy's memo machinery.
+"""
+
+from __future__ import annotations
+
+import copy
+import glob
+import os
+import sys
+
+_native = None
+
+
+def _load_native():
+    global _native
+    here = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
+                                        "native", "fastcopy"))
+    sos = glob.glob(os.path.join(here, "_fastcopy*.so"))
+    if not sos and os.path.isdir(here) and not os.environ.get(
+            "KTPU_NO_NATIVE_BUILD"):
+        # first use on this machine: build the extension in place (quiet)
+        import subprocess
+        try:
+            subprocess.run([sys.executable, "setup.py", "build_ext",
+                            "--inplace"], cwd=here, capture_output=True,
+                           timeout=120, check=False)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        sos = glob.glob(os.path.join(here, "_fastcopy*.so"))
+    for path in sos:
+        d = os.path.dirname(path)
+        if d not in sys.path:
+            sys.path.insert(0, d)
+    try:
+        import _fastcopy  # type: ignore
+        _native = _fastcopy
+    except ImportError:
+        _native = None
+
+
+_load_native()
+
+
+def deep_copy_json(obj):
+    if _native is not None:
+        try:
+            return _native.deepcopy_json(obj)
+        except TypeError:
+            pass  # non-JSON node: fall through
+    return copy.deepcopy(obj)
+
+
+def is_native() -> bool:
+    return _native is not None

@@ -1,0 +1,112 @@
+/* fastcopy — C deep copy for JSON-shaped Python objects.
+ *
+ * The control plane stores objects as plain dict/list/scalar trees (the
+ * wire shape); every store write deep-copies the inbound object so stored
+ * state stays private (store/kv.py).  copy.deepcopy pays for generality
+ * (memo dict, reduce protocol, type dispatch per node); this extension
+ * recurses only over dict/list/tuple and shares immutable scalars, which
+ * profiling showed is the dominant host cost of the write path at
+ * scheduler_perf scale.
+ *
+ * Reference context: the reference's Go apiserver gets the same effect
+ * from generated DeepCopy methods (zz_generated.deepcopy.go) — this is
+ * the TPU build's native runtime equivalent (SURVEY.md §2: native surface).
+ *
+ * Falls back transparently: kubernetes_tpu/utils/fastcopy.py uses
+ * copy.deepcopy when the extension isn't built.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+static PyObject *deepcopy_json_obj(PyObject *obj, int depth);
+
+static PyObject *
+deepcopy_json_obj(PyObject *obj, int depth)
+{
+    if (depth > 200) {
+        PyErr_SetString(PyExc_RecursionError, "fastcopy: object too deep");
+        return NULL;
+    }
+    if (PyDict_CheckExact(obj)) {
+        PyObject *out = PyDict_New();
+        if (out == NULL)
+            return NULL;
+        PyObject *key, *value;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(obj, &pos, &key, &value)) {
+            PyObject *cv = deepcopy_json_obj(value, depth + 1);
+            if (cv == NULL || PyDict_SetItem(out, key, cv) < 0) {
+                Py_XDECREF(cv);
+                Py_DECREF(out);
+                return NULL;
+            }
+            Py_DECREF(cv);
+        }
+        return out;
+    }
+    if (PyList_CheckExact(obj)) {
+        Py_ssize_t n = PyList_GET_SIZE(obj);
+        PyObject *out = PyList_New(n);
+        if (out == NULL)
+            return NULL;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *cv = deepcopy_json_obj(PyList_GET_ITEM(obj, i), depth + 1);
+            if (cv == NULL) {
+                Py_DECREF(out);
+                return NULL;
+            }
+            PyList_SET_ITEM(out, i, cv); /* steals */
+        }
+        return out;
+    }
+    if (PyTuple_CheckExact(obj)) {
+        Py_ssize_t n = PyTuple_GET_SIZE(obj);
+        PyObject *out = PyTuple_New(n);
+        if (out == NULL)
+            return NULL;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *cv = deepcopy_json_obj(PyTuple_GET_ITEM(obj, i), depth + 1);
+            if (cv == NULL) {
+                Py_DECREF(out);
+                return NULL;
+            }
+            PyTuple_SET_ITEM(out, i, cv); /* steals */
+        }
+        return out;
+    }
+    /* scalars (str/int/float/bool/None/bytes) are immutable: share */
+    if (obj == Py_None || PyUnicode_CheckExact(obj) || PyLong_CheckExact(obj)
+        || PyFloat_CheckExact(obj) || PyBool_Check(obj)
+        || PyBytes_CheckExact(obj)) {
+        Py_INCREF(obj);
+        return obj;
+    }
+    /* non-JSON node: signal so the wrapper falls back to copy.deepcopy */
+    PyErr_Format(PyExc_TypeError, "fastcopy: unsupported type %s",
+                 Py_TYPE(obj)->tp_name);
+    return NULL;
+}
+
+static PyObject *
+fastcopy_deepcopy_json(PyObject *self, PyObject *obj)
+{
+    return deepcopy_json_obj(obj, 0);
+}
+
+static PyMethodDef FastcopyMethods[] = {
+    {"deepcopy_json", fastcopy_deepcopy_json, METH_O,
+     "Deep copy a JSON-shaped object tree (dict/list/tuple/scalars)."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef fastcopymodule = {
+    PyModuleDef_HEAD_INIT, "_fastcopy",
+    "C deep copy for JSON-shaped objects", -1, FastcopyMethods,
+};
+
+PyMODINIT_FUNC
+PyInit__fastcopy(void)
+{
+    return PyModule_Create(&fastcopymodule);
+}

@@ -1,0 +1,8 @@
+from setuptools import Extension, setup
+
+setup(
+    name="fastcopy",
+    version="1.0",
+    ext_modules=[Extension("_fastcopy", sources=["fastcopy.c"],
+                           extra_compile_args=["-O2"])],
+)

@@ -1,0 +1,58 @@
+/* pause — the pod-sandbox init process.
+ *
+ * Reference behavior: build/pause/linux/pause.c (68 LoC) — the only native
+ * program in the reference tree.  It holds a pod's shared namespaces open
+ * and reaps zombies re-parented to it:
+ *   - SIGINT/SIGTERM -> exit cleanly
+ *   - SIGCHLD        -> waitpid(-1, ..., WNOHANG) loop
+ *   - otherwise      -> pause() forever
+ * Built via native/Makefile; the hollow runtime doesn't exec it (sandboxes
+ * are simulated), but a real CRI integration points its sandbox image here.
+ */
+
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#define VERSION "tpu-pause-1.0"
+
+static void sigdown(int signo) {
+  psignal(signo, "shutting down, got signal");
+  exit(0);
+}
+
+static void sigreap(int signo) {
+  (void)signo;
+  while (waitpid(-1, NULL, WNOHANG) > 0)
+    ;
+}
+
+int main(int argc, char **argv) {
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "-v") || !strcmp(argv[i], "--version")) {
+      printf("%s\n", VERSION);
+      return 0;
+    }
+  }
+  if (getpid() != 1)
+    fprintf(stderr, "warning: pause should be the first process\n");
+
+  if (sigaction(SIGINT, &(struct sigaction){.sa_handler = sigdown}, NULL) < 0)
+    return 1;
+  if (sigaction(SIGTERM, &(struct sigaction){.sa_handler = sigdown}, NULL) < 0)
+    return 2;
+  if (sigaction(SIGCHLD,
+                &(struct sigaction){.sa_handler = sigreap,
+                                    .sa_flags = SA_NOCLDSTOP},
+                NULL) < 0)
+    return 3;
+
+  for (;;)
+    pause();
+  fprintf(stderr, "error: infinite loop terminated\n");
+  return 42;
+}
