@@ -177,7 +177,13 @@ class APIServer:
                     self._send_json(410, status_error(410, "Expired", str(e)))
 
             def _serve_watch(self, resource: str, q) -> None:
-                since = int(q.get("resourceVersion", ["0"])[0] or 0)
+                raw = q.get("resourceVersion", [""])[0]
+                try:
+                    since = int(raw) if raw != "" else None
+                except ValueError:
+                    self._send_json(400, status_error(
+                        400, "BadRequest", f"invalid resourceVersion {raw!r}"))
+                    return
                 w = server.store.watch(resource, since_rv=since)
                 with server._metrics_lock:
                     server.metrics["watch_streams"] += 1

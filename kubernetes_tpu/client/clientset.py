@@ -69,7 +69,7 @@ class Client:
     def list(self, resource: str, namespace: str | None = None) -> tuple[list[Obj], int]:
         raise NotImplementedError
 
-    def watch(self, resource: str, since_rv: int = 0) -> Watch:
+    def watch(self, resource: str, since_rv: int | None = None) -> Watch:
         raise NotImplementedError
 
     # -- conveniences used across the tree --------------------------------
@@ -173,5 +173,5 @@ class LocalClient(Client):
     def list(self, resource: str, namespace: str | None = None) -> tuple[list[Obj], int]:
         return self.store.list(resource, namespace)
 
-    def watch(self, resource: str, since_rv: int = 0) -> Watch:
+    def watch(self, resource: str, since_rv: int | None = None) -> Watch:
         return self.store.watch(resource, since_rv)

@@ -176,6 +176,8 @@ class HTTPClient(Client):
         data = self._request("GET", self._path(resource, namespace))
         return data.get("items", []), int(data["metadata"]["resourceVersion"])
 
-    def watch(self, resource: str, since_rv: int = 0):
-        path = self._path(resource) + f"?watch=true&resourceVersion={since_rv}"
+    def watch(self, resource: str, since_rv: int | None = None):
+        path = self._path(resource) + "?watch=true"
+        if since_rv is not None:
+            path += f"&resourceVersion={since_rv}"
         return HTTPWatch(self.host, self.port, path, self._headers)

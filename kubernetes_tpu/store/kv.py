@@ -255,16 +255,20 @@ class MemoryStore:
         with self._lock:
             return len(self._table(resource))
 
-    def watch(self, resource: str, since_rv: int = 0) -> Watch:
+    def watch(self, resource: str, since_rv: int | None = None) -> Watch:
         """Open a watch delivering every event with revision > since_rv.
 
-        since_rv=0 means "from now".  Raises TooOldError if since_rv predates
-        the retained history (client must re-list, reflector.go semantics).
+        since_rv=None means "from now".  since_rv=0 is a real revision (the
+        rv an empty-store list returns) and replays ALL retained history —
+        conflating it with "from now" loses events created between a client's
+        list and the watch registration.  Raises TooOldError if since_rv
+        predates the retained history (client must re-list, reflector.go
+        semantics).
         """
         with self._lock:
             w = Watch(self, resource)
             hist = self._history.get(resource)
-            if since_rv and hist:
+            if since_rv is not None and hist:
                 # If the ring is full, events older than hist[0] were dropped;
                 # we can only guarantee completeness for since_rv at or past
                 # hist[0].revision - 1 (conservative, like etcd compaction).

@@ -127,6 +127,20 @@ class TestWatch:
         s.create("pods", pod("a"))
         assert w.next(timeout=0.1) is None
 
+    def test_watch_since_rv_zero_replays(self):
+        """rv=0 is the revision an empty-store list returns; a watch from it
+        must replay events created between the list and the watch call —
+        conflating it with "from now" (None) drops them (the informer
+        bootstrap race: list empty -> object created -> watch)."""
+        s = kv.MemoryStore()
+        _, rv = s.list("nodes")
+        assert rv == 0
+        s.create("nodes", meta.new_object("Node", "n1", None))
+        w = s.watch("nodes", since_rv=rv)
+        ev = w.next(timeout=1)
+        assert ev is not None and ev.type == kv.ADDED
+        assert meta.name(ev.object) == "n1"
+
 
 class TestInformer:
     def test_sync_and_events(self):
