@@ -43,6 +43,7 @@ def main(argv=None) -> None:
         from ..ops.flatten import Caps
         backend = TPUBatchBackend(Caps(n_cap=max(1024, args.nodes * 2)),
                                   batch_size=args.batch_size)
+        backend.warmup()
         profile = Profile(fw, batch_backend=backend, batch_size=args.batch_size)
     else:
         profile = Profile(fw)

@@ -121,6 +121,7 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
         from ..ops.backend import TPUBatchBackend
         from ..ops.flatten import Caps
         backend = TPUBatchBackend(caps or Caps(), batch_size=batch_size)
+        backend.warmup()
         fw = new_default_framework(client, factory)
         profiles = {"default-scheduler": Profile(
             fw, batch_backend=backend, batch_size=batch_size)}
