@@ -522,6 +522,13 @@ class BatchEncoder:
         t, c = self.t, self.t.caps
         if pi.nominated_node_name:
             return False  # preemption nominations go through the per-pod path
+        for v in (pi.pod.get("spec") or {}).get("volumes") or ():
+            if (v.get("persistentVolumeClaim") or v.get("gcePersistentDisk")
+                    or v.get("awsElasticBlockStore") or v.get("azureDisk")
+                    or v.get("iscsi") or v.get("csi")):
+                # volume binding/zones/limits are deeply stateful (PVC/PV/
+                # StorageClass lookups + API writes at PreBind): oracle path
+                return False
         self.t._encode_resource(b.req[i], pi.request)
         self.t._encode_resource(b.req_nz[i], pi.request_nonzero)
 

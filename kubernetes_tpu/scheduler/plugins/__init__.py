@@ -16,8 +16,13 @@ from .nodebasic import (
     TaintToleration,
 )
 from .noderesources import NodeResourcesBalancedAllocation, NodeResourcesFit
+from .nodevolumelimits import NodeVolumeLimits
 from .podtopologyspread import PodTopologySpread
 from .queuesort import PrioritySort
+from .selectorspread import SelectorSpread
+from .volumebinding import VolumeBinding
+from .volumerestrictions import VolumeRestrictions
+from .volumezone import VolumeZone
 
 # default score weights (default_plugins.go: NodeResourcesBalancedAllocation 1,
 # ImageLocality 1, InterPodAffinity 1, NodeResourcesFit 1, NodeAffinity 1,
@@ -51,6 +56,14 @@ def in_tree_registry() -> Registry:
         "DefaultBinder": lambda args, h: DefaultBinder(h.client),
         "DefaultPreemption": lambda args, h: DefaultPreemption(h.client),
         "Coscheduling": lambda args, h: Coscheduling(h.client, h),
+        "VolumeBinding":
+            lambda args, h: VolumeBinding(h.client, h.informer_factory),
+        "VolumeRestrictions":
+            lambda args, h: VolumeRestrictions(h.informer_factory),
+        "VolumeZone": lambda args, h: VolumeZone(h.informer_factory),
+        "NodeVolumeLimits":
+            lambda args, h: NodeVolumeLimits(h.informer_factory),
+        "SelectorSpread": lambda args, h: SelectorSpread(h.informer_factory),
     }
 
 
@@ -66,9 +79,15 @@ DEFAULT_PLUGINS = [
     "InterPodAffinity",
     "NodeResourcesBalancedAllocation",
     "ImageLocality",
+    "VolumeBinding",
+    "VolumeRestrictions",
+    "VolumeZone",
+    "NodeVolumeLimits",
     "DefaultPreemption",
     "DefaultBinder",
 ]
+# SelectorSpread is registered but not default-enabled (default_plugins.go:
+# PodTopologySpread subsumed it in v1.25+).
 
 
 def build_default_plugins(handle: Handle, enabled: list[str] | None = None,

@@ -1,3 +1,7 @@
 """Testing kit (reference: pkg/scheduler/testing)."""
 
-from .wrappers import NodeWrapper, PodWrapper, make_node, make_pod  # noqa: F401
+from .wrappers import (  # noqa: F401
+    NodeWrapper, PodWrapper, make_node, make_pod, make_pv, make_pvc,
+    make_storage_class,
+)
+from .fake import FakeInformer, FakeInformerFactory  # noqa: F401,E402

@@ -1,0 +1,49 @@
+"""Fake informer factory for plugin unit tests.
+
+Mirrors the role of framework/fake/listers.go: hand-populated listers with
+the Informer get/list surface, no watch machinery.
+"""
+
+from __future__ import annotations
+
+from ..api import meta
+from ..api.meta import Obj
+
+
+class FakeInformer:
+    def __init__(self):
+        self._indexer: dict[str, Obj] = {}
+
+    def add(self, obj: Obj) -> None:
+        self._indexer[meta.namespaced_name(obj)] = obj
+
+    def get(self, namespace: str, name: str) -> Obj | None:
+        key = f"{namespace}/{name}" if namespace else name
+        return self._indexer.get(key)
+
+    def get_by_key(self, key: str) -> Obj | None:
+        return self._indexer.get(key)
+
+    def list(self, namespace: str | None = None) -> list[Obj]:
+        if namespace:
+            prefix = namespace + "/"
+            return [o for k, o in self._indexer.items()
+                    if k.startswith(prefix)]
+        return list(self._indexer.values())
+
+    def __len__(self) -> int:
+        return len(self._indexer)
+
+
+class FakeInformerFactory:
+    def __init__(self):
+        self._informers: dict[str, FakeInformer] = {}
+
+    def informer(self, resource: str) -> FakeInformer:
+        inf = self._informers.get(resource)
+        if inf is None:
+            inf = self._informers[resource] = FakeInformer()
+        return inf
+
+    def add(self, resource: str, obj: Obj) -> None:
+        self.informer(resource).add(obj)

@@ -108,6 +108,16 @@ class PodWrapper:
             {"containerPort": port, "hostPort": port, "protocol": protocol})
         return self
 
+    def pvc(self, claim_name: str, volume_name: str | None = None) -> "PodWrapper":
+        self.obj["spec"].setdefault("volumes", []).append(
+            {"name": volume_name or claim_name,
+             "persistentVolumeClaim": {"claimName": claim_name}})
+        return self
+
+    def inline_volume(self, volume: dict) -> "PodWrapper":
+        self.obj["spec"].setdefault("volumes", []).append(volume)
+        return self
+
     def build(self) -> Obj:
         if not self.obj["spec"]["containers"]:
             self.container("img")
@@ -163,3 +173,50 @@ def make_pod(name: str, namespace: str = "default") -> PodWrapper:
 
 def make_node(name: str) -> NodeWrapper:
     return NodeWrapper(name)
+
+
+def make_pvc(name: str, namespace: str = "default", storage: str = "1Gi",
+             storage_class: str | None = None, volume_name: str | None = None,
+             access_modes: list[str] | None = None) -> Obj:
+    pvc = meta.new_object("PersistentVolumeClaim", name, namespace)
+    pvc["spec"] = {
+        "accessModes": access_modes or ["ReadWriteOnce"],
+        "resources": {"requests": {"storage": storage}},
+    }
+    if storage_class:
+        pvc["spec"]["storageClassName"] = storage_class
+    if volume_name:
+        pvc["spec"]["volumeName"] = volume_name
+    return pvc
+
+
+def make_pv(name: str, storage: str = "1Gi",
+            storage_class: str | None = None,
+            access_modes: list[str] | None = None,
+            zone: str | None = None,
+            node_affinity_hostname: str | None = None) -> Obj:
+    pv = meta.new_object("PersistentVolume", name, None)
+    pv["spec"] = {
+        "capacity": {"storage": storage},
+        "accessModes": access_modes or ["ReadWriteOnce"],
+    }
+    if storage_class:
+        pv["spec"]["storageClassName"] = storage_class
+    if zone:
+        pv["metadata"].setdefault("labels", {})[
+            "topology.kubernetes.io/zone"] = zone
+    if node_affinity_hostname:
+        pv["spec"]["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
+            {"matchExpressions": [{"key": "kubernetes.io/hostname",
+                                   "operator": "In",
+                                   "values": [node_affinity_hostname]}]}]}}
+    return pv
+
+
+def make_storage_class(name: str, provisioner: str = "example.com/prov",
+                       wait_for_first_consumer: bool = False) -> Obj:
+    sc = meta.new_object("StorageClass", name, None)
+    sc["provisioner"] = provisioner
+    sc["volumeBindingMode"] = ("WaitForFirstConsumer"
+                               if wait_for_first_consumer else "Immediate")
+    return sc

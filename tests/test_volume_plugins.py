@@ -1,0 +1,413 @@
+"""Volume plugin suite + SelectorSpread tests.
+
+Mirrors the reference's per-plugin tables:
+  plugins/volumebinding/volume_binding_test.go
+  plugins/volumerestrictions/volume_restrictions_test.go
+  plugins/volumezone/volume_zone_test.go
+  plugins/nodevolumelimits/csi_test.go
+  plugins/selectorspread/selector_spread_perf_test.go
+"""
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client.clientset import (
+    CSINODES, PVCS, PVS, REPLICASETS, SERVICES, STORAGECLASSES, LocalClient,
+)
+from kubernetes_tpu.scheduler.cache import Snapshot
+from kubernetes_tpu.scheduler.framework import CycleState
+from kubernetes_tpu.scheduler.plugins.nodevolumelimits import NodeVolumeLimits
+from kubernetes_tpu.scheduler.plugins.selectorspread import SelectorSpread
+from kubernetes_tpu.scheduler.plugins.volumebinding import (
+    SELECTED_NODE_ANNOTATION, VolumeBinding,
+)
+from kubernetes_tpu.scheduler.plugins.volumerestrictions import VolumeRestrictions
+from kubernetes_tpu.scheduler.plugins.volumezone import VolumeZone
+from kubernetes_tpu.scheduler.types import (
+    SKIP, UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE, NodeInfo, PodInfo,
+)
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import (
+    FakeInformerFactory, make_node, make_pod, make_pv, make_pvc,
+    make_storage_class,
+)
+
+
+def ni(node, pods=()):
+    info = NodeInfo(node)
+    for p in pods:
+        info.add_pod(PodInfo(p))
+    return info
+
+
+def snapshot_of(*node_infos):
+    s = Snapshot()
+    for n in node_infos:
+        s.node_info_map[n.name] = n
+    s.node_info_list = list(node_infos)
+    return s
+
+
+class TestVolumeBinding:
+    def test_no_volumes_skips(self):
+        plugin = VolumeBinding(informer_factory=FakeInformerFactory())
+        pod = PodInfo(make_pod("p").build())
+        _, status = plugin.pre_filter(CycleState(), pod, snapshot_of())
+        assert status is not None and status.code == SKIP
+
+    def test_missing_pvc_unresolvable(self):
+        plugin = VolumeBinding(informer_factory=FakeInformerFactory())
+        pod = PodInfo(make_pod("p").pvc("missing").build())
+        _, status = plugin.pre_filter(CycleState(), pod, snapshot_of())
+        assert status.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_unbound_immediate_unschedulable(self):
+        f = FakeInformerFactory()
+        f.add(STORAGECLASSES, make_storage_class("fast"))
+        f.add(PVCS, make_pvc("c", storage_class="fast"))
+        plugin = VolumeBinding(informer_factory=f)
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        _, status = plugin.pre_filter(CycleState(), pod, snapshot_of())
+        assert status.code == UNSCHEDULABLE
+        assert "unbound immediate" in status.message()
+
+    def test_bound_pv_node_affinity(self):
+        f = FakeInformerFactory()
+        f.add(PVS, make_pv("pv1", node_affinity_hostname="n1"))
+        f.add(PVCS, make_pvc("c", volume_name="pv1"))
+        plugin = VolumeBinding(informer_factory=f)
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        state = CycleState()
+        _, status = plugin.pre_filter(state, pod, snapshot_of())
+        assert status is None
+        n1 = ni(make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build())
+        n2 = ni(make_node("n2").labels(**{"kubernetes.io/hostname": "n2"}).build())
+        assert plugin.filter(state, pod, n1) is None
+        st = plugin.filter(state, pod, n2)
+        assert st is not None and "affinity conflict" in st.message()
+
+    def test_wffc_static_binding_smallest_fit(self):
+        f = FakeInformerFactory()
+        f.add(STORAGECLASSES,
+              make_storage_class("wffc", wait_for_first_consumer=True))
+        f.add(PVCS, make_pvc("c", storage="1Gi", storage_class="wffc"))
+        f.add(PVS, make_pv("pv-big", storage="10Gi", storage_class="wffc"))
+        f.add(PVS, make_pv("pv-small", storage="1Gi", storage_class="wffc"))
+        plugin = VolumeBinding(informer_factory=f)
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        state = CycleState()
+        _, status = plugin.pre_filter(state, pod, snapshot_of())
+        assert status is None
+        node = ni(make_node("n1").build())
+        assert plugin.filter(state, pod, node) is None
+        st = state.read("VolumeBinding/state")
+        bindings = st.bindings_by_node["n1"]
+        assert len(bindings) == 1
+        assert meta.name(bindings[0][1]) == "pv-small"
+
+    def test_wffc_no_pv_no_provisioner_fails(self):
+        f = FakeInformerFactory()
+        f.add(STORAGECLASSES, make_storage_class(
+            "wffc", provisioner="kubernetes.io/no-provisioner",
+            wait_for_first_consumer=True))
+        f.add(PVCS, make_pvc("c", storage_class="wffc"))
+        plugin = VolumeBinding(informer_factory=f)
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        state = CycleState()
+        plugin.pre_filter(state, pod, snapshot_of())
+        st = plugin.filter(state, pod, ni(make_node("n1").build()))
+        assert st is not None and st.code == UNSCHEDULABLE
+
+    def test_wffc_dynamic_provisioning_allowed(self):
+        f = FakeInformerFactory()
+        f.add(STORAGECLASSES, make_storage_class(
+            "wffc", provisioner="ebs.csi.aws.com",
+            wait_for_first_consumer=True))
+        f.add(PVCS, make_pvc("c", storage_class="wffc"))
+        plugin = VolumeBinding(informer_factory=f)
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        state = CycleState()
+        plugin.pre_filter(state, pod, snapshot_of())
+        assert plugin.filter(state, pod, ni(make_node("n1").build())) is None
+        st = state.read("VolumeBinding/state")
+        assert st.bindings_by_node["n1"][0][1] is None  # dynamic
+
+    def test_reserve_prevents_double_assume(self):
+        f = FakeInformerFactory()
+        f.add(STORAGECLASSES, make_storage_class(
+            "wffc", provisioner="kubernetes.io/no-provisioner",
+            wait_for_first_consumer=True))
+        f.add(PVCS, make_pvc("c1", storage_class="wffc"))
+        f.add(PVCS, make_pvc("c2", storage_class="wffc"))
+        f.add(PVS, make_pv("pv1", storage_class="wffc"))
+        plugin = VolumeBinding(informer_factory=f)
+        node = ni(make_node("n1").build())
+
+        pod1 = PodInfo(make_pod("p1").pvc("c1").build())
+        s1 = CycleState()
+        plugin.pre_filter(s1, pod1, snapshot_of())
+        assert plugin.filter(s1, pod1, node) is None
+        plugin.reserve(s1, pod1, "n1")
+
+        # pv1 is now assumed; second pod must not match it
+        pod2 = PodInfo(make_pod("p2").pvc("c2").build())
+        s2 = CycleState()
+        plugin.pre_filter(s2, pod2, snapshot_of())
+        st = plugin.filter(s2, pod2, node)
+        assert st is not None  # no provisioner fallback for default class
+
+        plugin.unreserve(s1, pod1, "n1")
+        s3 = CycleState()
+        plugin.pre_filter(s3, pod2, snapshot_of())
+        assert plugin.filter(s3, pod2, node) is None
+
+    def test_pre_bind_writes_bindings(self):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        f = FakeInformerFactory()
+        sc = make_storage_class("wffc", wait_for_first_consumer=True)
+        pvc = make_pvc("c", storage_class="wffc")
+        pv = make_pv("pv1", storage_class="wffc")
+        dyn_pvc = make_pvc("cdyn", storage_class="dyn")
+        dyn_sc = make_storage_class("dyn", provisioner="csi.x.io",
+                                    wait_for_first_consumer=True)
+        for r, o in ((STORAGECLASSES, sc), (STORAGECLASSES, dyn_sc),
+                     (PVCS, pvc), (PVCS, dyn_pvc), (PVS, pv)):
+            f.add(r, o)
+            store.create(r, o)
+        plugin = VolumeBinding(client=client, informer_factory=f)
+        pod = PodInfo(make_pod("p").pvc("c").pvc("cdyn").build())
+        state = CycleState()
+        _, status = plugin.pre_filter(state, pod, snapshot_of())
+        assert status is None
+        node = ni(make_node("n1").build())
+        assert plugin.filter(state, pod, node) is None
+        plugin.reserve(state, pod, "n1")
+        assert plugin.pre_bind(state, pod, "n1") is None
+        bound_pvc = store.get(PVCS, "default", "c")
+        assert bound_pvc["spec"]["volumeName"] == "pv1"
+        bound_pv = store.get(PVS, "", "pv1")
+        assert bound_pv["spec"]["claimRef"]["name"] == "c"
+        annotated = store.get(PVCS, "default", "cdyn")
+        assert annotated["metadata"]["annotations"][
+            SELECTED_NODE_ANNOTATION] == "n1"
+
+
+class TestVolumeRestrictions:
+    def test_gce_pd_conflict(self):
+        vol = {"name": "d", "gcePersistentDisk": {"pdName": "disk1"}}
+        existing = make_pod("e").inline_volume(vol).node("n1").build()
+        node = ni(make_node("n1").build(), [existing])
+        plugin = VolumeRestrictions()
+        pod = PodInfo(make_pod("p").inline_volume(dict(vol)).build())
+        _, status = plugin.pre_filter(CycleState(), pod, snapshot_of(node))
+        assert status is None
+        st = plugin.filter(CycleState(), pod, node)
+        assert st is not None and st.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_gce_pd_both_read_only_ok(self):
+        ro = {"name": "d", "gcePersistentDisk": {"pdName": "disk1",
+                                                 "readOnly": True}}
+        existing = make_pod("e").inline_volume(ro).node("n1").build()
+        node = ni(make_node("n1").build(), [existing])
+        plugin = VolumeRestrictions()
+        pod = PodInfo(make_pod("p").inline_volume(dict(ro)).build())
+        assert plugin.filter(CycleState(), pod, node) is None
+
+    def test_aws_ebs_conflict_even_read_only(self):
+        ro = {"name": "d", "awsElasticBlockStore": {"volumeID": "v1",
+                                                    "readOnly": True}}
+        existing = make_pod("e").inline_volume(ro).node("n1").build()
+        node = ni(make_node("n1").build(), [existing])
+        plugin = VolumeRestrictions()
+        pod = PodInfo(make_pod("p").inline_volume(dict(ro)).build())
+        assert plugin.filter(CycleState(), pod, node) is not None
+
+    def test_read_write_once_pod(self):
+        f = FakeInformerFactory()
+        f.add(PVCS, make_pvc("c", access_modes=["ReadWriteOncePod"]))
+        plugin = VolumeRestrictions(informer_factory=f)
+        user = make_pod("e").pvc("c").node("n1").build()
+        node = ni(make_node("n1").build(), [user])
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        _, status = plugin.pre_filter(CycleState(), pod, snapshot_of(node))
+        assert status is not None and status.code == UNSCHEDULABLE
+        assert "ReadWriteOncePod" in status.message()
+
+    def test_no_volumes_skips(self):
+        plugin = VolumeRestrictions(informer_factory=FakeInformerFactory())
+        pod = PodInfo(make_pod("p").build())
+        _, status = plugin.pre_filter(CycleState(), pod, snapshot_of())
+        assert status is not None and status.code == SKIP
+
+
+class TestVolumeZone:
+    def _factory(self):
+        f = FakeInformerFactory()
+        f.add(PVS, make_pv("pv1", zone="us-a"))
+        f.add(PVCS, make_pvc("c", volume_name="pv1"))
+        return f
+
+    def test_zone_match(self):
+        plugin = VolumeZone(informer_factory=self._factory())
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        good = ni(make_node("n1").zone("us-a").build())
+        bad = ni(make_node("n2").zone("us-b").build())
+        assert plugin.filter(CycleState(), pod, good) is None
+        st = plugin.filter(CycleState(), pod, bad)
+        assert st is not None and "volume zone" in st.message()
+
+    def test_comma_separated_zone_set(self):
+        f = FakeInformerFactory()
+        pv = make_pv("pv1")
+        pv["metadata"].setdefault("labels", {})[
+            "topology.kubernetes.io/zone"] = "us-a,us-b"
+        f.add(PVS, pv)
+        f.add(PVCS, make_pvc("c", volume_name="pv1"))
+        plugin = VolumeZone(informer_factory=f)
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        assert plugin.filter(
+            CycleState(), pod, ni(make_node("n").zone("us-b").build())) is None
+
+    def test_unbound_pvc_ignored(self):
+        f = FakeInformerFactory()
+        f.add(PVCS, make_pvc("c"))
+        plugin = VolumeZone(informer_factory=f)
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        assert plugin.filter(
+            CycleState(), pod, ni(make_node("n").zone("z").build())) is None
+
+
+class TestNodeVolumeLimits:
+    def test_csinode_limit(self):
+        f = FakeInformerFactory()
+        csinode = meta.new_object("CSINode", "n1", None)
+        csinode["spec"] = {"drivers": [
+            {"name": "csi.x.io", "allocatable": {"count": 2}}]}
+        f.add(CSINODES, csinode)
+        plugin = NodeVolumeLimits(informer_factory=f)
+
+        def csi_pod(name, handle):
+            return make_pod(name).inline_volume(
+                {"name": handle,
+                 "csi": {"driver": "csi.x.io", "volumeHandle": handle}}).build()
+
+        existing = [csi_pod("e1", "v1"), csi_pod("e2", "v2")]
+        node = ni(make_node("n1").build(), existing)
+        pod = PodInfo(csi_pod("p", "v3"))
+        st = plugin.filter(CycleState(), pod, node)
+        assert st is not None and "max volume count" in st.message()
+        # same volume handle does not add a new attachment
+        dup = PodInfo(csi_pod("p2", "v1"))
+        assert plugin.filter(CycleState(), dup, node) is None
+
+    def test_legacy_ebs_default_limit(self):
+        plugin = NodeVolumeLimits(informer_factory=FakeInformerFactory())
+
+        def ebs_pod(name, vid):
+            return make_pod(name).inline_volume(
+                {"name": vid,
+                 "awsElasticBlockStore": {"volumeID": vid}}).build()
+
+        existing = [ebs_pod(f"e{i}", f"v{i}") for i in range(39)]
+        node = ni(make_node("n1").build(), existing)
+        pod = PodInfo(ebs_pod("p", "v-new"))
+        st = plugin.filter(CycleState(), pod, node)
+        assert st is not None
+
+    def test_no_volumes_skip(self):
+        plugin = NodeVolumeLimits(informer_factory=FakeInformerFactory())
+        pod = PodInfo(make_pod("p").build())
+        _, status = plugin.pre_filter(CycleState(), pod, snapshot_of())
+        assert status is not None and status.code == SKIP
+
+
+class TestSelectorSpread:
+    def _factory(self):
+        f = FakeInformerFactory()
+        svc = meta.new_object("Service", "svc", "default")
+        svc["spec"] = {"selector": {"app": "web"}}
+        f.add(SERVICES, svc)
+        return f
+
+    def test_spreads_away_from_loaded_nodes(self):
+        f = self._factory()
+        plugin = SelectorSpread(informer_factory=f)
+        pod = PodInfo(make_pod("p").labels(app="web").build())
+        loaded = ni(make_node("n1").build(), [
+            make_pod("e1").labels(app="web").node("n1").build(),
+            make_pod("e2").labels(app="web").node("n1").build()])
+        empty = ni(make_node("n2").build())
+        state = CycleState()
+        status = plugin.pre_score(state, pod, [loaded, empty])
+        assert status is None
+        s1, _ = plugin.score(state, pod, loaded)
+        s2, _ = plugin.score(state, pod, empty)
+        scores = {"n1": s1, "n2": s2}
+        plugin.normalize_scores(state, pod, scores)
+        assert scores["n2"] > scores["n1"]
+
+    def test_no_matching_selector_skips(self):
+        plugin = SelectorSpread(informer_factory=FakeInformerFactory())
+        pod = PodInfo(make_pod("p").labels(app="web").build())
+        status = plugin.pre_score(CycleState(), pod, [])
+        assert status is not None and status.code == SKIP
+
+    def test_replicaset_selector_counts(self):
+        f = FakeInformerFactory()
+        rs = meta.new_object("ReplicaSet", "rs", "default")
+        rs["spec"] = {"selector": {"matchLabels": {"app": "db"}}}
+        f.add(REPLICASETS, rs)
+        plugin = SelectorSpread(informer_factory=f)
+        pod = PodInfo(make_pod("p").labels(app="db").build())
+        node = ni(make_node("n1").build(),
+                  [make_pod("e").labels(app="db").node("n1").build()])
+        state = CycleState()
+        assert plugin.pre_score(state, pod, [node]) is None
+        s, _ = plugin.score(state, pod, node)
+        assert s == 1
+
+
+class TestVolumeBindingE2E:
+    def test_wffc_pod_scheduled_and_pvc_bound(self):
+        """Full pipeline: pod with a WaitForFirstConsumer PVC schedules onto
+        the node whose PV matches, and PreBind writes the PVC/PV binding."""
+        import time
+
+        from kubernetes_tpu.client import SharedInformerFactory
+        from kubernetes_tpu.client.clientset import NODES, PODS
+        from kubernetes_tpu.scheduler import new_scheduler
+
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        store.create(STORAGECLASSES, make_storage_class(
+            "wffc", provisioner="kubernetes.io/no-provisioner",
+            wait_for_first_consumer=True))
+        store.create(PVS, make_pv("pv1", storage_class="wffc",
+                                  node_affinity_hostname="n2"))
+        store.create(PVCS, make_pvc("c", storage_class="wffc"))
+        factory = SharedInformerFactory(client)
+        sched = new_scheduler(client, factory)
+        factory.start()
+        assert factory.wait_for_cache_sync()
+        sched.run()
+        try:
+            for n in ("n1", "n2", "n3"):
+                client.create(NODES, make_node(n).labels(
+                    **{"kubernetes.io/hostname": n}).build())
+            client.create(PODS, make_pod("p").req(cpu="100m").pvc("c").build())
+            deadline = time.time() + 15
+            bound = None
+            while time.time() < deadline:
+                bound = meta.pod_node_name(client.get(PODS, "default", "p"))
+                if bound:
+                    break
+                time.sleep(0.05)
+            assert bound == "n2"  # the only node pv1's affinity allows
+            pvc = store.get(PVCS, "default", "c")
+            assert pvc["spec"]["volumeName"] == "pv1"
+            pv = store.get(PVS, "", "pv1")
+            assert pv["spec"]["claimRef"]["name"] == "c"
+        finally:
+            sched.stop()
+            factory.stop()
