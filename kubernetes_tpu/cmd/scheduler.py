@@ -17,6 +17,8 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="tpu-scheduler")
     ap.add_argument("--server", default="http://127.0.0.1:8080")
     ap.add_argument("--token", default=None)
+    ap.add_argument("--config", default=None,
+                    help="KubeSchedulerConfiguration YAML path")
     ap.add_argument("--leader-elect", action="store_true")
     ap.add_argument("--tpu-batch", action="store_true",
                     help="enable the TPU batch scheduling backend")
@@ -33,16 +35,31 @@ def main(argv=None) -> None:
 
     client = HTTPClient.from_url(args.server, args.token)
     factory = SharedInformerFactory(client)
-    fw = new_default_framework(client, factory)
-    if args.tpu_batch:
-        from ..ops.backend import TPUBatchBackend
-        from ..ops.flatten import Caps
-        backend = TPUBatchBackend(Caps(n_cap=args.node_capacity),
-                                  batch_size=args.batch_size)
-        profile = Profile(fw, batch_backend=backend, batch_size=args.batch_size)
+    if args.config:
+        from ..scheduler.config import load_config, scheduler_from_config
+        sched = scheduler_from_config(client, factory, load_config(args.config))
+        if args.tpu_batch:
+            from ..ops.backend import TPUBatchBackend
+            from ..ops.flatten import Caps
+            backend = TPUBatchBackend(Caps(n_cap=args.node_capacity),
+                                      batch_size=args.batch_size)
+            backend.warmup()
+            for profile in sched.profiles.values():
+                profile.batch_backend = backend
+                profile.batch_size = args.batch_size
     else:
-        profile = Profile(fw)
-    sched = Scheduler(client, factory, {"default-scheduler": profile})
+        fw = new_default_framework(client, factory)
+        if args.tpu_batch:
+            from ..ops.backend import TPUBatchBackend
+            from ..ops.flatten import Caps
+            backend = TPUBatchBackend(Caps(n_cap=args.node_capacity),
+                                      batch_size=args.batch_size)
+            backend.warmup()
+            profile = Profile(fw, batch_backend=backend,
+                              batch_size=args.batch_size)
+        else:
+            profile = Profile(fw)
+        sched = Scheduler(client, factory, {"default-scheduler": profile})
     factory.start()
     factory.wait_for_cache_sync()
 

@@ -5,6 +5,10 @@ from __future__ import annotations
 from ..client.clientset import Client
 from ..client.informer import SharedInformerFactory
 from .cache import Cache, Snapshot
+from .config import (
+    SchedulerConfig, load_config, scheduler_from_config,
+)
+from .extender import Extender, HTTPExtender
 from .framework import CycleState, Framework, Handle
 from .plugins import DEFAULT_PLUGINS, DEFAULT_SCORE_WEIGHTS, build_default_plugins
 from .queue import SchedulingQueue

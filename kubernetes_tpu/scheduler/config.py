@@ -1,0 +1,230 @@
+"""KubeSchedulerConfiguration — the scheduler's component config.
+
+Reference: pkg/scheduler/apis/config/ (types.go:41 KubeSchedulerConfiguration,
+types.go:100+ KubeSchedulerProfile/Plugins/PluginSet, types_pluginargs.go)
+and apis/config/v1/default_plugins.go:28 (the single MultiPoint default
+list + the enabled/disabled merge rules).  Shape accepted (YAML or dict):
+
+  apiVersion: kubescheduler.config.k8s.io/v1
+  kind: KubeSchedulerConfiguration
+  parallelism: 16
+  percentageOfNodesToScore: 0
+  podInitialBackoffSeconds: 1
+  podMaxBackoffSeconds: 10
+  profiles:
+    - schedulerName: default-scheduler
+      percentageOfNodesToScore: 0
+      plugins:
+        multiPoint:
+          enabled: [{name: Coscheduling}]
+          disabled: [{name: ImageLocality}]     # or [{name: "*"}]
+        score:
+          disabled: [{name: NodeResourcesFit}]  # point-scoped disable
+          enabled: [{name: TaintToleration, weight: 3}]
+      pluginConfig:
+        - name: NodeResourcesFit
+          args: {strategy: MostAllocated}
+  extenders:
+    - urlPrefix: http://127.0.0.1:9000
+      filterVerb: filter
+      weight: 2
+
+Merge semantics (default_plugins.go mergePlugins):
+  1. start from the default MultiPoint list;
+  2. multiPoint.disabled removes names ("*" clears the list);
+  3. multiPoint.enabled appends (weight applies to Score);
+  4. each point's .disabled masks that point only ("*" masks every default);
+  5. each point's .enabled appends plugins to that point only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from .extender import build_extenders
+from .framework import Framework, Handle
+from .plugins import (
+    DEFAULT_PLUGINS, DEFAULT_SCORE_WEIGHTS, build_default_plugins,
+    in_tree_registry,
+)
+
+EXTENSION_POINTS = ("queueSort", "preFilter", "filter", "postFilter",
+                    "preScore", "score", "reserve", "permit", "preBind",
+                    "bind", "postBind")
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class ProfileConfig:
+    scheduler_name: str = "default-scheduler"
+    percentage_of_nodes_to_score: int = 0
+    plugins: dict[str, Any] = field(default_factory=dict)
+    plugin_config: dict[str, dict] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfig:
+    parallelism: int = 16
+    percentage_of_nodes_to_score: int = 0
+    pod_initial_backoff: float = 1.0
+    pod_max_backoff: float = 10.0
+    profiles: list[ProfileConfig] = field(default_factory=list)
+    extenders: list[dict] = field(default_factory=list)
+
+
+def load_config(source: str | dict) -> SchedulerConfig:
+    """Parse + validate a KubeSchedulerConfiguration (path, YAML text or
+    dict).  Mirrors apis/config/validation/."""
+    if isinstance(source, str):
+        import yaml
+        try:
+            with open(source) as f:
+                data = yaml.safe_load(f)
+        except OSError:
+            data = yaml.safe_load(source)
+    else:
+        data = source
+    data = data or {}
+    kind = data.get("kind", "KubeSchedulerConfiguration")
+    if kind != "KubeSchedulerConfiguration":
+        raise ConfigError(f"unexpected kind {kind!r}")
+
+    cfg = SchedulerConfig(
+        parallelism=data.get("parallelism", 16),
+        percentage_of_nodes_to_score=data.get("percentageOfNodesToScore", 0),
+        pod_initial_backoff=data.get("podInitialBackoffSeconds", 1.0),
+        pod_max_backoff=data.get("podMaxBackoffSeconds", 10.0),
+        extenders=data.get("extenders") or [],
+    )
+    if cfg.parallelism <= 0:
+        raise ConfigError("parallelism must be positive")
+    if not 0 <= cfg.percentage_of_nodes_to_score <= 100:
+        raise ConfigError("percentageOfNodesToScore must be in [0,100]")
+
+    known = set(in_tree_registry())
+    seen_names: set[str] = set()
+    for p in data.get("profiles") or [{}]:
+        name = p.get("schedulerName", "default-scheduler")
+        if name in seen_names:
+            raise ConfigError(f"duplicate profile {name!r}")
+        seen_names.add(name)
+        plugins = p.get("plugins") or {}
+        for point, pset in plugins.items():
+            if point not in EXTENSION_POINTS + ("multiPoint",):
+                raise ConfigError(f"unknown extension point {point!r}")
+            for entry in list((pset or {}).get("enabled") or ()):
+                if entry["name"] not in known:
+                    raise ConfigError(
+                        f"unknown plugin {entry['name']!r} in {point}.enabled")
+        plugin_config = {pc["name"]: pc.get("args") or {}
+                         for pc in p.get("pluginConfig") or ()}
+        cfg.profiles.append(ProfileConfig(
+            scheduler_name=name,
+            percentage_of_nodes_to_score=p.get(
+                "percentageOfNodesToScore",
+                cfg.percentage_of_nodes_to_score),
+            plugins=plugins, plugin_config=plugin_config))
+    return cfg
+
+
+def _merge_plugin_sets(plugins_cfg: dict
+                       ) -> tuple[list[str], dict[str, int],
+                                  dict[str, set[str]], dict[str, list[str]]]:
+    """Apply the default_plugins.go merge. Returns:
+    (base plugin names, score weights, per-plugin disabled points,
+     per-point extra plugin names)."""
+    weights = dict(DEFAULT_SCORE_WEIGHTS)
+    base = list(DEFAULT_PLUGINS)
+
+    mp = plugins_cfg.get("multiPoint") or {}
+    disabled = [d["name"] for d in mp.get("disabled") or ()]
+    if "*" in disabled:
+        base = []
+    else:
+        base = [n for n in base if n not in disabled]
+    for e in mp.get("enabled") or ():
+        if e["name"] not in base:
+            base.append(e["name"])
+        if "weight" in e:
+            weights[e["name"]] = e["weight"]
+
+    disabled_points: dict[str, set[str]] = {}
+    extra_points: dict[str, list[str]] = {}
+    for point in EXTENSION_POINTS:
+        pset = plugins_cfg.get(point) or {}
+        for d in pset.get("disabled") or ():
+            if d["name"] == "*":
+                for n in base:
+                    disabled_points.setdefault(n, set()).add(point)
+            else:
+                disabled_points.setdefault(d["name"], set()).add(point)
+        for e in pset.get("enabled") or ():
+            extra_points.setdefault(point, []).append(e["name"])
+            if point == "score" and "weight" in e:
+                weights[e["name"]] = e["weight"]
+            # point-scoped enable overrides a point-scoped "*" disable
+            disabled_points.get(e["name"], set()).discard(point)
+    return base, weights, disabled_points, extra_points
+
+
+def build_framework_from_profile(client, informer_factory,
+                                 profile_cfg: ProfileConfig,
+                                 out_of_tree_registry=None) -> Framework:
+    """profile.NewMap body for one profile (profile/profile.go:48), with
+    WithFrameworkOutOfTreeRegistry merge (scheduler.go:180)."""
+    registry = in_tree_registry()
+    if out_of_tree_registry:
+        overlap = set(registry) & set(out_of_tree_registry)
+        if overlap:
+            raise ConfigError(
+                f"out-of-tree plugins shadow in-tree: {sorted(overlap)}")
+        registry.update(out_of_tree_registry)
+
+    base, weights, disabled_points, extra_points = _merge_plugin_sets(
+        profile_cfg.plugins)
+    extra_names = [n for names in extra_points.values() for n in names]
+    all_names = base + [n for n in extra_names if n not in base]
+    for n in all_names:
+        if n not in registry:
+            raise ConfigError(f"unknown plugin {n!r}")
+
+    handle = Handle(client=client, informer_factory=informer_factory)
+    plugins = [registry[n](profile_cfg.plugin_config.get(n), handle)
+               for n in all_names]
+
+    extra_only = {n for n in extra_names if n not in base}
+
+    def point_filter(name: str, point: str) -> bool:
+        if point in disabled_points.get(name, ()):
+            return False
+        if name in extra_only:
+            # enabled only at the points that named it
+            return name in extra_points.get(point, ())
+        return True
+
+    return Framework(profile_cfg.scheduler_name, plugins,
+                     score_weights=weights, handle=handle,
+                     point_filter=point_filter)
+
+
+def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
+                          out_of_tree_registry=None):
+    """Setup (cmd/kube-scheduler/app/server.go:307): config -> Scheduler."""
+    from .queue import SchedulingQueue  # noqa: F401  (backoff knobs below)
+    from .scheduler import Profile, Scheduler
+
+    profiles = {}
+    for pc in cfg.profiles or [ProfileConfig()]:
+        fw = build_framework_from_profile(client, informer_factory, pc,
+                                          out_of_tree_registry)
+        profiles[pc.scheduler_name] = Profile(
+            fw, percentage_of_nodes_to_score=pc.percentage_of_nodes_to_score)
+    sched = Scheduler(client, informer_factory, profiles,
+                      extenders=build_extenders(cfg.extenders))
+    sched.queue._initial_backoff = cfg.pod_initial_backoff
+    sched.queue._max_backoff = cfg.pod_max_backoff
+    return sched

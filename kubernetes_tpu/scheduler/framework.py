@@ -259,10 +259,16 @@ class Framework:
 
     def __init__(self, profile_name: str, plugins: Sequence[Plugin],
                  score_weights: dict[str, int] | None = None,
-                 handle: Handle | None = None):
+                 handle: Handle | None = None,
+                 point_filter: Callable[[str, str], bool] | None = None):
+        """point_filter(plugin_name, point) gates which extension points a
+        plugin is registered at — this is how the component config's
+        per-extension-point enable/disable (apis/config types.go Plugins)
+        maps onto the isinstance-based distribution below.  None = all."""
         self.profile_name = profile_name
         self.handle = handle or Handle()
         score_weights = score_weights or {}
+        allow = point_filter or (lambda name, point: True)
         self.queue_sort: QueueSortPlugin | None = None
         self.pre_filter: list[PreFilterPlugin] = []
         self.filter: list[FilterPlugin] = []
@@ -276,27 +282,27 @@ class Framework:
         self.post_bind: list[PostBindPlugin] = []
         self.all_plugins: list[Plugin] = list(plugins)
         for p in plugins:
-            if isinstance(p, QueueSortPlugin):
+            if isinstance(p, QueueSortPlugin) and allow(p.name, "queueSort"):
                 self.queue_sort = p
-            if isinstance(p, PreFilterPlugin):
+            if isinstance(p, PreFilterPlugin) and allow(p.name, "preFilter"):
                 self.pre_filter.append(p)
-            if isinstance(p, FilterPlugin):
+            if isinstance(p, FilterPlugin) and allow(p.name, "filter"):
                 self.filter.append(p)
-            if isinstance(p, PostFilterPlugin):
+            if isinstance(p, PostFilterPlugin) and allow(p.name, "postFilter"):
                 self.post_filter.append(p)
-            if isinstance(p, PreScorePlugin):
+            if isinstance(p, PreScorePlugin) and allow(p.name, "preScore"):
                 self.pre_score.append(p)
-            if isinstance(p, ScorePlugin):
+            if isinstance(p, ScorePlugin) and allow(p.name, "score"):
                 self.score.append((p, score_weights.get(p.name, 1)))
-            if isinstance(p, ReservePlugin):
+            if isinstance(p, ReservePlugin) and allow(p.name, "reserve"):
                 self.reserve.append(p)
-            if isinstance(p, PermitPlugin):
+            if isinstance(p, PermitPlugin) and allow(p.name, "permit"):
                 self.permit.append(p)
-            if isinstance(p, PreBindPlugin):
+            if isinstance(p, PreBindPlugin) and allow(p.name, "preBind"):
                 self.pre_bind.append(p)
-            if isinstance(p, BindPlugin):
+            if isinstance(p, BindPlugin) and allow(p.name, "bind"):
                 self.bind.append(p)
-            if isinstance(p, PostBindPlugin):
+            if isinstance(p, PostBindPlugin) and allow(p.name, "postBind"):
                 self.post_bind.append(p)
         for p in plugins:  # late-bind plugins that need the framework itself
             if hasattr(p, "set_framework"):

@@ -103,10 +103,12 @@ class Scheduler:
     def __init__(self, client: Client,
                  informer_factory: SharedInformerFactory,
                  profiles: dict[str, Profile],
-                 next_start_node_index_random: bool = False):
+                 next_start_node_index_random: bool = False,
+                 extenders: Sequence | None = None):
         self.client = client
         self.informer_factory = informer_factory
         self.profiles = profiles
+        self.extenders = list(extenders or ())
         self.cache = Cache()
         self.metrics = SchedulerMetrics()
         # union of all profiles' event maps gates unschedulable requeue
@@ -320,6 +322,9 @@ class Scheduler:
             raise FitError(pod_info.pod, 0, Diagnosis(pre_filter_msg="no nodes available"))
         feasible, diagnosis = self._find_nodes_that_fit(fw, profile, state,
                                                         pod_info, snapshot)
+        if feasible and self.extenders:
+            feasible = self._find_nodes_that_pass_extenders(
+                pod_info.pod, feasible, diagnosis)
         if not feasible:
             raise FitError(pod_info.pod, len(snapshot), diagnosis)
         if len(feasible) == 1:
@@ -330,7 +335,69 @@ class Scheduler:
         scores, s = fw.run_score_plugins(state, pod_info, feasible)
         if not is_success(s):
             raise RuntimeError(f"Score failed: {s.message()}")
+        if self.extenders:
+            self._add_extender_scores(pod_info.pod, feasible, scores)
         return self._select_host(scores)
+
+    # -- extenders (schedule_one.go:613,733; extender.go) -----------------
+
+    def _find_nodes_that_pass_extenders(self, pod: Obj,
+                                        feasible: list[NodeInfo],
+                                        diagnosis: Diagnosis) -> list[NodeInfo]:
+        """findNodesThatPassExtenders: each interested extender filters in
+        sequence; ignorable extender errors are skipped, others raise."""
+        from .extender import ExtenderError
+        for ext in self.extenders:
+            if not feasible:
+                break
+            if not ext.is_interested(pod):
+                continue
+            try:
+                feasible, failed, failed_unresolvable = ext.filter(pod, feasible)
+            except ExtenderError as e:
+                if ext.is_ignorable():
+                    logger.warning("skipping ignorable extender %s: %s",
+                                   ext.name(), e)
+                    continue
+                raise
+            for name, msg in failed.items():
+                diagnosis.node_to_status.setdefault(
+                    name, Status(UNSCHEDULABLE, msg))
+            for name, msg in failed_unresolvable.items():
+                diagnosis.node_to_status[name] = Status(
+                    UNSCHEDULABLE_AND_UNRESOLVABLE, msg)
+        return feasible
+
+    def _add_extender_scores(self, pod: Obj, feasible: list[NodeInfo],
+                             scores: dict[str, int]) -> None:
+        """prioritizeNodes extender fan-out (schedule_one.go:733): extender
+        score × weight adds onto the plugin score sum; extender prioritize
+        errors are never fatal."""
+        from .extender import ExtenderError
+        for ext in self.extenders:
+            if not ext.is_interested(pod):
+                continue
+            try:
+                ext_scores, weight = ext.prioritize(pod, feasible)
+            except ExtenderError as e:
+                logger.warning("extender %s prioritize failed: %s", ext.name(), e)
+                continue
+            for name, sc in ext_scores.items():
+                if name in scores:
+                    scores[name] += sc * weight
+
+    def _extenders_bind(self, pod: Obj, node_name: str) -> bool:
+        """schedule_one.go bind(): the first interested binder extender does
+        the binding instead of the framework's Bind plugins."""
+        from .extender import ExtenderError
+        for ext in self.extenders:
+            if ext.is_binder() and ext.is_interested(pod):
+                try:
+                    ext.bind(pod, node_name)
+                    return True
+                except ExtenderError as e:
+                    raise RuntimeError(f"extender bind: {e}") from e
+        return False
 
     def _find_nodes_that_fit(self, fw: Framework, profile: Profile,
                              state: CycleState, pod_info: PodInfo,
@@ -423,10 +490,21 @@ class Scheduler:
             if not is_success(s):
                 self._bind_failure(fw, state, qpi, assumed, node_name, s, cycle)
                 return
-            s = fw.run_bind_plugins(state, pod_info, node_name)
-            if not is_success(s):
-                self._bind_failure(fw, state, qpi, assumed, node_name, s, cycle)
-                return
+            bound_by_extender = False
+            if self.extenders:
+                try:
+                    bound_by_extender = self._extenders_bind(pod_info.pod,
+                                                             node_name)
+                except RuntimeError as e:
+                    self._bind_failure(fw, state, qpi, assumed, node_name,
+                                       Status(ERROR, str(e)), cycle)
+                    return
+            if not bound_by_extender:
+                s = fw.run_bind_plugins(state, pod_info, node_name)
+                if not is_success(s):
+                    self._bind_failure(fw, state, qpi, assumed, node_name, s,
+                                       cycle)
+                    return
             self.cache.finish_binding(assumed)
             fw.run_post_bind_plugins(state, pod_info, node_name)
             self.metrics.observe_attempt("scheduled", time.monotonic() - start)
@@ -495,6 +573,14 @@ class Scheduler:
         cycle = self.queue.scheduling_cycle()
         start = time.monotonic()
         live = [q for q in batch if not self._skip_schedule(q.pod)]
+        if self.extenders:
+            # extender webhooks are per-pod HTTP calls: route interested
+            # pods through the oracle path so the extender contract holds
+            ext_pods = [q for q in live if any(
+                e.is_interested(q.pod) for e in self.extenders)]
+            live = [q for q in live if q not in ext_pods]
+            for q in ext_pods:
+                self.schedule_one(q)
         if not live:
             return
         snapshot = Snapshot() if not hasattr(self, "_snapshot") else self._snapshot

@@ -1,0 +1,309 @@
+"""HTTP extender + KubeSchedulerConfiguration tests.
+
+Reference shapes: pkg/scheduler/extender_test.go (with a live HTTP test
+server, like testing/fake_extender.go), pkg/scheduler/apis/config/
+validation tests, apis/config/v1/default_plugins_test.go merge rules.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS, LocalClient
+from kubernetes_tpu.scheduler import new_scheduler
+from kubernetes_tpu.scheduler.config import (
+    ConfigError, build_framework_from_profile, load_config,
+    scheduler_from_config,
+)
+from kubernetes_tpu.scheduler.extender import HTTPExtender
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+class _ExtenderServer:
+    """A scriptable extender webhook (testing/fake_extender.go role)."""
+
+    def __init__(self, filter_fn=None, prioritize_fn=None, bind_fn=None,
+                 fail=False):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                args = json.loads(self.rfile.read(n) or b"{}")
+                outer.calls.append(self.path)
+                if outer.fail:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                if self.path == "/filter":
+                    body = outer.filter_fn(args)
+                elif self.path == "/prioritize":
+                    body = outer.prioritize_fn(args)
+                elif self.path == "/bind":
+                    body = outer.bind_fn(args)
+                else:
+                    body = {"error": f"unknown verb {self.path}"}
+                data = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.calls: list[str] = []
+        self.fail = fail
+        self.filter_fn = filter_fn or (lambda a: {"nodenames": a.get("nodenames")})
+        self.prioritize_fn = prioritize_fn or (lambda a: [])
+        self.bind_fn = bind_fn or (lambda a: {})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture
+def cluster():
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    yield store, client, factory
+    factory.stop()
+
+
+def run_sched(client, factory, extenders):
+    sched = new_scheduler(client, factory)
+    sched.extenders = extenders
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    return sched
+
+
+class TestHTTPExtender:
+    def test_filter_restricts_nodes(self, cluster):
+        store, client, factory = cluster
+        srv = _ExtenderServer(filter_fn=lambda a: {
+            "nodenames": [n for n in a["nodenames"] if n == "n2"]})
+        ext = HTTPExtender(srv.url, filter_verb="filter",
+                           node_cache_capable=True)
+        sched = run_sched(client, factory, [ext])
+        try:
+            for n in ("n1", "n2", "n3"):
+                client.create(NODES, make_node(n).build())
+            client.create(PODS, make_pod("p").req(cpu="100m").build())
+            assert wait_for(lambda: meta.pod_node_name(
+                client.get(PODS, "default", "p")) == "n2")
+            assert "/filter" in srv.calls
+        finally:
+            sched.stop()
+            srv.stop()
+
+    def test_failed_nodes_map(self, cluster):
+        store, client, factory = cluster
+        srv = _ExtenderServer(filter_fn=lambda a: {
+            "nodenames": None,
+            "failedNodes": {n: "nope" for n in a["nodenames"]}})
+        ext = HTTPExtender(srv.url, filter_verb="filter",
+                           node_cache_capable=True)
+        sched = run_sched(client, factory, [ext])
+        try:
+            client.create(NODES, make_node("n1").build())
+            client.create(PODS, make_pod("p").req(cpu="100m").build())
+            assert wait_for(lambda: any(
+                c.get("reason") == "Unschedulable"
+                for c in (client.get(PODS, "default", "p").get("status")
+                          or {}).get("conditions", [])))
+            assert not meta.pod_node_name(client.get(PODS, "default", "p"))
+        finally:
+            sched.stop()
+            srv.stop()
+
+    def test_prioritize_steers_selection(self, cluster):
+        store, client, factory = cluster
+        srv = _ExtenderServer(prioritize_fn=lambda a: [
+            {"host": n, "score": 100 if n == "n3" else 0}
+            for n in a["nodenames"]])
+        ext = HTTPExtender(srv.url, prioritize_verb="prioritize",
+                           weight=10, node_cache_capable=True)
+        sched = run_sched(client, factory, [ext])
+        try:
+            for n in ("n1", "n2", "n3"):
+                client.create(NODES, make_node(n).build())
+            client.create(PODS, make_pod("p").req(cpu="100m").build())
+            assert wait_for(lambda: meta.pod_node_name(
+                client.get(PODS, "default", "p")) == "n3")
+        finally:
+            sched.stop()
+            srv.stop()
+
+    def test_bind_delegation(self, cluster):
+        store, client, factory = cluster
+        bound = {}
+
+        def bind_fn(args):
+            bound.update(args)
+            client.bind(client.get(PODS, args["podNamespace"],
+                                   args["podName"]), args["node"])
+            return {}
+
+        srv = _ExtenderServer(bind_fn=bind_fn)
+        ext = HTTPExtender(srv.url, bind_verb="bind", node_cache_capable=True)
+        sched = run_sched(client, factory, [ext])
+        try:
+            client.create(NODES, make_node("n1").build())
+            client.create(PODS, make_pod("p").req(cpu="100m").build())
+            assert wait_for(lambda: meta.pod_node_name(
+                client.get(PODS, "default", "p")) == "n1")
+            assert bound["node"] == "n1" and bound["podName"] == "p"
+        finally:
+            sched.stop()
+            srv.stop()
+
+    def test_ignorable_extender_error_skipped(self, cluster):
+        store, client, factory = cluster
+        srv = _ExtenderServer(fail=True)
+        ext = HTTPExtender(srv.url, filter_verb="filter",
+                           node_cache_capable=True, ignorable=True)
+        sched = run_sched(client, factory, [ext])
+        try:
+            client.create(NODES, make_node("n1").build())
+            client.create(PODS, make_pod("p").req(cpu="100m").build())
+            assert wait_for(lambda: meta.pod_node_name(
+                client.get(PODS, "default", "p")) == "n1")
+        finally:
+            sched.stop()
+            srv.stop()
+
+    def test_managed_resources_gates_interest(self):
+        ext = HTTPExtender("http://x", filter_verb="filter",
+                           managed_resources=["example.com/gpu"])
+        plain = make_pod("p").req(cpu="1").build()
+        gpu = make_pod("g").req(**{"example.com/gpu": "1"}).build()
+        assert not ext.is_interested(plain)
+        assert ext.is_interested(gpu)
+        assert HTTPExtender("http://x").is_interested(plain)
+
+
+class TestSchedulerConfig:
+    def test_defaults(self):
+        cfg = load_config({})
+        assert cfg.parallelism == 16
+        assert len(cfg.profiles) == 1
+        assert cfg.profiles[0].scheduler_name == "default-scheduler"
+
+    def test_yaml_round_trip(self, tmp_path):
+        path = tmp_path / "cfg.yaml"
+        path.write_text("""
+apiVersion: kubescheduler.config.k8s.io/v1
+kind: KubeSchedulerConfiguration
+percentageOfNodesToScore: 50
+profiles:
+  - schedulerName: my-sched
+    plugins:
+      score:
+        disabled: [{name: ImageLocality}]
+""")
+        cfg = load_config(str(path))
+        assert cfg.percentage_of_nodes_to_score == 50
+        assert cfg.profiles[0].scheduler_name == "my-sched"
+
+    def test_validation_errors(self):
+        with pytest.raises(ConfigError):
+            load_config({"kind": "NotAConfig"})
+        with pytest.raises(ConfigError):
+            load_config({"parallelism": 0})
+        with pytest.raises(ConfigError):
+            load_config({"profiles": [
+                {"schedulerName": "a"}, {"schedulerName": "a"}]})
+        with pytest.raises(ConfigError):
+            load_config({"profiles": [{"plugins": {"noSuchPoint": {}}}]})
+        with pytest.raises(ConfigError):
+            load_config({"profiles": [{"plugins": {
+                "filter": {"enabled": [{"name": "Bogus"}]}}}]})
+
+    def test_point_scoped_disable(self):
+        cfg = load_config({"profiles": [{"plugins": {
+            "score": {"disabled": [{"name": "NodeResourcesFit"}]}}}]})
+        fw = build_framework_from_profile(None, None, cfg.profiles[0])
+        score_names = {p.name for p, _ in fw.score}
+        filter_names = {p.name for p in fw.filter}
+        assert "NodeResourcesFit" not in score_names
+        assert "NodeResourcesFit" in filter_names
+
+    def test_multipoint_disable_all(self):
+        cfg = load_config({"profiles": [{"plugins": {
+            "multiPoint": {"disabled": [{"name": "*"}],
+                           "enabled": [{"name": "NodeResourcesFit"},
+                                       {"name": "PrioritySort"},
+                                       {"name": "DefaultBinder"}]}}}]})
+        fw = build_framework_from_profile(None, None, cfg.profiles[0])
+        assert {p.name for p in fw.filter} == {"NodeResourcesFit"}
+        assert fw.queue_sort is not None
+
+    def test_score_weight_override(self):
+        cfg = load_config({"profiles": [{"plugins": {
+            "score": {"enabled": [{"name": "TaintToleration",
+                                   "weight": 7}]}}}]})
+        fw = build_framework_from_profile(None, None, cfg.profiles[0])
+        weights = {p.name: w for p, w in fw.score}
+        assert weights["TaintToleration"] == 7
+
+    def test_plugin_args_passed(self):
+        cfg = load_config({"profiles": [{"pluginConfig": [
+            {"name": "NodeResourcesFit",
+             "args": {"strategy": "MostAllocated"}}]}]})
+        fw = build_framework_from_profile(None, None, cfg.profiles[0])
+        fit = next(p for p in fw.filter if p.name == "NodeResourcesFit")
+        assert fit.strategy == "MostAllocated"
+
+    def test_scheduler_from_config_schedules(self, cluster):
+        store, client, factory = cluster
+        cfg = load_config({
+            "podInitialBackoffSeconds": 0.5,
+            "profiles": [{"schedulerName": "custom"},
+                         {"schedulerName": "default-scheduler"}]})
+        sched = scheduler_from_config(client, factory, cfg)
+        factory.start()
+        factory.wait_for_cache_sync()
+        sched.run()
+        try:
+            client.create(NODES, make_node("n1").build())
+            client.create(PODS, make_pod("p").req(cpu="100m")
+                          .scheduler("custom").build())
+            assert wait_for(lambda: meta.pod_node_name(
+                client.get(PODS, "default", "p")) == "n1")
+        finally:
+            sched.stop()
+
+    def test_extenders_from_config(self):
+        cfg = load_config({"extenders": [
+            {"urlPrefix": "http://127.0.0.1:9999", "filterVerb": "filter",
+             "weight": 3, "ignorable": True,
+             "managedResources": [{"name": "example.com/gpu"}]}]})
+        from kubernetes_tpu.scheduler.extender import build_extenders
+        exts = build_extenders(cfg.extenders)
+        assert len(exts) == 1
+        assert exts[0].weight == 3 and exts[0].is_ignorable()
