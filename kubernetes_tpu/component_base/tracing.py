@@ -1,0 +1,130 @@
+"""Operation tracing.
+
+Two layers, mirroring the reference:
+
+1. ``Trace`` — utiltrace-style (k8s.io/utils/trace, used by the scheduler
+   at schedule_one.go:373: a named operation accumulates steps and is
+   logged only if total latency exceeds a threshold).
+2. ``TracerProvider``/``Span`` — a minimal OTel-shaped provider
+   (component-base/tracing/utils.go:35 NewProvider) with an in-memory
+   exporter, so the apiserver WithTracing filter and kubelet CRI wrapping
+   (KubeletTracing gate) have a seam.  On TPU the heavyweight profiling
+   story is jax.profiler (see ops/backend.py), not OTel; this keeps the
+   control-plane contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Trace:
+    """utiltrace.Trace: log steps when an operation exceeds a threshold."""
+
+    def __init__(self, name: str, **fields: Any):
+        self.name = name
+        self.fields = fields
+        self.start = time.monotonic()
+        self.steps: List[tuple] = []
+
+    def step(self, msg: str, **fields: Any) -> None:
+        self.steps.append((time.monotonic(), msg, fields))
+
+    def log_if_long(self, threshold: float) -> bool:
+        total = time.monotonic() - self.start
+        if total < threshold:
+            return False
+        parts = ["Trace %r (total %.1fms):" % (self.name, total * 1e3)]
+        last = self.start
+        for t, msg, fields in self.steps:
+            extra = (" " + ",".join("%s=%s" % kv for kv in fields.items())
+                     if fields else "")
+            parts.append("  step %r +%.1fms%s" % (msg, (t - last) * 1e3, extra))
+            last = t
+        logger.info("\n".join(parts))
+        return True
+
+
+class Span:
+    def __init__(self, tracer: "Tracer", name: str,
+                 parent: Optional["Span"] = None):
+        self.tracer = tracer
+        self.name = name
+        self.parent = parent
+        self.attributes: Dict[str, Any] = {}
+        self.events: List[tuple] = []
+        self.start_time = time.monotonic()
+        self.end_time: Optional[float] = None
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        self.events.append((time.monotonic(), name, attrs))
+
+    def end(self) -> None:
+        if self.end_time is None:
+            self.end_time = time.monotonic()
+            self.tracer.provider._export(self)
+
+    @property
+    def duration(self) -> float:
+        return (self.end_time or time.monotonic()) - self.start_time
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+
+class Tracer:
+    def __init__(self, provider: "TracerProvider", name: str):
+        self.provider = provider
+        self.name = name
+
+    def start_span(self, name: str, parent: Optional[Span] = None) -> Span:
+        return Span(self, name, parent)
+
+
+class TracerProvider:
+    """In-memory provider; sampling_rate mirrors TracingConfiguration
+    SamplingRatePerMillion (0 disables record-keeping but spans still
+    function as timers)."""
+
+    def __init__(self, sampling_rate_per_million: int = 1_000_000,
+                 max_spans: int = 4096):
+        self.sampling_rate_per_million = sampling_rate_per_million
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self.spans: List[Span] = []
+        self._counter = 0
+
+    def tracer(self, name: str) -> Tracer:
+        return Tracer(self, name)
+
+    def _export(self, span: Span) -> None:
+        with self._lock:
+            self._counter += 1
+            keep = (self._counter * self.sampling_rate_per_million
+                    ) % 1_000_000 < self.sampling_rate_per_million
+            if self.sampling_rate_per_million >= 1_000_000:
+                keep = True
+            elif self.sampling_rate_per_million <= 0:
+                keep = False
+            if keep:
+                self.spans.append(span)
+                if len(self.spans) > self.max_spans:
+                    del self.spans[: len(self.spans) - self.max_spans]
+
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return list(self.spans)
+
+
+default_tracer_provider = TracerProvider()

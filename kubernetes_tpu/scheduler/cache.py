@@ -80,6 +80,13 @@ class Cache:
 
     # -- pods ------------------------------------------------------------
 
+    def stats(self) -> dict[str, int]:
+        """Cache sizes for the scheduler_cache_size{type=} gauge."""
+        with self._lock:
+            return {"nodes": len(self._nodes),
+                    "pods": len(self._pod_states),
+                    "assumed_pods": len(self._assumed_pods)}
+
     def assume_pod(self, pod: Obj) -> None:
         key = meta.namespaced_name(pod)
         with self._lock:

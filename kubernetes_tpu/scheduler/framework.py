@@ -25,11 +25,22 @@ from ..api.meta import Obj
 from .cache import Snapshot
 from .types import (
     ERROR, SKIP, SUCCESS, UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE, WAIT,
-    ClusterEvent, Diagnosis, NodeInfo, PodInfo, Status, is_success,
+    _CODE_NAMES, ClusterEvent, Diagnosis, NodeInfo, PodInfo, Status, is_success,
 )
 
 MAX_NODE_SCORE = 100  # framework/interface.go MaxNodeScore
 MIN_NODE_SCORE = 0
+
+def _status_label(out: Any) -> str:
+    """Map a runner's return value to a status label for metrics."""
+    status = out
+    if isinstance(out, tuple):
+        status = next((x for x in reversed(out) if isinstance(x, Status)), None)
+    if status is None:
+        return "Success"
+    if isinstance(status, Status):
+        return _CODE_NAMES.get(status.code, str(status.code))
+    return "Success"
 
 
 class CycleState:
@@ -307,6 +318,40 @@ class Framework:
         for p in plugins:  # late-bind plugins that need the framework itself
             if hasattr(p, "set_framework"):
                 p.set_framework(self)
+        # metrics_recorder(extension_point, status_code_str, seconds) — set by
+        # the Scheduler; records framework_extension_point_duration_seconds
+        # (runtime/framework.go records this around each RunXPlugins).
+        self.metrics_recorder = None
+        self._instrument_extension_points()
+
+    _TIMED_POINTS = (
+        ("PreFilter", "run_pre_filter_plugins"),
+        ("PostFilter", "run_post_filter_plugins"),
+        ("PreScore", "run_pre_score_plugins"),
+        ("Score", "run_score_plugins"),
+        ("Reserve", "run_reserve_plugins"),
+        ("Permit", "run_permit_plugins"),
+        ("PreBind", "run_pre_bind_plugins"),
+        ("Bind", "run_bind_plugins"),
+    )
+
+    def _instrument_extension_points(self) -> None:
+        """Wrap once-per-cycle runners with timing.  Filter is deliberately
+        excluded: it runs per node (hot loop); its cost is covered by
+        scheduling_algorithm_duration and the TPU device histograms."""
+        for point, name in self._TIMED_POINTS:
+            orig = getattr(self, name)
+
+            def wrapper(*a, __orig=orig, __point=point, **kw):
+                rec = self.metrics_recorder
+                if rec is None:
+                    return __orig(*a, **kw)
+                t0 = time.perf_counter()
+                out = __orig(*a, **kw)
+                rec(__point, _status_label(out), time.perf_counter() - t0)
+                return out
+
+            setattr(self, name, wrapper)
 
     def cluster_event_map(self) -> dict[str, list[ClusterEvent]]:
         return {p.name: p.events_to_register() for p in self.all_plugins}

@@ -1,0 +1,106 @@
+"""Scheduler metrics.
+
+Reference: pkg/scheduler/metrics/metrics.go:30-200 — the full named metric
+set, registered on the shared component-base registry with the same
+stability levels and the same exponential latency buckets
+(ExponentialBuckets(0.001, 2, 15), metrics.go:58-65).  The queue exposes
+pending_pods{queue=active|backoff|unschedulable} and the framework runtime
+records per-extension-point / per-plugin duration histograms.
+"""
+
+from __future__ import annotations
+
+from ..component_base import metrics as cbm
+
+SCHEDULER_SUBSYSTEM = "scheduler"
+
+_LATENCY_BUCKETS = cbm.exponential_buckets(0.001, 2, 15)
+
+
+class Metrics:
+    """One bundle per scheduler process (tests get isolated registries)."""
+
+    def __init__(self, registry: cbm.Registry | None = None):
+        self.registry = registry or cbm.Registry()
+        r = self.registry
+        self.schedule_attempts = cbm.Counter(
+            "scheduler_schedule_attempts_total",
+            "Number of attempts to schedule pods, by result.",
+            labels=("result", "profile"), stability=cbm.STABLE)
+        self.scheduling_attempt_duration = cbm.Histogram(
+            "scheduler_scheduling_attempt_duration_seconds",
+            "Scheduling attempt latency (algorithm + binding).",
+            labels=("result", "profile"), buckets=_LATENCY_BUCKETS,
+            stability=cbm.STABLE)
+        self.scheduling_algorithm_duration = cbm.Histogram(
+            "scheduler_scheduling_algorithm_duration_seconds",
+            "Scheduling algorithm latency.",
+            labels=("profile",), buckets=_LATENCY_BUCKETS)
+        self.pod_scheduling_duration = cbm.Histogram(
+            "scheduler_pod_scheduling_duration_seconds",
+            "E2e pod scheduling latency, from first attempt to bound.",
+            labels=("attempts",),
+            buckets=cbm.exponential_buckets(0.001, 2, 20),
+            stability=cbm.STABLE)
+        self.pod_scheduling_attempts = cbm.Histogram(
+            "scheduler_pod_scheduling_attempts",
+            "Number of attempts to successfully schedule a pod.",
+            buckets=[1, 2, 4, 8, 16], stability=cbm.STABLE)
+        self.framework_extension_point_duration = cbm.Histogram(
+            "scheduler_framework_extension_point_duration_seconds",
+            "Latency for running all plugins of an extension point.",
+            labels=("extension_point", "status", "profile"),
+            buckets=cbm.exponential_buckets(0.0001, 2, 12))
+        self.plugin_execution_duration = cbm.Histogram(
+            "scheduler_plugin_execution_duration_seconds",
+            "Duration for running a plugin at a specific extension point.",
+            labels=("plugin", "extension_point", "status"),
+            buckets=cbm.exponential_buckets(0.00001, 1.5, 20))
+        self.pending_pods = cbm.Gauge(
+            "scheduler_pending_pods",
+            "Pending pods by queue: active, backoff, unschedulable, gated.",
+            labels=("queue",), stability=cbm.STABLE)
+        self.queue_incoming_pods = cbm.Counter(
+            "scheduler_queue_incoming_pods_total",
+            "Pods added to scheduling queues by event and queue.",
+            labels=("queue", "event"), stability=cbm.STABLE)
+        self.preemption_attempts = cbm.Counter(
+            "scheduler_preemption_attempts_total",
+            "Total preemption attempts in the cluster.", stability=cbm.STABLE)
+        self.preemption_victims = cbm.Histogram(
+            "scheduler_preemption_victims",
+            "Number of selected preemption victims.",
+            buckets=cbm.linear_buckets(5, 5, 10), stability=cbm.STABLE)
+        self.cache_size = cbm.Gauge(
+            "scheduler_scheduler_cache_size",
+            "Number of nodes, pods, and assumed pods in the cache.",
+            labels=("type",))
+        self.unschedulable_reasons = cbm.Gauge(
+            "scheduler_unschedulable_pods",
+            "Pods the scheduler found unschedulable, by plugin and profile.",
+            labels=("plugin", "profile"))
+        self.goroutines = cbm.Gauge(
+            "scheduler_goroutines",
+            "Number of running binding goroutines.", labels=("operation",))
+        # TPU-path additions (no upstream analogue): batch shape + device time
+        self.tpu_batch_size = cbm.Histogram(
+            "scheduler_tpu_batch_size",
+            "Pods per TPU assignment batch.",
+            buckets=[1, 8, 32, 64, 128, 256, 512, 1024])
+        self.tpu_device_duration = cbm.Histogram(
+            "scheduler_tpu_device_duration_seconds",
+            "Device time per TPU assignment batch.",
+            buckets=_LATENCY_BUCKETS)
+        r.must_register(
+            self.schedule_attempts, self.scheduling_attempt_duration,
+            self.scheduling_algorithm_duration, self.pod_scheduling_duration,
+            self.pod_scheduling_attempts,
+            self.framework_extension_point_duration,
+            self.plugin_execution_duration, self.pending_pods,
+            self.queue_incoming_pods, self.preemption_attempts,
+            self.preemption_victims, self.cache_size,
+            self.unschedulable_reasons, self.goroutines,
+            self.tpu_batch_size, self.tpu_device_duration)
+
+    def expose(self) -> str:
+        return self.registry.expose()

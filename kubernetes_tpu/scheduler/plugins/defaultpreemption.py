@@ -23,6 +23,8 @@ class DefaultPreemption(PostFilterPlugin):
         self._framework = framework
         self._snapshot_getter = snapshot_getter or (lambda: None)
         self._evaluator: Evaluator | None = None
+        # set by the Scheduler: observer(victim_count) for preemption metrics
+        self.preemption_observer = None
 
     def set_framework(self, fw) -> None:
         self._framework = fw
@@ -34,7 +36,10 @@ class DefaultPreemption(PostFilterPlugin):
                     filtered_node_status_map: dict[str, Status]
                     ) -> tuple[str | None, Status]:
         if self._evaluator is None:
-            self._evaluator = Evaluator(self._framework, self.client)
+            self._evaluator = Evaluator(
+                self._framework, self.client,
+                observer=lambda n: (self.preemption_observer(n)
+                                    if self.preemption_observer else None))
         snapshot = self._snapshot_getter()
         if snapshot is None:
             return None, Status(UNSCHEDULABLE, "no snapshot for preemption")

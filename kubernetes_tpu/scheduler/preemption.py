@@ -41,9 +41,13 @@ class Candidate:
 
 
 class Evaluator:
-    def __init__(self, framework: Framework, client: Client):
+    def __init__(self, framework: Framework, client: Client,
+                 observer=None):
         self.fw = framework
         self.client = client
+        # observer(victim_count) — feeds preemption_attempts_total /
+        # preemption_victims (metrics.go preemption counters)
+        self.observer = observer
 
     # -- entry (preemption.go:146) ---------------------------------------
 
@@ -59,6 +63,8 @@ class Evaluator:
         status = self._prepare_candidate(best, pod_info)
         if not is_success(status):
             return None, status
+        if self.observer is not None:
+            self.observer(len(best.victims))
         return best.node_name, Status(SUCCESS)
 
     def _pod_eligible(self, pod_info: PodInfo, snapshot: Snapshot) -> bool:

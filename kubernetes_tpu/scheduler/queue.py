@@ -171,6 +171,13 @@ class SchedulingQueue:
         with self._lock:
             return self._scheduling_cycle
 
+    def stats(self) -> dict[str, int]:
+        """Queue sizes for the pending_pods{queue=} gauge."""
+        with self._lock:
+            return {"active": len(self._active),
+                    "backoff": len(self._backoff),
+                    "unschedulable": len(self._unschedulable)}
+
     def pop(self, timeout: float | None = None) -> QueuedPodInfo | None:
         with self._cond:
             deadline = None if timeout is None else time.monotonic() + timeout

@@ -33,6 +33,7 @@ from ..api.meta import Obj
 from ..client.clientset import Client, NODES, PODS
 from ..client.informer import SharedInformerFactory
 from ..store import kv
+from . import metrics as _metrics
 from .cache import Cache, Snapshot
 from .framework import CycleState, Framework, Handle
 from .queue import SchedulingQueue
@@ -50,20 +51,39 @@ MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5
 
 
 class SchedulerMetrics:
-    """Counter bundle (pkg/scheduler/metrics/metrics.go, minimal)."""
+    """Scheduler metric bundle.
+
+    Full named metric set lives in metrics.Metrics (pkg/scheduler/metrics/
+    metrics.go parity, Prometheus exposition via .expose()); this wrapper
+    keeps the cheap in-process views (attempt counts, raw latency list)
+    that the perf harness samples at 1s without text parsing.
+    """
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
+        self.prom = _metrics.Metrics()
         self.schedule_attempts = {"scheduled": 0, "unschedulable": 0, "error": 0}
         self.scheduling_latency_sum = 0.0
         self.scheduling_latencies: list[float] = []
         self.preemption_attempts = 0
 
-    def observe_attempt(self, result: str, latency: float) -> None:
+    def observe_attempt(self, result: str, latency: float,
+                        profile: str = "default-scheduler") -> None:
         with self.lock:
             self.schedule_attempts[result] = self.schedule_attempts.get(result, 0) + 1
             self.scheduling_latency_sum += latency
             self.scheduling_latencies.append(latency)
+        self.prom.schedule_attempts.inc(1.0, result, profile)
+        self.prom.scheduling_attempt_duration.observe(latency, result, profile)
+
+    def observe_preemption(self, victims: int) -> None:
+        with self.lock:
+            self.preemption_attempts += 1
+        self.prom.preemption_attempts.inc()
+        self.prom.preemption_victims.observe(victims)
+
+    def expose(self) -> str:
+        return self.prom.expose()
 
 
 class BatchBackend:
@@ -121,18 +141,34 @@ class Scheduler:
         self.queue = SchedulingQueue(
             sort_key=sort_key or (lambda q: (-q.pod_info.priority, q.timestamp)),
             cluster_event_map=event_map)
+        for prof_name, p in profiles.items():
+            p.framework.metrics_recorder = (
+                lambda point, status, sec, _n=prof_name:
+                self.metrics.prom.framework_extension_point_duration.observe(
+                    sec, point, status, _n))
         for p in profiles.values():
             p.framework.handle.nominator = self.queue.nominator
             for plugin in p.framework.post_filter:
                 if hasattr(plugin, "_snapshot_getter"):
                     plugin._snapshot_getter = (
                         lambda s=self: getattr(s, "_snapshot", None))
+                if hasattr(plugin, "preemption_observer"):
+                    plugin.preemption_observer = self.metrics.observe_preemption
         self._stop = threading.Event()
         self._binder_pool = ThreadPoolExecutor(max_workers=16,
                                                thread_name_prefix="bind")
         self._next_start_node_index = 0
         self._threads: list[threading.Thread] = []
         self._wire_event_handlers()
+
+    def expose_metrics(self) -> str:
+        """Refresh pull-time gauges (pending_pods, cache_size) and return
+        the Prometheus exposition text for this scheduler's registry."""
+        for queue, n in self.queue.stats().items():
+            self.metrics.prom.pending_pods.set(n, queue)
+        for typ, n in self.cache.stats().items():
+            self.metrics.prom.cache_size.set(n, typ)
+        return self.metrics.expose()
 
     # -- event handlers (eventhandlers.go:249) ---------------------------
 
@@ -507,7 +543,8 @@ class Scheduler:
                     return
             self.cache.finish_binding(assumed)
             fw.run_post_bind_plugins(state, pod_info, node_name)
-            self.metrics.observe_attempt("scheduled", time.monotonic() - start)
+            self.metrics.observe_attempt("scheduled", time.monotonic() - start,
+                                         fw.profile_name)
             self.client.create_event(pod_info.pod, "Scheduled",
                                      f"Successfully assigned {qpi.key} to {node_name}")
         except Exception as e:  # pragma: no cover
@@ -534,7 +571,8 @@ class Scheduler:
         qpi.unschedulable_plugins = plugins
         result = "unschedulable" if s.code in (
             UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE) else "error"
-        self.metrics.observe_attempt(result, time.monotonic() - start)
+        self.metrics.observe_attempt(result, time.monotonic() - start,
+                                     fw.profile_name)
         # re-fetch: pod may have been updated/deleted meanwhile
         try:
             current = self.client.get(PODS, meta.namespace(qpi.pod), meta.name(qpi.pod))

@@ -1,0 +1,226 @@
+"""component-base: metrics registry, featuregate, tracing, logs, configz.
+
+Reference contracts: staging/src/k8s.io/component-base/{metrics,featuregate,
+logs,tracing}, pkg/scheduler/metrics/metrics.go.
+"""
+
+import logging
+
+import pytest
+
+from kubernetes_tpu.component_base import configz, featuregate, logs, metrics, tracing
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_counter_inc_and_expose():
+    r = metrics.Registry()
+    c = metrics.new_counter("sched_attempts_total", "attempts",
+                            labels=("result",), registry=r)
+    c.inc(1.0, "scheduled")
+    c.inc(2.0, "error")
+    c.labels("scheduled").inc()
+    assert c.value("scheduled") == 2.0
+    text = r.expose()
+    assert 'sched_attempts_total{result="scheduled"} 2' in text
+    assert 'sched_attempts_total{result="error"} 2' in text
+    assert "# TYPE sched_attempts_total counter" in text
+
+
+def test_counter_cannot_decrease():
+    c = metrics.Counter("c")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_inc_dec():
+    r = metrics.Registry()
+    g = metrics.new_gauge("pending_pods", "", labels=("queue",), registry=r)
+    g.set(5, "active")
+    g.inc(2, "active")
+    g.dec(1, "active")
+    assert g.value("active") == 6
+    assert 'pending_pods{queue="active"} 6' in r.expose()
+
+
+def test_histogram_buckets_sum_count_quantile():
+    h = metrics.Histogram("lat", buckets=[0.001, 0.01, 0.1, 1.0])
+    for v in (0.0005, 0.005, 0.05, 0.5):
+        h.observe(v)
+    assert h.count() == 4
+    assert abs(h.sum() - 0.5555) < 1e-9
+    # median falls in the 0.01 bucket (2 of 4 observations <= 0.01)
+    assert h.quantile(0.5) == 0.01
+    text = "\n".join(h.collect())
+    assert 'lat_bucket{le="0.001"} 1' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+
+
+def test_histogram_labeled_series_independent():
+    h = metrics.Histogram("d", labels=("point",), buckets=[1, 10])
+    h.observe(0.5, "PreFilter")
+    h.labels("Score").observe(5)
+    assert h.count("PreFilter") == 1
+    assert h.count("Score") == 1
+    assert h.count("Bind") == 0
+
+
+def test_exponential_buckets_match_reference():
+    # metrics.go:58 ExponentialBuckets(0.001, 2, 15)
+    b = metrics.exponential_buckets(0.001, 2, 15)
+    assert len(b) == 15
+    assert b[0] == 0.001
+    assert abs(b[-1] - 16.384) < 1e-9
+
+
+def test_registry_duplicate_registration_fails():
+    r = metrics.Registry()
+    r.register(metrics.Counter("x"))
+    with pytest.raises(ValueError):
+        r.register(metrics.Counter("x"))
+
+
+def test_hidden_metric_skipped_in_exposition_but_writable():
+    r = metrics.Registry()
+    c = metrics.new_counter("old_metric", registry=r,
+                            deprecated_version="1.24")
+    c.hidden = True
+    c.inc()
+    assert "old_metric" not in r.expose()
+    assert c.value() == 1.0
+
+
+def test_stability_level_in_help():
+    r = metrics.Registry()
+    metrics.new_counter("s", "help text", registry=r,
+                        stability=metrics.STABLE)
+    assert "# HELP s [STABLE] help text" in r.expose()
+
+
+# -- featuregate -----------------------------------------------------------
+
+def test_featuregate_defaults_and_set():
+    fg = featuregate.FeatureGate().add({
+        "Alpha1": featuregate.FeatureSpec(False, featuregate.ALPHA),
+        "Beta1": featuregate.FeatureSpec(True, featuregate.BETA),
+    })
+    assert not fg.enabled("Alpha1")
+    assert fg.enabled("Beta1")
+    fg.set("Alpha1=true,Beta1=false")
+    assert fg.enabled("Alpha1")
+    assert not fg.enabled("Beta1")
+
+
+def test_featuregate_unknown_gate_errors():
+    fg = featuregate.FeatureGate()
+    with pytest.raises(ValueError):
+        fg.set_from_map({"Nope": True})
+    with pytest.raises(ValueError):
+        fg.enabled("Nope")
+
+
+def test_featuregate_locked_ga_gate():
+    fg = featuregate.FeatureGate().add({
+        "GA1": featuregate.FeatureSpec(True, featuregate.GA,
+                                       lock_to_default=True)})
+    with pytest.raises(ValueError):
+        fg.set_from_map({"GA1": False})
+    fg.set_from_map({"GA1": True})  # default value is fine
+
+
+def test_default_feature_catalogue():
+    fg = featuregate.default_feature_gate.deep_copy()
+    assert fg.enabled("TPUBatchAssign")
+    assert fg.enabled("ServerSideApply")
+    fg.set("TPUBatchAssign=false")
+    assert not fg.enabled("TPUBatchAssign")
+    # the shared default gate is unaffected by the copy
+    assert featuregate.default_feature_gate.enabled("TPUBatchAssign")
+
+
+# -- tracing ---------------------------------------------------------------
+
+def test_utiltrace_logs_only_over_threshold(caplog):
+    tr = tracing.Trace("scheduleOne", pod="default/p")
+    tr.step("snapshot")
+    tr.step("filter")
+    with caplog.at_level(logging.INFO, logger="kubernetes_tpu.component_base.tracing"):
+        assert not tr.log_if_long(60.0)      # fast op: silent
+        assert tr.log_if_long(0.0)           # threshold 0: logged
+    assert "scheduleOne" in caplog.text
+    assert "filter" in caplog.text
+
+
+def test_span_provider_records():
+    tp = tracing.TracerProvider()
+    tracer = tp.tracer("apiserver")
+    with tracer.start_span("HTTP POST /api/v1/pods") as span:
+        span.set_attribute("code", 201)
+        span.add_event("admission done")
+    spans = tp.snapshot()
+    assert len(spans) == 1
+    assert spans[0].attributes["code"] == 201
+    assert spans[0].duration >= 0
+
+
+def test_span_provider_sampling_off():
+    tp = tracing.TracerProvider(sampling_rate_per_million=0)
+    with tp.tracer("t").start_span("s"):
+        pass
+    assert tp.snapshot() == []
+
+
+# -- logs ------------------------------------------------------------------
+
+def test_structured_text_and_verbosity(caplog):
+    logger = logging.getLogger("test.logs")
+    logs.set_format("text")
+    logs.set_verbosity(4)
+    try:
+        with caplog.at_level(logging.INFO):
+            logs.info_s(logger, "Scheduled pod", pod="ns/p", node="n1")
+            logs.v(10).info_s(logger, "super verbose dump")
+        assert 'Scheduled pod pod="ns/p" node="n1"' in caplog.text
+        assert "super verbose dump" not in caplog.text
+        assert logs.enabled(4) and not logs.enabled(5)
+    finally:
+        logs.set_verbosity(0)
+
+
+def test_json_log_format(caplog):
+    logger = logging.getLogger("test.logs.json")
+    logs.set_format("json")
+    try:
+        with caplog.at_level(logging.ERROR):
+            logs.error_s(logger, RuntimeError("boom"), "bind failed", pod="a/b")
+        assert '"msg": "bind failed"' in caplog.text
+        assert '"err": "boom"' in caplog.text
+    finally:
+        logs.set_format("text")
+
+
+# -- configz ---------------------------------------------------------------
+
+def test_configz_registry():
+    r = configz.Registry()
+    r.install("kubescheduler.config.k8s.io", {"parallelism": 16})
+    assert r.snapshot() == {"kubescheduler.config.k8s.io": {"parallelism": 16}}
+    r.delete("kubescheduler.config.k8s.io")
+    assert r.snapshot() == {}
+
+
+# -- scheduler metrics bundle ---------------------------------------------
+
+def test_scheduler_metrics_bundle_exposition():
+    from kubernetes_tpu.scheduler.metrics import Metrics
+    m = Metrics()
+    m.schedule_attempts.inc(1.0, "scheduled", "default-scheduler")
+    m.framework_extension_point_duration.observe(
+        0.002, "PreFilter", "Success", "default-scheduler")
+    m.pending_pods.set(3, "active")
+    text = m.expose()
+    assert ('scheduler_schedule_attempts_total{result="scheduled",'
+            'profile="default-scheduler"} 1') in text
+    assert "scheduler_framework_extension_point_duration_seconds_bucket" in text
+    assert 'scheduler_pending_pods{queue="active"} 3' in text

@@ -75,7 +75,7 @@ class _ExtenderServer:
         self.httpd.shutdown()
 
 
-def wait_for(pred, timeout=10.0):
+def wait_for(pred, timeout=30.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
         if pred():

@@ -16,7 +16,7 @@ from kubernetes_tpu.store import kv
 from kubernetes_tpu.testing import make_node, make_pod
 
 
-def wait_for(predicate, timeout=10.0):
+def wait_for(predicate, timeout=30.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
         if predicate():

@@ -21,7 +21,7 @@ from kubernetes_tpu.scheduler import new_scheduler
 from kubernetes_tpu.store import kv
 
 
-def wait_for(predicate, timeout=15.0):
+def wait_for(predicate, timeout=30.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
         if predicate():

@@ -30,7 +30,7 @@ def cluster():
     factory.stop()
 
 
-def wait_for(predicate, timeout=10.0, interval=0.01):
+def wait_for(predicate, timeout=30.0, interval=0.01):
     deadline = time.time() + timeout
     while time.time() < deadline:
         if predicate():
