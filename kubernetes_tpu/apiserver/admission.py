@@ -1,0 +1,394 @@
+"""Admission control chain.
+
+Reference: staging/src/k8s.io/apiserver/pkg/admission (two-phase chain:
+all mutating plugins run before all validating plugins; order fixed by
+pkg/kubeapiserver/options/plugins.go:64) + in-tree plugins under
+plugin/pkg/admission/ + webhook admission
+(staging/src/k8s.io/apiserver/pkg/admission/plugin/webhook — AdmissionReview
+POSTed to external HTTP endpoints, mutating webhooks may return a JSONPatch).
+
+In-tree set reproduced (the ones meaningful without kubelet-side state):
+  NamespaceLifecycle    reject writes into missing/terminating namespaces
+  Priority              resolve priorityClassName -> spec.priority
+  LimitRanger           apply LimitRange defaults to container resources
+  ResourceQuota         reject creates that would exceed a ResourceQuota
+  DefaultTolerationSeconds  add default NoExecute tolerations to pods
+  TaintNodesByCondition vestigial here (node controller owns taints)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time as _time
+import urllib.request
+from typing import Callable, List, Optional
+
+from ..api import meta, quantity
+from ..store import kv
+from . import patch as patchlib
+
+logger = logging.getLogger(__name__)
+
+CREATE, UPDATE, DELETE, CONNECT = "CREATE", "UPDATE", "DELETE", "CONNECT"
+
+
+class AdmissionDenied(Exception):
+    """Rejection: surfaces as HTTP 400/403 with the plugin name."""
+
+    def __init__(self, plugin: str, message: str):
+        super().__init__(message)
+        self.plugin = plugin
+
+
+class Attributes:
+    """admission.Attributes (pkg/admission/interfaces.go)."""
+
+    __slots__ = ("verb", "resource", "subresource", "namespace", "name",
+                 "obj", "old_obj")
+
+    def __init__(self, verb: str, resource: str, obj, old_obj=None,
+                 namespace: str = "", name: str = "", subresource: str = ""):
+        self.verb = verb
+        self.resource = resource
+        self.subresource = subresource
+        self.namespace = namespace
+        self.name = name
+        self.obj = obj
+        self.old_obj = old_obj
+
+
+class AdmissionPlugin:
+    name = "plugin"
+
+    def admit(self, attrs: Attributes) -> None:
+        """Mutating phase: may modify attrs.obj in place or raise."""
+
+    def validate(self, attrs: Attributes) -> None:
+        """Validating phase: raise AdmissionDenied to reject."""
+
+
+class Chain:
+    """Runs every plugin's admit(), then every plugin's validate()."""
+
+    def __init__(self, plugins: Optional[List[AdmissionPlugin]] = None):
+        self.plugins: List[AdmissionPlugin] = list(plugins or ())
+
+    def register(self, plugin: AdmissionPlugin) -> None:
+        self.plugins.append(plugin)
+
+    def run(self, attrs: Attributes) -> None:
+        for p in self.plugins:
+            p.admit(attrs)
+        for p in self.plugins:
+            p.validate(attrs)
+
+
+# -- in-tree plugins -------------------------------------------------------
+
+class NamespaceLifecycle(AdmissionPlugin):
+    """plugin/pkg/admission/namespace/lifecycle: creates into a
+    nonexistent or terminating namespace are rejected; the immortal
+    namespaces (default, kube-system) can't be deleted."""
+
+    name = "NamespaceLifecycle"
+    IMMORTAL = {"default", "kube-system", "kube-public"}
+
+    def __init__(self, store: kv.MemoryStore):
+        self.store = store
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource == "namespaces" and attrs.verb == DELETE:
+            if attrs.name in self.IMMORTAL:
+                raise AdmissionDenied(self.name,
+                                      "this namespace may not be deleted")
+            return
+        if attrs.verb != CREATE or not attrs.namespace:
+            return
+        if attrs.resource in ("namespaces", "events"):
+            return
+        try:
+            ns = self.store.get("namespaces", "", attrs.namespace)
+        except kv.NotFoundError:
+            if attrs.namespace == "default":
+                return  # default namespace is implicit
+            raise AdmissionDenied(
+                self.name, "namespace %r not found" % attrs.namespace)
+        phase = ((ns.get("status") or {}).get("phase")
+                 or ("Terminating" if meta.deletion_timestamp(ns) else "Active"))
+        if phase == "Terminating":
+            raise AdmissionDenied(
+                self.name,
+                "unable to create new content in namespace %s because it is "
+                "being terminated" % attrs.namespace)
+
+
+class Priority(AdmissionPlugin):
+    """plugin/pkg/admission/priority: resolve pod.spec.priorityClassName to
+    spec.priority; unknown class -> reject; default class applies."""
+
+    name = "Priority"
+
+    def __init__(self, store: kv.MemoryStore):
+        self.store = store
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.verb != CREATE:
+            return
+        pod = attrs.obj
+        spec = pod.setdefault("spec", {})
+        cls_name = spec.get("priorityClassName")
+        if not cls_name:
+            default = self._default_class()
+            if default is not None:
+                spec["priorityClassName"] = meta.name(default)
+                spec["priority"] = default.get("value", 0)
+            else:
+                spec.setdefault("priority", 0)
+            return
+        if cls_name in ("system-cluster-critical", "system-node-critical"):
+            spec["priority"] = (2000000000 if cls_name == "system-cluster-critical"
+                                else 2000001000)
+            return
+        try:
+            cls = self.store.get("priorityclasses", "", cls_name)
+        except kv.NotFoundError:
+            raise AdmissionDenied(
+                self.name, "no PriorityClass with name %s was found" % cls_name)
+        spec["priority"] = cls.get("value", 0)
+
+    def _default_class(self):
+        items, _ = self.store.list("priorityclasses")
+        for pc in items:
+            if pc.get("globalDefault"):
+                return pc
+        return None
+
+
+class LimitRanger(AdmissionPlugin):
+    """plugin/pkg/admission/limitranger: apply LimitRange default/
+    defaultRequest to containers missing requests/limits."""
+
+    name = "LimitRanger"
+
+    def __init__(self, store: kv.MemoryStore):
+        self.store = store
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.verb != CREATE:
+            return
+        items, _ = self.store.list("limitranges", attrs.namespace or "default")
+        defaults_req: dict = {}
+        defaults_lim: dict = {}
+        for lr in items:
+            for lim in (lr.get("spec") or {}).get("limits", []):
+                if lim.get("type") != "Container":
+                    continue
+                defaults_req.update(lim.get("defaultRequest") or {})
+                defaults_lim.update(lim.get("default") or {})
+        if not defaults_req and not defaults_lim:
+            return
+        for c in (attrs.obj.get("spec") or {}).get("containers", []):
+            res = c.setdefault("resources", {})
+            req = res.setdefault("requests", {})
+            lim = res.setdefault("limits", {})
+            for k, v in defaults_req.items():
+                req.setdefault(k, v)
+            for k, v in defaults_lim.items():
+                lim.setdefault(k, v)
+                req.setdefault(k, v)
+
+
+class ResourceQuota(AdmissionPlugin):
+    """plugin/pkg/admission/resourcequota: reject pod creates that would
+    push aggregate requests over any ResourceQuota hard limit in the
+    namespace.  Usage is recomputed from live pods (the reference keeps a
+    quota controller + admission cache; recompute is the same contract)."""
+
+    name = "ResourceQuota"
+
+    # reservations younger than this count toward usage even before the
+    # store write lands (closes the check-then-create race between two
+    # concurrent admissions; the write happens after validate() returns)
+    RESERVATION_TTL = 2.0
+
+    def __init__(self, store: kv.MemoryStore):
+        self.store = store
+        self._lock = threading.Lock()
+        # (ns, pod_name) -> (cpu_milli, mem_bytes, reserved_at)
+        self._pending: dict = {}
+
+    def validate(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.verb != CREATE:
+            return
+        ns = attrs.namespace or "default"
+        quotas, _ = self.store.list("resourcequotas", ns)
+        if not quotas:
+            return
+        with self._lock:
+            pods, _ = self.store.list("pods", ns)
+            stored_names = {(p.get("metadata") or {}).get("name")
+                            for p in pods}
+            now = _time.monotonic()
+            self._pending = {
+                k: v for k, v in self._pending.items()
+                if now - v[2] < self.RESERVATION_TTL
+                and k[1] not in stored_names}
+            pend = [v for k, v in self._pending.items() if k[0] == ns]
+            used_cpu = (sum(self._pod_cpu(p) for p in pods)
+                        + sum(v[0] for v in pend))
+            used_mem = (sum(self._pod_mem(p) for p in pods)
+                        + sum(v[1] for v in pend))
+            n_pods = len(pods) + len(pend)
+            new_cpu = self._pod_cpu(attrs.obj)
+            new_mem = self._pod_mem(attrs.obj)
+            for q in quotas:
+                hard = (q.get("spec") or {}).get("hard") or {}
+                checks = (
+                    ("pods", n_pods + 1,
+                     lambda v: float(v)),
+                    ("requests.cpu", used_cpu + new_cpu,
+                     quantity.parse_cpu_milli),
+                    ("requests.memory", used_mem + new_mem,
+                     quantity.parse_mem_bytes),
+                    ("cpu", used_cpu + new_cpu, quantity.parse_cpu_milli),
+                    ("memory", used_mem + new_mem, quantity.parse_mem_bytes),
+                )
+                for key, would_use, parse in checks:
+                    if key in hard and would_use > parse(hard[key]):
+                        raise AdmissionDenied(
+                            self.name,
+                            "exceeded quota: %s, requested %s over hard limit"
+                            " %s=%s" % (meta.name(q), key, key, hard[key]))
+            name = (attrs.obj.get("metadata") or {}).get("name") or attrs.name
+            self._pending[(ns, name)] = (new_cpu, new_mem, now)
+
+    @staticmethod
+    def _pod_cpu(pod) -> int:
+        total = 0
+        for c in (pod.get("spec") or {}).get("containers", []):
+            req = ((c.get("resources") or {}).get("requests") or {})
+            total += quantity.parse_cpu_milli(req.get("cpu", "0"))
+        return total
+
+    @staticmethod
+    def _pod_mem(pod) -> int:
+        total = 0
+        for c in (pod.get("spec") or {}).get("containers", []):
+            req = ((c.get("resources") or {}).get("requests") or {})
+            total += quantity.parse_mem_bytes(req.get("memory", "0"))
+        return total
+
+
+class DefaultTolerationSeconds(AdmissionPlugin):
+    """plugin/pkg/admission/defaulttolerationseconds: every pod gets
+    not-ready/unreachable NoExecute tolerations for 300s unless it already
+    tolerates them."""
+
+    name = "DefaultTolerationSeconds"
+    KEYS = ("node.kubernetes.io/not-ready", "node.kubernetes.io/unreachable")
+
+    def admit(self, attrs: Attributes) -> None:
+        if attrs.resource != "pods" or attrs.verb != CREATE:
+            return
+        spec = attrs.obj.setdefault("spec", {})
+        tolerations = spec.setdefault("tolerations", [])
+        for key in self.KEYS:
+            if any(t.get("key") == key and t.get("effect") == "NoExecute"
+                   for t in tolerations):
+                continue
+            tolerations.append({"key": key, "operator": "Exists",
+                                "effect": "NoExecute",
+                                "tolerationSeconds": 300})
+
+
+# -- webhook admission -----------------------------------------------------
+
+class Webhook:
+    """One registered webhook (Mutating or Validating).
+
+    match: fn(attrs) -> bool; url receives an AdmissionReview POST.
+    failure_policy: 'Ignore' (errors pass) or 'Fail' (errors reject) —
+    the same knob as admissionregistration FailurePolicyType.
+    """
+
+    def __init__(self, name: str, url: str, mutating: bool = False,
+                 failure_policy: str = "Fail", timeout: float = 10.0,
+                 match: Optional[Callable[[Attributes], bool]] = None):
+        self.name = name
+        self.url = url
+        self.mutating = mutating
+        self.failure_policy = failure_policy
+        self.timeout = timeout
+        self.match = match or (lambda attrs: True)
+
+
+class WebhookAdmission(AdmissionPlugin):
+    name = "Webhook"
+
+    def __init__(self) -> None:
+        self.webhooks: List[Webhook] = []
+
+    def register(self, wh: Webhook) -> None:
+        self.webhooks.append(wh)
+
+    def _call(self, wh: Webhook, attrs: Attributes) -> Optional[dict]:
+        review = {"kind": "AdmissionReview", "apiVersion": "admission/v1",
+                  "request": {"uid": "0", "operation": attrs.verb,
+                              "resource": attrs.resource,
+                              "subResource": attrs.subresource,
+                              "namespace": attrs.namespace,
+                              "name": attrs.name,
+                              "object": attrs.obj,
+                              "oldObject": attrs.old_obj}}
+        req = urllib.request.Request(
+            wh.url, data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=wh.timeout) as resp:
+                return json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 — network errors hit policy
+            if wh.failure_policy == "Ignore":
+                logger.warning("webhook %s failed (ignored): %s", wh.name, e)
+                return None
+            raise AdmissionDenied(wh.name, "webhook call failed: %s" % e)
+
+    def _apply(self, wh: Webhook, attrs: Attributes, phase: str) -> None:
+        resp = self._call(wh, attrs)
+        if resp is None:
+            return
+        result = resp.get("response") or {}
+        if not result.get("allowed", False):
+            msg = ((result.get("status") or {}).get("message")
+                   or "admission webhook %s denied the request" % wh.name)
+            raise AdmissionDenied(wh.name, msg)
+        if phase == "mutate" and result.get("patchType") == "JSONPatch":
+            import base64
+            ops = json.loads(base64.b64decode(result["patch"]))
+            patched = patchlib.json_patch(attrs.obj, ops)
+            attrs.obj.clear()
+            attrs.obj.update(patched)
+
+    def admit(self, attrs: Attributes) -> None:
+        for wh in self.webhooks:
+            if wh.mutating and wh.match(attrs):
+                self._apply(wh, attrs, "mutate")
+
+    def validate(self, attrs: Attributes) -> None:
+        for wh in self.webhooks:
+            if not wh.mutating and wh.match(attrs):
+                self._apply(wh, attrs, "validate")
+
+
+def default_chain(store: kv.MemoryStore) -> Chain:
+    """The default plugin order (pkg/kubeapiserver/options/plugins.go:64,
+    reduced to the reproduced set)."""
+    return Chain([
+        NamespaceLifecycle(store),
+        LimitRanger(store),
+        DefaultTolerationSeconds(),
+        Priority(store),
+        # webhook admission sits between mutating in-tree and quota
+        ResourceQuota(store),  # always last (plugins.go keeps quota last)
+    ])

@@ -1,0 +1,146 @@
+"""CustomResourceDefinition serving.
+
+Reference: staging/src/k8s.io/apiextensions-apiserver — CRD objects create
+new REST resources at /apis/{group}/{version}/...; custom objects are
+validated against the CRD's openAPIV3Schema (structural-schema subset:
+type, required, properties, items, enum, minimum/maximum, pattern) and
+stored like any built-in.  The coscheduling PodGroup CRD rides this.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional
+
+CRDS = "customresourcedefinitions"
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def validate_schema(obj, schema: dict, path: str = "") -> None:
+    """Validate obj against an openAPIV3Schema subset."""
+    if not schema:
+        return
+    typ = schema.get("type")
+    where = path or "<root>"
+    if typ == "object" or (typ is None and "properties" in schema):
+        if not isinstance(obj, dict):
+            raise ValidationError("%s: expected object" % where)
+        props = schema.get("properties", {})
+        for req in schema.get("required", []):
+            if req not in obj:
+                raise ValidationError("%s: missing required field %r"
+                                      % (where, req))
+        for key, val in obj.items():
+            if key in props:
+                validate_schema(val, props[key], path + "." + key)
+            elif schema.get("additionalProperties") is False:
+                raise ValidationError("%s: unknown field %r" % (where, key))
+    elif typ == "array":
+        if not isinstance(obj, list):
+            raise ValidationError("%s: expected array" % where)
+        items = schema.get("items")
+        if items:
+            for i, v in enumerate(obj):
+                validate_schema(v, items, "%s[%d]" % (path, i))
+    elif typ == "string":
+        if not isinstance(obj, str):
+            raise ValidationError("%s: expected string" % where)
+        pat = schema.get("pattern")
+        if pat and not re.search(pat, obj):
+            raise ValidationError("%s: does not match pattern %s"
+                                  % (where, pat))
+    elif typ == "integer":
+        if not isinstance(obj, int) or isinstance(obj, bool):
+            raise ValidationError("%s: expected integer" % where)
+    elif typ == "number":
+        if not isinstance(obj, (int, float)) or isinstance(obj, bool):
+            raise ValidationError("%s: expected number" % where)
+    elif typ == "boolean":
+        if not isinstance(obj, bool):
+            raise ValidationError("%s: expected boolean" % where)
+    if "enum" in schema and obj not in schema["enum"]:
+        raise ValidationError("%s: %r not in enum %s"
+                              % (where, obj, schema["enum"]))
+    if isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        if "minimum" in schema and obj < schema["minimum"]:
+            raise ValidationError("%s: %s below minimum %s"
+                                  % (where, obj, schema["minimum"]))
+        if "maximum" in schema and obj > schema["maximum"]:
+            raise ValidationError("%s: %s above maximum %s"
+                                  % (where, obj, schema["maximum"]))
+
+
+class CRDRegistry:
+    """Tracks established CRDs; maps (group, plural) -> serving info."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_plural: Dict[str, dict] = {}
+
+    def establish(self, crd_obj: dict) -> dict:
+        """Validate + index a CRD object; returns it with status set."""
+        spec = crd_obj.get("spec") or {}
+        group = spec.get("group")
+        names = spec.get("names") or {}
+        plural = names.get("plural")
+        kind = names.get("kind")
+        if not group or not plural or not kind:
+            raise ValidationError(
+                "CRD needs spec.group, spec.names.plural, spec.names.kind")
+        versions = spec.get("versions") or [{"name": "v1", "served": True,
+                                             "storage": True}]
+        served = [v for v in versions if v.get("served", True)]
+        if not served:
+            raise ValidationError("CRD has no served versions")
+        info = {
+            "group": group, "plural": plural, "kind": kind,
+            "singular": names.get("singular", kind.lower()),
+            "short_names": names.get("shortNames", []),
+            "namespaced": spec.get("scope", "Namespaced") == "Namespaced",
+            "versions": [v["name"] for v in served],
+            "schemas": {v["name"]: ((v.get("schema") or {})
+                                    .get("openAPIV3Schema") or {})
+                        for v in served},
+        }
+        with self._lock:
+            self._by_plural[plural] = info
+            for short in info["short_names"]:
+                self._by_plural.setdefault(short, info)
+        crd_obj.setdefault("status", {})["conditions"] = [
+            {"type": "Established", "status": "True"}]
+        return crd_obj
+
+    def remove(self, crd_obj: dict) -> None:
+        names = (crd_obj.get("spec") or {}).get("names") or {}
+        with self._lock:
+            info = self._by_plural.pop(names.get("plural", ""), None)
+            if info:
+                for short in info["short_names"]:
+                    if self._by_plural.get(short) is info:
+                        del self._by_plural[short]
+
+    def lookup(self, plural: str) -> Optional[dict]:
+        with self._lock:
+            return self._by_plural.get(plural)
+
+    def resources(self) -> List[dict]:
+        with self._lock:
+            seen = []
+            for info in self._by_plural.values():
+                if info not in seen:
+                    seen.append(info)
+            return seen
+
+    def validate_object(self, plural: str, version: str, obj: dict) -> None:
+        info = self.lookup(plural)
+        if info is None:
+            raise ValidationError("no CRD for resource %r" % plural)
+        if version not in info["versions"]:
+            raise ValidationError("version %r not served for %r"
+                                  % (version, plural))
+        schema = info["schemas"].get(version) or {}
+        validate_schema(obj, schema)

@@ -1,0 +1,159 @@
+"""API Priority & Fairness (simplified).
+
+Reference: staging/src/k8s.io/apiserver/pkg/util/flowcontrol — FlowSchemas
+classify requests into PriorityLevels; each level has a concurrency limit
+(seats) and bounded per-flow queues drained fairly; exempt levels bypass.
+Reproduced contract: classification by (user, verb, resource) matchers,
+per-level semaphore with a bounded FIFO wait queue and a queue timeout;
+a full queue or timed-out wait -> HTTP 429 with Retry-After.  The fair
+*shuffle-sharding* of upstream queues collapses to per-flow hashing over a
+fixed queue set — fairness between flows, not between individual requests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+DEFAULT_LEVELS = (
+    # (name, seats, queues, queue_length, exempt)
+    ("exempt", 0, 0, 0, True),
+    ("leader-election", 10, 16, 50, False),
+    ("workload-high", 40, 128, 50, False),
+    ("workload-low", 20, 128, 50, False),
+    ("global-default", 20, 128, 50, False),
+    ("catch-all", 5, 1, 50, False),
+)
+
+
+class RejectedError(Exception):
+    """Surfaces as HTTP 429 Too Many Requests."""
+
+
+class PriorityLevel:
+    def __init__(self, name: str, seats: int, queues: int = 64,
+                 queue_length: int = 50, exempt: bool = False):
+        self.name = name
+        self.seats = seats
+        self.exempt = exempt
+        self.queue_length = queue_length
+        self.queues = max(1, queues)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._in_flight = 0
+        self._waiting = 0
+        # metrics
+        self.dispatched = 0
+        self.rejected = 0
+        self.timed_out = 0
+
+    def acquire(self, flow_key: str = "", timeout: float = 15.0) -> bool:
+        if self.exempt:
+            with self._lock:
+                self.dispatched += 1
+            return True
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            if (self._in_flight < self.seats and self._waiting == 0):
+                self._in_flight += 1
+                self.dispatched += 1
+                return True
+            if self._waiting >= self.queue_length * self.queues:
+                self.rejected += 1
+                raise RejectedError("too many requests for priority level "
+                                    + self.name)
+            self._waiting += 1
+            try:
+                while self._in_flight >= self.seats:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self.timed_out += 1
+                        raise RejectedError(
+                            "request timed out in priority level queue "
+                            + self.name)
+                    self._cond.wait(remaining)
+                self._in_flight += 1
+                self.dispatched += 1
+                return True
+            finally:
+                self._waiting -= 1
+
+    def release(self) -> None:
+        if self.exempt:
+            return
+        with self._cond:
+            self._in_flight = max(0, self._in_flight - 1)
+            self._cond.notify()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"in_flight": self._in_flight, "waiting": self._waiting,
+                    "dispatched": self.dispatched, "rejected": self.rejected,
+                    "timed_out": self.timed_out}
+
+
+class FlowSchema:
+    """Matches requests to a priority level (flowcontrol FlowSchema)."""
+
+    def __init__(self, name: str, level: str, matching_precedence: int = 1000,
+                 match: Optional[Callable[[str, str, str], bool]] = None):
+        self.name = name
+        self.level = level
+        self.matching_precedence = matching_precedence
+        self.match = match or (lambda user, verb, resource: True)
+
+
+class Dispatcher:
+    """The WithPriorityAndFairness filter (config.go:823)."""
+
+    def __init__(self, levels=DEFAULT_LEVELS,
+                 schemas: Optional[List[FlowSchema]] = None,
+                 queue_timeout: float = 15.0):
+        self.levels = {name: PriorityLevel(name, seats, queues, qlen, exempt)
+                       for name, seats, queues, qlen, exempt in levels}
+        self.queue_timeout = queue_timeout
+        self.schemas = sorted(schemas if schemas is not None
+                              else self._default_schemas(),
+                              key=lambda s: s.matching_precedence)
+
+    @staticmethod
+    def _default_schemas() -> List[FlowSchema]:
+        return [
+            FlowSchema("system-leader-election", "leader-election", 100,
+                       lambda u, v, r: r == "leases"),
+            FlowSchema("kube-system-service-accounts", "workload-high", 900,
+                       lambda u, v, r: u.startswith("system:")),
+            FlowSchema("global-default", "global-default", 9900),
+            FlowSchema("catch-all", "catch-all", 10000),
+        ]
+
+    def classify(self, user: str, verb: str, resource: str) -> PriorityLevel:
+        for schema in self.schemas:
+            if schema.match(user, verb, resource):
+                level = self.levels.get(schema.level)
+                if level is not None:
+                    return level
+        return self.levels["catch-all"]
+
+    class _Ticket:
+        __slots__ = ("level",)
+
+        def __init__(self, level: PriorityLevel):
+            self.level = level
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            self.level.release()
+
+    def admit(self, user: str, verb: str, resource: str) -> "Dispatcher._Ticket":
+        """Raises RejectedError (-> 429) or returns a context manager that
+        holds a seat for the request's duration."""
+        level = self.classify(user, verb, resource)
+        level.acquire(flow_key=user, timeout=self.queue_timeout)
+        return self._Ticket(level)
+
+    def stats(self) -> dict:
+        return {name: lvl.stats() for name, lvl in self.levels.items()}

@@ -8,18 +8,25 @@ contract (SURVEY.md layers 4-5):
     GET    /api/v1/namespaces/{ns}/{resource}/{nm}  get
     POST   /api/v1/namespaces/{ns}/{resource}       create
     PUT    /api/v1/namespaces/{ns}/{resource}/{nm}  update (CAS -> 409)
+    PATCH  ...                                      merge/json/strategic patch
     DELETE /api/v1/namespaces/{ns}/{resource}/{nm}  delete
     GET    ...?watch=true&resourceVersion=N         newline-delimited JSON
                                                     event stream
-  plus /healthz /readyz /version /metrics, and a minimal handler chain
-  (request log -> authn stub -> admission hooks -> registry), mirroring
-  DefaultBuildHandlerChain (server/config.go:813) in shape.
+  /apis/{group}/{version}/... serves the same verbs for grouped + custom
+  resources (apiextensions-apiserver shape); subresources:
+    PUT/PATCH .../{name}/status      status-only writes (registry strategies)
+    POST      .../pods/{name}/binding    writes spec.nodeName (scheduler)
+    POST      .../pods/{name}/eviction   PDB-checked delete (429 if blocked)
+    GET/PUT   .../{name}/scale           replica count subresource
 
-Cluster-scoped resources (nodes, ...) use ns="-" internally; the routes
-also accept /api/v1/{resource}/{name} for them.
+Handler chain (DefaultBuildHandlerChain, server/config.go:813, in order):
+  request log -> authn (bearer token) -> audit -> API priority & fairness
+  -> route -> admission chain (mutating then validating) -> registry/store.
 
 Errors are metav1.Status-shaped JSON with the right HTTP codes
-(404/409/410 Gone for compacted watches).
+(404/409/410 Gone for compacted watches/422 validation/429 APF).
+Cluster-scoped resources (nodes, ...) use an empty namespace key; the routes
+also accept /api/v1/{resource}/{name} for them.
 """
 
 from __future__ import annotations
@@ -32,15 +39,36 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import __version__
 from ..api import meta
+from ..component_base import configz
 from ..store import kv
+from . import admission as adm
+from . import audit as auditlib
+from . import crd as crdlib
+from . import flowcontrol
+from . import patch as patchlib
 
 logger = logging.getLogger(__name__)
 
 CLUSTER_SCOPED = {"nodes", "persistentvolumes", "namespaces", "priorityclasses",
-                  "storageclasses", "csinodes"}
+                  "storageclasses", "csinodes", crdlib.CRDS}
 
-# admission hook: fn(verb, resource, obj) -> obj (mutate) or raise AdmissionError
-AdmissionHook = "callable"
+SUBRESOURCES = {"status", "binding", "eviction", "scale"}
+
+# built-in group routing (/apis/{group}/{version}); all resources share the
+# flat store namespace, so the group prefix is addressing only
+BUILTIN_GROUPS = {
+    "apps": {"deployments", "replicasets", "statefulsets", "daemonsets"},
+    "batch": {"jobs", "cronjobs"},
+    "policy": {"poddisruptionbudgets"},
+    "scheduling.k8s.io": {"priorityclasses"},
+    "storage.k8s.io": {"storageclasses", "csinodes"},
+    "coordination.k8s.io": {"leases"},
+    "apiextensions.k8s.io": {crdlib.CRDS},
+    "autoscaling": {"horizontalpodautoscalers"},
+}
+
+SCALABLE = {"deployments", "replicasets", "statefulsets",
+            "replicationcontrollers"}
 
 
 class AdmissionError(Exception):
@@ -52,14 +80,52 @@ def status_error(code: int, reason: str, message: str) -> dict:
             "reason": reason, "message": message, "code": code}
 
 
+class _Route:
+    __slots__ = ("resource", "ns", "name", "subresource", "group", "version",
+                 "query", "path")
+
+    def __init__(self, resource=None, ns=None, name=None, subresource=None,
+                 group=None, version="v1", query=None, path=""):
+        self.resource = resource
+        self.ns = ns
+        self.name = name
+        self.subresource = subresource
+        self.group = group
+        self.version = version
+        self.query = query or {}
+        self.path = path
+
+
 class APIServer:
     def __init__(self, store: kv.MemoryStore, host: str = "127.0.0.1",
-                 port: int = 0, token: str | None = None):
+                 port: int = 0, token: str | None = None,
+                 admission_chain: adm.Chain | None = None,
+                 enable_default_admission: bool = False,
+                 flow_dispatcher: flowcontrol.Dispatcher | None = None,
+                 audit_logger: auditlib.AuditLogger | None = None):
         self.store = store
         self.token = token
-        self.admission_hooks: list = []
-        self.metrics = {"requests_total": 0, "watch_streams": 0}
+        self.admission_hooks: list = []  # legacy fn(verb, resource, obj) hooks
+        self.admission_chain = admission_chain or (
+            adm.default_chain(store) if enable_default_admission
+            else adm.Chain())
+        self.flow = flow_dispatcher  # None = APF filter disabled
+        self.audit = audit_logger
+        self.crds = crdlib.CRDRegistry()
+        self.metrics = {"requests_total": 0, "watch_streams": 0,
+                        "requests_rejected_total": 0}
         self._metrics_lock = threading.Lock()
+        # re-establish CRDs already persisted (restart = re-list, crash-only)
+        try:
+            existing, _ = store.list(crdlib.CRDS)
+            for obj in existing:
+                try:
+                    self.crds.establish(obj)
+                except crdlib.ValidationError:
+                    logger.warning("skipping invalid persisted CRD %s",
+                                   meta.name(obj))
+        except Exception:  # noqa: BLE001 — store without that resource yet
+            pass
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -100,6 +166,12 @@ class APIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _user(self) -> str:
+                auth = self.headers.get("Authorization", "")
+                if auth.startswith("Bearer "):
+                    return "system:token-user"
+                return "system:anonymous"
+
             def _authn(self) -> bool:
                 if server.token is None:
                     return True
@@ -110,44 +182,117 @@ class APIServer:
                                                   "invalid bearer token"))
                 return False
 
-            def _route(self):
-                """-> (resource, ns, name, query) or None after writing error."""
+            def _route(self) -> _Route | None:
                 u = urlparse(self.path)
                 parts = [p for p in u.path.split("/") if p]
                 q = parse_qs(u.query)
-                if not parts or parts[0] not in ("api",):
-                    return None, None, None, q, u.path
-                # /api/v1/...
-                rest = parts[2:] if len(parts) > 1 else []
-                ns = name = None
-                resource = None
-                if len(rest) >= 2 and rest[0] == "namespaces" and len(rest) >= 3:
-                    ns, resource = rest[1], rest[2]
-                    name = rest[3] if len(rest) > 3 else None
+                r = _Route(query=q, path=u.path)
+                if not parts:
+                    return r
+                if parts[0] == "api":
+                    rest = parts[2:]  # skip version "v1"
+                elif parts[0] == "apis" and len(parts) >= 3:
+                    r.group, r.version = parts[1], parts[2]
+                    rest = parts[3:]
+                else:
+                    return r
+                if len(rest) >= 3 and rest[0] == "namespaces":
+                    r.ns, r.resource = rest[1], rest[2]
+                    if len(rest) > 3:
+                        r.name = rest[3]
+                    if len(rest) > 4:
+                        if rest[4] in SUBRESOURCES and len(rest) == 5:
+                            r.subresource = rest[4]
+                        else:  # unknown subresource (exec/log/...) -> 404
+                            r.resource = None
                 elif rest:
-                    resource = rest[0]
-                    name = rest[1] if len(rest) > 1 else None
-                return resource, ns, name, q, u.path
+                    r.resource = rest[0]
+                    if len(rest) > 1:
+                        r.name = rest[1]
+                    if len(rest) > 2:
+                        if rest[2] in SUBRESOURCES and len(rest) == 3:
+                            r.subresource = rest[2]
+                        else:
+                            r.resource = None
+                return r
+
+            # ---- shared filters ----
+
+            def _begin(self, verb: str):
+                """authn + APF admission. Returns (route, ticket) or None
+                after writing the error response."""
+                with server._metrics_lock:
+                    server.metrics["requests_total"] += 1
+                if not self._authn():
+                    return None
+                r = self._route()
+                ticket = None
+                # long-running requests (watches) are exempt from APF —
+                # a held seat for a stream's lifetime would starve the
+                # level (upstream longRunningRequestCheck does the same)
+                is_watch = bool(r) and r.query.get("watch",
+                                                   ["false"])[0] == "true"
+                if server.flow is not None and r and r.resource \
+                        and not is_watch:
+                    try:
+                        ticket = server.flow.admit(self._user(), verb,
+                                                   r.resource)
+                    except flowcontrol.RejectedError as e:
+                        with server._metrics_lock:
+                            server.metrics["requests_rejected_total"] += 1
+                        body = json.dumps(status_error(
+                            429, "TooManyRequests", str(e))).encode()
+                        self.send_response(429)
+                        self.send_header("Retry-After", "1")
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return None
+                return r, ticket
+
+            def _audit(self, r: _Route, verb: str, code: int,
+                       obj: dict | None = None) -> None:
+                if server.audit is not None and r is not None and r.resource:
+                    server.audit.log("ResponseComplete", self._user(), verb,
+                                     r.resource, r.ns or "", r.name or "",
+                                     code, obj)
 
             # ---- verbs ----
 
             def do_GET(self):
-                with server._metrics_lock:
-                    server.metrics["requests_total"] += 1
-                if not self._authn():
+                begun = self._begin("get")
+                if begun is None:
                     return
-                path = urlparse(self.path).path
-                if path == "/healthz" or path == "/readyz" or path == "/livez":
+                r, ticket = begun
+                try:
+                    self._do_get(r)
+                finally:
+                    if ticket:
+                        ticket.__exit__()
+
+            def _do_get(self, r: _Route) -> None:
+                path = r.path
+                if path in ("/healthz", "/readyz", "/livez"):
                     self._send_json(200, {"status": "ok"})
                     return
                 if path == "/version":
                     self._send_json(200, {"gitVersion": f"v{__version__}",
                                           "platform": "tpu"})
                     return
+                if path == "/configz":
+                    self._send_json(200, configz.default_registry.snapshot())
+                    return
                 if path == "/metrics":
                     with server._metrics_lock:
                         lines = [f"apiserver_{k} {v}"
                                  for k, v in server.metrics.items()]
+                    if server.flow is not None:
+                        for name, st in server.flow.stats().items():
+                            for k, v in st.items():
+                                lines.append(
+                                    'apiserver_flowcontrol_%s{priority_level'
+                                    '="%s"} %s' % (k, name, v))
                     body = ("\n".join(lines) + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")
@@ -155,22 +300,39 @@ class APIServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                resource, ns, name, q, _ = self._route()
-                if resource is None:
+                if path == "/apis":
+                    groups = sorted(set(BUILTIN_GROUPS)
+                                    | {i["group"]
+                                       for i in server.crds.resources()})
+                    self._send_json(200, {"kind": "APIGroupList",
+                                          "groups": [{"name": g}
+                                                     for g in groups]})
+                    return
+                if r.resource is None:
                     self._send_json(404, status_error(404, "NotFound", path))
                     return
                 try:
-                    if q.get("watch", ["false"])[0] == "true":
-                        self._serve_watch(resource, q)
-                    elif name is not None:
-                        obj = server.store.get(resource, ns or "", name)
+                    if r.query.get("watch", ["false"])[0] == "true":
+                        self._serve_watch(r.resource, r.query)
+                    elif r.name is not None and r.subresource == "scale":
+                        obj = server.store.get(r.resource, r.ns or "", r.name)
+                        self._send_json(200, _scale_of(obj, r.resource))
+                        self._audit(r, "get", 200)
+                    elif r.name is not None:
+                        obj = server.store.get(r.resource, r.ns or "", r.name)
                         self._send_json(200, obj)
+                        self._audit(r, "get", 200)
                     else:
-                        items, rv = server.store.list(resource, ns)
+                        sel = r.query.get("labelSelector", [None])[0]
+                        items, rv = server.store.list(r.resource, r.ns)
+                        if sel:
+                            items = [o for o in items
+                                     if _matches_selector(o, sel)]
                         self._send_json(200, {
                             "kind": "List", "apiVersion": "v1",
                             "metadata": {"resourceVersion": str(rv)},
                             "items": items})
+                        self._audit(r, "list", 200)
                 except kv.NotFoundError as e:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
                 except kv.TooOldError as e:
@@ -215,7 +377,7 @@ class APIServer:
                     pass
                 self.close_connection = True
 
-            def _read_body(self) -> dict | None:
+            def _read_body(self) -> dict | list | None:
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     return json.loads(self.rfile.read(length))
@@ -224,72 +386,404 @@ class APIServer:
                                                       "invalid JSON body"))
                     return None
 
-            def _admit(self, verb: str, resource: str, obj: dict) -> dict | None:
+            def _admit(self, verb: str, r: _Route, obj: dict,
+                       old: dict | None = None) -> dict | None:
+                """Run legacy hooks + the admission chain; None = rejected
+                (response already written)."""
                 for hook in server.admission_hooks:
                     try:
-                        obj = hook(verb, resource, obj) or obj
+                        obj = hook(verb, r.resource, obj) or obj
                     except AdmissionError as e:
                         self._send_json(400, status_error(
                             400, "AdmissionDenied", str(e)))
                         return None
-                return obj
+                attrs = adm.Attributes(verb, r.resource, obj, old,
+                                       namespace=r.ns or "",
+                                       name=r.name or meta.name(obj) or "",
+                                       subresource=r.subresource or "")
+                try:
+                    server.admission_chain.run(attrs)
+                except adm.AdmissionDenied as e:
+                    self._send_json(403, status_error(
+                        403, "Forbidden",
+                        "admission plugin %s denied the request: %s"
+                        % (e.plugin, e)))
+                    return None
+                return attrs.obj
+
+            def _validate_custom(self, r: _Route, obj: dict) -> bool:
+                """CRD schema validation for custom resources."""
+                if r.group is None or r.group in BUILTIN_GROUPS:
+                    return True
+                try:
+                    server.crds.validate_object(r.resource, r.version, obj)
+                    return True
+                except crdlib.ValidationError as e:
+                    self._send_json(422, status_error(422, "Invalid", str(e)))
+                    return False
 
             def do_POST(self):
-                with server._metrics_lock:
-                    server.metrics["requests_total"] += 1
-                if not self._authn():
+                begun = self._begin("create")
+                if begun is None:
                     return
-                resource, ns, name, q, path = self._route()
-                if resource is None:
-                    self._send_json(404, status_error(404, "NotFound", path))
+                r, ticket = begun
+                try:
+                    self._do_post(r)
+                finally:
+                    if ticket:
+                        ticket.__exit__()
+
+            def _do_post(self, r: _Route) -> None:
+                if r.resource is None:
+                    self._send_json(404, status_error(404, "NotFound", r.path))
                     return
                 obj = self._read_body()
                 if obj is None:
                     return
-                if ns and "metadata" in obj:
-                    obj["metadata"].setdefault("namespace", ns)
-                obj = self._admit("CREATE", resource, obj)
+                # -- subresources --
+                if r.subresource == "binding":
+                    self._post_binding(r, obj)
+                    return
+                if r.subresource == "eviction":
+                    self._post_eviction(r, obj)
+                    return
+                if r.ns and "metadata" in obj:
+                    obj["metadata"].setdefault("namespace", r.ns)
+                obj = self._admit(adm.CREATE, r, obj)
                 if obj is None:
                     return
+                if not self._validate_custom(r, obj):
+                    return
+                if r.resource == crdlib.CRDS:
+                    try:
+                        obj = server.crds.establish(obj)
+                    except crdlib.ValidationError as e:
+                        self._send_json(422, status_error(422, "Invalid",
+                                                          str(e)))
+                        return
                 try:
-                    self._send_json(201, server.store.create(resource, obj))
+                    created = server.store.create(r.resource, obj)
+                    self._send_json(201, created)
+                    self._audit(r, "create", 201, created)
                 except kv.AlreadyExistsError as e:
-                    self._send_json(409, status_error(409, "AlreadyExists", str(e)))
+                    self._send_json(409, status_error(409, "AlreadyExists",
+                                                      str(e)))
+
+            def _post_binding(self, r: _Route, binding: dict) -> None:
+                """POST pods/{name}/binding (registry/core/pod/storage
+                BindingREST): writes spec.nodeName once."""
+                node = ((binding.get("target") or {}).get("name")
+                        or binding.get("nodeName"))
+                if not node:
+                    self._send_json(400, status_error(
+                        400, "BadRequest", "binding needs target.name"))
+                    return
+                try:
+                    def bind(pod):
+                        if meta.pod_node_name(pod):
+                            raise kv.ConflictError(
+                                "pod %s is already assigned to node %s"
+                                % (r.name, meta.pod_node_name(pod)))
+                        pod.setdefault("spec", {})["nodeName"] = node
+                        return pod
+                    server.store.guaranteed_update(
+                        "pods", r.ns or "default", r.name, bind)
+                    self._send_json(201, {"kind": "Status", "status": "Success"})
+                    self._audit(r, "create", 201)
+                except kv.NotFoundError as e:
+                    self._send_json(404, status_error(404, "NotFound", str(e)))
+                except kv.ConflictError as e:
+                    self._send_json(409, status_error(409, "Conflict", str(e)))
+
+            def _post_eviction(self, r: _Route, eviction: dict) -> None:
+                """POST pods/{name}/eviction (registry/core/pod/storage
+                EvictionREST): PDB-gated delete -> 429 when blocked."""
+                ns = r.ns or "default"
+                try:
+                    pod = server.store.get("pods", ns, r.name)
+                except kv.NotFoundError as e:
+                    self._send_json(404, status_error(404, "NotFound", str(e)))
+                    return
+                try:
+                    pdbs, _ = server.store.list("poddisruptionbudgets", ns)
+                except Exception:  # noqa: BLE001
+                    pdbs = []
+                guarding = [p for p in pdbs if _pdb_matches(p, pod)]
+                for pdb in guarding:
+                    if not _pdb_allows_eviction(server.store, pdb, ns):
+                        self._send_json(429, status_error(
+                            429, "TooManyRequests",
+                            "Cannot evict pod as it would violate the pod's "
+                            "disruption budget."))
+                        return
+                server.store.delete("pods", ns, r.name)
+                for pdb in guarding:  # eviction consumes a disruption
+                    if "disruptionsAllowed" in (pdb.get("status") or {}):
+                        def dec(cur):
+                            st = cur.setdefault("status", {})
+                            st["disruptionsAllowed"] = max(
+                                0, int(st.get("disruptionsAllowed", 0)) - 1)
+                            return cur
+                        try:
+                            server.store.guaranteed_update(
+                                "poddisruptionbudgets", ns,
+                                (pdb.get("metadata") or {}).get("name"), dec)
+                        except kv.NotFoundError:
+                            pass
+                self._send_json(201, {"kind": "Status", "status": "Success"})
+                self._audit(r, "delete", 201)
 
             def do_PUT(self):
-                with server._metrics_lock:
-                    server.metrics["requests_total"] += 1
-                if not self._authn():
+                begun = self._begin("update")
+                if begun is None:
                     return
-                resource, ns, name, q, path = self._route()
-                if resource is None or name is None:
-                    self._send_json(404, status_error(404, "NotFound", path))
+                r, ticket = begun
+                try:
+                    self._do_put(r)
+                finally:
+                    if ticket:
+                        ticket.__exit__()
+
+            def _do_put(self, r: _Route) -> None:
+                if r.resource is None or r.name is None:
+                    self._send_json(404, status_error(404, "NotFound", r.path))
                     return
                 obj = self._read_body()
                 if obj is None:
                     return
-                obj = self._admit("UPDATE", resource, obj)
-                if obj is None:
-                    return
                 try:
-                    self._send_json(200, server.store.update(resource, obj))
+                    if r.subresource == "status":
+                        # status strategy: only .status moves (registry
+                        # strategies split spec/status writes)
+                        new_status = obj.get("status")
+
+                        def set_status(cur):
+                            cur["status"] = new_status
+                            return cur
+                        updated = server.store.guaranteed_update(
+                            r.resource, r.ns or "", r.name, set_status)
+                        self._send_json(200, updated)
+                        self._audit(r, "update", 200)
+                        return
+                    if r.subresource == "scale":
+                        replicas = int((obj.get("spec") or {})
+                                       .get("replicas", 0))
+
+                        def set_scale(cur):
+                            cur.setdefault("spec", {})["replicas"] = replicas
+                            return cur
+                        updated = server.store.guaranteed_update(
+                            r.resource, r.ns or "", r.name, set_scale)
+                        self._send_json(200, _scale_of(updated, r.resource))
+                        self._audit(r, "update", 200)
+                        return
+                    old = None
+                    try:
+                        old = server.store.get(r.resource, r.ns or "", r.name)
+                    except kv.NotFoundError:
+                        pass
+                    obj = self._admit(adm.UPDATE, r, obj, old)
+                    if obj is None:
+                        return
+                    if not self._validate_custom(r, obj):
+                        return
+                    updated = server.store.update(r.resource, obj)
+                    self._send_json(200, updated)
+                    self._audit(r, "update", 200, updated)
+                except kv.NotFoundError as e:
+                    self._send_json(404, status_error(404, "NotFound", str(e)))
+                except kv.ConflictError as e:
+                    self._send_json(409, status_error(409, "Conflict", str(e)))
+
+            def do_PATCH(self):
+                begun = self._begin("patch")
+                if begun is None:
+                    return
+                r, ticket = begun
+                try:
+                    self._do_patch(r)
+                finally:
+                    if ticket:
+                        ticket.__exit__()
+
+            def _do_patch(self, r: _Route) -> None:
+                if r.resource is None or r.name is None:
+                    self._send_json(404, status_error(404, "NotFound", r.path))
+                    return
+                body = self._read_body()
+                if body is None:
+                    return
+                ctype = self.headers.get("Content-Type",
+                                         "application/strategic-merge-patch+json")
+                try:
+                    def apply(cur):
+                        patched = patchlib.apply_patch(ctype, cur, body)
+                        if r.subresource == "status":
+                            # status patch may only change .status
+                            merged = dict(cur)
+                            merged["status"] = patched.get("status")
+                            patched = merged
+                        # resourceVersion comes from the store's CAS loop
+                        patched.setdefault("metadata", {})["resourceVersion"] = \
+                            (cur.get("metadata") or {}).get("resourceVersion")
+                        # the patched object passes the same gates as a PUT
+                        for hook in server.admission_hooks:
+                            patched = hook(adm.UPDATE, r.resource,
+                                           patched) or patched
+                        server.admission_chain.run(adm.Attributes(
+                            adm.UPDATE, r.resource, patched, cur,
+                            namespace=r.ns or "", name=r.name,
+                            subresource=r.subresource or ""))
+                        if r.group is not None and r.group not in BUILTIN_GROUPS:
+                            server.crds.validate_object(r.resource, r.version,
+                                                        patched)
+                        return patched
+                    updated = server.store.guaranteed_update(
+                        r.resource, r.ns or "", r.name, apply)
+                    self._send_json(200, updated)
+                    self._audit(r, "patch", 200)
+                except (patchlib.PatchError, crdlib.ValidationError) as e:
+                    self._send_json(422, status_error(422, "Invalid", str(e)))
+                except adm.AdmissionDenied as e:
+                    self._send_json(403, status_error(
+                        403, "Forbidden",
+                        "admission plugin %s denied the request: %s"
+                        % (e.plugin, e)))
+                except AdmissionError as e:
+                    self._send_json(400, status_error(400, "AdmissionDenied",
+                                                      str(e)))
                 except kv.NotFoundError as e:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
                 except kv.ConflictError as e:
                     self._send_json(409, status_error(409, "Conflict", str(e)))
 
             def do_DELETE(self):
-                with server._metrics_lock:
-                    server.metrics["requests_total"] += 1
-                if not self._authn():
+                begun = self._begin("delete")
+                if begun is None:
                     return
-                resource, ns, name, q, path = self._route()
-                if resource is None or name is None:
-                    self._send_json(404, status_error(404, "NotFound", path))
+                r, ticket = begun
+                try:
+                    self._do_delete(r)
+                finally:
+                    if ticket:
+                        ticket.__exit__()
+
+            def _do_delete(self, r: _Route) -> None:
+                if r.resource is None or r.name is None:
+                    self._send_json(404, status_error(404, "NotFound", r.path))
+                    return
+                attrs = adm.Attributes(adm.DELETE, r.resource, None,
+                                       namespace=r.ns or "", name=r.name)
+                try:
+                    server.admission_chain.run(attrs)
+                except adm.AdmissionDenied as e:
+                    self._send_json(403, status_error(
+                        403, "Forbidden", str(e)))
                     return
                 try:
-                    self._send_json(200, server.store.delete(resource, ns or "", name))
+                    deleted = server.store.delete(r.resource, r.ns or "",
+                                                  r.name)
+                    if r.resource == crdlib.CRDS:
+                        server.crds.remove(deleted)
+                    self._send_json(200, deleted)
+                    self._audit(r, "delete", 200)
                 except kv.NotFoundError as e:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
 
         return Handler
+
+
+# -- helpers ---------------------------------------------------------------
+
+def _scale_of(obj: dict, resource: str) -> dict:
+    """autoscaling/v1 Scale subresource projection."""
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    return {"kind": "Scale", "apiVersion": "autoscaling/v1",
+            "metadata": {"name": meta.name(obj),
+                         "namespace": meta.namespace(obj)},
+            "spec": {"replicas": spec.get("replicas", 0)},
+            "status": {"replicas": status.get("replicas", 0),
+                       "selector": (spec.get("selector") or {})
+                       .get("matchLabels", {})}}
+
+
+def _matches_selector(obj: dict, selector: str) -> bool:
+    """labelSelector query param: k=v[,k=v...] equality matching."""
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    for part in selector.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            k, v = part.split("!=", 1)
+            if labels.get(k.strip()) == v.strip():
+                return False
+        elif "=" in part:
+            k, v = part.split("=", 1)
+            if labels.get(k.strip()) != v.strip():
+                return False
+        else:  # existence
+            if part not in labels:
+                return False
+    return True
+
+
+def _pdb_matches(pdb: dict, pod: dict) -> bool:
+    sel = ((pdb.get("spec") or {}).get("selector") or {}).get("matchLabels", {})
+    labels = (pod.get("metadata") or {}).get("labels") or {}
+    return bool(sel) and all(labels.get(k) == v for k, v in sel.items())
+
+
+def _parse_intstr(value, expected: int) -> int:
+    """IntOrString: '50%' of expected (rounded up for minAvailable-style
+    use; upstream uses intstr.GetScaledValueFromIntOrPercent)."""
+    if isinstance(value, str) and value.endswith("%"):
+        pct = float(value[:-1])
+        return -(-int(pct * expected) // 100)  # ceil
+    return int(value)
+
+
+def _expected_count(store: kv.MemoryStore, matching: list, ns: str) -> int:
+    """Desired replica count from the pods' owning controller (the
+    disruption controller reads scale subresources the same way); falls
+    back to the observed pod count."""
+    for p in matching:
+        ref = next((r for r in ((p.get("metadata") or {})
+                                .get("ownerReferences") or [])
+                    if r.get("controller")), None)
+        if ref and ref.get("kind") in ("ReplicaSet", "StatefulSet",
+                                       "ReplicationController", "Deployment"):
+            try:
+                owner = store.get(ref["kind"].lower() + "s", ns, ref["name"])
+                return int((owner.get("spec") or {}).get("replicas", 1))
+            except kv.NotFoundError:
+                pass
+    return len(matching)
+
+
+def _pdb_allows_eviction(store: kv.MemoryStore, pdb: dict, ns: str) -> bool:
+    """Eviction gate (registry/core/pod/storage/eviction.go): prefer the
+    disruption controller's status.disruptionsAllowed; otherwise compute
+    inline from minAvailable/maxUnavailable (IntOrString, % supported)."""
+    status = pdb.get("status") or {}
+    if "disruptionsAllowed" in status:
+        return int(status["disruptionsAllowed"]) > 0
+    spec = pdb.get("spec") or {}
+    sel = (spec.get("selector") or {}).get("matchLabels", {})
+    pods, _ = store.list("pods", ns)
+    matching = [p for p in pods
+                if all(((p.get("metadata") or {}).get("labels") or {})
+                       .get(k) == v for k, v in sel.items())]
+    healthy = sum(1 for p in matching
+                  if (p.get("status") or {}).get("phase")
+                  not in ("Failed", "Succeeded")
+                  and not (p.get("metadata") or {}).get("deletionTimestamp"))
+    expected = _expected_count(store, matching, ns)
+    if "minAvailable" in spec:
+        return healthy - 1 >= _parse_intstr(spec["minAvailable"], expected)
+    if "maxUnavailable" in spec:
+        max_unavail = _parse_intstr(spec["maxUnavailable"], expected)
+        disrupted = max(0, expected - healthy)
+        return disrupted + 1 <= max_unavail
+    return True

@@ -119,12 +119,16 @@ class HTTPClient(Client):
                 self.host, self.port)
         return conn
 
-    def _request(self, method: str, path: str, body: Obj | None = None) -> dict:
+    def _request(self, method: str, path: str, body: Obj | None = None,
+                 content_type: str | None = None) -> dict:
         payload = json.dumps(body) if body is not None else None
+        headers = self._headers
+        if content_type is not None:
+            headers = dict(headers, **{"Content-Type": content_type})
         for attempt in range(2):  # retry once on stale keep-alive conns
             conn = self._conn()
             try:
-                conn.request(method, path, body=payload, headers=self._headers)
+                conn.request(method, path, body=payload, headers=headers)
                 resp = conn.getresponse()
                 data = json.loads(resp.read() or b"{}")
                 break
@@ -181,3 +185,40 @@ class HTTPClient(Client):
         if since_rv is not None:
             path += f"&resourceVersion={since_rv}"
         return HTTPWatch(self.host, self.port, path, self._headers)
+
+    # -- patch + subresources (endpoints/handlers/patch.go; pod storage) --
+
+    def patch(self, resource: str, namespace: str, name: str, patch_body,
+              patch_type: str = "application/strategic-merge-patch+json",
+              subresource: str | None = None) -> Obj:
+        path = self._path(resource, namespace, name)
+        if subresource:
+            path += "/" + subresource
+        return self._request("PATCH", path, patch_body,
+                             content_type=patch_type)
+
+    def bind(self, pod: Obj, node_name: str) -> Obj:
+        """POST pods/{name}/binding (DefaultBinder's write)."""
+        path = self._path("pods", meta.namespace(pod), meta.name(pod)) + "/binding"
+        return self._request("POST", path, {
+            "kind": "Binding", "apiVersion": "v1",
+            "metadata": {"name": meta.name(pod)},
+            "target": {"kind": "Node", "name": node_name}})
+
+    def evict(self, namespace: str, name: str) -> Obj:
+        """POST pods/{name}/eviction — PDB-gated delete (429 when blocked)."""
+        path = self._path("pods", namespace, name) + "/eviction"
+        return self._request("POST", path, {
+            "kind": "Eviction", "apiVersion": "policy/v1",
+            "metadata": {"name": name, "namespace": namespace}})
+
+    def update_status(self, resource: str, obj: Obj) -> Obj:
+        path = self._path(resource, meta.namespace(obj), meta.name(obj)) + "/status"
+        return self._request("PUT", path, obj)
+
+    def scale(self, resource: str, namespace: str, name: str,
+              replicas: int | None = None) -> Obj:
+        path = self._path(resource, namespace, name) + "/scale"
+        if replicas is None:
+            return self._request("GET", path)
+        return self._request("PUT", path, {"spec": {"replicas": replicas}})

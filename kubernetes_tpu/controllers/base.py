@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
 from ..api import meta
 from ..api.meta import Obj
@@ -74,6 +75,59 @@ class Controller:
                 self.queue.forget(key)
             finally:
                 self.queue.done(key)
+
+
+class Expectations:
+    """Controller expectations (pkg/controller/controller_utils.go
+    ControllerExpectations): dampen informer lag.  After a sync creates or
+    deletes N children, it records N expected add/delete events; until the
+    informer has delivered them (or the expectation times out), further
+    syncs of that key must not mutate children — otherwise a second sync
+    racing the informer re-creates/re-deletes the same diff."""
+
+    TIMEOUT = 300.0  # ExpectationsTimeout (controller_utils.go:328)
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # key -> [pending_adds, pending_dels, set_time]
+        self._by_key: dict[str, list] = {}
+
+    def expect_creations(self, key: str, n: int) -> None:
+        with self._lock:
+            self._by_key[key] = [n, 0, time.monotonic()]
+
+    def expect_deletions(self, key: str, n: int) -> None:
+        with self._lock:
+            self._by_key[key] = [0, n, time.monotonic()]
+
+    def creation_observed(self, key: str) -> None:
+        self._observed(key, 0)
+
+    def deletion_observed(self, key: str) -> None:
+        self._observed(key, 1)
+
+    def _observed(self, key: str, idx: int) -> None:
+        with self._lock:
+            e = self._by_key.get(key)
+            if e is not None:
+                e[idx] -= 1
+
+    def satisfied(self, key: str) -> bool:
+        with self._lock:
+            e = self._by_key.get(key)
+            if e is None:
+                return True
+            if e[0] <= 0 and e[1] <= 0:
+                del self._by_key[key]
+                return True
+            if time.monotonic() - e[2] > self.TIMEOUT:
+                del self._by_key[key]
+                return True
+            return False
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._by_key.pop(key, None)
 
 
 def split_key(key: str) -> tuple[str, str]:

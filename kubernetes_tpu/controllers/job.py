@@ -16,7 +16,7 @@ from ..api import meta
 from ..api.meta import Obj
 from ..client.clientset import JOBS, PODS
 from ..store import kv
-from .base import Controller, is_owned_by, owner_ref, split_key
+from .base import Controller, Expectations, is_owned_by, owner_ref, split_key
 from .replicaset import pod_is_active
 
 logger = logging.getLogger(__name__)
@@ -29,6 +29,7 @@ class JobController(Controller):
         super().__init__(client, factory)
         self.job_informer = factory.informer(JOBS)
         self.pod_informer = factory.informer(PODS)
+        self.expectations = Expectations()
         self.job_informer.add_event_handler(
             lambda t, obj, old: self.enqueue(obj))
         self.pod_informer.add_event_handler(self._on_pod)
@@ -36,7 +37,12 @@ class JobController(Controller):
     def _on_pod(self, type_, pod: Obj, old) -> None:
         ref = meta.controller_ref(pod)
         if ref and ref.get("kind") == "Job":
-            self.enqueue_key(f"{meta.namespace(pod)}/{ref['name']}")
+            key = f"{meta.namespace(pod)}/{ref['name']}"
+            if type_ == kv.ADDED:
+                self.expectations.creation_observed(key)
+            elif type_ == kv.DELETED:
+                self.expectations.deletion_observed(key)
+            self.enqueue_key(key)
 
     def sync(self, key: str) -> None:
         ns, name = split_key(key)
@@ -58,7 +64,7 @@ class JobController(Controller):
         conds = (job.get("status") or {}).get("conditions") or []
         done = any(c.get("type") in ("Complete", "Failed") for c in conds)
 
-        if not done:
+        if not done and self.expectations.satisfied(key):
             if succeeded >= completions:
                 conds = [{"type": "Complete", "status": "True"}]
                 for p in active:  # completions reached: reap stragglers
@@ -72,8 +78,23 @@ class JobController(Controller):
                           "reason": "BackoffLimitExceeded"}]
             else:
                 want_active = min(parallelism, completions - succeeded)
-                for _ in range(want_active - len(active)):
-                    self._create_pod(job)
+                n_new = want_active - len(active)
+                if n_new > 0:
+                    self.expectations.expect_creations(key, n_new)
+                    for i in range(n_new):
+                        try:
+                            if not self._create_pod(job):
+                                self.expectations.creation_observed(key)
+                        except Exception:
+                            # lower this + all remaining uncreated slots so
+                            # the retry isn't gated for TIMEOUT (the
+                            # reference's slowStartBatch does the same)
+                            for _ in range(n_new - i):
+                                self.expectations.creation_observed(key)
+                            raise
+        elif not done:
+            # expectations pending: leave children alone this round
+            pass
 
         status = {"active": len(active), "succeeded": succeeded,
                   "failed": failed, "conditions": conds}
@@ -101,5 +122,6 @@ class JobController(Controller):
         pod["spec"].setdefault("schedulerName", "default-scheduler")
         try:
             self.client.create(PODS, pod)
+            return True
         except kv.AlreadyExistsError:
-            pass
+            return False

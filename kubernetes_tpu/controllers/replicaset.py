@@ -19,7 +19,7 @@ from ..api.labels import selector_from_dict
 from ..api.meta import Obj
 from ..client.clientset import PODS, REPLICASETS
 from ..store import kv
-from .base import Controller, is_owned_by, owner_ref, split_key
+from .base import Controller, Expectations, is_owned_by, owner_ref, split_key
 
 logger = logging.getLogger(__name__)
 
@@ -45,6 +45,7 @@ class ReplicaSetController(Controller):
         super().__init__(client, factory)
         self.rs_informer = factory.informer(REPLICASETS)
         self.pod_informer = factory.informer(PODS)
+        self.expectations = Expectations()
         self.rs_informer.add_event_handler(
             lambda t, obj, old: self.enqueue(obj))
         self.pod_informer.add_event_handler(self._on_pod)
@@ -52,12 +53,18 @@ class ReplicaSetController(Controller):
     def _on_pod(self, type_: str, pod: Obj, old: Obj | None) -> None:
         ref = meta.controller_ref(pod)
         if ref and ref.get("kind") == "ReplicaSet":
-            self.enqueue_key(f"{meta.namespace(pod)}/{ref['name']}")
+            key = f"{meta.namespace(pod)}/{ref['name']}"
+            if type_ == kv.ADDED:
+                self.expectations.creation_observed(key)
+            elif type_ == kv.DELETED:
+                self.expectations.deletion_observed(key)
+            self.enqueue_key(key)
 
     def sync(self, key: str) -> None:
         ns, name = split_key(key)
         rs = self.rs_informer.get(ns, name)
         if rs is None:
+            self.expectations.delete(key)
             return
         spec = rs.get("spec") or {}
         want = spec.get("replicas", 1)
@@ -72,19 +79,34 @@ class ReplicaSetController(Controller):
                 pods.append(p)
 
         diff = want - len(pods)
-        if diff > 0:
-            for _ in range(diff):
-                self._create_pod(rs)
-        elif diff < 0:
-            # prefer deleting not-ready, then youngest (pods-to-delete ranking)
-            victims = sorted(pods, key=lambda p: (
-                pod_is_ready(p), meta.creation_timestamp(p)))
-            for p in victims[:(-diff)]:
-                try:
-                    self.client.delete(PODS, ns, meta.name(p))
-                except kv.NotFoundError:
-                    pass
-        self._update_status(rs, pods if diff <= 0 else pods)
+        if self.expectations.satisfied(key):
+            if diff > 0:
+                self.expectations.expect_creations(key, diff)
+                for i in range(diff):
+                    try:
+                        if not self._create_pod(rs):
+                            self.expectations.creation_observed(key)
+                    except Exception:
+                        # lower remaining slots so the retry isn't gated
+                        # for TIMEOUT (slowStartBatch semantics)
+                        for _ in range(diff - i):
+                            self.expectations.creation_observed(key)
+                        raise
+            elif diff < 0:
+                # prefer deleting not-ready, then youngest
+                victims = sorted(pods, key=lambda p: (
+                    pod_is_ready(p), meta.creation_timestamp(p)))[:(-diff)]
+                self.expectations.expect_deletions(key, len(victims))
+                for i, p in enumerate(victims):
+                    try:
+                        self.client.delete(PODS, ns, meta.name(p))
+                    except kv.NotFoundError:
+                        self.expectations.deletion_observed(key)
+                    except Exception:
+                        for _ in range(len(victims) - i):
+                            self.expectations.deletion_observed(key)
+                        raise
+        self._update_status(rs, pods)
 
     def _adopt(self, pod: Obj, rs: Obj) -> None:
         def patch(p):
@@ -114,8 +136,9 @@ class ReplicaSetController(Controller):
         pod["spec"].setdefault("schedulerName", "default-scheduler")
         try:
             self.client.create(PODS, pod)
+            return True
         except kv.AlreadyExistsError:
-            pass
+            return False
 
     def _update_status(self, rs: Obj, pods: list[Obj]) -> None:
         ready = sum(1 for p in pods if pod_is_ready(p))

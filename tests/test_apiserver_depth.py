@@ -1,0 +1,684 @@
+"""apiserver depth: patch verbs, subresources, admission, APF, audit, CRDs.
+
+Reference contracts: endpoints/handlers/patch.go (3 patch content types),
+registry/core/pod/storage (binding/eviction subresources), apiserver
+admission chain order, util/flowcontrol APF, audit policy levels,
+apiextensions-apiserver CRD serving.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.apiserver import admission as adm
+from kubernetes_tpu.apiserver import audit as auditlib
+from kubernetes_tpu.apiserver import crd as crdlib
+from kubernetes_tpu.apiserver import flowcontrol
+from kubernetes_tpu.apiserver import patch as patchlib
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.client.http_client import HTTPClient, HTTPError
+from kubernetes_tpu.store import kv
+
+
+@pytest.fixture()
+def server():
+    store = kv.MemoryStore()
+    srv = APIServer(store, enable_default_admission=True,
+                    audit_logger=auditlib.AuditLogger()).start()
+    yield srv, store, HTTPClient.from_url(srv.url)
+    srv.stop()
+
+
+def make_pod(name, ns="default", cpu="100m", labels=None, **spec):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": labels or {}},
+            "spec": {"containers": [{"name": "c", "image": "img",
+                                     "resources": {"requests": {
+                                         "cpu": cpu, "memory": "64Mi"}}}],
+                     **spec}}
+
+
+# -- patch library ---------------------------------------------------------
+
+class TestPatchLibrary:
+    def test_json_merge_patch_rfc7386(self):
+        target = {"a": 1, "b": {"c": 2, "d": 3}, "e": [1, 2]}
+        patch = {"a": 9, "b": {"c": None}, "e": [5]}
+        out = patchlib.json_merge_patch(target, patch)
+        assert out == {"a": 9, "b": {"d": 3}, "e": [5]}
+
+    def test_json_patch_rfc6902(self):
+        doc = {"spec": {"replicas": 1, "list": [1, 2, 3]}}
+        ops = [{"op": "replace", "path": "/spec/replicas", "value": 5},
+               {"op": "add", "path": "/spec/list/-", "value": 4},
+               {"op": "remove", "path": "/spec/list/0"},
+               {"op": "test", "path": "/spec/replicas", "value": 5}]
+        out = patchlib.json_patch(doc, ops)
+        assert out == {"spec": {"replicas": 5, "list": [2, 3, 4]}}
+        with pytest.raises(patchlib.PatchError):
+            patchlib.json_patch(doc, [{"op": "test", "path": "/spec/replicas",
+                                       "value": 99}])
+
+    def test_json_patch_move_copy(self):
+        doc = {"a": {"x": 1}, "b": {}}
+        out = patchlib.json_patch(doc, [
+            {"op": "copy", "from": "/a/x", "path": "/b/y"},
+            {"op": "move", "from": "/a/x", "path": "/b/z"}])
+        assert out == {"a": {}, "b": {"y": 1, "z": 1}}
+
+    def test_strategic_merge_containers_by_name(self):
+        target = {"spec": {"containers": [
+            {"name": "app", "image": "v1", "env": [{"name": "A", "value": "1"}]},
+            {"name": "sidecar", "image": "s1"}]}}
+        patch = {"spec": {"containers": [
+            {"name": "app", "image": "v2"}]}}
+        out = patchlib.strategic_merge_patch(target, patch)
+        containers = out["spec"]["containers"]
+        assert len(containers) == 2
+        app = next(c for c in containers if c["name"] == "app")
+        assert app["image"] == "v2"
+        assert app["env"] == [{"name": "A", "value": "1"}]  # merged, not lost
+
+    def test_strategic_merge_delete_directive(self):
+        target = {"spec": {"containers": [{"name": "a"}, {"name": "b"}]}}
+        patch = {"spec": {"containers": [{"name": "a", "$patch": "delete"}]}}
+        out = patchlib.strategic_merge_patch(target, patch)
+        assert out["spec"]["containers"] == [{"name": "b"}]
+
+    def test_strategic_merge_atomic_list_replaced(self):
+        target = {"spec": {"nodeSelectorTerms": [1, 2]}}
+        out = patchlib.strategic_merge_patch(
+            target, {"spec": {"nodeSelectorTerms": [3]}})
+        assert out["spec"]["nodeSelectorTerms"] == [3]
+
+
+# -- PATCH over HTTP -------------------------------------------------------
+
+class TestPatchVerb:
+    def test_strategic_merge_patch_http(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("p1"))
+        out = client.patch("pods", "default", "p1",
+                           {"metadata": {"labels": {"x": "y"}}})
+        assert out["metadata"]["labels"]["x"] == "y"
+        assert out["spec"]["containers"]  # untouched
+
+    def test_json_patch_http(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("p2"))
+        out = client.patch("pods", "default", "p2",
+                           [{"op": "add", "path": "/metadata/labels/app",
+                             "value": "web"}],
+                           patch_type="application/json-patch+json")
+        assert out["metadata"]["labels"]["app"] == "web"
+
+    def test_patch_bumps_resource_version(self, server):
+        srv, store, client = server
+        created = client.create("pods", make_pod("p3"))
+        out = client.patch("pods", "default", "p3",
+                           {"metadata": {"labels": {"a": "b"}}})
+        assert int(out["metadata"]["resourceVersion"]) > int(
+            created["metadata"]["resourceVersion"])
+
+    def test_patch_missing_object_404(self, server):
+        srv, store, client = server
+        with pytest.raises(kv.NotFoundError):
+            client.patch("pods", "default", "nope", {"metadata": {}})
+
+
+# -- subresources ----------------------------------------------------------
+
+class TestSubresources:
+    def test_binding_subresource(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("bindme"))
+        client.bind({"metadata": {"name": "bindme", "namespace": "default"}},
+                    "node-1")
+        pod = client.get("pods", "default", "bindme")
+        assert pod["spec"]["nodeName"] == "node-1"
+
+    def test_binding_conflict_on_double_bind(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("once"))
+        client.bind({"metadata": {"name": "once", "namespace": "default"}}, "n1")
+        with pytest.raises(kv.ConflictError):
+            client.bind({"metadata": {"name": "once",
+                                      "namespace": "default"}}, "n2")
+
+    def test_status_subresource_only_touches_status(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("st"))
+        client.update_status("pods", {
+            "metadata": {"name": "st", "namespace": "default"},
+            "spec": {"nodeName": "SHOULD-NOT-APPLY"},
+            "status": {"phase": "Running"}})
+        pod = client.get("pods", "default", "st")
+        assert pod["status"]["phase"] == "Running"
+        assert "nodeName" not in pod["spec"]
+
+    def test_eviction_allowed_without_pdb(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("victim"))
+        client.evict("default", "victim")
+        with pytest.raises(kv.NotFoundError):
+            client.get("pods", "default", "victim")
+
+    def test_eviction_blocked_by_pdb_429(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("guarded", labels={"app": "db"}))
+        client.create("poddisruptionbudgets", {
+            "metadata": {"name": "pdb", "namespace": "default"},
+            "spec": {"minAvailable": 1,
+                     "selector": {"matchLabels": {"app": "db"}}}})
+        with pytest.raises(HTTPError) as exc:
+            client.evict("default", "guarded")
+        assert exc.value.code == 429
+        client.get("pods", "default", "guarded")  # still there
+
+    def test_scale_subresource(self, server):
+        srv, store, client = server
+        client.create("deployments", {
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 2, "selector": {"matchLabels": {"a": "b"}},
+                     "template": {"metadata": {"labels": {"a": "b"}}}}})
+        scale = client.scale("deployments", "default", "web")
+        assert scale["kind"] == "Scale" and scale["spec"]["replicas"] == 2
+        client.scale("deployments", "default", "web", replicas=5)
+        assert client.get("deployments", "default", "web")["spec"]["replicas"] == 5
+
+
+# -- admission chain -------------------------------------------------------
+
+class TestAdmission:
+    def test_priority_admission_resolves_class(self, server):
+        srv, store, client = server
+        client.create("priorityclasses", {
+            "metadata": {"name": "high"}, "value": 1000})
+        pod = make_pod("prio")
+        pod["spec"]["priorityClassName"] = "high"
+        created = client.create("pods", pod)
+        assert created["spec"]["priority"] == 1000
+
+    def test_priority_admission_unknown_class_rejected(self, server):
+        srv, store, client = server
+        pod = make_pod("bad")
+        pod["spec"]["priorityClassName"] = "missing"
+        with pytest.raises(HTTPError) as exc:
+            client.create("pods", pod)
+        assert exc.value.code == 403
+
+    def test_default_toleration_seconds(self, server):
+        srv, store, client = server
+        created = client.create("pods", make_pod("tol"))
+        keys = {t["key"] for t in created["spec"]["tolerations"]}
+        assert "node.kubernetes.io/not-ready" in keys
+        assert "node.kubernetes.io/unreachable" in keys
+
+    def test_namespace_lifecycle_rejects_missing_ns(self, server):
+        srv, store, client = server
+        with pytest.raises(HTTPError) as exc:
+            client.create("pods", make_pod("p", ns="ghost"))
+        assert exc.value.code == 403
+        client.create("namespaces", {"metadata": {"name": "ghost"}})
+        client.create("pods", make_pod("p", ns="ghost"))  # now fine
+
+    def test_namespace_lifecycle_blocks_terminating(self, server):
+        srv, store, client = server
+        client.create("namespaces", {
+            "metadata": {"name": "dying"},
+            "status": {"phase": "Terminating"}})
+        with pytest.raises(HTTPError):
+            client.create("pods", make_pod("p", ns="dying"))
+
+    def test_limit_ranger_defaults(self, server):
+        srv, store, client = server
+        client.create("limitranges", {
+            "metadata": {"name": "lr", "namespace": "default"},
+            "spec": {"limits": [{"type": "Container",
+                                 "defaultRequest": {"cpu": "250m"},
+                                 "default": {"memory": "256Mi"}}]}})
+        pod = {"metadata": {"name": "lrpod", "namespace": "default"},
+               "spec": {"containers": [{"name": "c", "image": "i"}]}}
+        created = client.create("pods", pod)
+        res = created["spec"]["containers"][0]["resources"]
+        assert res["requests"]["cpu"] == "250m"
+        assert res["limits"]["memory"] == "256Mi"
+
+    def test_resource_quota_enforced(self, server):
+        srv, store, client = server
+        client.create("resourcequotas", {
+            "metadata": {"name": "rq", "namespace": "default"},
+            "spec": {"hard": {"pods": "2", "requests.cpu": "300m"}}})
+        client.create("pods", make_pod("q1", cpu="100m"))
+        client.create("pods", make_pod("q2", cpu="100m"))
+        with pytest.raises(HTTPError) as exc:  # pod count 3 > 2
+            client.create("pods", make_pod("q3", cpu="50m"))
+        assert exc.value.code == 403
+
+    def test_mutating_webhook_jsonpatch(self, server):
+        import base64
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        srv, store, client = server
+
+        class WH(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                json.loads(self.rfile.read(n))
+                ops = [{"op": "add", "path": "/metadata/labels/injected",
+                        "value": "true"}]
+                body = json.dumps({"response": {
+                    "allowed": True, "patchType": "JSONPatch",
+                    "patch": base64.b64encode(
+                        json.dumps(ops).encode()).decode()}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        whd = ThreadingHTTPServer(("127.0.0.1", 0), WH)
+        threading.Thread(target=whd.serve_forever, daemon=True).start()
+        try:
+            wa = adm.WebhookAdmission()
+            wa.register(adm.Webhook(
+                "inject", "http://127.0.0.1:%d/" % whd.server_address[1],
+                mutating=True,
+                match=lambda attrs: attrs.resource == "pods"))
+            srv.admission_chain.register(wa)
+            created = client.create("pods", make_pod("hooked"))
+            assert created["metadata"]["labels"]["injected"] == "true"
+        finally:
+            whd.shutdown()
+
+    def test_validating_webhook_denies(self, server):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        srv, store, client = server
+
+        class WH(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = json.dumps({"response": {
+                    "allowed": False,
+                    "status": {"message": "nope"}}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        whd = ThreadingHTTPServer(("127.0.0.1", 0), WH)
+        threading.Thread(target=whd.serve_forever, daemon=True).start()
+        try:
+            wa = adm.WebhookAdmission()
+            wa.register(adm.Webhook(
+                "deny", "http://127.0.0.1:%d/" % whd.server_address[1],
+                match=lambda attrs: attrs.resource == "pods"))
+            srv.admission_chain.register(wa)
+            with pytest.raises(HTTPError) as exc:
+                client.create("pods", make_pod("denied"))
+            assert "nope" in str(exc.value)
+        finally:
+            whd.shutdown()
+
+
+# -- API priority & fairness ----------------------------------------------
+
+class TestFlowControl:
+    def test_classification(self):
+        d = flowcontrol.Dispatcher()
+        assert d.classify("u", "update", "leases").name == "leader-election"
+        assert d.classify("system:scheduler", "get", "pods").name == "workload-high"
+        assert d.classify("alice", "get", "pods").name == "global-default"
+
+    def test_seats_block_and_release(self):
+        lvl = flowcontrol.PriorityLevel("t", seats=1, queues=1, queue_length=4)
+        assert lvl.acquire()
+        done = threading.Event()
+
+        def second():
+            lvl.acquire(timeout=5.0)
+            done.set()
+            lvl.release()
+
+        t = threading.Thread(target=second, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not done.is_set()  # queued behind the held seat
+        lvl.release()
+        assert done.wait(2.0)
+
+    def test_queue_timeout_rejects(self):
+        lvl = flowcontrol.PriorityLevel("t", seats=1, queues=1, queue_length=4)
+        lvl.acquire()
+        with pytest.raises(flowcontrol.RejectedError):
+            lvl.acquire(timeout=0.05)
+        assert lvl.stats()["timed_out"] == 1
+
+    def test_full_queue_rejects_immediately(self):
+        lvl = flowcontrol.PriorityLevel("t", seats=1, queues=1, queue_length=0)
+        lvl.acquire()
+        with pytest.raises(flowcontrol.RejectedError):
+            lvl.acquire(timeout=5.0)
+
+    def test_exempt_never_blocks(self):
+        lvl = flowcontrol.PriorityLevel("exempt", seats=0, exempt=True)
+        for _ in range(100):
+            assert lvl.acquire()
+
+    def test_apf_wired_into_server(self):
+        store = kv.MemoryStore()
+        srv = APIServer(store,
+                        flow_dispatcher=flowcontrol.Dispatcher()).start()
+        try:
+            client = HTTPClient.from_url(srv.url)
+            client.create("pods", make_pod("apf"))
+            metrics = urllib.request.urlopen(srv.url + "/metrics").read().decode()
+            assert "apiserver_flowcontrol_dispatched" in metrics
+        finally:
+            srv.stop()
+
+
+# -- audit -----------------------------------------------------------------
+
+class TestAudit:
+    def test_policy_levels(self):
+        pol = auditlib.Policy(rules=[
+            auditlib.PolicyRule(auditlib.LEVEL_NONE, resources=["events"]),
+            auditlib.PolicyRule(auditlib.LEVEL_REQUEST, resources=["pods"]),
+        ])
+        assert pol.level_for("u", "create", "events") == "None"
+        assert pol.level_for("u", "create", "pods") == "Request"
+        assert pol.level_for("u", "get", "nodes") == "Metadata"
+
+    def test_server_audits_writes(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("audited"))
+        client.delete("pods", "default", "audited")
+        events = srv.audit.snapshot()
+        verbs = [(e["verb"], e["objectRef"]["resource"]) for e in events]
+        assert ("create", "pods") in verbs
+        assert ("delete", "pods") in verbs
+        assert all(e["stage"] == "ResponseComplete" for e in events)
+
+    def test_request_level_includes_object(self):
+        log = auditlib.AuditLogger(policy=auditlib.Policy(
+            default_level=auditlib.LEVEL_REQUEST))
+        ev = log.log("ResponseComplete", "u", "create", "pods",
+                     obj={"kind": "Pod"})
+        assert ev["requestObject"] == {"kind": "Pod"}
+
+    def test_none_level_drops(self):
+        log = auditlib.AuditLogger(policy=auditlib.Policy(
+            default_level=auditlib.LEVEL_NONE))
+        assert log.log("ResponseComplete", "u", "get", "pods") is None
+        assert log.snapshot() == []
+
+
+# -- CRDs ------------------------------------------------------------------
+
+def podgroup_crd():
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "podgroups.scheduling.x-k8s.io"},
+        "spec": {
+            "group": "scheduling.x-k8s.io",
+            "scope": "Namespaced",
+            "names": {"plural": "podgroups", "kind": "PodGroup",
+                      "shortNames": ["pg"]},
+            "versions": [{
+                "name": "v1alpha1", "served": True, "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {"type": "object",
+                                 "required": ["minMember"],
+                                 "properties": {
+                                     "minMember": {"type": "integer",
+                                                   "minimum": 1}}}}}}}]}}
+
+
+class TestCRDs:
+    def test_crd_establish_and_serve(self, server):
+        srv, store, client = server
+        client.create("customresourcedefinitions", podgroup_crd())
+        # serve the custom resource under its group path
+        body = json.dumps({
+            "apiVersion": "scheduling.x-k8s.io/v1alpha1", "kind": "PodGroup",
+            "metadata": {"name": "gang-a", "namespace": "default"},
+            "spec": {"minMember": 3}}).encode()
+        req = urllib.request.Request(
+            srv.url + "/apis/scheduling.x-k8s.io/v1alpha1/namespaces/default/podgroups",
+            data=body, headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 201
+        got = json.loads(urllib.request.urlopen(
+            srv.url + "/apis/scheduling.x-k8s.io/v1alpha1/namespaces/default/"
+            "podgroups/gang-a").read())
+        assert got["spec"]["minMember"] == 3
+
+    def test_crd_schema_validation_422(self, server):
+        srv, store, client = server
+        client.create("customresourcedefinitions", podgroup_crd())
+        bad = json.dumps({
+            "apiVersion": "scheduling.x-k8s.io/v1alpha1", "kind": "PodGroup",
+            "metadata": {"name": "bad", "namespace": "default"},
+            "spec": {"minMember": 0}}).encode()  # below minimum
+        req = urllib.request.Request(
+            srv.url + "/apis/scheduling.x-k8s.io/v1alpha1/namespaces/default/podgroups",
+            data=bad, headers={"Content-Type": "application/json"},
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 422
+
+    def test_crd_missing_required_field(self):
+        reg = crdlib.CRDRegistry()
+        reg.establish(podgroup_crd())
+        with pytest.raises(crdlib.ValidationError):
+            reg.validate_object("podgroups", "v1alpha1",
+                                {"spec": {}})  # minMember required
+
+    def test_crd_survives_restart(self):
+        store = kv.MemoryStore()
+        srv = APIServer(store).start()
+        c = HTTPClient.from_url(srv.url)
+        c.create("customresourcedefinitions", podgroup_crd())
+        srv.stop()
+        # new server over the same store re-establishes from persisted CRDs
+        srv2 = APIServer(store).start()
+        try:
+            assert srv2.crds.lookup("podgroups") is not None
+            assert srv2.crds.lookup("pg") is not None  # short name
+        finally:
+            srv2.stop()
+
+    def test_watch_custom_resource(self, server):
+        srv, store, client = server
+        client.create("customresourcedefinitions", podgroup_crd())
+        w = store.watch("podgroups")
+        store.create("podgroups", {
+            "metadata": {"name": "g", "namespace": "default"},
+            "spec": {"minMember": 2}})
+        ev = w.next(timeout=2.0)
+        assert ev is not None and ev.type == kv.ADDED
+        w.stop()
+
+
+# -- label selector on list ------------------------------------------------
+
+def test_list_label_selector(server):
+    srv, store, client = server
+    client.create("pods", make_pod("l1", labels={"app": "a"}))
+    client.create("pods", make_pod("l2", labels={"app": "b"}))
+    data = json.loads(urllib.request.urlopen(
+        srv.url + "/api/v1/namespaces/default/pods?labelSelector=app%3Da"
+    ).read())
+    names = [i["metadata"]["name"] for i in data["items"]]
+    assert names == ["l1"]
+
+
+# -- review regressions ----------------------------------------------------
+
+class TestReviewRegressions:
+    def test_patch_respects_admission(self, server):
+        """PATCH runs the same admission gates as PUT."""
+        srv, store, client = server
+        client.create("pods", make_pod("padm"))
+
+        class Deny(adm.AdmissionPlugin):
+            name = "DenyLabel"
+
+            def validate(self, attrs):
+                labels = ((attrs.obj or {}).get("metadata") or {}).get(
+                    "labels") or {}
+                if labels.get("forbidden") == "yes":
+                    raise adm.AdmissionDenied(self.name, "forbidden label")
+
+        srv.admission_chain.register(Deny())
+        with pytest.raises(HTTPError) as exc:
+            client.patch("pods", "default", "padm",
+                         {"metadata": {"labels": {"forbidden": "yes"}}})
+        assert exc.value.code == 403
+        pod = client.get("pods", "default", "padm")
+        assert (pod["metadata"].get("labels") or {}).get("forbidden") != "yes"
+
+    def test_patch_validates_crd_schema(self, server):
+        srv, store, client = server
+        client.create("customresourcedefinitions", podgroup_crd())
+        body = json.dumps({
+            "apiVersion": "scheduling.x-k8s.io/v1alpha1", "kind": "PodGroup",
+            "metadata": {"name": "g1", "namespace": "default"},
+            "spec": {"minMember": 3}}).encode()
+        base = (srv.url + "/apis/scheduling.x-k8s.io/v1alpha1/namespaces/"
+                "default/podgroups")
+        urllib.request.urlopen(urllib.request.Request(
+            base, data=body, headers={"Content-Type": "application/json"},
+            method="POST"))
+        bad_patch = json.dumps({"spec": {"minMember": 0}}).encode()
+        req = urllib.request.Request(
+            base + "/g1", data=bad_patch,
+            headers={"Content-Type": "application/merge-patch+json"},
+            method="PATCH")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 422
+
+    def test_unknown_subresource_404(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("sub"))
+        req = urllib.request.Request(
+            srv.url + "/api/v1/namespaces/default/pods/sub/exec")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 404
+        # DELETE on a bogus subresource must NOT delete the parent
+        req = urllib.request.Request(
+            srv.url + "/api/v1/namespaces/default/pods/sub/anything",
+            method="DELETE")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req)
+        client.get("pods", "default", "sub")  # still exists
+
+    def test_watch_exempt_from_apf(self):
+        """A held watch stream must not consume APF seats."""
+        levels = (("catch-all", 1, 1, 4, False),)
+        schemas = [flowcontrol.FlowSchema("all", "catch-all", 1)]
+        store = kv.MemoryStore()
+        srv = APIServer(store, flow_dispatcher=flowcontrol.Dispatcher(
+            levels=levels, schemas=schemas)).start()
+        try:
+            client = HTTPClient.from_url(srv.url)
+            w = client.watch("pods")  # long-running stream held open
+            time.sleep(0.1)
+            client.create("pods", make_pod("apf-free"))  # must not 429
+            ev = w.next(timeout=3.0)
+            assert ev is not None
+            w.stop()
+        finally:
+            srv.stop()
+
+    def test_pdb_max_unavailable_blocks(self, server):
+        srv, store, client = server
+        # RS with 3 desired, only 2 healthy pods -> 1 disruption used
+        rs = {"metadata": {"name": "rs1", "namespace": "default"},
+              "spec": {"replicas": 3,
+                       "selector": {"matchLabels": {"app": "w"}}}}
+        created_rs = client.create("replicasets", rs)
+        for i in range(2):
+            p = make_pod("w%d" % i, labels={"app": "w"})
+            p["metadata"]["ownerReferences"] = [{
+                "kind": "ReplicaSet", "name": "rs1",
+                "uid": created_rs["metadata"].get("uid", ""),
+                "controller": True}]
+            client.create("pods", p)
+        client.create("poddisruptionbudgets", {
+            "metadata": {"name": "pdb-mu", "namespace": "default"},
+            "spec": {"maxUnavailable": 1,
+                     "selector": {"matchLabels": {"app": "w"}}}})
+        with pytest.raises(HTTPError) as exc:  # 1 already down, budget spent
+            client.evict("default", "w0")
+        assert exc.value.code == 429
+
+    def test_pdb_percentage_min_available(self, server):
+        srv, store, client = server
+        for i in range(4):
+            client.create("pods", make_pod("pc%d" % i, labels={"app": "pc"}))
+        client.create("poddisruptionbudgets", {
+            "metadata": {"name": "pdb-pct", "namespace": "default"},
+            "spec": {"minAvailable": "75%",
+                     "selector": {"matchLabels": {"app": "pc"}}}})
+        client.evict("default", "pc0")  # 3 of 4 left = 75%, allowed
+        with pytest.raises(HTTPError) as exc:  # 2 of 4 < 75%
+            client.evict("default", "pc1")
+        assert exc.value.code == 429
+
+    def test_pdb_status_disruptions_allowed_consumed(self, server):
+        srv, store, client = server
+        client.create("pods", make_pod("da0", labels={"app": "da"}))
+        client.create("pods", make_pod("da1", labels={"app": "da"}))
+        client.create("poddisruptionbudgets", {
+            "metadata": {"name": "pdb-da", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"app": "da"}}},
+            "status": {"disruptionsAllowed": 1}})
+        client.evict("default", "da0")  # consumes the single disruption
+        with pytest.raises(HTTPError) as exc:
+            client.evict("default", "da1")
+        assert exc.value.code == 429
+
+    def test_quota_concurrent_creates_cannot_exceed(self, server):
+        srv, store, client = server
+        client.create("resourcequotas", {
+            "metadata": {"name": "rq1", "namespace": "default"},
+            "spec": {"hard": {"pods": "1"}}})
+        results = []
+
+        def create(i):
+            c = HTTPClient.from_url(srv.url)
+            try:
+                c.create("pods", make_pod("race%d" % i))
+                results.append("ok")
+            except Exception:
+                results.append("denied")
+
+        threads = [threading.Thread(target=create, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        pods, _ = store.list("pods", "default")
+        assert len(pods) <= 1
+        assert results.count("ok") <= 1
