@@ -31,6 +31,14 @@ KIND_TO_RESOURCE = {
     "ConfigMap": "configmaps", "Secret": "secrets", "Lease": "leases",
     "PodGroup": "podgroups", "PodDisruptionBudget": "poddisruptionbudgets",
     "Event": "events", "PriorityClass": "priorityclasses",
+    "StatefulSet": "statefulsets", "DaemonSet": "daemonsets",
+    "CronJob": "cronjobs", "ResourceQuota": "resourcequotas",
+    "ServiceAccount": "serviceaccounts", "LimitRange": "limitranges",
+    "HorizontalPodAutoscaler": "horizontalpodautoscalers",
+    "PersistentVolume": "persistentvolumes",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+    "StorageClass": "storageclasses",
+    "CustomResourceDefinition": "customresourcedefinitions",
 }
 ALIASES = {
     "po": "pods", "pod": "pods", "no": "nodes", "node": "nodes",
@@ -40,6 +48,14 @@ ALIASES = {
     "ns": "namespaces", "namespace": "namespaces", "cm": "configmaps",
     "pg": "podgroups", "podgroup": "podgroups", "pdb": "poddisruptionbudgets",
     "ev": "events", "event": "events", "lease": "leases", "pc": "priorityclasses",
+    "sts": "statefulsets", "statefulset": "statefulsets",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "cj": "cronjobs", "cronjob": "cronjobs", "quota": "resourcequotas",
+    "sa": "serviceaccounts", "serviceaccount": "serviceaccounts",
+    "hpa": "horizontalpodautoscalers", "limits": "limitranges",
+    "pv": "persistentvolumes", "pvc": "persistentvolumeclaims",
+    "sc": "storageclasses", "crd": "customresourcedefinitions",
+    "crds": "customresourcedefinitions",
 }
 
 

@@ -45,6 +45,12 @@ REPLICATIONCONTROLLERS = "replicationcontrollers"
 PRIORITYCLASSES = "priorityclasses"
 STORAGECLASSES = "storageclasses"
 CSINODES = "csinodes"
+CRONJOBS = "cronjobs"
+RESOURCEQUOTAS = "resourcequotas"
+SERVICEACCOUNTS = "serviceaccounts"
+LIMITRANGES = "limitranges"
+HPAS = "horizontalpodautoscalers"
+ENDPOINTSLICES = "endpointslices"
 
 
 class Client:

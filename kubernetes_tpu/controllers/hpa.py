@@ -1,0 +1,152 @@
+"""Horizontal Pod Autoscaler controller.
+
+Reference: pkg/controller/podautoscaler/ — the classic ratio algorithm:
+desired = ceil(current * currentMetricValue / targetMetricValue), clamped
+to [minReplicas, maxReplicas], with a scale-down stabilization window.
+
+There is no metrics-server in this stack; pod usage comes from a pluggable
+metrics getter.  The default reads the pod annotation
+``metrics.kubernetes.io/cpu-usage`` (milliCPU, stamped by the hollow
+kubelet or tests) — the same seam upstream fills with the resource-metrics
+API.  Targets: spec.targetCPUUtilizationPercentage (autoscaling/v1 shape)
+against container CPU requests.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..api import meta, quantity
+from ..api.meta import Obj
+from ..client.clientset import HPAS, PODS, Client
+from ..client.informer import SharedInformerFactory
+from ..store import kv
+
+logger = logging.getLogger(__name__)
+
+USAGE_ANNOTATION = "metrics.kubernetes.io/cpu-usage"
+
+SCALE_TARGETS = {"Deployment": "deployments", "ReplicaSet": "replicasets",
+                 "StatefulSet": "statefulsets"}
+
+
+def default_metrics_getter(pod: Obj) -> float | None:
+    """-> milliCPU in use, or None if no sample."""
+    raw = (pod["metadata"].get("annotations") or {}).get(USAGE_ANNOTATION)
+    if raw is None:
+        return None
+    try:
+        return float(quantity.parse_cpu_milli(raw))
+    except (ValueError, TypeError):
+        return None
+
+
+class HorizontalPodAutoscaler:
+    name = "horizontalpodautoscaler"
+
+    def __init__(self, client: Client, factory: SharedInformerFactory,
+                 tick: float = 15.0, metrics_getter=default_metrics_getter,
+                 downscale_stabilization: float = 300.0):
+        self.client = client
+        self.hpa_informer = factory.informer(HPAS)
+        self.pod_informer = factory.informer(PODS)
+        self.tick = tick
+        self.metrics_getter = metrics_getter
+        self.downscale_stabilization = downscale_stabilization
+        self._recommendations: dict[str, list[tuple[float, int]]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def run(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick):
+            try:
+                self.reconcile_once(time.time())
+            except Exception:  # noqa: BLE001
+                logger.exception("hpa reconcile failed")
+
+    def reconcile_once(self, now: float) -> None:
+        live = set()
+        for hpa in self.hpa_informer.list(None):
+            live.add(meta.namespaced_name(hpa))
+            try:
+                self._sync_one(hpa, now)
+            except Exception as e:  # noqa: BLE001 — one bad HPA must not
+                logger.warning("hpa %s: %s", meta.namespaced_name(hpa), e)
+        # drop stabilization windows of deleted HPAs
+        for key in list(self._recommendations):
+            if key not in live:
+                del self._recommendations[key]
+
+    def _sync_one(self, hpa: Obj, now: float) -> None:
+        spec = hpa.get("spec") or {}
+        ref = spec.get("scaleTargetRef") or {}
+        resource = SCALE_TARGETS.get(ref.get("kind"))
+        if resource is None:
+            return
+        ns, hpa_name = meta.namespace(hpa), meta.name(hpa)
+        target = self.client.get(resource, ns, ref.get("name", ""))
+        current = int((target.get("spec") or {}).get("replicas", 1))
+        sel = ((target.get("spec") or {}).get("selector") or {}) \
+            .get("matchLabels", {})
+        pods = [p for p in self.pod_informer.list(ns)
+                if sel and all(meta.labels(p).get(k) == v
+                               for k, v in sel.items())
+                and (p.get("status") or {}).get("phase")
+                not in ("Succeeded", "Failed")]
+        target_pct = spec.get("targetCPUUtilizationPercentage", 80)
+        if not isinstance(target_pct, (int, float)) or target_pct <= 0:
+            logger.warning("hpa %s/%s: invalid target %r", ns, hpa_name,
+                           target_pct)
+            return
+        utilizations = []
+        for p in pods:
+            usage = self.metrics_getter(p)
+            if usage is None:
+                continue
+            request = sum(quantity.parse_cpu_milli(
+                ((c.get("resources") or {}).get("requests") or {})
+                .get("cpu", "0"))
+                for c in (p.get("spec") or {}).get("containers", []))
+            if request > 0:
+                utilizations.append(100.0 * usage / request)
+        if not utilizations:
+            return  # no samples: hold (upstream: no-scale on missing metrics)
+        avg = sum(utilizations) / len(utilizations)
+        desired = max(1, -(-int(current * avg) // int(target_pct)))  # ceil
+        lo = spec.get("minReplicas", 1)
+        hi = spec.get("maxReplicas", max(lo, desired))
+        desired = max(lo, min(hi, desired))
+        key = f"{ns}/{hpa_name}"
+        # scale-down stabilization: act on the max recommendation in window
+        recs = self._recommendations.setdefault(key, [])
+        recs.append((now, desired))
+        recs[:] = [(t, d) for t, d in recs
+                   if now - t <= self.downscale_stabilization]
+        if desired < current:
+            desired = max(d for _, d in recs)
+        if desired != current:
+            def patch(o):
+                o.setdefault("spec", {})["replicas"] = desired
+                return o
+            self.client.guaranteed_update(resource, ns, ref["name"], patch)
+        status = {"currentReplicas": current, "desiredReplicas": desired,
+                  "currentCPUUtilizationPercentage": int(avg),
+                  "lastScaleTime": now if desired != current
+                  else (hpa.get("status") or {}).get("lastScaleTime")}
+        def spatch(o):
+            o["status"] = status
+            return o
+        try:
+            self.client.guaranteed_update(HPAS, ns, hpa_name, spatch)
+        except kv.NotFoundError:
+            pass

@@ -98,7 +98,13 @@ class JobController(Controller):
 
         status = {"active": len(active), "succeeded": succeeded,
                   "failed": failed, "conditions": conds}
-        if (job.get("status") or {}) != status:
+        prev = job.get("status") or {}
+        if any(c.get("type") in ("Complete", "Failed") for c in conds):
+            # own the completion stamp so status rewrites don't wipe it
+            # (the ttl-after-finished controller keys its sweep off this)
+            status["completionTime"] = prev.get("completionTime",
+                                                time.time())
+        if prev != status:
             def patch(o):
                 o["status"] = status
                 return o

@@ -13,16 +13,30 @@ import threading
 from ..client.clientset import Client
 from ..client.informer import SharedInformerFactory
 from ..client.leaderelection import LeaderElector
+from .cronjob import CronJobController
+from .daemonset import DaemonSetController
 from .deployment import DeploymentController
+from .disruption import DisruptionController
 from .garbagecollector import GarbageCollector
+from .hpa import HorizontalPodAutoscaler
 from .job import JobController
+from .namespace import NamespaceController
 from .nodelifecycle import NodeLifecycleController
+from .podgc import PodGCController
 from .replicaset import ReplicaSetController
+from .resourcequota import ResourceQuotaController
+from .serviceaccount import ServiceAccountController
+from .statefulset import StatefulSetController
+from .ttlafterfinished import TTLAfterFinishedController
 
 logger = logging.getLogger(__name__)
 
-DEFAULT_CONTROLLERS = ("deployment", "replicaset", "job", "garbagecollector",
-                       "nodelifecycle")
+# startup list mirrors controllermanager.go:425-467 (the subset built)
+DEFAULT_CONTROLLERS = ("deployment", "replicaset", "statefulset", "daemonset",
+                       "job", "cronjob", "garbagecollector", "nodelifecycle",
+                       "disruption", "namespace", "resourcequota",
+                       "serviceaccount", "podgc", "ttlafterfinished",
+                       "horizontalpodautoscaler")
 
 
 class ControllerManager:
@@ -35,9 +49,19 @@ class ControllerManager:
         ctors = {
             "deployment": DeploymentController,
             "replicaset": ReplicaSetController,
+            "statefulset": StatefulSetController,
+            "daemonset": DaemonSetController,
             "job": JobController,
+            "cronjob": CronJobController,
             "garbagecollector": GarbageCollector,
             "nodelifecycle": NodeLifecycleController,
+            "disruption": DisruptionController,
+            "namespace": NamespaceController,
+            "resourcequota": ResourceQuotaController,
+            "serviceaccount": ServiceAccountController,
+            "podgc": PodGCController,
+            "ttlafterfinished": TTLAfterFinishedController,
+            "horizontalpodautoscaler": HorizontalPodAutoscaler,
         }
         for name in controllers:
             self.controllers[name] = ctors[name](client, factory)
