@@ -745,21 +745,31 @@ def _parse_intstr(value, expected: int) -> int:
 
 
 def _expected_count(store: kv.MemoryStore, matching: list, ns: str) -> int:
-    """Desired replica count from the pods' owning controller (the
-    disruption controller reads scale subresources the same way); falls
-    back to the observed pod count."""
+    """Desired replica count summed over every distinct owning controller
+    (the disruption controller reads scale subresources the same way);
+    unowned pods count themselves."""
+    owners: dict = {}
+    unowned = 0
     for p in matching:
         ref = next((r for r in ((p.get("metadata") or {})
                                 .get("ownerReferences") or [])
                     if r.get("controller")), None)
         if ref and ref.get("kind") in ("ReplicaSet", "StatefulSet",
                                        "ReplicationController", "Deployment"):
+            key = (ref["kind"], ref["name"])
+            if key in owners:
+                continue
             try:
                 owner = store.get(ref["kind"].lower() + "s", ns, ref["name"])
-                return int((owner.get("spec") or {}).get("replicas", 1))
+                owners[key] = int((owner.get("spec") or {})
+                                  .get("replicas", 1))
             except kv.NotFoundError:
-                pass
-    return len(matching)
+                owners[key] = 0
+        else:
+            unowned += 1
+    if not owners:
+        return len(matching)
+    return sum(owners.values()) + unowned
 
 
 def _pdb_allows_eviction(store: kv.MemoryStore, pdb: dict, ns: str) -> bool:

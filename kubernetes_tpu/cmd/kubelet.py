@@ -19,6 +19,11 @@ def main(argv=None) -> None:
     ap.add_argument("--max-pods", type=int, default=110)
     ap.add_argument("--hollow-nodes", type=int, default=0,
                     help="kubemark mode: register N hollow nodes instead of one")
+    ap.add_argument("--full", action="store_true",
+                    help="run the full kubelet (pod workers, probes, "
+                         "eviction, image GC, checkpoints) instead of hollow")
+    ap.add_argument("--root-dir", default=None,
+                    help="checkpoint/state directory for --full")
     ap.add_argument("-v", "--verbosity", type=int, default=1)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
@@ -36,6 +41,13 @@ def main(argv=None) -> None:
                                       cpu=args.cpu, memory=args.memory,
                                       pods=args.max_pods)
         print(f"kubemark: {args.hollow_nodes} hollow nodes registered")
+    elif args.full:
+        from ..kubelet.kubelet import Kubelet
+        kl = Kubelet(client, factory, args.node_name, root_dir=args.root_dir,
+                     cpu=args.cpu, memory=args.memory, pods=args.max_pods)
+        kl.restore_state()  # crash-only restart path
+        kubelets = [kl.start()]
+        print(f"kubelet (full) running as node {args.node_name}")
     else:
         kubelets = [HollowKubelet(client, factory, args.node_name,
                                   cpu=args.cpu, memory=args.memory,

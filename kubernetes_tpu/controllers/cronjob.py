@@ -31,17 +31,22 @@ class CronParseError(ValueError):
 def _parse_field(field: str, lo: int, hi: int) -> set[int]:
     out: set[int] = set()
     for part in field.split(","):
-        step = 1
-        if "/" in part:
-            part, step_s = part.split("/", 1)
-            step = int(step_s)
-        if part == "*" or part == "":
-            rng = range(lo, hi + 1)
-        elif "-" in part:
-            a, b = part.split("-", 1)
-            rng = range(int(a), int(b) + 1)
-        else:
-            rng = range(int(part), int(part) + 1)
+        try:
+            step = 1
+            if "/" in part:
+                part, step_s = part.split("/", 1)
+                step = int(step_s)
+            if step <= 0:
+                raise CronParseError("step must be positive: %r" % field)
+            if part == "*" or part == "":
+                rng = range(lo, hi + 1)
+            elif "-" in part:
+                a, b = part.split("-", 1)
+                rng = range(int(a), int(b) + 1)
+            else:
+                rng = range(int(part), int(part) + 1)
+        except ValueError:
+            raise CronParseError("invalid cron field %r" % field)
         for v in rng:
             if v < lo or v > hi:
                 raise CronParseError("value %d out of range [%d,%d]"
@@ -66,6 +71,17 @@ class CronSchedule:
         self.dow = _parse_field(fields[4], 0, 6)  # 0 = Sunday
         self._dom_star = fields[2] == "*"
         self._dow_star = fields[4] == "*"
+        # reject never-matching dom/month combos ('0 0 31 2 *') at parse
+        # time: otherwise next_after scans its whole horizon every tick.
+        # Only the dom-governed case (dow='*') can be infeasible — with a
+        # restricted dow, vixie OR semantics still fires on dow matches.
+        if not self._dom_star and self._dow_star:
+            max_day = {1: 31, 2: 29, 3: 31, 4: 30, 5: 31, 6: 30, 7: 31,
+                       8: 31, 9: 30, 10: 31, 11: 30, 12: 31}
+            if all(min(self.dom) > max_day[m] for m in self.months):
+                raise CronParseError(
+                    "schedule never matches: day-of-month %s in months %s"
+                    % (sorted(self.dom), sorted(self.months)))
 
     def matches(self, t: time.struct_time) -> bool:
         if t.tm_min not in self.minutes or t.tm_hour not in self.hours \
@@ -124,7 +140,7 @@ class CronJobController:
         for cj in self.cj_informer.list(None):
             try:
                 self._sync_one(cj, now)
-            except CronParseError as e:
+            except Exception as e:  # noqa: BLE001 — one bad CronJob must
                 logger.error("cronjob %s: %s", meta.namespaced_name(cj), e)
 
     def _sync_one(self, cj: Obj, now: float) -> None:

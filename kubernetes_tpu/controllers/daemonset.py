@@ -32,11 +32,48 @@ DAEMON_TOLERATIONS = [
 ]
 
 
+def _affinity_matches(pod_spec: dict, node: Obj) -> bool:
+    """Template requiredDuringScheduling node affinity (matchExpressions
+    over labels; matchFields over metadata.name), OR across terms."""
+    terms = (((pod_spec.get("affinity") or {}).get("nodeAffinity") or {})
+             .get("requiredDuringSchedulingIgnoredDuringExecution") or {}) \
+        .get("nodeSelectorTerms")
+    if not terms:
+        return True
+    node_labels = meta.labels(node)
+    for term in terms:
+        ok = True
+        for req in term.get("matchExpressions", []):
+            val = node_labels.get(req.get("key"))
+            op = req.get("operator", "In")
+            if op == "In":
+                ok = val in (req.get("values") or [])
+            elif op == "NotIn":
+                ok = val not in (req.get("values") or [])
+            elif op == "Exists":
+                ok = req.get("key") in node_labels
+            elif op == "DoesNotExist":
+                ok = req.get("key") not in node_labels
+            if not ok:
+                break
+        for req in term.get("matchFields", []) if ok else ():
+            if req.get("key") == "metadata.name":
+                ok = meta.name(node) in (req.get("values") or [])
+            if not ok:
+                break
+        if ok:
+            return True
+    return False
+
+
 def _node_matches(ds: Obj, node: Obj) -> bool:
-    sel = ((ds.get("spec") or {}).get("template") or {}).get("spec", {}) \
-        .get("nodeSelector") or {}
+    pod_spec = (((ds.get("spec") or {}).get("template") or {})
+                .get("spec") or {})
+    sel = pod_spec.get("nodeSelector") or {}
     node_labels = meta.labels(node)
     if not all(node_labels.get(k) == v for k, v in sel.items()):
+        return False
+    if not _affinity_matches(pod_spec, node):
         return False
     # untolerated NoSchedule/NoExecute taints exclude the node
     tolerations = (((ds.get("spec") or {}).get("template") or {})

@@ -46,17 +46,29 @@ class DisruptionController(Controller):
                 self.enqueue(pdb)
 
     def _expected(self, matching: list[Obj], ns: str) -> int:
+        """Sum the scale of every distinct owning controller (upstream
+        getExpectedScale); unowned pods count themselves."""
+        owners: dict[tuple, int] = {}
+        unowned = 0
         for p in matching:
             ref = meta.controller_ref(p)
             if ref and ref.get("kind") in ("ReplicaSet", "StatefulSet",
                                            "ReplicationController"):
+                key = (ref["kind"], ref["name"])
+                if key in owners:
+                    continue
                 try:
                     owner = self.client.get(ref["kind"].lower() + "s", ns,
                                             ref["name"])
-                    return int((owner.get("spec") or {}).get("replicas", 1))
+                    owners[key] = int((owner.get("spec") or {})
+                                      .get("replicas", 1))
                 except kv.NotFoundError:
-                    pass
-        return len(matching)
+                    owners[key] = 0
+            else:
+                unowned += 1
+        if not owners:
+            return len(matching)
+        return sum(owners.values()) + unowned
 
     def sync(self, key: str) -> None:
         ns, name = split_key(key)

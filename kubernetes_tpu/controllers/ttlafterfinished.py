@@ -41,27 +41,9 @@ class TTLAfterFinishedController:
         self.tick = tick
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.job_informer.add_event_handler(self._on_job)
-
-    def _on_job(self, type_, job: Obj, old) -> None:
-        # stamp completionTime the moment a job finishes (job controller
-        # owns conditions; we own the timestamp like upstream's shared path)
-        if type_ == kv.DELETED:
-            return
-        status = job.get("status") or {}
-        conds = status.get("conditions") or []
-        done = any(c.get("type") in ("Complete", "Failed")
-                   and c.get("status") == "True" for c in conds)
-        if done and status.get("completionTime") is None:
-            def patch(o):
-                o.setdefault("status", {}).setdefault("completionTime",
-                                                      time.time())
-                return o
-            try:
-                self.client.guaranteed_update(JOBS, meta.namespace(job),
-                                              meta.name(job), patch)
-            except kv.NotFoundError:
-                pass
+        # completionTime is stamped by the job controller in the same
+        # status write that sets the Complete/Failed condition; stamping it
+        # here too would race that writer and shift the TTL deadline.
 
     def run(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True,

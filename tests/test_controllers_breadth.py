@@ -504,3 +504,74 @@ class TestHPA:
         ctrl.reconcile_once(time.time())
         assert client.get(REPLICASETS, "default", "quiet")["spec"][
             "replicas"] == 2
+
+
+# -- review regressions ----------------------------------------------------
+
+class TestControllerReviewRegressions:
+    def test_bad_cron_does_not_starve_others(self, cluster):
+        store, client, mgr = cluster
+        bad = meta.new_object("CronJob", "aaa-bad", "default")
+        bad["spec"] = {"schedule": "1-x * * * *", "jobTemplate": {"spec": {}}}
+        good = meta.new_object("CronJob", "zzz-good", "default")
+        good["spec"] = {"schedule": "* * * * *",
+                        "jobTemplate": {"spec": {"template": {"spec": {
+                            "containers": [{"name": "c0", "image": "i"}]}}}}}
+        client.create(CRONJOBS, bad)
+        client.create(CRONJOBS, good)
+        ctrl = mgr.controllers["cronjob"]
+        wait_for(lambda: len(ctrl.cj_informer.list("default")) == 2)
+        ctrl.reconcile_once(time.time() + 60)  # must not raise
+        jobs = [meta.name(j) for j in client.list(JOBS, "default")[0]]
+        assert any(n.startswith("zzz-good-") for n in jobs)
+
+    def test_impossible_dom_schedule_rejected(self):
+        from kubernetes_tpu.controllers.cronjob import CronParseError
+        with pytest.raises(CronParseError):
+            CronSchedule("0 0 31 2 *")  # Feb 31 never exists
+        with pytest.raises(CronParseError):
+            CronSchedule("*/0 * * * *")  # zero step
+        CronSchedule("0 0 31 2 0")  # dow restricted: fires on Sundays
+
+    def test_daemonset_respects_template_affinity(self, cluster):
+        store, client, _ = cluster
+        client.create(NODES, make_node("tpu-node", labels={"accel": "tpu"}))
+        client.create(NODES, make_node("plain-node"))
+        ds = meta.new_object("DaemonSet", "affin", "default")
+        ds["spec"] = {"template": {
+            "metadata": {"labels": {"app": "affin"}},
+            "spec": {
+                "affinity": {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchExpressions": [
+                            {"key": "accel", "operator": "In",
+                             "values": ["tpu"]}]}]}}},
+                "containers": [{"name": "c0", "image": "i"}]}}}
+        client.create(DAEMONSETS, ds)
+        assert wait_for(lambda: len(pods_of(client)) == 1)
+        time.sleep(0.3)
+        assert len(pods_of(client)) == 1  # plain-node excluded
+
+    def test_pdb_expected_sums_multiple_owners(self, cluster):
+        store, client, mgr = cluster
+        for rs_name in ("rs-a", "rs-b"):
+            rs = meta.new_object("ReplicaSet", rs_name, "default")
+            rs["spec"] = {"replicas": 3,
+                          "selector": {"matchLabels": {"tier": rs_name}},
+                          "template": {"metadata": {"labels": {
+                              "tier": rs_name, "shared": "yes"}},
+                              "spec": {"containers": [
+                                  {"name": "c0", "image": "i"}]}}}
+            client.create(REPLICASETS, rs)
+        assert wait_for(lambda: len(pods_of(client)) == 6)
+        for p in pods_of(client):
+            mark_ready(client, p)
+        pdb = meta.new_object("PodDisruptionBudget", "span", "default")
+        pdb["spec"] = {"minAvailable": "50%",
+                       "selector": {"matchLabels": {"shared": "yes"}}}
+        client.create(PDBS, pdb)
+        assert wait_for(lambda: (client.get(PDBS, "default", "span")
+                                 .get("status") or {})
+                        .get("expectedPods") == 6)
+        st = client.get(PDBS, "default", "span")["status"]
+        assert st["desiredHealthy"] == 3 and st["disruptionsAllowed"] == 3

@@ -1,0 +1,386 @@
+"""Kubelet depth: checkpoint manager, QoS, probes, eviction, status
+manager, pod workers, image GC, restart policy + crash backoff.
+
+Behavioral contracts from pkg/kubelet/{checkpointmanager,prober,eviction,
+status,pod_workers.go,images,kuberuntime}.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.kubelet.checkpoint import (
+    CheckpointManager, CorruptCheckpointError,
+)
+from kubernetes_tpu.kubelet.cri import EXITED, RUNNING, FakeRuntimeService
+from kubernetes_tpu.kubelet.eviction import EvictionManager
+from kubernetes_tpu.kubelet.images import ImageGCManager
+from kubernetes_tpu.kubelet.kubelet import Kubelet
+from kubernetes_tpu.kubelet.pod_workers import PodWorkers
+from kubernetes_tpu.kubelet.probes import LIVENESS, READINESS, ProbeManager
+from kubernetes_tpu.kubelet.qos import (
+    BEST_EFFORT, BURSTABLE, GUARANTEED, pod_qos,
+)
+from kubernetes_tpu.kubelet.status_manager import StatusManager
+from kubernetes_tpu.store import kv
+
+
+def wait_for(predicate, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def make_pod(name, node="n1", requests=None, limits=None, **spec_extra):
+    pod = meta.new_object("Pod", name, "default")
+    pod["metadata"]["uid"] = f"uid-{name}"
+    res = {}
+    if requests:
+        res["requests"] = requests
+    if limits:
+        res["limits"] = limits
+    pod["spec"] = {"nodeName": node,
+                   "containers": [{"name": "c0", "image": "img:v1",
+                                   "resources": res}],
+                   **spec_extra}
+    return pod
+
+
+# -- checkpoint manager ----------------------------------------------------
+
+class TestCheckpointManager:
+    def test_roundtrip(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.create_checkpoint("state", {"a": [1, 2], "b": "x"})
+        assert cm.get_checkpoint("state") == {"a": [1, 2], "b": "x"}
+        assert cm.list_checkpoints() == ["state"]
+        cm.remove_checkpoint("state")
+        assert cm.list_checkpoints() == []
+
+    def test_missing_raises_keyerror(self, tmp_path):
+        with pytest.raises(KeyError):
+            CheckpointManager(str(tmp_path)).get_checkpoint("nope")
+
+    def test_corrupt_checksum_detected(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.create_checkpoint("state", {"v": 1})
+        path = tmp_path / "state"
+        doc = json.loads(path.read_text())
+        doc["data"] = json.dumps({"v": 2})  # tampered, checksum stale
+        path.write_text(json.dumps(doc))
+        with pytest.raises(CorruptCheckpointError):
+            cm.get_checkpoint("state")
+
+    def test_torn_write_detected(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        (tmp_path / "torn").write_text('{"data": "{\\"v\\"')
+        with pytest.raises(CorruptCheckpointError):
+            cm.get_checkpoint("torn")
+
+    def test_invalid_names_rejected(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        with pytest.raises(ValueError):
+            cm.create_checkpoint("../escape", {})
+
+
+# -- QoS -------------------------------------------------------------------
+
+class TestQoS:
+    def test_guaranteed(self):
+        p = make_pod("g", requests={"cpu": "1", "memory": "1Gi"},
+                     limits={"cpu": "1", "memory": "1Gi"})
+        assert pod_qos(p) == GUARANTEED
+
+    def test_limits_only_is_guaranteed(self):
+        p = make_pod("g2", limits={"cpu": "1", "memory": "1Gi"})
+        assert pod_qos(p) == GUARANTEED
+
+    def test_burstable(self):
+        p = make_pod("b", requests={"cpu": "1"})
+        assert pod_qos(p) == BURSTABLE
+
+    def test_best_effort(self):
+        assert pod_qos(make_pod("be")) == BEST_EFFORT
+
+    def test_mismatched_request_limit_burstable(self):
+        p = make_pod("m", requests={"cpu": "1", "memory": "1Gi"},
+                     limits={"cpu": "2", "memory": "1Gi"})
+        assert pod_qos(p) == BURSTABLE
+
+
+# -- probes ----------------------------------------------------------------
+
+class TestProbes:
+    def test_readiness_gates_until_success(self):
+        results = []
+        pm = ProbeManager(
+            handler=lambda pod, c, t, running: True,
+            on_readiness_change=lambda p, c, ok: results.append(ok))
+        pod = make_pod("r")
+        pod["spec"]["containers"][0]["readinessProbe"] = {
+            "initialDelaySeconds": 0.3, "periodSeconds": 0.05}
+        pm.add_pod(pod)
+        assert pm.pod_ready(pod) is False  # gated until first success
+        assert wait_for(lambda: pm.pod_ready(pod), timeout=5)
+        assert results == [True]
+        pm.stop()
+
+    def test_failure_threshold_before_liveness_restart(self):
+        restarts = []
+        pm = ProbeManager(
+            handler=lambda pod, c, t, running: False,
+            on_liveness_failure=lambda p, c: restarts.append(c))
+        pod = make_pod("l")
+        pod["spec"]["containers"][0]["livenessProbe"] = {
+            "periodSeconds": 0.05, "failureThreshold": 3}
+        pm.add_pod(pod)
+        assert wait_for(lambda: restarts, timeout=5)
+        assert restarts[0] == "c0"
+        pm.stop()
+
+    def test_remove_pod_stops_workers(self):
+        pm = ProbeManager(handler=lambda *a: True)
+        pod = make_pod("gone")
+        pod["spec"]["containers"][0]["readinessProbe"] = {"periodSeconds": 1}
+        pm.add_pod(pod)
+        pm.remove_pod(pod)
+        assert pm.readiness == {}
+        assert pm._workers == {}
+
+    def test_annotation_handler_fails_probe(self):
+        from kubernetes_tpu.kubelet.probes import default_handler
+        pod = make_pod("ann")
+        pod["metadata"]["annotations"] = {"hollow/fail-readiness": "true"}
+        assert default_handler(pod, {"name": "c0"}, READINESS, True) is False
+        assert default_handler(pod, {"name": "c0"}, LIVENESS, True) is True
+
+
+# -- eviction --------------------------------------------------------------
+
+class TestEviction:
+    def _cluster(self):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        return store, client
+
+    def test_evicts_best_effort_first_under_pressure(self):
+        store, client = self._cluster()
+        node = meta.new_object("Node", "n1", "")
+        node["status"] = {"conditions": []}
+        client.create(NODES, node)
+        be = make_pod("besteffort")
+        bu = make_pod("burstable", requests={"memory": "900Mi"})
+        client.create(PODS, be)
+        client.create(PODS, bu)
+        pods = [client.get(PODS, "default", "besteffort"),
+                client.get(PODS, "default", "burstable")]
+        em = EvictionManager(
+            client, "n1", memory_capacity=1 << 30,  # 1Gi, ~900Mi used
+            memory_available_threshold=0.15,  # 12% free < 15% -> pressure
+            stats_provider=lambda ps: sum(
+                0 if meta.name(p) == "besteffort" else 943718400
+                for p in ps),
+            list_pods=lambda: [client.get(PODS, "default", meta.name(p))
+                               for p in pods
+                               if meta.name(p) in {
+                                   meta.name(x)
+                                   for x in client.list(PODS, "default")[0]}])
+        evicted = em.synchronize()
+        # BestEffort dies first even though Burstable is the hog
+        assert evicted[0] == "besteffort"
+        assert (client.get(PODS, "default", "besteffort")["status"]["reason"]
+                == "Evicted")
+        node = client.get(NODES, "", "n1")
+        assert any(c["type"] == "MemoryPressure"
+                   for c in node["status"]["conditions"])
+
+    def test_no_pressure_no_eviction(self):
+        store, client = self._cluster()
+        node = meta.new_object("Node", "n1", "")
+        client.create(NODES, node)
+        p = make_pod("calm", requests={"memory": "64Mi"})
+        client.create(PODS, p)
+        em = EvictionManager(client, "n1", memory_capacity=1 << 30,
+                             list_pods=lambda: [p])
+        assert em.synchronize() == []
+        assert em.under_pressure is False
+
+
+# -- status manager --------------------------------------------------------
+
+class TestStatusManager:
+    def test_dedupes_identical_statuses(self):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        pod = make_pod("s")
+        client.create(PODS, pod)
+        sm = StatusManager(client)
+        for _ in range(5):
+            sm.set_pod_status(pod, {"phase": "Running"})
+        assert sm.api_writes == 1
+        sm.set_pod_status(pod, {"phase": "Succeeded"})
+        assert sm.api_writes == 2
+        assert client.get(PODS, "default", "s")["status"]["phase"] == "Succeeded"
+
+    def test_missing_pod_dropped(self):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        sm = StatusManager(client)
+        pod = make_pod("ghost")
+        sm.set_pod_status(pod, {"phase": "Running"})  # pod never created
+        assert sm.get_pod_status("uid-ghost") is None
+
+
+# -- pod workers -----------------------------------------------------------
+
+class TestPodWorkers:
+    def test_serialized_per_pod_and_coalesced(self):
+        seen = []
+        gate = threading.Event()
+
+        def sync(update_type, pod):
+            if not seen:
+                gate.wait(5)
+            seen.append((update_type, pod["metadata"]["labels"]["v"]))
+
+        pw = PodWorkers(sync)
+        pod = make_pod("w")
+        for v in ("1", "2", "3"):  # arrive while sync #1 blocks
+            pod = meta.deep_copy(pod)
+            pod["metadata"]["labels"] = {"v": v}
+            pw.update_pod("SYNC", pod)
+        gate.set()
+        assert wait_for(lambda: len(seen) == 2, timeout=5)
+        time.sleep(0.1)
+        # v=2 was coalesced away: only the first and the latest ran
+        assert [v for _, v in seen] == ["1", "3"]
+        pw.stop()
+
+    def test_worker_exception_does_not_kill_pool(self):
+        calls = []
+
+        def sync(update_type, pod):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("boom")
+
+        pw = PodWorkers(sync)
+        pod = make_pod("e")
+        pw.update_pod("SYNC", pod)
+        assert wait_for(lambda: len(calls) == 1)
+        pw.update_pod("SYNC", pod)
+        assert wait_for(lambda: len(calls) == 2)
+        pw.stop()
+
+
+# -- image GC --------------------------------------------------------------
+
+class TestImageGC:
+    def test_gc_when_over_threshold(self):
+        rt = FakeRuntimeService()
+        gc = ImageGCManager(rt, disk_capacity=10, image_size=1,
+                            high_threshold_percent=85,
+                            low_threshold_percent=50)
+        for i in range(9):  # 90% > 85%
+            rt.pull_image(f"img:{i}")
+            gc.image_used(f"img:{i}")
+        deleted = gc.garbage_collect(in_use={"img:8"})
+        assert "img:8" not in deleted
+        assert gc.usage_percent() <= 50
+        # oldest-used deleted first
+        assert deleted[0] == "img:0"
+
+    def test_no_gc_below_threshold(self):
+        rt = FakeRuntimeService()
+        gc = ImageGCManager(rt, disk_capacity=10, image_size=1)
+        rt.pull_image("img:a")
+        assert gc.garbage_collect(in_use=set()) == []
+
+
+# -- full kubelet: restart policy + crash backoff + probes ----------------
+
+@pytest.fixture
+def kubelet_cluster(tmp_path):
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    kl = Kubelet(client, factory, "n1", root_dir=str(tmp_path),
+                 heartbeat_interval=3600)
+    factory.start()
+    factory.wait_for_cache_sync()
+    kl.start()
+    yield store, client, kl
+    kl.stop()
+    factory.stop()
+
+
+class TestKubeletFull:
+    def test_pod_runs_and_reports_qos(self, kubelet_cluster):
+        store, client, kl = kubelet_cluster
+        client.create(PODS, make_pod("app", requests={"cpu": "1"}))
+        assert wait_for(lambda: (client.get(PODS, "default", "app")
+                                 .get("status") or {}).get("phase") == "Running")
+        assert client.get(PODS, "default", "app")["status"]["qosClass"] == \
+            BURSTABLE
+
+    def test_restart_policy_always_restarts_exited(self, kubelet_cluster):
+        store, client, kl = kubelet_cluster
+        pod = make_pod("crashy")
+        pod["metadata"]["annotations"] = {"hollow/run-seconds": "0.05",
+                                          "hollow/exit-code": "1"}
+        client.create(PODS, pod)
+        assert wait_for(lambda: kl._container_running(pod, "c0"), timeout=10)
+        # wait for the planned exit, then the restart
+        assert wait_for(
+            lambda: (kl.runtime.list_containers()
+                     and any(c["state"] == EXITED
+                             for c in kl.runtime.list_containers()))
+            or kl._backoff, timeout=10)
+        kl.workers.update_pod("SYNC", client.get(PODS, "default", "crashy"))
+        assert wait_for(lambda: ("uid-crashy", "c0") in kl._backoff,
+                        timeout=10)
+
+    def test_restart_policy_never_stays_dead(self, kubelet_cluster):
+        store, client, kl = kubelet_cluster
+        pod = make_pod("oneshot", restartPolicy="Never")
+        pod["metadata"]["annotations"] = {"hollow/run-seconds": "0.05",
+                                          "hollow/exit-code": "0"}
+        client.create(PODS, pod)
+        assert wait_for(lambda: (client.get(PODS, "default", "oneshot")
+                                 .get("status") or {}).get("phase")
+                        == "Succeeded", timeout=10)
+
+    def test_readiness_probe_gates_ready_condition(self, kubelet_cluster):
+        store, client, kl = kubelet_cluster
+        pod = make_pod("gated")
+        pod["metadata"]["annotations"] = {"hollow/fail-readiness": "true"}
+        pod["spec"]["containers"][0]["readinessProbe"] = {
+            "periodSeconds": 0.05}
+        client.create(PODS, pod)
+        assert wait_for(lambda: (client.get(PODS, "default", "gated")
+                                 .get("status") or {}).get("phase")
+                        == "Running", timeout=10)
+        time.sleep(0.3)
+        conds = client.get(PODS, "default", "gated")["status"]["conditions"]
+        ready = next(c for c in conds if c["type"] == "Ready")
+        assert ready["status"] == "False"
+
+    def test_checkpoint_and_restore(self, kubelet_cluster, tmp_path):
+        store, client, kl = kubelet_cluster
+        client.create(PODS, make_pod("persist"))
+        assert wait_for(lambda: kl._pod_state, timeout=10)
+        kl._checkpoint_state()
+        # a fresh kubelet over the same root restores allocation state
+        factory2 = SharedInformerFactory(client)
+        kl2 = Kubelet(client, factory2, "n1", root_dir=str(tmp_path),
+                      heartbeat_interval=3600)
+        assert kl2.restore_state() is True
+        assert "uid-persist" in kl2._pod_state
