@@ -100,6 +100,20 @@ class Client:
 
         return self.guaranteed_update(PODS, ns, nm, apply)
 
+    def bind_many(self, bindings: list[tuple[str, str, str]]
+                  ) -> list[tuple[Obj | None, Exception | None]]:
+        """Bulk bind: (namespace, name, node_name) triples, per-entry
+        results.  Generic clients fall back to per-pod bind(); LocalClient
+        uses the store's transactional multi-bind."""
+        out: list[tuple[Obj | None, Exception | None]] = []
+        for ns, nm, node in bindings:
+            try:
+                out.append((self.bind({"metadata": {"namespace": ns,
+                                                    "name": nm}}, node), None))
+            except kv.StoreError as e:
+                out.append((None, e))
+        return out
+
     def update_status(self, resource: str, obj: Obj) -> Obj:
         """Status-subresource write: merge .status only."""
         status = obj.get("status") or {}
@@ -181,3 +195,7 @@ class LocalClient(Client):
 
     def watch(self, resource: str, since_rv: int | None = None) -> Watch:
         return self.store.watch(resource, since_rv)
+
+    def bind_many(self, bindings: list[tuple[str, str, str]]
+                  ) -> list[tuple[Obj | None, Exception | None]]:
+        return self.store.bind_many(PODS, bindings)

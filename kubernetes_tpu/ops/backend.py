@@ -49,6 +49,12 @@ logger = logging.getLogger(__name__)
 
 DYN_FIELDS = ("used", "used_nz", "npods", "port_mask")
 
+# dispatch() sentinel: an earlier batch is still in flight and this batch
+# needs row patches / a refresh, which would clobber the in-flight batch's
+# device-side accounting.  The caller must resolve the in-flight batch and
+# finish its tail (so the authoritative tensors catch up), then re-dispatch.
+FLUSH_FIRST = object()
+
 
 class TPUBatchBackend(BatchBackend):
     def __init__(self, caps: Caps | None = None, batch_size: int = 256,
@@ -68,8 +74,12 @@ class TPUBatchBackend(BatchBackend):
         self._static_node = None    # dict of device arrays (rarely changes)
         self._static_version = -1
         self._mirror: dict[str, np.ndarray] | None = None
+        # dispatched-but-unresolved batches (pipeline bookkeeping) and node
+        # rows whose dirtiness must survive an early-exit dispatch attempt
+        self._unresolved: list[object] = []
+        self._carry_dirty: set[int] = set()
         self.stats = {"batches": 0, "full_refresh": 0, "patched_rows": 0,
-                      "waves": 0}
+                      "waves": 0, "flush_first": 0}
 
     # -- device sync -----------------------------------------------------
 
@@ -131,7 +141,8 @@ class TPUBatchBackend(BatchBackend):
         self.stats["full_refresh"] += 1
 
     def _diff_patches(self, dirty_rows) -> tuple[np.ndarray, np.ndarray] | None:
-        """Rows where authoritative != mirror. None -> too many (refresh)."""
+        """Rows where authoritative != mirror (read-only; mirror untouched).
+        None -> too many (refresh)."""
         t, m = self.tensors, self._mirror
         rows = []
         for r in dirty_rows:
@@ -149,10 +160,14 @@ class TPUBatchBackend(BatchBackend):
         vals = np.concatenate([
             t.used[rows_a], t.used_nz[rows_a], t.npods[rows_a][:, None],
             t.port_mask[rows_a]], axis=1).astype(np.float32)
-        # bring the mirror in line with what the device will hold
+        return rows_a, vals
+
+    def _sync_mirror_rows(self, rows_a: np.ndarray) -> None:
+        """Bring the mirror in line with what the device will hold after the
+        row patch uploads authoritative values."""
+        t, m = self.tensors, self._mirror
         for f in DYN_FIELDS:
             m[f][rows_a] = getattr(t, f)[rows_a]
-        return rows_a, vals
 
     def _replay(self, batch: PodBatch, assignments: np.ndarray) -> None:
         """Apply the kernel's commit rules to the host mirror."""
@@ -196,29 +211,57 @@ class TPUBatchBackend(BatchBackend):
 
     # -- BatchBackend ----------------------------------------------------
 
-    def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot
-               ) -> list[tuple[int | None, Status | None]]:
+    def dispatch(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot):
+        """Host prep + async device dispatch.  Returns resolve() -> results,
+        or the FLUSH_FIRST sentinel.
+
+        The device call is dispatched but NOT waited on; the caller can
+        overlap host work with the device round trip and call resolve() when
+        it needs the answers.  Pipelining over an in-flight batch is only
+        allowed when this batch needs NO row patches, no refresh, and no
+        static re-upload: in that state the device chains its own resident
+        accounting (donated state) and the host mirror/authoritative pair
+        agree, so nothing the in-flight batch committed can be clobbered.
+        Otherwise dispatch returns FLUSH_FIRST: the caller must resolve the
+        in-flight batch AND finish its assume tail (so the authoritative
+        tensors catch up with the mirror), then call dispatch again — the
+        dirty rows from this attempt are carried over so no external change
+        is lost."""
         with self._lock:
+            dirty = set(self.tensors.update_from_snapshot_tracked(snapshot))
+            dirty |= self._carry_dirty
             try:
-                dirty_rows = self.tensors.update_from_snapshot_tracked(snapshot)
                 batch = self.encoder.encode(list(pod_infos))
             except VocabFullError as e:
                 logger.warning("tensorization overflow (%s); batch -> oracle path", e)
-                return [(None, Status(SKIP, str(e)))] * len(pod_infos)
+                self._carry_dirty = dirty
+                results = [(None, Status(SKIP, str(e)))] * len(pod_infos)
+                return lambda: results
 
-            if self._static_version != self.tensors.static_version:
-                self._upload_static()
-
+            inflight = bool(self._unresolved)
+            static_changed = self._static_version != self.tensors.static_version
             cd_sg, cd_asg = self.tensors.domain_base_counts()
             patches = None
             if self._state is not None:
                 if (np.array_equal(cd_sg, self._mirror["cd_sg"])
                         and np.array_equal(cd_asg, self._mirror["cd_asg"])):
-                    patches = self._diff_patches(dirty_rows)
-            if self._state is None or patches is None:
+                    patches = self._diff_patches(sorted(dirty))
+            needs_refresh = self._state is None or patches is None
+            needs_patch = patches is not None and len(patches[0]) > 0
+            if inflight and (static_changed or needs_refresh or needs_patch):
+                self._carry_dirty = dirty
+                self.stats["flush_first"] += 1
+                return FLUSH_FIRST
+
+            if static_changed:
+                self._upload_static()
+            if needs_refresh:
                 self._full_refresh(cd_sg, cd_asg)
                 patches = (np.empty(0, np.int32),
                            np.empty((0, self._spec.f_patch), np.float32))
+            elif needs_patch:
+                self._sync_mirror_rows(patches[0])
+            self._carry_dirty = set()
             self.stats["patched_rows"] += len(patches[0])
 
             buf = pack_pod_batch(batch, self._spec, patches[0], patches[1])
@@ -226,24 +269,43 @@ class TPUBatchBackend(BatchBackend):
             fn = self._pick_variant(batch)
             self._state, assignments_dev, waves = fn(
                 self._state, self._static_node, jnp.asarray(buf))
-            assignments = np.asarray(assignments_dev)
             self.stats["batches"] += 1
-            self.stats["waves"] += int(waves)
-            self._replay(batch, assignments)
+            holder = object()
+            self._unresolved.append(holder)
 
-        escapes = set(batch.escape)
-        results: list[tuple[int | None, Status | None]] = []
-        for i in range(len(pod_infos)):
-            if i >= self.batch_size or i in escapes:
-                results.append((None, Status(SKIP, "escape to per-pod path")))
-                continue
-            row = int(assignments[i])
-            if row < 0:
-                results.append((None, Status(
-                    UNSCHEDULABLE, "no feasible node (TPU batch filter)")))
-            else:
-                results.append((row, None))
-        return results
+        n = len(pod_infos)
+
+        def resolve() -> list[tuple[int | None, Status | None]]:
+            with self._lock:
+                assignments = np.asarray(assignments_dev)  # blocks on device
+                self.stats["waves"] += int(waves)
+                self._replay(batch, assignments)
+                try:
+                    self._unresolved.remove(holder)
+                except ValueError:  # pragma: no cover - double resolve
+                    pass
+            escapes = set(batch.escape)
+            results: list[tuple[int | None, Status | None]] = []
+            for i in range(n):
+                if i >= self.batch_size or i in escapes:
+                    results.append((None, Status(SKIP, "escape to per-pod path")))
+                    continue
+                row = int(assignments[i])
+                if row < 0:
+                    results.append((None, Status(
+                        UNSCHEDULABLE, "no feasible node (TPU batch filter)")))
+                else:
+                    results.append((row, None))
+            return results
+
+        return resolve
+
+    def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot
+               ) -> list[tuple[int | None, Status | None]]:
+        resolve = self.dispatch(pod_infos, snapshot)
+        if resolve is FLUSH_FIRST:  # pragma: no cover - sync caller, no inflight
+            raise RuntimeError("FLUSH_FIRST with no pipelined caller")
+        return resolve()
 
     def node_name(self, idx: int) -> str:
         name = self.tensors.node_name(idx)

@@ -13,6 +13,8 @@ from ..types import ERROR, NodeInfo, PodInfo, Status
 
 class DefaultBinder(BindPlugin):
     name = "DefaultBinder"
+    # marks the scheduler's bulk-bind fast path as semantically equivalent
+    is_default_binder = True
 
     def __init__(self, client=None):
         self.client = client

@@ -99,6 +99,14 @@ class BatchBackend:
         """Returns, per pod (same order): (node_index or None, status)."""
         raise NotImplementedError
 
+    def dispatch(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot):
+        """Async variant: kick off the batch and return resolve() -> results.
+        Default wraps assign() synchronously; TPUBatchBackend overrides it
+        with a true async device dispatch so the scheduler can overlap the
+        previous batch's bind tail with the device round trip."""
+        results = self.assign(pod_infos, snapshot)
+        return lambda: results
+
     def node_name(self, idx: int) -> str:
         raise NotImplementedError
 
@@ -155,6 +163,8 @@ class Scheduler:
                 if hasattr(plugin, "preemption_observer"):
                     plugin.preemption_observer = self.metrics.observe_preemption
         self._stop = threading.Event()
+        self._pending = None  # in-flight dispatched batch (depth-1 pipeline)
+        self._deferred: list[QueuedPodInfo] = []  # per-pod pods awaiting a quiescent cache
         self._binder_pool = ThreadPoolExecutor(max_workers=16,
                                                thread_name_prefix="bind")
         self._next_start_node_index = 0
@@ -240,35 +250,68 @@ class Scheduler:
     def stop(self) -> None:
         self._stop.set()
         self.queue.close()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        if not any(t.is_alive() for t in self._threads):
+            self._flush_pending()  # loop thread gone: safe to drain here
         self._binder_pool.shutdown(wait=False)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             self.schedule_step(timeout=0.5)
+        self._flush_pending()
+        deferred, self._deferred = self._deferred, []
+        for q in deferred:  # don't strand popped pods on shutdown
+            self.schedule_one(q)
 
     def schedule_step(self, timeout: float | None = None) -> int:
         """One scheduling iteration; returns number of pods processed.
-        Batch mode if any profile has a batch backend; else per-pod."""
+        Batch mode if any profile has a batch backend; else per-pod.
+
+        Batch mode is a depth-1 pipeline: batch k+1 is dispatched to the
+        device (async) BEFORE batch k's assume/bind tail runs on the host, so
+        the device round trip (~70 ms on a tunneled chip) hides behind host
+        work.  Safety: the backend refuses to pipeline (FLUSH_FIRST) whenever
+        this would risk clobbering in-flight device accounting, and per-pod
+        scheduling (other profiles, extender pods, tensor-escape pods) is
+        deferred to moments when nothing is in flight — otherwise the
+        per-pod Filter could double-place onto capacity an in-flight batch
+        already claimed.  While a batch is in flight the queue pop is
+        non-blocking so an emptying queue flushes the pipeline immediately
+        instead of parking the last batch behind the pop timeout."""
         batch_profile = next((p for p in self.profiles.values()
                               if p.batch_backend is not None), None)
         if batch_profile is not None:
-            batch = self.queue.pop_batch(batch_profile.batch_size, timeout)
-            if not batch:
-                return 0
-            # route: pods of other profiles go through per-pod path
-            mine = [q for q in batch
-                    if self._profile_for(q.pod) is batch_profile]
-            others = [q for q in batch if self._profile_for(q.pod) is not batch_profile]
-            if mine:
-                self.schedule_batch(batch_profile, mine)
-            for q in others:
-                self.schedule_one(q)
+            t = 0.0 if self._pending is not None else timeout
+            batch = self.queue.pop_batch(batch_profile.batch_size, t)
+            mine: list[QueuedPodInfo] = []
+            perpod: list[QueuedPodInfo] = []
+            if batch:
+                for q in batch:
+                    (mine if self._profile_for(q.pod) is batch_profile
+                     else perpod).append(q)
+            if perpod or self._deferred:
+                # per-pod scheduling needs a cache with no in-flight claims
+                self._flush_pending()
+                deferred, self._deferred = self._deferred, []
+                for q in deferred + perpod:
+                    self.schedule_one(q)
+            pending = self._dispatch_batch(batch_profile, mine) if mine else None
+            self._flush_pending()
+            self._pending = pending
             return len(batch)
         qpi = self.queue.pop(timeout)
         if qpi is None:
             return 0
         self.schedule_one(qpi)
         return 1
+
+    def _flush_pending(self) -> None:
+        """Resolve the in-flight batch (blocks on device) and run its tail."""
+        pending = self._pending
+        self._pending = None
+        if pending is not None:
+            self._finish_batch(*pending)
 
     def _profile_for(self, pod: Obj) -> Profile | None:
         name = (pod.get("spec") or {}).get("schedulerName", "default-scheduler")
@@ -308,8 +351,8 @@ class Scheduler:
         if node_name is None:
             return  # failure already handled (reserve/permit path)
         # async binding cycle (schedule_one.go:100)
-        self._binder_pool.submit(self._binding_cycle, fw, state, qpi,
-                                 node_name, cycle, start)
+        self._submit_binding(self._binding_cycle, fw, state, qpi,
+                             node_name, cycle, start)
 
     def _skip_schedule(self, pod: Obj) -> bool:
         # schedule_one.go skipPodSchedule: deleted or assumed-and-updated
@@ -600,35 +643,71 @@ class Scheduler:
     # -- batch pipeline (TPU path; no reference equivalent) --------------
 
     def schedule_batch(self, profile: Profile, batch: list[QueuedPodInfo]) -> None:
-        """Schedule a whole batch through the TPU backend.
+        """Schedule a whole batch through the TPU backend synchronously
+        (dispatch + finish in one call; the run loop pipelines instead)."""
+        pending = self._dispatch_batch(profile, batch)
+        if pending is not None:
+            self._finish_batch(*pending)
+        deferred, self._deferred = self._deferred, []
+        for q in deferred:
+            self.schedule_one(q)
 
-        The backend returns a conflict-free assignment (intra-batch resource
-        accounting is its job); each returned assignment then goes through
-        the same assume -> Reserve -> Permit -> bind tail as the per-pod
-        path, so cache/queue/failure semantics are identical."""
-        fw = profile.framework
+    def _dispatch_batch(self, profile: Profile, batch: list[QueuedPodInfo]):
+        """Pre-process a batch and dispatch it to the device (async).
+
+        Returns (profile, live, resolve, cycle, start) for _finish_batch, or
+        None if nothing went to the device."""
+        from ..ops.backend import FLUSH_FIRST
         backend = profile.batch_backend
         cycle = self.queue.scheduling_cycle()
         start = time.monotonic()
         live = [q for q in batch if not self._skip_schedule(q.pod)]
         if self.extenders:
             # extender webhooks are per-pod HTTP calls: route interested
-            # pods through the oracle path so the extender contract holds
+            # pods through the oracle path (deferred to a quiescent moment)
+            # so the extender contract holds
             ext_pods = [q for q in live if any(
                 e.is_interested(q.pod) for e in self.extenders)]
             live = [q for q in live if q not in ext_pods]
-            for q in ext_pods:
-                self.schedule_one(q)
+            self._deferred.extend(ext_pods)
         if not live:
-            return
+            return None
         snapshot = Snapshot() if not hasattr(self, "_snapshot") else self._snapshot
         self._snapshot = self.cache.update_snapshot(snapshot)
-        results = backend.assign([q.pod_info for q in live], self._snapshot)
+        resolve = backend.dispatch([q.pod_info for q in live], self._snapshot)
+        if resolve is FLUSH_FIRST:
+            # the batch needs device-state repair; drain the in-flight batch
+            # and its tail, refresh the snapshot, and re-dispatch clean
+            self._flush_pending()
+            self._snapshot = self.cache.update_snapshot(self._snapshot)
+            resolve = backend.dispatch([q.pod_info for q in live], self._snapshot)
+            if resolve is FLUSH_FIRST:  # pragma: no cover - nothing in flight
+                raise RuntimeError("backend demanded flush with empty pipeline")
+        return profile, live, resolve, cycle, start
+
+    def _finish_batch(self, profile: Profile, live: list[QueuedPodInfo],
+                      resolve, cycle: int, start: float) -> None:
+        """Resolve a dispatched batch and run the assume -> Reserve ->
+        Permit -> bind tail.
+
+        The backend returns a conflict-free assignment (intra-batch resource
+        accounting is its job); each returned assignment then goes through
+        the same assume -> Reserve -> Permit -> bind tail as the per-pod
+        path, so cache/queue/failure semantics are identical.  Pods whose
+        Permit is immediate and whose Bind would be the DefaultBinder are
+        written back through one bulk store transaction instead of one
+        guaranteed-update per pod."""
+        fw = profile.framework
+        backend = profile.batch_backend
+        results = resolve()
+        bulk: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         for qpi, (node_idx, s) in zip(live, results):
             if node_idx is None:
                 if s is not None and s.is_skip():
-                    # constraint not tensor-encodable: per-pod oracle path
-                    self.schedule_one(qpi)
+                    # constraint not tensor-encodable: per-pod oracle path,
+                    # deferred until nothing is in flight (a pipelined next
+                    # batch may already be claiming capacity on device)
+                    self._deferred.append(qpi)
                     continue
                 st = s or Status(UNSCHEDULABLE, "no feasible node (batch)")
                 self._handle_failure(fw, qpi, st, cycle,
@@ -658,5 +737,76 @@ class Scheduler:
                 self._handle_failure(fw, qpi, st, cycle,
                                      {st.plugin} if st.plugin else set(), start)
                 continue
-            self._binder_pool.submit(self._binding_cycle, fw, state, qpi,
+            if (st is None or st.is_success()) and self._bulk_bindable(fw):
+                bulk.append((state, qpi, node_name, assumed))
+            else:
+                self._submit_binding(self._binding_cycle, fw, state, qpi,
                                      node_name, cycle, start)
+        if bulk:
+            self._submit_binding(self._binding_cycle_bulk, fw, bulk,
+                                 cycle, start)
+
+    def _submit_binding(self, fn, *args) -> None:
+        """Submit a binding cycle to the pool; if the pool was shut down
+        (stop() racing a final flush), run it inline so no assumed pod is
+        stranded unbound and unrequeued."""
+        try:
+            self._binder_pool.submit(fn, *args)
+        except RuntimeError:
+            fn(*args)
+
+    @staticmethod
+    def _bulk_bindable(fw: Framework) -> bool:
+        """True when the profile's Bind step is exactly the DefaultBinder
+        (so a bulk store bind is semantically the same write).  The marker
+        must be defined by the plugin's own class: a subclass overriding
+        bind() would inherit the attribute but must NOT be bypassed."""
+        return (len(fw.bind) == 1
+                and type(fw.bind[0]).__dict__.get("is_default_binder", False))
+
+    def _binding_cycle_bulk(self, fw: Framework,
+                            items: list[tuple[CycleState, QueuedPodInfo, str, Obj]],
+                            cycle: int, start: float) -> None:
+        """Binding cycle for a whole batch: per-pod WaitOnPermit (immediate
+        for everything routed here) and PreBind, then ONE bulk bind write,
+        then per-pod PostBind/metrics/events.  Failure handling per pod is
+        identical to _binding_cycle (Forget + unreserve + requeue)."""
+        ready: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
+        for state, qpi, node_name, assumed in items:
+            try:
+                s = fw.wait_on_permit(qpi.pod_info)
+                if not is_success(s):
+                    self._bind_failure(fw, state, qpi, assumed, node_name, s,
+                                       cycle)
+                    continue
+                s = fw.run_pre_bind_plugins(state, qpi.pod_info, node_name)
+                if not is_success(s):
+                    self._bind_failure(fw, state, qpi, assumed, node_name, s,
+                                       cycle)
+                    continue
+                ready.append((state, qpi, node_name, assumed))
+            except Exception as e:  # pragma: no cover
+                logger.exception("bulk binding prep error for %s", qpi.key)
+                self._bind_failure(fw, state, qpi, assumed, node_name,
+                                   Status(ERROR, str(e)), cycle)
+        if not ready:
+            return
+        bindings = [(meta.namespace(q.pod), meta.name(q.pod), node)
+                    for _, q, node, _ in ready]
+        try:
+            results = self.client.bind_many(bindings)
+        except Exception as e:  # pragma: no cover
+            logger.exception("bulk bind failed")
+            results = [(None, e)] * len(ready)
+        for (state, qpi, node_name, assumed), (obj, err) in zip(ready, results):
+            if err is not None:
+                self._bind_failure(fw, state, qpi, assumed, node_name,
+                                   Status(ERROR, f"binding rejected: {err}"),
+                                   cycle)
+                continue
+            self.cache.finish_binding(assumed)
+            fw.run_post_bind_plugins(state, qpi.pod_info, node_name)
+            self.metrics.observe_attempt("scheduled", time.monotonic() - start,
+                                         fw.profile_name)
+            self.client.create_event(qpi.pod, "Scheduled",
+                                     f"Successfully assigned {qpi.key} to {node_name}")

@@ -240,6 +240,44 @@ class MemoryStore:
             self._emit(resource, DELETED, tomb)
             return tomb
 
+    def bind_many(self, resource: str,
+                  bindings: list[tuple[str, str, str]]
+                  ) -> list[tuple[Obj | None, StoreError | None]]:
+        """Bulk Binding write: one lock round trip for a whole TPU batch.
+
+        Each (namespace, name, node_name) entry follows BindingREST semantics
+        (pkg/registry/core/pod/storage — fail if the pod is already bound);
+        results are per-entry so one conflict doesn't poison the batch.  The
+        reference has no bulk verb (scheduler binds one pod per goroutine);
+        batched assignment makes the 1-write-per-pod pattern the bottleneck,
+        so the store grows a transactional multi-bind instead.
+        """
+        out: list[tuple[Obj | None, StoreError | None]] = []
+        with self._lock:
+            table = self._table(resource)
+            for ns, nm, node in bindings:
+                key = self._key(ns, nm)
+                cur = table.get(key)
+                if cur is None:
+                    out.append((None, NotFoundError(
+                        f"{resource} {key!r} not found")))
+                    continue
+                if (cur.get("spec") or {}).get("nodeName"):
+                    out.append((None, ConflictError(
+                        f"pod {key!r} is already bound to "
+                        f"{cur['spec']['nodeName']!r}")))
+                    continue
+                obj = meta.deep_copy(cur)
+                obj.setdefault("spec", {})["nodeName"] = node
+                conds = obj.setdefault("status", {}).setdefault("conditions", [])
+                conds.append({"type": "PodScheduled", "status": "True"})
+                self._rev += 1
+                meta.set_resource_version(obj, self._rev)
+                table[key] = obj
+                self._emit(resource, MODIFIED, obj)
+                out.append((obj, None))
+        return out
+
     def list(self, resource: str, namespace: str | None = None) -> tuple[list[Obj], int]:
         """GetList (etcd3/store.go:526): returns (items, list revision)."""
         with self._lock:
