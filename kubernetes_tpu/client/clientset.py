@@ -152,10 +152,17 @@ class Client:
                     q: "_q.Queue" = _q.Queue(maxsize=10_000)
 
                     def drain() -> None:
+                        # drain in chunks: one write per buffered burst keeps
+                        # event traffic off the scheduler's GIL/lock budget
                         while True:
-                            item = q.get()
+                            chunk = [q.get()]
                             try:
-                                self.create(EVENTS, item)
+                                while len(chunk) < 512:
+                                    chunk.append(q.get_nowait())
+                            except _q.Empty:
+                                pass
+                            try:
+                                self.create_events(chunk)
                             except kv.StoreError:
                                 pass
 
@@ -166,6 +173,15 @@ class Client:
             self._event_queue.put_nowait(ev)
         except _q.Full:
             pass  # queue full: drop (bounded broadcaster semantics)
+
+    def create_events(self, events: list[Obj]) -> None:
+        """Write a burst of Events. Generic clients write one by one;
+        LocalClient uses the store's bulk create."""
+        for ev in events:
+            try:
+                self.create(EVENTS, ev)
+            except kv.StoreError:
+                pass
 
 
 class LocalClient(Client):
@@ -199,3 +215,6 @@ class LocalClient(Client):
     def bind_many(self, bindings: list[tuple[str, str, str]]
                   ) -> list[tuple[Obj | None, Exception | None]]:
         return self.store.bind_many(PODS, bindings)
+
+    def create_events(self, events: list[Obj]) -> None:
+        self.store.create_many(EVENTS, events)

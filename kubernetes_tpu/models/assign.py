@@ -722,11 +722,15 @@ def _apply_patches(state: dict, prow, pval, caps: Caps):
 def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
                            weights: dict[str, float] | None = None,
                            features: frozenset = ALL_FEATURES):
-    """fn(state, static_node, buf) -> (new_state, assignments, waves).
+    """fn(state, static_node, buf) -> (new_state, result).
     `state` is device-resident and donated; `buf` is the single per-batch
-    upload produced by pack_pod_batch.  `features` selects a specialized
-    kernel variant (the backend keeps one per feature set and picks per
-    batch based on what the batch actually uses)."""
+    upload produced by pack_pod_batch.  `result` is int32[p_cap+1]:
+    assignments for each pod slot followed by the wave count in the last
+    element — one array so the host pulls the whole answer in ONE device
+    transfer (a second scalar pull costs a full tunnel round trip).
+    `features` selects a specialized kernel variant (the backend keeps one
+    per feature set and picks per batch based on what the batch actually
+    uses)."""
     spec = PackSpec(caps, p_cap, k_cap)
     core = _make_wave_core(caps, {"fit": 1.0, "balanced": 1.0, "spread": 2.0,
                                   "affinity": 1.0, "taint": 1.0,
@@ -739,6 +743,9 @@ def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
         state = _apply_patches(state, prow, pval, caps)
         out = core({**static_node, **state}, pod)
         new_state = {k: out[k] for k in STATE_KEYS}
-        return new_state, out["assignments"], out["waves"]
+        result = jnp.concatenate([
+            out["assignments"].astype(jnp.int32),
+            out["waves"].reshape(1).astype(jnp.int32)])
+        return new_state, result
 
     return fn, spec

@@ -102,12 +102,12 @@ class TPUBatchBackend(BatchBackend):
                 np.empty((0, self._spec.f_patch), np.float32)))
             # an all-invalid batch leaves the resident state numerically
             # unchanged, so running it through both variants is free
-            self._state, a, _ = self._fn(self._state, self._static_node, buf)
+            self._state, a = self._fn(self._state, self._static_node, buf)
             if self._fn_plain is None:
                 self._fn_plain, _ = build_packed_assign_fn(
                     self.caps, self.batch_size, self._k_cap, self._weights,
                     features=PLAIN_FEATURES)
-            self._state, a, _ = self._fn_plain(
+            self._state, a = self._fn_plain(
                 self._state, self._static_node, buf)
             np.asarray(a)  # block until the device round trip completes
 
@@ -228,13 +228,17 @@ class TPUBatchBackend(BatchBackend):
         dirty rows from this attempt are carried over so no external change
         is lost."""
         with self._lock:
-            dirty = set(self.tensors.update_from_snapshot_tracked(snapshot))
-            dirty |= self._carry_dirty
             try:
+                dirty = set(self.tensors.update_from_snapshot_tracked(snapshot))
+                dirty |= self._carry_dirty
                 batch = self.encoder.encode(list(pod_infos))
             except VocabFullError as e:
                 logger.warning("tensorization overflow (%s); batch -> oracle path", e)
-                self._carry_dirty = dirty
+                # the tracked update may have partially applied: drop the
+                # mirror-diff fast path and force a full dynamic refresh on
+                # the next successful dispatch
+                self._state = None
+                self._carry_dirty = set()
                 results = [(None, Status(SKIP, str(e)))] * len(pod_infos)
                 return lambda: results
 
@@ -267,7 +271,7 @@ class TPUBatchBackend(BatchBackend):
             buf = pack_pod_batch(batch, self._spec, patches[0], patches[1])
             import jax.numpy as jnp
             fn = self._pick_variant(batch)
-            self._state, assignments_dev, waves = fn(
+            self._state, result_dev = fn(
                 self._state, self._static_node, jnp.asarray(buf))
             self.stats["batches"] += 1
             holder = object()
@@ -277,8 +281,9 @@ class TPUBatchBackend(BatchBackend):
 
         def resolve() -> list[tuple[int | None, Status | None]]:
             with self._lock:
-                assignments = np.asarray(assignments_dev)  # blocks on device
-                self.stats["waves"] += int(waves)
+                result = np.asarray(result_dev)  # ONE blocking device pull
+                assignments = result[:-1]
+                self.stats["waves"] += int(result[-1])
                 self._replay(batch, assignments)
                 try:
                     self._unresolved.remove(holder)

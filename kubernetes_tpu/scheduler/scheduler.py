@@ -804,8 +804,16 @@ class Scheduler:
                                    Status(ERROR, f"binding rejected: {err}"),
                                    cycle)
                 continue
-            self.cache.finish_binding(assumed)
-            fw.run_post_bind_plugins(state, qpi.pod_info, node_name)
+            # the pod IS bound in the store at this point: a failure in the
+            # confirm/PostBind tail must not abort the rest of the batch or
+            # route an already-bound pod through _bind_failure (which would
+            # forget + requeue it)
+            try:
+                self.cache.finish_binding(assumed)
+                fw.run_post_bind_plugins(state, qpi.pod_info, node_name)
+            except Exception:
+                logger.exception("post-bind tail failed for %s (pod stays "
+                                 "bound to %s)", qpi.key, node_name)
             self.metrics.observe_attempt("scheduled", time.monotonic() - start,
                                          fw.profile_name)
             self.client.create_event(qpi.pod, "Scheduled",

@@ -179,6 +179,30 @@ class MemoryStore:
             self._emit(resource, ADDED, obj)
             return obj
 
+    def create_many(self, resource: str, objs: list[Obj]
+                    ) -> list[tuple[Obj | None, StoreError | None]]:
+        """Bulk create: one lock round trip, per-entry results.  Used by the
+        event broadcaster to flush its buffer without taking the store lock
+        once per event (the reference's EventBroadcaster batches through a
+        single sink goroutine; here the lock is the serialization point)."""
+        out: list[tuple[Obj | None, StoreError | None]] = []
+        with self._lock:
+            table = self._table(resource)
+            for obj in objs:
+                key = meta.namespaced_name(obj)
+                if key in table:
+                    out.append((None, AlreadyExistsError(
+                        f"{resource} {key!r} already exists")))
+                    continue
+                obj = meta.deep_copy(obj)
+                meta.finalize_new(obj)
+                self._rev += 1
+                meta.set_resource_version(obj, self._rev)
+                table[key] = obj
+                self._emit(resource, ADDED, obj)
+                out.append((obj, None))
+        return out
+
     def get(self, resource: str, namespace: str, name: str) -> Obj:
         with self._lock:
             table = self._table(resource)
