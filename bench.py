@@ -25,8 +25,8 @@ import time
 BASELINE_PODS_PER_SEC = 300.0
 
 N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
-N_PODS = int(os.environ.get("BENCH_PODS", "5000"))
-BATCH = int(os.environ.get("BENCH_BATCH", "1024"))
+N_PODS = int(os.environ.get("BENCH_PODS", "20000"))
+BATCH = int(os.environ.get("BENCH_BATCH", "2048"))
 
 
 def main() -> None:

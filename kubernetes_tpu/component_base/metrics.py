@@ -14,8 +14,9 @@ Thread-safe; hot-path observe() is a dict update under a per-metric lock.
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 ALPHA = "ALPHA"
 BETA = "BETA"
@@ -165,19 +166,26 @@ class Histogram(_Metric):
         super().__init__(name, help, labels, **kw)
         self.buckets = sorted(buckets if buckets is not None
                               else self.DEFAULT_BUCKETS)
-        # per label-key: (bucket counts list, sum, count)
-        self._series: Dict[Tuple[str, ...],
-                           Tuple[List[int], float, int]] = {}
+        # per label-key: mutable [per-bucket counts (NON-cumulative), sum, n].
+        # observe() is on the per-pod scheduling path, so it does one bisect
+        # + one increment; the cumulative form Prometheus exposes is computed
+        # at collect/quantile time instead.
+        self._series: Dict[Tuple[str, ...], List[Any]] = {}
 
     def observe(self, value: float, *label_values: str) -> None:
-        key = tuple(str(v) for v in label_values)
+        for v in label_values:
+            if type(v) is not str:
+                label_values = tuple(str(x) for x in label_values)
+                break
+        i = bisect.bisect_left(self.buckets, value)
         with self._lock:
-            counts, total, n = self._series.get(
-                key, ([0] * len(self.buckets), 0.0, 0))
-            for i, ub in enumerate(self.buckets):
-                if value <= ub:
-                    counts[i] += 1
-            self._series[key] = (counts, total + value, n + 1)
+            s = self._series.get(label_values)
+            if s is None:
+                s = self._series[label_values] = [[0] * len(self.buckets), 0.0, 0]
+            if i < len(self.buckets):
+                s[0][i] += 1
+            s[1] += value
+            s[2] += 1
 
     def labels(self, *label_values: str) -> "_BoundHistogram":
         return _BoundHistogram(self, tuple(str(v) for v in label_values))
@@ -199,10 +207,12 @@ class Histogram(_Metric):
             s = self._series.get(tuple(str(v) for v in label_values))
             if not s or s[2] == 0:
                 return 0.0
-            counts, _, n = s
+            counts, _, n = list(s[0]), s[1], s[2]
         target = q * n
+        cum = 0
         for i, ub in enumerate(self.buckets):
-            if counts[i] >= target:
+            cum += counts[i]
+            if cum >= target:
                 return ub
         return float("inf")
 
@@ -212,11 +222,13 @@ class Histogram(_Metric):
                            for k, (c, t, n) in self._series.items())
         out = self._header()
         for key, (counts, total, n) in items:
+            cum = 0
             for ub, c in zip(self.buckets, counts):
+                cum += c
                 out.append("%s_bucket%s %d" % (
                     self.name,
                     _fmt_labels(self.label_names + ("le",),
-                                key + (_fmt_value(ub),)), c))
+                                key + (_fmt_value(ub),)), cum))
             out.append("%s_bucket%s %d" % (
                 self.name,
                 _fmt_labels(self.label_names + ("le",), key + ("+Inf",)), n))
