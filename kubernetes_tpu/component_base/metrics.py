@@ -187,6 +187,27 @@ class Histogram(_Metric):
             s[1] += value
             s[2] += 1
 
+    def observe_many(self, values: Sequence[float], *label_values: str) -> None:
+        """Bulk observe under one lock (batch scheduling tail)."""
+        if not values:
+            return
+        for v in label_values:
+            if type(v) is not str:
+                label_values = tuple(str(x) for x in label_values)
+                break
+        nb = len(self.buckets)
+        with self._lock:
+            s = self._series.get(label_values)
+            if s is None:
+                s = self._series[label_values] = [[0] * nb, 0.0, 0]
+            counts = s[0]
+            for value in values:
+                i = bisect.bisect_left(self.buckets, value)
+                if i < nb:
+                    counts[i] += 1
+            s[1] += sum(values)
+            s[2] += len(values)
+
     def labels(self, *label_values: str) -> "_BoundHistogram":
         return _BoundHistogram(self, tuple(str(v) for v in label_values))
 

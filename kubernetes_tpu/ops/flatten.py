@@ -165,6 +165,7 @@ class ClusterTensors:
         self.row_of: dict[str, int] = {}
         self.node_infos: list[NodeInfo | None] = [None] * c.n_cap
         self.gen = np.zeros(c.n_cap, np.int64)
+        self.node_gen = np.full(c.n_cap, -1, np.int64)  # last static encode
         self._free = list(range(c.n_cap - 1, -1, -1))
         # static_version tracks arrays that rarely change (labels, taints,
         # alloc, domains); the device cache keys off it so binding a pod —
@@ -273,6 +274,7 @@ class ClusterTensors:
                 row = self.row_of.pop(name)
                 self.valid[row] = False
                 self.node_infos[row] = None
+                self.node_gen[row] = -1
                 self._free.append(row)
                 self.static_version += 1
                 dirty.append(row)
@@ -308,8 +310,17 @@ class ClusterTensors:
         for asg_idx in range(len(self.asgs)):
             self._encode_asg_row(asg_idx, row, ni)
 
-        # ---- static fields (labels/taints/alloc; compare before write so
-        # routine pod-bind dirtying never bumps static_version) ----
+        # ---- static fields (labels/taints/alloc) ----
+        # Binds dirty only dynamic fields; NodeInfo.node_generation advances
+        # only when the node OBJECT changed, so rows dirtied by pod traffic
+        # skip the static rebuild entirely (the dominant case: every batch
+        # dirties one row per bound pod).
+        if self.valid[row] and self.node_gen[row] == ni.node_generation:
+            return
+        # compare before write so routine no-op refreshes never bump
+        # static_version (a bump forces a multi-MB device re-upload);
+        # node_gen is recorded only after every fallible encode below
+        # succeeds, so a VocabFullError mid-encode retries next dispatch
         alloc_new = np.zeros(c.r, np.float32)
         self._encode_resource(alloc_new, ni.allocatable)
         taint_new = np.zeros(c.t_cap, np.float32)
@@ -353,6 +364,7 @@ class ClusterTensors:
             self.label_mask[row] = label_new
             self.key_mask[row] = key_new
             self.static_version += 1
+        self.node_gen[row] = ni.node_generation
 
     def _encode_sg_row(self, sg_idx: int, row: int, ni: NodeInfo) -> None:
         sg = self.sgs[sg_idx]

@@ -97,6 +97,33 @@ class Cache:
             self._pod_states[key] = ps
             self._assumed_pods.add(key)
 
+    def assume_pods(self, items: list[tuple[Obj, "PodInfo"]]
+                    ) -> list[str | None]:
+        """Bulk assume under ONE lock acquisition (batch tail hot path).
+
+        Each item is (assumed_pod, pod_info) where pod_info is a
+        clone_with_pod of the already-parsed PodInfo — skips both the
+        per-pod lock round trip and the PodInfo re-parse.  Returns one
+        error string (or None) per item, same order."""
+        errs: list[str | None] = []
+        with self._lock:
+            for pod, pi in items:
+                key = pi.key
+                if key in self._pod_states:
+                    errs.append(f"pod {key} already in cache")
+                    continue
+                node_name = meta.pod_node_name(pod)
+                if node_name:
+                    ni = self._nodes.get(node_name)
+                    if ni is None:
+                        ni = self._nodes[node_name] = NodeInfo()
+                    ni.add_pod(pi)
+                ps = _PodState(pod, assumed=True)
+                self._pod_states[key] = ps
+                self._assumed_pods.add(key)
+                errs.append(None)
+        return errs
+
     def finish_binding(self, pod: Obj) -> None:
         key = meta.namespaced_name(pod)
         with self._lock:
@@ -105,6 +132,17 @@ class Cache:
                 ps.binding_finished = True
                 if self._ttl > 0:
                     ps.deadline = time.monotonic() + self._ttl
+
+    def finish_bindings(self, pods: list[Obj]) -> None:
+        """Bulk finish_binding under one lock (batch bind tail)."""
+        with self._lock:
+            now = time.monotonic() if self._ttl > 0 else 0.0
+            for pod in pods:
+                ps = self._pod_states.get(meta.namespaced_name(pod))
+                if ps and ps.assumed:
+                    ps.binding_finished = True
+                    if self._ttl > 0:
+                        ps.deadline = now + self._ttl
 
     def forget_pod(self, pod: Obj) -> None:
         key = meta.namespaced_name(pod)

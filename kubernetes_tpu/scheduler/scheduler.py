@@ -76,6 +76,20 @@ class SchedulerMetrics:
         self.prom.schedule_attempts.inc(1.0, result, profile)
         self.prom.scheduling_attempt_duration.observe(latency, result, profile)
 
+    def observe_attempts(self, result: str, latencies: list[float],
+                         profile: str = "default-scheduler") -> None:
+        """Bulk observe (batch bind tail): one lock, one counter bump."""
+        if not latencies:
+            return
+        with self.lock:
+            self.schedule_attempts[result] = (
+                self.schedule_attempts.get(result, 0) + len(latencies))
+            self.scheduling_latency_sum += sum(latencies)
+            self.scheduling_latencies.extend(latencies)
+        self.prom.schedule_attempts.inc(float(len(latencies)), result, profile)
+        self.prom.scheduling_attempt_duration.observe_many(latencies, result,
+                                                           profile)
+
     def observe_preemption(self, victims: int) -> None:
         with self.lock:
             self.preemption_attempts += 1
@@ -701,6 +715,8 @@ class Scheduler:
         backend = profile.batch_backend
         results = resolve()
         bulk: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
+        # phase 1: collect placements; failures/escapes handled per pod
+        placed: list[tuple[QueuedPodInfo, str, Obj, PodInfo]] = []
         for qpi, (node_idx, s) in zip(live, results):
             if node_idx is None:
                 if s is not None and s.is_skip():
@@ -714,16 +730,23 @@ class Scheduler:
                                      {st.plugin} if st.plugin else set(), start)
                 continue
             node_name = backend.node_name(node_idx)
-            state = CycleState()
-            pod_info = qpi.pod_info
-            assumed = meta.deep_copy(pod_info.pod)
-            assumed["spec"]["nodeName"] = node_name
-            try:
-                self.cache.assume_pod(assumed)
-            except ValueError as e:
-                self._handle_failure(fw, qpi, Status(ERROR, str(e)), cycle,
+            pod = qpi.pod_info.pod
+            # 2-level shallow copy: only spec is replaced; nested values are
+            # never mutated in place (store reads hand out copies), so the
+            # deep copy the per-pod path does is pure overhead here
+            assumed = {**pod, "spec": {**(pod.get("spec") or {}),
+                                       "nodeName": node_name}}
+            placed.append((qpi, node_name, assumed,
+                           qpi.pod_info.clone_with_pod(assumed)))
+        # phase 2: ONE bulk assume (single cache lock for the whole batch)
+        errs = self.cache.assume_pods([(a, pi) for _, _, a, pi in placed])
+        for (qpi, node_name, assumed, _pi), err in zip(placed, errs):
+            if err is not None:
+                self._handle_failure(fw, qpi, Status(ERROR, err), cycle,
                                      set(), start)
                 continue
+            state = CycleState()
+            pod_info = qpi.pod_info
             st = fw.run_reserve_plugins(state, pod_info, node_name)
             if not is_success(st):
                 self.cache.forget_pod(assumed)
@@ -798,23 +821,29 @@ class Scheduler:
         except Exception as e:  # pragma: no cover
             logger.exception("bulk bind failed")
             results = [(None, e)] * len(ready)
+        bound: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         for (state, qpi, node_name, assumed), (obj, err) in zip(ready, results):
             if err is not None:
                 self._bind_failure(fw, state, qpi, assumed, node_name,
                                    Status(ERROR, f"binding rejected: {err}"),
                                    cycle)
                 continue
-            # the pod IS bound in the store at this point: a failure in the
-            # confirm/PostBind tail must not abort the rest of the batch or
-            # route an already-bound pod through _bind_failure (which would
-            # forget + requeue it)
+            bound.append((state, qpi, node_name, assumed))
+        if not bound:
+            return
+        # pods ARE bound in the store at this point: a failure in the
+        # confirm/PostBind tail must not abort the rest of the batch or
+        # route an already-bound pod through _bind_failure (which would
+        # forget + requeue it)
+        self.cache.finish_bindings([a for _, _, _, a in bound])
+        latency = time.monotonic() - start
+        for state, qpi, node_name, assumed in bound:
             try:
-                self.cache.finish_binding(assumed)
                 fw.run_post_bind_plugins(state, qpi.pod_info, node_name)
             except Exception:
                 logger.exception("post-bind tail failed for %s (pod stays "
                                  "bound to %s)", qpi.key, node_name)
-            self.metrics.observe_attempt("scheduled", time.monotonic() - start,
-                                         fw.profile_name)
             self.client.create_event(qpi.pod, "Scheduled",
                                      f"Successfully assigned {qpi.key} to {node_name}")
+        self.metrics.observe_attempts("scheduled", [latency] * len(bound),
+                                      fw.profile_name)

@@ -196,6 +196,19 @@ class PodInfo:
         self.host_ports = _collect_host_ports(spec)
         self.topology_spread_constraints = spec.get("topologySpreadConstraints") or []
 
+    def clone_with_pod(self, pod: Obj) -> "PodInfo":
+        """Copy of this PodInfo pointing at `pod` WITHOUT re-parsing.
+
+        For the assume path: the assumed object differs from the parsed one
+        only in spec.nodeName, which none of the precomputed attributes
+        derive from — re-running update() for every pod in a 2k batch is
+        pure overhead."""
+        c = PodInfo.__new__(PodInfo)
+        for slot in PodInfo.__slots__:
+            setattr(c, slot, getattr(self, slot))
+        c.pod = pod
+        return c
+
     def has_required_anti_affinity(self) -> bool:
         return bool(self.required_anti_affinity_terms)
 
@@ -258,7 +271,7 @@ class NodeInfo:
 
     __slots__ = ("node", "pods", "pods_with_affinity", "pods_with_required_anti_affinity",
                  "requested", "non_zero_requested", "allocatable", "used_ports",
-                 "image_sizes", "pvc_ref_counts", "generation")
+                 "image_sizes", "pvc_ref_counts", "generation", "node_generation")
 
     def __init__(self, node: Obj | None = None):
         self.node = node
@@ -272,6 +285,11 @@ class NodeInfo:
         self.image_sizes: dict[str, int] = {}
         self.pvc_ref_counts: dict[str, int] = {}
         self.generation = next(_generation)
+        # node_generation advances only when the node OBJECT changes (labels,
+        # taints, allocatable) — not on pod add/remove.  The TPU flattener
+        # keys its static-field re-encode off this, so routine binds touch
+        # only the dynamic arrays.
+        self.node_generation = self.generation
         if node is not None:
             for img in (node.get("status") or {}).get("images") or ():
                 size = img.get("sizeBytes", 0)
@@ -291,6 +309,7 @@ class NodeInfo:
             for name in img.get("names") or ():
                 self.image_sizes[name] = size
         self.generation = next(_generation)
+        self.node_generation = self.generation
 
     def add_pod(self, pi: PodInfo) -> None:
         self.pods.append(pi)
@@ -351,6 +370,7 @@ class NodeInfo:
         c.image_sizes = dict(self.image_sizes)
         c.pvc_ref_counts = dict(self.pvc_ref_counts)
         c.generation = self.generation
+        c.node_generation = self.node_generation
         return c
 
 

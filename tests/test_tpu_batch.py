@@ -307,3 +307,33 @@ class TestOracleParity:
                     for ni in snap2.list():
                         assert insufficient_resources(pi, ni), \
                             f"oracle would place {pi.key} on {ni.name} but batch refused"
+
+
+class TestStaticEncodeRetry:
+    def test_vocab_overflow_mid_encode_retries_static(self):
+        """A VocabFullError raised while re-encoding a node's static fields
+        must NOT mark the row up to date: the next update must retry the
+        static encode once the cause is gone (flatten.py node_gen ordering)."""
+        from kubernetes_tpu.ops.flatten import ClusterTensors, VocabFullError
+
+        t = ClusterTensors(small_caps(s_cap=1))
+        cache = Cache()
+        cache.add_node(make_node("n0").capacity(cpu="8").build())
+        snap = cache.update_snapshot(Snapshot())
+        t.update_from_snapshot(snap)
+
+        # node update adds TWO new scalar resources -> scalar vocab (cap 1)
+        # overflows mid-encode
+        cache.add_node(make_node("n0").capacity(
+            cpu="16", **{"example.com/a": "1", "example.com/b": "1"}).build())
+        snap = cache.update_snapshot(snap)
+        with pytest.raises(VocabFullError):
+            t.update_from_snapshot(snap)
+
+        # cause removed: node drops back to one scalar; the static encode
+        # must run again and pick up the new allocatable cpu
+        cache.add_node(make_node("n0").capacity(cpu="32").build())
+        snap = cache.update_snapshot(snap)
+        t.update_from_snapshot(snap)
+        row = t.row_of["n0"]
+        assert t.alloc[row, 0] == 32000.0
