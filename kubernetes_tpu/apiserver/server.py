@@ -135,10 +135,34 @@ class APIServer:
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "APIServer":
+        self.bootstrap_system()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         name="apiserver", daemon=True)
         self._thread.start()
         return self
+
+    def bootstrap_system(self) -> None:
+        """System namespaces + the kubernetes Service — what the reference's
+        controlplane bootstrap-controller materializes on startup
+        (pkg/controlplane/controller.go RunKubernetesNamespaces/
+        RunKubernetesService).  Idempotent; crash-only restart safe."""
+        for ns in ("default", "kube-system", "kube-public",
+                   "kube-node-lease"):
+            obj = meta.new_object("Namespace", ns, None)
+            obj["status"] = {"phase": "Active"}
+            try:
+                self.store.create("namespaces", obj)
+            except kv.AlreadyExistsError:
+                pass
+        svc = meta.new_object("Service", "kubernetes", "default")
+        svc["spec"] = {"type": "ClusterIP", "clusterIP": "10.96.0.1",
+                       "ports": [{"name": "https", "port": 443,
+                                  "protocol": "TCP",
+                                  "targetPort": self.port}]}
+        try:
+            self.store.create("services", svc)
+        except kv.AlreadyExistsError:
+            pass
 
     def stop(self) -> None:
         self.httpd.shutdown()

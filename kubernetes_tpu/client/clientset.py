@@ -51,6 +51,8 @@ SERVICEACCOUNTS = "serviceaccounts"
 LIMITRANGES = "limitranges"
 HPAS = "horizontalpodautoscalers"
 ENDPOINTSLICES = "endpointslices"
+CSRS = "certificatesigningrequests"
+VOLUMEATTACHMENTS = "volumeattachments"
 
 
 class Client:

@@ -13,15 +13,18 @@ import logging
 
 from ..api import meta
 from ..api.meta import Obj
-from ..client.clientset import DEPLOYMENTS, JOBS, PODS, REPLICASETS
+from ..client.clientset import (
+    DEPLOYMENTS, JOBS, PODS, PVCS, REPLICASETS, REPLICATIONCONTROLLERS,
+)
 from ..store import kv
 from .base import Controller, split_key
 
 logger = logging.getLogger(__name__)
 
 KIND_TO_RESOURCE = {"ReplicaSet": REPLICASETS, "Deployment": DEPLOYMENTS,
-                    "Job": JOBS, "Pod": PODS}
-WATCHED = [PODS, REPLICASETS, JOBS]
+                    "Job": JOBS, "Pod": PODS,
+                    "ReplicationController": REPLICATIONCONTROLLERS}
+WATCHED = [PODS, REPLICASETS, JOBS, PVCS]
 
 
 class GarbageCollector(Controller):
@@ -37,7 +40,9 @@ class GarbageCollector(Controller):
                 lambda t, obj, old, res=res: self.enqueue_key(
                     f"{res}|{meta.namespaced_name(obj)}"))
         # owner kinds we must watch for deletions to re-check dependents
-        for res in (REPLICASETS, DEPLOYMENTS, JOBS):
+        # (PODS is already in WATCHED; it owns ephemeral-volume PVCs)
+        for res in (REPLICASETS, DEPLOYMENTS, JOBS, REPLICATIONCONTROLLERS,
+                    PODS):
             factory.informer(res).add_event_handler(self._on_owner_event)
 
     def _on_owner_event(self, type_: str, obj: Obj, old) -> None:

@@ -27,16 +27,37 @@ from .replicaset import ReplicaSetController
 from .resourcequota import ResourceQuotaController
 from .serviceaccount import ServiceAccountController
 from .statefulset import StatefulSetController
+from .bootstrap import BootstrapSigner, TokenCleaner
+from .certificates import (
+    CSRApprovingController, CSRCleanerController, CSRSigningController,
+)
+from .endpointslice import EndpointSliceController
+from .nodeipam import NodeIpamController
+from .replication import ReplicationControllerController
+from .rootca import RootCACertPublisher
+from .ttl import TTLController
 from .ttlafterfinished import TTLAfterFinishedController
+from .volume import (
+    AttachDetachController, EphemeralVolumeController,
+    PersistentVolumeController, PVCProtectionController,
+    PVProtectionController,
+)
 
 logger = logging.getLogger(__name__)
 
-# startup list mirrors controllermanager.go:425-467 (the subset built)
+# startup list mirrors controllermanager.go:425-467; bootstrapsigner and
+# tokencleaner are registered but off by default, same as the reference
+# (controllermanager.go ControllersDisabledByDefault); nodeipam is gated on
+# --allocate-node-cidrs there, off by default here too
 DEFAULT_CONTROLLERS = ("deployment", "replicaset", "statefulset", "daemonset",
                        "job", "cronjob", "garbagecollector", "nodelifecycle",
                        "disruption", "namespace", "resourcequota",
                        "serviceaccount", "podgc", "ttlafterfinished",
-                       "horizontalpodautoscaler")
+                       "horizontalpodautoscaler", "endpointslice",
+                       "replicationcontroller", "csrapproving", "csrsigning",
+                       "csrcleaner", "ttl", "root-ca-cert-publisher",
+                       "persistentvolume-binder", "pvc-protection",
+                       "pv-protection", "attachdetach", "ephemeral-volume")
 
 
 class ControllerManager:
@@ -62,6 +83,22 @@ class ControllerManager:
             "podgc": PodGCController,
             "ttlafterfinished": TTLAfterFinishedController,
             "horizontalpodautoscaler": HorizontalPodAutoscaler,
+            "endpointslice": EndpointSliceController,
+            "replicationcontroller": ReplicationControllerController,
+            "csrapproving": CSRApprovingController,
+            "csrsigning": CSRSigningController,
+            "csrcleaner": CSRCleanerController,
+            "ttl": TTLController,
+            "root-ca-cert-publisher": RootCACertPublisher,
+            "persistentvolume-binder": PersistentVolumeController,
+            "pvc-protection": PVCProtectionController,
+            "pv-protection": PVProtectionController,
+            "attachdetach": AttachDetachController,
+            "ephemeral-volume": EphemeralVolumeController,
+            # registered but disabled by default (reference parity):
+            "nodeipam": NodeIpamController,
+            "tokencleaner": TokenCleaner,
+            "bootstrapsigner": BootstrapSigner,
         }
         for name in controllers:
             self.controllers[name] = ctors[name](client, factory)

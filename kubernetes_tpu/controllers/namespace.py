@@ -26,6 +26,7 @@ NAMESPACED_RESOURCES = (
     cs.JOBS, cs.CRONJOBS, cs.STATEFULSETS, cs.DAEMONSETS, cs.CONFIGMAPS,
     cs.SECRETS, cs.PVCS, cs.PDBS, cs.PODGROUPS, cs.RESOURCEQUOTAS,
     cs.SERVICEACCOUNTS, cs.LIMITRANGES, cs.HPAS, cs.LEASES, cs.EVENTS,
+    cs.ENDPOINTSLICES, cs.REPLICATIONCONTROLLERS,
 )
 
 

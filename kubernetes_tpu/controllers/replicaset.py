@@ -40,10 +40,12 @@ def pod_is_active(pod: Obj) -> bool:
 
 class ReplicaSetController(Controller):
     name = "replicaset"
+    kind = "ReplicaSet"          # controllerRef kind owned pods carry
+    resource = REPLICASETS       # status-update target resource
 
     def __init__(self, client, factory):
         super().__init__(client, factory)
-        self.rs_informer = factory.informer(REPLICASETS)
+        self.rs_informer = factory.informer(self.resource)
         self.pod_informer = factory.informer(PODS)
         self.expectations = Expectations()
         self.rs_informer.add_event_handler(
@@ -52,7 +54,7 @@ class ReplicaSetController(Controller):
 
     def _on_pod(self, type_: str, pod: Obj, old: Obj | None) -> None:
         ref = meta.controller_ref(pod)
-        if ref and ref.get("kind") == "ReplicaSet":
+        if ref and ref.get("kind") == self.kind:
             key = f"{meta.namespace(pod)}/{ref['name']}"
             if type_ == kv.ADDED:
                 self.expectations.creation_observed(key)
@@ -66,6 +68,7 @@ class ReplicaSetController(Controller):
         if rs is None:
             self.expectations.delete(key)
             return
+        rs = self._normalize(rs)
         spec = rs.get("spec") or {}
         want = spec.get("replicas", 1)
         selector = selector_from_dict(spec.get("selector") or {})
@@ -108,10 +111,14 @@ class ReplicaSetController(Controller):
                         raise
         self._update_status(rs, pods)
 
+    def _normalize(self, rs: Obj) -> Obj:
+        """Hook for subclasses reshaping the object before sync (RC)."""
+        return rs
+
     def _adopt(self, pod: Obj, rs: Obj) -> None:
         def patch(p):
             p["metadata"].setdefault("ownerReferences", []).append(
-                owner_ref(rs, "ReplicaSet"))
+                owner_ref(rs, self.kind))
             return p
         try:
             self.client.guaranteed_update(PODS, meta.namespace(pod),
@@ -130,7 +137,7 @@ class ReplicaSetController(Controller):
         pod["metadata"]["labels"] = dict(tmpl_meta.get("labels") or {})
         if tmpl_meta.get("annotations"):
             pod["metadata"]["annotations"] = dict(tmpl_meta["annotations"])
-        pod["metadata"]["ownerReferences"] = [owner_ref(rs, "ReplicaSet")]
+        pod["metadata"]["ownerReferences"] = [owner_ref(rs, self.kind)]
         pod["spec"] = meta.deep_copy(tmpl.get("spec") or {"containers": [
             {"name": "c0", "image": "img"}]})
         pod["spec"].setdefault("schedulerName", "default-scheduler")
@@ -152,7 +159,7 @@ class ReplicaSetController(Controller):
             o["status"] = status
             return o
         try:
-            self.client.guaranteed_update(REPLICASETS, meta.namespace(rs),
+            self.client.guaranteed_update(self.resource, meta.namespace(rs),
                                           meta.name(rs), patch)
         except kv.NotFoundError:
             pass

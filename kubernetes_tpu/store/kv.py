@@ -32,6 +32,7 @@ dominates end-to-end scheduling throughput.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Iterator
 
@@ -228,6 +229,13 @@ class MemoryStore:
             obj["metadata"].setdefault("creationTimestamp", meta.creation_timestamp(cur))
             self._rev += 1
             meta.set_resource_version(obj, self._rev)
+            # deleteWithoutFinalizers: stripping the last finalizer off a
+            # terminating object completes its deletion
+            if (obj["metadata"].get("deletionTimestamp")
+                    and not obj["metadata"].get("finalizers")):
+                del table[key]
+                self._emit(resource, DELETED, obj)
+                return obj
             table[key] = obj
             self._emit(resource, MODIFIED, obj)
             return obj
@@ -254,6 +262,21 @@ class MemoryStore:
             cur = table[key]
             if expect_rv is not None and expect_rv != meta.resource_version(cur):
                 raise ConflictError(f"{resource} {key!r}: stale delete")
+            # finalizer semantics (registry/generic/registry/store.go Delete):
+            # an object carrying finalizers is not removed — it gets a
+            # deletionTimestamp and stays until a controller strips the last
+            # finalizer (the update() path below then really deletes it)
+            if cur["metadata"].get("finalizers"):
+                if cur["metadata"].get("deletionTimestamp"):
+                    return cur  # already terminating
+                marked = dict(cur)
+                marked["metadata"] = dict(cur["metadata"])
+                marked["metadata"]["deletionTimestamp"] = time.time()
+                self._rev += 1
+                meta.set_resource_version(marked, self._rev)
+                table[key] = marked
+                self._emit(resource, MODIFIED, marked)
+                return marked
             del table[key]
             self._rev += 1
             # tombstone: shallow copy with fresh metadata (readers may still
