@@ -19,6 +19,9 @@ def main(argv=None) -> None:
     ap.add_argument("--nodes", type=int, default=3)
     ap.add_argument("--tpu-batch", action="store_true")
     ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--devices-per-node", type=int, default=0,
+                    help="give each hollow node N google.com/tpu devices "
+                         "(exercises the kubelet device/topology managers)")
     ap.add_argument("-v", "--verbosity", type=int, default=1)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
@@ -56,7 +59,21 @@ def main(argv=None) -> None:
     sched.run()
     mgr.run()
     endpoints.run()
-    kubelets = start_hollow_nodes(client, factory, args.nodes)
+    if args.devices_per_node > 0:
+        from ..kubelet import HollowKubelet
+        from ..kubelet.cm import ContainerManager, DevicePlugin
+        kubelets = []
+        num_numa = 2
+        for i in range(args.nodes):
+            cmgr = ContainerManager(num_cpus=32, memory_bytes=256 << 30,
+                                    num_numa=num_numa)
+            cmgr.devices.register(DevicePlugin("google.com/tpu", {
+                f"tpu{d}": d * num_numa // args.devices_per_node
+                for d in range(args.devices_per_node)}))
+            kubelets.append(HollowKubelet(client, factory, f"hollow-{i}",
+                                          container_manager=cmgr).start())
+    else:
+        kubelets = start_hollow_nodes(client, factory, args.nodes)
 
     print(f"cluster up: apiserver={server.url} nodes={args.nodes} "
           f"scheduler={'tpu-batch' if args.tpu_batch else 'per-pod'}")

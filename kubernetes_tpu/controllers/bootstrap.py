@@ -133,19 +133,28 @@ class BootstrapSigner(Controller):
             sigs[f"jws-kubeconfig-{tid}"] = base64.urlsafe_b64encode(
                 mac).decode("ascii")
 
-        desired = {"kubeconfig": self.kubeconfig, **sigs}
+        managed = {"kubeconfig": self.kubeconfig, **sigs}
+
+        def merge(data: dict) -> dict:
+            # only the kubeconfig + jws-* entries are ours; foreign keys
+            # are preserved (bootstrapsigner.go updates signatures in place)
+            out = {k: v for k, v in data.items()
+                   if not k.startswith("jws-kubeconfig-")}
+            out.update(managed)
+            return out
+
         cm = self.cm_informer.get(CLUSTER_INFO_NS, CLUSTER_INFO_NAME)
         if cm is None:
             obj = meta.new_object("ConfigMap", CLUSTER_INFO_NAME,
                                   CLUSTER_INFO_NS)
-            obj["data"] = desired
+            obj["data"] = dict(managed)
             try:
                 self.client.create(CONFIGMAPS, obj)
             except kv.AlreadyExistsError:
                 pass
-        elif (cm.get("data") or {}) != desired:
+        elif merge(cm.get("data") or {}) != (cm.get("data") or {}):
             def patch(o):
-                o["data"] = desired
+                o["data"] = merge(o.get("data") or {})
                 return o
             try:
                 self.client.guaranteed_update(CONFIGMAPS, CLUSTER_INFO_NS,

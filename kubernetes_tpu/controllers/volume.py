@@ -94,8 +94,16 @@ class PersistentVolumeController(Controller):
         self.pv_informer = factory.informer(PVS)
         self.sc_informer = factory.informer(STORAGECLASSES)
         self.pvc_informer.add_event_handler(self._on_claim)
-        self.pv_informer.add_event_handler(
-            lambda t, obj, old: self.enqueue_key("volume:" + meta.name(obj)))
+        self.pv_informer.add_event_handler(self._on_volume)
+
+    def _on_volume(self, type_, pv: Obj, old: Obj | None) -> None:
+        self.enqueue_key("volume:" + meta.name(pv))
+        # a PV appearing or becoming Available can satisfy waiting claims;
+        # with no periodic resync, this event is their only wake-up
+        if not (pv.get("spec") or {}).get("claimRef"):
+            for pvc in self.pvc_informer.list(None):
+                if not (pvc.get("spec") or {}).get("volumeName"):
+                    self.enqueue_key("claim:" + meta.namespaced_name(pvc))
 
     def _on_claim(self, type_, pvc: Obj, old: Obj | None) -> None:
         self.enqueue_key("claim:" + meta.namespaced_name(pvc))
@@ -173,6 +181,9 @@ class PersistentVolumeController(Controller):
             if won["bind"]:
                 self.client.guaranteed_update(PVCS, meta.namespace(pvc),
                                               meta.name(pvc), set_volume)
+            else:
+                # lost the PV to a racing claim: try again for another PV
+                self.enqueue_key("claim:" + meta.namespaced_name(pvc))
         except kv.NotFoundError:
             pass
 

@@ -33,13 +33,17 @@ class HollowKubelet:
                  node_name: str, cpu: str = "32", memory: str = "256Gi",
                  pods: int = 110, labels: dict[str, str] | None = None,
                  heartbeat_interval: float = 10.0,
-                 runtime: FakeRuntimeService | None = None):
+                 runtime: FakeRuntimeService | None = None,
+                 container_manager=None):
         self.client = client
         self.node_name = node_name
         self.cpu, self.memory, self.max_pods = cpu, memory, pods
         self.labels = labels or {}
         self.heartbeat_interval = heartbeat_interval
         self.runtime = runtime or FakeRuntimeService()
+        # optional cm.ContainerManager: runs resource admission (cpu/memory/
+        # device/topology managers) before containers start
+        self.container_manager = container_manager
         self.pod_informer = factory.informer(PODS)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -51,6 +55,15 @@ class HollowKubelet:
 
     def start(self) -> "HollowKubelet":
         self._register_node()
+        if self.container_manager is not None:
+            # reconcile checkpointed allocations against live pods: anything
+            # restored for a pod that vanished while we were down leaks
+            # forever otherwise (callers start the informer factory before
+            # kubelets, so the view is synced here)
+            live = {meta.uid(p) for p in self.pod_informer.list()
+                    if meta.pod_node_name(p) == self.node_name
+                    and not meta.pod_is_terminal(p)}
+            self.container_manager.reconcile(live)
         self.pod_informer.add_event_handler(self._on_pod_event)
         for target, name in ((self._heartbeat_loop, "heartbeat"),
                              (self._pleg_loop, "pleg")):
@@ -69,6 +82,11 @@ class HollowKubelet:
         rl = make_resource_list(
             cpu_milli=int(float(self.cpu) * 1000),
             mem=self._mem_bytes(), pods=self.max_pods)
+        if self.container_manager is not None:
+            # device plugins surface as scalar allocatable (devicemanager
+            # feeding nodestatus, e.g. google.com/tpu)
+            for res, n in self.container_manager.devices.allocatable().items():
+                rl[res] = str(n)
         node = meta.new_object("Node", self.node_name, None)
         node["metadata"]["labels"] = {
             "kubernetes.io/hostname": self.node_name, **self.labels}
@@ -120,13 +138,23 @@ class HollowKubelet:
             return
         if type_ == kv.DELETED or not mine:
             self._kill_pod(pod)
-        elif not meta.pod_is_terminal(pod):
+        elif meta.pod_is_terminal(pod):
+            # terminal pods keep their API object but give back their
+            # sandbox and resource-manager allocations (devicemanager
+            # reclaims terminated pods' devices via activePods)
+            self._kill_pod(pod)
+        else:
             self._sync_pod(pod)
 
     def _sync_pod(self, pod: Obj) -> None:
         """kuberuntime SyncPod (kuberuntime_manager.go:672): ensure sandbox,
         start missing containers, then report status."""
         uid = meta.uid(pod)
+        if self.container_manager is not None:
+            with self._lock:
+                new_pod = uid not in self._pod_state
+            if new_pod and not self._admit(pod):
+                return
         with self._lock:
             st = self._pod_state.get(uid)
             if st is None:
@@ -144,8 +172,30 @@ class HollowKubelet:
                 st["containers"][c["name"]] = cid
         self._report_status(pod)
 
+    def _admit(self, pod: Obj) -> bool:
+        """kubelet admission (HandlePodAdditions -> canAdmitPod): resource
+        managers allocate or the pod is failed with the admission reason."""
+        from .cm import AdmissionError
+        try:
+            self.container_manager.admit_pod(pod)
+            return True
+        except AdmissionError as e:
+            def patch(p):
+                p.setdefault("status", {}).update({
+                    "phase": "Failed", "reason": "UnexpectedAdmissionError",
+                    "message": str(e)})
+                return p
+            try:
+                self.client.guaranteed_update(PODS, meta.namespace(pod),
+                                              meta.name(pod), patch)
+            except kv.StoreError:
+                pass
+            return False
+
     def _kill_pod(self, pod: Obj) -> None:
         uid = meta.uid(pod)
+        if self.container_manager is not None:
+            self.container_manager.release_pod(uid)
         with self._lock:
             st = self._pod_state.pop(uid, None)
         if st:
@@ -183,6 +233,12 @@ class HollowKubelet:
         }
         try:
             def patch(p):
+                # terminal phases never regress (status_manager versioned
+                # updates): a stale Running report must not resurrect a
+                # pod that went Succeeded/Failed meanwhile
+                if (p.get("status") or {}).get("phase") in ("Succeeded",
+                                                            "Failed"):
+                    return p
                 p.setdefault("status", {}).update(status)
                 return p
             self.client.guaranteed_update(PODS, meta.namespace(pod),

@@ -376,3 +376,21 @@ class TestCascadeDeletion:
         assert wait_for(lambda: not any(
             meta.name(c) == "eph-pod-scratch"
             for c in client.list(PVCS, "default")[0]))
+
+
+class TestBinderWakeups:
+    def test_claim_created_before_pv_binds_when_pv_arrives(self, cluster):
+        _, client, _ = cluster
+        pvc = meta.new_object("PersistentVolumeClaim", "early-claim", "default")
+        pvc["spec"] = {"accessModes": ["ReadWriteOnce"],
+                       "resources": {"requests": {"storage": "1Gi"}}}
+        client.create(PVCS, pvc)
+        time.sleep(0.3)  # claim syncs with no PV available
+        pv = meta.new_object("PersistentVolume", "late-pv", None)
+        pv["spec"] = {"capacity": {"storage": "2Gi"},
+                      "accessModes": ["ReadWriteOnce"],
+                      "persistentVolumeReclaimPolicy": "Retain"}
+        client.create(PVS, pv)
+        assert wait_for(lambda: (client.get(PVCS, "default", "early-claim")
+                                 .get("spec") or {}).get("volumeName")
+                        == "late-pv")

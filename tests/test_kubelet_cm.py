@@ -1,0 +1,267 @@
+"""Container-manager layer: cpu/memory/device managers + topology manager.
+
+Behavioral contracts from pkg/kubelet/cm/{cpumanager,memorymanager,
+devicemanager,topologymanager}.
+"""
+
+import pytest
+
+from kubernetes_tpu.kubelet.cm import (
+    POLICY_STATIC, TOPOLOGY_RESTRICTED, TOPOLOGY_SINGLE_NUMA, AdmissionError,
+    ContainerManager, CPUManager, DeviceManager, DevicePlugin, MemoryManager,
+    TopologyHint, TopologyManager, merge_hints,
+)
+
+
+def guaranteed_pod(uid, cpu="2", memory="2Gi", extra_requests=None):
+    req = {"cpu": cpu, "memory": memory, **(extra_requests or {})}
+    return {"metadata": {"uid": uid, "name": uid},
+            "spec": {"containers": [{"name": "c0", "image": "img",
+                                     "resources": {"requests": dict(req),
+                                                   "limits": dict(req)}}]}}
+
+
+def burstable_pod(uid, cpu="2"):
+    return {"metadata": {"uid": uid, "name": uid},
+            "spec": {"containers": [{"name": "c0", "image": "img",
+                                     "resources": {"requests": {"cpu": cpu}}}]}}
+
+
+class TestCPUManager:
+    def test_exclusive_cores_for_guaranteed_integer(self):
+        m = CPUManager(num_cpus=8, reserved=1)
+        cores = m.allocate(guaranteed_pod("p1", cpu="2"))
+        assert len(cores) == 2 and all(c >= 1 for c in cores)
+        # second pod gets disjoint cores
+        cores2 = m.allocate(guaranteed_pod("p2", cpu="3"))
+        assert not set(cores) & set(cores2)
+        m.release("p1")
+        assert set(m.allocate(guaranteed_pod("p3", cpu="2"))) == set(cores)
+
+    def test_non_integer_or_burstable_stay_shared(self):
+        m = CPUManager(num_cpus=8)
+        assert m.allocate(guaranteed_pod("p1", cpu="1500m")) == []
+        assert m.allocate(burstable_pod("p2")) == []
+
+    def test_exhaustion_raises(self):
+        m = CPUManager(num_cpus=4, reserved=1)
+        m.allocate(guaranteed_pod("p1", cpu="3"))
+        with pytest.raises(AdmissionError):
+            m.allocate(guaranteed_pod("p2", cpu="1"))
+
+    def test_checkpoint_restore(self, tmp_path):
+        from kubernetes_tpu.kubelet.checkpoint import CheckpointManager
+        ck = CheckpointManager(str(tmp_path))
+        m = CPUManager(num_cpus=8, checkpoints=ck)
+        cores = m.allocate(guaranteed_pod("p1", cpu="2"))
+        m2 = CPUManager(num_cpus=8, checkpoints=ck)
+        assert m2.assignments["p1"] == cores
+
+
+class TestMemoryManager:
+    def test_numa_bank_allocation(self):
+        m = MemoryManager(numa_banks=[4 << 30, 4 << 30])
+        alloc = m.allocate(guaranteed_pod("p1", memory="3Gi"),
+                           TopologyHint(0b01, True))
+        assert alloc == {0: 3 << 30}
+        # spills across banks when one can't hold it
+        alloc2 = m.allocate(guaranteed_pod("p2", memory="4Gi"))
+        assert sum(alloc2.values()) == 4 << 30 and len(alloc2) == 2
+
+    def test_exhaustion(self):
+        m = MemoryManager(numa_banks=[2 << 30])
+        with pytest.raises(AdmissionError):
+            m.allocate(guaranteed_pod("p1", memory="3Gi"))
+
+
+class TestDeviceManager:
+    def _mgr(self):
+        m = DeviceManager()
+        m.register(DevicePlugin("google.com/tpu",
+                                {"tpu0": 0, "tpu1": 0, "tpu2": 1, "tpu3": 1}))
+        return m
+
+    def test_allocatable_and_allocate(self):
+        m = self._mgr()
+        assert m.allocatable() == {"google.com/tpu": 4}
+        pod = guaranteed_pod("p1", extra_requests={"google.com/tpu": "2"})
+        alloc = m.allocate(pod, TopologyHint(0b10, True))
+        assert alloc["google.com/tpu"] == ["tpu2", "tpu3"]  # NUMA-1 first
+        pod2 = guaranteed_pod("p2", extra_requests={"google.com/tpu": "3"})
+        with pytest.raises(AdmissionError):
+            m.allocate(pod2)
+
+    def test_hints_prefer_single_numa(self):
+        m = self._mgr()
+        pod = guaranteed_pod("p1", extra_requests={"google.com/tpu": "2"})
+        hints = m.hints(pod)
+        assert TopologyHint(0b01, True) in hints
+        assert TopologyHint(0b10, True) in hints
+        # 3 devices cannot come from one NUMA node: only the wide fallback
+        pod3 = guaranteed_pod("p3", extra_requests={"google.com/tpu": "3"})
+        hints3 = m.hints(pod3)
+        assert hints3 == [TopologyHint(0b11, False)]
+
+
+class TestTopologyManager:
+    def test_merge_prefers_narrow_preferred(self):
+        merged = merge_hints([[TopologyHint(0b01, True),
+                               TopologyHint(0b11, False)],
+                              [TopologyHint(0b01, True)]], 2)
+        assert merged == TopologyHint(0b01, True)
+
+    def test_restricted_rejects_unpreferred(self):
+        tm = TopologyManager(TOPOLOGY_RESTRICTED, num_numa=2)
+        with pytest.raises(AdmissionError):
+            tm.admit("p1", [[TopologyHint(0b11, False)]])
+
+    def test_single_numa_rejects_wide(self):
+        tm = TopologyManager(TOPOLOGY_SINGLE_NUMA, num_numa=2)
+        with pytest.raises(AdmissionError):
+            tm.admit("p1", [[TopologyHint(0b11, True)]])
+        assert tm.admit("p2", [[TopologyHint(0b10, True)]]).numa_mask == 0b10
+
+
+class TestContainerManager:
+    def test_admit_and_release_roundtrip(self, tmp_path):
+        cm = ContainerManager(num_cpus=8, memory_bytes=8 << 30, num_numa=2,
+                              topology_policy=TOPOLOGY_SINGLE_NUMA,
+                              checkpoint_dir=str(tmp_path))
+        cm.devices.register(DevicePlugin("google.com/tpu",
+                                         {"tpu0": 0, "tpu1": 1}))
+        pod = guaranteed_pod("p1", cpu="2", memory="2Gi",
+                             extra_requests={"google.com/tpu": "1"})
+        cm.admit_pod(pod)
+        assert cm.cpu.assignments["p1"]
+        assert cm.devices.allocations["p1"]["google.com/tpu"]
+        # everything the pod got sits on ONE numa node
+        numa = cm.topology.pod_hints["p1"].numa_mask
+        assert bin(numa).count("1") == 1
+        cm.release_pod("p1")
+        assert "p1" not in cm.cpu.assignments
+        assert "p1" not in cm.devices.allocations
+
+    def test_admission_failure_rolls_back(self):
+        cm = ContainerManager(num_cpus=4, memory_bytes=2 << 30)
+        # memory is the blocker; CPU allocation must be rolled back
+        pod = guaranteed_pod("p1", cpu="2", memory="4Gi")
+        with pytest.raises(AdmissionError):
+            cm.admit_pod(pod)
+        assert "p1" not in cm.cpu.assignments
+        assert "p1" not in cm.memory.assignments
+
+
+class TestKubeletAdmissionIntegration:
+    def test_hollow_kubelet_admits_and_fails_pods(self):
+        import time as _t
+
+        from kubernetes_tpu.api import meta
+        from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+        from kubernetes_tpu.client.clientset import PODS
+        from kubernetes_tpu.kubelet.hollow import HollowKubelet
+        from kubernetes_tpu.store import kv as kvs
+
+        def wait_for(pred, timeout=10.0):
+            deadline = _t.time() + timeout
+            while _t.time() < deadline:
+                if pred():
+                    return True
+                _t.sleep(0.02)
+            return False
+
+        store = kvs.MemoryStore()
+        client = LocalClient(store)
+        factory = SharedInformerFactory(client)
+        cm = ContainerManager(num_cpus=4, memory_bytes=8 << 30)
+        cm.devices.register(DevicePlugin("google.com/tpu", {"tpu0": 0}))
+        kubelet = HollowKubelet(client, factory, "cm-node",
+                                container_manager=cm)
+        factory.start()
+        factory.wait_for_cache_sync()
+        kubelet.start()
+        try:
+            # device allocatable surfaced on the node
+            node = client.get("nodes", "", "cm-node")
+            assert node["status"]["allocatable"]["google.com/tpu"] == "1"
+            ok = guaranteed_pod("ok-pod", cpu="2", memory="1Gi",
+                                extra_requests={"google.com/tpu": "1"})
+            ok["metadata"]["namespace"] = "default"
+            ok["spec"]["nodeName"] = "cm-node"
+            client.create(PODS, ok)
+            assert wait_for(lambda: (client.get(PODS, "default", "ok-pod")
+                                     .get("status") or {}).get("phase")
+                            == "Running")
+            assert cm.devices.allocations  # admitted through the cm
+            # second TPU pod must fail admission (only one chip)
+            bad = guaranteed_pod("bad-pod", cpu="1", memory="1Gi",
+                                 extra_requests={"google.com/tpu": "1"})
+            bad["metadata"]["namespace"] = "default"
+            bad["spec"]["nodeName"] = "cm-node"
+            client.create(PODS, bad)
+            assert wait_for(lambda: (client.get(PODS, "default", "bad-pod")
+                                     .get("status") or {}).get("reason")
+                            == "UnexpectedAdmissionError")
+            # deleting the good pod releases its devices
+            client.delete(PODS, "default", "ok-pod")
+            assert wait_for(lambda: not cm.devices.allocations)
+        finally:
+            kubelet.stop()
+            factory.stop()
+
+
+class TestTerminalReclaimAndReconcile:
+    def test_terminal_pod_releases_devices(self):
+        import time as _t
+
+        from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+        from kubernetes_tpu.client.clientset import PODS
+        from kubernetes_tpu.kubelet.hollow import HollowKubelet
+        from kubernetes_tpu.store import kv as kvs
+
+        store = kvs.MemoryStore()
+        client = LocalClient(store)
+        factory = SharedInformerFactory(client)
+        cm = ContainerManager(num_cpus=8, memory_bytes=8 << 30)
+        cm.devices.register(DevicePlugin("google.com/tpu", {"tpu0": 0}))
+        kubelet = HollowKubelet(client, factory, "t-node",
+                                container_manager=cm)
+        factory.start()
+        factory.wait_for_cache_sync()
+        kubelet.start()
+        try:
+            pod = guaranteed_pod("term-pod", cpu="1", memory="1Gi",
+                                 extra_requests={"google.com/tpu": "1"})
+            pod["metadata"]["namespace"] = "default"
+            pod["spec"]["nodeName"] = "t-node"
+            client.create(PODS, pod)
+            deadline = _t.time() + 10
+            while _t.time() < deadline and not cm.devices.allocations:
+                _t.sleep(0.02)
+            assert cm.devices.allocations
+            # pod turns terminal (NOT deleted): devices must come back
+            client.update_status(PODS, {**client.get(PODS, "default",
+                                                     "term-pod"),
+                                        "status": {"phase": "Succeeded"}})
+            deadline = _t.time() + 10
+            while _t.time() < deadline and cm.devices.allocations:
+                _t.sleep(0.02)
+            assert not cm.devices.allocations
+        finally:
+            kubelet.stop()
+            factory.stop()
+
+    def test_restart_reconciles_stale_checkpoint(self, tmp_path):
+        cm = ContainerManager(num_cpus=8, memory_bytes=8 << 30,
+                              checkpoint_dir=str(tmp_path))
+        cm.devices.register(DevicePlugin("google.com/tpu", {"tpu0": 0}))
+        cm.admit_pod(guaranteed_pod("ghost", cpu="1", memory="1Gi",
+                                    extra_requests={"google.com/tpu": "1"}))
+        # simulated restart: fresh managers restore the checkpoint...
+        cm2 = ContainerManager(num_cpus=8, memory_bytes=8 << 30,
+                               checkpoint_dir=str(tmp_path))
+        cm2.devices.register(DevicePlugin("google.com/tpu", {"tpu0": 0}))
+        assert "ghost" in cm2.devices.allocations
+        # ...and reconcile against live pods (ghost vanished meanwhile)
+        cm2.reconcile(set())
+        assert "ghost" not in cm2.devices.allocations
+        assert "ghost" not in cm2.cpu.assignments
