@@ -50,7 +50,9 @@ from . import patch as patchlib
 logger = logging.getLogger(__name__)
 
 CLUSTER_SCOPED = {"nodes", "persistentvolumes", "namespaces", "priorityclasses",
-                  "storageclasses", "csinodes", crdlib.CRDS}
+                  "storageclasses", "csinodes", crdlib.CRDS,
+                  "certificatesigningrequests", "volumeattachments",
+                  "apiservices"}
 
 SUBRESOURCES = {"status", "binding", "eviction", "scale"}
 
@@ -61,10 +63,13 @@ BUILTIN_GROUPS = {
     "batch": {"jobs", "cronjobs"},
     "policy": {"poddisruptionbudgets"},
     "scheduling.k8s.io": {"priorityclasses"},
-    "storage.k8s.io": {"storageclasses", "csinodes"},
+    "storage.k8s.io": {"storageclasses", "csinodes", "volumeattachments"},
     "coordination.k8s.io": {"leases"},
     "apiextensions.k8s.io": {crdlib.CRDS},
     "autoscaling": {"horizontalpodautoscalers"},
+    "certificates.k8s.io": {"certificatesigningrequests"},
+    "discovery.k8s.io": {"endpointslices"},
+    "apiregistration.k8s.io": {"apiservices"},
 }
 
 SCALABLE = {"deployments", "replicasets", "statefulsets",
@@ -112,6 +117,8 @@ class APIServer:
         self.flow = flow_dispatcher  # None = APF filter disabled
         self.audit = audit_logger
         self.crds = crdlib.CRDRegistry()
+        from . import aggregator as agglib
+        self.aggregator = agglib.AggregatorRegistry(store)
         self.metrics = {"requests_total": 0, "watch_streams": 0,
                         "requests_rejected_total": 0}
         self._metrics_lock = threading.Lock()
@@ -165,6 +172,7 @@ class APIServer:
             pass
 
     def stop(self) -> None:
+        self.aggregator.stop()
         self.httpd.shutdown()
 
     @property
@@ -205,6 +213,42 @@ class APIServer:
                 self._send_json(401, status_error(401, "Unauthorized",
                                                   "invalid bearer token"))
                 return False
+
+            def _maybe_proxy(self) -> bool:
+                """Aggregation layer (kube-aggregator handler_proxy.go):
+                requests for an /apis/<group>/<version> registered to an
+                external APIService are proxied to its backend.  Runs after
+                authn/APF (same chain position as the reference)."""
+                from . import aggregator as agglib
+                u = urlparse(self.path)
+                parts = [p for p in u.path.split("/") if p]
+                if len(parts) < 3 or parts[0] != "apis":
+                    return False
+                if server.aggregator.backend_for(parts[1], parts[2]) is None:
+                    return False
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else None
+                res = server.aggregator.proxy(
+                    self.command, u.path, u.query, body, dict(self.headers))
+                if res is None:
+                    # APIService deleted between the backend_for check and
+                    # the proxy call; the body is consumed, so answer
+                    # directly instead of falling through to local routing
+                    self._send_json(404, status_error(
+                        404, "NotFound", u.path))
+                    return True
+                status, hdrs, payload = res
+                self.send_response(status)
+                for k, v in hdrs.items():
+                    if k.lower() not in agglib.HOP_HEADERS:
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                try:
+                    self.wfile.write(payload)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                return True
 
             def _route(self) -> _Route | None:
                 u = urlparse(self.path)
@@ -290,6 +334,8 @@ class APIServer:
                     return
                 r, ticket = begun
                 try:
+                    if self._maybe_proxy():
+                        return
                     self._do_get(r)
                 finally:
                     if ticket:
@@ -452,6 +498,8 @@ class APIServer:
                     return
                 r, ticket = begun
                 try:
+                    if self._maybe_proxy():
+                        return
                     self._do_post(r)
                 finally:
                     if ticket:
@@ -563,6 +611,8 @@ class APIServer:
                     return
                 r, ticket = begun
                 try:
+                    if self._maybe_proxy():
+                        return
                     self._do_put(r)
                 finally:
                     if ticket:
@@ -625,6 +675,8 @@ class APIServer:
                     return
                 r, ticket = begun
                 try:
+                    if self._maybe_proxy():
+                        return
                     self._do_patch(r)
                 finally:
                     if ticket:
@@ -687,6 +739,8 @@ class APIServer:
                     return
                 r, ticket = begun
                 try:
+                    if self._maybe_proxy():
+                        return
                     self._do_delete(r)
                 finally:
                     if ticket:

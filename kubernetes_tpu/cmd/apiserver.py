@@ -13,6 +13,9 @@ def main(argv=None) -> None:
     ap.add_argument("--bind-address", default="127.0.0.1")
     ap.add_argument("--secure-port", type=int, default=8080)
     ap.add_argument("--token", default=None, help="static bearer token authn")
+    ap.add_argument("--encrypt-secrets", action="store_true",
+                    help="KMS envelope encryption of Secrets at rest "
+                         "(EncryptionConfiguration kms provider equivalent)")
     ap.add_argument("-v", "--verbosity", type=int, default=1)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
@@ -20,7 +23,11 @@ def main(argv=None) -> None:
     from ..apiserver import APIServer
     from ..store import kv
 
-    store = kv.MemoryStore(history=1_000_000)
+    transformers = None
+    if args.encrypt_secrets:
+        from ..store.encryption import EnvelopeTransformer, LocalKMS
+        transformers = {"secrets": EnvelopeTransformer(LocalKMS())}
+    store = kv.MemoryStore(history=1_000_000, transformers=transformers)
     server = APIServer(store, host=args.bind_address, port=args.secure_port,
                        token=args.token).start()
     print(f"apiserver listening on {server.url}")

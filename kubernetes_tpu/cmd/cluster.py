@@ -80,6 +80,8 @@ def main(argv=None) -> None:
     print(f"try: python -m kubernetes_tpu.cli.kubectl --server {server.url} "
           f"get nodes")
     stop = threading.Event()
+    from ..scheduler.debugger import CacheDebugger
+    CacheDebugger(sched, client).listen_for_signal()  # SIGUSR2 dump+compare
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()

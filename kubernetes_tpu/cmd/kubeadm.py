@@ -1,0 +1,179 @@
+"""Cluster bootstrap phases (the kubeadm-equivalent).
+
+Reference: cmd/kubeadm — `init` runs an ordered phase list (preflight,
+certs, control-plane, upload-config, bootstrap-token; app/phases/),
+prints the join command; `join` validates the token against the
+cluster-info ConfigMap's JWS signature (app/phases/bootstraptoken) and
+registers the node.
+
+Mapped to this stack: `init` starts the in-process control plane
+(apiserver + scheduler + controller-manager incl. bootstrapsigner),
+mints a bootstrap token Secret, uploads the kubeadm-config ConfigMap and
+prints the join line.  `join --token` fetches kube-public/cluster-info
+WITHOUT credentials, verifies the HMAC signature with the token secret
+(the trust bootstrap), then registers a hollow node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import hashlib
+import hmac
+import json
+import logging
+import secrets as pysecrets
+import signal
+import threading
+import time
+import urllib.request
+
+logger = logging.getLogger(__name__)
+
+PHASES_INIT = ("preflight", "certs", "control-plane", "upload-config",
+               "bootstrap-token")
+
+
+def _phase(name: str, msg: str) -> None:
+    print(f"[{name}] {msg}")
+
+
+def init(args) -> None:
+    from ..apiserver import APIServer
+    from ..client.clientset import CONFIGMAPS, SECRETS, LocalClient
+    from ..client.informer import SharedInformerFactory
+    from ..controllers import ControllerManager
+    from ..controllers.bootstrap import BOOTSTRAP_TOKEN_TYPE, BootstrapSigner
+    from ..api import meta
+    from ..controllers.certificates import ClusterCA
+    from ..scheduler import Profile, Scheduler, new_default_framework
+    from ..store import kv
+
+    # preflight (app/preflight/checks.go: port availability &c.)
+    _phase("preflight", "running pre-flight checks")
+    import socket
+    with socket.socket() as s:
+        if s.connect_ex(("127.0.0.1", args.secure_port)) == 0:
+            raise SystemExit(
+                f"[preflight] port {args.secure_port} already in use")
+
+    _phase("certs", "generating cluster CA")
+    ClusterCA.shared()  # materialized here; published by root-ca controller
+
+    _phase("control-plane", "starting apiserver, scheduler, "
+           "controller-manager")
+    store = kv.MemoryStore(history=1_000_000)
+    server = APIServer(store, port=args.secure_port).start()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    fw = new_default_framework(client, factory)
+    sched = Scheduler(client, factory, {"default-scheduler": Profile(fw)})
+    mgr = ControllerManager(client, factory)
+    signer = BootstrapSigner(client, factory)
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    mgr.run()
+    signer.run()
+
+    _phase("upload-config", "storing kubeadm-config ConfigMap")
+    cfg = meta.new_object("ConfigMap", "kubeadm-config", "kube-system")
+    cfg["data"] = {"ClusterConfiguration": json.dumps(
+        {"kubernetesVersion": "tpu", "controlPlaneEndpoint": server.url})}
+    try:
+        client.create(CONFIGMAPS, cfg)
+    except kv.AlreadyExistsError:
+        pass
+
+    _phase("bootstrap-token", "creating bootstrap token")
+    token_id = pysecrets.token_hex(3)
+    token_secret = pysecrets.token_hex(8)
+    tok = meta.new_object("Secret", f"bootstrap-token-{token_id}",
+                          "kube-system")
+    tok["type"] = BOOTSTRAP_TOKEN_TYPE
+    tok["data"] = {"token-id": token_id, "token-secret": token_secret,
+                   "expiration": str(time.time() + 24 * 3600),
+                   "usage-bootstrap-authentication": "true"}
+    client.create(SECRETS, tok)
+
+    print()
+    print("Your control plane initialized successfully!")
+    print("To join a node run:\n")
+    print(f"  python -m kubernetes_tpu.cmd.kubeadm join "
+          f"--server {server.url} --token {token_id}.{token_secret}\n")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    signer.stop()
+    mgr.stop()
+    sched.stop()
+    factory.stop()
+    server.stop()
+
+
+def join(args) -> None:
+    from ..client.http_client import HTTPClient
+    from ..client.informer import SharedInformerFactory
+    from ..kubelet import HollowKubelet
+
+    token_id, _, token_secret = args.token.partition(".")
+    if not token_id or not token_secret:
+        raise SystemExit("token must be <id>.<secret>")
+
+    # discovery (bootstraptoken/clusterinfo): UNAUTHENTICATED fetch of
+    # cluster-info; trust is established by verifying the JWS/HMAC made
+    # with the shared token secret
+    _phase("discovery", f"fetching cluster-info from {args.server}")
+    url = (f"{args.server}/api/v1/namespaces/kube-public/"
+           f"configmaps/cluster-info")
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        info = json.loads(resp.read())
+    data = info.get("data") or {}
+    sig = data.get(f"jws-kubeconfig-{token_id}")
+    if sig is None:
+        raise SystemExit(f"[discovery] no signature for token id {token_id} "
+                         "in cluster-info (token unknown or expired)")
+    kubeconfig = data.get("kubeconfig", "")
+    want = base64.urlsafe_b64encode(hmac.new(
+        token_secret.encode(), kubeconfig.encode(),
+        hashlib.sha256).digest()).decode("ascii")
+    if not hmac.compare_digest(want, sig):
+        raise SystemExit("[discovery] cluster-info signature mismatch "
+                         "(wrong token secret)")
+    _phase("discovery", "cluster-info signature verified")
+
+    _phase("kubelet-start", f"registering node {args.node_name}")
+    client = HTTPClient.from_url(args.server)
+    factory = SharedInformerFactory(client)
+    kubelet = HollowKubelet(client, factory, args.node_name)
+    factory.start()
+    factory.wait_for_cache_sync()
+    kubelet.start()
+    print(f"\nNode {args.node_name} joined the cluster.")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    kubelet.stop()
+    factory.stop()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="tpu-kubeadm")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ini = sub.add_parser("init", help="bootstrap a control plane")
+    ini.add_argument("--secure-port", type=int, default=8080)
+    ini.set_defaults(fn=init)
+    jn = sub.add_parser("join", help="join a node using a bootstrap token")
+    jn.add_argument("--server", required=True)
+    jn.add_argument("--token", required=True, help="<id>.<secret>")
+    jn.add_argument("--node-name", default=f"node-{pysecrets.token_hex(3)}")
+    jn.set_defaults(fn=join)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,8 @@ def main(argv=None) -> None:
         sched.run()
     print("scheduler running"
           + (" (tpu-batch profile)" if args.tpu_batch else " (per-pod)"))
+    from ..scheduler.debugger import CacheDebugger
+    CacheDebugger(sched, client).listen_for_signal()  # SIGUSR2 dump+compare
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()

@@ -305,6 +305,13 @@ class Cache:
                     pvc for ni in snapshot.node_info_list for pvc in ni.pvc_ref_counts}
             return snapshot
 
+    def comparison_snapshot(self) -> tuple[set[str], set[str], set[str]]:
+        """(node names, pod keys, assumed pod keys) under one lock — the
+        comparer's view (internal/cache/debugger/comparer.go)."""
+        with self._lock:
+            return ({n for n, ni in self._nodes.items() if ni.node is not None},
+                    set(self._pod_states), set(self._assumed_pods))
+
     def dump(self) -> dict:
         """Debug dump (internal/cache/debugger semantics)."""
         with self._lock:

@@ -123,7 +123,7 @@ class Watch:
 class MemoryStore:
     """The cluster store. One instance == one 'etcd'."""
 
-    def __init__(self, history: int = 100_000):
+    def __init__(self, history: int = 100_000, transformers: dict | None = None):
         self._lock = threading.RLock()
         self._rev = 0
         # resource -> {"ns/name": obj}
@@ -133,11 +133,24 @@ class MemoryStore:
         self._history_len = history
         # resource -> oldest revision still in history (compaction floor)
         self._watchers: dict[str, list[Watch]] = {}
+        # resource -> EnvelopeTransformer (encryption.py): values of these
+        # resources are sealed AT REST in the table; reads/watches serve
+        # plaintext (the watch ring is a serving cache, like the reference's
+        # cacher, and holds decrypted objects — at-rest covers the table)
+        self._transformers = dict(transformers or {})
 
     # -- internals -------------------------------------------------------
 
     def _table(self, resource: str) -> dict[str, Obj]:
         return self._data.setdefault(resource, {})
+
+    def _seal(self, resource: str, obj: Obj) -> Obj:
+        t = self._transformers.get(resource)
+        return t.encrypt_obj(obj) if t is not None else obj
+
+    def _open(self, resource: str, stored: Obj) -> Obj:
+        t = self._transformers.get(resource)
+        return t.decrypt_obj(stored) if t is not None else stored
 
     def _emit(self, resource: str, type_: str, obj: Obj) -> None:
         ev = WatchEvent(type_, obj, self._rev)
@@ -176,7 +189,7 @@ class MemoryStore:
             meta.finalize_new(obj)
             self._rev += 1
             meta.set_resource_version(obj, self._rev)
-            table[key] = obj
+            table[key] = self._seal(resource, obj)
             self._emit(resource, ADDED, obj)
             return obj
 
@@ -199,7 +212,7 @@ class MemoryStore:
                 meta.finalize_new(obj)
                 self._rev += 1
                 meta.set_resource_version(obj, self._rev)
-                table[key] = obj
+                table[key] = self._seal(resource, obj)
                 self._emit(resource, ADDED, obj)
                 out.append((obj, None))
         return out
@@ -210,7 +223,7 @@ class MemoryStore:
             key = self._key(namespace, name)
             if key not in table:
                 raise NotFoundError(f"{resource} {key!r} not found")
-            return table[key]
+            return self._open(resource, table[key])
 
     def update(self, resource: str, obj: Obj, expect_rv: int | None = None) -> Obj:
         """CAS update: expect_rv defaults to the object's own resourceVersion."""
@@ -236,7 +249,7 @@ class MemoryStore:
                 del table[key]
                 self._emit(resource, DELETED, obj)
                 return obj
-            table[key] = obj
+            table[key] = self._seal(resource, obj)
             self._emit(resource, MODIFIED, obj)
             return obj
 
@@ -268,20 +281,20 @@ class MemoryStore:
             # finalizer (the update() path below then really deletes it)
             if cur["metadata"].get("finalizers"):
                 if cur["metadata"].get("deletionTimestamp"):
-                    return cur  # already terminating
-                marked = dict(cur)
+                    return self._open(resource, cur)  # already terminating
+                marked = dict(self._open(resource, cur))
                 marked["metadata"] = dict(cur["metadata"])
                 marked["metadata"]["deletionTimestamp"] = time.time()
                 self._rev += 1
                 meta.set_resource_version(marked, self._rev)
-                table[key] = marked
+                table[key] = self._seal(resource, marked)
                 self._emit(resource, MODIFIED, marked)
                 return marked
             del table[key]
             self._rev += 1
             # tombstone: shallow copy with fresh metadata (readers may still
             # hold the stored object; never mutate it in place)
-            tomb = dict(cur)
+            tomb = dict(self._open(resource, cur))
             tomb["metadata"] = dict(cur["metadata"])
             meta.set_resource_version(tomb, self._rev)
             self._emit(resource, DELETED, tomb)
@@ -309,6 +322,7 @@ class MemoryStore:
                     out.append((None, NotFoundError(
                         f"{resource} {key!r} not found")))
                     continue
+                cur = self._open(resource, cur)
                 if (cur.get("spec") or {}).get("nodeName"):
                     out.append((None, ConflictError(
                         f"pod {key!r} is already bound to "
@@ -320,7 +334,7 @@ class MemoryStore:
                 conds.append({"type": "PodScheduled", "status": "True"})
                 self._rev += 1
                 meta.set_resource_version(obj, self._rev)
-                table[key] = obj
+                table[key] = self._seal(resource, obj)
                 self._emit(resource, MODIFIED, obj)
                 out.append((obj, None))
         return out
@@ -329,11 +343,14 @@ class MemoryStore:
         """GetList (etcd3/store.go:526): returns (items, list revision)."""
         with self._lock:
             table = self._table(resource)
+            t = self._transformers.get(resource)
             if namespace:
                 prefix = namespace + "/"
                 items = [o for k, o in table.items() if k.startswith(prefix)]
             else:
                 items = list(table.values())
+            if t is not None:  # decrypt only transformed resources
+                items = [t.decrypt_obj(o) for o in items]
             return items, self._rev
 
     def count(self, resource: str) -> int:

@@ -1,0 +1,201 @@
+"""Aggregation layer (APIService proxying) + KMS envelope encryption.
+
+Behavioral contracts from staging/src/k8s.io/kube-aggregator and
+staging/src/k8s.io/kms + apiserver/pkg/storage/value/encrypt/envelope.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver.aggregator import APISERVICES
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.store.encryption import (
+    ENVELOPE_KEY, DecryptError, EnvelopeTransformer, LocalKMS,
+)
+
+
+def http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class _EchoBackend:
+    """Stand-in aggregated apiserver: echoes method+path as JSON."""
+
+    def __init__(self):
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _serve(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length).decode() if length else ""
+                payload = json.dumps({"backend": True,
+                                      "method": self.command,
+                                      "path": self.path,
+                                      "body": body}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _serve
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+class TestAggregator:
+    def test_apiservice_routes_to_backend(self):
+        store = kv.MemoryStore()
+        server = APIServer(store).start()
+        backend = _EchoBackend()
+        try:
+            apisvc = meta.new_object("APIService",
+                                     "v1beta1.metrics.example.com", None)
+            apisvc["spec"] = {"group": "metrics.example.com",
+                              "version": "v1beta1",
+                              "service": {"url": backend.url}}
+            code, _ = http("POST", f"{server.url}/apis/apiregistration.k8s.io"
+                           "/v1/apiservices", apisvc)
+            assert code in (200, 201)
+            time.sleep(0.6)  # registry watch applies the route
+
+            code, body = http("GET", f"{server.url}/apis/metrics.example.com"
+                              "/v1beta1/nodes")
+            assert code == 200 and body["backend"] is True
+            assert body["path"].endswith("/v1beta1/nodes")
+            # unregistered group still served locally
+            code, body = http("GET", f"{server.url}/apis/apps/v1/deployments")
+            assert code == 200 and "items" in body
+        finally:
+            backend.stop()
+            server.stop()
+
+    def test_unreachable_backend_returns_503(self):
+        store = kv.MemoryStore()
+        server = APIServer(store).start()
+        try:
+            apisvc = meta.new_object("APIService", "v1.dead.example.com", None)
+            apisvc["spec"] = {"group": "dead.example.com", "version": "v1",
+                              "service": {"url": "http://127.0.0.1:1"}}
+            store.create(APISERVICES, apisvc)
+            time.sleep(0.6)
+            code, body = http("GET",
+                              f"{server.url}/apis/dead.example.com/v1/things")
+            assert code == 503
+            assert body["reason"] == "ServiceUnavailable"
+        finally:
+            server.stop()
+
+
+class TestEnvelopeEncryption:
+    def _store(self):
+        kms = LocalKMS()
+        t = EnvelopeTransformer(kms)
+        return kv.MemoryStore(transformers={"secrets": t}), kms, t
+
+    def test_secrets_sealed_at_rest_plain_on_read(self):
+        store, kms, t = self._store()
+        s = meta.new_object("Secret", "db-pass", "default")
+        s["data"] = {"password": "hunter2"}
+        store.create("secrets", s)
+        # at rest: envelope, no plaintext
+        raw = store._data["secrets"]["default/db-pass"]
+        assert ENVELOPE_KEY in raw and "data" not in raw
+        assert "hunter2" not in json.dumps(raw)
+        # reads serve plaintext
+        got = store.get("secrets", "default", "db-pass")
+        assert got["data"]["password"] == "hunter2"
+        items, _ = store.list("secrets", "default")
+        assert items[0]["data"]["password"] == "hunter2"
+        # other resources untouched
+        cm = meta.new_object("ConfigMap", "plain", "default")
+        cm["data"] = {"k": "v"}
+        store.create("configmaps", cm)
+        assert "data" in store._data["configmaps"]["default/plain"]
+
+    def test_update_and_watch_roundtrip(self):
+        store, kms, t = self._store()
+        s = meta.new_object("Secret", "tok", "default")
+        s["data"] = {"t": "one"}
+        store.create("secrets", s)
+        w = store.watch("secrets", since_rv=0)
+        ev = w.next(timeout=1)
+        assert ev.type == kv.ADDED and ev.object["data"]["t"] == "one"
+
+        def bump(o):
+            o["data"]["t"] = "two"
+            return o
+        store.guaranteed_update("secrets", "default", "tok", bump)
+        ev = w.next(timeout=1)
+        assert ev.type == kv.MODIFIED and ev.object["data"]["t"] == "two"
+        assert store.get("secrets", "default", "tok")["data"]["t"] == "two"
+        w.stop()
+
+    def test_key_rotation_keeps_old_data_readable(self):
+        store, kms, t = self._store()
+        s = meta.new_object("Secret", "old", "default")
+        s["data"] = {"v": "pre-rotation"}
+        store.create("secrets", s)
+        old_kid = store._data["secrets"]["default/old"][ENVELOPE_KEY]["kid"]
+        kms.rotate()
+        # old object still decrypts with the retired key
+        assert store.get("secrets", "default", "old")["data"]["v"] == \
+            "pre-rotation"
+        # new writes use the new key
+        s2 = meta.new_object("Secret", "new", "default")
+        s2["data"] = {"v": "post"}
+        store.create("secrets", s2)
+        new_kid = store._data["secrets"]["default/new"][ENVELOPE_KEY]["kid"]
+        assert new_kid != old_kid
+
+    def test_unknown_key_raises(self):
+        kms = LocalKMS()
+        with pytest.raises(DecryptError):
+            kms.decrypt("nope", b"x" * 32)
+
+    def test_finalizer_delete_flow_stays_plaintext_to_watchers(self):
+        store, kms, t = self._store()
+        s = meta.new_object("Secret", "fin", "default")
+        s["metadata"]["finalizers"] = ["example.com/hold"]
+        s["data"] = {"v": "sealed"}
+        store.create("secrets", s)
+        w = store.watch("secrets", since_rv=store.revision)
+        marked = store.delete("secrets", "default", "fin")
+        assert marked["metadata"]["deletionTimestamp"]
+        assert marked["data"]["v"] == "sealed"  # caller sees plaintext
+        ev = w.next(timeout=1)
+        assert ev.object["data"]["v"] == "sealed"
+
+        def strip(o):
+            o["metadata"]["finalizers"] = []
+            return o
+        store.guaranteed_update("secrets", "default", "fin", strip)
+        ev = w.next(timeout=1)
+        assert ev.type == kv.DELETED
+        with pytest.raises(kv.NotFoundError):
+            store.get("secrets", "default", "fin")
+        w.stop()

@@ -1,0 +1,73 @@
+"""Scheduler cache debugger (dump/compare) + kubeadm join discovery.
+
+Behavioral contracts from pkg/scheduler/internal/cache/debugger and
+cmd/kubeadm/app/phases/bootstraptoken.
+"""
+
+import base64
+import hashlib
+import hmac
+import time
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.scheduler import Profile, Scheduler, new_default_framework
+from kubernetes_tpu.scheduler.debugger import CacheDebugger
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestCacheDebugger:
+    def test_dump_and_compare(self):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        factory = SharedInformerFactory(client)
+        fw = new_default_framework(client, factory)
+        sched = Scheduler(client, factory, {"default-scheduler": Profile(fw)})
+        factory.start()
+        factory.wait_for_cache_sync()
+        try:
+            client.create(NODES, make_node("dbg-1").build())
+            client.create(PODS, make_pod("p1").node("dbg-1").build())
+            assert wait_for(lambda: sched.cache.node_count() == 1)
+            dbg = CacheDebugger(sched, client)
+            dump = dbg.dump()
+            assert dump["cache"]["nodes"] == {"dbg-1": 1}
+            diff = dbg.compare()
+            assert diff["nodes"] == {"missing": [], "extra": []}
+            assert diff["pods"] == {"missing": [], "extra": []}
+            # poison the cache: remove the node behind the informer's back
+            sched.cache.remove_node(make_node("dbg-1").build())
+            diff = dbg.compare()
+            assert diff["nodes"]["missing"] == ["dbg-1"]
+        finally:
+            factory.stop()
+
+
+class TestKubeadmDiscovery:
+    def test_signature_validates_and_rejects(self):
+        # the exact verification join() performs, against BootstrapSigner's
+        # published signature
+        kubeconfig = "apiVersion: v1\nkind: Config\n"
+        secret = "s3cret"
+        sig = base64.urlsafe_b64encode(hmac.new(
+            secret.encode(), kubeconfig.encode(),
+            hashlib.sha256).digest()).decode("ascii")
+        good = base64.urlsafe_b64encode(hmac.new(
+            b"s3cret", kubeconfig.encode(),
+            hashlib.sha256).digest()).decode("ascii")
+        assert hmac.compare_digest(sig, good)
+        bad = base64.urlsafe_b64encode(hmac.new(
+            b"wrong", kubeconfig.encode(),
+            hashlib.sha256).digest()).decode("ascii")
+        assert not hmac.compare_digest(sig, bad)
