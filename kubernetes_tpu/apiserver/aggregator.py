@@ -109,41 +109,56 @@ class AggregatorRegistry:
 
     # -- the proxy -------------------------------------------------------
 
-    def proxy(self, method: str, path: str, query: str, body: bytes | None,
-              headers: dict) -> tuple[int, dict, bytes] | None:
-        """Proxy /apis/<group>/<version>/** if registered.
-        Returns (status, headers, body) or None when the path is local.
-        Availability transitions are recorded on the APIService's
-        Available status condition (apiservice status controller)."""
+    def resolve(self, path: str) -> tuple[str, str] | None:
+        """(backend url, APIService name) for a proxied path, else None.
+        The single route lookup — callers pass the result to proxy_open."""
         parts = [p for p in path.split("/") if p]
         if len(parts) < 3 or parts[0] != "apis":
             return None
         with self._lock:
-            route = self._routes.get((parts[1], parts[2]))
-        if route is None:
-            return None
-        backend, svc_name = route
+            return self._routes.get((parts[1], parts[2]))
+
+    def proxy_open(self, backend: str, svc_name: str, method: str, path: str,
+                   query: str, body: bytes | None, headers: dict):
+        """Open the backend request; returns (status, headers, resp) where
+        resp is a file-like to STREAM from (so watch streams relay instead
+        of buffering).  Availability transitions are recorded on the
+        APIService's Available condition: only CONNECTION failures mark it
+        unavailable — an idle-stream timeout mid-relay just ends the
+        stream (the client re-watches, reflector semantics)."""
+        import io
         url = backend + path + (f"?{query}" if query else "")
         fwd = {k: v for k, v in headers.items()
                if k.lower() not in HOP_HEADERS}
         req = urllib.request.Request(url, data=body, method=method,
                                      headers=fwd)
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                out = (resp.status, dict(resp.headers), resp.read())
+            resp = urllib.request.urlopen(req, timeout=30)
             self._observe_availability(svc_name, True)
-            return out
+            return resp.status, dict(resp.headers), resp
         except urllib.error.HTTPError as e:
             # backend responded: it IS available, just unhappy
             self._observe_availability(svc_name, True)
-            return (e.code, dict(e.headers or {}), e.read())
+            return e.code, dict(e.headers or {}), e
         except (urllib.error.URLError, OSError) as e:
             logger.warning("aggregator: backend %s unreachable: %s", url, e)
             self._observe_availability(svc_name, False, str(e))
             return (503, {"Content-Type": "application/json"},
-                    b'{"kind":"Status","status":"Failure",'
-                    b'"reason":"ServiceUnavailable",'
-                    b'"message":"aggregated apiserver unreachable"}')
+                    io.BytesIO(b'{"kind":"Status","status":"Failure",'
+                               b'"reason":"ServiceUnavailable",'
+                               b'"message":"aggregated apiserver '
+                               b'unreachable"}'))
+
+    def proxy(self, method: str, path: str, query: str, body: bytes | None,
+              headers: dict) -> tuple[int, dict, bytes] | None:
+        """One-shot convenience (tests): resolve + open + read fully."""
+        route = self.resolve(path)
+        if route is None:
+            return None
+        status, hdrs, resp = self.proxy_open(route[0], route[1], method,
+                                             path, query, body, headers)
+        with resp:
+            return status, hdrs, resp.read()
 
     def _observe_availability(self, svc_name: str, available: bool,
                               message: str = "") -> None:

@@ -217,37 +217,50 @@ class APIServer:
             def _maybe_proxy(self) -> bool:
                 """Aggregation layer (kube-aggregator handler_proxy.go):
                 requests for an /apis/<group>/<version> registered to an
-                external APIService are proxied to its backend.  Runs after
-                authn/APF (same chain position as the reference)."""
+                external APIService are proxied (STREAMED — watch relays
+                work) to its backend.  Runs after authn/APF, and records
+                the same ResponseComplete audit event local requests get."""
                 from . import aggregator as agglib
                 u = urlparse(self.path)
-                parts = [p for p in u.path.split("/") if p]
-                if len(parts) < 3 or parts[0] != "apis":
-                    return False
-                if server.aggregator.backend_for(parts[1], parts[2]) is None:
+                route = server.aggregator.resolve(u.path)
+                if route is None:
                     return False
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
-                res = server.aggregator.proxy(
-                    self.command, u.path, u.query, body, dict(self.headers))
-                if res is None:
-                    # APIService deleted between the backend_for check and
-                    # the proxy call; the body is consumed, so answer
-                    # directly instead of falling through to local routing
-                    self._send_json(404, status_error(
-                        404, "NotFound", u.path))
-                    return True
-                status, hdrs, payload = res
+                status, hdrs, resp = server.aggregator.proxy_open(
+                    route[0], route[1], self.command, u.path, u.query, body,
+                    dict(self.headers))
+                length_hdr = next((v for k, v in hdrs.items()
+                                   if k.lower() == "content-length"), None)
+                has_len = length_hdr is not None
                 self.send_response(status)
                 for k, v in hdrs.items():
                     if k.lower() not in agglib.HOP_HEADERS:
                         self.send_header(k, v)
-                self.send_header("Content-Length", str(len(payload)))
+                if has_len:
+                    # body is relayed verbatim, so the backend's length
+                    # stays valid (HOP_HEADERS drops it for the loop above)
+                    self.send_header("Content-Length", length_hdr)
+                else:
+                    # unknown length (streaming backend): relay until EOF
+                    # and close — the HTTP/1.0-style framing watch clients
+                    # handle fine
+                    self.send_header("Connection", "close")
                 self.end_headers()
                 try:
-                    self.wfile.write(payload)
-                except (BrokenPipeError, ConnectionResetError):
-                    pass
+                    with resp:
+                        while True:
+                            chunk = resp.read(65536)
+                            if not chunk:
+                                break
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # client or backend went away mid-stream
+                finally:
+                    if not has_len:
+                        self.close_connection = True
+                self._audit(self._route(), self.command.lower(), status)
                 return True
 
             def _route(self) -> _Route | None:

@@ -220,6 +220,13 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
     f_ports = "ports" in features
     f_cons = "constraints" in features
     f_asg = "asg" in features
+    # PLAIN single-device waves run the fused Pallas tile kernel for the
+    # [P,N] mask+score+argmax (ops/pallas_kernels.py); everything else
+    # (conflict resolution, commits) is unchanged XLA.  The kernel bakes in
+    # the default fit/balanced weights, so custom weights take the XLA path
+    from ..ops import pallas_kernels as pk
+    use_pallas = (not features and comm.axis is None and pk.pallas_enabled()
+                  and w["fit"] == 1.0 and w["balanced"] == 1.0)
 
     def assign(node: dict, pod: dict) -> dict[str, jnp.ndarray]:
         n_loc = node["alloc"].shape[0]
@@ -239,12 +246,24 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
         req, req_nz = pod["req"], pod["req_nz"]
         earlier = jnp.tril(jnp.ones((P, P), jnp.float32), k=-1)  # q<p
         p_iota = jnp.arange(P)
+        pk_static = (pk.prepare_static(req, req_nz, alloc, node["maxpods"],
+                                       static_mask)
+                     if use_pallas else None)
 
         def wave(state):
             (used, used_nz, npods, ports, cd_sg, cd_asg,
              assigned, active, _progress, wcount) = state
 
             avail = alloc - used                              # [N,R]
+            if use_pallas:
+                # fused Pallas [P,N] pass straight to per-pod claims
+                claims, _best = pk.claims(pk_static, active, used, used_nz,
+                                          npods)
+                has = claims >= 0
+                boot_flags = []
+                return _resolve_and_commit(state, claims, has, boot_flags,
+                                           avail)
+
             # per-resource 2-D compares instead of one [P,N,R] broadcast
             fit = (npods + 1.0 <= node["maxpods"])[None, :]
             for r in range(caps.r):
@@ -315,6 +334,13 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
             claims, _ = comm.row_argmax(
                 jnp.where(feasible, score + noise, NEG), n_loc)
             claims = jnp.where(has, claims, -1)               # global idx
+            return _resolve_and_commit(state, claims, has, boot_flags, avail)
+
+        def _resolve_and_commit(state, claims, has, boot_flags, avail):
+            """Wave tail shared by the Pallas and XLA paths: conflict
+            resolution in pod/queue order + aggregate commit."""
+            (used, used_nz, npods, ports, cd_sg, cd_asg,
+             assigned, active, _progress, wcount) = state
 
             # ---- conflict resolution (pod/queue order) ----
             # claims are GLOBAL indices: same-node is a [P,P] outer equality,

@@ -1,0 +1,193 @@
+"""Pallas TPU kernels for the assignment hot tile.
+
+The wave solver (models/assign.py) spends its device time in the per-wave
+[P, N] pass: resource-fit masking, LeastAllocated + BalancedAllocation
+scoring, tie-break noise, and the per-pod masked argmax (the reference's
+HOT LOOPS 1-2, schedule_one.go:512 + runtime/framework.go:903, fused with
+selectHost :777).  XLA emits several [P, N] intermediates for it (one per
+resource compare, two score planes, the masked select); at bench shapes
+(P=2048, N=5632) each plane is ~46 MB of HBM traffic.
+
+`claims` fuses the whole pass into one VMEM-resident tile program: a
+(pods x nodes) grid where each step loads transposed [R, TP] request and
+[R, TN] node tiles (lane dimension = the large axis, so Mosaic never
+relayouts the tiny R axis), computes mask+score+noise in registers, and
+folds a running (best score, best index) pair per pod in VMEM scratch —
+one HBM read per input tile, one [1, TP] write per pod tile, and no
+[P, N] materialization at all.
+
+Used by the PLAIN kernel variant (no selectors/ports/constraints — the
+common case the backend already specializes, ops/backend.py _pick_variant)
+on single-device meshes.  Non-TPU backends run the same kernel in
+interpret mode (tests) — `claims` is numerically identical to the
+assign.py oracle path either way.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e9
+TIE_NOISE = 1e-3
+
+TP = 128   # pod-tile size
+TN = 512   # node-tile size (lane-dim multiple of 128)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def pallas_enabled() -> bool:
+    """Kernel on by default; KTPU_PALLAS=0 opts out (oracle fallback)."""
+    return os.environ.get("KTPU_PALLAS", "1") != "0"
+
+
+def _claims_kernel(r_dim: int, n_tiles: int,
+                   req_ref, req_nz_ref, active_ref,
+                   alloc_ref, dyn_ref, caps_ref, smask_ref,
+                   idx_out_ref, score_out_ref,
+                   best_ref, bidx_ref):
+    """One (pi, ni) grid step: fold node tile ni into pod tile pi's best.
+
+    Layouts (lane dim last, always TP or TN):
+      req_ref/req_nz_ref [R, TP]   active_ref [1, TP]   (static per batch)
+      alloc_ref [R, TN]                                  (static per batch)
+      dyn_ref [2R, TN] = used rows, then used_nz rows    (changes per wave)
+      caps_ref [2, TN] = npods row, maxpods row
+      smask_ref [TP, TN]                                 (static per batch)
+      outputs/scratch [1, TP]
+    """
+    pi = pl.program_id(0)
+    ni = pl.program_id(1)
+
+    @pl.when(ni == 0)
+    def _init():
+        best_ref[:] = jnp.full_like(best_ref, NEG)
+        bidx_ref[:] = jnp.full_like(bidx_ref, -1)
+
+    npods = caps_ref[0, :]
+    maxpods = caps_ref[1, :]
+    fit = (npods + 1.0 <= maxpods)[None, :]               # [1, TN]
+    for r in range(r_dim):
+        avail_r = alloc_ref[r, :] - dyn_ref[r, :]          # alloc - used
+        fit = fit & (req_ref[r, :][:, None] <= avail_r[None, :])
+    mask = (smask_ref[:] > 0.0) & fit & (active_ref[0, :][:, None] > 0.0)
+
+    # LeastAllocated + BalancedAllocation over cpu/mem
+    # (assign._fit_scores_vec semantics: util clipped to [0, 1])
+    utils = []
+    for r in range(2):
+        a = alloc_ref[r, :][None, :]                       # [1, TN]
+        u = (dyn_ref[r_dim + r, :][None, :]                # used_nz
+             + req_nz_ref[r, :][:, None])                  # [TP, TN]
+        utils.append(jnp.where(a > 0.0,
+                               jnp.minimum(u / jnp.maximum(a, 1.0), 1.0),
+                               1.0))
+    ucpu, umem = utils
+    score = (2.0 - ucpu - umem) * 50.0 \
+        + (1.0 - jnp.abs(ucpu - umem) * 0.5) * 100.0
+
+    # deterministic tie-break noise keyed on GLOBAL (pod, node) ids —
+    # identical to the assign.py formula so results match the oracle
+    gp = (pi * TP + jax.lax.broadcasted_iota(jnp.int32, (TP, TN), 0)
+          ).astype(jnp.float32)
+    gn = (ni * TN + jax.lax.broadcasted_iota(jnp.int32, (TP, TN), 1)
+          ).astype(jnp.float32)
+    h = jnp.sin(gp * 12.9898 + gn * 78.233) * 43758.5453
+    noise = (h - jnp.floor(h)) * TIE_NOISE
+
+    masked = jnp.where(mask, score + noise, NEG)           # [TP, TN]
+    tile_best = jnp.max(masked, axis=-1)[None, :]          # [1, TP]
+    tile_idx = jnp.argmax(masked, axis=-1)[None, :]        # [1, TP]
+
+    upd = tile_best > best_ref[:]
+    best_ref[:] = jnp.where(upd, tile_best, best_ref[:])
+    bidx_ref[:] = jnp.where(upd, ni * TN + tile_idx.astype(jnp.int32),
+                            bidx_ref[:])
+
+    @pl.when(ni == n_tiles - 1)
+    def _flush():
+        idx_out_ref[:] = jnp.where(best_ref[:] > NEG / 2, bidx_ref[:], -1)
+        score_out_ref[:] = best_ref[:]
+
+
+def _pad_last(x, want):
+    d = want - x.shape[-1]
+    if d == 0:
+        return x
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, d)])
+
+
+def prepare_static(req, req_nz, alloc, maxpods, static_mask):
+    """Batch-invariant tiles, computed ONCE outside the wave loop: the
+    [P,N] mask astype/pad alone is ~46 MB at bench shapes and must not be
+    re-materialized every wave."""
+    P, N = static_mask.shape
+    Pp = -(-P // TP) * TP
+    Np = -(-N // TN) * TN
+    smask_p = _pad_last(static_mask.astype(jnp.float32), Np)
+    smask_p = jnp.pad(smask_p, [(0, Pp - P), (0, 0)])
+    return {
+        "req_t": _pad_last(req.T, Pp),
+        "req_nz_t": _pad_last(req_nz.T, Pp),
+        "alloc_t": _pad_last(alloc.T, Np),
+        "maxpods": maxpods,
+        "smask_p": smask_p,
+        "shape": (P, N, req.shape[1]),
+    }
+
+
+def claims(static, active, used, used_nz, npods):
+    """Fused mask+score+argmax: returns (claims int32[P], best f32[P]).
+    claims[p] = -1 when no node is feasible for pod p.  `static` comes
+    from prepare_static; only the small dynamic aggregates are transposed
+    per call."""
+    P, N, R = static["shape"]
+    Pp = static["smask_p"].shape[0]
+    Np = static["smask_p"].shape[1]
+    active_t = _pad_last(active.astype(jnp.float32)[None, :], Pp)
+    dyn_t = _pad_last(jnp.concatenate([used.T, used_nz.T]), Np)
+    # padded node columns get maxpods=0 -> pod-count check fails ->
+    # infeasible; padded pod rows have active=0 -> masked out
+    caps_t = _pad_last(jnp.stack([npods, static["maxpods"]]), Np)
+
+    p_tiles, n_tiles = Pp // TP, Np // TN
+    kernel = functools.partial(_claims_kernel, R, n_tiles)
+    idx, score = pl.pallas_call(
+        kernel,
+        grid=(p_tiles, n_tiles),
+        in_specs=[
+            pl.BlockSpec((R, TP), lambda i, j: (0, i)),      # req_t
+            pl.BlockSpec((R, TP), lambda i, j: (0, i)),      # req_nz_t
+            pl.BlockSpec((1, TP), lambda i, j: (0, i)),      # active_t
+            pl.BlockSpec((R, TN), lambda i, j: (0, j)),      # alloc_t
+            pl.BlockSpec((2 * R, TN), lambda i, j: (0, j)),  # dyn_t
+            pl.BlockSpec((2, TN), lambda i, j: (0, j)),      # caps_t
+            pl.BlockSpec((TP, TN), lambda i, j: (i, j)),     # smask
+        ],
+        out_specs=[
+            pl.BlockSpec((1, TP), lambda i, j: (0, i)),
+            pl.BlockSpec((1, TP), lambda i, j: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, Pp), jnp.int32),
+            jax.ShapeDtypeStruct((1, Pp), jnp.float32),
+        ],
+        scratch_shapes=[
+            # running (best score, best index) per pod tile
+            pltpu.VMEM((1, TP), jnp.float32),
+            pltpu.VMEM((1, TP), jnp.int32),
+        ],
+        interpret=_use_interpret(),
+    )(static["req_t"], static["req_nz_t"], active_t,
+      static["alloc_t"], dyn_t, caps_t, static["smask_p"])
+    idx = idx[0, :P]
+    best = score[0, :P]
+    return jnp.where(idx >= N, -1, idx), best

@@ -1,0 +1,98 @@
+"""Pallas claims kernel vs the scalar oracle.
+
+The fused mask+score+argmax tile kernel (ops/pallas_kernels.py) must be
+bit-identical to the wave solver's XLA path (models/assign.py) — same
+feasibility rules, same LeastAllocated+BalancedAllocation scores, same
+tie-break noise.  Runs in interpret mode on CPU (tests/conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from kubernetes_tpu.ops.pallas_kernels import (  # noqa: E402
+    NEG, TIE_NOISE, claims, prepare_static,
+)
+
+
+def oracle(req, req_nz, active, alloc, used, used_nz, npods, maxpods, smask):
+    P, R = req.shape
+    N = alloc.shape[0]
+    fit = (npods + 1 <= maxpods)[None, :]
+    for r in range(R):
+        fit = fit & (req[:, r][:, None] <= (alloc[:, r] - used[:, r])[None, :])
+    mask = smask & fit & active[:, None]
+    utils = []
+    for r in range(2):
+        a = alloc[:, r][None, :]
+        u = used_nz[:, r][None, :] + req_nz[:, r][:, None]
+        utils.append(np.where(a > 0, np.minimum(u / np.maximum(a, 1.0), 1.0),
+                              1.0))
+    ucpu, umem = utils
+    score = (2 - ucpu - umem) * 50 + (1 - np.abs(ucpu - umem) * 0.5) * 100
+    gp = np.arange(P, dtype=np.float32)[:, None]
+    gn = np.arange(N, dtype=np.float32)[None, :]
+    h = np.sin(gp * 12.9898 + gn * 78.233, dtype=np.float32) * 43758.5453
+    noise = (h - np.floor(h)) * TIE_NOISE
+    masked = np.where(mask, (score + noise).astype(np.float32), NEG)
+    return np.where(mask.any(1), masked.argmax(1), -1)
+
+
+def run_kernel(req, req_nz, active, alloc, used, used_nz, npods, maxpods,
+               smask):
+    static = prepare_static(jnp.asarray(req), jnp.asarray(req_nz),
+                            jnp.asarray(alloc), jnp.asarray(maxpods),
+                            jnp.asarray(smask))
+    idx, best = claims(static, jnp.asarray(active), jnp.asarray(used),
+                       jnp.asarray(used_nz), jnp.asarray(npods))
+    return np.asarray(idx)
+
+
+@pytest.mark.parametrize("P,N", [(8, 64), (20, 700), (130, 520)])
+def test_matches_oracle(P, N):
+    R = 6
+    rng = np.random.default_rng(P * 1000 + N)
+    req = rng.uniform(0, 4, (P, R)).astype(np.float32)
+    req[:, 3:] = 0
+    req_nz = req.copy()
+    active = rng.random(P) > 0.1
+    alloc = rng.uniform(2, 16, (N, R)).astype(np.float32)
+    alloc[:, 3:] = 0
+    used = rng.uniform(0, 4, (N, R)).astype(np.float32)
+    used[:, 3:] = 0
+    used_nz = used.copy()
+    npods = rng.integers(0, 5, N).astype(np.float32)
+    maxpods = np.full(N, 110, np.float32)
+    smask = rng.random((P, N)) > 0.25
+
+    args = (req, req_nz, active, alloc, used, used_nz, npods, maxpods, smask)
+    got = run_kernel(*args)
+    want = oracle(*args)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_no_feasible_node_returns_minus_one():
+    P, N, R = 9, 130, 6
+    req = np.full((P, R), 100.0, np.float32)  # nothing fits
+    alloc = np.ones((N, R), np.float32)
+    args = (req, req, np.ones(P, bool), alloc, np.zeros((N, R), np.float32),
+            np.zeros((N, R), np.float32), np.zeros(N, np.float32),
+            np.full(N, 10, np.float32), np.ones((P, N), bool))
+    got = run_kernel(*args)
+    assert (got == -1).all()
+
+
+def test_scalar_resource_gates_fit():
+    # pod wants 1 unit of scalar resource r=3; only node 1 has it
+    P, N, R = 1, 200, 6
+    req = np.zeros((P, R), np.float32)
+    req[0, 3] = 1.0
+    alloc = np.zeros((N, R), np.float32)
+    alloc[:, :2] = 8.0
+    alloc[1, 3] = 2.0
+    args = (req, req, np.ones(P, bool), alloc, np.zeros((N, R), np.float32),
+            np.zeros((N, R), np.float32), np.zeros(N, np.float32),
+            np.full(N, 10, np.float32), np.ones((P, N), bool))
+    got = run_kernel(*args)
+    assert got[0] == 1
