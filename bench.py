@@ -47,17 +47,24 @@ def main() -> None:
     caps = Caps(n_cap=n_cap,
                 l_cap=256, kl_cap=62, t_cap=16, pt_cap=16, s_cap=3,
                 sg_cap=16, asg_cap=16)
+    # multiple full passes, report the MEDIAN: host-thread scheduling noise
+    # swings individual runs ~20% in either direction, and the first run
+    # additionally pays compile/trace warmup
+    runs = []
     t0 = time.monotonic()
-    summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
-                                        batch_size=BATCH)
+    for _ in range(max(1, int(os.environ.get("BENCH_RUNS", "3")))):
+        summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
+                                            batch_size=BATCH)
+        if not stats.get("barrier_ok", False):
+            print(json.dumps({"metric": "scheduler_perf_throughput",
+                              "value": 0.0, "unit": "pods/s",
+                              "vs_baseline": 0.0,
+                              "error": "pods left unscheduled",
+                              "detail": summary.to_dict()}))
+            sys.exit(1)
+        runs.append(summary)
     wall = time.monotonic() - t0
-    if not stats.get("barrier_ok", False):
-        print(json.dumps({"metric": "scheduler_perf_throughput",
-                          "value": 0.0, "unit": "pods/s",
-                          "vs_baseline": 0.0,
-                          "error": "pods left unscheduled",
-                          "detail": summary.to_dict()}))
-        sys.exit(1)
+    summary = sorted(runs, key=lambda s: s.average)[len(runs) // 2]
     value = summary.average
     print(json.dumps({
         "metric": "scheduler_perf_throughput",
@@ -65,7 +72,9 @@ def main() -> None:
         "unit": "pods/s",
         "vs_baseline": round(value / BASELINE_PODS_PER_SEC, 2),
         "detail": {"nodes": N_NODES, "pods": N_PODS, "batch": BATCH,
-                   "wall_s": round(wall, 1), **summary.to_dict()},
+                   "wall_s": round(wall, 1), "runs": len(runs),
+                   "averages": [round(s.average, 1) for s in runs],
+                   **summary.to_dict()},
     }))
 
 

@@ -174,6 +174,7 @@ class APIServer:
     def stop(self) -> None:
         self.aggregator.stop()
         self.httpd.shutdown()
+        self.httpd.server_close()  # release the listening socket
 
     @property
     def url(self) -> str:

@@ -326,6 +326,192 @@ class Kubectl:
                            f"exitCode={c.get('exitCode')}\n")
         return 0
 
+    # -- rollout / label / annotate / patch / wait ------------------------
+
+    def rollout(self, action: str, resource: str, name: str,
+                namespace: str, timeout: float = 60.0) -> int:
+        """rollout status|restart|undo (kubectl/pkg/cmd/rollout)."""
+        resource = resolve_resource(resource)
+        if action == "status":
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                try:
+                    o = self.client.get(resource, namespace, name)
+                except kv.NotFoundError as e:
+                    self.out.write(f"Error: {e}\n")
+                    return 1
+                spec = o.get("spec") or {}
+                st = o.get("status") or {}
+                want = spec.get("replicas", 1)
+                ready = st.get("readyReplicas", 0)
+                gen_ok = st.get("observedGeneration", 0) >= \
+                    o["metadata"].get("generation", 0)
+                if gen_ok and ready >= want:
+                    self.out.write(f'{resource} "{name}" successfully '
+                                   f"rolled out\n")
+                    return 0
+                time.sleep(0.1)
+            self.out.write(f"error: rollout status timed out for {name}\n")
+            return 1
+        if action == "restart":
+            # restartedAt annotation on the pod template forces new pods
+            def patch(o):
+                tmpl = o.setdefault("spec", {}).setdefault("template", {})
+                ann = tmpl.setdefault("metadata", {}).setdefault(
+                    "annotations", {})
+                ann["kubectl.kubernetes.io/restartedAt"] = str(time.time())
+                o["metadata"]["generation"] = \
+                    o["metadata"].get("generation", 0) + 1
+                return o
+            try:
+                self.client.guaranteed_update(resource, namespace, name,
+                                              patch)
+            except kv.NotFoundError as e:
+                self.out.write(f"Error: {e}\n")
+                return 1
+            self.out.write(f"{resource}/{name} restarted\n")
+            return 0
+        if action == "undo":
+            # roll back to the previous revision's template, read from the
+            # deployment's retained old ReplicaSets (rollout history —
+            # kubectl/pkg/cmd/rollout + deployment/rollback.go semantics)
+            from ..controllers.deployment import HASH_LABEL, template_hash
+            try:
+                o = self.client.get(resource, namespace, name)
+            except kv.NotFoundError as e:
+                self.out.write(f"Error: {e}\n")
+                return 1
+            cur_hash = template_hash((o.get("spec") or {}).get("template")
+                                     or {})
+            rses, _ = self.client.list("replicasets", namespace)
+            old = [rs for rs in rses
+                   if any(r.get("uid") == meta.uid(o)
+                          for r in meta.owner_references(rs))
+                   and meta.labels(rs).get(HASH_LABEL) != cur_hash]
+            if not old:
+                self.out.write("error: no rollout history\n")
+                return 1
+            prev_rs = max(old, key=meta.creation_timestamp)
+            prev_tmpl = ((prev_rs.get("spec") or {}).get("template") or {})
+            # drop the controller-stamped hash label from the restored
+            # template so re-hashing is stable
+            tmpl = json.loads(json.dumps(prev_tmpl))
+            (tmpl.get("metadata") or {}).get("labels", {}).pop(
+                HASH_LABEL, None)
+
+            def revert(obj):
+                obj["spec"]["template"] = tmpl
+                obj["metadata"]["generation"] = \
+                    obj["metadata"].get("generation", 0) + 1
+                return obj
+            self.client.guaranteed_update(resource, namespace, name, revert)
+            self.out.write(f"{resource}/{name} rolled back\n")
+            return 0
+        self.out.write(f"error: unknown rollout action {action}\n")
+        return 1
+
+    def _update_any_scope(self, resource: str, name: str, namespace: str,
+                          patch) -> None:
+        """guaranteed_update with the same namespaced-then-cluster-scoped
+        fallback get/describe/delete use (raises NotFoundError if both
+        miss)."""
+        try:
+            self.client.guaranteed_update(resource, namespace, name, patch)
+        except kv.NotFoundError:
+            self.client.guaranteed_update(resource, "", name, patch)
+
+    def _kv_patch(self, resource: str, name: str, namespace: str,
+                  pairs: list[str], field: str) -> int:
+        """Shared label/annotate implementation: k=v sets, k- removes."""
+        resource = resolve_resource(resource)
+
+        def patch(o):
+            target = o["metadata"].setdefault(field, {})
+            for pair in pairs:
+                if pair.endswith("-") and "=" not in pair:
+                    target.pop(pair[:-1], None)
+                else:
+                    k, _, v = pair.partition("=")
+                    target[k] = v
+            return o
+        try:
+            self._update_any_scope(resource, name, namespace, patch)
+        except kv.NotFoundError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        self.out.write(f"{resource}/{name} {field[:-1]}ed\n")
+        return 0
+
+    def label(self, resource, name, namespace, pairs) -> int:
+        return self._kv_patch(resource, name, namespace, pairs, "labels")
+
+    def annotate(self, resource, name, namespace, pairs) -> int:
+        return self._kv_patch(resource, name, namespace, pairs, "annotations")
+
+    def patch(self, resource: str, name: str, namespace: str,
+              patch_json: str) -> int:
+        """kubectl patch (strategic-merge reduced to deep merge)."""
+        resource = resolve_resource(resource)
+        try:
+            delta = json.loads(patch_json)
+        except json.JSONDecodeError as e:
+            self.out.write(f"error: invalid patch: {e}\n")
+            return 1
+
+        def deep_merge(dst, src):
+            for k, v in src.items():
+                if v is None:
+                    dst.pop(k, None)
+                elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    deep_merge(dst[k], v)
+                else:
+                    dst[k] = v
+            return dst
+
+        try:
+            self._update_any_scope(resource, name, namespace,
+                                   lambda o: deep_merge(o, delta))
+        except kv.NotFoundError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        self.out.write(f"{resource}/{name} patched\n")
+        return 0
+
+    def wait(self, resource: str, name: str, namespace: str,
+             condition: str, timeout: float = 30.0) -> int:
+        """kubectl wait --for=condition=<Type> | --for=delete."""
+        resource = resolve_resource(resource)
+        want_delete = condition == "delete"
+        cond_name = (condition.partition("=")[2]
+                     if condition.startswith("condition=") else "")
+        if not want_delete and not cond_name:
+            self.out.write("error: --for must be condition=<Type> or "
+                           "delete\n")
+            return 1
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                o = self.client.get(resource, namespace, name)
+            except kv.NotFoundError:
+                try:  # cluster-scoped fallback (same as get/describe)
+                    o = self.client.get(resource, "", name)
+                except kv.NotFoundError:
+                    if want_delete:
+                        self.out.write(f"{resource}/{name} deleted\n")
+                        return 0
+                    time.sleep(0.1)
+                    continue
+            if not want_delete:
+                for c in (o.get("status") or {}).get("conditions") or ():
+                    if (c.get("type", "").lower() == cond_name.lower()
+                            and c.get("status") == "True"):
+                        self.out.write(
+                            f"{resource}/{name} condition met\n")
+                        return 0
+            time.sleep(0.1)
+        self.out.write(f"error: timed out waiting for {resource}/{name}\n")
+        return 1
+
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="kubectl", description=__doc__)
@@ -358,6 +544,26 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("what", choices=["nodes"])
     lg = sub.add_parser("logs")
     lg.add_argument("name")
+    ro = sub.add_parser("rollout")
+    ro.add_argument("action", choices=["status", "restart", "undo"])
+    ro.add_argument("resource")
+    ro.add_argument("name")
+    ro.add_argument("--timeout", type=float, default=60.0)
+    for verb in ("label", "annotate"):
+        lb = sub.add_parser(verb)
+        lb.add_argument("resource")
+        lb.add_argument("name")
+        lb.add_argument("pairs", nargs="+", help="k=v to set, k- to remove")
+    pt = sub.add_parser("patch")
+    pt.add_argument("resource")
+    pt.add_argument("name")
+    pt.add_argument("-p", "--patch", required=True, help="JSON merge patch")
+    wt = sub.add_parser("wait")
+    wt.add_argument("resource")
+    wt.add_argument("name")
+    wt.add_argument("--for", dest="condition", required=True,
+                    help="condition=<Type> or delete")
+    wt.add_argument("--timeout", type=float, default=30.0)
     sub.add_parser("version")
     return ap
 
@@ -391,6 +597,19 @@ def run(argv: list[str] | None = None, client: Client | None = None,
         return k.top_nodes()
     if args.cmd == "logs":
         return k.logs(args.name, args.namespace)
+    if args.cmd == "rollout":
+        return k.rollout(args.action, args.resource, args.name,
+                         args.namespace, args.timeout)
+    if args.cmd == "label":
+        return k.label(args.resource, args.name, args.namespace, args.pairs)
+    if args.cmd == "annotate":
+        return k.annotate(args.resource, args.name, args.namespace,
+                          args.pairs)
+    if args.cmd == "patch":
+        return k.patch(args.resource, args.name, args.namespace, args.patch)
+    if args.cmd == "wait":
+        return k.wait(args.resource, args.name, args.namespace,
+                      args.condition, args.timeout)
     if args.cmd == "version":
         out.write(f"kubectl-tpu v{__version__}\n")
         return 0

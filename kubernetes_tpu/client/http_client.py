@@ -73,9 +73,12 @@ class HTTPWatch:
                 return kv.WatchEvent(
                     payload["type"], payload["object"],
                     meta.resource_version(payload["object"]))
-        except (TimeoutError, OSError):
-            if self._stopped:
-                return None
+        except TimeoutError:
+            return None  # poll timeout: stream is still alive
+        except OSError:
+            # connection died (reset/refused/closed): mark the stream
+            # stopped so the reflector relists instead of polling a corpse
+            self._stopped = True
             return None
 
     def stop(self) -> None:

@@ -1,12 +1,23 @@
-"""kube-proxy: Service -> Endpoint dataplane, simulated.
+"""kube-proxy: Service -> Endpoint dataplane.
 
-Reference: pkg/proxy/iptables/proxier.go:775 (syncProxyRules: rebuild the
-full ruleset on every change, via change trackers in pkg/proxy/{service,
-endpoints}.go).  The dataplane here is a rule table instead of netfilter:
-each Service clusterIP:port maps to its backend endpoints, and route()
-performs the random-endpoint selection iptables' statistic module does.
-A real node agent would render self.rules into iptables-restore input —
-the shape of the table matches what syncProxyRules builds.
+Reference: pkg/proxy/
+  iptables/proxier.go:775 (syncProxyRules: full ruleset rebuild per sync,
+    rendered as ONE iptables-restore input; change trackers in
+    pkg/proxy/{service,endpoints}.go)
+  ipvs/proxier.go:1019 (virtual-server table + real servers per service)
+  session affinity: ClientIP -> recent-client map with timeout
+    (proxier.go affinity tracking / iptables -m recent)
+
+The in-process dataplane is a rule table: each Service clusterIP:port (and
+NodePort) maps to its backend endpoints, `route()` performs the random
+endpoint selection iptables' statistic module does (or ipvs round-robin in
+ipvs mode), and `render_iptables()`/`render_ipvs()` emit the textual rule
+program a real node agent would hand to iptables-restore / ipvsadm —
+the table shape matches what syncProxyRules builds.
+
+Backends come from EndpointSlices (discovery.k8s.io, the reference's
+default since 1.19) with legacy Endpoints as fallback when no slice
+exists for a service.
 """
 
 from __future__ import annotations
@@ -14,29 +25,40 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 
 from ..api import meta
 from ..api.meta import Obj
-from ..client.clientset import ENDPOINTS, SERVICES, Client
+from ..client.clientset import ENDPOINTS, ENDPOINTSLICES, SERVICES, Client
 from ..client.informer import SharedInformerFactory
 
 logger = logging.getLogger(__name__)
 
+SERVICE_NAME_LABEL = "kubernetes.io/service-name"
+MODE_IPTABLES = "iptables"
+MODE_IPVS = "ipvs"
+
 
 class ServiceProxy:
     def __init__(self, client: Client, factory: SharedInformerFactory,
-                 node_name: str = ""):
+                 node_name: str = "", mode: str = MODE_IPTABLES):
         self.client = client
         self.node_name = node_name
+        self.mode = mode
         self.svc_informer = factory.informer(SERVICES)
         self.ep_informer = factory.informer(ENDPOINTS)
+        self.slice_informer = factory.informer(ENDPOINTSLICES)
         self._lock = threading.Lock()
-        # (clusterIP, port, proto) -> {"service": ns/name, "backends": [(ip, port)]}
+        # (ip, port, proto) -> {"service", "backends", "affinity",
+        #                       "affinity_seconds"}; NodePorts use ip=""
         self.rules: dict[tuple[str, int, str], dict] = {}
+        # session affinity state: (rule key, client ip) -> (backend, stamp)
+        self._affinity: dict[tuple, tuple[tuple[str, int], float]] = {}
+        self._rr: dict[tuple, int] = {}  # ipvs round-robin cursors
         self.sync_count = 0
         self._pending = threading.Event()
-        self.svc_informer.add_event_handler(lambda *a: self._pending.set())
-        self.ep_informer.add_event_handler(lambda *a: self._pending.set())
+        for inf in (self.svc_informer, self.ep_informer, self.slice_informer):
+            inf.add_event_handler(lambda *a: self._pending.set())
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -60,44 +82,174 @@ class ServiceProxy:
                 except Exception:  # noqa: BLE001
                     logger.exception("syncProxyRules failed")
 
+    # -- backend collection ----------------------------------------------
+
+    def _slice_backends(self, svc: Obj) -> dict[str, list] | None:
+        """port-name -> [(ip, port)] from EndpointSlices, None if no slice
+        exists for the service (fall back to legacy Endpoints)."""
+        ns, name = meta.namespace(svc), meta.name(svc)
+        slices = [sl for sl in self.slice_informer.list(ns)
+                  if meta.labels(sl).get(SERVICE_NAME_LABEL) == name]
+        if not slices:
+            return None
+        out: dict[str, list] = {}
+        for sl in slices:
+            ports = sl.get("ports") or ()
+            for ep in sl.get("endpoints") or ():
+                if not (ep.get("conditions") or {}).get("ready", True):
+                    continue
+                for addr in ep.get("addresses") or ():
+                    for p in ports:
+                        out.setdefault(p.get("name") or "", []).append(
+                            (addr, p.get("port")))
+        return out
+
+    def _endpoints_backends(self, svc: Obj) -> dict[str, list]:
+        ep = self.ep_informer.get(meta.namespace(svc), meta.name(svc))
+        out: dict[str, list] = {}
+        for subset in (ep or {}).get("subsets") or ():
+            for port in subset.get("ports") or ():
+                out.setdefault(port.get("name", ""), [])
+                for addr in subset.get("addresses") or ():
+                    out[port.get("name", "")].append(
+                        (addr["ip"], port["port"]))
+        return out
+
     # syncProxyRules (iptables/proxier.go:775): full rebuild each sync
     def sync_proxy_rules(self) -> None:
         new_rules: dict[tuple[str, int, str], dict] = {}
-        eps_by_key = {meta.namespaced_name(ep): ep
-                      for ep in self.ep_informer.list()}
         for svc in self.svc_informer.list():
             spec = svc.get("spec") or {}
             cluster_ip = spec.get("clusterIP")
             if not cluster_ip or cluster_ip == "None":
                 continue
-            ep = eps_by_key.get(meta.namespaced_name(svc))
-            backends_by_portname: dict[str, list[tuple[str, int]]] = {}
-            for subset in (ep or {}).get("subsets") or ():
-                for port in subset.get("ports") or ():
-                    backends_by_portname.setdefault(port.get("name", ""), [])
-                    for addr in subset.get("addresses") or ():
-                        backends_by_portname[port.get("name", "")].append(
-                            (addr["ip"], port["port"]))
+            backends = self._slice_backends(svc)
+            if backends is None:
+                backends = self._endpoints_backends(svc)
+            affinity = (spec.get("sessionAffinity") == "ClientIP")
+            aff_secs = (((spec.get("sessionAffinityConfig") or {})
+                         .get("clientIP") or {}).get("timeoutSeconds")
+                        or 10800)
             for p in spec.get("ports") or ():
-                key = (cluster_ip, p.get("port"), p.get("protocol", "TCP"))
-                new_rules[key] = {
+                entry = {
                     "service": meta.namespaced_name(svc),
-                    "backends": backends_by_portname.get(p.get("name", ""), []),
+                    "backends": backends.get(p.get("name") or "", []),
+                    "affinity": affinity,
+                    "affinity_seconds": aff_secs,
                 }
+                proto = p.get("protocol", "TCP")
+                new_rules[(cluster_ip, p.get("port"), proto)] = entry
+                node_port = p.get("nodePort")
+                if node_port and spec.get("type") in ("NodePort",
+                                                      "LoadBalancer"):
+                    # NodePort rules match any node IP; key on ip=""
+                    new_rules[("", node_port, proto)] = entry
         with self._lock:
             self.rules = new_rules
             self.sync_count += 1
+            # prune dead rules AND expired pins (kube-proxy ages affinity
+            # entries out; without this the map grows one entry per client)
+            now = time.time()
+            self._affinity = {
+                k: v for k, v in self._affinity.items()
+                if k[0] in new_rules
+                and now - v[1] < new_rules[k[0]]["affinity_seconds"]}
+            self._rr = {k: v for k, v in self._rr.items() if k in new_rules}
 
-    # the dataplane lookup (what an iptables DNAT chain would do)
-    def route(self, cluster_ip: str, port: int, proto: str = "TCP",
-              rng: random.Random | None = None) -> tuple[str, int] | None:
+    # -- the dataplane lookup (what the DNAT chain / ipvs director does) --
+
+    def route(self, ip: str, port: int, proto: str = "TCP",
+              client_ip: str = "", rng: random.Random | None = None,
+              now: float | None = None) -> tuple[str, int] | None:
+        """Resolve a (virtual ip, port) to a backend.  ip="" or an unknown
+        ip with a NodePort rule matches the NodePort path."""
+        now = time.time() if now is None else now
         with self._lock:
-            rule = self.rules.get((cluster_ip, port, proto))
+            key = (ip, port, proto)
+            rule = self.rules.get(key)
+            if rule is None:
+                key = ("", port, proto)  # NodePort: matches any node ip
+                rule = self.rules.get(key)
             if not rule or not rule["backends"]:
                 return None
-            return (rng or random).choice(rule["backends"])
+            # affinity/rr state keys on the MATCHED rule key, so NodePort
+            # lookups via concrete node ips share state and survive the
+            # sync-time prune
+            if rule["affinity"] and client_ip:
+                akey = (key, client_ip)
+                hit = self._affinity.get(akey)
+                if (hit and hit[0] in rule["backends"]
+                        and now - hit[1] < rule["affinity_seconds"]):
+                    self._affinity[akey] = (hit[0], now)
+                    return hit[0]
+            if self.mode == MODE_IPVS:
+                cur = self._rr.get(key, 0)
+                self._rr[key] = cur + 1
+                backend = rule["backends"][cur % len(rule["backends"])]
+            else:
+                backend = (rng or random).choice(rule["backends"])
+            if rule["affinity"] and client_ip:
+                self._affinity[(key, client_ip)] = (backend, now)
+            return backend
 
     def rule_table(self) -> dict:
         with self._lock:
-            return {f"{ip}:{port}/{proto}": dict(r)
+            return {f"{ip or '*'}:{port}/{proto}": dict(r)
                     for (ip, port, proto), r in self.rules.items()}
+
+    # -- rule-program rendering ------------------------------------------
+
+    def render_iptables(self) -> str:
+        """The iptables-restore input syncProxyRules writes (shape of
+        proxier.go's natRules: KUBE-SERVICES -> KUBE-SVC-* -> KUBE-SEP-*
+        with statistic-module probabilities)."""
+        lines = ["*nat", ":KUBE-SERVICES - [0:0]", ":KUBE-NODEPORTS - [0:0]"]
+        chains: list[str] = []
+        with self._lock:
+            items = sorted(self.rules.items(),
+                           key=lambda kv: (kv[1]["service"], kv[0]))
+            for (ip, port, proto), rule in items:
+                svc_id = rule["service"].replace("/", "-").upper()
+                svc_chain = f"KUBE-SVC-{svc_id}-{port}"
+                lines.append(f":{svc_chain} - [0:0]")
+                if ip:
+                    lines.append(
+                        f"-A KUBE-SERVICES -d {ip}/32 -p {proto.lower()} "
+                        f"--dport {port} -j {svc_chain}")
+                else:
+                    lines.append(
+                        f"-A KUBE-NODEPORTS -p {proto.lower()} "
+                        f"--dport {port} -j {svc_chain}")
+                n = len(rule["backends"])
+                for i, (bip, bport) in enumerate(rule["backends"]):
+                    sep = f"KUBE-SEP-{svc_id}-{port}-{i}"
+                    lines.append(f":{sep} - [0:0]")
+                    if i < n - 1:
+                        prob = 1.0 / (n - i)
+                        chains.append(
+                            f"-A {svc_chain} -m statistic --mode random "
+                            f"--probability {prob:.5f} -j {sep}")
+                    else:
+                        chains.append(f"-A {svc_chain} -j {sep}")
+                    chains.append(
+                        f"-A {sep} -p {proto.lower()} -j DNAT "
+                        f"--to-destination {bip}:{bport}")
+        lines.extend(chains)
+        lines.append("COMMIT")
+        return "\n".join(lines) + "\n"
+
+    def render_ipvs(self) -> str:
+        """The ipvsadm program (ipvs/proxier.go virtual/real servers)."""
+        lines = []
+        with self._lock:
+            items = sorted(self.rules.items(),
+                           key=lambda kv: (kv[1]["service"], kv[0]))
+            for (ip, port, proto), rule in items:
+                flag = "-t" if proto == "TCP" else "-u"
+                vip = ip or "<node-ip>"
+                persist = (f" -p {rule['affinity_seconds']}"
+                           if rule["affinity"] else "")
+                lines.append(f"-A {flag} {vip}:{port} -s rr{persist}")
+                for bip, bport in rule["backends"]:
+                    lines.append(f"-a {flag} {vip}:{port} -r {bip}:{bport} -m")
+        return "\n".join(lines) + "\n"

@@ -163,3 +163,85 @@ class TestKubectlOverHTTP:
             assert rc == 0 and "n1" in out
         finally:
             server.stop()
+
+
+class TestKubectlBreadth:
+    def _deploy(self, client, name="web", image="img:v1", replicas=2):
+        dep = meta.new_object("Deployment", name, "default")
+        dep["spec"] = {"replicas": replicas,
+                       "selector": {"matchLabels": {"app": name}},
+                       "template": {"metadata": {"labels": {"app": name}},
+                                    "spec": {"containers": [
+                                        {"name": "c0", "image": image}]}}}
+        client.create("deployments", dep)
+        return dep
+
+    def test_label_annotate_patch(self, cluster):
+        client = cluster
+        client.create("nodes", make_node("kb-1").build())
+        rc, _ = kubectl(client, "label", "node", "kb-1", "env=prod")
+        assert rc == 0
+        assert meta.labels(client.get("nodes", "", "kb-1"))["env"] == "prod"
+        rc, _ = kubectl(client, "label", "node", "kb-1", "env-")
+        assert rc == 0
+        assert "env" not in meta.labels(client.get("nodes", "", "kb-1"))
+        rc, _ = kubectl(client, "annotate", "node", "kb-1", "team=infra")
+        assert rc == 0
+        rc, _ = kubectl(client, "patch", "node", "kb-1",
+                        "-p", '{"spec":{"unschedulable":true}}')
+        assert rc == 0
+        assert client.get("nodes", "", "kb-1")["spec"]["unschedulable"]
+
+    def test_rollout_status_restart_undo(self, cluster):
+        client = cluster
+        client.create("nodes", make_node("kb-2").capacity(cpu="64").build())
+        self._deploy(client, "roll", image="img:v1")
+        assert wait_for(lambda: len([
+            p for p in client.list("pods", "default")[0]
+            if meta.deletion_timestamp(p) is None]) == 2)
+        for p in client.list("pods", "default")[0]:
+            client.update_status("pods", {**p, "status": {
+                "phase": "Running",
+                "conditions": [{"type": "Ready", "status": "True"}]}})
+        rc, out = kubectl(client, "rollout", "status", "deployment", "roll")
+        assert rc == 0 and "successfully rolled out" in out
+
+        # template change -> second RS; undo -> back to v1 template
+        def set_v2(o):
+            o["spec"]["template"]["spec"]["containers"][0]["image"] = "img:v2"
+            o["metadata"]["generation"] = 2
+            return o
+        client.guaranteed_update("deployments", "default", "roll", set_v2)
+        assert wait_for(lambda: len([
+            rs for rs in client.list("replicasets", "default")[0]]) >= 2)
+        rc, out = kubectl(client, "rollout", "undo", "deployment", "roll")
+        assert rc == 0 and "rolled back" in out
+        img = client.get("deployments", "default", "roll")[
+            "spec"]["template"]["spec"]["containers"][0]["image"]
+        assert img == "img:v1"
+
+        rc, out = kubectl(client, "rollout", "restart", "deployment", "roll")
+        assert rc == 0
+        ann = client.get("deployments", "default", "roll")[
+            "spec"]["template"]["metadata"]["annotations"]
+        assert "kubectl.kubernetes.io/restartedAt" in ann
+
+    def test_wait_for_condition_and_delete(self, cluster):
+        client = cluster
+        pod = make_pod("waity").node("kb-3").build()
+        client.create("pods", pod)
+        rc, out = kubectl(client, "wait", "pod", "waity",
+                          "--for", "condition=Ready", "--timeout", "0.4")
+        assert rc == 1  # not ready yet
+        client.update_status("pods", {**client.get("pods", "default", "waity"),
+                                      "status": {"phase": "Running",
+                                                 "conditions": [
+                                                     {"type": "Ready",
+                                                      "status": "True"}]}})
+        rc, out = kubectl(client, "wait", "pod", "waity",
+                          "--for", "condition=Ready", "--timeout", "5")
+        assert rc == 0
+        client.delete("pods", "default", "waity")
+        rc, out = kubectl(client, "wait", "pod", "waity",
+                          "--for", "delete", "--timeout", "5")
+        assert rc == 0

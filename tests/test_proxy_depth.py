@@ -1,0 +1,138 @@
+"""kube-proxy depth: EndpointSlice backends, NodePort, session affinity,
+iptables/ipvs rule rendering.
+
+Behavioral contracts from pkg/proxy/{iptables,ipvs}/proxier.go.
+"""
+
+import random
+import time
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import ENDPOINTSLICES, SERVICES
+from kubernetes_tpu.proxy.proxier import MODE_IPVS, ServiceProxy
+from kubernetes_tpu.store import kv
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def make_service(name, cluster_ip, port=80, node_port=None, affinity=False):
+    svc = meta.new_object("Service", name, "default")
+    svc["spec"] = {"clusterIP": cluster_ip,
+                   "ports": [{"port": port, "protocol": "TCP",
+                              **({"nodePort": node_port} if node_port else {})}]}
+    if node_port:
+        svc["spec"]["type"] = "NodePort"
+    if affinity:
+        svc["spec"]["sessionAffinity"] = "ClientIP"
+    return svc
+
+
+def make_slice(svc_name, ips, port=80):
+    sl = meta.new_object("EndpointSlice", f"{svc_name}-0", "default")
+    sl["metadata"]["labels"] = {"kubernetes.io/service-name": svc_name}
+    sl["endpoints"] = [{"addresses": [ip], "conditions": {"ready": True}}
+                       for ip in ips]
+    sl["ports"] = [{"name": "", "port": port, "protocol": "TCP"}]
+    return sl
+
+
+class TestProxyDepth:
+    def _stack(self, mode="iptables"):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        factory = SharedInformerFactory(client)
+        proxy = ServiceProxy(client, factory, "n1", mode=mode)
+        factory.start()
+        factory.wait_for_cache_sync()
+        proxy.start()
+        return store, client, factory, proxy
+
+    def test_endpointslice_backends_and_nodeport(self):
+        _, client, factory, proxy = self._stack()
+        try:
+            client.create(SERVICES, make_service("web", "10.96.0.10",
+                                                 node_port=30080))
+            client.create(ENDPOINTSLICES,
+                          make_slice("web", ["10.1.0.1", "10.1.0.2"]))
+            assert wait_for(lambda: proxy.route("10.96.0.10", 80) is not None)
+            assert proxy.route("10.96.0.10", 80)[0] in ("10.1.0.1", "10.1.0.2")
+            # NodePort matches any node ip
+            assert proxy.route("192.168.1.5", 30080) is not None
+            # unready endpoints excluded
+            sl = client.get(ENDPOINTSLICES, "default", "web-0")
+
+            def unready(o):
+                o["endpoints"][0]["conditions"]["ready"] = False
+                return o
+            client.guaranteed_update(ENDPOINTSLICES, "default", "web-0",
+                                     unready)
+            assert wait_for(lambda: all(
+                proxy.route("10.96.0.10", 80)[0] == "10.1.0.2"
+                for _ in range(8)))
+        finally:
+            proxy.stop()
+            factory.stop()
+
+    def test_session_affinity_pins_client(self):
+        _, client, factory, proxy = self._stack()
+        try:
+            client.create(SERVICES, make_service("aff", "10.96.0.20",
+                                                 affinity=True))
+            client.create(ENDPOINTSLICES,
+                          make_slice("aff", [f"10.2.0.{i}" for i in range(8)]))
+            assert wait_for(lambda: proxy.route("10.96.0.20", 80,
+                                                client_ip="1.2.3.4"))
+            first = proxy.route("10.96.0.20", 80, client_ip="1.2.3.4")
+            for _ in range(16):
+                assert proxy.route("10.96.0.20", 80,
+                                   client_ip="1.2.3.4") == first
+            # affinity expires after the timeout
+            aged = proxy.route("10.96.0.20", 80, client_ip="1.2.3.4",
+                               now=time.time() + 20000,
+                               rng=random.Random(7))
+            assert aged is not None  # may or may not differ; just resolves
+        finally:
+            proxy.stop()
+            factory.stop()
+
+    def test_ipvs_round_robin(self):
+        _, client, factory, proxy = self._stack(mode=MODE_IPVS)
+        try:
+            client.create(SERVICES, make_service("rr", "10.96.0.30"))
+            client.create(ENDPOINTSLICES,
+                          make_slice("rr", ["10.3.0.1", "10.3.0.2"]))
+            assert wait_for(lambda: proxy.route("10.96.0.30", 80))
+            seen = [proxy.route("10.96.0.30", 80)[0] for _ in range(4)]
+            assert seen[0] != seen[1] and seen[0] == seen[2]
+        finally:
+            proxy.stop()
+            factory.stop()
+
+    def test_rule_rendering(self):
+        _, client, factory, proxy = self._stack()
+        try:
+            client.create(SERVICES, make_service("render", "10.96.0.40",
+                                                 node_port=30090))
+            client.create(ENDPOINTSLICES,
+                          make_slice("render", ["10.4.0.1", "10.4.0.2"]))
+            assert wait_for(lambda: proxy.route("10.96.0.40", 80))
+            ipt = proxy.render_iptables()
+            assert "*nat" in ipt and ipt.rstrip().endswith("COMMIT")
+            assert "-d 10.96.0.40/32" in ipt
+            assert "--probability 0.50000" in ipt
+            assert "KUBE-NODEPORTS" in ipt and "--dport 30090" in ipt
+            assert "DNAT --to-destination 10.4.0.1:80" in ipt
+            ipvs = proxy.render_ipvs()
+            assert "-A -t 10.96.0.40:80 -s rr" in ipvs
+            assert "-r 10.4.0.2:80" in ipvs
+        finally:
+            proxy.stop()
+            factory.stop()
