@@ -344,9 +344,14 @@ class Kubectl:
                 st = o.get("status") or {}
                 want = spec.get("replicas", 1)
                 ready = st.get("readyReplicas", 0)
+                # deployments must also have rolled all replicas onto the
+                # NEW template (rollout_status.go DeploymentStatusViewer:
+                # updatedReplicas == replicas), else a restart reports
+                # success while old-RS pods still serve
+                updated = st.get("updatedReplicas", ready)
                 gen_ok = st.get("observedGeneration", 0) >= \
                     o["metadata"].get("generation", 0)
-                if gen_ok and ready >= want:
+                if gen_ok and ready >= want and updated >= want:
                     self.out.write(f'{resource} "{name}" successfully '
                                    f"rolled out\n")
                     return 0
@@ -404,7 +409,12 @@ class Kubectl:
                 obj["metadata"]["generation"] = \
                     obj["metadata"].get("generation", 0) + 1
                 return obj
-            self.client.guaranteed_update(resource, namespace, name, revert)
+            try:
+                self.client.guaranteed_update(resource, namespace, name,
+                                              revert)
+            except kv.NotFoundError as e:
+                self.out.write(f"Error: {e}\n")
+                return 1
             self.out.write(f"{resource}/{name} rolled back\n")
             return 0
         self.out.write(f"error: unknown rollout action {action}\n")
@@ -439,7 +449,8 @@ class Kubectl:
         except kv.NotFoundError as e:
             self.out.write(f"Error: {e}\n")
             return 1
-        self.out.write(f"{resource}/{name} {field[:-1]}ed\n")
+        verb = "labeled" if field == "labels" else "annotated"
+        self.out.write(f"{resource}/{name} {verb}\n")
         return 0
 
     def label(self, resource, name, namespace, pairs) -> int:
@@ -450,27 +461,19 @@ class Kubectl:
 
     def patch(self, resource: str, name: str, namespace: str,
               patch_json: str) -> int:
-        """kubectl patch (strategic-merge reduced to deep merge)."""
+        """kubectl patch — RFC 7386 merge patch, the same implementation
+        the apiserver's merge-patch content type uses (apiserver/patch.py)
+        so CLI and API semantics can't drift."""
+        from ..apiserver.patch import json_merge_patch
         resource = resolve_resource(resource)
         try:
             delta = json.loads(patch_json)
         except json.JSONDecodeError as e:
             self.out.write(f"error: invalid patch: {e}\n")
             return 1
-
-        def deep_merge(dst, src):
-            for k, v in src.items():
-                if v is None:
-                    dst.pop(k, None)
-                elif isinstance(v, dict) and isinstance(dst.get(k), dict):
-                    deep_merge(dst[k], v)
-                else:
-                    dst[k] = v
-            return dst
-
         try:
             self._update_any_scope(resource, name, namespace,
-                                   lambda o: deep_merge(o, delta))
+                                   lambda o: json_merge_patch(o, delta))
         except kv.NotFoundError as e:
             self.out.write(f"Error: {e}\n")
             return 1

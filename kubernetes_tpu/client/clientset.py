@@ -163,18 +163,42 @@ class Client:
                                     chunk.append(q.get_nowait())
                             except _q.Empty:
                                 pass
+                            stop = None in chunk  # close() sentinel
+                            chunk = [e for e in chunk if e is not None]
                             try:
-                                self.create_events(chunk)
+                                if chunk:
+                                    self.create_events(chunk)
                             except kv.StoreError:
                                 pass
+                            if stop:
+                                return
 
-                    threading.Thread(target=drain, name="event-broadcaster",
-                                     daemon=True).start()
+                    t = threading.Thread(target=drain,
+                                         name="event-broadcaster",
+                                         daemon=True)
+                    t.start()
+                    self._event_thread = t
                     self._event_queue = q
         try:
             self._event_queue.put_nowait(ev)
         except _q.Full:
             pass  # queue full: drop (bounded broadcaster semantics)
+
+    def close(self) -> None:
+        """Stop the event-broadcaster thread, flushing buffered events
+        (joins the drain thread so the flush completes before return;
+        the broadcaster restarts lazily if events are recorded later)."""
+        q = getattr(self, "_event_queue", None)
+        t = getattr(self, "_event_thread", None)
+        if q is None:
+            return
+        self._event_queue = None  # next create_event restarts the thread
+        try:
+            q.put(None, timeout=1.0)
+        except Exception:  # noqa: BLE001 - full queue: drop the flush
+            return
+        if t is not None:
+            t.join(timeout=5.0)
 
     def create_events(self, events: list[Obj]) -> None:
         """Write a burst of Events. Generic clients write one by one;

@@ -109,6 +109,7 @@ class PerfCluster:
     def shutdown(self) -> None:
         self.scheduler.stop()
         self.factory.stop()
+        self.client.close()  # event-broadcaster thread
 
 
 def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,

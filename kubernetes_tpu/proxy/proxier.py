@@ -84,12 +84,12 @@ class ServiceProxy:
 
     # -- backend collection ----------------------------------------------
 
-    def _slice_backends(self, svc: Obj) -> dict[str, list] | None:
+    def _slice_backends(self, svc: Obj, slices_by_svc: dict) -> \
+            dict[str, list] | None:
         """port-name -> [(ip, port)] from EndpointSlices, None if no slice
         exists for the service (fall back to legacy Endpoints)."""
-        ns, name = meta.namespace(svc), meta.name(svc)
-        slices = [sl for sl in self.slice_informer.list(ns)
-                  if meta.labels(sl).get(SERVICE_NAME_LABEL) == name]
+        slices = slices_by_svc.get(
+            (meta.namespace(svc), meta.name(svc)))
         if not slices:
             return None
         out: dict[str, list] = {}
@@ -118,12 +118,19 @@ class ServiceProxy:
     # syncProxyRules (iptables/proxier.go:775): full rebuild each sync
     def sync_proxy_rules(self) -> None:
         new_rules: dict[tuple[str, int, str], dict] = {}
+        # one slice index per sync: O(services + slices), not services*slices
+        slices_by_svc: dict[tuple[str, str], list] = {}
+        for sl in self.slice_informer.list():
+            svc_name = meta.labels(sl).get(SERVICE_NAME_LABEL)
+            if svc_name:
+                slices_by_svc.setdefault(
+                    (meta.namespace(sl), svc_name), []).append(sl)
         for svc in self.svc_informer.list():
             spec = svc.get("spec") or {}
             cluster_ip = spec.get("clusterIP")
             if not cluster_ip or cluster_ip == "None":
                 continue
-            backends = self._slice_backends(svc)
+            backends = self._slice_backends(svc, slices_by_svc)
             if backends is None:
                 backends = self._endpoints_backends(svc)
             affinity = (spec.get("sessionAffinity") == "ClientIP")
@@ -204,7 +211,11 @@ class ServiceProxy:
         proxier.go's natRules: KUBE-SERVICES -> KUBE-SVC-* -> KUBE-SEP-*
         with statistic-module probabilities)."""
         lines = ["*nat", ":KUBE-SERVICES - [0:0]", ":KUBE-NODEPORTS - [0:0]"]
-        chains: list[str] = []
+        # the terminal rule that links NodePorts into the traffic path
+        # (syncProxyRules appends it after all per-service rules)
+        chains: list[str] = [
+            "-A KUBE-SERVICES -m addrtype --dst-type LOCAL "
+            "-j KUBE-NODEPORTS"]
         with self._lock:
             items = sorted(self.rules.items(),
                            key=lambda kv: (kv[1]["service"], kv[0]))
