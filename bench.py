@@ -122,7 +122,8 @@ def main() -> None:
     emit(med["value"], {"wall_s": round(wall, 1), "runs": n_runs,
                         "averages": [r["value"] for r in results],
                         **{k: v for k, v in med["detail"].items()
-                           if k not in ("nodes", "pods", "batch")}})
+                           if k not in ("nodes", "pods", "batch",
+                                        "wall_s")}})
 
 
 if __name__ == "__main__":

@@ -148,10 +148,12 @@ class Client:
     def _event_sink(self, ev: Obj) -> None:
         import queue as _q
         import threading
-        if getattr(self, "_event_queue", None) is None:
+        q = getattr(self, "_event_queue", None)
+        if q is None:
             with Client._event_init_lock:
-                if getattr(self, "_event_queue", None) is None:
-                    q: "_q.Queue" = _q.Queue(maxsize=10_000)
+                q = getattr(self, "_event_queue", None)
+                if q is None:
+                    q = _q.Queue(maxsize=10_000)
 
                     def drain() -> None:
                         # drain in chunks: one write per buffered burst keeps
@@ -180,7 +182,10 @@ class Client:
                     self._event_thread = t
                     self._event_queue = q
         try:
-            self._event_queue.put_nowait(ev)
+            # the LOCAL q: close() may null _event_queue concurrently (an
+            # event racing close lands in the drained queue = dropped,
+            # bounded-broadcaster semantics, never an AttributeError)
+            q.put_nowait(ev)
         except _q.Full:
             pass  # queue full: drop (bounded broadcaster semantics)
 

@@ -56,6 +56,27 @@ DYN_FIELDS = ("used", "used_nz", "npods", "port_mask")
 FLUSH_FIRST = object()
 
 
+def decode_results(assignments, n: int, batch_size: int, escapes: set,
+                   row_infos: list, no_fit_msg: str
+                   ) -> list[tuple[str | None, Status | None]]:
+    """Shared assignment decode (single-chip + sharded backends): map each
+    pod slot to (node_name, status).  `row_infos` is the node_infos list
+    CAPTURED AT DISPATCH — a later dispatch may recycle rows, so names must
+    resolve against the batch's own view."""
+    results: list[tuple[str | None, Status | None]] = []
+    for i in range(n):
+        if i >= batch_size or i in escapes:
+            results.append((None, Status(SKIP, "escape to per-pod path")))
+            continue
+        row = int(assignments[i])
+        if row < 0:
+            results.append((None, Status(UNSCHEDULABLE, no_fit_msg)))
+        else:
+            ni = row_infos[row]
+            results.append((ni.name if ni is not None else None, None))
+    return results
+
+
 class TPUBatchBackend(BatchBackend):
     def __init__(self, caps: Caps | None = None, batch_size: int = 256,
                  weights: dict[str, float] | None = None, k_cap: int = 1024):
@@ -276,10 +297,14 @@ class TPUBatchBackend(BatchBackend):
             self.stats["batches"] += 1
             holder = object()
             self._unresolved.append(holder)
+            # row->NodeInfo view AT DISPATCH: a later dispatch may recycle
+            # rows (node deleted, slot reused), so resolve() must not read
+            # the live tensors
+            row_infos = list(self.tensors.node_infos)
 
         n = len(pod_infos)
 
-        def resolve() -> list[tuple[int | None, Status | None]]:
+        def resolve() -> list[tuple[str | None, Status | None]]:
             with self._lock:
                 result = np.asarray(result_dev)  # ONE blocking device pull
                 assignments = result[:-1]
@@ -289,31 +314,15 @@ class TPUBatchBackend(BatchBackend):
                     self._unresolved.remove(holder)
                 except ValueError:  # pragma: no cover - double resolve
                     pass
-            escapes = set(batch.escape)
-            results: list[tuple[int | None, Status | None]] = []
-            for i in range(n):
-                if i >= self.batch_size or i in escapes:
-                    results.append((None, Status(SKIP, "escape to per-pod path")))
-                    continue
-                row = int(assignments[i])
-                if row < 0:
-                    results.append((None, Status(
-                        UNSCHEDULABLE, "no feasible node (TPU batch filter)")))
-                else:
-                    results.append((row, None))
-            return results
+            return decode_results(assignments, n, self.batch_size,
+                                  set(batch.escape), row_infos,
+                                  "no feasible node (TPU batch filter)")
 
         return resolve
 
     def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot
-               ) -> list[tuple[int | None, Status | None]]:
+               ) -> list[tuple[str | None, Status | None]]:
         resolve = self.dispatch(pod_infos, snapshot)
         if resolve is FLUSH_FIRST:  # pragma: no cover - sync caller, no inflight
             raise RuntimeError("FLUSH_FIRST with no pipelined caller")
         return resolve()
-
-    def node_name(self, idx: int) -> str:
-        name = self.tensors.node_name(idx)
-        if name is None:
-            raise KeyError(f"no node at row {idx}")
-        return name

@@ -1,0 +1,117 @@
+"""Sharded batch backend: the scheduler's device path over a device Mesh.
+
+This is the multi-chip realization of the BatchBackend contract
+(scheduler/scheduler.py): the node axis shards across the mesh
+(parallel/mesh.py shard_map, XLA ICI collectives), the pod batch and
+domain-count tables replicate, and the whole Filter/Score/Assign step runs
+as ONE jitted program per batch.  Used for multi-chip execution and the
+driver's dryrun; the single-chip TPUBatchBackend (ops/backend.py) remains
+the latency-optimized path (resident device state + packed transport) on
+one chip.
+
+Unlike the packed backend it re-uploads the node-side arrays per batch —
+multi-host transports stage via each host's local devices, so the resident
+single-buffer trick does not apply; snapshot deltas still keep the HOST
+side incremental (ClusterTensors dirty-row re-encode).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from ..ops.backend import decode_results
+from ..ops.flatten import BatchEncoder, Caps, ClusterTensors, VocabFullError
+from ..scheduler.cache import Snapshot
+from ..scheduler.scheduler import BatchBackend
+from ..scheduler.types import SKIP, PodInfo, Status
+from .mesh import build_sharded_assign_fn, make_mesh, pod_specs
+
+logger = logging.getLogger(__name__)
+
+POD_KEYS = tuple(pod_specs())
+
+
+class ShardedTPUBatchBackend(BatchBackend):
+    # node arrays are rebuilt from the host snapshot per batch (no resident
+    # device-state chaining), so an unresolved batch's placements are
+    # invisible to the next dispatch: the scheduler must finish k before
+    # dispatching k+1
+    supports_pipelining = False
+    def __init__(self, caps: Caps | None = None, batch_size: int = 256,
+                 weights: dict[str, float] | None = None, mesh=None):
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.caps = caps or Caps()
+        n_dev = self.mesh.devices.size
+        if self.caps.n_cap % n_dev != 0:
+            raise ValueError(
+                f"n_cap {self.caps.n_cap} must divide by {n_dev} devices")
+        self.batch_size = batch_size
+        self.tensors = ClusterTensors(self.caps)
+        self.encoder = BatchEncoder(self.tensors, batch_size)
+        self._fn = build_sharded_assign_fn(self.caps, self.mesh, weights)
+        self._shardings = self._make_shardings()
+        self._lock = threading.Lock()
+        self.stats = {"batches": 0, "waves": 0}
+
+    def _make_shardings(self):
+        from jax.sharding import NamedSharding
+
+        from .mesh import node_specs, pod_specs
+        ns, ps = node_specs(), pod_specs()
+        return ({k: NamedSharding(self.mesh, v) for k, v in ns.items()},
+                {k: NamedSharding(self.mesh, v) for k, v in ps.items()})
+
+    def _node_arrays(self):
+        import jax
+        t = self.tensors
+        cd_sg, cd_asg = t.domain_base_counts()
+        raw = {
+            "alloc": t.alloc, "used": t.used, "used_nz": t.used_nz,
+            "npods": t.npods, "maxpods": t.maxpods, "valid": t.valid,
+            "taint_mask": t.taint_mask, "label_mask": t.label_mask,
+            "key_mask": t.key_mask, "port_mask": t.port_mask,
+            "dom_sg": t.dom_sg, "dom_asg": t.dom_asg,
+            "cd_sg": cd_sg, "cd_asg": cd_asg,
+        }
+        shard = self._shardings[0]
+        return {k: jax.device_put(v, shard[k]) for k, v in raw.items()}
+
+    # -- BatchBackend -----------------------------------------------------
+
+    def dispatch(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot):
+        import jax
+        with self._lock:
+            try:
+                self.tensors.update_from_snapshot(snapshot)
+                batch = self.encoder.encode(list(pod_infos))
+            except VocabFullError as e:
+                logger.warning("tensorization overflow (%s); batch -> "
+                               "oracle path", e)
+                results = [(None, Status(SKIP, str(e)))] * len(pod_infos)
+                return lambda: results
+            node_arrays = self._node_arrays()
+            pshard = self._shardings[1]
+            pod_arrays = {k: jax.device_put(getattr(batch, k), pshard[k])
+                          for k in POD_KEYS}
+            out = self._fn(node_arrays, pod_arrays)
+            self.stats["batches"] += 1
+            row_infos = list(self.tensors.node_infos)  # view at dispatch
+
+        n = len(pod_infos)
+
+        def resolve():
+            assignments = np.asarray(out["assignments"])
+            with self._lock:
+                self.stats["waves"] += int(np.asarray(out["waves"]))
+            return decode_results(assignments, n, self.batch_size,
+                                  set(batch.escape), row_infos,
+                                  "no feasible node (sharded batch filter)")
+
+        return resolve
+
+    def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot):
+        return self.dispatch(pod_infos, snapshot)()

@@ -101,16 +101,29 @@ class SchedulerMetrics:
 
 
 class BatchBackend:
-    """Contract for the TPU batch path (implemented by ops/backend.py).
+    """Contract for the TPU batch path (implemented by ops/backend.py and
+    parallel/backend.py).
 
     assign() must account for intra-batch resource consumption: if two pods
     in the batch fit the same node only serially, the returned assignment
     reflects the running-sum constraint (SURVEY.md §7 hard part #1).
+
+    Results carry node NAMES, resolved against the snapshot the batch was
+    dispatched with — a later dispatch may recycle node rows (deleted node
+    freed, new node reused the slot), so indices must never escape the
+    backend.
+
+    Backends that cannot safely overlap a new dispatch with an unresolved
+    batch (no resident device state chaining) set supports_pipelining =
+    False; the scheduler then resolves + finishes batch k before
+    dispatching k+1.
     """
 
+    supports_pipelining = True
+
     def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot
-               ) -> list[tuple[int | None, Status | None]]:
-        """Returns, per pod (same order): (node_index or None, status)."""
+               ) -> list[tuple[str | None, Status | None]]:
+        """Returns, per pod (same order): (node_name or None, status)."""
         raise NotImplementedError
 
     def dispatch(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot):
@@ -120,9 +133,6 @@ class BatchBackend:
         previous batch's bind tail with the device round trip."""
         results = self.assign(pod_infos, snapshot)
         return lambda: results
-
-    def node_name(self, idx: int) -> str:
-        raise NotImplementedError
 
 
 class Profile:
@@ -673,6 +683,11 @@ class Scheduler:
         None if nothing went to the device."""
         from ..ops.backend import FLUSH_FIRST
         backend = profile.batch_backend
+        if not backend.supports_pipelining:
+            # no resident device-state chaining: batch k must be resolved
+            # AND assumed before k+1's snapshot is flattened, or k+1 is
+            # scored against capacity batch k already claimed
+            self._flush_pending()
         cycle = self.queue.scheduling_cycle()
         start = time.monotonic()
         live = [q for q in batch if not self._skip_schedule(q.pod)]
@@ -712,13 +727,12 @@ class Scheduler:
         written back through one bulk store transaction instead of one
         guaranteed-update per pod."""
         fw = profile.framework
-        backend = profile.batch_backend
         results = resolve()
         bulk: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         # phase 1: collect placements; failures/escapes handled per pod
         placed: list[tuple[QueuedPodInfo, str, Obj, PodInfo]] = []
-        for qpi, (node_idx, s) in zip(live, results):
-            if node_idx is None:
+        for qpi, (node_name, s) in zip(live, results):
+            if node_name is None:
                 if s is not None and s.is_skip():
                     # constraint not tensor-encodable: per-pod oracle path,
                     # deferred until nothing is in flight (a pipelined next
@@ -729,7 +743,6 @@ class Scheduler:
                 self._handle_failure(fw, qpi, st, cycle,
                                      {st.plugin} if st.plugin else set(), start)
                 continue
-            node_name = backend.node_name(node_idx)
             pod = qpi.pod_info.pod
             # 2-level shallow copy: only spec is replaced; nested values are
             # never mutated in place (store reads hand out copies), so the

@@ -1,0 +1,71 @@
+"""Full scheduler over the sharded (multi-chip) batch backend on the
+8-virtual-device CPU mesh: store -> informers -> queue -> shard_map'd
+Filter/Score/Assign over the node axis -> assume -> bind.
+"""
+
+import time
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.ops.flatten import Caps
+from kubernetes_tpu.parallel.backend import ShardedTPUBatchBackend
+from kubernetes_tpu.scheduler import Profile, Scheduler, new_default_framework
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def wait_for(pred, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_scheduler_end_to_end_on_mesh():
+    import jax
+    n_dev = len(jax.devices())
+    assert n_dev >= 8, "conftest should provide 8 virtual devices"
+
+    caps = Caps(n_cap=64, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                s_cap=2, sg_cap=8, asg_cap=8)
+    backend = ShardedTPUBatchBackend(caps, batch_size=16)
+    assert backend.mesh.devices.size == n_dev
+
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    fw = new_default_framework(client, factory)
+    sched = Scheduler(client, factory, {"default-scheduler": Profile(
+        fw, batch_backend=backend, batch_size=16)})
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    try:
+        for i in range(24):
+            client.create(NODES, make_node(f"mesh-{i}").zone("abc"[i % 3])
+                          .capacity(cpu="8", mem="32Gi").build())
+        for i in range(40):
+            client.create(PODS, make_pod(f"mp{i}")
+                          .req(cpu="500m", mem="512Mi").build())
+        assert wait_for(lambda: all(
+            meta.pod_node_name(p)
+            for p in client.list(PODS, "default")[0]))
+        # every placement respects capacity (8 cpu per node => <=16 pods)
+        per_node = {}
+        for p in client.list(PODS, "default")[0]:
+            per_node[meta.pod_node_name(p)] = \
+                per_node.get(meta.pod_node_name(p), 0) + 1
+        assert max(per_node.values()) <= 16
+        assert backend.stats["batches"] >= 1
+        # an infeasible pod comes back unschedulable through the same path
+        client.create(PODS, make_pod("mp-huge").req(cpu="64").build())
+        assert wait_for(lambda: any(
+            c.get("reason") == "Unschedulable"
+            for c in (client.get(PODS, "default", "mp-huge")
+                      .get("status") or {}).get("conditions") or ()))
+    finally:
+        sched.stop()
+        factory.stop()

@@ -36,7 +36,8 @@ def small_caps(**kw):
 def run_assign(backend, pods, snapshot):
     infos = [PodInfo(p) for p in pods]
     results = backend.assign(infos, snapshot)
-    return [backend.node_name(r[0]) if r[0] is not None else (r[1].code if r[1] else None)
+    # results carry node NAMES (BatchBackend contract)
+    return [r[0] if r[0] is not None else (r[1].code if r[1] else None)
             for r in results]
 
 
@@ -291,9 +292,8 @@ class TestOracleParity:
             for n in nodes:
                 cache.add_node(n)
             snap2 = cache.update_snapshot(Snapshot())
-            for pi, (row, status) in zip(infos, results):
-                if row is not None:
-                    name = backend.node_name(row)
+            for pi, (name, status) in zip(infos, results):
+                if name is not None:
                     ni = snap2.get(name)
                     assert insufficient_resources(pi, ni) == [], \
                         f"oracle rejects batch placement of {pi.key} on {name}"
@@ -301,8 +301,8 @@ class TestOracleParity:
                     bound["spec"] = dict(pi.pod["spec"], nodeName=name)
                     cache.add_pod(bound)
                     snap2 = cache.update_snapshot(snap2)
-            for pi, (row, status) in zip(infos, results):
-                if row is None:
+            for pi, (name, status) in zip(infos, results):
+                if name is None:
                     assert status is not None
                     for ni in snap2.list():
                         assert insufficient_resources(pi, ni), \
