@@ -49,10 +49,10 @@ from . import patch as patchlib
 
 logger = logging.getLogger(__name__)
 
-CLUSTER_SCOPED = {"nodes", "persistentvolumes", "namespaces", "priorityclasses",
-                  "storageclasses", "csinodes", crdlib.CRDS,
-                  "certificatesigningrequests", "volumeattachments",
-                  "apiservices"}
+from ..client.clientset import CLUSTER_SCOPED_RESOURCES
+
+# alias, not a copy: mutating a fork would re-split client/server routing
+CLUSTER_SCOPED = CLUSTER_SCOPED_RESOURCES
 
 SUBRESOURCES = {"status", "binding", "eviction", "scale"}
 

@@ -97,9 +97,10 @@ class HTTPWatch:
 
 class HTTPClient(Client):
     def __init__(self, host: str, port: int, token: str | None = None,
-                 cluster_scoped: frozenset[str] = frozenset(
-                     {"nodes", "persistentvolumes", "namespaces",
-                      "priorityclasses", "storageclasses", "csinodes"})):
+                 cluster_scoped: frozenset[str] | None = None):
+        from .clientset import CLUSTER_SCOPED_RESOURCES
+        if cluster_scoped is None:
+            cluster_scoped = CLUSTER_SCOPED_RESOURCES
         self.host, self.port = host, port
         self._headers = {"Content-Type": "application/json"}
         if token:

@@ -61,13 +61,10 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "statefulset", "daemonset",
 
 
 class ControllerManager:
-    def __init__(self, client: Client, factory: SharedInformerFactory,
-                 controllers: tuple[str, ...] = DEFAULT_CONTROLLERS,
-                 leader_elect: bool = False, identity: str | None = None):
-        self.client = client
-        self.factory = factory
-        self.controllers: dict[str, object] = {}
-        ctors = {
+    # name -> constructor; the complete registry (controllermanager.go's
+    # NewControllerInitializers).  Class-level so tooling/tests can audit
+    # that every controller is wired without instantiating anything.
+    CTORS = {
             "deployment": DeploymentController,
             "replicaset": ReplicaSetController,
             "statefulset": StatefulSetController,
@@ -99,9 +96,16 @@ class ControllerManager:
             "nodeipam": NodeIpamController,
             "tokencleaner": TokenCleaner,
             "bootstrapsigner": BootstrapSigner,
-        }
+    }
+
+    def __init__(self, client: Client, factory: SharedInformerFactory,
+                 controllers: tuple[str, ...] = DEFAULT_CONTROLLERS,
+                 leader_elect: bool = False, identity: str | None = None):
+        self.client = client
+        self.factory = factory
+        self.controllers: dict[str, object] = {}
         for name in controllers:
-            self.controllers[name] = ctors[name](client, factory)
+            self.controllers[name] = self.CTORS[name](client, factory)
         self._elector: LeaderElector | None = None
         self._leader_elect = leader_elect
         self._identity = identity

@@ -42,7 +42,7 @@ from ..models.assign import (
 )
 from ..scheduler.cache import Snapshot
 from ..scheduler.scheduler import BatchBackend
-from ..scheduler.types import SKIP, UNSCHEDULABLE, PodInfo, Status
+from ..scheduler.types import ERROR, SKIP, UNSCHEDULABLE, PodInfo, Status
 from .flatten import BatchEncoder, Caps, ClusterTensors, PodBatch, VocabFullError
 
 logger = logging.getLogger(__name__)
@@ -71,9 +71,18 @@ def decode_results(assignments, n: int, batch_size: int, escapes: set,
         row = int(assignments[i])
         if row < 0:
             results.append((None, Status(UNSCHEDULABLE, no_fit_msg)))
+            continue
+        ni = row_infos[row]
+        if ni is None:
+            # invariant violation (device placed onto an invalid row):
+            # surface it loudly — the device-side capacity claim is now
+            # phantom until the next refresh, and silently reporting
+            # "no feasible node" would mask the encoding bug
+            results.append((None, Status(
+                ERROR, f"device assigned row {row} with no NodeInfo "
+                       "(encoder/valid-mask bug)")))
         else:
-            ni = row_infos[row]
-            results.append((ni.name if ni is not None else None, None))
+            results.append((ni.name, None))
     return results
 
 

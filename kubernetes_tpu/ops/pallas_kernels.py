@@ -1,9 +1,10 @@
 """Pallas TPU kernels for the assignment hot tile.
 
 The wave solver (models/assign.py) spends its device time in the per-wave
-[P, N] pass: resource-fit masking, LeastAllocated + BalancedAllocation
+[P, N] pass — resource-fit masking, LeastAllocated + BalancedAllocation
 scoring, tie-break noise, and the per-pod masked argmax (the reference's
-HOT LOOPS 1-2, schedule_one.go:512 + runtime/framework.go:903, fused with
+HOT LOOPS 1-2, pkg/scheduler/schedule_one.go:512 findNodesThatPassFilters
++ framework/runtime/framework.go:903 RunScorePlugins, fused with
 selectHost :777).  XLA emits several [P, N] intermediates for it (one per
 resource compare, two score planes, the masked select); at bench shapes
 (P=2048, N=5632) each plane is ~46 MB of HBM traffic.

@@ -1,5 +1,8 @@
 """Sharded batch backend: the scheduler's device path over a device Mesh.
 
+SURVEY.md §2.6/§5: the node axis is this workload's long axis; sharding
+it over the mesh is our sequence-parallelism analog.
+
 This is the multi-chip realization of the BatchBackend contract
 (scheduler/scheduler.py): the node axis shards across the mesh
 (parallel/mesh.py shard_map, XLA ICI collectives), the pod batch and
@@ -41,6 +44,7 @@ class ShardedTPUBatchBackend(BatchBackend):
     # invisible to the next dispatch: the scheduler must finish k before
     # dispatching k+1
     supports_pipelining = False
+
     def __init__(self, caps: Caps | None = None, batch_size: int = 256,
                  weights: dict[str, float] | None = None, mesh=None):
         self.mesh = mesh if mesh is not None else make_mesh()

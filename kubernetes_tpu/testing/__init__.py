@@ -5,3 +5,15 @@ from .wrappers import (  # noqa: F401
     make_storage_class,
 )
 from .fake import FakeInformer, FakeInformerFactory  # noqa: F401,E402
+
+
+def wait_for(predicate, timeout: float = 30.0, interval: float = 0.02) -> bool:
+    """Poll until predicate() is truthy; the shared test/e2e helper
+    (test/e2e/framework wait.go shape)."""
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False

@@ -1,5 +1,9 @@
 """Deep copy for JSON-shaped objects: native extension with pure fallback.
 
+The reference generates per-type DeepCopyObject via deepcopy-gen
+(staging/src/k8s.io/code-generator); our objects are plain dict trees, so
+one native copier covers every type.
+
 native/fastcopy builds `_fastcopy` (CPython C API); the store's write path
 (store/kv.py via api.meta.deep_copy) is the consumer.  Objects here are
 always dict/list/scalar trees, so the C path shares immutable scalars and

@@ -1,0 +1,171 @@
+"""E2E conformance tier: everything over the REAL HTTP API against a live
+in-process control plane (apiserver + scheduler + controllers + hollow
+nodes) — the reference's test/e2e shape (ginkgo suites against a running
+cluster), reduced to the core conformance behaviors:
+
+  - workloads: Deployment -> ReplicaSet -> Pods scheduled and Running
+  - services: selector -> EndpointSlice -> kube-proxy routes to a backend
+  - storage: PVC -> dynamic provisioning -> Bound, protection finalizer
+  - scheduling: taints keep pods off tainted nodes until tolerated
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.http_client import HTTPClient
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.kubelet import start_hollow_nodes
+from kubernetes_tpu.proxy.proxier import ServiceProxy
+from kubernetes_tpu.scheduler import Profile, Scheduler, new_default_framework
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import wait_for
+
+
+@pytest.fixture(scope="module")
+def e2e():
+    """A full cluster; the TEST talks to it exclusively over HTTP."""
+    store = kv.MemoryStore(history=1_000_000)
+    server = APIServer(store).start()
+    local = LocalClient(store)
+    factory = SharedInformerFactory(local)
+    fw = new_default_framework(local, factory)
+    sched = Scheduler(local, factory, {"default-scheduler": Profile(fw)})
+    mgr = ControllerManager(local, factory)
+    endpoints = EndpointsController(local, factory)
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    mgr.run()
+    endpoints.run()
+    kubelets = start_hollow_nodes(local, factory, 3)
+    proxy = ServiceProxy(local, factory, "hollow-0").start()
+
+    http = HTTPClient.from_url(server.url)
+    yield http, proxy
+    proxy.stop()
+    for k in kubelets:
+        k.stop()
+    endpoints.stop()
+    mgr.stop()
+    sched.stop()
+    factory.stop()
+    server.stop()
+    local.close()
+
+
+def _deploy(http, name, replicas=3):
+    dep = meta.new_object("Deployment", name, "default")
+    dep["spec"] = {
+        "replicas": replicas,
+        "selector": {"matchLabels": {"app": name}},
+        "template": {"metadata": {"labels": {"app": name}},
+                     "spec": {"containers": [{
+                         "name": "c0", "image": "img",
+                         "resources": {"requests": {"cpu": "100m",
+                                                    "memory": "64Mi"}}}]}}}
+    http.create("deployments", dep)
+
+    def running():
+        pods, _ = http.list("pods", "default")
+        mine = [p for p in pods if meta.labels(p).get("app") == name]
+        return (len(mine) == replicas
+                and all(meta.pod_node_name(p) for p in mine)
+                and all((p.get("status") or {}).get("phase") == "Running"
+                        for p in mine))
+    assert wait_for(running)
+
+
+def test_workloads_deployment_to_running_pods(e2e):
+    http, _ = e2e
+    _deploy(http, "conf-web")
+    # owner chain: pod -> ReplicaSet -> Deployment
+    pods, _ = http.list("pods", "default")
+    pod = next(p for p in pods if meta.labels(p).get("app") == "conf-web")
+    rs_ref = meta.controller_ref(pod)
+    assert rs_ref["kind"] == "ReplicaSet"
+    rs = http.get("replicasets", "default", rs_ref["name"])
+    assert meta.controller_ref(rs)["kind"] == "Deployment"
+
+
+def test_service_endpointslice_proxy_path(e2e):
+    http, proxy = e2e
+    _deploy(http, "conf-be", replicas=2)  # own backends: order-independent
+    svc = meta.new_object("Service", "conf-svc", "default")
+    svc["spec"] = {"clusterIP": "10.96.7.7", "selector": {"app": "conf-be"},
+                   "ports": [{"port": 80, "protocol": "TCP"}]}
+    http.create("services", svc)
+    assert wait_for(lambda: any(
+        meta.labels(sl).get("kubernetes.io/service-name") == "conf-svc"
+        and sl.get("endpoints")
+        for sl in http.list("endpointslices", "default")[0]))
+    assert wait_for(lambda: proxy.route("10.96.7.7", 80) is not None)
+    backend_ip, backend_port = proxy.route("10.96.7.7", 80)
+    pods, _ = http.list("pods", "default")
+    pod_ips = {(p.get("status") or {}).get("podIP") for p in pods}
+    assert backend_ip in pod_ips
+
+
+def test_storage_dynamic_provisioning_and_protection(e2e):
+    http, _ = e2e
+    sc = meta.new_object("StorageClass", "conf-fast", None)
+    sc["provisioner"] = "tpu.kubernetes.io/host-provisioner"
+    http.create("storageclasses", sc)
+    pvc = meta.new_object("PersistentVolumeClaim", "conf-claim", "default")
+    pvc["spec"] = {"accessModes": ["ReadWriteOnce"],
+                   "storageClassName": "conf-fast",
+                   "resources": {"requests": {"storage": "1Gi"}}}
+    http.create("persistentvolumeclaims", pvc)
+    assert wait_for(lambda: (http.get("persistentvolumeclaims", "default",
+                                      "conf-claim").get("status") or {})
+                    .get("phase") == "Bound")
+    got = http.get("persistentvolumeclaims", "default", "conf-claim")
+    assert "kubernetes.io/pvc-protection" in got["metadata"]["finalizers"]
+    pv = http.get("persistentvolumes", "", got["spec"]["volumeName"])
+    assert (pv.get("spec") or {}).get("claimRef", {}).get(
+        "name") == "conf-claim"
+
+
+def test_scheduling_taints_and_tolerations(e2e):
+    http, _ = e2e
+
+    def taint(n):
+        n.setdefault("spec", {})["taints"] = [
+            {"key": "conf", "value": "x", "effect": "NoSchedule"}]
+        return n
+
+    def untaint(n):
+        n.setdefault("spec", {}).pop("taints", None)
+        return n
+
+    try:
+        # taint every node; an intolerant pod must stay Pending
+        for i in range(3):
+            http.guaranteed_update("nodes", "", f"hollow-{i}", taint)
+        pod = meta.new_object("Pod", "conf-taint", "default")
+        pod["spec"] = {"containers": [{"name": "c0", "image": "img"}],
+                       "schedulerName": "default-scheduler"}
+        http.create("pods", pod)
+        time.sleep(1.0)
+        assert not meta.pod_node_name(
+            http.get("pods", "default", "conf-taint"))
+        # tolerating pod schedules
+        tpod = meta.new_object("Pod", "conf-tol", "default")
+        tpod["spec"] = {"containers": [{"name": "c0", "image": "img"}],
+                        "tolerations": [{"key": "conf", "operator": "Exists",
+                                         "effect": "NoSchedule"}],
+                        "schedulerName": "default-scheduler"}
+        http.create("pods", tpod)
+        assert wait_for(lambda: meta.pod_node_name(
+            http.get("pods", "default", "conf-tol")))
+    finally:
+        # leave the shared nodes clean for whatever runs after
+        for i in range(3):
+            http.guaranteed_update("nodes", "", f"hollow-{i}", untaint)
+    # untaint -> the pending pod gets picked up on the cluster event
+    assert wait_for(lambda: meta.pod_node_name(
+        http.get("pods", "default", "conf-taint")))

@@ -12,16 +12,7 @@ from kubernetes_tpu.ops.flatten import Caps
 from kubernetes_tpu.parallel.backend import ShardedTPUBatchBackend
 from kubernetes_tpu.scheduler import Profile, Scheduler, new_default_framework
 from kubernetes_tpu.store import kv
-from kubernetes_tpu.testing import make_node, make_pod
-
-
-def wait_for(pred, timeout=60.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(0.05)
-    return False
+from kubernetes_tpu.testing import make_node, make_pod, wait_for
 
 
 def test_scheduler_end_to_end_on_mesh():

@@ -1,0 +1,94 @@
+"""Static verify tier (the reference's hack/verify-*.sh + test/typecheck):
+every module imports cleanly, public modules carry reference citations,
+and the wire-facing registries stay mutually consistent.
+"""
+
+import importlib
+import pathlib
+import pkgutil
+
+import kubernetes_tpu
+
+ROOT = pathlib.Path(kubernetes_tpu.__file__).parent
+
+
+def _walk_modules(include_packages: bool = True):
+    for mod in pkgutil.walk_packages([str(ROOT)], prefix="kubernetes_tpu."):
+        if mod.ispkg and not include_packages:
+            continue
+        yield mod.name
+
+
+def test_every_module_imports():
+    failures = []
+    for name in _walk_modules():
+        try:
+            importlib.import_module(name)
+        except Exception as e:  # noqa: BLE001
+            failures.append((name, repr(e)))
+    assert not failures, f"modules failed to import: {failures}"
+
+
+def test_subsystem_modules_cite_the_reference():
+    """Parity auditability: each subsystem module names the reference file
+    it mirrors (pkg/..., staging/..., cmd/...) in its docstring."""
+    missing = []
+    for name in _walk_modules(include_packages=False):
+        if ".testing" in name:
+            continue
+        mod = importlib.import_module(name)
+        doc = mod.__doc__ or ""
+        if not any(tok in doc for tok in ("pkg/", "staging/", "cmd/",
+                                          "test/", "build/", "hack/",
+                                          "component-base", "k8s.io/",
+                                          "scheduler-plugins", "BASELINE",
+                                          "SURVEY")):
+            missing.append(name)
+    assert not missing, f"modules without reference citations: {missing}"
+
+
+def test_cluster_scoped_sets_agree():
+    """The apiserver routing and HTTP client must key off the SAME
+    cluster-scoped set (or writes route to the wrong key).  Both sides
+    derive from clientset.CLUSTER_SCOPED_RESOURCES; this pins the sharing
+    so a fork can't sneak back in."""
+    import inspect
+
+    from kubernetes_tpu.apiserver.server import CLUSTER_SCOPED
+    from kubernetes_tpu.client.clientset import CLUSTER_SCOPED_RESOURCES
+    from kubernetes_tpu.client.http_client import HTTPClient
+
+    assert CLUSTER_SCOPED is CLUSTER_SCOPED_RESOURCES  # alias, not a fork
+    default = inspect.signature(HTTPClient.__init__) \
+        .parameters["cluster_scoped"].default
+    assert default is None  # None -> CLUSTER_SCOPED_RESOURCES at runtime
+    client = HTTPClient("127.0.0.1", 1)
+    assert client._cluster_scoped == CLUSTER_SCOPED_RESOURCES
+
+
+def test_controller_registry_complete():
+    """Every controller module's Controller subclass is constructible from
+    the manager's registry (a new controller that isn't wired in is dead
+    code).  Checks the ACTUAL ControllerManager.CTORS mapping."""
+    import inspect
+
+    from kubernetes_tpu.controllers.base import Controller
+    from kubernetes_tpu.controllers.manager import ControllerManager
+
+    wired = set(ControllerManager.CTORS.values())
+    # EndpointsController predates the manager and is wired directly by
+    # cmd/cluster + cmd/controller_manager
+    from kubernetes_tpu.controllers.endpoints import EndpointsController
+    wired.add(EndpointsController)
+    unwired = []
+    for name in _walk_modules():
+        if not name.startswith("kubernetes_tpu.controllers."):
+            continue
+        mod = importlib.import_module(name)
+        for _, cls in inspect.getmembers(mod, inspect.isclass):
+            if (issubclass(cls, Controller) and cls is not Controller
+                    and cls.__module__ == name
+                    and cls.name != "controller"
+                    and cls not in wired):
+                unwired.append((name, cls.__name__))
+    assert not unwired, f"controllers not registered in the manager: {unwired}"
