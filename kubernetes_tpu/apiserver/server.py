@@ -51,7 +51,10 @@ logger = logging.getLogger(__name__)
 
 from ..client.clientset import CLUSTER_SCOPED_RESOURCES
 
-# alias, not a copy: mutating a fork would re-split client/server routing
+# alias, not a copy (a fork would re-split client/server scoping); the
+# server enforces it on create: namespaced paths for these 400, and any
+# client-supplied metadata.namespace is stripped so storage keys match
+# the cluster-scoped read paths
 CLUSTER_SCOPED = CLUSTER_SCOPED_RESOURCES
 
 SUBRESOURCES = {"status", "binding", "eviction", "scale"}
@@ -533,7 +536,17 @@ class APIServer:
                 if r.subresource == "eviction":
                     self._post_eviction(r, obj)
                     return
-                if r.ns and "metadata" in obj:
+                if r.resource in CLUSTER_SCOPED:
+                    if r.ns:
+                        self._send_json(400, status_error(
+                            400, "BadRequest",
+                            f"{r.resource} is cluster-scoped"))
+                        return
+                    if "metadata" in obj:
+                        # stray namespace would fork the storage key away
+                        # from the cluster-scoped read path
+                        obj["metadata"].pop("namespace", None)
+                elif r.ns and "metadata" in obj:
                     obj["metadata"].setdefault("namespace", r.ns)
                 obj = self._admit(adm.CREATE, r, obj)
                 if obj is None:

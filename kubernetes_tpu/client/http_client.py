@@ -15,7 +15,7 @@ from typing import Callable
 from ..api import meta
 from ..api.meta import Obj
 from ..store import kv
-from .clientset import Client
+from .clientset import CLUSTER_SCOPED_RESOURCES, Client
 
 _ERRORS = {404: kv.NotFoundError, 409: kv.ConflictError, 410: kv.TooOldError}
 
@@ -97,10 +97,7 @@ class HTTPWatch:
 
 class HTTPClient(Client):
     def __init__(self, host: str, port: int, token: str | None = None,
-                 cluster_scoped: frozenset[str] | None = None):
-        from .clientset import CLUSTER_SCOPED_RESOURCES
-        if cluster_scoped is None:
-            cluster_scoped = CLUSTER_SCOPED_RESOURCES
+                 cluster_scoped: frozenset[str] = CLUSTER_SCOPED_RESOURCES):
         self.host, self.port = host, port
         self._headers = {"Content-Type": "application/json"}
         if token:

@@ -20,16 +20,7 @@ from kubernetes_tpu.client.clientset import (
 )
 from kubernetes_tpu.controllers import ControllerManager
 from kubernetes_tpu.store import kv
-from kubernetes_tpu.testing import make_node, make_pod
-
-
-def wait_for(predicate, timeout=30.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if predicate():
-            return True
-        time.sleep(0.02)
-    return False
+from kubernetes_tpu.testing import make_node, make_pod, wait_for
 
 
 @pytest.fixture
@@ -394,3 +385,72 @@ class TestBinderWakeups:
         assert wait_for(lambda: (client.get(PVCS, "default", "early-claim")
                                  .get("spec") or {}).get("volumeName")
                         == "late-pv")
+
+
+class TestCloudControllerManager:
+    def _ccm(self, client):
+        from kubernetes_tpu.client import SharedInformerFactory
+        from kubernetes_tpu.controllers.cloud import CloudControllerManager
+        factory = SharedInformerFactory(client)
+        ccm = CloudControllerManager(client, factory)
+        factory.start()
+        factory.wait_for_cache_sync()
+        ccm.run()
+        return factory, ccm
+
+    def test_loadbalancer_lifecycle(self, cluster):
+        _, client, _ = cluster
+        factory, ccm = self._ccm(client)
+        try:
+            svc = meta.new_object("Service", "lb-svc", "default")
+            svc["spec"] = {"type": "LoadBalancer", "clusterIP": "10.96.9.9",
+                           "ports": [{"port": 443}]}
+            client.create(SERVICES, svc)
+            assert wait_for(lambda: ((client.get(SERVICES, "default",
+                                                 "lb-svc").get("status")
+                                      or {}).get("loadBalancer") or {})
+                            .get("ingress"))
+            ip = client.get(SERVICES, "default", "lb-svc")[
+                "status"]["loadBalancer"]["ingress"][0]["ip"]
+            assert ip.startswith("203.0.113.")
+            # type change -> deprovision + status cleared
+            def retype(o):
+                o["spec"]["type"] = "ClusterIP"
+                return o
+            client.guaranteed_update(SERVICES, "default", "lb-svc", retype)
+            assert wait_for(lambda: not (client.get(SERVICES, "default",
+                                                    "lb-svc").get("status")
+                                         or {}).get("loadBalancer"))
+            assert "default/lb-svc" not in ccm.cloud._lbs
+        finally:
+            ccm.stop()
+            factory.stop()
+
+    def test_node_metadata_routes_and_taint(self, cluster):
+        _, client, _ = cluster
+        factory, ccm = self._ccm(client)
+        try:
+            n = make_node("cloud-1").build()
+            n["spec"]["taints"] = [{
+                "key": "node.cloudprovider.kubernetes.io/uninitialized",
+                "value": "true", "effect": "NoSchedule"}]
+            n["spec"]["podCIDR"] = "10.244.9.0/24"
+            client.create(NODES, n)
+            assert wait_for(lambda: (client.get(NODES, "", "cloud-1")
+                                     .get("spec") or {}).get("providerID"))
+            got = client.get(NODES, "", "cloud-1")
+            assert meta.labels(got)["topology.kubernetes.io/zone"] \
+                == "tpu-zone-a"
+            assert not any(
+                t.get("key").startswith("node.cloudprovider")
+                for t in got["spec"].get("taints") or ())
+            assert wait_for(
+                lambda: ccm.cloud.routes.get("cloud-1") == "10.244.9.0/24")
+            assert wait_for(lambda: any(
+                c.get("type") == "NetworkUnavailable"
+                and c.get("status") == "False"
+                for c in (client.get(NODES, "", "cloud-1").get("status")
+                          or {}).get("conditions") or ()))
+        finally:
+            ccm.stop()
+            factory.stop()

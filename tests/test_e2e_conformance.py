@@ -146,13 +146,30 @@ def test_scheduling_taints_and_tolerations(e2e):
         # taint every node; an intolerant pod must stay Pending
         for i in range(3):
             http.guaranteed_update("nodes", "", f"hollow-{i}", taint)
-        pod = meta.new_object("Pod", "conf-taint", "default")
-        pod["spec"] = {"containers": [{"name": "c0", "image": "img"}],
-                       "schedulerName": "default-scheduler"}
-        http.create("pods", pod)
-        time.sleep(1.0)
-        assert not meta.pod_node_name(
-            http.get("pods", "default", "conf-taint"))
+        # the scheduler's node informer may lag the taint writes; retry
+        # with fresh intolerant pods until one is REJECTED (deterministic:
+        # each attempt ends in either a bind — informer lagged, retry — or
+        # an Unschedulable condition)
+        taint_pod = None
+        for attempt in range(10):
+            name = f"conf-taint-{attempt}"
+            pod = meta.new_object("Pod", name, "default")
+            pod["spec"] = {"containers": [{"name": "c0", "image": "img"}],
+                           "schedulerName": "default-scheduler"}
+            http.create("pods", pod)
+
+            def settled(n=name):
+                cur = http.get("pods", "default", n)
+                return meta.pod_node_name(cur) or any(
+                    c.get("reason") == "Unschedulable"
+                    for c in (cur.get("status") or {}).get("conditions")
+                    or ())
+            assert wait_for(settled)
+            if not meta.pod_node_name(http.get("pods", "default", name)):
+                taint_pod = name
+                break
+            http.delete("pods", "default", name)  # raced the informer
+        assert taint_pod, "scheduler never observed the taints"
         # tolerating pod schedules
         tpod = meta.new_object("Pod", "conf-tol", "default")
         tpod["spec"] = {"containers": [{"name": "c0", "image": "img"}],
@@ -168,4 +185,4 @@ def test_scheduling_taints_and_tolerations(e2e):
             http.guaranteed_update("nodes", "", f"hollow-{i}", untaint)
     # untaint -> the pending pod gets picked up on the cluster event
     assert wait_for(lambda: meta.pod_node_name(
-        http.get("pods", "default", "conf-taint")))
+        http.get("pods", "default", taint_pod)))

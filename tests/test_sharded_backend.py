@@ -3,8 +3,6 @@
 Filter/Score/Assign over the node axis -> assume -> bind.
 """
 
-import time
-
 from kubernetes_tpu.api import meta
 from kubernetes_tpu.client import LocalClient, SharedInformerFactory
 from kubernetes_tpu.client.clientset import NODES, PODS
@@ -43,7 +41,7 @@ def test_scheduler_end_to_end_on_mesh():
                           .req(cpu="500m", mem="512Mi").build())
         assert wait_for(lambda: all(
             meta.pod_node_name(p)
-            for p in client.list(PODS, "default")[0]))
+            for p in client.list(PODS, "default")[0]), timeout=60.0)
         # every placement respects capacity (8 cpu per node => <=16 pods)
         per_node = {}
         for p in client.list(PODS, "default")[0]:
@@ -56,7 +54,8 @@ def test_scheduler_end_to_end_on_mesh():
         assert wait_for(lambda: any(
             c.get("reason") == "Unschedulable"
             for c in (client.get(PODS, "default", "mp-huge")
-                      .get("status") or {}).get("conditions") or ()))
+                      .get("status") or {}).get("conditions") or ()),
+            timeout=60.0)
     finally:
         sched.stop()
         factory.stop()

@@ -61,7 +61,7 @@ def test_cluster_scoped_sets_agree():
     assert CLUSTER_SCOPED is CLUSTER_SCOPED_RESOURCES  # alias, not a fork
     default = inspect.signature(HTTPClient.__init__) \
         .parameters["cluster_scoped"].default
-    assert default is None  # None -> CLUSTER_SCOPED_RESOURCES at runtime
+    assert default is CLUSTER_SCOPED_RESOURCES
     client = HTTPClient("127.0.0.1", 1)
     assert client._cluster_scoped == CLUSTER_SCOPED_RESOURCES
 
@@ -80,6 +80,12 @@ def test_controller_registry_complete():
     # cmd/cluster + cmd/controller_manager
     from kubernetes_tpu.controllers.endpoints import EndpointsController
     wired.add(EndpointsController)
+    # cloud controllers run under their OWN manager (a separate binary in
+    # the reference: cmd/cloud-controller-manager)
+    from kubernetes_tpu.controllers import cloud as cloud_mod
+    wired.update({cloud_mod.CloudServiceController,
+                  cloud_mod.CloudRouteController,
+                  cloud_mod.CloudNodeController})
     unwired = []
     for name in _walk_modules():
         if not name.startswith("kubernetes_tpu.controllers."):
