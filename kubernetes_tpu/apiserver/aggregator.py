@@ -37,8 +37,20 @@ HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "te",
 class AggregatorRegistry:
     """Maps (group, version) -> backend URL, fed by APIService objects."""
 
-    def __init__(self, store: kv.MemoryStore):
+    def __init__(self, store: kv.MemoryStore,
+                 local_groups: frozenset[str] | set[str] = frozenset(),
+                 is_local=None):
         self.store = store
+        # groups the apiserver serves itself.  The reference pre-registers
+        # Local APIService objects for built-in groups (kube-aggregator
+        # pkg/apiserver/apiservice.go) and its autoregister controller does
+        # the same for established CRD groups, so a service-backed
+        # APIService can never shadow either.  We enforce the same
+        # precedence: a static builtin set plus a dynamic predicate
+        # (CRD groups establish AFTER an APIService may have been applied,
+        # so the authoritative check happens at resolve time).
+        self._local_groups = frozenset(local_groups)
+        self._is_local_extra = is_local or (lambda group: False)
         self._lock = threading.Lock()
         # (group, version) -> (backend url, APIService name)
         self._routes: dict[tuple[str, str], tuple[str, str]] = {}
@@ -70,6 +82,14 @@ class AggregatorRegistry:
     def _apply(self, obj: dict, deleted: bool = False) -> None:
         gv = self._parse(obj)
         if gv is None:
+            return
+        if self._group_is_local(gv[0]):
+            # locally-served group: ignore the route so an APIService
+            # cannot hijack e.g. apps/v1 or an established CRD's traffic
+            if not deleted:
+                logger.warning(
+                    "aggregator: ignoring APIService %s for locally-served "
+                    "group %r", meta.name(obj), gv[0])
             return
         url = ((obj.get("spec") or {}).get("service") or {}).get("url")
         with self._lock:
@@ -109,11 +129,20 @@ class AggregatorRegistry:
 
     # -- the proxy -------------------------------------------------------
 
+    def _group_is_local(self, group: str) -> bool:
+        return (group == "" or group in self._local_groups
+                or self._is_local_extra(group))
+
     def resolve(self, path: str) -> tuple[str, str] | None:
         """(backend url, APIService name) for a proxied path, else None.
-        The single route lookup — callers pass the result to proxy_open."""
+        The single route lookup — callers pass the result to proxy_open.
+        Locally-served groups (builtins + established CRDs) never resolve
+        to a backend, even if a route slipped in before the CRD
+        established."""
         parts = [p for p in path.split("/") if p]
         if len(parts) < 3 or parts[0] != "apis":
+            return None
+        if self._group_is_local(parts[1]):
             return None
         with self._lock:
             return self._routes.get((parts[1], parts[2]))

@@ -127,6 +127,13 @@ class CRDRegistry:
         with self._lock:
             return self._by_plural.get(plural)
 
+    def groups(self) -> set:
+        """API groups currently served by established CRDs.  The aggregator
+        treats these as locally-served (the reference's autoregister
+        controller pins Local APIServices for CRD groups)."""
+        with self._lock:
+            return {info["group"] for info in self._by_plural.values()}
+
     def resources(self) -> List[dict]:
         with self._lock:
             seen = []

@@ -121,7 +121,9 @@ class APIServer:
         self.audit = audit_logger
         self.crds = crdlib.CRDRegistry()
         from . import aggregator as agglib
-        self.aggregator = agglib.AggregatorRegistry(store)
+        self.aggregator = agglib.AggregatorRegistry(
+            store, local_groups=set(BUILTIN_GROUPS),
+            is_local=lambda group: group in self.crds.groups())
         self.metrics = {"requests_total": 0, "watch_streams": 0,
                         "requests_rejected_total": 0}
         self._metrics_lock = threading.Lock()

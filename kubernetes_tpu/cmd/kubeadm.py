@@ -69,7 +69,8 @@ def init(args) -> None:
     fw = new_default_framework(client, factory)
     sched = Scheduler(client, factory, {"default-scheduler": Profile(fw)})
     mgr = ControllerManager(client, factory)
-    signer = BootstrapSigner(client, factory)
+    signer = BootstrapSigner(client, factory, server_url=server.url,
+                             ca_pem=ClusterCA.shared().ca_pem())
     factory.start()
     factory.wait_for_cache_sync()
     sched.run()
@@ -141,7 +142,29 @@ def join(args) -> None:
     if not hmac.compare_digest(want, sig):
         raise SystemExit("[discovery] cluster-info signature mismatch "
                          "(wrong token secret)")
-    _phase("discovery", "cluster-info signature verified")
+    # the signature is only useful if the signed payload pins the cluster
+    # identity: check the endpoint we dialed is the one the control plane
+    # published (MITM defense; reference validates the signed kubeconfig's
+    # server + CA in bootstraptoken/clusterinfo discovery)
+    try:
+        signed = json.loads(kubeconfig)
+        signed_cluster = (signed.get("clusters") or [{}])[0].get(
+            "cluster") or {}
+    except (ValueError, AttributeError, IndexError):
+        raise SystemExit("[discovery] signed kubeconfig is unparseable")
+    signed_server = signed_cluster.get("server")
+    if not signed_server:
+        raise SystemExit("[discovery] signed kubeconfig carries no server "
+                         "endpoint — refusing blind trust")
+    if signed_server.rstrip("/") != args.server.rstrip("/"):
+        raise SystemExit(f"[discovery] dialed {args.server} but the signed "
+                         f"cluster-info names {signed_server} — aborting")
+    ca_b64 = signed_cluster.get("certificate-authority-data")
+    if ca_b64:
+        ca_pem = base64.b64decode(ca_b64).decode()
+        _phase("discovery", "pinned cluster CA "
+               f"({hashlib.sha256(ca_pem.encode()).hexdigest()[:12]})")
+    _phase("discovery", "cluster-info signature verified; endpoint bound")
 
     _phase("kubelet-start", f"registering node {args.node_name}")
     client = HTTPClient.from_url(args.server)

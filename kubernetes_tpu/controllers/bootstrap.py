@@ -28,6 +28,25 @@ logger = logging.getLogger(__name__)
 BOOTSTRAP_TOKEN_TYPE = "bootstrap.kubernetes.io/token"
 TOKEN_SECRET_NS = "kube-system"
 CLUSTER_INFO_NS = "kube-public"
+
+
+def build_cluster_info_kubeconfig(server_url: str = "",
+                                  ca_pem: str = "") -> str:
+    """The kubeconfig stub published in cluster-info.  JSON (a valid
+    kubeconfig encoding) so join can parse it without a YAML dependency;
+    carries the apiserver endpoint and CA bundle — the two facts the JWS
+    exists to protect."""
+    import json as _json
+    cluster: dict = {}
+    if server_url:
+        cluster["server"] = server_url
+    if ca_pem:
+        cluster["certificate-authority-data"] = base64.b64encode(
+            ca_pem.encode()).decode("ascii")
+    return _json.dumps({
+        "apiVersion": "v1", "kind": "Config",
+        "clusters": [{"name": "", "cluster": cluster}],
+    }, sort_keys=True)
 CLUSTER_INFO_NAME = "cluster-info"
 
 
@@ -92,9 +111,26 @@ class BootstrapSigner(Controller):
 
     name = "bootstrapsigner"
 
-    def __init__(self, client, factory, kubeconfig: str = ""):
+    def __init__(self, client, factory, kubeconfig: str = "",
+                 server_url: str = "", ca_pem: str = ""):
         super().__init__(client, factory)
-        self.kubeconfig = kubeconfig or "apiVersion: v1\nkind: Config\n"
+        # The signed payload must BIND cluster identity — endpoint + CA —
+        # or the signature only proves token knowledge (bootstrapsigner.go
+        # signs a kubeconfig carrying the CA bundle and server address).
+        # When constructed from the manager registry (no explicit URL),
+        # derive the endpoint from the HTTP client so the published
+        # cluster-info stays joinable; in-process LocalClients have no
+        # endpoint and publish a stub join must reject.
+        if not server_url and hasattr(client, "host"):
+            server_url = f"http://{client.host}:{client.port}"
+        if not ca_pem:
+            try:
+                from .certificates import ClusterCA
+                ca_pem = ClusterCA.shared().ca_pem()
+            except Exception:  # cryptography unavailable: stub CA omitted
+                ca_pem = ""
+        self.kubeconfig = kubeconfig or build_cluster_info_kubeconfig(
+            server_url, ca_pem)
         self.secret_informer = factory.informer(SECRETS)
         self.cm_informer = factory.informer(CONFIGMAPS)
         self.secret_informer.add_event_handler(self._on_change)

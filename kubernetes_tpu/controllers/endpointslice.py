@@ -11,6 +11,7 @@ diff it against the informer's view).
 
 from __future__ import annotations
 
+import hashlib
 import logging
 
 from ..api import meta
@@ -25,6 +26,35 @@ logger = logging.getLogger(__name__)
 
 MAX_ENDPOINTS_PER_SLICE = 100
 SERVICE_NAME_LABEL = "kubernetes.io/service-name"
+
+
+def _numeric_or_service_port(pt: dict):
+    tp = pt.get("targetPort", pt.get("port"))
+    return tp if isinstance(tp, int) else pt.get("port")
+
+
+def _resolve_ports(svc_ports: list, pod: Obj) -> list[dict]:
+    """Per-endpoint port resolution: a string targetPort names a container
+    port on the pod (reference resolves named ports per endpoint in
+    endpointslice/reconciler.go); unresolvable names fall back to the
+    service port so the proxier never sees a non-numeric backend port."""
+    out = []
+    containers = (pod.get("spec") or {}).get("containers") or []
+    for pt in svc_ports:
+        tp = pt.get("targetPort", pt.get("port"))
+        if isinstance(tp, str):
+            resolved = None
+            for c in containers:
+                for cp in c.get("ports") or []:
+                    if cp.get("name") == tp:
+                        resolved = cp.get("containerPort")
+                        break
+                if resolved is not None:
+                    break
+            tp = resolved if resolved is not None else pt.get("port")
+        out.append({"name": pt.get("name", ""), "port": tp,
+                    "protocol": pt.get("protocol", "TCP")})
+    return out
 
 
 class EndpointSliceController(Controller):
@@ -62,38 +92,59 @@ class EndpointSliceController(Controller):
             return
         sel = selector_from_dict(
             {"matchLabels": (svc["spec"] or {}).get("selector") or {}})
-        endpoints = []
+        svc_ports = list(svc["spec"].get("ports") or ())
+        # endpoints grouped by their RESOLVED port numbers: a named
+        # targetPort can map to different container ports on different
+        # pods, and slice ports are per-slice, so each distinct mapping
+        # gets its own slice group (reference reconciler behavior)
+        groups: dict[tuple, list[dict]] = {}
         for p in self.pod_informer.list(ns):
             # unready pods are included with ready=False (slices publish
             # readiness as a condition, unlike legacy Endpoints subsets)
             if (sel.matches(meta.labels(p)) and meta.pod_node_name(p)
                     and meta.deletion_timestamp(p) is None
                     and not meta.pod_is_terminal(p)):
-                endpoints.append({
-                    "addresses": [((p.get("status") or {}).get("podIP"))
-                                  or "0.0.0.0"],
-                    "conditions": {"ready": pod_is_ready(p)},
-                    "nodeName": meta.pod_node_name(p),
-                    "targetRef": {"kind": "Pod", "namespace": ns,
-                                  "name": meta.name(p), "uid": meta.uid(p)},
-                })
-        endpoints.sort(key=lambda e: e["targetRef"]["name"])
-        ports = [{"name": pt.get("name", ""), "port": pt.get("targetPort",
-                                                             pt.get("port")),
-                  "protocol": pt.get("protocol", "TCP")}
-                 for pt in (svc["spec"].get("ports") or ())]
+                ports = _resolve_ports(svc_ports, p)
+                groups.setdefault(
+                    tuple((pt["name"], pt["port"], pt["protocol"])
+                          for pt in ports), []).append({
+                              "addresses": [((p.get("status") or {})
+                                             .get("podIP")) or "0.0.0.0",],
+                              "conditions": {"ready": pod_is_ready(p)},
+                              "nodeName": meta.pod_node_name(p),
+                              "targetRef": {"kind": "Pod", "namespace": ns,
+                                            "name": meta.name(p),
+                                            "uid": meta.uid(p)},
+                          })
+        if not groups:
+            groups[tuple((pt.get("name", ""),
+                          _numeric_or_service_port(pt), pt.get(
+                              "protocol", "TCP")) for pt in svc_ports)] = []
 
         desired: list[Obj] = []
-        chunks = [endpoints[i:i + MAX_ENDPOINTS_PER_SLICE]
-                  for i in range(0, len(endpoints), MAX_ENDPOINTS_PER_SLICE)]
-        for i, chunk in enumerate(chunks or [[]]):
-            sl = meta.new_object("EndpointSlice", f"{name}-{i}", ns)
-            sl["metadata"]["labels"] = {SERVICE_NAME_LABEL: name}
-            sl["metadata"]["ownerReferences"] = [owner_ref(svc, "Service")]
-            sl["addressType"] = "IPv4"
-            sl["endpoints"] = chunk
-            sl["ports"] = ports
-            desired.append(sl)
+        for ports_key in sorted(groups):
+            endpoints = sorted(groups[ports_key],
+                               key=lambda e: e["targetRef"]["name"])
+            ports = [{"name": nm_, "port": port_, "protocol": proto_}
+                     for nm_, port_, proto_ in ports_key]
+            # slice names are stable per port-group (digest suffix), so a
+            # group appearing/vanishing never renames other groups' slices
+            # (a shared running index would delete+recreate them and spam
+            # every proxier with no-op watch events)
+            gid = hashlib.sha256(repr(ports_key).encode()).hexdigest()[:8]
+            chunks = [endpoints[i:i + MAX_ENDPOINTS_PER_SLICE]
+                      for i in range(0, len(endpoints),
+                                     MAX_ENDPOINTS_PER_SLICE)] or [[]]
+            for i, chunk in enumerate(chunks):
+                sl = meta.new_object("EndpointSlice",
+                                     f"{name}-{gid}-{i}", ns)
+                sl["metadata"]["labels"] = {SERVICE_NAME_LABEL: name}
+                sl["metadata"]["ownerReferences"] = [owner_ref(svc,
+                                                               "Service")]
+                sl["addressType"] = "IPv4"
+                sl["endpoints"] = chunk
+                sl["ports"] = ports
+                desired.append(sl)
 
         want = {meta.name(sl): sl for sl in desired}
         have = {meta.name(sl): sl for sl in existing}

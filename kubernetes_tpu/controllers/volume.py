@@ -431,15 +431,13 @@ class AttachDetachController(Controller):
                 have[(spec.get("source") or {}).get("persistentVolumeName",
                                                     "")] = va
         for vol in want - set(have):
-            # reference uses csi-<sha256(attacher+vol+node)>; we keep the
-            # readable prefix but guarantee uniqueness with a digest suffix
-            # (plain [:253] truncation can collide two volumes on one node)
-            raw = f"{node}-{vol}"
-            if len(raw) > 253:
-                import hashlib
-                raw = raw[:240] + "-" + hashlib.sha256(
-                    raw.encode()).hexdigest()[:12]
-            va = meta.new_object("VolumeAttachment", raw, None)
+            # reference names are csi-<sha256(attacher+vol+node)> BECAUSE
+            # concatenation is ambiguous: (node "a", vol "b-c") and
+            # (node "a-b", vol "c") both make "a-b-c".  Always digest.
+            import hashlib
+            va_name = "va-" + hashlib.sha256(
+                f"{node}/{vol}".encode()).hexdigest()[:32]
+            va = meta.new_object("VolumeAttachment", va_name, None)
             va["spec"] = {"attacher": "tpu.kubernetes.io/host-attacher",
                           "nodeName": node,
                           "source": {"persistentVolumeName": vol}}

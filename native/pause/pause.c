@@ -1,58 +1,86 @@
-/* pause — the pod-sandbox init process.
+/* ktpu-pause: minimal init for a simulated pod sandbox.
  *
- * Reference behavior: build/pause/linux/pause.c (68 LoC) — the only native
- * program in the reference tree.  It holds a pod's shared namespaces open
- * and reaps zombies re-parented to it:
- *   - SIGINT/SIGTERM -> exit cleanly
- *   - SIGCHLD        -> waitpid(-1, ..., WNOHANG) loop
- *   - otherwise      -> pause() forever
- * Built via native/Makefile; the hollow runtime doesn't exec it (sandboxes
- * are simulated), but a real CRI integration points its sandbox image here.
+ * Role (behavioral parity with the reference's sandbox init,
+ * build/pause/linux/pause.c): keep the pod's shared namespaces alive,
+ * reap orphaned children, and terminate on the runtime's stop signal.
+ *
+ * Design (deliberately different from the reference): instead of
+ * installing async signal handlers and sleeping in pause(), we block the
+ * signals of interest and drain them synchronously with sigwaitinfo().
+ * This keeps all logic on the main thread — no handler reentrancy rules
+ * to respect — and makes the state machine a plain loop:
+ *
+ *     mask {TERM, INT, CHLD}  ->  wait  ->  reap | quit
+ *
+ * Built via native/Makefile.  The hollow CRI runtime never execs this
+ * (sandboxes are simulated); a real CRI integration would use it as the
+ * sandbox image's entrypoint.
  */
 
+#include <errno.h>
 #include <signal.h>
 #include <stdio.h>
-#include <stdlib.h>
 #include <string.h>
-#include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
 
-#define VERSION "tpu-pause-1.0"
+static const char kVersion[] = "ktpu-pause 2.0";
 
-static void sigdown(int signo) {
-  psignal(signo, "shutting down, got signal");
-  exit(0);
-}
-
-static void sigreap(int signo) {
-  (void)signo;
-  while (waitpid(-1, NULL, WNOHANG) > 0)
-    ;
+/* Collect every exited child without blocking; orphans in the pid
+ * namespace re-parent to us, so this doubles as the zombie reaper. */
+static void reap_children(void) {
+  pid_t done;
+  do {
+    done = waitpid(-1, NULL, WNOHANG);
+  } while (done > 0);
 }
 
 int main(int argc, char **argv) {
-  for (int i = 1; i < argc; ++i) {
-    if (!strcmp(argv[i], "-v") || !strcmp(argv[i], "--version")) {
-      printf("%s\n", VERSION);
+  sigset_t watched;
+  int arg;
+
+  for (arg = 1; arg < argc; ++arg) {
+    if (strcmp(argv[arg], "--version") == 0 || strcmp(argv[arg], "-V") == 0) {
+      puts(kVersion);
       return 0;
     }
   }
+
   if (getpid() != 1)
-    fprintf(stderr, "warning: pause should be the first process\n");
+    fprintf(stderr,
+            "ktpu-pause: running as pid %d (expected to be the sandbox "
+            "init)\n",
+            (int)getpid());
 
-  if (sigaction(SIGINT, &(struct sigaction){.sa_handler = sigdown}, NULL) < 0)
-    return 1;
-  if (sigaction(SIGTERM, &(struct sigaction){.sa_handler = sigdown}, NULL) < 0)
-    return 2;
-  if (sigaction(SIGCHLD,
-                &(struct sigaction){.sa_handler = sigreap,
-                                    .sa_flags = SA_NOCLDSTOP},
-                NULL) < 0)
-    return 3;
+  sigemptyset(&watched);
+  sigaddset(&watched, SIGTERM);
+  sigaddset(&watched, SIGINT);
+  sigaddset(&watched, SIGCHLD);
+  if (sigprocmask(SIG_BLOCK, &watched, NULL) != 0) {
+    perror("ktpu-pause: sigprocmask");
+    return 10;
+  }
 
-  for (;;)
-    pause();
-  fprintf(stderr, "error: infinite loop terminated\n");
-  return 42;
+  for (;;) {
+    siginfo_t info;
+    if (sigwaitinfo(&watched, &info) < 0) {
+      if (errno == EINTR)
+        continue;
+      perror("ktpu-pause: sigwaitinfo");
+      return 11;
+    }
+    switch (info.si_signo) {
+    case SIGCHLD:
+      reap_children();
+      break;
+    case SIGTERM:
+    case SIGINT:
+      fprintf(stderr, "ktpu-pause: exiting on %s\n", strsignal(info.si_signo));
+      /* final sweep so no zombie outlives the sandbox */
+      reap_children();
+      return 0;
+    default:
+      break;
+    }
+  }
 }

@@ -94,6 +94,60 @@ class TestAggregator:
             backend.stop()
             server.stop()
 
+    def test_builtin_group_cannot_be_shadowed(self):
+        """An APIService naming a built-in group (e.g. v1.apps) must NOT
+        redirect apps/v1 traffic to an external backend — the reference's
+        Local APIServices always win (kube-aggregator apiservice.go)."""
+        store = kv.MemoryStore()
+        server = APIServer(store).start()
+        backend = _EchoBackend()
+        try:
+            apisvc = meta.new_object("APIService", "v1.apps", None)
+            apisvc["spec"] = {"group": "apps", "version": "v1",
+                              "service": {"url": backend.url}}
+            store.create(APISERVICES, apisvc)
+            time.sleep(0.6)
+            code, body = http("GET", f"{server.url}/apis/apps/v1/deployments")
+            assert code == 200
+            assert "backend" not in body and "items" in body
+        finally:
+            backend.stop()
+            server.stop()
+
+    def test_crd_group_cannot_be_shadowed(self):
+        """A service-backed APIService must not hijack a group served by an
+        established CRD — even if the APIService was created FIRST (the
+        reference autoregister controller pins Local APIServices for CRD
+        groups)."""
+        store = kv.MemoryStore()
+        server = APIServer(store).start()
+        backend = _EchoBackend()
+        try:
+            apisvc = meta.new_object("APIService", "v1.widgets.example.com",
+                                     None)
+            apisvc["spec"] = {"group": "widgets.example.com", "version": "v1",
+                              "service": {"url": backend.url}}
+            store.create(APISERVICES, apisvc)
+            time.sleep(0.6)
+            crd = meta.new_object("CustomResourceDefinition",
+                                  "widgets.widgets.example.com", None)
+            crd["spec"] = {"group": "widgets.example.com",
+                           "names": {"plural": "widgets", "kind": "Widget"},
+                           "scope": "Namespaced",
+                           "versions": [{"name": "v1", "served": True,
+                                         "storage": True}]}
+            code, _ = http("POST", f"{server.url}/apis/apiextensions.k8s.io"
+                           "/v1/customresourcedefinitions", crd)
+            assert code in (200, 201)
+            code, body = http(
+                "GET", f"{server.url}/apis/widgets.example.com/v1/"
+                "namespaces/default/widgets")
+            assert code == 200
+            assert "backend" not in body and "items" in body
+        finally:
+            backend.stop()
+            server.stop()
+
     def test_unreachable_backend_returns_503(self):
         store = kv.MemoryStore()
         server = APIServer(store).start()

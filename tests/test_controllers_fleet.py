@@ -72,6 +72,45 @@ class TestEndpointSlice:
             lambda: not client.list(ENDPOINTSLICES, "default")[0])
 
 
+class TestEndpointSliceNamedPorts:
+    def test_named_target_port_resolves_per_pod(self, cluster):
+        """String targetPorts resolve against each pod's container ports;
+        pods with different mappings land in separate slices (reference
+        endpointslice/reconciler.go resolves named ports per endpoint)."""
+        _, client, _ = cluster
+        svc = meta.new_object("Service", "api", "default")
+        svc["spec"] = {"selector": {"app": "api"},
+                       "ports": [{"port": 80, "targetPort": "http",
+                                  "protocol": "TCP"}]}
+        client.create(SERVICES, svc)
+        p1 = bound_running_pod("a1", labels={"app": "api"})
+        p1["spec"]["containers"] = [{"name": "c0", "image": "img",
+                                     "ports": [{"name": "http",
+                                                "containerPort": 8080}]}]
+        p2 = bound_running_pod("a2", labels={"app": "api"})
+        p2["spec"]["containers"] = [{"name": "c0", "image": "img",
+                                     "ports": [{"name": "http",
+                                                "containerPort": 9090}]}]
+        client.create(PODS, p1)
+        client.create(PODS, p2)
+
+        def resolved():
+            sls = [s for s in client.list(ENDPOINTSLICES, "default")[0]
+                   if meta.labels(s).get("kubernetes.io/service-name")
+                   == "api"]
+            got = {}
+            for s in sls:
+                for ep in s.get("endpoints") or ():
+                    got[ep["targetRef"]["name"]] = [
+                        pt["port"] for pt in s.get("ports") or ()]
+            return got == {"a1": [8080], "a2": [9090]}
+        assert wait_for(resolved)
+        # no slice may carry a non-numeric port (the proxier consumes these)
+        for s in client.list(ENDPOINTSLICES, "default")[0]:
+            for pt in s.get("ports") or ():
+                assert isinstance(pt["port"], int)
+
+
 class TestReplicationController:
     def test_scales_up_and_down(self, cluster):
         _, client, _ = cluster
