@@ -64,8 +64,13 @@ def run_once() -> dict:
     if not stats.get("barrier_ok", False):
         return {"error": "pods left unscheduled", "value": 0.0,
                 "detail": summary.to_dict()}
+    detail = summary.to_dict()
+    e2e = stats.get("e2e") or {}
+    if e2e:
+        detail["pod_e2e_p50_ms"] = e2e.get("p50_ms")
+        detail["pod_e2e_p99_ms"] = e2e.get("p99_ms")
     return {"value": summary.average, "wall_s": round(wall, 1),
-            "detail": summary.to_dict()}
+            "detail": detail}
 
 
 def emit(value: float, extra: dict) -> None:

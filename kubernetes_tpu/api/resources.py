@@ -114,9 +114,11 @@ def pod_request(pod: dict) -> Resource:
     return total
 
 
-def pod_request_nonzero(pod: dict) -> Resource:
-    """Like pod_request but with scoring defaults applied (non_zero.go)."""
-    r = pod_request(pod)
+def pod_request_nonzero(pod: dict, request: Resource | None = None) -> Resource:
+    """Like pod_request but with scoring defaults applied (non_zero.go).
+    Pass an already-computed pod_request to skip the re-parse (PodInfo hot
+    path computes both)."""
+    r = request.clone() if request is not None else pod_request(pod)
     if r.milli_cpu == 0:
         r.milli_cpu = DEFAULT_MILLI_CPU_REQUEST
     if r.memory == 0:

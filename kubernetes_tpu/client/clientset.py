@@ -141,14 +141,16 @@ class Client:
         must never sit on the scheduling/binding critical path).  Overflow
         drops events, like the broadcaster's bounded queue."""
         import time as _t
-        ev = meta.new_object("Event", f"{meta.name(regarding)}.{int(_t.time()*1e6):x}",
-                             meta.namespace(regarding) or "default")
-        ev.update({
-            "type": type_, "reason": reason, "message": message,
-            "involvedObject": {"kind": regarding.get("kind"),
-                               "namespace": meta.namespace(regarding),
-                               "name": meta.name(regarding), "uid": meta.uid(regarding)},
-        })
+        md = regarding["metadata"]
+        ns = md.get("namespace", "")
+        nm = md["name"]
+        ev = {"apiVersion": "v1", "kind": "Event",
+              "metadata": {"name": f"{nm}.{int(_t.time() * 1e6):x}",
+                           "namespace": ns or "default"},
+              "type": type_, "reason": reason, "message": message,
+              "involvedObject": {"kind": regarding.get("kind"),
+                                 "namespace": ns, "name": nm,
+                                 "uid": md.get("uid", "")}}
         self._event_sink(ev)
 
     _event_init_lock = __import__("threading").Lock()
@@ -256,4 +258,16 @@ class LocalClient(Client):
         return self.store.bind_many(PODS, bindings)
 
     def create_events(self, events: list[Obj]) -> None:
-        self.store.create_many(EVENTS, events)
+        # broadcaster-owned objects, never touched after the flush:
+        # ownership transfer, no inbound copy
+        self.store.create_many(EVENTS, events, copy=False)
+
+    def create_pods_bulk(self, pods: list[Obj]) -> None:
+        """Chunked bulk pod submission (perf-harness transport analog of
+        the reference's 5000-QPS burst client).  Ownership transfer: the
+        caller must not touch the pod objects after this call (copy=False).
+        Raises on the first error — harness payloads are generated, not
+        user input."""
+        for obj, err in self.store.create_many(PODS, pods, copy=False):
+            if err is not None:
+                raise err

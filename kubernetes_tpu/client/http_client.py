@@ -52,6 +52,13 @@ class HTTPWatch:
         self._stopped = False
         self._lock = threading.Lock()
 
+    def next_batch(self, timeout: float | None = None):
+        """kv.Watch.next_batch parity for bulk informer consumption: over
+        HTTP we read one framed event per call (the socket stream has no
+        cheap drain), so a batch is just 0-or-1 events."""
+        ev = self.next(timeout)
+        return [ev] if ev is not None else []
+
     def next(self, timeout: float | None = None):
         if self._stopped:
             return None

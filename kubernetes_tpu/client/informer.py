@@ -48,6 +48,7 @@ class Informer:
         self._dispatch_lock = threading.RLock()
         self._indexer: dict[str, Obj] = {}
         self._handlers: list[EventHandler] = []
+        self._bulk_handlers: list[Callable[[list], None]] = []
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -94,6 +95,20 @@ class Informer:
                 for obj in objs:
                     handler(kv.ADDED, obj, None)
 
+    def add_bulk_event_handler(self, handler: Callable[[list], None]) -> None:
+        """Register a BULK handler: called with a list of
+        (event_type, obj, old) triples covering a whole watch burst, after
+        per-event handlers.  Consumers with per-event lock overhead (the
+        scheduler's queue/cache) use this to amortize it; semantics are
+        identical to receiving the triples one at a time, in order."""
+        with self._dispatch_lock:
+            self._bulk_handlers.append(handler)
+            if self._synced.is_set():
+                with self._lock:
+                    objs = list(self._indexer.values())
+                if objs:
+                    handler([(kv.ADDED, obj, None) for obj in objs])
+
     def start(self) -> None:
         if self._thread is not None:
             return
@@ -137,40 +152,67 @@ class Informer:
                 self._indexer = fresh
             # Replace semantics: diff old vs new and emit synthetic events
             # (DeltaFIFO Replace -> Sync/Delete).
+            triples = []
             for key, obj in fresh.items():
                 prev = old.get(key)
                 if prev is None:
-                    self._dispatch(kv.ADDED, obj, None)
+                    triples.append((kv.ADDED, obj, None))
                 elif meta.resource_version(prev) != meta.resource_version(obj):
-                    self._dispatch(kv.MODIFIED, obj, prev)
+                    triples.append((kv.MODIFIED, obj, prev))
             for key, prev in old.items():
                 if key not in fresh:
-                    self._dispatch(kv.DELETED, prev, None)
+                    triples.append((kv.DELETED, prev, None))
+            self._dispatch_all(triples)
             self._synced.set()  # inside the lock: registration either
             # replays this state or receives the live stream — no gap
 
         w = self.client.watch(self.resource, since_rv=rv)
         try:
             while not self._stop.is_set():
-                ev = w.next(timeout=0.5)
-                if ev is None:
+                evs = w.next_batch(timeout=0.5)
+                if not evs:
                     if w.stopped:
                         return
                     continue
-                key = meta.namespaced_name(ev.object)
+                # apply the whole burst to the indexer under ONE lock
+                # acquisition, then dispatch; per-resource ordering is
+                # preserved (single informer thread, in-order drain)
+                triples = []
                 with self._dispatch_lock:
-                    if ev.type == kv.DELETED:
-                        with self._lock:
-                            old_obj = self._indexer.pop(key, None)
-                        self._dispatch(kv.DELETED, ev.object, old_obj)
-                    else:
-                        with self._lock:
-                            prev = self._indexer.get(key)
-                            self._indexer[key] = ev.object
-                        self._dispatch(kv.MODIFIED if prev is not None
-                                       else kv.ADDED, ev.object, prev)
+                    with self._lock:
+                        for ev in evs:
+                            key = meta.namespaced_name(ev.object)
+                            if ev.type == kv.DELETED:
+                                prev = self._indexer.pop(key, None)
+                                triples.append((kv.DELETED, ev.object, prev))
+                            else:
+                                prev = self._indexer.get(key)
+                                self._indexer[key] = ev.object
+                                triples.append(
+                                    (kv.MODIFIED if prev is not None
+                                     else kv.ADDED, ev.object, prev))
+                    self._dispatch_all(triples)
         finally:
             w.stop()
+
+    def _dispatch_all(self, triples: list) -> None:
+        """Run per-event handlers event-by-event, then bulk handlers once.
+        Caller holds _dispatch_lock."""
+        if not triples:
+            return
+        for type_, obj, old in triples:
+            for h in self._handlers:
+                try:
+                    h(type_, obj, old)
+                except Exception:  # pragma: no cover
+                    logger.exception("informer %s: handler error on %s",
+                                     self.resource, type_)
+        for bh in self._bulk_handlers:
+            try:
+                bh(triples)
+            except Exception:  # pragma: no cover
+                logger.exception("informer %s: bulk handler error",
+                                 self.resource)
 
     def _dispatch(self, type_: str, obj: Obj, old: Obj | None) -> None:
         for h in self._handlers:

@@ -63,12 +63,14 @@ def decode_results(assignments, n: int, batch_size: int, escapes: set,
     pod slot to (node_name, status).  `row_infos` is the node_infos list
     CAPTURED AT DISPATCH — a later dispatch may recycle rows, so names must
     resolve against the batch's own view."""
+    rows = np.asarray(assignments).tolist()  # ONE bulk convert, not
+    # int(arr[i]) per pod (np scalar indexing costs ~0.5µs each)
     results: list[tuple[str | None, Status | None]] = []
     for i in range(n):
-        if i >= batch_size or i in escapes:
+        if i >= batch_size or (escapes and i in escapes):
             results.append((None, Status(SKIP, "escape to per-pod path")))
             continue
-        row = int(assignments[i])
+        row = rows[i]
         if row < 0:
             results.append((None, Status(UNSCHEDULABLE, no_fit_msg)))
             continue
@@ -200,27 +202,31 @@ class TPUBatchBackend(BatchBackend):
             m[f][rows_a] = getattr(t, f)[rows_a]
 
     def _replay(self, batch: PodBatch, assignments: np.ndarray) -> None:
-        """Apply the kernel's commit rules to the host mirror."""
+        """Apply the kernel's commit rules to the host mirror.  Fully
+        vectorized: np.add.at / maximum.at accumulate correctly when many
+        pods land on the same row (a per-pod Python loop here cost
+        ~15ms/batch at bench shapes)."""
         t, m = self.tensors, self._mirror
-        for p in range(min(len(assignments), self.batch_size)):
-            row = int(assignments[p])
-            if row < 0:
-                continue
-            m["used"][row] += batch.req[p]
-            m["used_nz"][row] += batch.req_nz[p]
-            m["npods"][row] += 1.0
-            np.minimum(m["port_mask"][row] + batch.ports[p], 1.0,
-                       out=m["port_mask"][row])
-            for sg in range(len(t.sgs)):
-                if batch.inc_sg[p, sg] > 0:
-                    d = t.dom_sg[sg, row]
-                    if d >= 0:
-                        m["cd_sg"][sg, d] += 1.0
-            for a in range(len(t.asgs)):
-                if batch.inc_asg[p, a] > 0:
-                    d = t.dom_asg[a, row]
-                    if d >= 0:
-                        m["cd_asg"][a, d] += 1.0
+        n = min(len(assignments), self.batch_size)
+        rows = np.asarray(assignments[:n], np.int64)
+        placed = np.nonzero(rows >= 0)[0]
+        if placed.size == 0:
+            return
+        prow = rows[placed]
+        np.add.at(m["used"], prow, batch.req[placed])
+        np.add.at(m["used_nz"], prow, batch.req_nz[placed])
+        np.add.at(m["npods"], prow, 1.0)
+        np.maximum.at(m["port_mask"], prow, batch.ports[placed])
+        for sg in range(len(t.sgs)):
+            inc = placed[batch.inc_sg[placed, sg] > 0]
+            if inc.size:
+                d = t.dom_sg[sg, rows[inc]]
+                np.add.at(m["cd_sg"][sg], d[d >= 0], 1.0)
+        for a in range(len(t.asgs)):
+            inc = placed[batch.inc_asg[placed, a] > 0]
+            if inc.size:
+                d = t.dom_asg[a, rows[inc]]
+                np.add.at(m["cd_asg"][a], d[d >= 0], 1.0)
 
     def _pick_variant(self, batch: PodBatch):
         """The device endpoint has high per-op overhead, so batches that use

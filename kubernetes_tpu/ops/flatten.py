@@ -251,11 +251,23 @@ class ClusterTensors:
         """Incremental refresh; returns True if anything changed."""
         return bool(self.update_from_snapshot_tracked(snapshot))
 
-    def update_from_snapshot_tracked(self, snapshot: Snapshot) -> list[int]:
-        """Incremental refresh; returns the rows re-encoded this call."""
+    def update_from_snapshot_tracked(self, snapshot) -> list[int]:
+        """Incremental refresh; returns the rows re-encoded this call.
+
+        Accepts either an immutable scheduler Snapshot or a zero-copy
+        cache view (scheduler/cache.py CacheFlattenView): views run the
+        whole re-encode under the cache lock so rows are never encoded
+        from a NodeInfo mid-mutation, and skip the per-dirty-node clone
+        the Snapshot path pays."""
+        run_locked = getattr(snapshot, "run_locked", None)
+        if run_locked is not None:
+            return run_locked(self._update_from_nodes_tracked)
+        return self._update_from_nodes_tracked(snapshot.node_info_list)
+
+    def _update_from_nodes_tracked(self, node_info_list) -> list[int]:
         dirty: list[int] = []
         live = set()
-        for ni in snapshot.node_info_list:
+        for ni in node_info_list:
             live.add(ni.name)
             row = self.row_of.get(ni.name)
             if row is None:

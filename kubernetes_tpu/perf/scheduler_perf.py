@@ -199,8 +199,19 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
             created_nodes += count
         elif opcode == "createPods":
             count = op["count"]
-            for i in range(count):
-                cluster.client.create(PODS, _default_pod(created_pods + i, op))
+            creator = getattr(cluster.client, "create_pods_bulk", None)
+            if creator is not None and count >= 256:
+                # bulk submission in chunks (the reference harness pumps
+                # pods through a 5000-QPS/5000-burst client, util.go:92;
+                # chunked create_many is the LocalClient transport analog)
+                for lo in range(0, count, 512):
+                    chunk = [_default_pod(created_pods + i, op)
+                             for i in range(lo, min(lo + 512, count))]
+                    creator(chunk)
+            else:
+                for i in range(count):
+                    cluster.client.create(PODS,
+                                          _default_pod(created_pods + i, op))
             created_pods += count
         elif opcode == "barrier":
             want = op.get("count", created_pods)
@@ -249,6 +260,7 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         stats = run_workload(cluster, ops, collector)
         summary = collector.stop()
         stats["wall"] = time.monotonic() - t0
+        stats["e2e"] = cluster.scheduler.metrics.e2e_summary()
         return summary, stats
     finally:
         cluster.shutdown()

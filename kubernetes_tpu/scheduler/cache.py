@@ -178,6 +178,22 @@ class Cache:
                 self._add_pod_to_node(pod)
                 self._pod_states[key] = _PodState(pod)
 
+    def confirm_or_add_pods(self, pods: list[Obj]) -> None:
+        """Bulk add_pod for a burst of newly-bound watch events (the
+        scheduler's own binds coming back).  Fast path: the pod is assumed
+        on the same node — just swap in the confirmed state.  Everything
+        else takes the ordinary add_pod route.  One lock round per burst."""
+        with self._lock:
+            for pod in pods:
+                key = meta.namespaced_name(pod)
+                ps = self._pod_states.get(key)
+                if ps is not None and ps.assumed and (
+                        meta.pod_node_name(ps.pod) == meta.pod_node_name(pod)):
+                    self._pod_states[key] = _PodState(pod)
+                    self._assumed_pods.discard(key)
+                else:
+                    self.add_pod(pod)  # RLock: safe to re-enter
+
     def update_pod(self, old: Obj, new: Obj) -> None:
         key = meta.namespaced_name(new)
         with self._lock:
@@ -305,6 +321,11 @@ class Cache:
                     pvc for ni in snapshot.node_info_list for pvc in ni.pvc_ref_counts}
             return snapshot
 
+    def flatten_view(self) -> "CacheFlattenView":
+        """Zero-copy view for the TPU batch flattener (see
+        CacheFlattenView)."""
+        return CacheFlattenView(self)
+
     def comparison_snapshot(self) -> tuple[set[str], set[str], set[str]]:
         """(node names, pod keys, assumed pod keys) under one lock — the
         comparer's view (internal/cache/debugger/comparer.go)."""
@@ -320,3 +341,23 @@ class Cache:
                 "assumed_pods": sorted(self._assumed_pods),
                 "pod_count": self.pod_count(),
             }
+
+
+class CacheFlattenView:
+    """Zero-copy alternative to update_snapshot for the TPU batch path.
+
+    The per-pod oracle path needs an immutable Snapshot because its
+    Filter/Score loops read NodeInfos over a long cycle.  The batch
+    flattener only needs each NodeInfo for the microseconds it takes to
+    re-encode its row, so it can read the cache's live NodeInfos directly —
+    under the cache lock — and skip the NodeInfo.clone per dirty node
+    (~8µs/pod at bench scale, reference analog: the generation-delta copy
+    in internal/cache/cache.go:197 that this view makes unnecessary)."""
+
+    def __init__(self, cache: Cache):
+        self._cache = cache
+
+    def run_locked(self, fn):
+        c = self._cache
+        with c._lock:
+            return fn([ni for ni in c._nodes.values() if ni.node is not None])

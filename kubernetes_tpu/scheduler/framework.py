@@ -523,6 +523,21 @@ class Framework:
             return s
         return Status(ERROR, "all bind plugins skipped")
 
+    def batch_tail_trivial(self) -> bool:
+        """True when the Reserve/Permit/WaitOnPermit/PreBind/PostBind hooks
+        are PROVABLY no-ops for a pod whose CycleState is empty — every
+        plugin at those points is `state_gated` (acts only on state written
+        by its own PreFilter, which the batch path never runs) and no
+        Permit plugin exists (so nothing can ever be in the waiting map).
+        The batch bind tail uses this to skip the per-pod hook loops
+        wholesale; adding e.g. Coscheduling (Permit) or any non-gated
+        reserve plugin turns the full path back on automatically."""
+        return (not self.permit
+                and all(getattr(p, "state_gated", False) for p in self.reserve)
+                and all(getattr(p, "state_gated", False) for p in self.pre_bind)
+                and all(getattr(p, "state_gated", False)
+                        for p in self.post_bind))
+
     def run_post_bind_plugins(self, state: CycleState, pod_info: PodInfo,
                               node_name: str) -> None:
         for p in self.post_bind:

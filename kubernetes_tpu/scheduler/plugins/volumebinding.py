@@ -92,6 +92,11 @@ def _access_modes_ok(pvc: dict, pv: dict) -> bool:
 
 class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin):
     name = "VolumeBinding"
+    # Reserve/PreBind act ONLY on CycleState written by this plugin's own
+    # PreFilter (st is None -> immediate no-op).  The batch tail uses this
+    # to prove the whole hook loop is skippable for batch-path pods, whose
+    # CycleState is always empty (scheduler.Framework.batch_tail_trivial).
+    state_gated = True
 
     def __init__(self, client=None, informer_factory=None):
         self.client = client

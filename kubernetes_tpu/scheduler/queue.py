@@ -167,6 +167,28 @@ class SchedulingQueue:
             self.nominator.add_nominated_pod(qpi.pod_info)
             self._cond.notify()
 
+    def add_many(self, pods: list[Obj]) -> None:
+        """Bulk add: PodInfo parsing happens OUTSIDE the lock (it is the
+        expensive part), then one locked loop + one wakeup for the burst."""
+        qpis = [QueuedPodInfo(PodInfo(p)) for p in pods]
+        with self._cond:
+            for qpi in qpis:
+                self._backoff.remove(qpi.key)
+                self._unschedulable.pop(qpi.key, None)
+                self._active.push(qpi)
+                self.nominator.add_nominated_pod(qpi.pod_info)
+            self._cond.notify()
+
+    def delete_many(self, pods: list[Obj]) -> None:
+        """Bulk delete (scheduler bind confirmations) under one lock."""
+        with self._cond:
+            for pod in pods:
+                key = meta.namespaced_name(pod)
+                self._active.remove(key)
+                self._backoff.remove(key)
+                self._unschedulable.pop(key, None)
+                self.nominator.delete_nominated_pod_if_exists(pod)
+
     def scheduling_cycle(self) -> int:
         with self._lock:
             return self._scheduling_cycle

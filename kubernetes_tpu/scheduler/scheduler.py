@@ -65,6 +65,11 @@ class SchedulerMetrics:
         self.schedule_attempts = {"scheduled": 0, "unschedulable": 0, "error": 0}
         self.scheduling_latency_sum = 0.0
         self.scheduling_latencies: list[float] = []
+        # submit->bind per pod: queue admission (QueuedPodInfo creation)
+        # to bind write confirmed.  The OTHER half of the north-star metric
+        # (p99 <10ms); reference: pod_scheduling_duration_seconds
+        # (pkg/scheduler/metrics/metrics.go:55-75)
+        self.pod_e2e_latencies: list[float] = []
         self.preemption_attempts = 0
 
     def observe_attempt(self, result: str, latency: float,
@@ -89,6 +94,31 @@ class SchedulerMetrics:
         self.prom.schedule_attempts.inc(float(len(latencies)), result, profile)
         self.prom.scheduling_attempt_duration.observe_many(latencies, result,
                                                            profile)
+
+    def observe_e2e(self, lat_attempts: list[tuple[float, int]]) -> None:
+        """Record submit->bind latencies for successfully-bound pods.
+        Entries are (latency_seconds, attempts) — the prom histogram is
+        labelled by attempt count like the reference's."""
+        if not lat_attempts:
+            return
+        with self.lock:
+            self.pod_e2e_latencies.extend(l for l, _ in lat_attempts)
+        by_attempts: dict[str, list[float]] = {}
+        for lat, att in lat_attempts:
+            by_attempts.setdefault(str(att), []).append(lat)
+        for att, ls in by_attempts.items():
+            self.prom.pod_scheduling_duration.observe_many(ls, att)
+
+    def e2e_summary(self) -> dict:
+        """Percentiles over all recorded submit->bind latencies (ms)."""
+        with self.lock:
+            xs = sorted(self.pod_e2e_latencies)
+        if not xs:
+            return {}
+        def pct(p: float) -> float:
+            return round(1e3 * xs[min(int(len(xs) * p), len(xs) - 1)], 2)
+        return {"count": len(xs), "p50_ms": pct(0.50), "p90_ms": pct(0.90),
+                "p99_ms": pct(0.99), "max_ms": round(1e3 * xs[-1], 2)}
 
     def observe_preemption(self, victims: int) -> None:
         with self.lock:
@@ -209,8 +239,50 @@ class Scheduler:
     def _wire_event_handlers(self) -> None:
         pods = self.informer_factory.informer(PODS)
         nodes = self.informer_factory.informer(NODES)
-        pods.add_event_handler(self._on_pod_event)
+        if hasattr(pods, "add_bulk_event_handler"):
+            pods.add_bulk_event_handler(self._on_pod_events)
+        else:  # pragma: no cover - non-bulk informer stand-ins
+            pods.add_event_handler(self._on_pod_event)
         nodes.add_event_handler(self._on_node_event)
+
+    def _on_pod_events(self, triples: list) -> None:
+        """Bulk pod-event handler: the two burst-dominant cases — new
+        unbound pods entering the queue, and this scheduler's own binds
+        coming back as watch confirmations — are applied with one lock
+        round per burst instead of one per pod.  Everything else falls
+        through to the per-event path, with flush barriers so same-pod
+        event order is preserved exactly."""
+        queue_adds: list[Obj] = []
+        confirms: list[Obj] = []
+
+        def flush() -> None:
+            if queue_adds:
+                self.queue.add_many(queue_adds)
+                queue_adds.clear()
+            if confirms:
+                self.cache.confirm_or_add_pods(confirms)
+                self.queue.delete_many(confirms)
+                # one coalesced move: move_all processes every parked pod
+                # per call, so N per-pod calls and 1 call are equivalent
+                self.queue.move_all_to_active_or_backoff(
+                    ClusterEvent("AssignedPod", "Add"))
+                confirms.clear()
+
+        for t, pod, old in triples:
+            bound = bool(meta.pod_node_name(pod))
+            if t == kv.ADDED and not bound:
+                if self._responsible_for(pod):
+                    queue_adds.append(pod)
+            elif (t == kv.MODIFIED and bound
+                    and not (old and meta.pod_node_name(old))
+                    and old is not None
+                    and meta.deletion_timestamp(pod) is None
+                    and not meta.pod_is_terminal(pod)):
+                confirms.append(pod)
+            else:
+                flush()
+                self._on_pod_event(t, pod, old)
+        flush()
 
     def _responsible_for(self, pod: Obj) -> bool:
         name = (pod.get("spec") or {}).get("schedulerName", "default-scheduler")
@@ -610,8 +682,11 @@ class Scheduler:
                     return
             self.cache.finish_binding(assumed)
             fw.run_post_bind_plugins(state, pod_info, node_name)
-            self.metrics.observe_attempt("scheduled", time.monotonic() - start,
+            now = time.monotonic()
+            self.metrics.observe_attempt("scheduled", now - start,
                                          fw.profile_name)
+            self.metrics.observe_e2e(
+                [(now - qpi.initial_attempt_timestamp, qpi.attempts)])
             self.client.create_event(pod_info.pod, "Scheduled",
                                      f"Successfully assigned {qpi.key} to {node_name}")
         except Exception as e:  # pragma: no cover
@@ -701,15 +776,17 @@ class Scheduler:
             self._deferred.extend(ext_pods)
         if not live:
             return None
-        snapshot = Snapshot() if not hasattr(self, "_snapshot") else self._snapshot
-        self._snapshot = self.cache.update_snapshot(snapshot)
-        resolve = backend.dispatch([q.pod_info for q in live], self._snapshot)
+        # zero-copy flatten: the backend re-encodes dirty node rows straight
+        # from cache NodeInfos under the cache lock — no Snapshot clone on
+        # the batch path (the per-pod oracle keeps its immutable Snapshot)
+        view = self.cache.flatten_view()
+        resolve = backend.dispatch([q.pod_info for q in live], view)
         if resolve is FLUSH_FIRST:
             # the batch needs device-state repair; drain the in-flight batch
-            # and its tail, refresh the snapshot, and re-dispatch clean
+            # and its tail (so the authoritative state catches up), then
+            # re-dispatch clean
             self._flush_pending()
-            self._snapshot = self.cache.update_snapshot(self._snapshot)
-            resolve = backend.dispatch([q.pod_info for q in live], self._snapshot)
+            resolve = backend.dispatch([q.pod_info for q in live], view)
             if resolve is FLUSH_FIRST:  # pragma: no cover - nothing in flight
                 raise RuntimeError("backend demanded flush with empty pipeline")
         return profile, live, resolve, cycle, start
@@ -753,11 +830,24 @@ class Scheduler:
                            qpi.pod_info.clone_with_pod(assumed)))
         # phase 2: ONE bulk assume (single cache lock for the whole batch)
         errs = self.cache.assume_pods([(a, pi) for _, _, a, pi in placed])
+        ok: list[tuple[QueuedPodInfo, str, Obj]] = []
         for (qpi, node_name, assumed, _pi), err in zip(placed, errs):
             if err is not None:
                 self._handle_failure(fw, qpi, Status(ERROR, err), cycle,
                                      set(), start)
-                continue
+            else:
+                ok.append((qpi, node_name, assumed))
+        if not ok:
+            return
+        # turbo tail: with an empty CycleState the hook loops are provably
+        # no-ops (batch_tail_trivial) and the Bind step is the DefaultBinder
+        # — go straight to the bulk store bind, skipping the per-pod
+        # Reserve/Permit/WaitOnPermit/PreBind calls entirely
+        if fw.batch_tail_trivial() and self._bulk_bindable(fw):
+            self._submit_binding(self._binding_cycle_turbo, fw, ok, cycle,
+                                 start)
+            return
+        for qpi, node_name, assumed in ok:
             state = CycleState()
             pod_info = qpi.pod_info
             st = fw.run_reserve_plugins(state, pod_info, node_name)
@@ -781,6 +871,18 @@ class Scheduler:
         if bulk:
             self._submit_binding(self._binding_cycle_bulk, fw, bulk,
                                  cycle, start)
+
+    def _binding_cycle_turbo(self, fw: Framework,
+                             items: list[tuple[QueuedPodInfo, str, Obj]],
+                             cycle: int, start: float) -> None:
+        """Bind tail for the provably-trivial case (batch_tail_trivial +
+        DefaultBinder): no per-pod plugin hook calls at all — straight to
+        the shared bulk commit.  The shared empty CycleState is sound
+        because no plugin on this path reads or writes state."""
+        state = CycleState()
+        self._bulk_bind_commit(
+            fw, [(state, qpi, node, assumed) for qpi, node, assumed in items],
+            cycle, start, run_post_bind=False)
 
     def _submit_binding(self, fn, *args) -> None:
         """Submit a binding cycle to the pool; if the pool was shut down
@@ -827,6 +929,16 @@ class Scheduler:
                                    Status(ERROR, str(e)), cycle)
         if not ready:
             return
+        self._bulk_bind_commit(fw, ready, cycle, start, run_post_bind=True)
+
+    def _bulk_bind_commit(self, fw: Framework,
+                          ready: list[tuple[CycleState, QueuedPodInfo, str, Obj]],
+                          cycle: int, start: float,
+                          run_post_bind: bool) -> None:
+        """Shared bind/confirm/metrics tail for the bulk paths: ONE bulk
+        bind write, bulk cache confirm, bulk metrics/events; per-pod
+        failure handling identical to _binding_cycle (Forget + unreserve +
+        requeue)."""
         bindings = [(meta.namespace(q.pod), meta.name(q.pod), node)
                     for _, q, node, _ in ready]
         try:
@@ -849,13 +961,18 @@ class Scheduler:
         # route an already-bound pod through _bind_failure (which would
         # forget + requeue it)
         self.cache.finish_bindings([a for _, _, _, a in bound])
-        latency = time.monotonic() - start
+        now = time.monotonic()
+        latency = now - start
+        self.metrics.observe_e2e(
+            [(now - q.initial_attempt_timestamp, q.attempts)
+             for _, q, _, _ in bound])
         for state, qpi, node_name, assumed in bound:
-            try:
-                fw.run_post_bind_plugins(state, qpi.pod_info, node_name)
-            except Exception:
-                logger.exception("post-bind tail failed for %s (pod stays "
-                                 "bound to %s)", qpi.key, node_name)
+            if run_post_bind:
+                try:
+                    fw.run_post_bind_plugins(state, qpi.pod_info, node_name)
+                except Exception:
+                    logger.exception("post-bind tail failed for %s (pod stays "
+                                     "bound to %s)", qpi.key, node_name)
             self.client.create_event(qpi.pod, "Scheduled",
                                      f"Successfully assigned {qpi.key} to {node_name}")
         self.metrics.observe_attempts("scheduled", [latency] * len(bound),

@@ -166,31 +166,44 @@ class PodInfo:
         self.labels = meta.labels(pod)
         self.priority = spec.get("priority") or 0
         self.request = pod_request(pod)
-        self.request_nonzero = pod_request_nonzero(pod)
+        self.request_nonzero = pod_request_nonzero(pod, self.request)
         self.scheduler_name = spec.get("schedulerName", "default-scheduler")
         self.nominated_node_name = (pod.get("status") or {}).get("nominatedNodeName", "")
 
-        ns = meta.namespace(pod)
-        affinity = spec.get("affinity") or {}
-        pa = affinity.get("podAffinity") or {}
-        paa = affinity.get("podAntiAffinity") or {}
-        self.required_affinity_terms = _compile_terms(
-            pa.get("requiredDuringSchedulingIgnoredDuringExecution"), ns)
-        self.required_anti_affinity_terms = _compile_terms(
-            paa.get("requiredDuringSchedulingIgnoredDuringExecution"), ns)
-        self.preferred_affinity_terms = _compile_terms(
-            pa.get("preferredDuringSchedulingIgnoredDuringExecution"), ns, weighted=True)
-        self.preferred_anti_affinity_terms = _compile_terms(
-            paa.get("preferredDuringSchedulingIgnoredDuringExecution"), ns, weighted=True)
-
-        na = affinity.get("nodeAffinity") or {}
+        affinity = spec.get("affinity")
         self.node_selector = spec.get("nodeSelector") or {}
-        req = na.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
-        self.node_affinity_required = [
-            _compile_node_selector_term(t) for t in req.get("nodeSelectorTerms") or ()]
-        self.node_affinity_preferred = [
-            (p.get("weight", 0), _compile_node_selector_term(p.get("preference") or {}))
-            for p in na.get("preferredDuringSchedulingIgnoredDuringExecution") or ()]
+        if not affinity:
+            # hot path: most pods carry no affinity stanza at all
+            self.required_affinity_terms = _EMPTY_TERMS
+            self.required_anti_affinity_terms = _EMPTY_TERMS
+            self.preferred_affinity_terms = _EMPTY_TERMS
+            self.preferred_anti_affinity_terms = _EMPTY_TERMS
+            self.node_affinity_required = _EMPTY_TERMS
+            self.node_affinity_preferred = _EMPTY_TERMS
+        else:
+            ns = meta.namespace(pod)
+            pa = affinity.get("podAffinity") or {}
+            paa = affinity.get("podAntiAffinity") or {}
+            self.required_affinity_terms = _compile_terms(
+                pa.get("requiredDuringSchedulingIgnoredDuringExecution"), ns)
+            self.required_anti_affinity_terms = _compile_terms(
+                paa.get("requiredDuringSchedulingIgnoredDuringExecution"), ns)
+            self.preferred_affinity_terms = _compile_terms(
+                pa.get("preferredDuringSchedulingIgnoredDuringExecution"), ns,
+                weighted=True)
+            self.preferred_anti_affinity_terms = _compile_terms(
+                paa.get("preferredDuringSchedulingIgnoredDuringExecution"),
+                ns, weighted=True)
+
+            na = affinity.get("nodeAffinity") or {}
+            req = na.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+            self.node_affinity_required = [
+                _compile_node_selector_term(t)
+                for t in req.get("nodeSelectorTerms") or ()]
+            self.node_affinity_preferred = [
+                (p.get("weight", 0),
+                 _compile_node_selector_term(p.get("preference") or {}))
+                for p in na.get("preferredDuringSchedulingIgnoredDuringExecution") or ()]
 
         self.tolerations = spec.get("tolerations") or []
         self.host_ports = _collect_host_ports(spec)
@@ -244,10 +257,20 @@ def node_selector_terms_match(terms: list[tuple[Selector, Selector]], node: Obj)
     return False
 
 
+_EMPTY_PORTS: list[tuple[str, str, int]] = []
+# shared empties for the no-affinity fast path; treated as immutable
+_EMPTY_TERMS: list = []
+
+
 def _collect_host_ports(spec: Obj) -> list[tuple[str, str, int]]:
-    """[(protocol, hostIP, hostPort)] for all containers."""
+    """[(protocol, hostIP, hostPort)] for all containers.  Fast path: most
+    pods declare no container ports at all (PodInfo hot path)."""
+    containers = spec.get("containers") or ()
+    inits = spec.get("initContainers")
+    if not inits and not any("ports" in c for c in containers):
+        return _EMPTY_PORTS
     out = []
-    for c in itertools.chain(spec.get("containers") or (), spec.get("initContainers") or ()):
+    for c in itertools.chain(containers, inits or ()):
         for p in c.get("ports") or ():
             hp = p.get("hostPort", 0)
             if hp:
