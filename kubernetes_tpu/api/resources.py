@@ -73,10 +73,11 @@ def _parse_resource_list(rl: dict[str, Any] | None) -> Resource:
     if not rl:
         return Resource()
     try:
-        key = tuple(sorted(rl.items()))
+        # lru_cache hashes the key, so unhashable VALUES raise there —
+        # keep the cached call inside the try
+        return _parse_resource_list_cached(tuple(sorted(rl.items()))).clone()
     except TypeError:
         return _parse_resource_list_uncached(rl)
-    return _parse_resource_list_cached(key).clone()
 
 
 @functools.lru_cache(maxsize=4096)
@@ -98,6 +99,42 @@ def _parse_resource_list_uncached(rl: dict[str, Any]) -> Resource:
         else:
             r.scalar[k] = parse_quantity(v)
     return r
+
+
+@functools.lru_cache(maxsize=4096)
+def _request_pair_cached(key: tuple) -> tuple[Resource, Resource]:
+    """(request, request_nonzero) as SHARED FROZEN instances for a
+    single-container requests shape.  Callers must treat both as
+    immutable (consumers only read them: NodeInfo add/sub read `other`,
+    plugins and the flattener only read fields)."""
+    r = _parse_resource_list_uncached(dict(key))
+    nz = r.clone()
+    if nz.milli_cpu == 0:
+        nz.milli_cpu = DEFAULT_MILLI_CPU_REQUEST
+    if nz.memory == 0:
+        nz.memory = DEFAULT_MEMORY_REQUEST
+    return r, nz
+
+
+def pod_request_pair(pod: dict) -> tuple[Resource, Resource]:
+    """(pod_request, pod_request_nonzero) with a shared-instance fast path
+    for the dominant pod shape (one container, no initContainers, no
+    overhead).  The returned Resources are SHARED and must not be mutated;
+    pods outside the fast shape get private instances."""
+    spec = pod.get("spec") or {}
+    containers = spec.get("containers") or ()
+    if (len(containers) == 1 and not spec.get("initContainers")
+            and not spec.get("overhead")):
+        rl = (containers[0].get("resources") or {}).get("requests")
+        try:
+            # the lru_cache HASHES the key, so the unhashable-value
+            # TypeError surfaces there — the call must sit inside the try
+            return _request_pair_cached(
+                tuple(sorted(rl.items())) if rl else ())
+        except TypeError:
+            pass  # unhashable values: fall through to the general path
+    r = pod_request(pod)
+    return r, pod_request_nonzero(pod, r)
 
 
 def pod_request(pod: dict) -> Resource:

@@ -262,12 +262,12 @@ class LocalClient(Client):
         # ownership transfer, no inbound copy
         self.store.create_many(EVENTS, events, copy=False)
 
-    def create_pods_bulk(self, pods: list[Obj]) -> None:
-        """Chunked bulk pod submission (perf-harness transport analog of
-        the reference's 5000-QPS burst client).  Ownership transfer: the
-        caller must not touch the pod objects after this call (copy=False).
-        Raises on the first error — harness payloads are generated, not
-        user input."""
-        for obj, err in self.store.create_many(PODS, pods, copy=False):
+    def create_bulk(self, resource: str, objs: list[Obj]) -> None:
+        """Bulk object submission (perf-harness transport analog of the
+        reference's 5000-QPS burst client, util.go:92).  Ownership
+        transfer: the caller must not touch the objects after this call
+        (copy=False).  Raises on the first error — harness payloads are
+        generated, not user input."""
+        for obj, err in self.store.create_many(resource, objs, copy=False):
             if err is not None:
                 raise err

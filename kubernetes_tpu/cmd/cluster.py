@@ -14,6 +14,8 @@ import threading
 
 
 def main(argv=None) -> None:
+    from ..utils.gctune import tune_for_throughput
+    tune_for_throughput()
     ap = argparse.ArgumentParser(prog="tpu-cluster")
     ap.add_argument("--secure-port", type=int, default=8080)
     ap.add_argument("--nodes", type=int, default=3)

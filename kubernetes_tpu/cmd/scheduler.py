@@ -14,6 +14,8 @@ import threading
 
 
 def main(argv=None) -> None:
+    from ..utils.gctune import tune_for_throughput
+    tune_for_throughput()
     ap = argparse.ArgumentParser(prog="tpu-scheduler")
     ap.add_argument("--server", default="http://127.0.0.1:8080")
     ap.add_argument("--token", default=None)

@@ -38,7 +38,9 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..api import meta
-from ..api.labels import EXISTS, GT, IN, LT, NOT_IN, DOES_NOT_EXIST, Selector
+from ..api.labels import (
+    EXISTS, GT, IN, LT, NOT_IN, DOES_NOT_EXIST, Selector, selector_from_dict,
+)
 from ..api.meta import Obj
 from ..scheduler.cache import Snapshot
 from ..scheduler.plugins.nodebasic import toleration_tolerates_taint
@@ -505,7 +507,20 @@ class BatchEncoder:
             sel_forb_ids=np.full((P, 8), -1, np.int32),
             key_ids=np.full((P, c.kg_cap, 4), -1, np.int32),
         )
-        for i, pi in enumerate(pod_infos[:P]):
+        pods = pod_infos[:P]
+        n = len(pods)
+        if n:
+            # request vectors column-wise in bulk (the rows are fresh
+            # zeros, so only the core columns + rare scalars need writes;
+            # a per-pod _encode_resource pair cost ~3µs/pod)
+            b.req[:n, 0] = [pi.request.milli_cpu for pi in pods]
+            b.req[:n, 1] = [pi.request.memory for pi in pods]
+            b.req[:n, 2] = [pi.request.ephemeral_storage for pi in pods]
+            b.req_nz[:n, 0] = [pi.request_nonzero.milli_cpu for pi in pods]
+            b.req_nz[:n, 1] = [pi.request_nonzero.memory for pi in pods]
+            b.req_nz[:n, 2] = [pi.request_nonzero.ephemeral_storage
+                               for pi in pods]
+        for i, pi in enumerate(pods):
             try:
                 ok = self._encode_pod(b, i, pi)
             except VocabFullError:
@@ -553,8 +568,15 @@ class BatchEncoder:
                 # volume binding/zones/limits are deeply stateful (PVC/PV/
                 # StorageClass lookups + API writes at PreBind): oracle path
                 return False
-        self.t._encode_resource(b.req[i], pi.request)
-        self.t._encode_resource(b.req_nz[i], pi.request_nonzero)
+        # (core request columns were filled column-wise in encode();
+        # scalar resources are rare enough to stay per-pod — and their
+        # VocabFullError must route this pod to the escape path)
+        if pi.request.scalar:
+            for name, v in pi.request.scalar.items():
+                b.req[i, CORE_R + t.scalar_vocab.get(name)] = v
+        if pi.request_nonzero.scalar:
+            for name, v in pi.request_nonzero.scalar.items():
+                b.req_nz[i, CORE_R + t.scalar_vocab.get(name)] = v
 
         # taints: mark every vocab taint this pod does NOT tolerate
         for tid, (key, value, effect) in enumerate(t.taint_vocab.items):
@@ -624,7 +646,6 @@ class BatchEncoder:
             b.c_weight[i, ci] = weight
             ci += 1
 
-        from ..api.labels import selector_from_dict
         ns = meta.namespace(pi.pod)
         for tsc in pi.topology_spread_constraints:
             sel = selector_from_dict(tsc.get("labelSelector"))

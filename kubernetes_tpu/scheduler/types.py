@@ -21,6 +21,7 @@ from ..api.labels import Selector, selector_from_dict
 from ..api.meta import Obj
 from ..api.resources import (
     Resource, node_allocatable, pod_request, pod_request_nonzero,
+    pod_request_pair,
 )
 
 # --- Status codes (framework/interface.go:84-120) -------------------------
@@ -165,8 +166,9 @@ class PodInfo:
         self.uid = meta.uid(pod)
         self.labels = meta.labels(pod)
         self.priority = spec.get("priority") or 0
-        self.request = pod_request(pod)
-        self.request_nonzero = pod_request_nonzero(pod, self.request)
+        # shared frozen instances for the common shape (see
+        # resources.pod_request_pair) — never mutated by consumers
+        self.request, self.request_nonzero = pod_request_pair(pod)
         self.scheduler_name = spec.get("schedulerName", "default-scheduler")
         self.nominated_node_name = (pod.get("status") or {}).get("nominatedNodeName", "")
 
