@@ -16,6 +16,9 @@ def main(argv=None) -> None:
     ap.add_argument("--encrypt-secrets", action="store_true",
                     help="KMS envelope encryption of Secrets at rest "
                          "(EncryptionConfiguration kms provider equivalent)")
+    ap.add_argument("--data-dir", default=None,
+                    help="directory for the store's WAL + snapshots; "
+                         "omitting it runs memory-only (no durability)")
     ap.add_argument("-v", "--verbosity", type=int, default=1)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
@@ -25,9 +28,16 @@ def main(argv=None) -> None:
 
     transformers = None
     if args.encrypt_secrets:
+        import os
         from ..store.encryption import EnvelopeTransformer, LocalKMS
-        transformers = {"secrets": EnvelopeTransformer(LocalKMS())}
-    store = kv.MemoryStore(history=1_000_000, transformers=transformers)
+        key_file = None
+        if args.data_dir:  # durable store needs a durable KEK ring
+            os.makedirs(args.data_dir, exist_ok=True)
+            key_file = os.path.join(args.data_dir, "kms-keys.json")
+        transformers = {"secrets": EnvelopeTransformer(
+            LocalKMS(key_file=key_file))}
+    store = kv.MemoryStore(history=1_000_000, transformers=transformers,
+                           durable_dir=args.data_dir)
     server = APIServer(store, host=args.bind_address, port=args.secure_port,
                        token=args.token).start()
     print(f"apiserver listening on {server.url}")

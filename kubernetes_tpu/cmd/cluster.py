@@ -24,6 +24,9 @@ def main(argv=None) -> None:
     ap.add_argument("--devices-per-node", type=int, default=0,
                     help="give each hollow node N google.com/tpu devices "
                          "(exercises the kubelet device/topology managers)")
+    ap.add_argument("--data-dir", default=None,
+                    help="directory for the store's WAL + snapshots; "
+                         "omitting it runs memory-only (no durability)")
     ap.add_argument("-v", "--verbosity", type=int, default=1)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
@@ -37,7 +40,7 @@ def main(argv=None) -> None:
     from ..scheduler import Profile, Scheduler, new_default_framework
     from ..store import kv
 
-    store = kv.MemoryStore(history=1_000_000)
+    store = kv.MemoryStore(history=1_000_000, durable_dir=args.data_dir)
     server = APIServer(store, port=args.secure_port).start()
     client = LocalClient(store)
     factory = SharedInformerFactory(client)

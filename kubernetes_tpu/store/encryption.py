@@ -32,19 +32,42 @@ class LocalKMS:
     """In-process KMS plugin: holds KEKs by key id (kms v2 Encrypt/Decrypt).
 
     rotate() adds a new KEK and makes it current; old key ids keep
-    decrypting (the reference's multi-key DecryptRequest behavior)."""
+    decrypting (the reference's multi-key DecryptRequest behavior).
 
-    def __init__(self) -> None:
+    key_file (optional) persists the KEK ring so sealed objects recovered
+    from a durable store stay decryptable across restarts — the role the
+    external KMS's own storage plays for the reference; without it the
+    keys are process-lifetime only (fine for a memory-only store)."""
+
+    def __init__(self, key_file: str | None = None) -> None:
         self._lock = threading.Lock()
         self._keys: dict[str, bytes] = {}
         self._current = ""
-        self.rotate()
+        self._key_file = key_file
+        if key_file and os.path.exists(key_file):
+            with open(key_file) as f:
+                ring = json.load(f)
+            self._keys = {k: base64.b64decode(v) for k, v in
+                          ring["keys"].items()}
+            self._current = ring["current"]
+        else:
+            self.rotate()
 
     def rotate(self) -> str:
         with self._lock:
             kid = f"key-{len(self._keys) + 1}"
             self._keys[kid] = os.urandom(32)
             self._current = kid
+            if self._key_file:
+                tmp = self._key_file + ".tmp"
+                with open(tmp, "w") as f:
+                    os.fchmod(f.fileno(), 0o600)
+                    json.dump({"current": kid,
+                               "keys": {k: base64.b64encode(v).decode()
+                                        for k, v in self._keys.items()}}, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._key_file)
             return kid
 
     @property

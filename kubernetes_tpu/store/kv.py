@@ -21,6 +21,13 @@ Design:
 
 Thread-safe; all blocking happens in Watch.next(), never under the lock.
 
+Durability (etcd WAL + snapshot equivalent, store/wal.py): pass
+durable_dir= to persist every mutation to an append-only checksummed log
+with periodic snapshot compaction; a restarted store recovers state + the
+revision counter from disk, and watch resumes below the recovery floor
+raise TooOldError (the serving history ring is process-local, exactly
+like the reference's cacher atop a persistent etcd).
+
 Object-sharing contract (same as client-go's informer cache): objects
 RETURNED by get/list/watch are shared references and MUST NOT be mutated by
 callers — mutate a deep copy and write it back.  Inbound objects on
@@ -38,6 +45,7 @@ from typing import Any, Callable, Iterator
 
 from ..api import meta
 from ..api.meta import Obj
+from . import wal as wal_mod
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -145,7 +153,9 @@ class Watch:
 class MemoryStore:
     """The cluster store. One instance == one 'etcd'."""
 
-    def __init__(self, history: int = 100_000, transformers: dict | None = None):
+    def __init__(self, history: int = 100_000, transformers: dict | None = None,
+                 durable_dir: str | None = None, wal_fsync: bool = False,
+                 compact_every: int = 200_000):
         self._lock = threading.RLock()
         self._rev = 0
         # resource -> {"ns/name": obj}
@@ -160,6 +170,23 @@ class MemoryStore:
         # plaintext (the watch ring is a serving cache, like the reference's
         # cacher, and holds decrypted objects — at-rest covers the table)
         self._transformers = dict(transformers or {})
+        # watch completeness floor: resumes below it must relist.  Starts
+        # at the recovered revision after a restart (the in-memory history
+        # ring did not survive, so pre-crash revisions are unobservable —
+        # etcd compaction semantics).
+        self._floor = 0
+        self._wal = None
+        self._compact_every = compact_every
+        self._snapshot_thread: threading.Thread | None = None
+        if durable_dir is not None:
+            rev, data, valid, replayed = wal_mod.WriteAheadLog.recover(
+                durable_dir)
+            self._rev = rev
+            self._floor = rev
+            self._data = {res: dict(tbl) for res, tbl in data.items()}
+            self._wal = wal_mod.WriteAheadLog(durable_dir, fsync=wal_fsync,
+                                              truncate_log_to=valid,
+                                              pending_records=replayed)
 
     # -- internals -------------------------------------------------------
 
@@ -204,6 +231,58 @@ class MemoryStore:
             return meta.namespaced_name(obj_or_ns)
         return f"{obj_or_ns}/{nm}" if obj_or_ns else (nm or "")
 
+    def _maybe_compact(self) -> None:
+        """Kick off a snapshot once the log holds enough records that a
+        replay would cost more than a snapshot load.  Called under the
+        store lock right after an append.  Only the log rotation + a
+        2-level state copy happen under the lock; serialization and disk
+        writes run on a background thread (objects in the tables are
+        immutable by the sharing contract, so the copy stays a consistent
+        image of this revision).
+        """
+        if self._wal.records_since_snapshot < self._compact_every:
+            return
+        if self._snapshot_thread is not None and self._snapshot_thread.is_alive():
+            return  # one snapshot in flight is enough
+        rev, image = self._begin_snapshot_locked()
+        t = threading.Thread(target=self._wal.finish_snapshot,
+                             args=(rev, image), name="store-snapshot",
+                             daemon=True)
+        self._snapshot_thread = t
+        t.start()
+
+    def _begin_snapshot_locked(self) -> tuple[int, dict]:
+        self._wal.begin_snapshot()
+        return self._rev, {res: dict(tbl) for res, tbl in self._data.items()}
+
+    # -- durability ------------------------------------------------------
+
+    @property
+    def durable(self) -> bool:
+        return self._wal is not None
+
+    def checkpoint(self) -> None:
+        """Force a snapshot now (etcd `snapshot` / compaction); returns
+        once it is on disk."""
+        if self._wal is None:
+            return
+        t = None
+        with self._lock:
+            t = self._snapshot_thread
+        if t is not None and t.is_alive():
+            t.join()
+        with self._lock:
+            rev, image = self._begin_snapshot_locked()
+        self._wal.finish_snapshot(rev, image)
+
+    def close(self) -> None:
+        t = self._snapshot_thread
+        if t is not None and t.is_alive():
+            t.join()
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+
     # -- storage.Interface -----------------------------------------------
 
     @property
@@ -221,7 +300,11 @@ class MemoryStore:
             meta.finalize_new(obj)
             self._rev += 1
             meta.set_resource_version(obj, self._rev)
-            table[key] = self._seal(resource, obj)
+            sealed = self._seal(resource, obj)
+            table[key] = sealed
+            if self._wal is not None:
+                self._wal.append_put(self._rev, resource, key, sealed)
+                self._maybe_compact()
             self._emit(resource, ADDED, obj)
             return obj
 
@@ -238,6 +321,7 @@ class MemoryStore:
         event broadcaster); the caller must guarantee no later mutation."""
         out: list[tuple[Obj | None, StoreError | None]] = []
         evs: list[WatchEvent] = []
+        recs: list[tuple] = []
         with self._lock:
             table = self._table(resource)
             for obj in objs:
@@ -251,9 +335,15 @@ class MemoryStore:
                 meta.finalize_new(obj)
                 self._rev += 1
                 meta.set_resource_version(obj, self._rev)
-                table[key] = self._seal(resource, obj)
+                sealed = self._seal(resource, obj)
+                table[key] = sealed
+                if self._wal is not None:
+                    recs.append((wal_mod.PUT, self._rev, resource, key, sealed))
                 evs.append(WatchEvent(ADDED, obj, self._rev))
                 out.append((obj, None))
+            if recs:
+                self._wal.append_many(recs)
+                self._maybe_compact()
             self._emit_many(resource, evs)
         return out
 
@@ -287,9 +377,16 @@ class MemoryStore:
             if (obj["metadata"].get("deletionTimestamp")
                     and not obj["metadata"].get("finalizers")):
                 del table[key]
+                if self._wal is not None:
+                    self._wal.append_delete(self._rev, resource, key)
+                    self._maybe_compact()
                 self._emit(resource, DELETED, obj)
                 return obj
-            table[key] = self._seal(resource, obj)
+            sealed = self._seal(resource, obj)
+            table[key] = sealed
+            if self._wal is not None:
+                self._wal.append_put(self._rev, resource, key, sealed)
+                self._maybe_compact()
             self._emit(resource, MODIFIED, obj)
             return obj
 
@@ -327,11 +424,18 @@ class MemoryStore:
                 marked["metadata"]["deletionTimestamp"] = time.time()
                 self._rev += 1
                 meta.set_resource_version(marked, self._rev)
-                table[key] = self._seal(resource, marked)
+                sealed = self._seal(resource, marked)
+                table[key] = sealed
+                if self._wal is not None:
+                    self._wal.append_put(self._rev, resource, key, sealed)
+                    self._maybe_compact()
                 self._emit(resource, MODIFIED, marked)
                 return marked
             del table[key]
             self._rev += 1
+            if self._wal is not None:
+                self._wal.append_delete(self._rev, resource, key)
+                self._maybe_compact()
             # tombstone: shallow copy with fresh metadata (readers may still
             # hold the stored object; never mutate it in place)
             tomb = dict(self._open(resource, cur))
@@ -354,6 +458,7 @@ class MemoryStore:
         """
         out: list[tuple[Obj | None, StoreError | None]] = []
         evs: list[WatchEvent] = []
+        recs: list[tuple] = []
         with self._lock:
             table = self._table(resource)
             for ns, nm, node in bindings:
@@ -385,9 +490,15 @@ class MemoryStore:
                                        "status": "True"}]}}
                 self._rev += 1
                 meta.set_resource_version(obj, self._rev)
-                table[key] = self._seal(resource, obj)
+                sealed = self._seal(resource, obj)
+                table[key] = sealed
+                if self._wal is not None:
+                    recs.append((wal_mod.PUT, self._rev, resource, key, sealed))
                 evs.append(WatchEvent(MODIFIED, obj, self._rev))
                 out.append((obj, None))
+            if recs:
+                self._wal.append_many(recs)
+                self._maybe_compact()
             self._emit_many(resource, evs)
         return out
 
@@ -422,6 +533,13 @@ class MemoryStore:
         with self._lock:
             w = Watch(self, resource)
             hist = self._history.get(resource)
+            if since_rv is not None and since_rv < self._floor:
+                # revisions below the floor predate this process (the
+                # history ring died with the previous one) — the client
+                # cannot be given a complete replay, so it must relist
+                raise TooOldError(
+                    f"watch {resource} from rv {since_rv}: compacted "
+                    f"(recovery floor {self._floor})")
             if since_rv is not None and hist:
                 # If the ring is full, events older than hist[0] were dropped;
                 # we can only guarantee completeness for since_rv at or past

@@ -1,0 +1,271 @@
+"""Durable store: WAL + snapshot + recovery (the etcd-persistence role).
+
+Reference semantics:
+  staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go:154,331 — every
+  revisioned write lands in a persistent etcd (WAL + snapshots);
+  crash-only components recover by re-list/re-watch against it, and a
+  watch from a compacted revision gets "too old" -> relist
+  (tools/cache/reflector.go:256).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.store import kv, wal
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def reopen(tmp_path, **kw):
+    return kv.MemoryStore(durable_dir=str(tmp_path), **kw)
+
+
+class TestWALRecovery:
+    def test_state_and_revision_survive_reopen(self, tmp_path):
+        s = reopen(tmp_path)
+        n = s.create("nodes", make_node("n1").build())
+        s.create("pods", make_pod("p1").build())
+        s.create("pods", make_pod("p2").build())
+        n2 = meta.deep_copy(n)
+        n2["metadata"]["labels"] = {"zone": "a"}
+        s.update("nodes", n2)
+        s.delete("pods", "default", "p2")
+        s.bind_many("pods", [("default", "p1", "n1")])
+        rev = s.revision
+        s.close()
+
+        r = reopen(tmp_path)
+        assert r.revision == rev
+        assert r.get("nodes", "", "n1")["metadata"]["labels"] == {"zone": "a"}
+        assert r.get("pods", "default", "p1")["spec"]["nodeName"] == "n1"
+        with pytest.raises(kv.NotFoundError):
+            r.get("pods", "default", "p2")
+        # revisions keep increasing from the recovered counter
+        r.create("pods", make_pod("p3").build())
+        assert r.revision == rev + 1
+
+    def test_watch_below_recovery_floor_is_too_old(self, tmp_path):
+        s = reopen(tmp_path)
+        s.create("nodes", make_node("n1").build())
+        old_rv = s.revision
+        s.create("nodes", make_node("n2").build())
+        s.close()
+
+        r = reopen(tmp_path)
+        # pre-crash revisions are not replayable: the serving history ring
+        # died with the old process -> client relists (reflector semantics)
+        with pytest.raises(kv.TooOldError):
+            r.watch("nodes", since_rv=old_rv)
+        # a fresh watch ("from now") works and sees post-recovery writes
+        w = r.watch("nodes")
+        r.create("nodes", make_node("n3").build())
+        ev = w.next(timeout=1.0)
+        assert ev.type == kv.ADDED
+        assert meta.name(ev.object) == "n3"
+        # and a resume from the current (post-recovery) revision is valid
+        rv = r.revision
+        w2 = r.watch("nodes", since_rv=rv)
+        r.create("nodes", make_node("n4").build())
+        assert meta.name(w2.next(timeout=1.0).object) == "n4"
+
+    def test_torn_tail_is_dropped_and_log_reusable(self, tmp_path):
+        s = reopen(tmp_path)
+        s.create("nodes", make_node("n1").build())
+        s.create("nodes", make_node("n2").build())
+        s.close()
+        log = tmp_path / wal.WriteAheadLog.LOG
+        blob = log.read_bytes()
+        log.write_bytes(blob[:-3])  # crash mid-append: torn last record
+
+        r = reopen(tmp_path)
+        assert r.get("nodes", "", "n1") is not None
+        with pytest.raises(kv.NotFoundError):
+            r.get("nodes", "", "n2")
+        # the torn tail was truncated, so appends after recovery parse
+        r.create("nodes", make_node("n3").build())
+        r.close()
+        r2 = reopen(tmp_path)
+        assert r2.get("nodes", "", "n3") is not None
+
+    def test_snapshot_compaction_resets_log(self, tmp_path):
+        s = reopen(tmp_path, compact_every=10)
+        for i in range(25):
+            s.create("pods", make_pod(f"p{i}").build())
+        rev = s.revision
+        s.close()
+        snap = tmp_path / wal.WriteAheadLog.SNAP
+        assert snap.exists()
+        # the log was rotated at the first threshold crossing, so the live
+        # log holds well under the full 25 records (not every crossing
+        # compacts — one snapshot in flight at a time — but each one that
+        # does restarts the log)
+        full = 25 * 310  # ~310 bytes per framed pod record
+        assert (tmp_path / wal.WriteAheadLog.LOG).stat().st_size < full * 0.7
+
+        r = reopen(tmp_path)
+        assert r.revision == rev
+        assert r.count("pods") == 25
+
+    def test_replayed_records_count_toward_compaction(self, tmp_path):
+        # a process that restarts more often than compact_every writes
+        # must still compact: recovery seeds the counter with the number
+        # of replayed log records
+        for _ in range(3):
+            s = reopen(tmp_path, compact_every=10)
+            base = s.count("pods")
+            for i in range(4):
+                s.create("pods", make_pod(f"p{base + i}").build())
+            s.close()
+        assert (tmp_path / wal.WriteAheadLog.SNAP).exists()
+        r = reopen(tmp_path)
+        assert r.count("pods") == 12
+
+    def test_second_process_is_locked_out(self, tmp_path):
+        s = reopen(tmp_path)
+        s.create("nodes", make_node("n1").build())
+        with pytest.raises(wal.LockedError):
+            reopen(tmp_path)
+        s.close()
+        # released on close: a successor can take over
+        r = reopen(tmp_path)
+        assert r.count("nodes") == 1
+
+    def test_kms_keys_survive_restart_with_key_file(self, tmp_path):
+        from kubernetes_tpu.store.encryption import (EnvelopeTransformer,
+                                                     LocalKMS)
+        key_file = str(tmp_path / "kms-keys.json")
+
+        def open_store():
+            return kv.MemoryStore(
+                durable_dir=str(tmp_path / "data"),
+                transformers={"secrets": EnvelopeTransformer(
+                    LocalKMS(key_file=key_file))})
+
+        s = open_store()
+        s.create("secrets", {"apiVersion": "v1", "kind": "Secret",
+                             "metadata": {"name": "tok",
+                                          "namespace": "default"},
+                             "data": {"password": "s3cr3t"}})
+        s.close()
+        # fresh process, fresh LocalKMS — the persisted KEK ring must
+        # decrypt what the previous process sealed
+        r = open_store()
+        assert r.get("secrets", "default", "tok")["data"][
+            "password"] == "s3cr3t"
+
+    def test_explicit_checkpoint(self, tmp_path):
+        s = reopen(tmp_path)
+        s.create("nodes", make_node("n1").build())
+        s.checkpoint()
+        assert (tmp_path / wal.WriteAheadLog.LOG).stat().st_size == 0
+        s.create("nodes", make_node("n2").build())
+        s.close()
+        r = reopen(tmp_path)
+        assert r.count("nodes") == 2
+
+    def test_encrypted_resources_stay_sealed_on_disk(self, tmp_path):
+        from kubernetes_tpu.store.encryption import (EnvelopeTransformer,
+                                                     LocalKMS)
+        kms = LocalKMS()
+        s = kv.MemoryStore(durable_dir=str(tmp_path),
+                           transformers={"secrets": EnvelopeTransformer(kms)})
+        secret = {"apiVersion": "v1", "kind": "Secret",
+                  "metadata": {"name": "tok", "namespace": "default"},
+                  "data": {"password": "hunter2-very-secret"}}
+        s.create("secrets", secret)
+        s.checkpoint()  # secret now lives in the snapshot file
+        s.create("secrets", {**secret,
+                             "metadata": {"name": "tok2",
+                                          "namespace": "default"}})
+        s.close()
+        for fname in (wal.WriteAheadLog.LOG, wal.WriteAheadLog.SNAP):
+            raw = (tmp_path / fname).read_bytes()
+            assert b"hunter2-very-secret" not in raw
+        # and recovery round-trips through the same transformer
+        r = kv.MemoryStore(durable_dir=str(tmp_path),
+                           transformers={"secrets": EnvelopeTransformer(kms)})
+        assert r.get("secrets", "default", "tok")["data"][
+            "password"] == "hunter2-very-secret"
+
+    def test_delete_via_finalizer_strip_persists(self, tmp_path):
+        s = reopen(tmp_path)
+        pod = make_pod("fz").build()
+        pod["metadata"]["finalizers"] = ["example.com/guard"]
+        created = s.create("pods", pod)
+        marked = s.delete("pods", "default", "fz")
+        assert marked["metadata"]["deletionTimestamp"]
+        s.close()
+        r = reopen(tmp_path)  # terminating state survives the crash
+        cur = r.get("pods", "default", "fz")
+        assert cur["metadata"]["deletionTimestamp"]
+        stripped = meta.deep_copy(cur)
+        stripped["metadata"]["finalizers"] = []
+        r.update("pods", stripped)
+        with pytest.raises(kv.NotFoundError):
+            r.get("pods", "default", "fz")
+        r.close()
+        r2 = reopen(tmp_path)
+        with pytest.raises(kv.NotFoundError):
+            r2.get("pods", "default", "fz")
+        assert created is not None
+
+
+def _spawn_apiserver(data_dir):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.cmd.apiserver",
+         "--secure-port", "0", "--data-dir", str(data_dir)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    line = proc.stdout.readline()
+    assert "listening on" in line, f"apiserver failed to start: {line!r}"
+    return proc, line.rsplit(" ", 1)[-1].strip()
+
+
+class TestKillTheStore:
+    def test_sigkill_apiserver_cluster_resumes_from_disk(self, tmp_path):
+        """The one failure round 1 could not survive: the store process
+        dies.  SIGKILL (no atexit, no flush handlers beyond the OS page
+        cache) and a fresh process must serve the same cluster."""
+        from kubernetes_tpu.client.http_client import HTTPClient
+
+        proc, url = _spawn_apiserver(tmp_path)
+        try:
+            client = HTTPClient.from_url(url)
+            for i in range(20):
+                client.create("nodes", make_node(f"kn-{i}").build())
+            for i in range(40):
+                client.create("pods", make_pod(f"kp-{i}").build())
+            client.delete("pods", "default", "kp-39")
+            _, rv = client.list("pods", "default")
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+
+        proc2, url2 = _spawn_apiserver(tmp_path)
+        try:
+            client2 = HTTPClient.from_url(url2)
+            nodes, _ = client2.list("nodes")
+            pods, new_rv = client2.list("pods", "default")
+            assert len(nodes) == 20
+            assert len(pods) == 39
+            assert new_rv >= rv  # revision counter survived: no rv reuse
+            # informers that survived the crash relist (TooOld) and converge
+            from kubernetes_tpu.client import SharedInformerFactory
+            factory = SharedInformerFactory(client2)
+            inf = factory.informer("pods")
+            factory.start()
+            assert factory.wait_for_cache_sync(timeout=30.0)
+            try:
+                assert inf.get("default", "kp-0") is not None
+                assert inf.get("default", "kp-39") is None
+            finally:
+                factory.stop()
+        finally:
+            proc2.send_signal(signal.SIGKILL)
+            proc2.wait(timeout=10)
