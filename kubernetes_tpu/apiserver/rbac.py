@@ -1,0 +1,298 @@
+"""RBAC authorization — the authorizer stage of the handler chain.
+
+Reference semantics:
+  staging/src/k8s.io/apiserver/pkg/server/config.go:815 — authorization
+    runs on every request, after authn/APF, before routing;
+  plugin/pkg/auth/authorizer/rbac/rbac.go — RBACAuthorizer walks
+    ClusterRoleBindings (cluster-wide grants) and RoleBindings (namespace
+    grants), resolving each roleRef to its rule list;
+  pkg/registry/rbac/validation/rule.go — rule matching: verbs,
+    apiGroups, resources ("pods/status" form for subresources, "*"
+    wildcards), resourceNames;
+  plugin/pkg/auth/authorizer/rbac/bootstrappolicy/ — the default
+    cluster roles every control-plane component is born with.
+
+Design: policy objects are ordinary resources in the store (roles /
+rolebindings namespaced; clusterroles / clusterrolebindings
+cluster-scoped).  The authorizer compiles them into a per-subject index
+and watches the four resources, recompiling lazily after a change — the
+hot path is two dict lookups plus rule scans for one subject, no store
+reads.  Identity comes from the authn stage as (user, [groups]).
+
+The in-process LocalClient bypasses HTTP and therefore authorization, by
+construction: the enforcement seam is the apiserver handler chain, same
+as the reference (a process that holds the store object IS the apiserver
+process).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..api import meta
+from ..store import kv
+
+ROLES = "roles"
+CLUSTERROLES = "clusterroles"
+ROLEBINDINGS = "rolebindings"
+CLUSTERROLEBINDINGS = "clusterrolebindings"
+
+RBAC_RESOURCES = (ROLES, CLUSTERROLES, ROLEBINDINGS, CLUSTERROLEBINDINGS)
+
+SUPERUSER_GROUP = "system:masters"
+
+
+class Attributes:
+    """One authorization question (authorizer.Attributes)."""
+
+    __slots__ = ("user", "groups", "verb", "resource", "subresource",
+                 "namespace", "name")
+
+    def __init__(self, user: str, groups: tuple[str, ...], verb: str,
+                 resource: str, subresource: str = "",
+                 namespace: str = "", name: str = ""):
+        self.user = user
+        self.groups = groups
+        self.verb = verb
+        self.resource = resource
+        self.subresource = subresource
+        self.namespace = namespace
+        self.name = name
+
+
+def _rule_matches(rule: dict, attrs: Attributes) -> bool:
+    verbs = rule.get("verbs") or []
+    if "*" not in verbs and attrs.verb not in verbs:
+        return False
+    resources = rule.get("resources") or []
+    target = attrs.resource
+    if attrs.subresource:
+        target = f"{attrs.resource}/{attrs.subresource}"
+    ok = False
+    for r in resources:
+        if r == "*" or r == target:
+            ok = True
+            break
+        # "*/status" matches any resource's status subresource
+        if attrs.subresource and r == f"*/{attrs.subresource}":
+            ok = True
+            break
+    if not ok:
+        return False
+    names = rule.get("resourceNames") or []
+    if names and attrs.name not in names:
+        return False
+    return True
+
+
+class RBACAuthorizer:
+    """Compiles bindings into {subject: grants} and answers authorize().
+
+    Subjects are "User:<name>" / "Group:<name>" strings.  Grants are
+    (namespace_or_None, rules) pairs: None namespace = cluster-wide.
+    """
+
+    def __init__(self, store: kv.MemoryStore):
+        self._store = store
+        self._lock = threading.Lock()
+        self._index: dict[str, list[tuple[str | None, list[dict]]]] = {}
+        self._dirty = True
+        self._watches = []
+        for res in RBAC_RESOURCES:
+            w = store.watch(res)
+            self._watches.append(w)
+            t = threading.Thread(target=self._watch_loop, args=(w,),
+                                 name=f"rbac-watch-{res}", daemon=True)
+            t.start()
+
+    def stop(self) -> None:
+        for w in self._watches:
+            w.stop()
+
+    def _watch_loop(self, w: kv.Watch) -> None:
+        while True:
+            evs = w.next_batch(timeout=None)
+            if not evs and w.stopped:
+                return
+            if evs:
+                with self._lock:
+                    self._dirty = True
+
+    # -- compilation -----------------------------------------------------
+
+    def _role_rules(self, kind: str, name: str, namespace: str) -> list[dict]:
+        try:
+            if kind == "ClusterRole":
+                obj = self._store.get(CLUSTERROLES, "", name)
+            else:
+                obj = self._store.get(ROLES, namespace, name)
+        except kv.NotFoundError:
+            return []  # dangling roleRef grants nothing (reference behavior)
+        return obj.get("rules") or []
+
+    def _recompile(self) -> None:
+        index: dict[str, list[tuple[str | None, list[dict]]]] = {}
+
+        def add(subjects, scope_ns, rules):
+            if not rules:
+                return
+            for s in subjects or []:
+                skey = f"{s.get('kind', 'User')}:{s.get('name', '')}"
+                index.setdefault(skey, []).append((scope_ns, rules))
+
+        crbs, _ = self._store.list(CLUSTERROLEBINDINGS)
+        for b in crbs:
+            ref = b.get("roleRef") or {}
+            rules = self._role_rules("ClusterRole", ref.get("name", ""), "")
+            add(b.get("subjects"), None, rules)
+        rbs, _ = self._store.list(ROLEBINDINGS)
+        for b in rbs:
+            ns = meta.namespace(b)
+            ref = b.get("roleRef") or {}
+            # a RoleBinding may reference a ClusterRole but only grants it
+            # INSIDE its own namespace (rbac.go AppliesTo)
+            rules = self._role_rules(ref.get("kind", "Role"),
+                                     ref.get("name", ""), ns)
+            add(b.get("subjects"), ns, rules)
+        self._index = index
+
+    # -- the authorizer stage --------------------------------------------
+
+    def authorize(self, attrs: Attributes) -> bool:
+        if SUPERUSER_GROUP in attrs.groups:
+            return True  # the privileged-group authorizer ahead of RBAC
+        with self._lock:
+            if self._dirty:
+                self._recompile()
+                self._dirty = False
+            index = self._index
+        subjects = [f"User:{attrs.user}"]
+        subjects += [f"Group:{g}" for g in attrs.groups]
+        for skey in subjects:
+            for scope_ns, rules in index.get(skey, ()):
+                if scope_ns is not None and scope_ns != attrs.namespace:
+                    continue
+                for rule in rules:
+                    if _rule_matches(rule, attrs):
+                        return True
+        return False
+
+
+# -- bootstrap policy ----------------------------------------------------
+
+def _role(name: str, rules: list[dict]) -> dict:
+    obj = meta.new_object("ClusterRole", name, None)
+    obj["rules"] = rules
+    return obj
+
+
+def _binding(name: str, role: str, subjects: list[dict]) -> dict:
+    obj = meta.new_object("ClusterRoleBinding", name, None)
+    obj["roleRef"] = {"kind": "ClusterRole", "name": role}
+    obj["subjects"] = subjects
+    return obj
+
+
+def _user(name: str) -> dict:
+    return {"kind": "User", "name": name}
+
+
+def _group(name: str) -> dict:
+    return {"kind": "Group", "name": name}
+
+
+READ = ["get", "list", "watch"]
+WRITE = ["create", "update", "patch", "delete"]
+
+
+def bootstrap_policy(store: kv.MemoryStore) -> None:
+    """Default roles + bindings for the control-plane components
+    (bootstrappolicy/policy.go ClusterRoles()/ClusterRoleBindings(),
+    reduced to the verbs this control plane actually issues).
+    Idempotent — crash-only restart safe."""
+    roles = [
+        _role("cluster-admin",
+              [{"verbs": ["*"], "apiGroups": ["*"], "resources": ["*"]}]),
+        _role("system:kube-scheduler", [
+            {"verbs": READ, "resources": [
+                "pods", "nodes", "namespaces", "services", "replicasets",
+                "statefulsets", "replicationcontrollers",
+                "poddisruptionbudgets", "persistentvolumeclaims",
+                "persistentvolumes", "storageclasses", "csinodes",
+                "podgroups", "priorityclasses"]},
+            {"verbs": ["create"], "resources": ["pods/binding", "bindings"]},
+            {"verbs": ["update", "patch"], "resources": ["pods/status"]},
+            {"verbs": ["delete"], "resources": ["pods"]},  # preemption
+            {"verbs": ["create", "patch", "update"], "resources": ["events"]},
+            {"verbs": ["get", "create", "update"], "resources": ["leases"]},
+        ]),
+        _role("system:kube-controller-manager", [
+            {"verbs": READ, "resources": ["*"]},
+            {"verbs": WRITE, "resources": [
+                "pods", "replicasets", "services", "endpoints",
+                "endpointslices", "serviceaccounts", "secrets", "configmaps",
+                "leases", "events", "namespaces", "podgroups",
+                "persistentvolumes", "persistentvolumeclaims",
+                "volumeattachments", "certificatesigningrequests",
+                "poddisruptionbudgets", "horizontalpodautoscalers"]},
+            {"verbs": ["update", "patch"], "resources": [
+                "*/status", "*/scale", "nodes", "deployments", "jobs",
+                "cronjobs", "statefulsets", "daemonsets",
+                "replicationcontrollers", "certificatesigningrequests/status",
+                "certificatesigningrequests/approval"]},
+            {"verbs": ["delete"], "resources": ["nodes"]},  # node lifecycle
+        ]),
+        _role("system:node", [
+            {"verbs": READ, "resources": [
+                "pods", "nodes", "services", "configmaps", "secrets",
+                "persistentvolumeclaims", "persistentvolumes"]},
+            {"verbs": ["create", "update", "patch"], "resources": [
+                "nodes", "nodes/status", "pods/status", "events", "leases"]},
+            {"verbs": ["create"], "resources": [
+                "certificatesigningrequests"]},
+            {"verbs": ["delete"], "resources": ["pods"]},  # eviction/own-pod
+        ]),
+        _role("system:kube-proxy", [
+            {"verbs": READ, "resources": [
+                "services", "endpoints", "endpointslices", "nodes"]},
+            {"verbs": ["create", "patch", "update"], "resources": ["events"]},
+        ]),
+        # user-facing roles (aggregationRule reduced to static rules)
+        _role("admin", [
+            {"verbs": ["*"], "resources": ["*"]}]),
+        _role("edit", [
+            {"verbs": READ + WRITE, "resources": [
+                "pods", "deployments", "replicasets", "statefulsets",
+                "daemonsets", "jobs", "cronjobs", "services", "endpoints",
+                "configmaps", "secrets", "persistentvolumeclaims",
+                "horizontalpodautoscalers", "poddisruptionbudgets"]}]),
+        _role("view", [
+            {"verbs": READ, "resources": [
+                "pods", "deployments", "replicasets", "statefulsets",
+                "daemonsets", "jobs", "cronjobs", "services", "endpoints",
+                "configmaps", "persistentvolumeclaims",
+                "horizontalpodautoscalers", "poddisruptionbudgets"]}]),
+    ]
+    bindings = [
+        _binding("cluster-admin", "cluster-admin",
+                 [_group(SUPERUSER_GROUP)]),
+        _binding("system:kube-scheduler", "system:kube-scheduler",
+                 [_user("system:kube-scheduler")]),
+        _binding("system:kube-controller-manager",
+                 "system:kube-controller-manager",
+                 [_user("system:kube-controller-manager")]),
+        _binding("system:node", "system:node",
+                 [_group("system:nodes")]),
+        _binding("system:kube-proxy", "system:kube-proxy",
+                 [_user("system:kube-proxy")]),
+    ]
+    for obj in roles:
+        try:
+            store.create(CLUSTERROLES, obj)
+        except kv.AlreadyExistsError:
+            pass
+    for obj in bindings:
+        try:
+            store.create(CLUSTERROLEBINDINGS, obj)
+        except kv.AlreadyExistsError:
+            pass

@@ -21,6 +21,7 @@ contract (SURVEY.md layers 4-5):
 
 Handler chain (DefaultBuildHandlerChain, server/config.go:813, in order):
   request log -> authn (bearer token) -> audit -> API priority & fairness
+  -> authorization (RBAC, rbac.py)
   -> route -> admission chain (mutating then validating) -> registry/store.
 
 Errors are metav1.Status-shaped JSON with the right HTTP codes
@@ -46,6 +47,7 @@ from . import audit as auditlib
 from . import crd as crdlib
 from . import flowcontrol
 from . import patch as patchlib
+from . import rbac as rbaclib
 
 logger = logging.getLogger(__name__)
 
@@ -107,12 +109,23 @@ class _Route:
 class APIServer:
     def __init__(self, store: kv.MemoryStore, host: str = "127.0.0.1",
                  port: int = 0, token: str | None = None,
+                 tokens: dict[str, tuple[str, tuple[str, ...]]] | None = None,
+                 enable_rbac: bool = False,
                  admission_chain: adm.Chain | None = None,
                  enable_default_admission: bool = False,
                  flow_dispatcher: flowcontrol.Dispatcher | None = None,
                  audit_logger: auditlib.AuditLogger | None = None):
         self.store = store
         self.token = token
+        # static bearer tokens -> identity (the reference's token-auth
+        # file: one line per token,user,groups).  The legacy single
+        # `token` becomes a superuser credential.
+        self.tokens = dict(tokens or {})
+        if token is not None:
+            self.tokens.setdefault(
+                token, ("system:admin", (rbaclib.SUPERUSER_GROUP,)))
+        self.authorizer = rbaclib.RBACAuthorizer(store) if enable_rbac \
+            else None
         self.admission_hooks: list = []  # legacy fn(verb, resource, obj) hooks
         self.admission_chain = admission_chain or (
             adm.default_chain(store) if enable_default_admission
@@ -175,8 +188,12 @@ class APIServer:
             self.store.create("services", svc)
         except kv.AlreadyExistsError:
             pass
+        if self.authorizer is not None:
+            rbaclib.bootstrap_policy(self.store)
 
     def stop(self) -> None:
+        if self.authorizer is not None:
+            self.authorizer.stop()
         self.aggregator.stop()
         self.httpd.shutdown()
         self.httpd.server_close()  # release the listening socket
@@ -204,18 +221,37 @@ class APIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _user(self) -> str:
+            def _identity(self) -> tuple[str, tuple[str, ...]] | None:
+                """Resolve the request's (user, groups); None = bad creds.
+
+                No configured tokens = authn disabled: everything runs as
+                the anonymous user (which RBAC, if enabled, still judges —
+                the reference's --anonymous-auth default)."""
+                if not server.tokens:
+                    return ("system:anonymous", ("system:unauthenticated",))
                 auth = self.headers.get("Authorization", "")
                 if auth.startswith("Bearer "):
-                    return "system:token-user"
-                return "system:anonymous"
+                    ident = server.tokens.get(auth[len("Bearer "):])
+                    if ident is not None:
+                        return ident
+                return None
+
+            def _user(self) -> str:
+                ident = self._identity()
+                return ident[0] if ident else "system:anonymous"
+
+            def _drain_body(self) -> None:
+                """Consume an unread request body before an early error
+                response — leftover bytes would be parsed as the next
+                request on this keep-alive connection."""
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    self.rfile.read(length)
 
             def _authn(self) -> bool:
-                if server.token is None:
+                if self._identity() is not None:
                     return True
-                auth = self.headers.get("Authorization", "")
-                if auth == f"Bearer {server.token}":
-                    return True
+                self._drain_body()
                 self._send_json(401, status_error(401, "Unauthorized",
                                                   "invalid bearer token"))
                 return False
@@ -327,6 +363,7 @@ class APIServer:
                     except flowcontrol.RejectedError as e:
                         with server._metrics_lock:
                             server.metrics["requests_rejected_total"] += 1
+                        self._drain_body()
                         body = json.dumps(status_error(
                             429, "TooManyRequests", str(e))).encode()
                         self.send_response(429)
@@ -335,6 +372,36 @@ class APIServer:
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
+                        return None
+                # authorization (config.go:815 — after authn/APF, before
+                # routing).  Non-resource paths (healthz, version, metrics)
+                # stay open, like the reference's system:discovery defaults.
+                if server.authorizer is not None and r is not None \
+                        and r.resource:
+                    if verb == "get":
+                        rverb = ("watch" if is_watch
+                                 else "get" if r.name else "list")
+                    elif verb == "delete" and not r.name:
+                        rverb = "deletecollection"
+                    else:
+                        rverb = verb
+                    user, groups = self._identity()
+                    attrs = rbaclib.Attributes(
+                        user, tuple(groups), rverb, r.resource,
+                        r.subresource or "", r.ns or "", r.name or "")
+                    if not server.authorizer.authorize(attrs):
+                        if ticket:
+                            ticket.__exit__()
+                        with server._metrics_lock:
+                            server.metrics["requests_rejected_total"] += 1
+                        self._drain_body()
+                        target = r.resource + (
+                            f"/{r.subresource}" if r.subresource else "")
+                        self._send_json(403, status_error(
+                            403, "Forbidden",
+                            f"user {user!r} cannot {rverb} {target}"
+                            + (f" in namespace {r.ns!r}" if r.ns else "")))
+                        self._audit(r, rverb, 403)
                         return None
                 return r, ticket
 

@@ -53,13 +53,18 @@ HPAS = "horizontalpodautoscalers"
 ENDPOINTSLICES = "endpointslices"
 CSRS = "certificatesigningrequests"
 VOLUMEATTACHMENTS = "volumeattachments"
+ROLES = "roles"
+CLUSTERROLES = "clusterroles"
+ROLEBINDINGS = "rolebindings"
+CLUSTERROLEBINDINGS = "clusterrolebindings"
 
 # the ONE cluster-scoped set: REST routing (apiserver/server.py) and client
 # path building (http_client.py) both key off it — divergence routes writes
 # to the wrong key (tests/test_verify_static.py guards the sharing)
 CLUSTER_SCOPED_RESOURCES = frozenset({
     NODES, PVS, NAMESPACES, PRIORITYCLASSES, STORAGECLASSES, CSINODES,
-    CSRS, VOLUMEATTACHMENTS, "apiservices", "customresourcedefinitions",
+    CSRS, VOLUMEATTACHMENTS, CLUSTERROLES, CLUSTERROLEBINDINGS,
+    "apiservices", "customresourcedefinitions",
 })
 
 

@@ -13,6 +13,11 @@ def main(argv=None) -> None:
     ap.add_argument("--bind-address", default="127.0.0.1")
     ap.add_argument("--secure-port", type=int, default=8080)
     ap.add_argument("--token", default=None, help="static bearer token authn")
+    ap.add_argument("--token-file", default=None,
+                    help="token auth file: one 'token,user,group1|group2' "
+                         "line per credential (reference --token-auth-file)")
+    ap.add_argument("--authorization-mode", default="AlwaysAllow",
+                    choices=["AlwaysAllow", "RBAC"])
     ap.add_argument("--encrypt-secrets", action="store_true",
                     help="KMS envelope encryption of Secrets at rest "
                          "(EncryptionConfiguration kms provider equivalent)")
@@ -36,10 +41,23 @@ def main(argv=None) -> None:
             key_file = os.path.join(args.data_dir, "kms-keys.json")
         transformers = {"secrets": EnvelopeTransformer(
             LocalKMS(key_file=key_file))}
+    tokens = None
+    if args.token_file:
+        tokens = {}
+        with open(args.token_file) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                tok, user, *rest = line.split(",")
+                groups = tuple(g for g in (rest[0].split("|") if rest else ())
+                               if g)
+                tokens[tok] = (user, groups)
     store = kv.MemoryStore(history=1_000_000, transformers=transformers,
                            durable_dir=args.data_dir)
     server = APIServer(store, host=args.bind_address, port=args.secure_port,
-                       token=args.token).start()
+                       token=args.token, tokens=tokens,
+                       enable_rbac=args.authorization_mode == "RBAC").start()
     print(f"apiserver listening on {server.url}")
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
