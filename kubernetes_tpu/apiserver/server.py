@@ -46,6 +46,7 @@ from . import admission as adm
 from . import audit as auditlib
 from . import crd as crdlib
 from . import flowcontrol
+from . import managedfields as mflib
 from . import patch as patchlib
 from . import rbac as rbaclib
 
@@ -757,6 +758,7 @@ class APIServer:
                         return
                     if not self._validate_custom(r, obj):
                         return
+                    mflib.track_update(old, obj, self._field_manager())
                     updated = server.store.update(r.resource, obj)
                     self._send_json(200, updated)
                     self._audit(r, "update", 200, updated)
@@ -787,6 +789,9 @@ class APIServer:
                     return
                 ctype = self.headers.get("Content-Type",
                                          "application/strategic-merge-patch+json")
+                if ctype.split(";")[0].strip() == mflib.APPLY_CONTENT_TYPE:
+                    self._do_apply(r, body)
+                    return
                 try:
                     def apply(cur):
                         patched = patchlib.apply_patch(ctype, cur, body)
@@ -798,6 +803,8 @@ class APIServer:
                         # resourceVersion comes from the store's CAS loop
                         patched.setdefault("metadata", {})["resourceVersion"] = \
                             (cur.get("metadata") or {}).get("resourceVersion")
+                        mflib.track_update(cur, patched,
+                                           self._field_manager())
                         # the patched object passes the same gates as a PUT
                         for hook in server.admission_hooks:
                             patched = hook(adm.UPDATE, r.resource,
@@ -828,6 +835,76 @@ class APIServer:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
                 except kv.ConflictError as e:
                     self._send_json(409, status_error(409, "Conflict", str(e)))
+
+            def _field_manager(self, default: str = "unknown") -> str:
+                r = self._route()
+                vals = r.query.get("fieldManager") if r else None
+                return vals[0] if vals else default
+
+            def _do_apply(self, r: _Route, applied: dict) -> None:
+                """Server-side apply (PATCH application/apply-patch+yaml):
+                create-or-merge driven by managedFields ownership
+                (managedfields.py; endpoints/handlers/patch.go applyPatcher)."""
+                manager = self._field_manager(default="apply")
+                force = (r.query.get("force") or ["false"])[0] == "true"
+                applied.setdefault("metadata", {}).setdefault("name", r.name)
+                if r.ns:
+                    applied["metadata"].setdefault("namespace", r.ns)
+                try:
+                    try:
+                        live = server.store.get(r.resource, r.ns or "",
+                                                r.name)
+                    except kv.NotFoundError:
+                        live = None
+                    if live is None:
+                        new = mflib.apply_merge(None, applied, manager)
+                        new = self._admit(adm.CREATE, r, new, None)
+                        if new is None:
+                            return
+                        if not self._validate_custom(r, new):
+                            return
+                        created = server.store.create(r.resource, new)
+                        self._send_json(201, created)
+                        self._audit(r, "apply", 201, created)
+                        return
+
+                    def merge(cur):
+                        new = mflib.apply_merge(cur, applied, manager,
+                                                force=force)
+                        new["metadata"]["resourceVersion"] = \
+                            cur["metadata"].get("resourceVersion")
+                        server.admission_chain.run(adm.Attributes(
+                            adm.UPDATE, r.resource, new, cur,
+                            namespace=r.ns or "", name=r.name,
+                            subresource=r.subresource or ""))
+                        if r.group is not None \
+                                and r.group not in BUILTIN_GROUPS:
+                            server.crds.validate_object(
+                                r.resource, r.version, new)
+                        return new
+                    updated = server.store.guaranteed_update(
+                        r.resource, r.ns or "", r.name, merge)
+                    self._send_json(200, updated)
+                    self._audit(r, "apply", 200)
+                except mflib.ApplyConflict as e:
+                    body = status_error(409, "Conflict", str(e))
+                    body["details"] = {"conflicts": [
+                        {"manager": m, "field": mflib.path_str(p)}
+                        for m, p in e.conflicts]}
+                    self._send_json(409, body)
+                except adm.AdmissionDenied as e:
+                    self._send_json(403, status_error(
+                        403, "Forbidden",
+                        "admission plugin %s denied the request: %s"
+                        % (e.plugin, e)))
+                except (patchlib.PatchError, crdlib.ValidationError) as e:
+                    self._send_json(422, status_error(422, "Invalid", str(e)))
+                except kv.ConflictError as e:
+                    self._send_json(409, status_error(409, "Conflict",
+                                                      str(e)))
+                except kv.AlreadyExistsError as e:
+                    self._send_json(409, status_error(409, "AlreadyExists",
+                                                      str(e)))
 
             def do_DELETE(self):
                 begun = self._begin("delete")

@@ -212,8 +212,13 @@ class Kubectl:
                 return 1
         return 0
 
-    def apply(self, path: str, namespace: str) -> int:
-        """create-or-update (server-side apply reduced to spec replace)."""
+    def apply(self, path: str, namespace: str, force: bool = False) -> int:
+        """Server-side apply: each manifest is merged by managedFields
+        ownership under the 'kubectl' field manager; conflicting fields
+        owned by other managers abort with the reference's remediation
+        hint unless --force-conflicts (kubectl pkg/cmd/apply with
+        --server-side semantics — the only apply mode here; fields you
+        stop applying are removed server-side)."""
         for obj in self._load_manifests(path):
             res = KIND_TO_RESOURCE.get(obj.get("kind", ""), "")
             if not res:
@@ -222,20 +227,20 @@ class Kubectl:
             obj.setdefault("metadata", {}).setdefault("namespace", namespace)
             ns, nm = meta.namespace(obj), meta.name(obj)
             try:
-                self.client.create(res, obj)
-                self.out.write(f"{res}/{nm} created\n")
-            except kv.AlreadyExistsError:
-                def merge(cur, new=obj):
-                    for k in ("spec", "data", "subsets"):
-                        if k in new:
-                            cur[k] = new[k]
-                    md = cur["metadata"]
-                    for k in ("labels", "annotations"):
-                        if (new.get("metadata") or {}).get(k):
-                            md[k] = new["metadata"][k]
-                    return cur
-                self.client.guaranteed_update(res, ns, nm, merge)
-                self.out.write(f"{res}/{nm} configured\n")
+                self.client.get(res, ns, nm)
+                verb = "configured"
+            except kv.NotFoundError:
+                verb = "created"
+            try:
+                self.client.apply(res, obj, field_manager="kubectl",
+                                  force=force)
+            except kv.ConflictError as e:
+                self.out.write(
+                    f"error: {e}\n"
+                    "hint: overwrite with --force-conflicts, or stop "
+                    "managing the conflicting fields\n")
+                return 1
+            self.out.write(f"{res}/{nm} {verb}\n")
         return 0
 
     def delete(self, resource: str, name: str, namespace: str) -> int:
@@ -533,6 +538,8 @@ def build_parser() -> argparse.ArgumentParser:
     for verb in ("create", "apply"):
         c = sub.add_parser(verb)
         c.add_argument("-f", "--filename", required=True)
+        if verb == "apply":
+            c.add_argument("--force-conflicts", action="store_true")
     dl = sub.add_parser("delete")
     dl.add_argument("resource")
     dl.add_argument("name")
@@ -585,7 +592,8 @@ def run(argv: list[str] | None = None, client: Client | None = None,
     if args.cmd == "create":
         return k.create(args.filename, args.namespace)
     if args.cmd == "apply":
-        return k.apply(args.filename, args.namespace)
+        return k.apply(args.filename, args.namespace,
+                       force=args.force_conflicts)
     if args.cmd == "delete":
         return k.delete(args.resource, args.name, args.namespace)
     if args.cmd == "scale":

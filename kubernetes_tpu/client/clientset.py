@@ -20,7 +20,7 @@ from typing import Callable
 from ..api import meta
 from ..api.meta import Obj
 from ..store import kv
-from ..store.kv import MemoryStore, Watch
+from ..store.kv import MemoryStore, NotFoundError, Watch
 
 # Canonical resource names (plural, lowercase — like REST paths).
 PODS = "pods"
@@ -251,6 +251,22 @@ class LocalClient(Client):
 
     def delete(self, resource: str, namespace: str, name: str) -> Obj:
         return self.store.delete(resource, namespace, name)
+
+    def apply(self, resource: str, obj: Obj, field_manager: str,
+              force: bool = False) -> Obj:
+        """Server-side apply (managedfields.py semantics, in process)."""
+        from ..apiserver import managedfields as mf
+        ns, nm = obj["metadata"].get("namespace", ""), obj["metadata"]["name"]
+        try:
+            def merge(cur):
+                new = mf.apply_merge(cur, obj, field_manager, force=force)
+                new["metadata"]["resourceVersion"] = \
+                    cur["metadata"].get("resourceVersion")
+                return new
+            return self.store.guaranteed_update(resource, ns, nm, merge)
+        except NotFoundError:
+            return self.store.create(
+                resource, mf.apply_merge(None, obj, field_manager))
 
     def list(self, resource: str, namespace: str | None = None) -> tuple[list[Obj], int]:
         return self.store.list(resource, namespace)

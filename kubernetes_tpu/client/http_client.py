@@ -205,6 +205,19 @@ class HTTPClient(Client):
         return self._request("PATCH", path, patch_body,
                              content_type=patch_type)
 
+    def apply(self, resource: str, obj: Obj, field_manager: str,
+              force: bool = False) -> Obj:
+        """Server-side apply: PATCH application/apply-patch+yaml with
+        fieldManager/force query params (apply.go sendPatch)."""
+        ns = (obj.get("metadata") or {}).get("namespace", "")
+        nm = obj["metadata"]["name"]
+        path = self._path(resource, ns, nm)
+        path += f"?fieldManager={field_manager}"
+        if force:
+            path += "&force=true"
+        return self._request("PATCH", path, obj,
+                             content_type="application/apply-patch+yaml")
+
     def bind(self, pod: Obj, node_name: str) -> Obj:
         """POST pods/{name}/binding (DefaultBinder's write)."""
         path = self._path("pods", meta.namespace(pod), meta.name(pod)) + "/binding"

@@ -1,0 +1,192 @@
+"""Server-side apply: managedFields ownership, conflicts, removal.
+
+Reference semantics:
+  staging/src/k8s.io/apimachinery/pkg/util/managedfields/ +
+  sigs.k8s.io/structured-merge-diff (apply = ownership-driven three-way
+  merge); endpoints/handlers/patch.go applyPatcher;
+  kubectl apply --server-side.
+"""
+
+import io
+
+import pytest
+
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver import managedfields as mf
+from kubernetes_tpu.client import LocalClient
+from kubernetes_tpu.client.http_client import HTTPClient
+from kubernetes_tpu.store import kv
+
+
+def deployment(name="web", **spec):
+    return {"apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": spec}
+
+
+class TestApplyMerge:
+    def test_create_on_apply_records_ownership(self):
+        new = mf.apply_merge(None, deployment(replicas=3), "kubectl")
+        entries = new["metadata"]["managedFields"]
+        assert len(entries) == 1
+        assert entries[0]["manager"] == "kubectl"
+        assert entries[0]["operation"] == "Apply"
+        # fieldsV1 trie round-trips to the same leaf set
+        leaves = mf.trie_to_leaves(entries[0]["fieldsV1"])
+        assert (("f", "spec"), ("f", "replicas")) in leaves
+
+    def test_disjoint_managers_merge(self):
+        live = mf.apply_merge(None, deployment(replicas=3), "kubectl")
+        applied = deployment()
+        applied["metadata"]["labels"] = {"team": "infra"}
+        del applied["spec"]
+        new = mf.apply_merge(live, applied, "label-controller")
+        assert new["spec"]["replicas"] == 3
+        assert new["metadata"]["labels"] == {"team": "infra"}
+        mgrs = mf.read_managers(new)
+        assert ("kubectl", "Apply") in mgrs
+        assert ("label-controller", "Apply") in mgrs
+
+    def test_conflict_then_force(self):
+        live = mf.apply_merge(None, deployment(replicas=3), "kubectl")
+        other = deployment(replicas=5)
+        with pytest.raises(mf.ApplyConflict) as ei:
+            mf.apply_merge(live, other, "hpa")
+        assert any(m == ("kubectl", "Apply") or m == "kubectl"
+                   for m, _ in ei.value.conflicts)
+        new = mf.apply_merge(live, other, "hpa", force=True)
+        assert new["spec"]["replicas"] == 5
+        mgrs = mf.read_managers(new)
+        # ownership of replicas moved to hpa; kubectl keeps nothing there
+        path = (("f", "spec"), ("f", "replicas"))
+        assert path in mgrs[("hpa", "Apply")]
+        assert path not in mgrs.get(("kubectl", "Apply"), set())
+
+    def test_same_value_is_not_a_conflict(self):
+        live = mf.apply_merge(None, deployment(replicas=3), "kubectl")
+        new = mf.apply_merge(live, deployment(replicas=3), "backup-tool")
+        mgrs = mf.read_managers(new)
+        path = (("f", "spec"), ("f", "replicas"))
+        assert path in mgrs[("kubectl", "Apply")]
+        assert path in mgrs[("backup-tool", "Apply")]  # co-ownership
+
+    def test_dropped_field_is_removed(self):
+        first = deployment()
+        first["metadata"]["labels"] = {"a": "1", "b": "2"}
+        live = mf.apply_merge(None, first, "kubectl")
+        second = deployment()
+        second["metadata"]["labels"] = {"a": "1"}
+        new = mf.apply_merge(live, second, "kubectl")
+        assert new["metadata"]["labels"] == {"a": "1"}
+
+    def test_dropped_but_coowned_field_stays(self):
+        first = deployment()
+        first["metadata"]["labels"] = {"a": "1"}
+        live = mf.apply_merge(None, first, "kubectl")
+        live = mf.apply_merge(live, first, "other")  # co-owner, same value
+        second = deployment()
+        second["metadata"]["labels"] = {}
+        new = mf.apply_merge(live, second, "kubectl")
+        # kubectl dropped it, but 'other' still owns it -> it stays
+        assert new["metadata"]["labels"] == {"a": "1"}
+
+    def test_keyed_list_elements_merge_by_name(self):
+        a = deployment(template={"containers": [
+            {"name": "app", "image": "app:v1"}]})
+        live = mf.apply_merge(None, a, "app-team")
+        b = deployment(template={"containers": [
+            {"name": "sidecar", "image": "proxy:v2"}]})
+        new = mf.apply_merge(live, b, "mesh-operator")
+        names = {c["name"] for c in new["spec"]["template"]["containers"]}
+        assert names == {"app", "sidecar"}
+        # each team owns its own element
+        mgrs = mf.read_managers(new)
+        app_leaf = next(p for p in mgrs[("app-team", "Apply")]
+                        if any(k == "k" for k, _ in p))
+        assert '"app"' in str(app_leaf)
+
+    def test_update_takes_ownership(self):
+        live = mf.apply_merge(None, deployment(replicas=3), "kubectl")
+        edited = {k: v for k, v in live.items()}
+        edited["spec"] = {"replicas": 7}
+        mf.track_update(live, edited, "scaler")
+        mgrs = mf.read_managers(edited)
+        path = (("f", "spec"), ("f", "replicas"))
+        assert path in mgrs[("scaler", "Update")]
+        assert path not in mgrs.get(("kubectl", "Apply"), set())
+        # the next kubectl apply with the OLD value now conflicts
+        with pytest.raises(mf.ApplyConflict):
+            mf.apply_merge(edited, deployment(replicas=3), "kubectl")
+
+
+class TestApplyOverHTTP:
+    @pytest.fixture()
+    def server(self):
+        s = APIServer(kv.MemoryStore()).start()
+        yield s
+        s.stop()
+
+    def test_apply_create_merge_conflict_force(self, server):
+        c1 = HTTPClient.from_url(server.url)
+        c2 = HTTPClient.from_url(server.url)
+        obj = deployment(replicas=2)
+        created = c1.apply("deployments", obj, field_manager="kubectl")
+        assert created["spec"]["replicas"] == 2
+        assert created["metadata"]["managedFields"]
+
+        with pytest.raises(kv.ConflictError) as ei:
+            c2.apply("deployments", deployment(replicas=9),
+                     field_manager="hpa")
+        assert "kubectl" in str(ei.value)
+        forced = c2.apply("deployments", deployment(replicas=9),
+                          field_manager="hpa", force=True)
+        assert forced["spec"]["replicas"] == 9
+
+    def test_put_records_update_manager(self, server):
+        c = HTTPClient.from_url(server.url)
+        c.create("configmaps", {"apiVersion": "v1", "kind": "ConfigMap",
+                                "metadata": {"name": "cm",
+                                             "namespace": "default"},
+                                "data": {"k": "v"}})
+        cur = c.get("configmaps", "default", "cm")
+        cur["data"] = {"k": "v2"}
+        updated = c.update("configmaps", cur)
+        mgrs = mf.read_managers(updated)
+        assert any(op == "Update" for _, op in mgrs)
+
+
+class TestKubectlApply(object):
+    def run_kubectl(self, client, *argv):
+        from kubernetes_tpu.cli.kubectl import run
+        out = io.StringIO()
+        rc = run(list(argv), client, out)
+        return rc, out.getvalue()
+
+    def test_apply_lifecycle(self, tmp_path):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        man = tmp_path / "dep.yaml"
+        man.write_text("""\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+spec:
+  replicas: 2
+""")
+        rc, out = self.run_kubectl(client, "apply", "-f", str(man))
+        assert rc == 0 and "created" in out
+        rc, out = self.run_kubectl(client, "apply", "-f", str(man))
+        assert rc == 0 and "configured" in out
+
+        # another manager takes the field over
+        client.apply("deployments",
+                     deployment(replicas=5), "hpa", force=True)
+        rc, out = self.run_kubectl(client, "apply", "-f", str(man))
+        assert rc == 1
+        assert "--force-conflicts" in out
+        rc, out = self.run_kubectl(client, "apply", "-f", str(man),
+                                   "--force-conflicts")
+        assert rc == 0
+        assert store.get("deployments", "default", "web")["spec"][
+            "replicas"] == 2
