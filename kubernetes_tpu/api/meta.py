@@ -21,6 +21,21 @@ from typing import Any
 
 Obj = dict[str, Any]
 
+# deletion-propagation finalizers (apimachinery metav1 FinalizerDeleteDependents
+# / FinalizerOrphanDependents; processed by the garbage collector)
+FOREGROUND_FINALIZER = "foregroundDeletion"
+ORPHAN_FINALIZER = "orphan"
+
+
+def propagation_finalizer(policy: str | None) -> str | None:
+    """DeleteOptions.propagationPolicy -> finalizer to park the object
+    with (None for Background/default: delete immediately, GC cascades)."""
+    if policy == "Foreground":
+        return FOREGROUND_FINALIZER
+    if policy == "Orphan":
+        return ORPHAN_FINALIZER
+    return None
+
 
 def new_object(kind: str, name: str, namespace: str | None = "default", **meta: Any) -> Obj:
     o: Obj = {"apiVersion": "v1", "kind": kind, "metadata": {"name": name}}

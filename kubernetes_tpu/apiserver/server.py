@@ -932,6 +932,21 @@ class APIServer:
                         403, "Forbidden", str(e)))
                     return
                 try:
+                    # DeleteOptions.propagationPolicy: Foreground/Orphan
+                    # park the object with the matching finalizer for the
+                    # garbage collector (registry store deletion strategy)
+                    policy = (r.query.get("propagationPolicy")
+                              or [None])[0]
+                    fin = meta.propagation_finalizer(policy)
+                    if fin is not None:
+                        def park(cur, fin=fin):
+                            fins = cur["metadata"].setdefault(
+                                "finalizers", [])
+                            if fin not in fins:
+                                fins.append(fin)
+                            return cur
+                        server.store.guaranteed_update(
+                            r.resource, r.ns or "", r.name, park)
                     deleted = server.store.delete(r.resource, r.ns or "",
                                                   r.name)
                     if r.resource == crdlib.CRDS:

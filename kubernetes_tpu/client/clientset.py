@@ -249,7 +249,16 @@ class LocalClient(Client):
                           fn: Callable[[Obj], Obj]) -> Obj:
         return self.store.guaranteed_update(resource, namespace, name, fn)
 
-    def delete(self, resource: str, namespace: str, name: str) -> Obj:
+    def delete(self, resource: str, namespace: str, name: str,
+               propagation_policy: str | None = None) -> Obj:
+        fin = meta.propagation_finalizer(propagation_policy)
+        if fin is not None:
+            def park(cur, fin=fin):
+                fins = cur["metadata"].setdefault("finalizers", [])
+                if fin not in fins:
+                    fins.append(fin)
+                return cur
+            self.store.guaranteed_update(resource, namespace, name, park)
         return self.store.delete(resource, namespace, name)
 
     def apply(self, resource: str, obj: Obj, field_manager: str,

@@ -180,8 +180,12 @@ class HTTPClient(Client):
                 continue
         raise kv.ConflictError(f"{resource} {namespace}/{name}: too many CAS retries")
 
-    def delete(self, resource: str, namespace: str, name: str) -> Obj:
-        return self._request("DELETE", self._path(resource, namespace, name))
+    def delete(self, resource: str, namespace: str, name: str,
+               propagation_policy: str | None = None) -> Obj:
+        path = self._path(resource, namespace, name)
+        if propagation_policy:
+            path += f"?propagationPolicy={propagation_policy}"
+        return self._request("DELETE", path)
 
     def list(self, resource: str, namespace: str | None = None
              ) -> tuple[list[Obj], int]:
