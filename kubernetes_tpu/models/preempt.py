@@ -1,0 +1,75 @@
+"""Batched preemption candidate search — the device half of PostFilter.
+
+Reference: pkg/scheduler/framework/preemption/preemption.go —
+  DryRunPreemption (:579) fans goroutines out over candidate nodes, each
+  one simulating "remove all lower-priority pods, does the preemptor
+  fit?"; the reference samples candidates (GetOffsetAndNumCandidates)
+  rather than scanning every node.
+
+TPU-native reshape: the "remove all lower-priority victims" probe is a
+pure arithmetic refilter — free'[p,n] = alloc[n] - (used[n] -
+reclaimable[g(p),n]) — so ALL failed pods × ALL nodes evaluate in one
+fused device op, grouped by pod priority (pods of equal priority see the
+same reclaimable matrix).  The device returns each pod's top-k candidate
+rows ranked by fewest-potential-victims (the dominant term of
+pickOneNodeForPreemption's ordering); the host then runs the exact
+reprieve/PDB dry-run (scheduler/preemption.py) on just those k nodes,
+preserving reference victim-selection semantics while the O(pods*nodes)
+scan stays on device.
+
+Like the reference's sampling, top-k is a candidate LIMIT, not an
+approximation of victim selection: every returned candidate is re-proved
+host-side by the full filter plugin set.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG = -1e30
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _preempt_candidates(alloc, used, npods, maxpods, valid,
+                        reclaim, reclaim_np, group_idx, req, active, k):
+    """Per failed pod: top-k candidate node rows where preempting every
+    lower-priority pod would make it fit.
+
+    alloc/used: f32[N,R]; npods/maxpods: f32[N]; valid: bool[N]
+    reclaim: f32[G,N,R] resources reclaimable per priority group
+    reclaim_np: f32[G,N] pod-count reclaimable per priority group
+    group_idx: i32[P] pod -> priority group; req: f32[P,R]
+    active: bool[P] (padding rows inactive)
+    returns (rows i32[P,k], feasible_count i32[P])
+    """
+    rec = reclaim[group_idx]          # [P,N,R]
+    rec_np = reclaim_np[group_idx]    # [P,N]
+    free = alloc[None, :, :] - (used[None, :, :] - rec)
+    fits = jnp.all(req[:, None, :] <= free + 1e-6, axis=-1)
+    fits &= (npods[None, :] - rec_np + 1.0) <= maxpods[None, :]
+    fits &= valid[None, :]
+    fits &= rec_np > 0.0              # no victims -> plain FitError, not
+    fits &= active[:, None]           # a preemption candidate
+    # rank: fewest potential victims first (pickOneNode's dominant term),
+    # break ties toward more absolute headroom
+    headroom = jnp.sum(jnp.maximum(free, 0.0), axis=-1)
+    score = jnp.where(fits, -rec_np + 1e-9 * headroom, NEG)
+    vals, rows = jax.lax.top_k(score, k)
+    rows = jnp.where(vals > NEG / 2, rows, -1)
+    return rows, jnp.sum(fits, axis=1, dtype=jnp.int32)
+
+
+def preempt_candidates(alloc, used, npods, maxpods, valid, reclaim,
+                       reclaim_np, group_idx, req, active, k: int):
+    """Host entry: numpy in, numpy out (one blocking device round trip —
+    preemption is the rare path, latency over throughput)."""
+    rows, count = _preempt_candidates(
+        jnp.asarray(alloc), jnp.asarray(used), jnp.asarray(npods),
+        jnp.asarray(maxpods), jnp.asarray(valid), jnp.asarray(reclaim),
+        jnp.asarray(reclaim_np), jnp.asarray(group_idx), jnp.asarray(req),
+        jnp.asarray(active), k)
+    import numpy as np
+    return np.asarray(rows), np.asarray(count)

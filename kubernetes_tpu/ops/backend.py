@@ -341,3 +341,79 @@ class TPUBatchBackend(BatchBackend):
         if resolve is FLUSH_FIRST:  # pragma: no cover - sync caller, no inflight
             raise RuntimeError("FLUSH_FIRST with no pipelined caller")
         return resolve()
+
+    # -- batched preemption (PostFilter's device half) -------------------
+
+    PREEMPT_P_CAP = 32   # failed pods per device call (padded)
+    PREEMPT_G_CAP = 8    # distinct priority groups per device call
+
+    def _req_vec(self, res) -> np.ndarray:
+        """Resource -> the flattener's [R] request layout (flatten.py
+        encode(): core columns + scalar-vocab slots)."""
+        from .flatten import CORE_R
+        v = np.zeros(self.caps.r, np.float32)
+        v[0] = res.milli_cpu
+        v[1] = res.memory
+        v[2] = res.ephemeral_storage
+        for name, val in (res.scalar or {}).items():
+            sid = self.tensors.scalar_vocab.lookup(name)
+            if sid is not None:  # victims with unknown scalars reclaim
+                v[CORE_R + sid] = val  # nothing the incoming pod can use
+        return v
+
+    def preempt_candidates(self, pod_infos: Sequence[PodInfo], k: int = 16
+                           ) -> list[list[str] | None]:
+        """For each FitError pod, the top-k candidate node names where
+        removing every lower-priority pod would make it fit (device masked
+        refilter, models/preempt.py), best first.  None = this pod needs a
+        host full scan (priority-group overflow).  The host re-proves every
+        candidate with the full filter set, so this is a candidate LIMIT
+        (like the reference's DryRunPreemption sampling), never a wrong
+        answer."""
+        from ..models.preempt import preempt_candidates as dev_fn
+        out: list[list[str] | None] = [None] * len(pod_infos)
+        with self._lock:
+            t = self.tensors
+            prios = sorted({pi.priority for pi in pod_infos})
+            groups = prios[:self.PREEMPT_G_CAP]
+            gid_of = {p: g for g, p in enumerate(groups)}
+            G, N, R = max(len(groups), 1), self.caps.n_cap, self.caps.r
+            reclaim = np.zeros((G, N, R), np.float32)
+            reclaim_np = np.zeros((G, N), np.float32)
+            thresholds = np.asarray(groups or [0], np.float32)
+            for row, ni in enumerate(t.node_infos):
+                if ni is None or not t.valid[row]:
+                    continue
+                for vp in ni.pods:
+                    gmask = vp.priority < thresholds  # groups this victim
+                    if not gmask.any():               # is reclaimable for
+                        continue
+                    rv = self._req_vec(vp.request)
+                    reclaim[gmask, row] += rv
+                    reclaim_np[gmask, row] += 1.0
+            row_names = [ni.name if ni is not None else None
+                         for ni in t.node_infos]
+            alloc, used = t.alloc.copy(), t.used.copy()
+            npods, maxpods = t.npods.copy(), t.maxpods.copy()
+            valid = t.valid.copy()
+
+        P = self.PREEMPT_P_CAP
+        idxs = [i for i, pi in enumerate(pod_infos)
+                if pi.priority in gid_of]
+        for at in range(0, len(idxs), P):
+            chunk = idxs[at:at + P]
+            req = np.zeros((P, self.caps.r), np.float32)
+            group_idx = np.zeros(P, np.int32)
+            active = np.zeros(P, bool)
+            for j, i in enumerate(chunk):
+                req[j] = self._req_vec(pod_infos[i].request)
+                group_idx[j] = gid_of[pod_infos[i].priority]
+                active[j] = True
+            rows, _count = dev_fn(alloc, used, npods, maxpods, valid,
+                                  reclaim, reclaim_np, group_idx, req,
+                                  active, k)
+            for j, i in enumerate(chunk):
+                names = [row_names[r] for r in rows[j] if r >= 0
+                         and row_names[r] is not None]
+                out[i] = names
+        return out

@@ -32,14 +32,32 @@ class DefaultPreemption(PostFilterPlugin):
     def events_to_register(self):
         return [ClusterEvent("AssignedPod", "Delete"), ClusterEvent("Pod", "Delete")]
 
-    def post_filter(self, state: CycleState, pod_info: PodInfo,
-                    filtered_node_status_map: dict[str, Status]
-                    ) -> tuple[str | None, Status]:
+    def evaluator(self) -> Evaluator:
+        """The (lazily built) evaluator — shared with the batched TPU
+        preemption path so both run identical victim selection."""
         if self._evaluator is None:
             self._evaluator = Evaluator(
                 self._framework, self.client,
                 observer=lambda n: (self.preemption_observer(n)
                                     if self.preemption_observer else None))
+        return self._evaluator
+
+    def persist_nomination(self, pod_info: PodInfo, nominated: str) -> None:
+        """Patch status.nominatedNodeName (handleSchedulingFailure)."""
+        try:
+            def patch(p):
+                p.setdefault("status", {})["nominatedNodeName"] = nominated
+                return p
+            self.client.guaranteed_update(
+                PODS, meta.namespace(pod_info.pod), meta.name(pod_info.pod),
+                patch)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def post_filter(self, state: CycleState, pod_info: PodInfo,
+                    filtered_node_status_map: dict[str, Status]
+                    ) -> tuple[str | None, Status]:
+        self.evaluator()
         snapshot = self._snapshot_getter()
         if snapshot is None:
             return None, Status(UNSCHEDULABLE, "no snapshot for preemption")
@@ -48,14 +66,6 @@ class DefaultPreemption(PostFilterPlugin):
         if nominated:
             # persist the nomination (schedule_one.go handleSchedulingFailure
             # patches status.nominatedNodeName via the API)
-            try:
-                def patch(p):
-                    p.setdefault("status", {})["nominatedNodeName"] = nominated
-                    return p
-                self.client.guaranteed_update(
-                    PODS, meta.namespace(pod_info.pod), meta.name(pod_info.pod),
-                    patch)
-            except Exception:  # noqa: BLE001
-                pass
+            self.persist_nomination(pod_info, nominated)
             return nominated, Status(SUCCESS)
         return None, status

@@ -67,6 +67,32 @@ class Evaluator:
             self.observer(len(best.victims))
         return best.node_name, Status(SUCCESS)
 
+    def preempt_among(self, state: CycleState, pod_info: PodInfo,
+                      node_infos: list[NodeInfo], snapshot: Snapshot
+                      ) -> tuple[str | None, Status]:
+        """preempt() restricted to a pre-filtered candidate node list —
+        the host tail of the batched TPU preemption path (the device
+        already proved these nodes resource-feasible after victim
+        removal; the exact reprieve/PDB dry-run still runs here, so
+        victim selection semantics match the per-pod path)."""
+        if not self._pod_eligible(pod_info, snapshot):
+            return None, Status(UNSCHEDULABLE, "pod is not eligible for preemption")
+        pdbs = self._list_pdbs(meta.namespace(pod_info.pod))
+        candidates = []
+        for ni in node_infos:
+            cand = self._dry_run_on_node(state, pod_info, ni, pdbs)
+            if cand is not None:
+                candidates.append(cand)
+        if not candidates:
+            return None, Status(UNSCHEDULABLE, "no preemption candidates")
+        best = self.select_candidate(candidates)
+        status = self._prepare_candidate(best, pod_info)
+        if not is_success(status):
+            return None, status
+        if self.observer is not None:
+            self.observer(len(best.victims))
+        return best.node_name, Status(SUCCESS)
+
     def _pod_eligible(self, pod_info: PodInfo, snapshot: Snapshot) -> bool:
         """podEligibleToPreemptOthers: if the pod already nominated a node
         and a victim there is still terminating, wait instead of preempting

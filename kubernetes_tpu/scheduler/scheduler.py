@@ -739,6 +739,54 @@ class Scheduler:
         except kv.StoreError:
             pass
 
+    def _batch_preempt(self, profile: Profile, fw: Framework,
+                       failures: list[tuple[QueuedPodInfo, Status]],
+                       cycle: int, start: float) -> None:
+        """PostFilter for a batch's FitError pods: the device proposes
+        candidate nodes via a masked victim-removal refilter
+        (ops/backend.preempt_candidates -> models/preempt.py), and the
+        host evaluator runs the exact reprieve/PDB dry-run on just those
+        candidates (preemption.go:579 DryRunPreemption semantics with the
+        reference's own candidate-sampling precedent).  Pods the device
+        cannot group (priority overflow) take the full host scan, so
+        coverage matches the per-pod path."""
+        plugin = next((p for p in fw.post_filter
+                       if hasattr(p, "evaluator")
+                       and hasattr(p, "persist_nomination")), None)
+        backend = profile.batch_backend
+        if plugin is None or not hasattr(backend, "preempt_candidates"):
+            for qpi, st in failures:
+                self._handle_failure(fw, qpi, st, cycle, set(), start)
+            return
+        snapshot = Snapshot() if not hasattr(self, "_snapshot") \
+            else self._snapshot
+        self._snapshot = snapshot = self.cache.update_snapshot(snapshot)
+        # higher-priority preemptors go first (activeQ pop-order parity)
+        order = sorted(range(len(failures)),
+                       key=lambda i: -failures[i][0].pod_info.priority)
+        cand_names = backend.preempt_candidates(
+            [failures[i][0].pod_info for i in order])
+        ev = plugin.evaluator()
+        for j, i in enumerate(order):
+            qpi, st = failures[i]
+            pod_info = qpi.pod_info
+            names = cand_names[j]
+            nominated = None
+            if names is None:
+                # device couldn't evaluate this pod: full host PostFilter
+                nominated, _ps = fw.run_post_filter_plugins(
+                    CycleState(), pod_info, {})
+            elif names:
+                infos = [ni for ni in (snapshot.get(nm) for nm in names)
+                         if ni is not None]
+                nominated, _ps = ev.preempt_among(
+                    CycleState(), pod_info, infos, snapshot)
+                if nominated:
+                    plugin.persist_nomination(pod_info, nominated)
+            if nominated:
+                self.queue.nominator.add_nominated_pod(pod_info, nominated)
+            self._handle_failure(fw, qpi, st, cycle, set(), start)
+
     # -- batch pipeline (TPU path; no reference equivalent) --------------
 
     def schedule_batch(self, profile: Profile, batch: list[QueuedPodInfo]) -> None:
@@ -808,6 +856,7 @@ class Scheduler:
         bulk: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         # phase 1: collect placements; failures/escapes handled per pod
         placed: list[tuple[QueuedPodInfo, str, Obj, PodInfo]] = []
+        fit_failures: list[tuple[QueuedPodInfo, Status]] = []
         for qpi, (node_name, s) in zip(live, results):
             if node_name is None:
                 if s is not None and s.is_skip():
@@ -817,6 +866,11 @@ class Scheduler:
                     self._deferred.append(qpi)
                     continue
                 st = s or Status(UNSCHEDULABLE, "no feasible node (batch)")
+                if st.code == UNSCHEDULABLE and fw.post_filter:
+                    # FitError: PostFilter (batched preemption) below,
+                    # after assume so dry-runs see this batch's claims
+                    fit_failures.append((qpi, st))
+                    continue
                 self._handle_failure(fw, qpi, st, cycle,
                                      {st.plugin} if st.plugin else set(), start)
                 continue
@@ -837,6 +891,8 @@ class Scheduler:
                                      set(), start)
             else:
                 ok.append((qpi, node_name, assumed))
+        if fit_failures:
+            self._batch_preempt(profile, fw, fit_failures, cycle, start)
         if not ok:
             return
         # turbo tail: with an empty CycleState the hook loops are provably

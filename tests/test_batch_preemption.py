@@ -1,0 +1,193 @@
+"""Batched (TPU-path) preemption: device candidate search + host dry-run.
+
+Reference semantics: framework/preemption/preemption.go DryRunPreemption
+(:579) / SelectCandidate (:307), run for FitError pods coming out of a
+DEVICE batch instead of the per-pod loop (VERDICT r1 item 7).
+
+Runs on CPU with 8 virtual devices (tests/conftest.py).
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.ops.backend import TPUBatchBackend
+from kubernetes_tpu.ops.flatten import Caps
+from kubernetes_tpu.scheduler import (
+    Profile, Scheduler, new_default_framework,
+)
+from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler.types import PodInfo
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def wait_for(predicate, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def small_caps():
+    return Caps(n_cap=16, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                s_cap=2, sg_cap=8, asg_cap=8)
+
+
+def snapshot_from(nodes, bound_pods=()):
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in bound_pods:
+        cache.add_pod(p)
+    return cache.update_snapshot(Snapshot())
+
+
+def bound(name, node, cpu="800m", prio=1):
+    return (make_pod(name).priority(prio).req(cpu=cpu)
+            .node(node).build())
+
+
+class TestPreemptCandidates:
+    """Unit: the device masked-refilter candidate search."""
+
+    def make_backend(self, nodes, bound_pods):
+        snap = snapshot_from(nodes, bound_pods)
+        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        backend.assign([], snap)  # sync tensors to the cluster state
+        return backend
+
+    def test_candidates_only_where_victims_free_enough(self):
+        nodes = [make_node(f"n{i}").capacity(cpu="1", mem="2Gi").build()
+                 for i in range(3)]
+        # n0: low-prio victim; n1: HIGH-prio occupant (not a victim);
+        # n2: low-prio victim
+        backend = self.make_backend(nodes, [
+            bound("v0", "n0", prio=1), bound("h1", "n1", prio=100),
+            bound("v2", "n2", prio=1)])
+        preemptor = PodInfo(make_pod("p").priority(50).req(cpu="800m").build())
+        (names,) = backend.preempt_candidates([preemptor])
+        assert set(names) == {"n0", "n2"}
+
+    def test_no_candidates_without_victims(self):
+        nodes = [make_node("n0").capacity(cpu="1", mem="2Gi").build()]
+        backend = self.make_backend(nodes, [bound("big", "n0", prio=100)])
+        preemptor = PodInfo(make_pod("p").priority(50).req(cpu="800m").build())
+        (names,) = backend.preempt_candidates([preemptor])
+        assert names == []
+
+    def test_priority_groups_see_different_victim_sets(self):
+        nodes = [make_node("n0").capacity(cpu="1", mem="2Gi").build()]
+        backend = self.make_backend(nodes, [bound("mid", "n0", prio=50)])
+        lo = PodInfo(make_pod("lo").priority(10).req(cpu="800m").build())
+        hi = PodInfo(make_pod("hi").priority(90).req(cpu="800m").build())
+        lo_names, hi_names = backend.preempt_candidates([lo, hi])
+        assert lo_names == []          # prio 10 cannot evict prio 50
+        assert hi_names == ["n0"]      # prio 90 can
+
+    def test_fewest_victims_ranked_first(self):
+        nodes = [make_node(f"n{i}").capacity(cpu="1", mem="2Gi").build()
+                 for i in range(2)]
+        backend = self.make_backend(nodes, [
+            bound("a0", "n0", cpu="400m"), bound("a1", "n0", cpu="400m"),
+            bound("b0", "n1", cpu="800m")])
+        preemptor = PodInfo(make_pod("p").priority(50).req(cpu="700m").build())
+        (names,) = backend.preempt_candidates([preemptor])
+        assert names[0] == "n1"  # one victim beats two
+
+
+@pytest.fixture
+def tpu_cluster():
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    fw = new_default_framework(client, factory)
+    backend = TPUBatchBackend(small_caps(), batch_size=8)
+    sched = Scheduler(client, factory, {"default-scheduler": Profile(
+        fw, batch_backend=backend, batch_size=8)})
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    yield store, client, sched
+    sched.stop()
+    factory.stop()
+
+
+def node_of(client, name):
+    try:
+        return meta.pod_node_name(client.get(PODS, "default", name)) or None
+    except kv.NotFoundError:
+        return None
+
+
+class TestBatchPathPreemption:
+    """E2E: FitError pods from the device batch preempt victims."""
+
+    def test_high_priority_preempts_through_batch_path(self, tpu_cluster):
+        store, client, sched = tpu_cluster
+        client.create(NODES,
+                      make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        client.create(PODS,
+                      make_pod("low").priority(1).req(cpu="800m").build())
+        assert wait_for(lambda: node_of(client, "low") == "n1")
+        client.create(PODS,
+                      make_pod("high").priority(100).req(cpu="800m").build())
+        # victim evicted, preemptor eventually lands on the freed node
+        assert wait_for(lambda: node_of(client, "low") is None)
+        assert wait_for(lambda: node_of(client, "high") == "n1")
+
+    def test_equal_priority_is_not_preempted(self, tpu_cluster):
+        store, client, sched = tpu_cluster
+        client.create(NODES,
+                      make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        client.create(PODS,
+                      make_pod("first").priority(5).req(cpu="800m").build())
+        assert wait_for(lambda: node_of(client, "first") == "n1")
+        client.create(PODS,
+                      make_pod("second").priority(5).req(cpu="800m").build())
+        time.sleep(1.0)
+        assert node_of(client, "first") == "n1"
+        assert node_of(client, "second") is None
+
+    def test_minimal_victim_set_through_batch_path(self, tpu_cluster):
+        store, client, sched = tpu_cluster
+        client.create(NODES,
+                      make_node("n1").capacity(cpu="2", mem="4Gi").build())
+        client.create(NODES,
+                      make_node("n2").capacity(cpu="2", mem="4Gi").build())
+        client.create(PODS, make_pod("v1a").priority(1).req(cpu="900m").build())
+        client.create(PODS, make_pod("v1b").priority(1).req(cpu="900m").build())
+        assert wait_for(lambda: node_of(client, "v1a") and
+                        node_of(client, "v1b"))
+        # ensure a known layout by filling whichever node got both/neither
+        layout = {node_of(client, "v1a"), node_of(client, "v1b")}
+        if layout == {"n1", "n2"}:
+            # one victim per node: preemptor needs only one victim either
+            # way; just verify a single eviction happens
+            client.create(PODS,
+                          make_pod("hi").priority(9).req(cpu="1500m").build())
+            assert wait_for(lambda: node_of(client, "hi") is not None)
+            survivors = [n for n in ("v1a", "v1b")
+                         if node_of(client, n) is not None]
+            assert len(survivors) == 1
+        else:
+            client.create(PODS,
+                          make_pod("hi").priority(9).req(cpu="1500m").build())
+            assert wait_for(lambda: node_of(client, "hi") is not None)
+
+    def test_preemption_metrics_recorded(self, tpu_cluster):
+        store, client, sched = tpu_cluster
+        client.create(NODES,
+                      make_node("n1").capacity(cpu="1", mem="2Gi").build())
+        client.create(PODS,
+                      make_pod("low").priority(1).req(cpu="900m").build())
+        assert wait_for(lambda: node_of(client, "low") == "n1")
+        client.create(PODS,
+                      make_pod("high").priority(50).req(cpu="900m").build())
+        assert wait_for(lambda: node_of(client, "high") == "n1")
+        assert sched.metrics.preemption_attempts >= 1
