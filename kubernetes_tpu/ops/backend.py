@@ -31,19 +31,23 @@ wrong answers are structurally impossible, only coverage varies.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Sequence
 
 import numpy as np
 
 from ..models.assign import (
-    ALL_FEATURES, PLAIN_FEATURES, STATE_KEYS, build_packed_assign_fn,
-    pack_pod_batch,
+    ALL_FEATURES, PLAIN_FEATURES, STATE_KEYS, PackSpec,
+    build_packed_assign_fn, pack_pod_batch,
 )
 from ..scheduler.cache import Snapshot
 from ..scheduler.scheduler import BatchBackend
 from ..scheduler.types import ERROR, SKIP, UNSCHEDULABLE, PodInfo, Status
-from .flatten import BatchEncoder, Caps, ClusterTensors, PodBatch, VocabFullError
+from .flatten import (
+    BatchEncoder, Caps, ClusterTensors, PodBatch, VocabFullError,
+    slice_pod_batch,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -88,15 +92,119 @@ def decode_results(assignments, n: int, batch_size: int, escapes: set,
     return results
 
 
-class TPUBatchBackend(BatchBackend):
+class ResidentHostMirror:
+    """Host-side replay mirror shared by the single-chip and sharded
+    backends: the device keeps the node dynamics resident; the host
+    mirror replays the kernel's commit rules so the next dispatch can
+    diff authoritative-vs-mirror and upload only externally-changed rows.
+    Consumers provide: self.tensors, self._mirror, self._f_patch,
+    self._k_cap, self.batch_size."""
+
+    def _needs_full(self, batch: PodBatch) -> bool:
+        """Batches using selectors/constraints/ports/pins need the
+        constraint-carrying kernel; the common plain case runs a variant
+        with those code paths elided (models/assign PLAIN_FEATURES)."""
+        t = self.tensors
+        return bool(
+            t.sgs or t.asgs or batch.c_kind.any()
+            or batch.sel_any_active.any() or batch.key_any_active.any()
+            or batch.sel_forb.any() or batch.key_forb.any()
+            or batch.ports.any() or batch.untol_prefer.any()
+            or (batch.node_row >= 0).any())
+
+    def _diff_patches(self, dirty_rows) -> tuple[np.ndarray, np.ndarray] | None:
+        """Rows where authoritative != mirror (read-only; mirror untouched).
+        None -> too many (refresh)."""
+        t, m = self.tensors, self._mirror
+        rows = []
+        for r in dirty_rows:
+            if (not np.array_equal(t.used[r], m["used"][r])
+                    or not np.array_equal(t.used_nz[r], m["used_nz"][r])
+                    or t.npods[r] != m["npods"][r]
+                    or not np.array_equal(t.port_mask[r], m["port_mask"][r])):
+                rows.append(r)
+        if len(rows) > self._k_cap:
+            return None
+        if not rows:
+            return np.empty(0, np.int32), np.empty((0, self._f_patch),
+                                                   np.float32)
+        rows_a = np.asarray(rows, np.int32)
+        vals = np.concatenate([
+            t.used[rows_a], t.used_nz[rows_a], t.npods[rows_a][:, None],
+            t.port_mask[rows_a]], axis=1).astype(np.float32)
+        return rows_a, vals
+
+    def _sync_mirror_rows(self, rows_a: np.ndarray) -> None:
+        """Bring the mirror in line with what the device will hold after the
+        row patch uploads authoritative values."""
+        t, m = self.tensors, self._mirror
+        for f in DYN_FIELDS:
+            m[f][rows_a] = getattr(t, f)[rows_a]
+
+    def _mirror_from_tensors(self, cd_sg: np.ndarray,
+                             cd_asg: np.ndarray) -> None:
+        t = self.tensors
+        self._mirror = {
+            "used": t.used.copy(), "used_nz": t.used_nz.copy(),
+            "npods": t.npods.copy(), "port_mask": t.port_mask.copy(),
+            "cd_sg": cd_sg.copy(), "cd_asg": cd_asg.copy(),
+        }
+
+    def _replay(self, batch: PodBatch, assignments: np.ndarray) -> None:
+        """Apply the kernel's commit rules to the host mirror.  Fully
+        vectorized: np.add.at / maximum.at accumulate correctly when many
+        pods land on the same row (a per-pod Python loop here cost
+        ~15ms/batch at bench shapes)."""
+        t, m = self.tensors, self._mirror
+        n = min(len(assignments), self.batch_size)
+        rows = np.asarray(assignments[:n], np.int64)
+        placed = np.nonzero(rows >= 0)[0]
+        if placed.size == 0:
+            return
+        prow = rows[placed]
+        np.add.at(m["used"], prow, batch.req[placed])
+        np.add.at(m["used_nz"], prow, batch.req_nz[placed])
+        np.add.at(m["npods"], prow, 1.0)
+        np.maximum.at(m["port_mask"], prow, batch.ports[placed])
+        for sg in range(len(t.sgs)):
+            inc = placed[batch.inc_sg[placed, sg] > 0]
+            if inc.size:
+                d = t.dom_sg[sg, rows[inc]]
+                np.add.at(m["cd_sg"][sg], d[d >= 0], 1.0)
+        for a in range(len(t.asgs)):
+            inc = placed[batch.inc_asg[placed, a] > 0]
+            if inc.size:
+                d = t.dom_asg[a, rows[inc]]
+                np.add.at(m["cd_asg"][a], d[d >= 0], 1.0)
+
+
+class TPUBatchBackend(ResidentHostMirror, BatchBackend):
     def __init__(self, caps: Caps | None = None, batch_size: int = 256,
-                 weights: dict[str, float] | None = None, k_cap: int = 1024):
+                 weights: dict[str, float] | None = None, k_cap: int = 1024,
+                 full_batch_cap: int | None = None):
         self.caps = caps or Caps()
         self.batch_size = batch_size
         self.tensors = ClusterTensors(self.caps)
         self.encoder = BatchEncoder(self.tensors, batch_size)
-        self._fn, self._spec = build_packed_assign_fn(
-            self.caps, batch_size, k_cap, weights)
+        # The constraint-carrying ("full") kernel variant materializes
+        # ~58 bytes per (pod, node) cell in [P,N] planes; at 100k nodes a
+        # 16k batch wants ~100G HBM.  It therefore compiles at its own
+        # capped P and oversized batches run through it in chunks
+        # (resident state chains across chunks), while the PLAIN variant
+        # — the Pallas fused tile, no [P,N] planes — keeps the full
+        # batch.  At bench 5k-node shapes the cap resolves to batch_size
+        # and nothing changes.
+        if full_batch_cap is None:
+            budget = float(os.environ.get("KTPU_FULL_HBM_BUDGET", 11e9))
+            fit = int(budget / (64 * self.caps.n_cap))
+            full_batch_cap = batch_size
+            while full_batch_cap > 256 and full_batch_cap > fit:
+                full_batch_cap //= 2
+        self.full_cap = min(full_batch_cap, batch_size)
+        self._fn_full = None   # built lazily / in warmup
+        self._spec_full = None
+        self._spec = PackSpec(self.caps, batch_size, k_cap)
+        self._f_patch = self._spec.f_patch
         self._weights = weights
         self._fn_plain = None  # built lazily on first plain batch
         self._k_cap = k_cap
@@ -129,19 +237,33 @@ class TPUBatchBackend(BatchBackend):
             if self._state is None:
                 self._full_refresh(cd_sg, cd_asg)
             batch = self.encoder.encode([])
-            buf = jnp.asarray(pack_pod_batch(
-                batch, self._spec, np.empty(0, np.int32),
-                np.empty((0, self._spec.f_patch), np.float32)))
+            empty = (np.empty(0, np.int32),
+                     np.empty((0, self._f_patch), np.float32))
             # an all-invalid batch leaves the resident state numerically
             # unchanged, so running it through both variants is free
-            self._state, a = self._fn(self._state, self._static_node, buf)
-            if self._fn_plain is None:
-                self._fn_plain, _ = build_packed_assign_fn(
-                    self.caps, self.batch_size, self._k_cap, self._weights,
-                    features=PLAIN_FEATURES)
-            self._state, a = self._fn_plain(
+            self._ensure_full()
+            buf = jnp.asarray(pack_pod_batch(
+                slice_pod_batch(batch, 0, 0, self.full_cap),
+                self._spec_full, *empty))
+            self._state, a = self._fn_full(self._state, self._static_node,
+                                           buf)
+            buf = jnp.asarray(pack_pod_batch(batch, self._spec, *empty))
+            self._state, a = self._ensure_plain()(
                 self._state, self._static_node, buf)
             np.asarray(a)  # block until the device round trip completes
+
+    def _ensure_full(self):
+        if self._fn_full is None:
+            self._fn_full, self._spec_full = build_packed_assign_fn(
+                self.caps, self.full_cap, self._k_cap, self._weights)
+        return self._fn_full
+
+    def _ensure_plain(self):
+        if self._fn_plain is None:
+            self._fn_plain, _ = build_packed_assign_fn(
+                self.caps, self.batch_size, self._k_cap, self._weights,
+                features=PLAIN_FEATURES)
+        return self._fn_plain
 
     def _upload_static(self) -> None:
         import jax.numpy as jnp
@@ -165,85 +287,8 @@ class TPUBatchBackend(BatchBackend):
             "port_mask": jnp.asarray(t.port_mask),
             "cd_sg": jnp.asarray(cd_sg), "cd_asg": jnp.asarray(cd_asg),
         }
-        self._mirror = {
-            "used": t.used.copy(), "used_nz": t.used_nz.copy(),
-            "npods": t.npods.copy(), "port_mask": t.port_mask.copy(),
-            "cd_sg": cd_sg.copy(), "cd_asg": cd_asg.copy(),
-        }
+        self._mirror_from_tensors(cd_sg, cd_asg)
         self.stats["full_refresh"] += 1
-
-    def _diff_patches(self, dirty_rows) -> tuple[np.ndarray, np.ndarray] | None:
-        """Rows where authoritative != mirror (read-only; mirror untouched).
-        None -> too many (refresh)."""
-        t, m = self.tensors, self._mirror
-        rows = []
-        for r in dirty_rows:
-            if (not np.array_equal(t.used[r], m["used"][r])
-                    or not np.array_equal(t.used_nz[r], m["used_nz"][r])
-                    or t.npods[r] != m["npods"][r]
-                    or not np.array_equal(t.port_mask[r], m["port_mask"][r])):
-                rows.append(r)
-        if len(rows) > self._k_cap:
-            return None
-        if not rows:
-            return np.empty(0, np.int32), np.empty((0, self._spec.f_patch),
-                                                   np.float32)
-        rows_a = np.asarray(rows, np.int32)
-        vals = np.concatenate([
-            t.used[rows_a], t.used_nz[rows_a], t.npods[rows_a][:, None],
-            t.port_mask[rows_a]], axis=1).astype(np.float32)
-        return rows_a, vals
-
-    def _sync_mirror_rows(self, rows_a: np.ndarray) -> None:
-        """Bring the mirror in line with what the device will hold after the
-        row patch uploads authoritative values."""
-        t, m = self.tensors, self._mirror
-        for f in DYN_FIELDS:
-            m[f][rows_a] = getattr(t, f)[rows_a]
-
-    def _replay(self, batch: PodBatch, assignments: np.ndarray) -> None:
-        """Apply the kernel's commit rules to the host mirror.  Fully
-        vectorized: np.add.at / maximum.at accumulate correctly when many
-        pods land on the same row (a per-pod Python loop here cost
-        ~15ms/batch at bench shapes)."""
-        t, m = self.tensors, self._mirror
-        n = min(len(assignments), self.batch_size)
-        rows = np.asarray(assignments[:n], np.int64)
-        placed = np.nonzero(rows >= 0)[0]
-        if placed.size == 0:
-            return
-        prow = rows[placed]
-        np.add.at(m["used"], prow, batch.req[placed])
-        np.add.at(m["used_nz"], prow, batch.req_nz[placed])
-        np.add.at(m["npods"], prow, 1.0)
-        np.maximum.at(m["port_mask"], prow, batch.ports[placed])
-        for sg in range(len(t.sgs)):
-            inc = placed[batch.inc_sg[placed, sg] > 0]
-            if inc.size:
-                d = t.dom_sg[sg, rows[inc]]
-                np.add.at(m["cd_sg"][sg], d[d >= 0], 1.0)
-        for a in range(len(t.asgs)):
-            inc = placed[batch.inc_asg[placed, a] > 0]
-            if inc.size:
-                d = t.dom_asg[a, rows[inc]]
-                np.add.at(m["cd_asg"][a], d[d >= 0], 1.0)
-
-    def _pick_variant(self, batch: PodBatch):
-        """The device endpoint has high per-op overhead, so batches that use
-        no selectors/constraints/ports/pins (the common case) run a kernel
-        with those code paths elided (models/assign PLAIN_FEATURES)."""
-        t = self.tensors
-        if (t.sgs or t.asgs or batch.c_kind.any() or batch.sel_any_active.any()
-                or batch.key_any_active.any() or batch.sel_forb.any()
-                or batch.key_forb.any() or batch.ports.any()
-                or batch.untol_prefer.any() or (batch.node_row >= 0).any()):
-            return self._fn
-        if self._fn_plain is None:
-            self._fn_plain, _ = build_packed_assign_fn(
-                self.caps, self.batch_size, self._k_cap, self._weights,
-                features=PLAIN_FEATURES)
-        self.stats["plain"] = self.stats.get("plain", 0) + 1
-        return self._fn_plain
 
     # -- BatchBackend ----------------------------------------------------
 
@@ -304,11 +349,44 @@ class TPUBatchBackend(BatchBackend):
             self._carry_dirty = set()
             self.stats["patched_rows"] += len(patches[0])
 
-            buf = pack_pod_batch(batch, self._spec, patches[0], patches[1])
             import jax.numpy as jnp
-            fn = self._pick_variant(batch)
-            self._state, result_dev = fn(
-                self._state, self._static_node, jnp.asarray(buf))
+            n = len(pod_infos)
+            if self._needs_full(batch) and n > self.full_cap:
+                # oversized constraint batch: chunk through the capped
+                # full kernel; resident state chains chunk to chunk, so
+                # intra-batch accounting stays exact.  Patches ride the
+                # first chunk only.
+                self._ensure_full()
+                chunks = []
+                p = patches
+                for lo in range(0, n, self.full_cap):
+                    hi = min(lo + self.full_cap, n)
+                    cbuf = pack_pod_batch(
+                        slice_pod_batch(batch, lo, hi, self.full_cap),
+                        self._spec_full, p[0], p[1])
+                    p = (np.empty(0, np.int32),
+                         np.empty((0, self._f_patch), np.float32))
+                    self._state, rd = self._fn_full(
+                        self._state, self._static_node, jnp.asarray(cbuf))
+                    chunks.append((rd, lo, hi))
+            elif self._needs_full(batch):
+                self._ensure_full()
+                if self.full_cap == self.batch_size:
+                    cb, hi = batch, self.batch_size
+                else:
+                    cb, hi = slice_pod_batch(batch, 0, n, self.full_cap), n
+                cbuf = pack_pod_batch(cb, self._spec_full, patches[0],
+                                      patches[1])
+                self._state, rd = self._fn_full(
+                    self._state, self._static_node, jnp.asarray(cbuf))
+                chunks = [(rd, 0, hi)]
+            else:
+                self.stats["plain"] = self.stats.get("plain", 0) + 1
+                buf = pack_pod_batch(batch, self._spec, patches[0],
+                                     patches[1])
+                self._state, rd = self._ensure_plain()(
+                    self._state, self._static_node, jnp.asarray(buf))
+                chunks = [(rd, 0, self.batch_size)]
             self.stats["batches"] += 1
             holder = object()
             self._unresolved.append(holder)
@@ -317,13 +395,13 @@ class TPUBatchBackend(BatchBackend):
             # the live tensors
             row_infos = list(self.tensors.node_infos)
 
-        n = len(pod_infos)
-
         def resolve() -> list[tuple[str | None, Status | None]]:
             with self._lock:
-                result = np.asarray(result_dev)  # ONE blocking device pull
-                assignments = result[:-1]
-                self.stats["waves"] += int(result[-1])
+                assignments = np.full(self.batch_size, -1, np.int64)
+                for rd, lo, hi in chunks:
+                    result = np.asarray(rd)  # blocking device pull
+                    assignments[lo:hi] = result[:-1][:hi - lo]
+                    self.stats["waves"] += int(result[-1])
                 self._replay(batch, assignments)
                 try:
                     self._unresolved.remove(holder)

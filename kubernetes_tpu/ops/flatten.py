@@ -470,6 +470,31 @@ class PodBatch:
     escape: list[int] = field(default_factory=list)  # batch positions for oracle path
 
 
+def slice_pod_batch(batch: "PodBatch", lo: int, hi: int,
+                    p_cap: int) -> "PodBatch":
+    """Rows [lo, hi) of a PodBatch re-padded to p_cap — the chunking
+    primitive for running an oversized batch through a kernel compiled at
+    a smaller P (the constraint-carrying variant's HBM cap at large
+    n_cap).  Padding rows are invalid; escape positions are remapped to
+    chunk-local indices."""
+    import dataclasses
+    n = hi - lo
+    fields = {}
+    for f in dataclasses.fields(PodBatch):
+        if f.name in ("p_cap", "escape"):
+            continue
+        arr = getattr(batch, f.name)
+        if arr is None:
+            fields[f.name] = None
+            continue
+        out = np.zeros((p_cap,) + arr.shape[1:], arr.dtype)
+        out[:n] = arr[lo:hi]
+        fields[f.name] = out
+    fields["node_row"][n:] = -1
+    fields["escape"] = [e - lo for e in batch.escape if lo <= e < hi]
+    return PodBatch(p_cap=p_cap, **fields)
+
+
 class BatchEncoder:
     """Encodes a list of PodInfos against a ClusterTensors instance."""
 

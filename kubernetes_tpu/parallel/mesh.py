@@ -53,6 +53,81 @@ def pod_specs() -> dict:
     return {k: P() for k in keys}
 
 
+STATE_KEYS = ("used", "used_nz", "npods", "port_mask", "cd_sg", "cd_asg")
+STATIC_KEYS = ("alloc", "maxpods", "valid", "taint_mask", "label_mask",
+               "key_mask", "dom_sg", "dom_asg")
+
+
+def state_specs(axis: str = NODE_AXIS) -> dict:
+    ns = node_specs(axis)
+    return {k: ns[k] for k in STATE_KEYS}
+
+
+def static_specs(axis: str = NODE_AXIS) -> dict:
+    ns = node_specs(axis)
+    return {k: ns[k] for k in STATIC_KEYS}
+
+
+def build_sharded_step_fn(caps: Caps, mesh: Mesh,
+                          weights: dict[str, float] | None = None,
+                          axis: str = NODE_AXIS, k_cap: int = 1024,
+                          features=None):
+    """Resident-state sharded step: fn(state, static, pods, prows, pvals)
+    -> (new_state, assignments, waves), with `state` DONATED and returned
+    updated — the multi-chip twin of the single-chip packed kernel's
+    resident-dynamics design (ops/backend.py transport notes).
+
+    prows i32[k_cap] are GLOBAL node rows to overwrite from pvals
+    f32[k_cap, 2R+1+PT] (used | used_nz | npods | port_mask — the same
+    patch layout as models/assign.PackSpec.f_patch) before the wave
+    solve; -1 rows are padding.  Each shard applies only the patches that
+    land in its slab, so the upload is replicated but the scatter is
+    local — no collective needed.
+    """
+    import jax.numpy as jnp
+
+    n_shards = mesh.devices.size
+    if caps.n_cap % n_shards != 0:
+        raise ValueError(f"n_cap {caps.n_cap} not divisible by {n_shards}")
+    shard_n = caps.n_cap // n_shards
+    R, PT = caps.r, caps.pt_cap
+    from ..models.assign import ALL_FEATURES
+    core = make_assign_core(
+        caps, weights, axis_name=axis,
+        features=ALL_FEATURES if features is None else features)
+
+    def stepped(state, static, pods, prows, pvals):
+        local = prows - jax.lax.axis_index(axis) * shard_n
+        in_shard = (prows >= 0) & (local >= 0) & (local < shard_n)
+        li = jnp.where(in_shard, local, 0)
+
+        def put(arr, vals):
+            cur = arr[li]
+            mask = in_shard.reshape((-1,) + (1,) * (vals.ndim - 1))
+            return arr.at[li].set(jnp.where(mask, vals, cur))
+
+        node = dict(static)
+        node["used"] = put(state["used"], pvals[:, :R])
+        node["used_nz"] = put(state["used_nz"], pvals[:, R:2 * R])
+        node["npods"] = put(state["npods"], pvals[:, 2 * R])
+        node["port_mask"] = put(state["port_mask"],
+                                pvals[:, 2 * R + 1:2 * R + 1 + PT])
+        node["cd_sg"] = state["cd_sg"]
+        node["cd_asg"] = state["cd_asg"]
+        out = core(node, pods)
+        new_state = {k: out[k] for k in STATE_KEYS}
+        return new_state, out["assignments"], out["waves"]
+
+    ss, st = state_specs(axis), static_specs(axis)
+    fn = jax.shard_map(
+        stepped, mesh=mesh,
+        in_specs=(ss, st, pod_specs(), P(), P()),
+        out_specs=(ss, P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
 def build_sharded_assign_fn(caps: Caps, mesh: Mesh,
                             weights: dict[str, float] | None = None,
                             axis: str = NODE_AXIS):
