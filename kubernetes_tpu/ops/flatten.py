@@ -175,6 +175,11 @@ class ClusterTensors:
         # re-upload of the label/key masks every batch.
         self.version = 0         # any host-array mutation
         self.static_version = 0  # label/key/taint/alloc/dom/valid mutations
+        # row-incremental static upload support: rows whose static fields
+        # changed since the backend's last upload; static_full forces a
+        # whole-array re-upload (column backfills touch every row)
+        self.static_dirty_rows: set[int] = set()
+        self.static_full = True
 
     # -- vocab helpers ---------------------------------------------------
 
@@ -192,6 +197,7 @@ class ClusterTensors:
                     self.label_mask[row, lid] = 1.0
         self.version += 1
         self.static_version += 1
+        self.static_full = True  # column fill: every row changed
         return lid
 
     def ensure_key_id(self, key: str) -> int:
@@ -205,6 +211,7 @@ class ClusterTensors:
                     self.key_mask[row, kid] = 1.0
         self.version += 1
         self.static_version += 1
+        self.static_full = True  # column fill: every row changed
         return kid
 
     def domain_id(self, topo_key: str, value: str) -> int:
@@ -229,6 +236,7 @@ class ClusterTensors:
                 self._encode_sg_row(idx, row, ni)
         self.version += 1
         self.static_version += 1  # dom_sg rows changed
+        self.static_full = True
         return idx
 
     def register_asg(self, group: SelectorGroup) -> int | None:
@@ -245,6 +253,7 @@ class ClusterTensors:
                 self._encode_asg_row(idx, row, ni)
         self.version += 1
         self.static_version += 1  # dom_asg rows changed
+        self.static_full = True
         return idx
 
     # -- node encoding ---------------------------------------------------
@@ -291,6 +300,7 @@ class ClusterTensors:
                 self.node_gen[row] = -1
                 self._free.append(row)
                 self.static_version += 1
+                self.static_dirty_rows.add(row)
                 dirty.append(row)
         if dirty:
             self.version += 1
@@ -335,9 +345,27 @@ class ClusterTensors:
         # static_version (a bump forces a multi-MB device re-upload);
         # node_gen is recorded only after every fallible encode below
         # succeeds, so a VocabFullError mid-encode retries next dispatch
-        alloc_new = np.zeros(c.r, np.float32)
+        fresh = not self.valid[row]
+        if fresh:
+            # creation flood fast path (100k nodes register before any pod
+            # exists): encode straight into the target rows (zero-filled
+            # first — a recycled row holds stale values) instead of
+            # building temporaries and diffing them against a row that is
+            # invalid anyway
+            alloc_new = self.alloc[row]
+            alloc_new[:] = 0.0
+            taint_new = self.taint_mask[row]
+            taint_new[:] = 0.0
+            label_new = self.label_mask[row]
+            label_new[:] = 0.0
+            key_new = self.key_mask[row]
+            key_new[:] = 0.0
+        else:
+            alloc_new = np.zeros(c.r, np.float32)
+            taint_new = np.zeros(c.t_cap, np.float32)
+            label_new = np.zeros(c.l_cap, np.float32)
+            key_new = np.zeros(c.kl_cap, np.float32)
         self._encode_resource(alloc_new, ni.allocatable)
-        taint_new = np.zeros(c.t_cap, np.float32)
         taints = list((node.get("spec") or {}).get("taints") or ())
         if (node.get("spec") or {}).get("unschedulable"):
             taints.append({"key": UNSCHEDULABLE_TAINT[0],
@@ -352,8 +380,6 @@ class ClusterTensors:
         # grow the vocab O(N)); node rows just set bits for known ids, and
         # ensure_label_id/ensure_key_id backfill columns when a pod first
         # references a label.
-        label_new = np.zeros(c.l_cap, np.float32)
-        key_new = np.zeros(c.kl_cap, np.float32)
         labels = meta.labels(node)
         for k, v in labels.items():
             lid = self.label_vocab.lookup((k, v))
@@ -363,21 +389,32 @@ class ClusterTensors:
             if kid is not None:
                 key_new[kid] = 1.0
 
+        if fresh:
+            self.valid[row] = True
+            self.maxpods[row] = ni.allocatable.allowed_pod_number
+            self.static_version += 1
+            self.static_dirty_rows.add(row)
+            self.node_gen[row] = ni.node_generation
+            return
         static_changed = (
-            not self.valid[row]
-            or self.maxpods[row] != ni.allocatable.allowed_pod_number
+            self.maxpods[row] != ni.allocatable.allowed_pod_number
             or not np.array_equal(self.alloc[row], alloc_new)
             or not np.array_equal(self.taint_mask[row], taint_new)
             or not np.array_equal(self.label_mask[row], label_new)
             or not np.array_equal(self.key_mask[row], key_new))
         if static_changed:
-            self.valid[row] = True
             self.alloc[row] = alloc_new
             self.maxpods[row] = ni.allocatable.allowed_pod_number
             self.taint_mask[row] = taint_new
             self.label_mask[row] = label_new
             self.key_mask[row] = key_new
             self.static_version += 1
+            self.static_dirty_rows.add(row)
+        elif self.sgs or self.asgs:
+            # a node-object change can move the row's topology domain
+            # (dom_sg/dom_asg) without touching any compared array; mark
+            # the row so an incremental static upload carries the doms
+            self.static_dirty_rows.add(row)
         self.node_gen[row] = ni.node_generation
 
     def _encode_sg_row(self, sg_idx: int, row: int, ni: NodeInfo) -> None:

@@ -118,6 +118,8 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         shard = self._shardings[1]
         self._static_node = {k: jax.device_put(v, shard[k])
                              for k, v in raw.items()}
+        t.static_dirty_rows = set()  # full upload covers them
+        t.static_full = False
         self._static_version = t.static_version
 
     def _full_refresh(self, cd_sg: np.ndarray, cd_asg: np.ndarray) -> None:

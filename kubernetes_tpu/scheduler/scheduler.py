@@ -386,6 +386,16 @@ class Scheduler:
                 for q in batch:
                     (mine if self._profile_for(q.pod) is batch_profile
                      else perpod).append(q)
+            if not batch and self._pending is None and not self._deferred:
+                # truly idle: let the backend absorb node churn into its
+                # host tensors now, so a later dispatch doesn't pay the
+                # whole re-encode (at 100k nodes the creation flood costs
+                # ~15s) inside a scheduling cycle
+                prefetch = getattr(batch_profile.batch_backend,
+                                   "prefetch", None)
+                if prefetch is not None:
+                    prefetch(self.cache.flatten_view())
+                return 0
             if perpod or self._deferred:
                 # per-pod scheduling needs a cache with no in-flight claims
                 self._flush_pending()
