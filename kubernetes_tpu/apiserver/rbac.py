@@ -257,6 +257,12 @@ def bootstrap_policy(store: kv.MemoryStore) -> None:
                 "services", "endpoints", "endpointslices", "nodes"]},
             {"verbs": ["create", "patch", "update"], "resources": ["events"]},
         ]),
+        _role("system:node-bootstrapper", [
+            # a joining node's bootstrap-token identity may submit CSRs
+            # and watch for the issued certificate
+            {"verbs": ["create", "get", "list", "watch"],
+             "resources": ["certificatesigningrequests"]},
+        ]),
         # user-facing roles (aggregationRule reduced to static rules)
         _role("admin", [
             {"verbs": ["*"], "resources": ["*"]}]),
@@ -282,7 +288,15 @@ def bootstrap_policy(store: kv.MemoryStore) -> None:
                  "system:kube-controller-manager",
                  [_user("system:kube-controller-manager")]),
         _binding("system:node", "system:node",
-                 [_group("system:nodes")]),
+                 [_group("system:nodes"),
+                  # plain-HTTP serving has no TLS client-cert authn, so a
+                  # joined kubelet keeps speaking with its bootstrap-token
+                  # identity; the issued CSR certificate is its identity
+                  # artifact (documented divergence from the reference's
+                  # cert-rotating node authn)
+                  _group("system:bootstrappers")]),
+        _binding("system:node-bootstrapper", "system:node-bootstrapper",
+                 [_group("system:bootstrappers")]),
         _binding("system:kube-proxy", "system:kube-proxy",
                  [_user("system:kube-proxy")]),
     ]
@@ -294,5 +308,24 @@ def bootstrap_policy(store: kv.MemoryStore) -> None:
     for obj in bindings:
         try:
             store.create(CLUSTERROLEBINDINGS, obj)
+        except kv.AlreadyExistsError:
+            pass
+    # kube-public/cluster-info is readable ANONYMOUSLY — the kubeadm join
+    # trust bootstrap depends on it (bootstrappolicy: the
+    # kubeadm:bootstrap-signer-clusterinfo Role + binding in kube-public)
+    info_role = meta.new_object("Role", "kubeadm:bootstrap-signer-clusterinfo",
+                                "kube-public")
+    info_role["rules"] = [{"verbs": ["get"], "resources": ["configmaps"],
+                           "resourceNames": ["cluster-info"]}]
+    info_rb = meta.new_object("RoleBinding",
+                              "kubeadm:bootstrap-signer-clusterinfo",
+                              "kube-public")
+    info_rb["roleRef"] = {"kind": "Role",
+                          "name": "kubeadm:bootstrap-signer-clusterinfo"}
+    info_rb["subjects"] = [_user("system:anonymous"),
+                           _group("system:unauthenticated")]
+    for res, obj in ((ROLES, info_role), (ROLEBINDINGS, info_rb)):
+        try:
+            store.create(res, obj)
         except kv.AlreadyExistsError:
             pass

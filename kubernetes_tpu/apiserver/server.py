@@ -112,6 +112,7 @@ class APIServer:
                  port: int = 0, token: str | None = None,
                  tokens: dict[str, tuple[str, tuple[str, ...]]] | None = None,
                  enable_rbac: bool = False,
+                 bootstrap_token_auth: bool = False,
                  admission_chain: adm.Chain | None = None,
                  enable_default_admission: bool = False,
                  flow_dispatcher: flowcontrol.Dispatcher | None = None,
@@ -127,6 +128,10 @@ class APIServer:
                 token, ("system:admin", (rbaclib.SUPERUSER_GROUP,)))
         self.authorizer = rbaclib.RBACAuthorizer(store) if enable_rbac \
             else None
+        # bootstrap token authenticator (plugin/pkg/auth/authenticator/
+        # token/bootstrap): live lookup of kube-system bootstrap Secrets,
+        # so `kubeadm join --token` credentials work without restarting
+        self.bootstrap_token_auth = bootstrap_token_auth
         self.admission_hooks: list = []  # legacy fn(verb, resource, obj) hooks
         self.admission_chain = admission_chain or (
             adm.default_chain(store) if enable_default_admission
@@ -157,6 +162,34 @@ class APIServer:
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
+
+    def _bootstrap_identity(self, token: str
+                            ) -> tuple[str, tuple[str, ...]] | None:
+        import hmac as hmaclib
+        import time as timelib
+        tid, _, tsec = token.partition(".")
+        if not tid or not tsec:
+            return None
+        try:
+            sec = self.store.get("secrets", "kube-system",
+                                 f"bootstrap-token-{tid}")
+        except kv.NotFoundError:
+            return None
+        if sec.get("type") != "bootstrap.kubernetes.io/token":
+            return None
+        data = sec.get("data") or {}
+        if not hmaclib.compare_digest(str(data.get("token-secret", "")),
+                                      tsec):
+            return None
+        if data.get("usage-bootstrap-authentication") != "true":
+            return None
+        exp = data.get("expiration")
+        try:
+            if exp is not None and float(exp) < timelib.time():
+                return None
+        except (TypeError, ValueError):
+            return None
+        return (f"system:bootstrap:{tid}", ("system:bootstrappers",))
 
     # -- lifecycle -------------------------------------------------------
 
@@ -225,16 +258,24 @@ class APIServer:
             def _identity(self) -> tuple[str, tuple[str, ...]] | None:
                 """Resolve the request's (user, groups); None = bad creds.
 
-                No configured tokens = authn disabled: everything runs as
-                the anonymous user (which RBAC, if enabled, still judges —
-                the reference's --anonymous-auth default)."""
-                if not server.tokens:
-                    return ("system:anonymous", ("system:unauthenticated",))
+                A PRESENT-but-unknown bearer token is a 401.  A request
+                with NO credentials authenticates as the anonymous user
+                ONLY when an authorizer is configured to judge it
+                (--anonymous-auth + RBAC — this is what lets `kubeadm
+                join` fetch kube-public/cluster-info before it has any
+                credential); with token-auth but no authorizer, anonymous
+                would mean unrestricted, so it stays a 401."""
                 auth = self.headers.get("Authorization", "")
+                if not server.tokens or (not auth
+                                         and server.authorizer is not None):
+                    return ("system:anonymous", ("system:unauthenticated",))
                 if auth.startswith("Bearer "):
-                    ident = server.tokens.get(auth[len("Bearer "):])
+                    bearer = auth[len("Bearer "):]
+                    ident = server.tokens.get(bearer)
                     if ident is not None:
                         return ident
+                    if server.bootstrap_token_auth and "." in bearer:
+                        return server._bootstrap_identity(bearer)
                 return None
 
             def _user(self) -> str:

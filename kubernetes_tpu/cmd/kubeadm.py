@@ -30,12 +30,40 @@ import urllib.request
 
 logger = logging.getLogger(__name__)
 
-PHASES_INIT = ("preflight", "certs", "control-plane", "upload-config",
-               "bootstrap-token")
+PHASES_INIT = ("preflight", "certs", "control-plane", "kubeconfig",
+               "upload-config", "bootstrap-token")
 
 
 def _phase(name: str, msg: str) -> None:
     print(f"[{name}] {msg}")
+
+
+def _kubeconfig(server_url: str, ca_pem: str, user: str, token: str) -> dict:
+    """A kubeconfig document binding endpoint + CA + credential (the
+    reference's kubeconfig phase: app/phases/kubeconfig)."""
+    return {
+        "apiVersion": "v1", "kind": "Config",
+        "clusters": [{"name": "kubernetes", "cluster": {
+            "server": server_url,
+            "certificate-authority-data": base64.b64encode(
+                ca_pem.encode()).decode()}}],
+        "users": [{"name": user, "user": {"token": token}}],
+        "contexts": [{"name": f"{user}@kubernetes", "context": {
+            "cluster": "kubernetes", "user": user}}],
+        "current-context": f"{user}@kubernetes",
+    }
+
+
+def _write_kubeconfig(cert_dir, fname: str, doc: dict) -> str:
+    import os
+
+    import yaml
+    os.makedirs(cert_dir, exist_ok=True)
+    path = os.path.join(cert_dir, fname)
+    with open(path, "w") as f:
+        os.fchmod(f.fileno(), 0o600)
+        yaml.safe_dump(doc, f, sort_keys=False)
+    return path
 
 
 def init(args) -> None:
@@ -57,13 +85,32 @@ def init(args) -> None:
             raise SystemExit(
                 f"[preflight] port {args.secure_port} already in use")
 
-    _phase("certs", "generating cluster CA")
-    ClusterCA.shared()  # materialized here; published by root-ca controller
+    import os
 
-    _phase("control-plane", "starting apiserver, scheduler, "
+    _phase("certs", "generating cluster CA")
+    ca = ClusterCA.shared()  # materialized here; published by root-ca ctrl
+    os.makedirs(args.cert_dir, exist_ok=True)
+    ca_path = os.path.join(args.cert_dir, "ca.crt")
+    with open(ca_path, "w") as f:
+        f.write(ca.ca_pem())
+    _phase("certs", f"wrote {ca_path}")
+
+    _phase("control-plane", "starting apiserver (RBAC), scheduler, "
            "controller-manager")
+    # component credentials: each control-plane identity gets its own
+    # bearer token, enforced by the RBAC bootstrap roles
+    comp_tokens = {
+        "admin": (pysecrets.token_urlsafe(16),
+                  ("kubernetes-admin", ("system:masters",))),
+        "scheduler": (pysecrets.token_urlsafe(16),
+                      ("system:kube-scheduler", ())),
+        "controller-manager": (pysecrets.token_urlsafe(16),
+                               ("system:kube-controller-manager", ())),
+    }
+    tokens = {tok: ident for tok, ident in comp_tokens.values()}
     store = kv.MemoryStore(history=1_000_000)
-    server = APIServer(store, port=args.secure_port).start()
+    server = APIServer(store, port=args.secure_port, tokens=tokens,
+                       enable_rbac=True, bootstrap_token_auth=True).start()
     client = LocalClient(store)
     factory = SharedInformerFactory(client)
     fw = new_default_framework(client, factory)
@@ -76,6 +123,19 @@ def init(args) -> None:
     sched.run()
     mgr.run()
     signer.run()
+
+    _phase("kubeconfig", "writing admin/scheduler/controller-manager "
+           "kubeconfig files")
+    for comp, fname, user in (("admin", "admin.conf", "kubernetes-admin"),
+                              ("scheduler", "scheduler.conf",
+                               "system:kube-scheduler"),
+                              ("controller-manager",
+                               "controller-manager.conf",
+                               "system:kube-controller-manager")):
+        tok, _ident = comp_tokens[comp]
+        path = _write_kubeconfig(args.cert_dir, fname, _kubeconfig(
+            server.url, ca.ca_pem(), user, tok))
+        _phase("kubeconfig", f"wrote {path}")
 
     _phase("upload-config", "storing kubeadm-config ConfigMap")
     cfg = meta.new_object("ConfigMap", "kubeadm-config", "kube-system")
@@ -166,8 +226,81 @@ def join(args) -> None:
                f"({hashlib.sha256(ca_pem.encode()).hexdigest()[:12]})")
     _phase("discovery", "cluster-info signature verified; endpoint bound")
 
+    # kubelet-tls-bootstrap (app/phases/kubelet + the CSR flow): submit a
+    # client CSR with the bootstrap-token identity, wait for the approve+
+    # sign controllers, keep the issued certificate as the node's identity
+    # material
+    import os
+    client = HTTPClient.from_url(args.server, token=args.token)
+    _phase("kubelet-tls-bootstrap",
+           f"submitting CSR for node {args.node_name}")
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+        key = ec.generate_private_key(ec.SECP256R1())
+        csr_pem = (x509.CertificateSigningRequestBuilder()
+                   .subject_name(x509.Name([
+                       x509.NameAttribute(
+                           NameOID.COMMON_NAME,
+                           f"system:node:{args.node_name}"),
+                       x509.NameAttribute(NameOID.ORGANIZATION_NAME,
+                                          "system:nodes")]))
+                   .sign(key, hashes.SHA256())
+                   .public_bytes(serialization.Encoding.PEM))
+        csr = {"apiVersion": "certificates.k8s.io/v1",
+               "kind": "CertificateSigningRequest",
+               "metadata": {"name": f"node-csr-{args.node_name}"},
+               "spec": {
+                   "signerName":
+                       "kubernetes.io/kube-apiserver-client-kubelet",
+                   "usages": ["key encipherment", "digital signature",
+                              "client auth"],
+                   "request": base64.b64encode(csr_pem).decode()}}
+        client.create("certificatesigningrequests", csr)
+        cert_pem = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            cur = client.get("certificatesigningrequests", "",
+                             f"node-csr-{args.node_name}")
+            cert_b64 = (cur.get("status") or {}).get("certificate")
+            if cert_b64:
+                cert_pem = base64.b64decode(cert_b64)
+                break
+            time.sleep(0.2)
+        if cert_pem is None:
+            raise SystemExit("[kubelet-tls-bootstrap] CSR was not signed "
+                             "(is the certificates controller running?)")
+        os.makedirs(args.cert_dir, exist_ok=True)
+        cert_path = os.path.join(args.cert_dir,
+                                 f"kubelet-{args.node_name}.crt")
+        with open(cert_path, "wb") as f:
+            f.write(cert_pem)
+        key_path = os.path.join(args.cert_dir,
+                                f"kubelet-{args.node_name}.key")
+        with open(key_path, "wb") as f:
+            os.fchmod(f.fileno(), 0o600)
+            f.write(key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption()))
+        if ca_b64:
+            kubeconfig_path = _write_kubeconfig(
+                args.cert_dir, f"kubelet-{args.node_name}.conf",
+                _kubeconfig(args.server,
+                            base64.b64decode(ca_b64).decode(),
+                            f"system:node:{args.node_name}", args.token))
+            _phase("kubelet-tls-bootstrap",
+                   f"wrote {cert_path}, {key_path}, {kubeconfig_path}")
+        else:
+            _phase("kubelet-tls-bootstrap",
+                   f"wrote {cert_path}, {key_path}")
+    except ImportError:
+        _phase("kubelet-tls-bootstrap",
+               "cryptography unavailable; skipping CSR flow")
+
     _phase("kubelet-start", f"registering node {args.node_name}")
-    client = HTTPClient.from_url(args.server)
     factory = SharedInformerFactory(client)
     kubelet = HollowKubelet(client, factory, args.node_name)
     factory.start()
@@ -187,11 +320,15 @@ def main(argv=None) -> None:
     sub = ap.add_subparsers(dest="cmd", required=True)
     ini = sub.add_parser("init", help="bootstrap a control plane")
     ini.add_argument("--secure-port", type=int, default=8080)
+    ini.add_argument("--cert-dir", default="./kubeadm-pki",
+                     help="where ca.crt and the kubeconfig files land")
     ini.set_defaults(fn=init)
     jn = sub.add_parser("join", help="join a node using a bootstrap token")
     jn.add_argument("--server", required=True)
     jn.add_argument("--token", required=True, help="<id>.<secret>")
     jn.add_argument("--node-name", default=f"node-{pysecrets.token_hex(3)}")
+    jn.add_argument("--cert-dir", default="./kubeadm-pki",
+                    help="where the issued kubelet cert/key land")
     jn.set_defaults(fn=join)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)

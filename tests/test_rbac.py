@@ -48,12 +48,29 @@ class TestAuthn:
             bad.list("pods", "default")
         assert ei.value.code == 401
 
-    def test_missing_token_is_401_when_tokens_configured(self, cluster):
+    def test_missing_token_is_anonymous_and_rbac_denied(self, cluster):
+        """No credential authenticates as system:anonymous
+        (--anonymous-auth default); RBAC then denies with 403 — the
+        401/403 split the reference makes."""
         _, server = cluster
         anon = HTTPClient.from_url(server.url)
         with pytest.raises(HTTPError) as ei:
             anon.list("pods", "default")
-        assert ei.value.code == 401
+        assert ei.value.code == 403
+
+    def test_anonymous_can_read_cluster_info(self, cluster):
+        store, server = cluster
+        info = meta.new_object("ConfigMap", "cluster-info", "kube-public")
+        info["data"] = {"kubeconfig": "{}"}
+        store.create("configmaps", info)
+        anon = HTTPClient.from_url(server.url)
+        # the kubeadm join trust bootstrap: anonymous GET of exactly this
+        # one object works, nothing else does
+        got = anon.get("configmaps", "kube-public", "cluster-info")
+        assert got["data"]["kubeconfig"] == "{}"
+        with pytest.raises(HTTPError) as ei:
+            anon.get("configmaps", "kube-system", "kubeadm-config")
+        assert ei.value.code == 403
 
 
 class TestRBACEnforcement:
