@@ -260,8 +260,7 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                 claims, _best = pk.claims(pk_static, active, used, used_nz,
                                           npods)
                 has = claims >= 0
-                boot_flags = []
-                return _resolve_and_commit(state, claims, has, boot_flags,
+                return _resolve_and_commit(state, claims, has, [], [],
                                            avail)
 
             # per-resource 2-D compares instead of one [P,N,R] broadcast
@@ -286,6 +285,7 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
 
             # constraints
             boot_flags = []     # [P] per c: relies on bootstrap this wave
+            minmatches = []     # [P,1] per c: min domain count (spread)
             for c in range(caps.c_cap if f_cons else 0):
                 kind = pod["c_kind"][:, c]                    # [P]
                 sg = jnp.clip(pod["c_sg"][:, c], 0)
@@ -328,15 +328,18 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                                    w["affinity"] * pod["c_weight"][:, c:c + 1]
                                    * gathered, 0.0)
                 boot_flags.append((kind == C_AFFINITY) & boot)
+                minmatches.append(minmatch)
 
             feasible = mask & active[:, None]
             has = comm.any_rows(feasible)                     # [P]
             claims, _ = comm.row_argmax(
                 jnp.where(feasible, score + noise, NEG), n_loc)
             claims = jnp.where(has, claims, -1)               # global idx
-            return _resolve_and_commit(state, claims, has, boot_flags, avail)
+            return _resolve_and_commit(state, claims, has, boot_flags,
+                                       minmatches, avail)
 
-        def _resolve_and_commit(state, claims, has, boot_flags, avail):
+        def _resolve_and_commit(state, claims, has, boot_flags, minmatches,
+                                avail):
             """Wave tail shared by the Pallas and XLA paths: conflict
             resolution in pod/queue order + aggregate commit."""
             (used, used_nz, npods, ports, cd_sg, cd_asg,
@@ -379,8 +382,23 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                 own = Dpq[p_iota, p_iota][:, None]            # [P,1] p's own domain
                 same_dom = (Dpq == own) & (own >= 0)
                 q_incs = pod["inc_sg"].T[sg]                  # [P,P]: inc of q for p's sg
-                serial = jnp.isin(kind, jnp.array(HARD_KINDS_SERIAL))
-                conf |= serial & (jnp.sum(both * same_dom * q_incs, axis=1) > 0)
+                k_same = jnp.sum(both * same_dom * q_incs, axis=1)  # [P]
+                # required anti-affinity: both entrants see gathered==0, so
+                # any earlier same-domain incrementer must serialize
+                conf |= (kind == C_ANTI_AFFINITY) & (k_same > 0)
+                # HARD spread admits a whole cohort per wave as long as the
+                # headroom holds: min domain count can only RISE as other
+                # claims commit, so count + self + k_earlier - min <=
+                # maxSkew keeps every wave-mate's accept valid (the old
+                # one-per-domain-per-wave rule made 3-zone spreading
+                # O(batch/zones) waves — pathological at bench shapes)
+                own = Dpq[p_iota, p_iota]                     # [P] own domain
+                cnt_own = cd_sg[jnp.clip(sg, 0), jnp.clip(own, 0)
+                                .astype(jnp.int32)]           # [P]
+                minm = minmatches[c][:, 0]
+                over = (cnt_own + pod["c_selfmatch"][:, c] + k_same
+                        - minm) > pod["c_maxskew"][:, c]
+                conf |= (kind == C_SPREAD_HARD) & (own >= 0) & over
                 # affinity bootstrap: serialize against any incrementing q
                 conf |= boot_flags[c] & (jnp.sum(both * q_incs, axis=1) > 0)
             for a in range(caps.asg_cap if f_asg else 0):
@@ -758,9 +776,16 @@ def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
     per feature set and picks per batch based on what the batch actually
     uses)."""
     spec = PackSpec(caps, p_cap, k_cap)
+    # wave ceiling: constraint batches can legitimately need many waves
+    # (hard spread admits ~domains*maxSkew pods per wave), and the loop
+    # exits the moment nothing is active or progress stops — so for the
+    # constraint-carrying variant the cap is p_cap (the absolute worst
+    # case of one forced serialization per wave), while the plain variant
+    # converges in O(contention) and keeps a tight bound
+    max_waves = 128 if features == PLAIN_FEATURES else max(128, p_cap)
     core = _make_wave_core(caps, {"fit": 1.0, "balanced": 1.0, "spread": 2.0,
                                   "affinity": 1.0, "taint": 1.0,
-                                  **(weights or {})}, _Comm(None), 128,
+                                  **(weights or {})}, _Comm(None), max_waves,
                            features)
 
     @functools.partial(jax.jit, donate_argnums=0)

@@ -70,13 +70,15 @@ def _apply_static_patch(static, rows, alloc_v, maxpods_v, valid_v,
         @jax.jit
         def go(static, rows, alloc_v, maxpods_v, valid_v, taint_v,
                label_v, key_v, dom_sg_v, dom_asg_v):
-            mask = rows >= 0
-            li = jnp.where(mask, rows, 0)
+            n = static["alloc"].shape[0]
+            # padding scatters to an OUT-OF-BOUNDS sentinel and is dropped.
+            # Do NOT route padding to a masked write of row 0: if row 0 is
+            # also genuinely patched, duplicate-index set() picks an
+            # arbitrary winner and can resurrect the stale value.
+            li = jnp.where(rows >= 0, rows, n)
 
             def put(a, v):
-                cur = a[li]
-                m = mask.reshape((-1,) + (1,) * (v.ndim - 1))
-                return a.at[li].set(jnp.where(m, v, cur))
+                return a.at[li].set(v, mode="drop")
 
             out = dict(static)
             out["alloc"] = put(static["alloc"], alloc_v)
@@ -85,12 +87,10 @@ def _apply_static_patch(static, rows, alloc_v, maxpods_v, valid_v,
             out["taint_mask"] = put(static["taint_mask"], taint_v)
             out["label_mask"] = put(static["label_mask"], label_v)
             out["key_mask"] = put(static["key_mask"], key_v)
-            cur_sg = static["dom_sg"][:, li]
             out["dom_sg"] = static["dom_sg"].at[:, li].set(
-                jnp.where(mask[None, :], dom_sg_v, cur_sg))
-            cur_asg = static["dom_asg"][:, li]
+                dom_sg_v, mode="drop")
             out["dom_asg"] = static["dom_asg"].at[:, li].set(
-                jnp.where(mask[None, :], dom_asg_v, cur_asg))
+                dom_asg_v, mode="drop")
             return out
 
         _static_patch_jit = go
@@ -249,17 +249,19 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         self.tensors = ClusterTensors(self.caps)
         self.encoder = BatchEncoder(self.tensors, batch_size)
         # The constraint-carrying ("full") kernel variant materializes
-        # ~58 bytes per (pod, node) cell in [P,N] planes; at 100k nodes a
-        # 16k batch wants ~100G HBM.  It therefore compiles at its own
-        # capped P and oversized batches run through it in chunks
-        # (resident state chains across chunks), while the PLAIN variant
-        # — the Pallas fused tile, no [P,N] planes — keeps the full
-        # batch.  At bench 5k-node shapes the cap resolves to batch_size
-        # and nothing changes.
+        # ~58 bytes per (pod, node) cell in [P,N] planes (at 100k nodes a
+        # 16k batch wants ~100G HBM) AND its wave tail runs [P,P]
+        # conflict matrices for up to ~P waves when hard constraints
+        # serialize admission (3-zone spreading admits ~zones*maxSkew
+        # pods per wave).  Both costs cap the full variant at its own P —
+        # hard ceiling 1024, lower if HBM demands — and oversized
+        # constraint batches chunk through it with resident state
+        # chaining, while the PLAIN variant (Pallas fused tile, no [P,N]
+        # planes, O(contention) waves) keeps the whole batch.
         if full_batch_cap is None:
             budget = float(os.environ.get("KTPU_FULL_HBM_BUDGET", 11e9))
             fit = int(budget / (64 * self.caps.n_cap))
-            full_batch_cap = batch_size
+            full_batch_cap = 1024
             while full_batch_cap > 256 and full_batch_cap > fit:
                 full_batch_cap //= 2
         self.full_cap = min(full_batch_cap, batch_size)
@@ -341,8 +343,12 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         import jax.numpy as jnp
         t = self.tensors
         rows = t.static_dirty_rows
+        # patch only when clearly cheaper than re-shipping the arrays: a
+        # registration flood (rows ~ n_cap) wants the single full upload,
+        # steady-state drift (a handful of rows) wants the tiny scatter
         if (self._static_node is None or t.static_full
-                or len(rows) > self.S_PATCH_MAX):
+                or len(rows) > self.S_PATCH_MAX
+                or len(rows) * 8 > self.caps.n_cap):
             self._static_node = {
                 "alloc": jnp.asarray(t.alloc),
                 "maxpods": jnp.asarray(t.maxpods),
@@ -354,9 +360,9 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 "dom_asg": jnp.asarray(t.dom_asg),
             }
         elif rows:
-            k = 1
+            k = 256  # pad floor bounds the number of distinct jit shapes
             while k < len(rows):
-                k *= 2  # pad to powers of two: few distinct jit shapes
+                k *= 2
             rows_a = np.full(k, -1, np.int32)
             rows_a[:len(rows)] = sorted(rows)
             safe = np.where(rows_a >= 0, rows_a, 0)

@@ -99,12 +99,13 @@ def build_sharded_step_fn(caps: Caps, mesh: Mesh,
     def stepped(state, static, pods, prows, pvals):
         local = prows - jax.lax.axis_index(axis) * shard_n
         in_shard = (prows >= 0) & (local >= 0) & (local < shard_n)
-        li = jnp.where(in_shard, local, 0)
+        # out-of-shard/padding entries scatter to an out-of-bounds
+        # sentinel and are DROPPED — a masked write of row 0 would race
+        # a genuine patch of row 0 through duplicate-index set()
+        li = jnp.where(in_shard, local, shard_n)
 
         def put(arr, vals):
-            cur = arr[li]
-            mask = in_shard.reshape((-1,) + (1,) * (vals.ndim - 1))
-            return arr.at[li].set(jnp.where(mask, vals, cur))
+            return arr.at[li].set(vals, mode="drop")
 
         node = dict(static)
         node["used"] = put(state["used"], pvals[:, :R])
