@@ -1,18 +1,23 @@
 #!/usr/bin/env python
 """Benchmark entry point (driver contract: ONE JSON line on stdout).
 
-Runs the scheduler_perf SchedulingBasic workload (reference:
-test/integration/scheduler_perf, 5000 nodes scale from
-config/performance-config.yaml, pod count raised to 20k for stable
-sampling) through the FULL pipeline — store -> watch -> informers ->
-queue -> TPU batch Filter/Score/Assign -> assume -> bind — and reports
-end-to-end scheduling throughput.
+Runs the scheduler_perf workloads (reference: test/integration/
+scheduler_perf, config/performance-config.yaml shapes) through the FULL
+pipeline — store -> watch -> informers -> queue -> TPU batch
+Filter/Score/Assign -> assume -> bind — and reports end-to-end
+scheduling throughput.
 
-Methodology: BENCH_RUNS (default 3) independent passes, each in a FRESH
-subprocess (its own interpreter, jax client, and device state — runs in
-one process interfere through allocator/device-buffer state), reporting
-the median.  BENCH_RUNS=1 or _BENCH_CHILD=1 runs a single in-process
-pass.
+Headline metric: SchedulingBasic at BENCH_NODES (default 5000) nodes,
+median of BENCH_RUNS fresh-subprocess passes (one interpreter + jax
+client + device state per pass — runs in one process interfere through
+allocator/device-buffer state).
+
+Tracked configs (BASELINE.md): unless BENCH_SUITE=basic, one pass each
+of the hard workloads also runs and lands in detail.configs —
+  Scheduling100k          100k nodes / 200k pods (BASELINE config #5 tier)
+  SchedulingPodAntiAffinity  5k nodes / 5k anti-affinity pods
+  TopologySpreading       1k nodes / 3 zones / 5k DoNotSchedule pods
+  CoschedulingGang        5k nodes / 10k pods in 1k PodGroups
 
 Baseline: the reference tree publishes no absolute numbers (BASELINE.md);
 upstream Kubernetes scheduler_perf results for the 5k-node SchedulingBasic
@@ -34,38 +39,52 @@ BASELINE_PODS_PER_SEC = 300.0
 
 N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
 # 50k pods: at ~10k+ pods/s a 20k-pod run is half pipeline ramp; 50k gives
-# ~5s of steady state under the 1s sampling window (same tracked config,
-# same stable-sampling rationale as the r01 10k->20k bump)
+# ~5s of steady state under the 1s sampling window
 N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
 # 16384 is the largest batch whose [P,N] working set fits v5e HBM at 5k
-# nodes (24576 exceeds 15.75G); with the GC fix the bigger batch wins on
-# both throughput AND backlog-drain latency
+# nodes for the PLAIN kernel; the constraint-carrying variant self-caps
+# (ops/backend.py full_batch_cap) and chunks
 BATCH = int(os.environ.get("BENCH_BATCH", "16384"))
 
+EXTRA_CONFIGS = {
+    "Scheduling100k": {"workload": "SchedulingBasicLarge",
+                       "nodes": 100_000, "pods": 200_000, "batch": 16384,
+                       "timeout": 1200.0},
+    "SchedulingPodAntiAffinity": {"workload": "SchedulingPodAntiAffinity",
+                                  "batch": 4096, "timeout": 900.0},
+    "TopologySpreading": {"workload": "TopologySpreading", "batch": 4096,
+                          "timeout": 900.0},
+    "CoschedulingGang": {"workload": "CoschedulingGang", "batch": 4096,
+                         "timeout": 900.0},
+}
 
-def run_once() -> dict:
+
+def run_once(workload: str, nodes: int | None, pods: int | None,
+             batch: int, barrier_timeout: float = 900.0) -> dict:
     """One full workload pass in this process; returns the result dict."""
     import copy
 
     from kubernetes_tpu.ops.flatten import Caps
     from kubernetes_tpu.perf import load_workloads, run_named_workload
 
-    cfg = copy.deepcopy(load_workloads()["SchedulingBasicLarge"])
+    cfg = copy.deepcopy(load_workloads()[workload])
     for op in cfg["workloadTemplate"]:
-        if op["opcode"] == "createNodes":
-            op["count"] = N_NODES
-        elif op["opcode"] == "createPods":
-            op["count"] = N_PODS
+        if op["opcode"] == "createNodes" and nodes is not None:
+            op["count"] = nodes
+        elif op["opcode"] == "createPods" and pods is not None:
+            op["count"] = pods
         elif op["opcode"] == "barrier":
-            op["timeout"] = 900.0
+            op["timeout"] = barrier_timeout
+    n_nodes = next(op["count"] for op in cfg["workloadTemplate"]
+                   if op["opcode"] == "createNodes")
 
-    n_cap = max(1024, -(-int(N_NODES * 1.1) // 256) * 256)  # ~10% headroom
+    n_cap = max(1024, -(-int(n_nodes * 1.1) // 256) * 256)  # ~10% headroom
     caps = Caps(n_cap=n_cap,
                 l_cap=256, kl_cap=62, t_cap=16, pt_cap=16, s_cap=3,
                 sg_cap=16, asg_cap=16)
     t0 = time.monotonic()
     summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
-                                        batch_size=BATCH)
+                                        batch_size=batch)
     wall = time.monotonic() - t0
     if not stats.get("barrier_ok", False):
         return {"error": "pods left unscheduled", "value": 0.0,
@@ -90,10 +109,51 @@ def emit(value: float, extra: dict) -> None:
     }))
 
 
+def _spawn_child(env_extra: dict, timeout: float) -> dict | None:
+    env = dict(os.environ, _BENCH_CHILD="1", **env_extra)
+    for attempt in (1, 2):  # one retry: tunnel hiccups are transient
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, env=env, timeout=timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            continue
+        if proc.returncode == 0:
+            try:
+                return json.loads(proc.stdout.strip().splitlines()[-1])
+            except (json.JSONDecodeError, IndexError):
+                continue
+        sys.stderr.write(proc.stderr[-2000:])
+        if attempt == 2 and proc.stdout.strip():
+            try:  # relay the child's own error JSON
+                return json.loads(proc.stdout.strip().splitlines()[-1])
+            except json.JSONDecodeError:
+                pass
+    return None
+
+
+def child_main() -> None:
+    name = os.environ.get("_BENCH_WORKLOAD", "SchedulingBasicLarge")
+    nodes = os.environ.get("_BENCH_W_NODES")
+    pods = os.environ.get("_BENCH_W_PODS")
+    batch = int(os.environ.get("_BENCH_W_BATCH", str(BATCH)))
+    res = run_once(name, int(nodes) if nodes else None,
+                   int(pods) if pods else None, batch,
+                   float(os.environ.get("_BENCH_W_TIMEOUT", "900")))
+    if "error" in res:
+        emit(0.0, {"error": res["error"], **res["detail"]})
+        sys.exit(1)
+    emit(res["value"], {"wall_s": res["wall_s"], **res["detail"]})
+
+
 def main() -> None:
+    if os.environ.get("_BENCH_CHILD") == "1":
+        child_main()
+        return
     n_runs = max(1, int(os.environ.get("BENCH_RUNS", "3")))
-    if os.environ.get("_BENCH_CHILD") == "1" or n_runs == 1:
-        res = run_once()
+    if n_runs == 1:
+        res = run_once("SchedulingBasicLarge", N_NODES, N_PODS, BATCH)
         if "error" in res:
             emit(0.0, {"error": res["error"], **res["detail"]})
             sys.exit(1)
@@ -102,36 +162,47 @@ def main() -> None:
 
     t0 = time.monotonic()
     results: list[dict] = []
-    env = dict(os.environ, _BENCH_CHILD="1")
+    head_env = {"_BENCH_WORKLOAD": "SchedulingBasicLarge",
+                "_BENCH_W_NODES": str(N_NODES),
+                "_BENCH_W_PODS": str(N_PODS),
+                "_BENCH_W_BATCH": str(BATCH)}
     for _ in range(n_runs):
-        for attempt in (1, 2):  # one retry: tunnel hiccups are transient
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                capture_output=True, text=True, env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-            if proc.returncode == 0:
-                results.append(
-                    json.loads(proc.stdout.strip().splitlines()[-1]))
-                break
-            sys.stderr.write(proc.stderr[-2000:])
-        else:
-            # relay the child's own JSON (e.g. "pods left unscheduled")
-            # so the driver's one line carries the real failure
-            lines = proc.stdout.strip().splitlines()
-            if lines:
-                try:
-                    child = json.loads(lines[-1])
-                    emit(0.0, child.get("detail", {"error": "child failed"}))
-                    sys.exit(1)
-                except json.JSONDecodeError:
-                    pass
+        got = _spawn_child(head_env, timeout=900.0)
+        if got is None:
             emit(0.0, {"error": "bench child failed twice"})
             sys.exit(1)
+        if got.get("value", 0.0) == 0.0:
+            emit(0.0, got.get("detail", {"error": "child failed"}))
+            sys.exit(1)
+        results.append(got)
+
+    configs: dict[str, dict] = {}
+    if os.environ.get("BENCH_SUITE", "full") != "basic":
+        for cname, c in EXTRA_CONFIGS.items():
+            env = {"_BENCH_WORKLOAD": c["workload"],
+                   "_BENCH_W_BATCH": str(c["batch"]),
+                   "_BENCH_W_TIMEOUT": str(c.get("timeout", 900.0))}
+            if "nodes" in c:
+                env["_BENCH_W_NODES"] = str(c["nodes"])
+            if "pods" in c:
+                env["_BENCH_W_PODS"] = str(c["pods"])
+            got = _spawn_child(env, timeout=c.get("timeout", 900.0) + 300)
+            if got is None:
+                configs[cname] = {"error": "failed"}
+                continue
+            d = got.get("detail", {})
+            configs[cname] = {
+                "pods_per_s": got.get("value", 0.0),
+                "p99_ms": d.get("pod_e2e_p99_ms"),
+                "total_pods": d.get("TotalPods"),
+            }
+
     wall = time.monotonic() - t0
     results.sort(key=lambda r: r["value"])
     med = results[len(results) // 2]
     emit(med["value"], {"wall_s": round(wall, 1), "runs": n_runs,
                         "averages": [r["value"] for r in results],
+                        "configs": configs,
                         **{k: v for k, v in med["detail"].items()
                            if k not in ("nodes", "pods", "batch",
                                         "wall_s")}})

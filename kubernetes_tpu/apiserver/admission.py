@@ -346,7 +346,8 @@ class WebhookAdmission(AdmissionPlugin):
             wh.url, data=json.dumps(review).encode(),
             headers={"Content-Type": "application/json"}, method="POST")
         try:
-            with urllib.request.urlopen(req, timeout=wh.timeout) as resp:
+            from .egress import CLUSTER, default_selector
+            with default_selector.open(CLUSTER, req, wh.timeout) as resp:
                 return json.loads(resp.read())
         except Exception as e:  # noqa: BLE001 — network errors hit policy
             if wh.failure_policy == "Ignore":

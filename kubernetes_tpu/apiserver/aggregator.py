@@ -162,7 +162,10 @@ class AggregatorRegistry:
         req = urllib.request.Request(url, data=body, method=method,
                                      headers=fwd)
         try:
-            resp = urllib.request.urlopen(req, timeout=30)
+            # Cluster-network egress (egress.py): aggregated backends may
+            # live behind a konnectivity-style tunnel
+            from .egress import CLUSTER, default_selector
+            resp = default_selector.open(CLUSTER, req, 30)
             self._observe_availability(svc_name, True)
             return resp.status, dict(resp.headers), resp
         except urllib.error.HTTPError as e:

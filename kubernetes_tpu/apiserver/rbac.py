@@ -257,6 +257,11 @@ def bootstrap_policy(store: kv.MemoryStore) -> None:
                 "services", "endpoints", "endpointslices", "nodes"]},
             {"verbs": ["create", "patch", "update"], "resources": ["events"]},
         ]),
+        _role("system:basic-user", [
+            # any authenticated user may ask "can I?" (SelfSubjectAccessReview)
+            {"verbs": ["create"],
+             "resources": ["selfsubjectaccessreviews"]},
+        ]),
         _role("system:node-bootstrapper", [
             # a joining node's bootstrap-token identity may submit CSRs
             # and watch for the issued certificate
@@ -297,6 +302,8 @@ def bootstrap_policy(store: kv.MemoryStore) -> None:
                   _group("system:bootstrappers")]),
         _binding("system:node-bootstrapper", "system:node-bootstrapper",
                  [_group("system:bootstrappers")]),
+        _binding("system:basic-user", "system:basic-user",
+                 [_group("system:authenticated")]),
         _binding("system:kube-proxy", "system:kube-proxy",
                  [_user("system:kube-proxy")]),
     ]

@@ -272,10 +272,17 @@ class APIServer:
                 if auth.startswith("Bearer "):
                     bearer = auth[len("Bearer "):]
                     ident = server.tokens.get(bearer)
+                    if ident is None and server.bootstrap_token_auth \
+                            and "." in bearer:
+                        ident = server._bootstrap_identity(bearer)
                     if ident is not None:
-                        return ident
-                    if server.bootstrap_token_auth and "." in bearer:
-                        return server._bootstrap_identity(bearer)
+                        # every real credential is in system:authenticated
+                        # (the group system:basic-user rights bind to)
+                        user, groups = ident
+                        if "system:authenticated" not in groups:
+                            groups = tuple(groups) + (
+                                "system:authenticated",)
+                        return (user, groups)
                 return None
 
             def _user(self) -> str:
@@ -646,6 +653,30 @@ class APIServer:
                     return
                 if r.subresource == "eviction":
                     self._post_eviction(r, obj)
+                    return
+                if r.resource == "selfsubjectaccessreviews":
+                    # authorization.k8s.io SelfSubjectAccessReview: answer
+                    # "can I?" for the REQUESTING identity; never persisted
+                    # (pkg/registry/authorization/selfsubjectaccessreview)
+                    attrs_spec = ((obj.get("spec") or {})
+                                  .get("resourceAttributes") or {})
+                    user, groups = self._identity()
+                    if server.authorizer is None:
+                        allowed, reason = True, "no authorizer configured"
+                    else:
+                        allowed = server.authorizer.authorize(
+                            rbaclib.Attributes(
+                                user, tuple(groups),
+                                attrs_spec.get("verb", "get"),
+                                attrs_spec.get("resource", ""),
+                                attrs_spec.get("subresource", ""),
+                                attrs_spec.get("namespace", ""),
+                                attrs_spec.get("name", "")))
+                        reason = ""
+                    obj.setdefault("status", {})
+                    obj["status"] = {"allowed": bool(allowed),
+                                     "reason": reason}
+                    self._send_json(201, obj)
                     return
                 if r.resource in CLUSTER_SCOPED:
                     if r.ns:

@@ -458,6 +458,110 @@ class Kubectl:
         self.out.write(f"{resource}/{name} {verb}\n")
         return 0
 
+    def auth_can_i(self, verb: str, resource: str, namespace: str) -> int:
+        """kubectl auth can-i — a SelfSubjectAccessReview for the caller's
+        own identity (kubectl pkg/cmd/auth/cani.go)."""
+        if hasattr(self.client, "store"):
+            # in-process client: no authn/authz seam to consult
+            self.out.write("yes (in-process client, no authorizer)\n")
+            return 0
+        review = {"apiVersion": "authorization.k8s.io/v1",
+                  "kind": "SelfSubjectAccessReview",
+                  "spec": {"resourceAttributes": {
+                      "verb": verb,
+                      "resource": resolve_resource(resource),
+                      "namespace": namespace or ""}}}
+        try:
+            out = self.client.create("selfsubjectaccessreviews", review)
+        except kv.StoreError as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+        allowed = (out.get("status") or {}).get("allowed", False)
+        self.out.write("yes\n" if allowed else "no\n")
+        return 0 if allowed else 1
+
+    def diff(self, path: str, namespace: str) -> int:
+        """kubectl diff — live object vs what a server-side apply of the
+        manifest would produce (computed with the SAME merge the server
+        runs, apiserver/managedfields.py), as a unified diff."""
+        import difflib
+
+        from ..apiserver import managedfields as mf
+        rc = 0
+        for obj in self._load_manifests(path):
+            res = KIND_TO_RESOURCE.get(obj.get("kind", ""), "")
+            if not res:
+                self.out.write(f"error: unknown kind {obj.get('kind')}\n")
+                return 2
+            obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+            ns, nm = meta.namespace(obj), meta.name(obj)
+            try:
+                live = self.client.get(res, ns, nm)
+            except kv.NotFoundError:
+                live = None
+            try:
+                merged = mf.apply_merge(live, obj, "kubectl", force=True)
+            except Exception as e:  # noqa: BLE001
+                self.out.write(f"error: {e}\n")
+                return 2
+
+            def clean(o):
+                if o is None:
+                    return []
+                o = meta.deep_copy(o)
+                md = o.get("metadata") or {}
+                for k in ("managedFields", "resourceVersion", "uid",
+                          "creationTimestamp"):
+                    md.pop(k, None)
+                return yaml.safe_dump(o, sort_keys=True).splitlines(
+                    keepends=True)
+
+            delta = list(difflib.unified_diff(
+                clean(live), clean(merged),
+                fromfile=f"live/{res}/{nm}", tofile=f"merged/{res}/{nm}"))
+            if delta:
+                rc = 1  # differences found (kubectl diff exit contract)
+                self.out.writelines(delta)
+        return rc
+
+    def taint(self, node: str, spec: str) -> int:
+        """kubectl taint nodes <node> key[=value]:Effect | key-"""
+        if spec.endswith("-"):
+            key = spec[:-1]
+
+            def strip(o):
+                taints = (o.get("spec") or {}).get("taints") or []
+                o.setdefault("spec", {})["taints"] = [
+                    t for t in taints if t.get("key") != key]
+                return o
+            try:
+                self.client.guaranteed_update("nodes", "", node, strip)
+            except kv.NotFoundError:
+                self.out.write(f"error: node {node!r} not found\n")
+                return 1
+            self.out.write(f"node/{node} untainted\n")
+            return 0
+        kv_part, _, effect = spec.rpartition(":")
+        if not effect or not kv_part:
+            self.out.write("error: taint must be key[=value]:Effect "
+                           "or key-\n")
+            return 1
+        key, _, value = kv_part.partition("=")
+        taint = {"key": key, "value": value, "effect": effect}
+
+        def add(o):
+            taints = o.setdefault("spec", {}).setdefault("taints", [])
+            taints[:] = [t for t in taints if t.get("key") != key]
+            taints.append(taint)
+            return o
+        try:
+            self.client.guaranteed_update("nodes", "", node, add)
+        except kv.NotFoundError:
+            self.out.write(f"error: node {node!r} not found\n")
+            return 1
+        self.out.write(f"node/{node} tainted\n")
+        return 0
+
     def label(self, resource, name, namespace, pairs) -> int:
         return self._kv_patch(resource, name, namespace, pairs, "labels")
 
@@ -574,6 +678,16 @@ def build_parser() -> argparse.ArgumentParser:
     wt.add_argument("--for", dest="condition", required=True,
                     help="condition=<Type> or delete")
     wt.add_argument("--timeout", type=float, default=30.0)
+    au = sub.add_parser("auth")
+    au.add_argument("subcmd", choices=["can-i"])
+    au.add_argument("verb")
+    au.add_argument("resource")
+    df = sub.add_parser("diff")
+    df.add_argument("-f", "--filename", required=True)
+    tn = sub.add_parser("taint")
+    tn.add_argument("resource", choices=["nodes", "node"])
+    tn.add_argument("node")
+    tn.add_argument("spec", help="key[=value]:Effect to add, key- to remove")
     sub.add_parser("version")
     return ap
 
@@ -621,6 +735,12 @@ def run(argv: list[str] | None = None, client: Client | None = None,
     if args.cmd == "wait":
         return k.wait(args.resource, args.name, args.namespace,
                       args.condition, args.timeout)
+    if args.cmd == "auth":
+        return k.auth_can_i(args.verb, args.resource, args.namespace)
+    if args.cmd == "diff":
+        return k.diff(args.filename, args.namespace)
+    if args.cmd == "taint":
+        return k.taint(args.node, args.spec)
     if args.cmd == "version":
         out.write(f"kubectl-tpu v{__version__}\n")
         return 0

@@ -64,7 +64,7 @@ CLUSTERROLEBINDINGS = "clusterrolebindings"
 CLUSTER_SCOPED_RESOURCES = frozenset({
     NODES, PVS, NAMESPACES, PRIORITYCLASSES, STORAGECLASSES, CSINODES,
     CSRS, VOLUMEATTACHMENTS, CLUSTERROLES, CLUSTERROLEBINDINGS,
-    "apiservices", "customresourcedefinitions",
+    "apiservices", "customresourcedefinitions", "storageversions",
 })
 
 

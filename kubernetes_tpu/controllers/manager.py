@@ -18,6 +18,7 @@ from .daemonset import DaemonSetController
 from .deployment import DeploymentController
 from .disruption import DisruptionController
 from .garbagecollector import GarbageCollector
+from .storageversion import StorageVersionGC
 from .hpa import HorizontalPodAutoscaler
 from .job import JobController
 from .namespace import NamespaceController
@@ -57,7 +58,8 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "statefulset", "daemonset",
                        "replicationcontroller", "csrapproving", "csrsigning",
                        "csrcleaner", "ttl", "root-ca-cert-publisher",
                        "persistentvolume-binder", "pvc-protection",
-                       "pv-protection", "attachdetach", "ephemeral-volume")
+                       "pv-protection", "attachdetach", "ephemeral-volume",
+                       "storage-version-gc")
 
 
 class ControllerManager:
@@ -87,6 +89,7 @@ class ControllerManager:
             "csrcleaner": CSRCleanerController,
             "ttl": TTLController,
             "root-ca-cert-publisher": RootCACertPublisher,
+            "storage-version-gc": StorageVersionGC,
             "persistentvolume-binder": PersistentVolumeController,
             "pvc-protection": PVCProtectionController,
             "pv-protection": PVProtectionController,

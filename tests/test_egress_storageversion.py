@@ -1,0 +1,225 @@
+"""Egress selector (konnectivity seam) + storage-version GC.
+
+Reference:
+  staging/src/k8s.io/apiserver/pkg/server/egressselector/egress_selector.go:40
+  pkg/controller/storageversiongc/gc_controller.go
+"""
+
+import http.server
+import socketserver
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver.egress import (
+    CLUSTER, EgressSelector, HTTPConnectDialer, default_selector,
+)
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import LEASES
+from kubernetes_tpu.controllers.storageversion import (
+    STORAGEVERSIONS, StorageVersionGC, publish_identity,
+    publish_storage_versions,
+)
+from kubernetes_tpu.store import kv
+
+
+def wait_for(pred, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class _ConnectProxy(threading.Thread):
+    """Tiny HTTP CONNECT proxy: tunnels and counts connections."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.tunnels = 0
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_CONNECT(self):
+                import socket
+                host, _, port = self.path.partition(":")
+                upstream = socket.create_connection((host, int(port)))
+                outer.tunnels += 1
+                self.send_response(200, "Connection Established")
+                self.end_headers()
+                # bidirectional relay until either side closes
+                conns = [self.connection, upstream]
+                import select
+                while True:
+                    r, _, _ = select.select(conns, [], [], 5)
+                    if not r:
+                        break
+                    done = False
+                    for s in r:
+                        data = s.recv(65536)
+                        if not data:
+                            done = True
+                            break
+                        (upstream if s is self.connection
+                         else self.connection).sendall(data)
+                    if done:
+                        break
+                upstream.close()
+
+        self.httpd = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+
+    def run(self):
+        self.httpd.serve_forever()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestEgressSelector:
+    def test_direct_default(self):
+        store = kv.MemoryStore()
+        server = APIServer(store).start()
+        try:
+            sel = EgressSelector()
+            req = urllib.request.Request(server.url + "/healthz")
+            with sel.open(CLUSTER, req, 5) as resp:
+                assert resp.status == 200
+        finally:
+            server.stop()
+
+    def test_http_connect_dialer_tunnels(self):
+        store = kv.MemoryStore()
+        server = APIServer(store).start()
+        proxy = _ConnectProxy()
+        proxy.start()
+        try:
+            sel = EgressSelector()
+            sel.register(CLUSTER, HTTPConnectDialer("127.0.0.1",
+                                                    proxy.port))
+            req = urllib.request.Request(server.url + "/healthz")
+            resp = sel.open(CLUSTER, req, 5)
+            import json
+            assert json.loads(resp.read())["status"] == "ok"
+            assert proxy.tunnels == 1
+        finally:
+            proxy.stop()
+            server.stop()
+
+    def test_aggregator_rides_the_selector(self):
+        """The aggregation proxy consults the process-global selector:
+        swapping the cluster dialer reroutes aggregated API traffic
+        without touching the aggregator."""
+        backend = APIServer(kv.MemoryStore()).start()
+        front_store = kv.MemoryStore()
+        front = APIServer(front_store).start()
+        proxy = _ConnectProxy()
+        proxy.start()
+        try:
+            svc = meta.new_object("APIService", "v1.metrics.example.io",
+                                  None)
+            svc["spec"] = {"group": "metrics.example.io", "version": "v1",
+                           "service": {"url": backend.url}}
+            front_store.create("apiservices", svc)
+            default_selector.register(
+                CLUSTER, HTTPConnectDialer("127.0.0.1", proxy.port))
+            req = urllib.request.Request(
+                front.url + "/apis/metrics.example.io/v1/widgets")
+            with urllib.request.urlopen(req, timeout=10):
+                pass
+            assert proxy.tunnels >= 1
+        finally:
+            default_selector.reset(CLUSTER)
+            proxy.stop()
+            front.stop()
+            backend.stop()
+
+
+@pytest.fixture
+def gc_env():
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    ctrl = StorageVersionGC(client, factory, resync=0.2)
+    factory.start()
+    factory.wait_for_cache_sync()
+    ctrl.run()
+    yield store, client, ctrl
+    ctrl.stop()
+    factory.stop()
+
+
+class TestStorageVersionGC:
+    def test_publish_and_gc_on_lease_delete(self, gc_env):
+        store, client, ctrl = gc_env
+        publish_identity(client, "apiserver-a")
+        publish_identity(client, "apiserver-b")
+        publish_storage_versions(client, "apiserver-a")
+        publish_storage_versions(client, "apiserver-b")
+        sv = store.get(STORAGEVERSIONS, "", "core.pods")
+        assert len(sv["status"]["storageVersions"]) == 2
+        assert sv["status"]["commonEncodingVersion"] == "v1"
+
+        # server B dies: its lease is deleted -> entries stripped
+        client.delete(LEASES, "kube-system", "apiserver-b")
+        assert wait_for(lambda: len(
+            store.get(STORAGEVERSIONS, "", "core.pods")["status"]
+            ["storageVersions"]) == 1)
+        left = store.get(STORAGEVERSIONS, "", "core.pods")
+        assert left["status"]["storageVersions"][0][
+            "apiServerID"] == "apiserver-a"
+
+    def test_sv_object_deleted_when_no_servers_remain(self, gc_env):
+        store, client, ctrl = gc_env
+        publish_identity(client, "apiserver-x")
+        publish_storage_versions(client, "apiserver-x", resources=("pods",))
+        client.delete(LEASES, "kube-system", "apiserver-x")
+
+        def gone():
+            try:
+                store.get(STORAGEVERSIONS, "", "core.pods")
+                return False
+            except kv.NotFoundError:
+                return True
+        assert wait_for(gone)
+
+    def test_expired_lease_is_dead(self, gc_env):
+        store, client, ctrl = gc_env
+        publish_identity(client, "apiserver-old")
+        publish_storage_versions(client, "apiserver-old",
+                                 resources=("pods",))
+        # age the lease past its TTL (no delete event — the periodic
+        # sweep must catch it)
+        def age(cur):
+            cur["spec"]["renewTime"] = time.time() - 3600
+            return cur
+        client.guaranteed_update(LEASES, "kube-system", "apiserver-old",
+                                 age)
+
+        def gone():
+            try:
+                store.get(STORAGEVERSIONS, "", "core.pods")
+                return False
+            except kv.NotFoundError:
+                return True
+        assert wait_for(gone)
+
+    def test_renewal_keeps_entries(self, gc_env):
+        store, client, ctrl = gc_env
+        publish_identity(client, "apiserver-live")
+        publish_storage_versions(client, "apiserver-live",
+                                 resources=("pods",))
+        time.sleep(0.6)  # several sweep cycles
+        sv = store.get(STORAGEVERSIONS, "", "core.pods")
+        assert len(sv["status"]["storageVersions"]) == 1
