@@ -47,6 +47,11 @@ N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
 BATCH = int(os.environ.get("BENCH_BATCH", "16384"))
 
 EXTRA_CONFIGS = {
+    # p99 under steady 8k pods/s arrival (~60% of capacity) — the
+    # honest latency number; the headline's p99 is backlog drain time
+    "SchedulingBasicPaced": {"workload": "SchedulingBasicLarge",
+                             "nodes": 5000, "pods": 24_000, "batch": 2048,
+                             "rate": 8000, "timeout": 900.0},
     "Scheduling100k": {"workload": "SchedulingBasicLarge",
                        "nodes": 100_000, "pods": 200_000, "batch": 16384,
                        "timeout": 1200.0},
@@ -60,7 +65,8 @@ EXTRA_CONFIGS = {
 
 
 def run_once(workload: str, nodes: int | None, pods: int | None,
-             batch: int, barrier_timeout: float = 900.0) -> dict:
+             batch: int, barrier_timeout: float = 900.0,
+             rate: float | None = None) -> dict:
     """One full workload pass in this process; returns the result dict."""
     import copy
 
@@ -75,6 +81,8 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
             op["count"] = pods
         elif op["opcode"] == "barrier":
             op["timeout"] = barrier_timeout
+        if op["opcode"] == "createPods" and rate:
+            op["ratePerSecond"] = rate
     n_nodes = next(op["count"] for op in cfg["workloadTemplate"]
                    if op["opcode"] == "createNodes")
 
@@ -138,9 +146,11 @@ def child_main() -> None:
     nodes = os.environ.get("_BENCH_W_NODES")
     pods = os.environ.get("_BENCH_W_PODS")
     batch = int(os.environ.get("_BENCH_W_BATCH", str(BATCH)))
+    rate = os.environ.get("_BENCH_W_RATE")
     res = run_once(name, int(nodes) if nodes else None,
                    int(pods) if pods else None, batch,
-                   float(os.environ.get("_BENCH_W_TIMEOUT", "900")))
+                   float(os.environ.get("_BENCH_W_TIMEOUT", "900")),
+                   rate=float(rate) if rate else None)
     if "error" in res:
         emit(0.0, {"error": res["error"], **res["detail"]})
         sys.exit(1)
@@ -186,6 +196,8 @@ def main() -> None:
                 env["_BENCH_W_NODES"] = str(c["nodes"])
             if "pods" in c:
                 env["_BENCH_W_PODS"] = str(c["pods"])
+            if "rate" in c:
+                env["_BENCH_W_RATE"] = str(c["rate"])
             got = _spawn_child(env, timeout=c.get("timeout", 900.0) + 300)
             if got is None:
                 configs[cname] = {"error": "failed"}
@@ -193,6 +205,7 @@ def main() -> None:
             d = got.get("detail", {})
             configs[cname] = {
                 "pods_per_s": got.get("value", 0.0),
+                "p50_ms": d.get("pod_e2e_p50_ms"),
                 "p99_ms": d.get("pod_e2e_p99_ms"),
                 "total_pods": d.get("TotalPods"),
             }

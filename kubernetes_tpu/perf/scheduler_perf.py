@@ -271,8 +271,25 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
                          _default_node, op)
             created_nodes += op["count"]
         elif opcode == "createPods":
-            _bulk_create(cluster.client, PODS, op["count"], created_pods,
-                         _default_pod, op)
+            rate = op.get("ratePerSecond")
+            if rate:
+                # paced arrival (the reference harness's client-QPS knob,
+                # util.go:92): steady load below capacity is what the
+                # p99-latency target is ABOUT — a full-backlog dump makes
+                # p99 the backlog drain time by construction
+                chunk = max(1, int(rate) // 20)  # 50ms ticks
+                next_t = time.monotonic()
+                for lo in range(0, op["count"], chunk):
+                    hi = min(lo + chunk, op["count"])
+                    _bulk_create(cluster.client, PODS, hi - lo,
+                                 created_pods + lo, _default_pod, op)
+                    next_t += (hi - lo) / rate
+                    delay = next_t - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+            else:
+                _bulk_create(cluster.client, PODS, op["count"],
+                             created_pods, _default_pod, op)
             created_pods += op["count"]
         elif opcode == "createPodGroups":
             from ..client.clientset import PODGROUPS
