@@ -98,9 +98,13 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin)
     # CycleState is always empty (scheduler.Framework.batch_tail_trivial).
     state_gated = True
 
-    def __init__(self, client=None, informer_factory=None):
+    def __init__(self, client=None, informer_factory=None,
+                 bind_timeout: float = 30.0):
         self.client = client
         self.factory = informer_factory
+        # binder.go bindTimeout: how long PreBind waits for the PV
+        # controller / provisioner to complete the bindings it requested
+        self.bind_timeout = bind_timeout
         self._lock = threading.Lock()
         # pv name -> pvc key it's assumed for (binder.go assumed cache)
         self._assumed: dict[str, str] = {}
@@ -273,8 +277,17 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin)
             ns, name = meta.namespace(pvc), meta.name(pvc)
             try:
                 if pv is not None:
-                    # static binding: PV.claimRef then PVC.volumeName
+                    # static binding: PV.claimRef then PVC.volumeName.
+                    # Never stomp a claimRef someone else won — the wait
+                    # below detects the mismatch and fails this binding
+                    # (the reference's bindAPIUpdate loses the same race
+                    # to the PV controller's own binds)
                     def set_claim_ref(obj, pvc=pvc):
+                        ref = (obj.get("spec") or {}).get("claimRef") or {}
+                        if ref and (ref.get("namespace"),
+                                    ref.get("name")) != (
+                                meta.namespace(pvc), meta.name(pvc)):
+                            return obj
                         obj.setdefault("spec", {})["claimRef"] = {
                             "namespace": meta.namespace(pvc),
                             "name": meta.name(pvc), "uid": meta.uid(pvc)}
@@ -302,4 +315,106 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin)
                     self.client.guaranteed_update(PVCS, ns, name, annotate)
             except Exception as e:  # pragma: no cover - API failure path
                 return Status(ERROR, f"binding volumes: {e}")
-        return None
+        # the writes above only REQUEST bindings; the PV controller (and,
+        # for dynamic claims, the provisioner) must finish them before the
+        # pod may bind (binder.go BindPodVolumes -> checkBindings poll)
+        status = self._wait_for_bindings(st, node_name)
+        if status is not None:
+            self._rollback(st, node_name)
+        return status
+
+    def _wait_for_bindings(self, st: "_PodVolumeState",
+                           node_name: str) -> Status | None:
+        """checkBindings (binder.go:1002): poll until every requested
+        binding reports Bound and each PV's claimRef still points at our
+        PVC; detect conflicts (someone else took the PV) immediately."""
+        import time
+        bindings = st.bindings_by_node.get(node_name, ())
+        if not bindings:
+            return None
+        deadline = time.monotonic() + self.bind_timeout
+        while True:
+            done = True
+            for pvc, pv in bindings:
+                ns, name = meta.namespace(pvc), meta.name(pvc)
+                try:
+                    cur = self.client.get(PVCS, ns, name)
+                except Exception:
+                    return Status(ERROR,
+                                  f"pvc {ns}/{name} vanished while binding")
+                vol = (cur.get("spec") or {}).get("volumeName")
+                phase = (cur.get("status") or {}).get("phase")
+                if pv is not None:
+                    # static: the PV must still reference our claim
+                    try:
+                        cur_pv = self.client.get(PVS, "", meta.name(pv))
+                    except Exception:
+                        return Status(ERROR,
+                                      f"pv {meta.name(pv)} vanished "
+                                      "while binding")
+                    ref = (cur_pv.get("spec") or {}).get("claimRef") or {}
+                    if ref and (ref.get("namespace"), ref.get("name")) != \
+                            (ns, name):
+                        return Status(ERROR,
+                                      f"pv {meta.name(pv)} was bound to a "
+                                      "different claim")
+                if not vol or phase != "Bound":
+                    done = False
+                    break
+            if done:
+                return None
+            if time.monotonic() >= deadline:
+                return Status(ERROR,
+                              "timed out waiting for volume binding")
+            time.sleep(0.05)
+
+    def _rollback(self, st: "_PodVolumeState", node_name: str) -> None:
+        """Failed/timed-out binding: revert what THIS plugin wrote so a
+        retry can choose freely (reference: RevertAssumedPodVolumes plus
+        leaving durable recovery to the PV controller; we additionally
+        clear a still-unbound claim's selected-node annotation so a
+        reschedule isn't pinned to the failed node).  Writes guarded by
+        ownership checks — a binding that completed meanwhile is left
+        alone."""
+        for pvc, pv in st.bindings_by_node.get(node_name, ()):
+            ns, name = meta.namespace(pvc), meta.name(pvc)
+            try:
+                if pv is not None:
+                    def clear_ref(obj, pvc=pvc):
+                        ref = (obj.get("spec") or {}).get("claimRef") or {}
+                        if (ref.get("namespace"), ref.get("name")) == \
+                                (meta.namespace(pvc), meta.name(pvc)):
+                            obj["spec"].pop("claimRef", None)
+                            obj.setdefault("status", {})["phase"] = \
+                                "Available"
+                        return obj
+                    self.client.guaranteed_update(PVS, "", meta.name(pv),
+                                                  clear_ref)
+
+                    def clear_vol(obj, pv=pv):
+                        # we wrote volumeName (and the Bound phase) for
+                        # this static binding, so it is ours to revert;
+                        # the wait already proved the PV does NOT
+                        # reference this claim
+                        spec = obj.setdefault("spec", {})
+                        if spec.get("volumeName") == meta.name(pv):
+                            spec.pop("volumeName", None)
+                            obj.setdefault("status", {})["phase"] = "Pending"
+                        return obj
+                    self.client.guaranteed_update(PVCS, ns, name, clear_vol)
+                else:
+                    def deannotate(obj, node_name=node_name):
+                        if (obj.get("status") or {}).get("phase") == "Bound":
+                            return obj  # provisioning completed: keep it
+                        anns = (obj.get("metadata") or {}).get(
+                            "annotations") or {}
+                        if anns.get(SELECTED_NODE_ANNOTATION) == node_name:
+                            anns.pop(SELECTED_NODE_ANNOTATION, None)
+                        return obj
+                    self.client.guaranteed_update(PVCS, ns, name, deannotate)
+            except Exception:  # noqa: BLE001 — rollback is best effort
+                pass
+        with self._lock:
+            for pvc, pv in st.bindings_by_node.get(node_name, ()):
+                if pv is not None:
+                    self._assumed.pop(meta.name(pv), None)

@@ -161,7 +161,12 @@ class TestVolumeBinding:
         plugin.pre_filter(s3, pod2, snapshot_of())
         assert plugin.filter(s3, pod2, node) is None
 
-    def test_pre_bind_writes_bindings(self):
+    def test_pre_bind_writes_bindings_and_waits_for_provisioning(self):
+        """PreBind requests the bindings AND WAITS for the provisioner to
+        complete them (binder.go BindPodVolumes/checkBindings)."""
+        import threading
+        import time as _time
+
         store = kv.MemoryStore()
         client = LocalClient(store)
         f = FakeInformerFactory()
@@ -175,7 +180,8 @@ class TestVolumeBinding:
                      (PVCS, pvc), (PVCS, dyn_pvc), (PVS, pv)):
             f.add(r, o)
             store.create(r, o)
-        plugin = VolumeBinding(client=client, informer_factory=f)
+        plugin = VolumeBinding(client=client, informer_factory=f,
+                               bind_timeout=10.0)
         pod = PodInfo(make_pod("p").pvc("c").pvc("cdyn").build())
         state = CycleState()
         _, status = plugin.pre_filter(state, pod, snapshot_of())
@@ -183,7 +189,26 @@ class TestVolumeBinding:
         node = ni(make_node("n1").build())
         assert plugin.filter(state, pod, node) is None
         plugin.reserve(state, pod, "n1")
+
+        # a mini PV-controller: provision+bind the dynamic claim once the
+        # selected-node annotation lands
+        def provisioner():
+            deadline = _time.time() + 8
+            while _time.time() < deadline:
+                cur = store.get(PVCS, "default", "cdyn")
+                anns = (cur.get("metadata") or {}).get("annotations") or {}
+                if anns.get(SELECTED_NODE_ANNOTATION):
+                    def bind(o):
+                        o.setdefault("spec", {})["volumeName"] = "pv-dyn"
+                        o.setdefault("status", {})["phase"] = "Bound"
+                        return o
+                    client.guaranteed_update(PVCS, "default", "cdyn", bind)
+                    return
+                _time.sleep(0.02)
+        t = threading.Thread(target=provisioner, daemon=True)
+        t.start()
         assert plugin.pre_bind(state, pod, "n1") is None
+        t.join()
         bound_pvc = store.get(PVCS, "default", "c")
         assert bound_pvc["spec"]["volumeName"] == "pv1"
         bound_pv = store.get(PVS, "", "pv1")
@@ -191,6 +216,72 @@ class TestVolumeBinding:
         annotated = store.get(PVCS, "default", "cdyn")
         assert annotated["metadata"]["annotations"][
             SELECTED_NODE_ANNOTATION] == "n1"
+        assert annotated["status"]["phase"] == "Bound"
+
+    def test_pre_bind_timeout_rolls_back(self):
+        """No provisioner ever answers: PreBind must fail after
+        bind_timeout and revert its writes so a retry can choose another
+        node (selected-node annotation cleared, assumed cache empty)."""
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        f = FakeInformerFactory()
+        dyn_sc = make_storage_class("dyn", provisioner="csi.x.io",
+                                    wait_for_first_consumer=True)
+        dyn_pvc = make_pvc("cdyn", storage_class="dyn")
+        for r, o in ((STORAGECLASSES, dyn_sc), (PVCS, dyn_pvc)):
+            f.add(r, o)
+            store.create(r, o)
+        plugin = VolumeBinding(client=client, informer_factory=f,
+                               bind_timeout=0.3)
+        pod = PodInfo(make_pod("p").pvc("cdyn").build())
+        state = CycleState()
+        plugin.pre_filter(state, pod, snapshot_of())
+        node = ni(make_node("n1").build())
+        assert plugin.filter(state, pod, node) is None
+        plugin.reserve(state, pod, "n1")
+        st = plugin.pre_bind(state, pod, "n1")
+        assert st is not None and "timed out" in st.message()
+        cur = store.get(PVCS, "default", "cdyn")
+        anns = (cur.get("metadata") or {}).get("annotations") or {}
+        assert SELECTED_NODE_ANNOTATION not in anns
+        plugin.unreserve(state, pod, "n1")
+        assert not plugin._assumed
+
+    def test_pre_bind_detects_stolen_pv(self):
+        """Another claim takes the PV between Reserve and the bind
+        completing: the wait detects the claimRef mismatch and rolls
+        back our PVC write (volumeName cleared, claim unbound)."""
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        f = FakeInformerFactory()
+        sc = make_storage_class("wffc", wait_for_first_consumer=True)
+        pvc = make_pvc("c", storage_class="wffc")
+        pv = make_pv("pv1", storage_class="wffc")
+        for r, o in ((STORAGECLASSES, sc), (PVCS, pvc), (PVS, pv)):
+            f.add(r, o)
+            store.create(r, o)
+        plugin = VolumeBinding(client=client, informer_factory=f,
+                               bind_timeout=2.0)
+        pod = PodInfo(make_pod("p").pvc("c").build())
+        state = CycleState()
+        plugin.pre_filter(state, pod, snapshot_of())
+        node = ni(make_node("n1").build())
+        assert plugin.filter(state, pod, node) is None
+        plugin.reserve(state, pod, "n1")
+        # sabotage: a racing claimant owns the PV before our PreBind
+        def steal(o):
+            o.setdefault("spec", {})["claimRef"] = {
+                "namespace": "default", "name": "thief", "uid": "thief-uid"}
+            return o
+        client.guaranteed_update(PVS, "", "pv1", steal)
+
+        st = plugin.pre_bind(state, pod, "n1")
+        assert st is not None and "different claim" in st.message()
+        # the thief keeps the PV; our PVC is not left half-bound
+        cur_pv = store.get(PVS, "", "pv1")
+        assert cur_pv["spec"]["claimRef"]["name"] == "thief"
+        cur = store.get(PVCS, "default", "c")
+        assert "volumeName" not in (cur.get("spec") or {})
 
 
 class TestVolumeRestrictions:
