@@ -20,6 +20,10 @@ def main(argv=None) -> None:
     ap.add_argument("--secure-port", type=int, default=8080)
     ap.add_argument("--nodes", type=int, default=3)
     ap.add_argument("--tpu-batch", action="store_true")
+    ap.add_argument("--tpu-worker", default=None,
+                    help="URL of an external tpu-worker process "
+                         "(cmd/tpu_worker.py); default runs the device "
+                         "backend in-process")
     ap.add_argument("--batch-size", type=int, default=512)
     ap.add_argument("--devices-per-node", type=int, default=0,
                     help="give each hollow node N google.com/tpu devices "
@@ -47,10 +51,15 @@ def main(argv=None) -> None:
 
     fw = new_default_framework(client, factory)
     if args.tpu_batch:
-        from ..ops.backend import TPUBatchBackend
         from ..ops.flatten import Caps
-        backend = TPUBatchBackend(Caps(n_cap=max(1024, args.nodes * 2)),
-                                  batch_size=args.batch_size)
+        caps = Caps(n_cap=max(1024, args.nodes * 2))
+        if args.tpu_worker:
+            from ..ops.remote import RemoteTPUBatchBackend
+            backend = RemoteTPUBatchBackend(args.tpu_worker, caps,
+                                            batch_size=args.batch_size)
+        else:
+            from ..ops.backend import TPUBatchBackend
+            backend = TPUBatchBackend(caps, batch_size=args.batch_size)
         backend.warmup()
         profile = Profile(fw, batch_backend=backend, batch_size=args.batch_size)
     else:

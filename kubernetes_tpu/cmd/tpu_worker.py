@@ -1,0 +1,38 @@
+"""Standalone TPU device worker (the out-of-process scheduling backend).
+
+Run this next to the chip; point the scheduler's RemoteTPUBatchBackend
+at its URL (ops/remote.py — BASELINE.json's scheduler<->JAX-worker shim
+as a real process boundary; in-tree precedent for out-of-process
+scheduling hooks: pkg/scheduler/extender.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="tpu-worker")
+    ap.add_argument("--bind-address", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9090)
+    ap.add_argument("-v", "--verbosity", type=int, default=1)
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
+
+    from ..ops.remote import DeviceWorker
+
+    worker = DeviceWorker(host=args.bind_address, port=args.port).start()
+    print(f"tpu-worker listening on {worker.url}")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    worker.stop()
+
+
+if __name__ == "__main__":
+    main()

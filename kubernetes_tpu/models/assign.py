@@ -616,16 +616,27 @@ KEY_V = 4       # max ids per Exists key group
 
 
 class PackSpec:
-    """Offsets for the single packed pod+patch buffer."""
+    """Offsets for the single packed pod+patch buffer.
 
-    def __init__(self, caps: Caps, p_cap: int, k_cap: int):
+    plain=True is the PLAIN-variant wire format: just req/req_nz plus an
+    untol_hard bitmask and validity — ~6x less upload per batch than the
+    full layout, which matters on a high-latency/limited-bandwidth link
+    (the tunneled chip; the north star's gRPC shim regime)."""
+
+    def __init__(self, caps: Caps, p_cap: int, k_cap: int,
+                 plain: bool = False):
         assert caps.t_cap <= 31 and caps.pt_cap <= 31, "bitmask packing caps"
         assert caps.sg_cap <= 31 and caps.asg_cap <= 31
         assert caps.g_cap <= 31 and caps.kg_cap <= 31 and caps.kl_cap <= 62
         self.caps, self.p_cap, self.k_cap = caps, p_cap, k_cap
+        self.plain = plain
         C, G, KG = caps.c_cap, caps.g_cap, caps.kg_cap
-        self.f_f = 2 * caps.r + 3 * C
-        self.f_i = 12 + 2 * C + G * SEL_V + FORB_V + KG * KEY_V
+        if plain:
+            self.f_f = 2 * caps.r
+            self.f_i = 2  # untol_hard bits | p_valid
+        else:
+            self.f_f = 2 * caps.r + 3 * C
+            self.f_i = 12 + 2 * C + G * SEL_V + FORB_V + KG * KEY_V
         self.f_patch = 2 * caps.r + 1 + caps.pt_cap
         self.a = p_cap * self.f_f
         self.b = p_cap * self.f_i
@@ -645,6 +656,21 @@ def pack_pod_batch(batch, spec: PackSpec,
     """PodBatch (+ optional row patches) -> single 1-D f32 buffer."""
     caps, P, K = spec.caps, spec.p_cap, spec.k_cap
     C, G, KG = caps.c_cap, caps.g_cap, caps.kg_cap
+    if spec.plain:
+        pf = np.concatenate([batch.req, batch.req_nz],
+                            axis=1).astype(np.float32)
+        pi = np.zeros((P, spec.f_i), np.int32)
+        pi[:, 0] = _bits(batch.untol_hard)
+        pi[:, 1] = batch.p_valid.astype(np.int32)
+        rows = np.full(K, -1, np.int32)
+        vals = np.zeros((K, spec.f_patch), np.float32)
+        if patch_rows is not None and len(patch_rows):
+            n = min(len(patch_rows), K)
+            rows[:n] = patch_rows[:n]
+            vals[:n] = patch_vals[:n]
+        return np.concatenate([
+            pf.ravel(), pi.view(np.float32).ravel(),
+            rows.view(np.float32), vals.ravel()]).astype(np.float32)
     pf = np.concatenate([batch.req, batch.req_nz, batch.c_maxskew,
                          batch.c_selfmatch, batch.c_weight],
                         axis=1).astype(np.float32)
@@ -693,6 +719,34 @@ def _unpack(buf, spec: PackSpec, features: frozenset = ALL_FEATURES):
 
     def unbits(word, width):
         return ((word[:, None] >> jnp.arange(width)) & 1).astype(jnp.float32)
+
+    if spec.plain:
+        # PLAIN wire format: everything the elided code paths would read
+        # is a traced zero constant (no transfer, folded at compile time)
+        zc = jnp.zeros
+        pod = {
+            "req": pf[:, :R], "req_nz": pf[:, R:2 * R],
+            "untol_hard": unbits(pi[:, 0], caps.t_cap),
+            "p_valid": pi[:, 1] > 0,
+            "untol_prefer": zc((P, caps.t_cap), jnp.float32),
+            "ports": zc((P, caps.pt_cap), jnp.float32),
+            "key_forb": zc((P, KL), jnp.float32),
+            "match_asg": zc((P, caps.asg_cap), jnp.float32),
+            "inc_asg": zc((P, caps.asg_cap), jnp.float32),
+            "inc_sg": zc((P, caps.sg_cap), jnp.float32),
+            "sel_any_active": zc((P, caps.g_cap), jnp.float32),
+            "key_any_active": zc((P, caps.kg_cap), jnp.float32),
+            "node_row": jnp.full((P,), -1, jnp.int32),
+            "c_kind": jnp.zeros((P, C), jnp.int32),
+            "c_sg": jnp.zeros((P, C), jnp.int32),
+            "c_maxskew": zc((P, C), jnp.float32),
+            "c_selfmatch": zc((P, C), jnp.float32),
+            "c_weight": zc((P, C), jnp.float32),
+            "sel_any": zc((P, G, L), jnp.float32),
+            "sel_forb": zc((P, L), jnp.float32),
+            "key_any": zc((P, KG, KL), jnp.float32),
+        }
+        return pod, prow, pval
 
     o = 12
     c_kind = pi[:, o:o + C]; o += C
@@ -775,7 +829,7 @@ def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
     `features` selects a specialized kernel variant (the backend keeps one
     per feature set and picks per batch based on what the batch actually
     uses)."""
-    spec = PackSpec(caps, p_cap, k_cap)
+    spec = PackSpec(caps, p_cap, k_cap, plain=(features == PLAIN_FEATURES))
     # wave ceiling: constraint batches can legitimately need many waves
     # (hard spread admits ~domains*maxSkew pods per wave), and the loop
     # exits the moment nothing is active or progress stops — so for the
