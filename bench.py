@@ -177,7 +177,9 @@ def main() -> None:
                 "_BENCH_W_PODS": str(N_PODS),
                 "_BENCH_W_BATCH": str(BATCH)}
     for _ in range(n_runs):
-        got = _spawn_child(head_env, timeout=900.0)
+        # margin over the child's 900s barrier so a stuck child still
+        # gets to emit its own error JSON before the parent gives up
+        got = _spawn_child(head_env, timeout=1200.0)
         if got is None:
             emit(0.0, {"error": "bench child failed twice"})
             sys.exit(1)

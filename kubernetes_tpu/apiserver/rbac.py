@@ -263,9 +263,10 @@ def bootstrap_policy(store: kv.MemoryStore) -> None:
              "resources": ["selfsubjectaccessreviews"]},
         ]),
         _role("system:node-bootstrapper", [
-            # a joining node's bootstrap-token identity may submit CSRs
-            # and watch for the issued certificate
-            {"verbs": ["create", "get", "list", "watch"],
+            # a joining node's bootstrap-token identity may submit CSRs,
+            # watch for the issued certificate, and replace a stale CSR
+            # left by an earlier failed join
+            {"verbs": ["create", "get", "list", "watch", "delete"],
              "resources": ["certificatesigningrequests"]},
         ]),
         # user-facing roles (aggregationRule reduced to static rules)

@@ -266,8 +266,9 @@ class APIServer:
                 credential); with token-auth but no authorizer, anonymous
                 would mean unrestricted, so it stays a 401."""
                 auth = self.headers.get("Authorization", "")
-                if not server.tokens or (not auth
-                                         and server.authorizer is not None):
+                authn_on = bool(server.tokens) or server.bootstrap_token_auth
+                if not authn_on or (not auth
+                                    and server.authorizer is not None):
                     return ("system:anonymous", ("system:unauthenticated",))
                 if auth.startswith("Bearer "):
                     bearer = auth[len("Bearer "):]
@@ -935,10 +936,17 @@ class APIServer:
                             return
                         if not self._validate_custom(r, new):
                             return
-                        created = server.store.create(r.resource, new)
-                        self._send_json(201, created)
-                        self._audit(r, "apply", 201, created)
-                        return
+                        try:
+                            created = server.store.create(r.resource, new)
+                        except kv.AlreadyExistsError:
+                            # lost the create race to a concurrent first
+                            # apply: fall through and MERGE with the
+                            # winner (apply-to-existing is well-defined)
+                            created = None
+                        if created is not None:
+                            self._send_json(201, created)
+                            self._audit(r, "apply", 201, created)
+                            return
 
                     def merge(cur):
                         new = mflib.apply_merge(cur, applied, manager,

@@ -266,16 +266,24 @@ class LocalClient(Client):
         """Server-side apply (managedfields.py semantics, in process)."""
         from ..apiserver import managedfields as mf
         ns, nm = obj["metadata"].get("namespace", ""), obj["metadata"]["name"]
-        try:
-            def merge(cur):
-                new = mf.apply_merge(cur, obj, field_manager, force=force)
-                new["metadata"]["resourceVersion"] = \
-                    cur["metadata"].get("resourceVersion")
-                return new
-            return self.store.guaranteed_update(resource, ns, nm, merge)
-        except NotFoundError:
-            return self.store.create(
-                resource, mf.apply_merge(None, obj, field_manager))
+
+        def merge(cur):
+            new = mf.apply_merge(cur, obj, field_manager, force=force)
+            new["metadata"]["resourceVersion"] = \
+                cur["metadata"].get("resourceVersion")
+            return new
+
+        for _ in range(2):
+            try:
+                return self.store.guaranteed_update(resource, ns, nm, merge)
+            except NotFoundError:
+                pass
+            try:
+                return self.store.create(
+                    resource, mf.apply_merge(None, obj, field_manager))
+            except kv.AlreadyExistsError:
+                continue  # lost the create race: merge with the winner
+        return self.store.guaranteed_update(resource, ns, nm, merge)
 
     def list(self, resource: str, namespace: str | None = None) -> tuple[list[Obj], int]:
         return self.store.list(resource, namespace)

@@ -258,7 +258,16 @@ def join(args) -> None:
                    "usages": ["key encipherment", "digital signature",
                               "client auth"],
                    "request": base64.b64encode(csr_pem).decode()}}
-        client.create("certificatesigningrequests", csr)
+        try:
+            client.create("certificatesigningrequests", csr)
+        except Exception as e:  # noqa: BLE001 — retried joins leave a
+            # stale CSR behind; replace it (its key is gone with the old
+            # process, so the old cert is useless to us anyway)
+            if "exists" not in str(e).lower():
+                raise
+            client.delete("certificatesigningrequests", "",
+                          f"node-csr-{args.node_name}")
+            client.create("certificatesigningrequests", csr)
         cert_pem = None
         deadline = time.time() + 30
         while time.time() < deadline:

@@ -219,7 +219,10 @@ class HorizontalPodAutoscaler:
                       current: int, now: float, up: bool,
                       select: str) -> int | None:
         """Replica bound allowed by the scaling policies, None = no limit
-        (or Disabled -> current, i.e. no change in that direction)."""
+        (or Disabled -> current, i.e. no change in that direction).
+        Only events in THIS direction consume policy budget (upstream
+        keeps separate scaleUpEvents/scaleDownEvents for the same
+        reason — an opposite-direction event must not inflate room)."""
         if select == "Disabled":
             return current
         if not policies:
@@ -227,12 +230,14 @@ class HorizontalPodAutoscaler:
         bounds = []
         for pol in policies:
             period = pol.get("periodSeconds", 60)
-            changed = sum(d for t, d in events if now - t <= period)
+            changed = sum((d if up else -d) for t, d in events
+                          if now - t <= period
+                          and (d > 0) == up)
             if pol.get("type") == "Percent":
                 allowed = int(current * pol.get("value", 100) / 100.0) or 1
             else:  # Pods
                 allowed = pol.get("value", 4)
-            room = max(0, allowed - (changed if up else -changed))
+            room = max(0, allowed - changed)
             bounds.append(current + room if up else current - room)
         pick = max if (up == (select != "Min")) else min
         return pick(bounds)

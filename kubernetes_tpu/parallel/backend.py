@@ -101,8 +101,20 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                 cd_sg, cd_asg = self.tensors.domain_base_counts()
                 self._full_refresh(cd_sg, cd_asg)
             batch = self.encoder.encode([])
-            a, _w = self._dispatch_locked(batch, *self._empty_patches())
-            np.asarray(a)  # an all-invalid batch changes nothing; block
+            # trace BOTH variants: an all-invalid batch leaves the
+            # resident state numerically unchanged, and paying the full
+            # kernel's multi-second XLA compile here beats paying it
+            # inside the first constraint-carrying scheduling cycle
+            import jax
+            pshard = self._shardings[2]
+            pod_arrays = {k: jax.device_put(getattr(batch, k), pshard[k])
+                          for k in POD_KEYS}
+            prows, pvals = self._empty_patches()
+            self._state, a, _w = self._fn(
+                self._state, self._static_node, pod_arrays, prows, pvals)
+            self._state, a, _w = self._ensure_plain()(
+                self._state, self._static_node, pod_arrays, prows, pvals)
+            np.asarray(a)  # block until the device round trips complete
 
     def _empty_patches(self):
         return (np.full(self._k_cap, -1, np.int32),

@@ -190,6 +190,35 @@ class TestBehaviorPolicies:
         ctrl.reconcile_once(t0 + 61)
         assert replicas(client) == 8
 
+    def test_opposite_direction_events_do_not_inflate_budget(self, hpa_env):
+        """A recent scale-UP must not grant extra scale-DOWN room (the
+        reference keeps separate scaleUpEvents/scaleDownEvents)."""
+        store, client, ctrl = hpa_env
+        make_rs(client, replicas=2)
+        annotate(client, {USAGE_ANNOTATION: "4000m"})  # wants max
+        make_hpa(client, {
+            "targetCPUUtilizationPercentage": 80,
+            "maxReplicas": 8,
+            "behavior": {
+                "scaleUp": {"policies": [
+                    {"type": "Pods", "value": 6, "periodSeconds": 60}]},
+                "scaleDown": {
+                    "stabilizationWindowSeconds": 0,
+                    "policies": [{"type": "Pods", "value": 2,
+                                  "periodSeconds": 60}]}}})
+        t0 = time.time()
+        sync(ctrl, client, now=t0)
+        assert replicas(client) == 8  # scaled up +6 (event recorded)
+        # load vanishes: scale-down budget is 2/period regardless of the
+        # +6 up-event sitting in the same window
+        annotate(client, {USAGE_ANNOTATION: "10m"})
+        assert wait_for(lambda: all(
+            (p["metadata"].get("annotations") or {}).get(
+                USAGE_ANNOTATION) == "10m"
+            for p in ctrl.pod_informer.list("default")))
+        ctrl.reconcile_once(t0 + 1)
+        assert replicas(client) == 6  # 8 - 2, NOT 8 - (2 + 6)
+
     def test_scale_down_percent_policy(self, hpa_env):
         store, client, ctrl = hpa_env
         make_rs(client, replicas=10)
