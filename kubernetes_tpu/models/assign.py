@@ -679,6 +679,13 @@ def pack_pod_batch(batch, spec: PackSpec,
         return np.concatenate([
             pf.ravel(), pi.view(np.float32).ravel(),
             rows.view(np.float32), vals.ravel()]).astype(np.float32)
+    # full wire format: materialize any lazy (None == zeros) fields the
+    # dense layout ships (see flatten.PodBatch laziness contract)
+    for _nm in ("untol_prefer", "ports", "key_forb", "match_asg", "inc_asg",
+                "inc_sg", "sel_any_active", "key_any_active", "node_row",
+                "c_kind", "c_sg", "c_maxskew", "c_selfmatch", "c_weight",
+                "sel_ids", "sel_forb_ids", "key_ids"):
+        batch.ensure(caps, _nm)
     pf = np.concatenate([batch.req, batch.req_nz, batch.c_maxskew,
                          batch.c_selfmatch, batch.c_weight],
                         axis=1).astype(np.float32)

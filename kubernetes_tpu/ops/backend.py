@@ -154,6 +154,10 @@ class ResidentHostMirror:
         with self._lock:
             if self._unresolved:
                 return
+            epoch_fn = getattr(snapshot, "epoch", None)
+            epoch = epoch_fn() if epoch_fn is not None else None
+            if epoch is not None and epoch == self._last_epoch:
+                return  # nothing external changed: the scan is a no-op
             try:
                 dirty = set(self.tensors.update_from_snapshot_tracked(
                     snapshot))
@@ -161,18 +165,22 @@ class ResidentHostMirror:
                 self._state = None  # force a refresh on next dispatch
                 return
             self._carry_dirty |= dirty
+            self._last_epoch = epoch
 
     def _needs_full(self, batch: PodBatch) -> bool:
         """Batches using selectors/constraints/ports/pins need the
         constraint-carrying kernel; the common plain case runs a variant
-        with those code paths elided (models/assign PLAIN_FEATURES)."""
+        with those code paths elided (models/assign PLAIN_FEATURES).
+        Lazy PodBatch fields: None == all-zeros == feature absent."""
+        def nz(a):
+            return a is not None and a.any()
         t = self.tensors
         return bool(
-            t.sgs or t.asgs or batch.c_kind.any()
-            or batch.sel_any_active.any() or batch.key_any_active.any()
-            or batch.sel_forb.any() or batch.key_forb.any()
-            or batch.ports.any() or batch.untol_prefer.any()
-            or (batch.node_row >= 0).any())
+            t.sgs or t.asgs or nz(batch.c_kind)
+            or nz(batch.sel_any_active) or nz(batch.key_any_active)
+            or nz(batch.sel_forb) or nz(batch.key_forb)
+            or nz(batch.ports) or nz(batch.untol_prefer)
+            or (batch.node_row is not None and (batch.node_row >= 0).any()))
 
     def _diff_patches(self, dirty_rows) -> tuple[np.ndarray, np.ndarray] | None:
         """Rows where authoritative != mirror (read-only; mirror untouched).
@@ -230,17 +238,20 @@ class ResidentHostMirror:
         np.add.at(m["used"], prow, batch.req[placed])
         np.add.at(m["used_nz"], prow, batch.req_nz[placed])
         np.add.at(m["npods"], prow, 1.0)
-        np.maximum.at(m["port_mask"], prow, batch.ports[placed])
-        for sg in range(len(t.sgs)):
-            inc = placed[batch.inc_sg[placed, sg] > 0]
-            if inc.size:
-                d = t.dom_sg[sg, rows[inc]]
-                np.add.at(m["cd_sg"][sg], d[d >= 0], 1.0)
-        for a in range(len(t.asgs)):
-            inc = placed[batch.inc_asg[placed, a] > 0]
-            if inc.size:
-                d = t.dom_asg[a, rows[inc]]
-                np.add.at(m["cd_asg"][a], d[d >= 0], 1.0)
+        if batch.ports is not None:
+            np.maximum.at(m["port_mask"], prow, batch.ports[placed])
+        if batch.inc_sg is not None:
+            for sg in range(len(t.sgs)):
+                inc = placed[batch.inc_sg[placed, sg] > 0]
+                if inc.size:
+                    d = t.dom_sg[sg, rows[inc]]
+                    np.add.at(m["cd_sg"][sg], d[d >= 0], 1.0)
+        if batch.inc_asg is not None:
+            for a in range(len(t.asgs)):
+                inc = placed[batch.inc_asg[placed, a] > 0]
+                if inc.size:
+                    d = t.dom_asg[a, rows[inc]]
+                    np.add.at(m["cd_asg"][a], d[d >= 0], 1.0)
 
 
 class TPUBatchBackend(ResidentHostMirror, BatchBackend):
@@ -286,6 +297,10 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         # rows whose dirtiness must survive an early-exit dispatch attempt
         self._unresolved: list[object] = []
         self._carry_dirty: set[int] = set()
+        # cache external-mutation epoch at last tensor sync: when the view
+        # reports the same epoch, every change since was our own replayed
+        # binds and the whole re-encode + mirror diff is skipped
+        self._last_epoch: int | None = None
         self.stats = {"batches": 0, "full_refresh": 0, "patched_rows": 0,
                       "waves": 0, "flush_first": 0}
 
@@ -428,9 +443,25 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         dirty rows from this attempt are carried over so no external change
         is lost."""
         with self._lock:
+            # epoch fast path: if every cache change since the last sync
+            # came from this backend's own batches (bulk assume + confirm),
+            # the mirror replay already holds the truth — skip the O(nodes)
+            # re-encode and the diff outright.  A mutation racing the epoch
+            # read is caught by the NEXT dispatch (epoch monotonically
+            # advances; _last_epoch only records the pre-sync value).
+            epoch_fn = getattr(snapshot, "epoch", None)
+            epoch = epoch_fn() if epoch_fn is not None else None
+            skip_sync = (epoch is not None and self._state is not None
+                         and epoch == self._last_epoch
+                         and not self._carry_dirty)
             try:
-                dirty = set(self.tensors.update_from_snapshot_tracked(snapshot))
-                dirty |= self._carry_dirty
+                if skip_sync:
+                    dirty = set()
+                else:
+                    dirty = set(self.tensors.update_from_snapshot_tracked(
+                        snapshot))
+                    dirty |= self._carry_dirty
+                    self._last_epoch = epoch
                 batch = self.encoder.encode(list(pod_infos))
             except VocabFullError as e:
                 logger.warning("tensorization overflow (%s); batch -> oracle path", e)
@@ -444,14 +475,19 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
 
             inflight = bool(self._unresolved)
             static_changed = self._static_version != self.tensors.static_version
-            cd_sg, cd_asg = self.tensors.domain_base_counts()
-            patches = None
-            if self._state is not None:
-                if (np.array_equal(cd_sg, self._mirror["cd_sg"])
-                        and np.array_equal(cd_asg, self._mirror["cd_asg"])):
-                    patches = self._diff_patches(sorted(dirty))
-            needs_refresh = self._state is None or patches is None
-            needs_patch = patches is not None and len(patches[0]) > 0
+            if skip_sync and not static_changed:
+                patches = (np.empty(0, np.int32),
+                           np.empty((0, self._spec.f_patch), np.float32))
+                needs_refresh = needs_patch = False
+            else:
+                cd_sg, cd_asg = self.tensors.domain_base_counts()
+                patches = None
+                if self._state is not None:
+                    if (np.array_equal(cd_sg, self._mirror["cd_sg"])
+                            and np.array_equal(cd_asg, self._mirror["cd_asg"])):
+                        patches = self._diff_patches(sorted(dirty))
+                needs_refresh = self._state is None or patches is None
+                needs_patch = patches is not None and len(patches[0]) > 0
             if inflight and (static_changed or needs_refresh or needs_patch):
                 self._carry_dirty = dirty
                 self.stats["flush_first"] += 1
@@ -467,6 +503,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 self._sync_mirror_rows(patches[0])
             self._carry_dirty = set()
             self.stats["patched_rows"] += len(patches[0])
+            self.stats["epoch_skips"] = self.stats.get("epoch_skips", 0) + (
+                1 if skip_sync else 0)
 
             import jax.numpy as jnp
             n = len(pod_infos)

@@ -511,36 +511,82 @@ class ClusterTensors:
 
 @dataclass
 class PodBatch:
-    """Encoded pod-side tensors for one batch (P = p_cap, padded)."""
+    """Encoded pod-side tensors for one batch (P = p_cap, padded).
+
+    Constraint-side fields are LAZY: None means "all zeros / -1" (the
+    field was never touched by any pod in the batch).  A 16k-pod plain
+    batch otherwise allocates ~100 MB of dense zeros per dispatch —
+    sel_any alone is [P, G, L] f32 — which measured as the single
+    biggest host cost of the batch path.  `ensure()` materializes a
+    field on first write; consumers treat None as zeros (pack: plain
+    spec never reads them; full spec materializes; _needs_full /
+    _replay: None-aware)."""
 
     p_cap: int
     req: np.ndarray            # f32[P, R]
     req_nz: np.ndarray         # f32[P, R]  (non-zero defaults, for scoring)
     p_valid: np.ndarray        # bool[P]
     untol_hard: np.ndarray     # f32[P, T]  1 = taint t blocks this pod
-    untol_prefer: np.ndarray   # f32[P, T]  1 = PreferNoSchedule taint not tolerated
-    sel_any: np.ndarray        # f32[P, G, L] any-of label groups
-    sel_any_active: np.ndarray  # f32[P, G]
-    sel_forb: np.ndarray       # f32[P, L]  forbidden label ids (NotIn)
-    key_any: np.ndarray        # f32[P, KG, KL] Exists groups
-    key_any_active: np.ndarray  # f32[P, KG]
-    key_forb: np.ndarray       # f32[P, KL] DoesNotExist
-    ports: np.ndarray          # f32[P, PT] requested host ports
-    node_row: np.ndarray       # i32[P] pinned node row (spec.nodeName) or -1
-    c_kind: np.ndarray         # i32[P, C]
-    c_sg: np.ndarray           # i32[P, C] selector-group index
-    c_maxskew: np.ndarray      # f32[P, C]
-    c_selfmatch: np.ndarray    # f32[P, C]
-    c_weight: np.ndarray       # f32[P, C] (preferred terms; signed)
-    inc_sg: np.ndarray         # f32[P, SG]  assigning pod p bumps sg counts
-    inc_asg: np.ndarray        # f32[P, ASG] pod carries this anti group
-    match_asg: np.ndarray      # f32[P, ASG] pod's labels match this anti group
+    untol_prefer: np.ndarray = None   # f32[P, T]  PreferNoSchedule not tolerated
+    sel_any: np.ndarray = None        # f32[P, G, L] any-of label groups
+    sel_any_active: np.ndarray = None  # f32[P, G]
+    sel_forb: np.ndarray = None       # f32[P, L]  forbidden label ids (NotIn)
+    key_any: np.ndarray = None        # f32[P, KG, KL] Exists groups
+    key_any_active: np.ndarray = None  # f32[P, KG]
+    key_forb: np.ndarray = None       # f32[P, KL] DoesNotExist
+    ports: np.ndarray = None          # f32[P, PT] requested host ports
+    node_row: np.ndarray = None       # i32[P] pinned node row or -1 (None = all -1)
+    c_kind: np.ndarray = None         # i32[P, C]
+    c_sg: np.ndarray = None           # i32[P, C] selector-group index
+    c_maxskew: np.ndarray = None      # f32[P, C]
+    c_selfmatch: np.ndarray = None    # f32[P, C]
+    c_weight: np.ndarray = None       # f32[P, C] (preferred terms; signed)
+    inc_sg: np.ndarray = None         # f32[P, SG]  assigning pod bumps sg counts
+    inc_asg: np.ndarray = None        # f32[P, ASG] pod carries this anti group
+    match_asg: np.ndarray = None      # f32[P, ASG] pod labels match anti group
     # id-based duals of the dense selector arrays (for packed transport;
     # -1 padded; see models/assign.PackSpec)
     sel_ids: np.ndarray = None        # i32[P, G, 8]
     sel_forb_ids: np.ndarray = None   # i32[P, 8]
     key_ids: np.ndarray = None        # i32[P, KG, 4]
     escape: list[int] = field(default_factory=list)  # batch positions for oracle path
+
+    _SHAPES = None  # caps-dependent; filled by shapes()
+
+    def shapes(self, caps: "Caps") -> dict:
+        c, P = caps, self.p_cap
+        return {
+            "untol_prefer": ((P, c.t_cap), np.float32, 0.0),
+            "sel_any": ((P, c.g_cap, c.l_cap), np.float32, 0.0),
+            "sel_any_active": ((P, c.g_cap), np.float32, 0.0),
+            "sel_forb": ((P, c.l_cap), np.float32, 0.0),
+            "key_any": ((P, c.kg_cap, c.kl_cap), np.float32, 0.0),
+            "key_any_active": ((P, c.kg_cap), np.float32, 0.0),
+            "key_forb": ((P, c.kl_cap), np.float32, 0.0),
+            "ports": ((P, c.pt_cap), np.float32, 0.0),
+            "node_row": ((P,), np.int32, -1),
+            "c_kind": ((P, c.c_cap), np.int32, 0),
+            "c_sg": ((P, c.c_cap), np.int32, -1),
+            "c_maxskew": ((P, c.c_cap), np.float32, 0.0),
+            "c_selfmatch": ((P, c.c_cap), np.float32, 0.0),
+            "c_weight": ((P, c.c_cap), np.float32, 0.0),
+            "inc_sg": ((P, c.sg_cap), np.float32, 0.0),
+            "inc_asg": ((P, c.asg_cap), np.float32, 0.0),
+            "match_asg": ((P, c.asg_cap), np.float32, 0.0),
+            "sel_ids": ((P, c.g_cap, 8), np.int32, -1),
+            "sel_forb_ids": ((P, 8), np.int32, -1),
+            "key_ids": ((P, c.kg_cap, 4), np.int32, -1),
+        }
+
+    def ensure(self, caps: "Caps", name: str) -> np.ndarray:
+        """Materialize a lazy field (None -> its zero/-1-filled array)."""
+        arr = getattr(self, name)
+        if arr is None:
+            shape, dtype, fill = self.shapes(caps)[name]
+            arr = (np.zeros(shape, dtype) if fill == 0.0
+                   else np.full(shape, fill, dtype))
+            setattr(self, name, arr)
+        return arr
 
 
 def slice_pod_batch(batch: "PodBatch", lo: int, hi: int,
@@ -563,7 +609,8 @@ def slice_pod_batch(batch: "PodBatch", lo: int, hi: int,
         out = np.zeros((p_cap,) + arr.shape[1:], arr.dtype)
         out[:n] = arr[lo:hi]
         fields[f.name] = out
-    fields["node_row"][n:] = -1
+    if fields.get("node_row") is not None:
+        fields["node_row"][n:] = -1
     fields["escape"] = [e - lo for e in batch.escape if lo <= e < hi]
     return PodBatch(p_cap=p_cap, **fields)
 
@@ -584,26 +631,6 @@ class BatchEncoder:
             req_nz=np.zeros((P, c.r), np.float32),
             p_valid=np.zeros(P, bool),
             untol_hard=np.zeros((P, c.t_cap), np.float32),
-            untol_prefer=np.zeros((P, c.t_cap), np.float32),
-            sel_any=np.zeros((P, c.g_cap, c.l_cap), np.float32),
-            sel_any_active=np.zeros((P, c.g_cap), np.float32),
-            sel_forb=np.zeros((P, c.l_cap), np.float32),
-            key_any=np.zeros((P, c.kg_cap, c.kl_cap), np.float32),
-            key_any_active=np.zeros((P, c.kg_cap), np.float32),
-            key_forb=np.zeros((P, c.kl_cap), np.float32),
-            ports=np.zeros((P, c.pt_cap), np.float32),
-            node_row=np.full(P, -1, np.int32),
-            c_kind=np.zeros((P, c.c_cap), np.int32),
-            c_sg=np.full((P, c.c_cap), -1, np.int32),
-            c_maxskew=np.zeros((P, c.c_cap), np.float32),
-            c_selfmatch=np.zeros((P, c.c_cap), np.float32),
-            c_weight=np.zeros((P, c.c_cap), np.float32),
-            inc_sg=np.zeros((P, c.sg_cap), np.float32),
-            inc_asg=np.zeros((P, c.asg_cap), np.float32),
-            match_asg=np.zeros((P, c.asg_cap), np.float32),
-            sel_ids=np.full((P, c.g_cap, 8), -1, np.int32),
-            sel_forb_ids=np.full((P, 8), -1, np.int32),
-            key_ids=np.full((P, c.kg_cap, 4), -1, np.int32),
         )
         pods = pod_infos[:P]
         n = len(pods)
@@ -618,7 +645,37 @@ class BatchEncoder:
             b.req_nz[:n, 1] = [pi.request_nonzero.memory for pi in pods]
             b.req_nz[:n, 2] = [pi.request_nonzero.ephemeral_storage
                                for pi in pods]
+        # plain fast path: a pod with no selectors/affinity/constraints/
+        # ports/pins/scalars needs NO per-field writes beyond the bulk
+        # request columns above — p_valid plus (when the taint vocab is
+        # non-empty and the pod carries no tolerations) one precomputed
+        # untolerated row.  This skips _encode_pod entirely for the
+        # dominant workload shape (~10µs/pod at bench scale).
+        taint_items = t.taint_vocab.items
+        if taint_items:
+            base_hard = np.zeros(c.t_cap, np.float32)
+            base_prefer = np.zeros(c.t_cap, np.float32)
+            for tid, (_k, _v, effect) in enumerate(taint_items):
+                if effect in ("NoSchedule", "NoExecute"):
+                    base_hard[tid] = 1.0
+                elif effect == "PreferNoSchedule":
+                    base_prefer[tid] = 1.0
+            any_prefer = bool(base_prefer.any())
+        is_plain = self._is_plain
         for i, pi in enumerate(pods):
+            if is_plain(pi):
+                b.p_valid[i] = True
+                if taint_items and not pi.tolerations:
+                    b.untol_hard[i] = base_hard
+                    if any_prefer:
+                        b.ensure(c, "untol_prefer")[i] = base_prefer
+                    continue
+                elif not taint_items:
+                    continue
+                # plain pod WITH tolerations vs a live taint vocab:
+                # only the taint section of the slow path applies
+                self._encode_taints(b, i, pi)
+                continue
             try:
                 ok = self._encode_pod(b, i, pi)
             except VocabFullError:
@@ -628,21 +685,64 @@ class BatchEncoder:
             else:
                 b.escape.append(i)
         # cross-pod: inc/match rows vs ALL registered groups
-        for i, pi in enumerate(pod_infos[:P]):
-            if not b.p_valid[i]:
-                continue
-            for sg_idx, sg in enumerate(t.sgs):
-                if sg.matches_pod(pi):
-                    b.inc_sg[i, sg_idx] = 1.0
-            for asg_idx, asg in enumerate(t.asgs):
-                if asg.matches_pod(pi):
-                    b.match_asg[i, asg_idx] = 1.0
-                for term in pi.required_anti_affinity_terms:
-                    if (term.topology_key == asg.topology_key
-                            and term.selector == asg.selector
-                            and term.namespaces == asg.namespaces):
-                        b.inc_asg[i, asg_idx] += 1.0
+        if t.sgs or t.asgs:
+            inc_sg = b.ensure(c, "inc_sg") if t.sgs else None
+            match_asg = b.ensure(c, "match_asg") if t.asgs else None
+            inc_asg = b.ensure(c, "inc_asg") if t.asgs else None
+            for i, pi in enumerate(pods):
+                if not b.p_valid[i]:
+                    continue
+                for sg_idx, sg in enumerate(t.sgs):
+                    if sg.matches_pod(pi):
+                        inc_sg[i, sg_idx] = 1.0
+                for asg_idx, asg in enumerate(t.asgs):
+                    if asg.matches_pod(pi):
+                        match_asg[i, asg_idx] = 1.0
+                    for term in pi.required_anti_affinity_terms:
+                        if (term.topology_key == asg.topology_key
+                                and term.selector == asg.selector
+                                and term.namespaces == asg.namespaces):
+                            inc_asg[i, asg_idx] += 1.0
         return b
+
+    @staticmethod
+    def _is_plain(pi: PodInfo) -> bool:
+        """True when the pod touches none of the constraint-side fields
+        (the checks mirror _encode_pod's write sites exactly; a pod that
+        fails any check takes the slow path, so divergence is impossible
+        for plain=True pods)."""
+        if (pi.nominated_node_name or pi.node_selector
+                or pi.node_affinity_required or pi.node_affinity_preferred
+                or pi.required_affinity_terms or pi.required_anti_affinity_terms
+                or pi.preferred_affinity_terms
+                or pi.preferred_anti_affinity_terms
+                or pi.topology_spread_constraints or pi.host_ports
+                or pi.request.scalar or pi.request_nonzero.scalar):
+            return False
+        spec = pi.pod.get("spec") or {}
+        if spec.get("nodeName"):
+            return False
+        for v in spec.get("volumes") or ():
+            if (v.get("persistentVolumeClaim") or v.get("gcePersistentDisk")
+                    or v.get("awsElasticBlockStore") or v.get("azureDisk")
+                    or v.get("iscsi") or v.get("csi")):
+                return False
+        return True
+
+    def _encode_taints(self, b: PodBatch, i: int, pi: PodInfo) -> None:
+        """Taint section of the pod encode (shared by slow path and the
+        plain-with-tolerations case): mark every vocab taint this pod
+        does NOT tolerate."""
+        t, c = self.t, self.t.caps
+        for tid, (key, value, effect) in enumerate(t.taint_vocab.items):
+            taint = {"key": key, "value": value, "effect": effect}
+            tolerated = any(toleration_tolerates_taint(tol, taint)
+                            for tol in pi.tolerations)
+            if not tolerated:
+                if effect in ("NoSchedule", "NoExecute"):
+                    b.untol_hard[i, tid] = 1.0
+                elif effect == "PreferNoSchedule":
+                    b.ensure(c, "untol_prefer")[i, tid] = 1.0
 
     @staticmethod
     def _push_id(arr: np.ndarray, i: int, lid: int) -> bool:
@@ -677,15 +777,7 @@ class BatchEncoder:
                 b.req_nz[i, CORE_R + t.scalar_vocab.get(name)] = v
 
         # taints: mark every vocab taint this pod does NOT tolerate
-        for tid, (key, value, effect) in enumerate(t.taint_vocab.items):
-            taint = {"key": key, "value": value, "effect": effect}
-            tolerated = any(toleration_tolerates_taint(tol, taint)
-                            for tol in pi.tolerations)
-            if not tolerated:
-                if effect in ("NoSchedule", "NoExecute"):
-                    b.untol_hard[i, tid] = 1.0
-                elif effect == "PreferNoSchedule":
-                    b.untol_prefer[i, tid] = 1.0
+        self._encode_taints(b, i, pi)
 
         # spec.nodeName pin
         want = (pi.pod.get("spec") or {}).get("nodeName")
@@ -693,7 +785,7 @@ class BatchEncoder:
             row = t.row_of.get(want)
             if row is None:
                 return False
-            b.node_row[i] = row
+            b.ensure(c, "node_row")[i] = row
 
         # node selector + required node affinity -> any-of groups / forbidden
         groups: list[list[int]] = []
@@ -707,28 +799,38 @@ class BatchEncoder:
                 return False
         if len(groups) > c.g_cap or len(key_groups) > c.kg_cap:
             return False
-        for g, ids in enumerate(groups):
-            if len(ids) > b.sel_ids.shape[2]:
-                return False  # any-of group too wide for packed transport
-            b.sel_any_active[i, g] = 1.0
-            for v, lid in enumerate(ids):
-                b.sel_any[i, g, lid] = 1.0
-                b.sel_ids[i, g, v] = lid
-        for g, ids in enumerate(key_groups):
-            if len(ids) > b.key_ids.shape[2]:
-                return False
-            b.key_any_active[i, g] = 1.0
-            for v, kid in enumerate(ids):
-                b.key_any[i, g, kid] = 1.0
-                b.key_ids[i, g, v] = kid
+        if groups:
+            sel_ids = b.ensure(c, "sel_ids")
+            sel_any_active = b.ensure(c, "sel_any_active")
+            sel_any = b.ensure(c, "sel_any")
+            for g, ids in enumerate(groups):
+                if len(ids) > sel_ids.shape[2]:
+                    return False  # any-of group too wide for packed transport
+                sel_any_active[i, g] = 1.0
+                for v, lid in enumerate(ids):
+                    sel_any[i, g, lid] = 1.0
+                    sel_ids[i, g, v] = lid
+        if key_groups:
+            key_ids = b.ensure(c, "key_ids")
+            key_any_active = b.ensure(c, "key_any_active")
+            key_any = b.ensure(c, "key_any")
+            for g, ids in enumerate(key_groups):
+                if len(ids) > key_ids.shape[2]:
+                    return False
+                key_any_active[i, g] = 1.0
+                for v, kid in enumerate(ids):
+                    key_any[i, g, kid] = 1.0
+                    key_ids[i, g, v] = kid
         if pi.node_affinity_preferred:
             return False  # node-affinity scoring: oracle path (rare)
 
         # host ports
-        for proto, ip, port in pi.host_ports:
-            if ip not in ("0.0.0.0", "", None):
-                return False  # per-IP port semantics: oracle path
-            b.ports[i, t.port_vocab.get((proto, port))] = 1.0
+        if pi.host_ports:
+            ports = b.ensure(c, "ports")
+            for proto, ip, port in pi.host_ports:
+                if ip not in ("0.0.0.0", "", None):
+                    return False  # per-IP port semantics: oracle path
+                ports[i, t.port_vocab.get((proto, port))] = 1.0
 
         # constraints
         ci = 0
@@ -737,11 +839,11 @@ class BatchEncoder:
             nonlocal ci
             if ci >= c.c_cap or sg_idx is None:
                 raise VocabFullError("constraint capacity")
-            b.c_kind[i, ci] = kind
-            b.c_sg[i, ci] = sg_idx
-            b.c_maxskew[i, ci] = maxskew
-            b.c_selfmatch[i, ci] = selfmatch
-            b.c_weight[i, ci] = weight
+            b.ensure(c, "c_kind")[i, ci] = kind
+            b.ensure(c, "c_sg")[i, ci] = sg_idx
+            b.ensure(c, "c_maxskew")[i, ci] = maxskew
+            b.ensure(c, "c_selfmatch")[i, ci] = selfmatch
+            b.ensure(c, "c_weight")[i, ci] = weight
             ci += 1
 
         ns = meta.namespace(pi.pod)
@@ -794,12 +896,14 @@ class BatchEncoder:
                 elif req.operator == NOT_IN:
                     for v in req.values:
                         lid = t.ensure_label_id((req.key, v))
-                        b.sel_forb[i, lid] = 1.0
-                        if not self._push_id(b.sel_forb_ids, i, lid):
+                        b.ensure(t.caps, "sel_forb")[i, lid] = 1.0
+                        if not self._push_id(b.ensure(t.caps, "sel_forb_ids"),
+                                             i, lid):
                             return False
                 elif req.operator == DOES_NOT_EXIST:
                     # key_forb travels as a dense bitmask; no id list needed
-                    b.key_forb[i, t.ensure_key_id(req.key)] = 1.0
+                    b.ensure(t.caps, "key_forb")[
+                        i, t.ensure_key_id(req.key)] = 1.0
                 else:  # Gt/Lt
                     return False
             return True

@@ -77,6 +77,13 @@ class Cache:
         self._pod_states: dict[str, _PodState] = {}
         self._assumed_pods: set[str] = set()
         self._ttl = ttl  # 0 = assumed pods never expire (reference default, scheduler.go:54)
+        # Bumped on every mutation the TPU batch backend does NOT already
+        # know about (everything except bulk batch-assume, the matching
+        # confirm fast path, and finish_binding).  The backend's host
+        # mirror replays its own batches' commits, so when this epoch is
+        # unchanged between dispatches the whole node re-encode + mirror
+        # diff is provably a no-op and is skipped (ops/backend.py).
+        self.mutation_epoch = 0
 
     # -- pods ------------------------------------------------------------
 
@@ -92,6 +99,7 @@ class Cache:
         with self._lock:
             if key in self._pod_states:
                 raise ValueError(f"pod {key} already in cache")
+            self.mutation_epoch += 1
             self._add_pod_to_node(pod)
             ps = _PodState(pod, assumed=True)
             self._pod_states[key] = ps
@@ -152,6 +160,7 @@ class Cache:
                 return
             if not ps.assumed:
                 raise ValueError(f"pod {key} is not assumed; cannot forget")
+            self.mutation_epoch += 1
             self._remove_pod_from_node(ps.pod)
             del self._pod_states[key]
             self._assumed_pods.discard(key)
@@ -165,15 +174,18 @@ class Cache:
                 # confirmation of an assumed pod
                 if meta.pod_node_name(ps.pod) != meta.pod_node_name(pod):
                     # scheduled somewhere else than assumed: fix up
+                    self.mutation_epoch += 1
                     self._remove_pod_from_node(ps.pod)
                     self._add_pod_to_node(pod)
                 self._pod_states[key] = _PodState(pod)
                 self._assumed_pods.discard(key)
             elif ps is None:
+                self.mutation_epoch += 1
                 self._add_pod_to_node(pod)
                 self._pod_states[key] = _PodState(pod)
             else:
                 # duplicate add — treat as update
+                self.mutation_epoch += 1
                 self._remove_pod_from_node(ps.pod)
                 self._add_pod_to_node(pod)
                 self._pod_states[key] = _PodState(pod)
@@ -201,6 +213,7 @@ class Cache:
             if ps is None:
                 self.add_pod(new)
                 return
+            self.mutation_epoch += 1
             self._remove_pod_from_node(ps.pod)
             self._add_pod_to_node(new)
             self._pod_states[key] = _PodState(new)
@@ -212,6 +225,7 @@ class Cache:
             ps = self._pod_states.get(key)
             if ps is None:
                 return
+            self.mutation_epoch += 1
             self._remove_pod_from_node(ps.pod)
             del self._pod_states[key]
             self._assumed_pods.discard(key)
@@ -253,6 +267,7 @@ class Cache:
     def add_node(self, node: Obj) -> None:
         name = meta.name(node)
         with self._lock:
+            self.mutation_epoch += 1
             ni = self._nodes.get(name)
             if ni is None:
                 ni = self._nodes[name] = NodeInfo()
@@ -267,6 +282,7 @@ class Cache:
             ni = self._nodes.get(name)
             if ni is None:
                 return
+            self.mutation_epoch += 1
             if ni.pods:
                 # keep NodeInfo for remaining (possibly assumed) pods
                 ni.node = None
@@ -356,6 +372,12 @@ class CacheFlattenView:
 
     def __init__(self, cache: Cache):
         self._cache = cache
+
+    def epoch(self) -> int:
+        """The cache's external-mutation epoch (int read; GIL-atomic).
+        Unchanged epoch == every change since the last read came from the
+        batch backend's own assume/confirm lifecycle."""
+        return self._cache.mutation_epoch
 
     def run_locked(self, fn):
         c = self._cache

@@ -22,6 +22,7 @@ import heapq
 import itertools
 import threading
 import time
+from collections import deque
 from typing import Callable, Iterable
 
 from ..api import meta
@@ -80,6 +81,76 @@ class _Heap:
         return list(self._entries.values())
 
 
+class _BucketQueue:
+    """Priority-bucketed FIFO active queue — the fast replacement for
+    _Heap when the queue-sort is priority-FIFO shaped (PrioritySort /
+    default sort): one deque per distinct priority, entries dict for lazy
+    deletion.  push/pop are O(1) dict+deque ops instead of O(log n)
+    heap churn with a key_fn call per push (~8µs/pod saved at bench
+    scale; almost all pods share one priority).
+
+    Ordering note: within a priority the order is INSERTION order.  For
+    fresh adds that equals the timestamp order the heap used; a pod
+    re-activated from backoff/unschedulable joins at the tail instead of
+    jumping ahead of fresher pods by its older park timestamp — the
+    reference's activeQ refreshes Timestamp on requeue, which makes
+    insertion order the faithful equivalent."""
+
+    def __init__(self) -> None:
+        self._buckets: dict[int, deque] = {}
+        self._prios: list[int] = []  # heap of active -priority values
+        self._entries: dict[str, QueuedPodInfo] = {}
+
+    def push(self, qpi: QueuedPodInfo) -> None:
+        self._entries[qpi.key] = qpi
+        p = -qpi.pod_info.priority
+        d = self._buckets.get(p)
+        if d is None:
+            d = self._buckets[p] = deque()
+            heapq.heappush(self._prios, p)
+        d.append(qpi)
+
+    def pop(self) -> QueuedPodInfo | None:
+        entries = self._entries
+        while self._prios:
+            p = self._prios[0]
+            d = self._buckets[p]
+            while d:
+                qpi = d.popleft()
+                if entries.get(qpi.key) is qpi:
+                    del entries[qpi.key]
+                    return qpi
+            heapq.heappop(self._prios)
+            del self._buckets[p]
+        return None
+
+    def peek(self) -> QueuedPodInfo | None:
+        entries = self._entries
+        while self._prios:
+            p = self._prios[0]
+            d = self._buckets[p]
+            while d:
+                qpi = d[0]
+                if entries.get(qpi.key) is qpi:
+                    return qpi
+                d.popleft()
+            heapq.heappop(self._prios)
+            del self._buckets[p]
+        return None
+
+    def remove(self, key: str) -> QueuedPodInfo | None:
+        return self._entries.pop(key, None)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def items(self) -> list[QueuedPodInfo]:
+        return list(self._entries.values())
+
+
 class PodNominator:
     """Nominated-pod bookkeeping (scheduling_queue.go nominator)."""
 
@@ -99,6 +170,9 @@ class PodNominator:
 
     def delete_nominated_pod_if_exists(self, pod: Obj) -> None:
         key = meta.namespaced_name(pod)
+        if key not in self._pod_to_node:
+            return  # lock-free precheck: dict reads are GIL-atomic and the
+            # hot caller (bulk bind-confirm delete) never nominated the pod
         with self._lock:
             node = self._pod_to_node.pop(key, None)
             if node:
@@ -123,10 +197,15 @@ class SchedulingQueue:
         pod_max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
         unschedulable_timeout: float = DEFAULT_UNSCHEDULABLE_TIMEOUT,
         cluster_event_map: dict[str, list[ClusterEvent]] | None = None,
+        priority_fifo: bool | None = None,
     ):
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
-        self._active = _Heap(sort_key)
+        # priority-FIFO-shaped sorts (the default + PrioritySort) take the
+        # O(1) bucket queue; a custom QueueSort keeps the generic heap
+        if priority_fifo is None:
+            priority_fifo = sort_key is default_sort_key
+        self._active = _BucketQueue() if priority_fifo else _Heap(sort_key)
         self._backoff = _Heap(lambda q: (self._backoff_expiry(q),))
         self._unschedulable: dict[str, QueuedPodInfo] = {}
         self._initial_backoff = pod_initial_backoff

@@ -198,11 +198,14 @@ class Scheduler:
         for p in profiles.values():
             event_map.update(p.framework.cluster_event_map())
         default_fw = next(iter(profiles.values())).framework
-        sort_key = (default_fw.queue_sort.sort_key if default_fw.queue_sort
-                    else None)
+        qs = default_fw.queue_sort
+        sort_key = qs.sort_key if qs else None
         self.queue = SchedulingQueue(
             sort_key=sort_key or (lambda q: (-q.pod_info.priority, q.timestamp)),
-            cluster_event_map=event_map)
+            cluster_event_map=event_map,
+            # PrioritySort (and the default key) are priority-FIFO shaped:
+            # the bucket queue implements them exactly (queue.py)
+            priority_fifo=qs is None or getattr(qs, "priority_fifo", False))
         for prof_name, p in profiles.items():
             p.framework.metrics_recorder = (
                 lambda point, status, sec, _n=prof_name:
