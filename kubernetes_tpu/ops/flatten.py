@@ -269,32 +269,36 @@ class ClusterTensors:
         cache view (scheduler/cache.py CacheFlattenView): views run the
         whole re-encode under the cache lock so rows are never encoded
         from a NodeInfo mid-mutation, and skip the per-dirty-node clone
-        the Snapshot path pays."""
+        the Snapshot path pays.  Views that can feed the changed-node
+        delta (run_locked_dirty) skip the O(nodes) membership scan too."""
+        run_dirty = getattr(snapshot, "run_locked_dirty", None)
+        if run_dirty is not None:
+            return run_dirty(self._update_from_dirty)
         run_locked = getattr(snapshot, "run_locked", None)
         if run_locked is not None:
             return run_locked(self._update_from_nodes_tracked)
         return self._update_from_nodes_tracked(snapshot.node_info_list)
 
-    def _update_from_nodes_tracked(self, node_info_list) -> list[int]:
+    def _sync_rows(self, named_infos) -> list[int]:
+        """Re-encode every (name, NodeInfo) whose generation advanced;
+        returns the touched rows.  Bind-only dirt (node_generation
+        unchanged, no ports/scalars/selector groups) takes a BULK columnar
+        re-encode: at bench shapes every batch dirties one row per bound
+        pod, and the per-row _encode_node costs ~30µs x 16k rows."""
         dirty: list[int] = []
-        live = set()
-        # bind-only dirt (node_generation unchanged, no ports/scalars/
-        # selector groups) takes a BULK columnar re-encode: at bench
-        # shapes every batch dirties one row per bound pod, and the
-        # per-row _encode_node costs ~30µs x 16k rows per dispatch
         bulk: list = []  # (row, ni) pairs eligible for the columnar path
         bulk_ok = not self.sgs and not self.asgs
-        for ni in node_info_list:
-            live.add(ni.name)
-            row = self.row_of.get(ni.name)
+        row_of, gen = self.row_of, self.gen
+        for name, ni in named_infos:
+            row = row_of.get(name)
             if row is None:
                 if not self._free:
                     raise VocabFullError(
                         f"node capacity {self.caps.n_cap} exceeded")
                 row = self._free.pop()
-                self.row_of[ni.name] = row
-                self.gen[row] = -1
-            if self.gen[row] != ni.generation:
+                row_of[name] = row
+                gen[row] = -1
+            if gen[row] != ni.generation:
                 if (bulk_ok and self.valid[row]
                         and self.node_gen[row] == ni.node_generation
                         and not ni.used_ports
@@ -302,20 +306,44 @@ class ClusterTensors:
                     bulk.append((row, ni))
                 else:
                     self._encode_node(row, ni)
-                self.gen[row] = ni.generation
+                gen[row] = ni.generation
                 dirty.append(row)
         if bulk:
             self._encode_dynamic_bulk(bulk)
+        return dirty
+
+    def _release_row(self, name: str) -> int | None:
+        row = self.row_of.pop(name, None)
+        if row is None:
+            return None
+        self.valid[row] = False
+        self.node_infos[row] = None
+        self.node_gen[row] = -1
+        self._free.append(row)
+        self.static_version += 1
+        self.static_dirty_rows.add(row)
+        return row
+
+    def _update_from_dirty(self, pairs, removed_names) -> list[int]:
+        """Incremental sync from a changed-node delta (CacheFlattenView.
+        run_locked_dirty): O(changed) instead of O(nodes)."""
+        dirty = self._sync_rows(pairs)
+        for name in removed_names:
+            row = self._release_row(name)
+            if row is not None:
+                dirty.append(row)
+        if dirty:
+            self.version += 1
+        return dirty
+
+    def _update_from_nodes_tracked(self, node_info_list) -> list[int]:
+        dirty = self._sync_rows((ni.name, ni) for ni in node_info_list)
+        live = {ni.name for ni in node_info_list}
         for name in list(self.row_of):
             if name not in live:
-                row = self.row_of.pop(name)
-                self.valid[row] = False
-                self.node_infos[row] = None
-                self.node_gen[row] = -1
-                self._free.append(row)
-                self.static_version += 1
-                self.static_dirty_rows.add(row)
-                dirty.append(row)
+                row = self._release_row(name)
+                if row is not None:
+                    dirty.append(row)
         if dirty:
             self.version += 1
         return dirty
