@@ -145,11 +145,16 @@ def _static_mask_and_score(node: dict, pod: dict, comm: _Comm, offset,
       static_score- PreferNoSchedule taint score contribution (0..100)
     """
     valid = node["valid"][None, :]                        # [1,N]
-    label = node["label_mask"]                            # [N,L]
-    keym = node["key_mask"]                               # [N,KL]
+    N = node["valid"].shape[0]
     P = pod["req"].shape[0]
 
     if "selectors" in features:
+        # label/key masks exist in the node dict ONLY for the selector-
+        # carrying (full) variant — the plain variant's static pytree
+        # omits them so ~140 MB of masks never ship to device at 100k
+        # nodes (ops/backend.py _upload_static split)
+        label = node["label_mask"]                        # [N,L]
+        keym = node["key_mask"]                           # [N,KL]
         hits = jnp.einsum("pgl,nl->pgn", pod["sel_any"], label)
         group_ok = (hits > 0) | (pod["sel_any_active"][:, :, None] == 0)
         sel_ok = jnp.all(group_ok, axis=1)                # [P,N]
@@ -160,12 +165,12 @@ def _static_mask_and_score(node: dict, pod: dict, comm: _Comm, offset,
         sel_ok &= (pod["key_forb"] @ keym.T) == 0         # DoesNotExist
         sel_mask = sel_ok & valid
     else:
-        sel_mask = jnp.broadcast_to(valid, (P, label.shape[0]))
+        sel_mask = jnp.broadcast_to(valid, (P, N))
 
     hard = (pod["untol_hard"] @ node["taint_mask"].T) == 0
     static_mask = sel_mask & hard
     if "pin" in features:
-        n_idx = offset + jnp.arange(label.shape[0])[None, :]
+        n_idx = offset + jnp.arange(N)[None, :]
         pin = ((pod["node_row"][:, None] < 0)
                | (n_idx == pod["node_row"][:, None]))
         static_mask = static_mask & pin
@@ -242,7 +247,9 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
         h = jnp.sin(pp[:, None] * 12.9898 + gn[None, :] * 78.233) * 43758.5453
         noise = (h - jnp.floor(h)) * TIE_NOISE
         alloc = node["alloc"]
-        dom_sg, dom_asg = node["dom_sg"], node["dom_asg"]
+        # absent in the plain variant's static pytree (only f_cons/f_asg
+        # blocks read them; those elide when the features are off)
+        dom_sg, dom_asg = node.get("dom_sg"), node.get("dom_asg")
         req, req_nz = pod["req"], pod["req_nz"]
         earlier = jnp.tril(jnp.ones((P, P), jnp.float32), k=-1)  # q<p
         p_iota = jnp.arange(P)

@@ -56,20 +56,30 @@ DYN_FIELDS = ("used", "used_nz", "npods", "port_mask")
 _static_patch_jit = None
 
 
-def _apply_static_patch(static, rows, alloc_v, maxpods_v, valid_v,
-                        taint_v, label_v, key_v, dom_sg_v, dom_asg_v):
-    """Row-wise scatter into the RESIDENT static arrays, so a handful of
-    changed nodes costs a few KB of transfer instead of a full ~150 MB
+# static array split: the selector-side arrays (label/key masks + topology
+# domains) are read ONLY by the constraint-carrying kernel variant — at
+# 100k nodes they are ~140 MB of the ~160 MB static payload, so the plain
+# path never ships them (models/assign._static_mask_and_score reads them
+# behind the "selectors" feature gate)
+STATIC_CORE = ("alloc", "maxpods", "valid", "taint_mask")
+STATIC_SEL = ("label_mask", "key_mask", "dom_sg", "dom_asg")
+
+_core_patch_jit = None
+_sel_patch_jit = None
+
+
+def _apply_static_patch(static, rows, alloc_v, maxpods_v, valid_v, taint_v):
+    """Row-wise scatter into the RESIDENT core static arrays, so a handful
+    of changed nodes costs a few KB of transfer instead of a full
     re-upload.  rows are padded with -1; the jitted scatter is built once
     (shapes vary only in the padded row count, by powers of two)."""
-    global _static_patch_jit
-    if _static_patch_jit is None:
+    global _core_patch_jit
+    if _core_patch_jit is None:
         import jax
         import jax.numpy as jnp
 
         @jax.jit
-        def go(static, rows, alloc_v, maxpods_v, valid_v, taint_v,
-               label_v, key_v, dom_sg_v, dom_asg_v):
+        def go(static, rows, alloc_v, maxpods_v, valid_v, taint_v):
             n = static["alloc"].shape[0]
             # padding scatters to an OUT-OF-BOUNDS sentinel and is dropped.
             # Do NOT route padding to a masked write of row 0: if row 0 is
@@ -85,17 +95,36 @@ def _apply_static_patch(static, rows, alloc_v, maxpods_v, valid_v,
             out["maxpods"] = put(static["maxpods"], maxpods_v)
             out["valid"] = put(static["valid"], valid_v)
             out["taint_mask"] = put(static["taint_mask"], taint_v)
-            out["label_mask"] = put(static["label_mask"], label_v)
-            out["key_mask"] = put(static["key_mask"], key_v)
-            out["dom_sg"] = static["dom_sg"].at[:, li].set(
-                dom_sg_v, mode="drop")
-            out["dom_asg"] = static["dom_asg"].at[:, li].set(
+            return out
+
+        _core_patch_jit = go
+    return _core_patch_jit(static, rows, alloc_v, maxpods_v, valid_v,
+                           taint_v)
+
+
+def _apply_sel_patch(sel, rows, label_v, key_v, dom_sg_v, dom_asg_v):
+    """Row-wise scatter for the selector-side static arrays (same padding
+    contract as _apply_static_patch)."""
+    global _sel_patch_jit
+    if _sel_patch_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def go(sel, rows, label_v, key_v, dom_sg_v, dom_asg_v):
+            n = sel["label_mask"].shape[0]
+            li = jnp.where(rows >= 0, rows, n)
+            out = dict(sel)
+            out["label_mask"] = sel["label_mask"].at[li].set(
+                label_v, mode="drop")
+            out["key_mask"] = sel["key_mask"].at[li].set(key_v, mode="drop")
+            out["dom_sg"] = sel["dom_sg"].at[:, li].set(dom_sg_v, mode="drop")
+            out["dom_asg"] = sel["dom_asg"].at[:, li].set(
                 dom_asg_v, mode="drop")
             return out
 
-        _static_patch_jit = go
-    return _static_patch_jit(static, rows, alloc_v, maxpods_v, valid_v,
-                             taint_v, label_v, key_v, dom_sg_v, dom_asg_v)
+        _sel_patch_jit = go
+    return _sel_patch_jit(sel, rows, label_v, key_v, dom_sg_v, dom_asg_v)
 
 
 # dispatch() sentinel: an earlier batch is still in flight and this batch
@@ -282,6 +311,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         self._fn_full = None   # built lazily / in warmup
         self._spec_full = None
         self._spec_plain = None
+        self._static_sel = None   # selector-side static arrays (lazy)
+        self._sel_stale = True
         self._spec = PackSpec(self.caps, batch_size, k_cap)
         self._f_patch = self._spec.f_patch
         self._weights = weights
@@ -342,10 +373,25 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         _full_refresh) to ship the same byte payloads to a worker process
         (the north star's scheduler<->JAX-worker shim boundary)."""
         import jax.numpy as jnp
-        fn = self._fn_full if variant == "full" else self._fn_plain
-        self._state, rd = fn(self._state, self._static_node,
-                             jnp.asarray(buf))
+        if variant == "full":
+            self._ensure_sel()
+            fn = self._fn_full
+            static = {**self._static_node, **self._static_sel}
+        else:
+            fn = self._fn_plain
+            static = self._static_node
+        self._state, rd = fn(self._state, static, jnp.asarray(buf))
         return rd
+
+    def _ensure_sel(self) -> None:
+        """Upload the selector-side static arrays if missing/stale (lazy:
+        only the full kernel variant reads them)."""
+        if self._static_sel is None or self._sel_stale:
+            import jax.numpy as jnp
+            t = self.tensors
+            self._static_sel = {k: jnp.asarray(getattr(t, k))
+                                for k in STATIC_SEL}
+            self._sel_stale = False
 
     def _ensure_full(self):
         if self._fn_full is None:
@@ -367,29 +413,28 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
 
         Full upload only when forced (first upload, vocab column
         backfills, or very many dirty rows); otherwise a row-wise scatter
-        on the RESIDENT static arrays (donated) — at 100k nodes the full
-        label/key masks are ~150 MB and were being re-shipped every time
-        a late node registration bumped static_version (measured ~240 ms
-        per batch in the 100k bench)."""
+        on the RESIDENT static arrays (donated).  The selector-side
+        arrays (STATIC_SEL) update lazily: when they are not resident
+        they are only marked stale — at 100k nodes they are ~140 MB that
+        the plain variant never reads."""
         import jax.numpy as jnp
         t = self.tensors
         rows = t.static_dirty_rows
         # patch only when clearly cheaper than re-shipping the arrays: a
         # registration flood (rows ~ n_cap) wants the single full upload,
         # steady-state drift (a handful of rows) wants the tiny scatter
-        if (self._static_node is None or t.static_full
+        full = (self._static_node is None or t.static_full
                 or len(rows) > self.S_PATCH_MAX
-                or len(rows) * 8 > self.caps.n_cap):
-            self._static_node = {
-                "alloc": jnp.asarray(t.alloc),
-                "maxpods": jnp.asarray(t.maxpods),
-                "valid": jnp.asarray(t.valid),
-                "taint_mask": jnp.asarray(t.taint_mask),
-                "label_mask": jnp.asarray(t.label_mask),
-                "key_mask": jnp.asarray(t.key_mask),
-                "dom_sg": jnp.asarray(t.dom_sg),
-                "dom_asg": jnp.asarray(t.dom_asg),
-            }
+                or len(rows) * 8 > self.caps.n_cap)
+        if full:
+            self._static_node = {k: jnp.asarray(getattr(t, k))
+                                 for k in STATIC_CORE}
+            if self._static_sel is not None:
+                self._static_sel = {k: jnp.asarray(getattr(t, k))
+                                    for k in STATIC_SEL}
+                self._sel_stale = False
+            else:
+                self._sel_stale = True
         elif rows:
             k = 256  # pad floor bounds the number of distinct jit shapes
             while k < len(rows):
@@ -397,15 +442,21 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             rows_a = np.full(k, -1, np.int32)
             rows_a[:len(rows)] = sorted(rows)
             safe = np.where(rows_a >= 0, rows_a, 0)
+            jrows = jnp.asarray(rows_a)
             self._static_node = _apply_static_patch(
-                self._static_node, jnp.asarray(rows_a),
+                self._static_node, jrows,
                 jnp.asarray(t.alloc[safe]), jnp.asarray(t.maxpods[safe]),
                 jnp.asarray(t.valid[safe]),
-                jnp.asarray(t.taint_mask[safe]),
-                jnp.asarray(t.label_mask[safe]),
-                jnp.asarray(t.key_mask[safe]),
-                jnp.asarray(t.dom_sg[:, safe]),
-                jnp.asarray(t.dom_asg[:, safe]))
+                jnp.asarray(t.taint_mask[safe]))
+            if self._static_sel is not None:
+                self._static_sel = _apply_sel_patch(
+                    self._static_sel, jrows,
+                    jnp.asarray(t.label_mask[safe]),
+                    jnp.asarray(t.key_mask[safe]),
+                    jnp.asarray(t.dom_sg[:, safe]),
+                    jnp.asarray(t.dom_asg[:, safe]))
+            else:
+                self._sel_stale = True
             self.stats["static_patched_rows"] = self.stats.get(
                 "static_patched_rows", 0) + len(rows)
         t.static_dirty_rows = set()

@@ -287,8 +287,9 @@ class ClusterTensors:
         pod, and the per-row _encode_node costs ~30µs x 16k rows."""
         dirty: list[int] = []
         bulk: list = []  # (row, ni) pairs eligible for the columnar path
+        fresh_bulk: list = []  # brand-new podless rows (creation floods)
         bulk_ok = not self.sgs and not self.asgs
-        row_of, gen = self.row_of, self.gen
+        row_of, gen, valid = self.row_of, self.gen, self.valid
         for name, ni in named_infos:
             row = row_of.get(name)
             if row is None:
@@ -299,18 +300,74 @@ class ClusterTensors:
                 row_of[name] = row
                 gen[row] = -1
             if gen[row] != ni.generation:
-                if (bulk_ok and self.valid[row]
+                if (bulk_ok and valid[row]
                         and self.node_gen[row] == ni.node_generation
                         and not ni.used_ports
                         and not ni.requested.scalar):
                     bulk.append((row, ni))
+                elif (bulk_ok and not valid[row] and ni.node is not None
+                        and not ni.pods and not ni.used_ports
+                        and not ni.allocatable.scalar):
+                    fresh_bulk.append((row, ni))
                 else:
                     self._encode_node(row, ni)
                 gen[row] = ni.generation
                 dirty.append(row)
         if bulk:
             self._encode_dynamic_bulk(bulk)
+        if fresh_bulk:
+            self._encode_fresh_bulk(fresh_bulk)
         return dirty
+
+    def _encode_fresh_bulk(self, pairs: list) -> None:
+        """Columnar encode for brand-new podless rows — the node-creation
+        flood shape (100k registrations before any pod exists).  The
+        per-row _encode_node costs ~30µs; this path is ~4µs/row: dynamic
+        fields are zero-filled column-wise, alloc/maxpods come from list
+        comprehensions, and only taints/labels stay per-row (short dict
+        loops, vocab lookups only)."""
+        rows = np.fromiter((r for r, _ in pairs), np.int64, len(pairs))
+        infos = [ni for _, ni in pairs]
+        node_infos = self.node_infos
+        for row, ni in pairs:
+            node_infos[row] = ni
+        for arr in (self.used, self.used_nz, self.port_mask, self.alloc,
+                    self.taint_mask, self.label_mask, self.key_mask):
+            arr[rows] = 0.0
+        self.npods[rows] = 0.0
+        self.alloc[rows, 0] = [ni.allocatable.milli_cpu for ni in infos]
+        self.alloc[rows, 1] = [ni.allocatable.memory for ni in infos]
+        self.alloc[rows, 2] = [ni.allocatable.ephemeral_storage
+                               for ni in infos]
+        self.maxpods[rows] = [ni.allocatable.allowed_pod_number
+                              for ni in infos]
+        self.node_gen[rows] = [ni.node_generation for ni in infos]
+        self.valid[rows] = True
+        tm, lm, km = self.taint_mask, self.label_mask, self.key_mask
+        lv, kv = self.label_vocab.lookup, self.key_vocab.lookup
+        tv = self.taint_vocab.get
+        for row, ni in pairs:
+            node = ni.node
+            spec = node.get("spec") or {}
+            taints = spec.get("taints")
+            if taints or spec.get("unschedulable"):
+                taints = list(taints or ())
+                if spec.get("unschedulable"):
+                    taints.append({"key": UNSCHEDULABLE_TAINT[0],
+                                   "value": UNSCHEDULABLE_TAINT[1],
+                                   "effect": UNSCHEDULABLE_TAINT[2]})
+                for t in taints:
+                    tm[row, tv((t.get("key", ""), t.get("value", ""),
+                                t.get("effect", "")))] = 1.0
+            for k, v in meta.labels(node).items():
+                lid = lv((k, v))
+                if lid is not None:
+                    lm[row, lid] = 1.0
+                kid = kv(k)
+                if kid is not None:
+                    km[row, kid] = 1.0
+        self.static_version += 1
+        self.static_dirty_rows.update(rows.tolist())
 
     def _release_row(self, name: str) -> int | None:
         row = self.row_of.pop(name, None)

@@ -136,8 +136,13 @@ class DeviceWorker:
             raise RuntimeError("worker not initialized (/init first)")
         if path == "/static":
             import jax.numpy as jnp
+            from .backend import STATIC_CORE, STATIC_SEL
             arrays = _load_arrays(body)
-            b._static_node = {k: jnp.asarray(v) for k, v in arrays.items()}
+            b._static_node = {k: jnp.asarray(arrays[k]) for k in STATIC_CORE}
+            # the worker holds BOTH halves resident (its tensors are empty,
+            # so the base _ensure_sel must never try to rebuild from them)
+            b._static_sel = {k: jnp.asarray(arrays[k]) for k in STATIC_SEL}
+            b._sel_stale = False
             return {"ok": True}
         if path == "/refresh":
             import jax.numpy as jnp
