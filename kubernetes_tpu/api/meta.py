@@ -108,11 +108,16 @@ _uid_prefix = uuid.uuid4().hex[:12]
 _uid_counter = itertools.count(1)
 
 
+def new_uid() -> str:
+    """Next unique object uid (bulk-create hot path)."""
+    return f"{_uid_prefix}-{next(_uid_counter):09x}"
+
+
 def finalize_new(o: Obj) -> None:
     """Fill in server-side metadata on create (uid, creationTimestamp)."""
     md = o["metadata"]
     if not md.get("uid"):
-        md["uid"] = f"{_uid_prefix}-{next(_uid_counter):09x}"
+        md["uid"] = new_uid()
     if not md.get("creationTimestamp"):
         md["creationTimestamp"] = time.time()
 

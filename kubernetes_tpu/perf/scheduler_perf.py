@@ -85,11 +85,15 @@ class ThroughputCollector:
             return
         new = 0
         seen = self._scheduled
+        DELETED = kv.DELETED
         for ev in evs:
-            if ev.type == kv.DELETED:
-                seen.discard(meta.namespaced_name(ev.object))
-            elif meta.pod_node_name(ev.object):
-                k = meta.namespaced_name(ev.object)
+            o = ev.object
+            md = o["metadata"]
+            ns = md.get("namespace", "")
+            k = f"{ns}/{md['name']}" if ns else md["name"]
+            if ev.type == DELETED:
+                seen.discard(k)
+            elif (o.get("spec") or {}).get("nodeName"):
                 if k not in seen:
                     seen.add(k)
                     new += 1

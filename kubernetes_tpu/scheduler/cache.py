@@ -205,14 +205,20 @@ class Cache:
         scheduler's own binds coming back).  Fast path: the pod is assumed
         on the same node — just swap in the confirmed state.  Everything
         else takes the ordinary add_pod route.  One lock round per burst."""
+        states = self._pod_states
+        assumed = self._assumed_pods
+        mk = _PodState
         with self._lock:
             for pod in pods:
-                key = meta.namespaced_name(pod)
-                ps = self._pod_states.get(key)
+                md = pod["metadata"]
+                ns = md.get("namespace", "")
+                key = f"{ns}/{md['name']}" if ns else md["name"]
+                ps = states.get(key)
                 if ps is not None and ps.assumed and (
-                        meta.pod_node_name(ps.pod) == meta.pod_node_name(pod)):
-                    self._pod_states[key] = _PodState(pod)
-                    self._assumed_pods.discard(key)
+                        (ps.pod.get("spec") or {}).get("nodeName")
+                        == (pod.get("spec") or {}).get("nodeName")):
+                    states[key] = mk(pod)
+                    assumed.discard(key)
                 else:
                     self.add_pod(pod)  # RLock: safe to re-enter
 

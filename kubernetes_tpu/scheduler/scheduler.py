@@ -271,16 +271,20 @@ class Scheduler:
                     ClusterEvent("AssignedPod", "Add"))
                 confirms.clear()
 
+        ADDED, MODIFIED = kv.ADDED, kv.MODIFIED
+        profiles = self.profiles
         for t, pod, old in triples:
-            bound = bool(meta.pod_node_name(pod))
-            if t == kv.ADDED and not bound:
-                if self._responsible_for(pod):
+            spec = pod.get("spec") or {}
+            bound = bool(spec.get("nodeName"))
+            if t == ADDED and not bound:
+                if spec.get("schedulerName", "default-scheduler") in profiles:
                     queue_adds.append(pod)
-            elif (t == kv.MODIFIED and bound
-                    and not (old and meta.pod_node_name(old))
+            elif (t == MODIFIED and bound
                     and old is not None
-                    and meta.deletion_timestamp(pod) is None
-                    and not meta.pod_is_terminal(pod)):
+                    and not (old.get("spec") or {}).get("nodeName")
+                    and pod["metadata"].get("deletionTimestamp") is None
+                    and (pod.get("status") or {}).get("phase")
+                    not in ("Succeeded", "Failed")):
                 confirms.append(pod)
             else:
                 flush()

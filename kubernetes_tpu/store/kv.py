@@ -322,25 +322,36 @@ class MemoryStore:
         out: list[tuple[Obj | None, StoreError | None]] = []
         evs: list[WatchEvent] = []
         recs: list[tuple] = []
+        now = time.time()  # one clock read per burst (finalize semantics)
+        transform = self._transformers.get(resource)
         with self._lock:
             table = self._table(resource)
+            rev = self._rev
             for obj in objs:
-                key = meta.namespaced_name(obj)
+                md = obj["metadata"]
+                ns = md.get("namespace", "")
+                key = f"{ns}/{md['name']}" if ns else md["name"]
                 if key in table:
                     out.append((None, AlreadyExistsError(
                         f"{resource} {key!r} already exists")))
                     continue
                 if copy:
                     obj = meta.deep_copy(obj)
-                meta.finalize_new(obj)
-                self._rev += 1
-                meta.set_resource_version(obj, self._rev)
-                sealed = self._seal(resource, obj)
+                    md = obj["metadata"]
+                if not md.get("uid"):
+                    md["uid"] = meta.new_uid()
+                if not md.get("creationTimestamp"):
+                    md["creationTimestamp"] = now
+                rev += 1
+                md["resourceVersion"] = rev
+                sealed = (transform.encrypt_obj(obj)
+                          if transform is not None else obj)
                 table[key] = sealed
                 if self._wal is not None:
-                    recs.append((wal_mod.PUT, self._rev, resource, key, sealed))
-                evs.append(WatchEvent(ADDED, obj, self._rev))
+                    recs.append((wal_mod.PUT, rev, resource, key, sealed))
+                evs.append(WatchEvent(ADDED, obj, rev))
                 out.append((obj, None))
+            self._rev = rev
             if recs:
                 self._wal.append_many(recs)
                 self._maybe_compact()
@@ -459,16 +470,19 @@ class MemoryStore:
         out: list[tuple[Obj | None, StoreError | None]] = []
         evs: list[WatchEvent] = []
         recs: list[tuple] = []
+        transform = self._transformers.get(resource)
         with self._lock:
             table = self._table(resource)
+            rev = self._rev
             for ns, nm, node in bindings:
-                key = self._key(ns, nm)
+                key = f"{ns}/{nm}" if ns else nm
                 cur = table.get(key)
                 if cur is None:
                     out.append((None, NotFoundError(
                         f"{resource} {key!r} not found")))
                     continue
-                cur = self._open(resource, cur)
+                if transform is not None:
+                    cur = transform.decrypt_obj(cur)
                 if (cur.get("spec") or {}).get("nodeName"):
                     out.append((None, ConflictError(
                         f"pod {key!r} is already bound to "
@@ -480,22 +494,23 @@ class MemoryStore:
                 # (returned objects are never mutated in place — the store
                 # itself always writes fresh containers)
                 status = cur.get("status") or {}
+                rev += 1
                 obj = {**cur,
-                       "metadata": dict(cur["metadata"]),
+                       "metadata": {**cur["metadata"], "resourceVersion": rev},
                        "spec": {**(cur.get("spec") or {}), "nodeName": node},
                        "status": {**status,
                                   "conditions": list(status.get(
                                       "conditions") or ()) + [
                                       {"type": "PodScheduled",
                                        "status": "True"}]}}
-                self._rev += 1
-                meta.set_resource_version(obj, self._rev)
-                sealed = self._seal(resource, obj)
+                sealed = (transform.encrypt_obj(obj)
+                          if transform is not None else obj)
                 table[key] = sealed
                 if self._wal is not None:
-                    recs.append((wal_mod.PUT, self._rev, resource, key, sealed))
-                evs.append(WatchEvent(MODIFIED, obj, self._rev))
+                    recs.append((wal_mod.PUT, rev, resource, key, sealed))
+                evs.append(WatchEvent(MODIFIED, obj, rev))
                 out.append((obj, None))
+            self._rev = rev
             if recs:
                 self._wal.append_many(recs)
                 self._maybe_compact()
