@@ -172,6 +172,10 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         fn = self._fn if self._needs_full(batch) else self._ensure_plain()
         self._state, assignments, waves = fn(
             self._state, self._static_node, pod_arrays, prows, pvals)
+        for h in (assignments, waves):
+            copy_async = getattr(h, "copy_to_host_async", None)
+            if copy_async is not None:  # see ops/backend.py _device_step
+                copy_async()
         return assignments, waves
 
     # -- BatchBackend ----------------------------------------------------

@@ -155,8 +155,14 @@ class PerfCluster:
 
 
 def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
-                  store: kv.MemoryStore | None = None) -> PerfCluster:
-    """mustSetupScheduler (util.go:79): in-proc everything, no kubelet."""
+                  store: kv.MemoryStore | None = None,
+                  pipeline_depth: int = 1,
+                  admission_interval: float = 0.0) -> PerfCluster:
+    """mustSetupScheduler (util.go:79): in-proc everything, no kubelet.
+
+    pipeline_depth/admission_interval select latency mode (scheduler.py):
+    depth ~4 + a few-ms admission interval turns the batch path into
+    overlapped micro-batches for p99-targeted runs."""
     from ..utils.gctune import tune_for_throughput
     tune_for_throughput()  # CPython gen-2 pauses cost ~35% at bench scale
     store = store or kv.MemoryStore(history=1_000_000)
@@ -170,7 +176,9 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
         fw = new_default_framework(client, factory)
         profiles = {"default-scheduler": Profile(
             fw, batch_backend=backend, batch_size=batch_size)}
-        sched = Scheduler(client, factory, profiles)
+        sched = Scheduler(client, factory, profiles,
+                          pipeline_depth=pipeline_depth,
+                          admission_interval=admission_interval)
     else:
         sched = new_scheduler(client, factory)
     factory.start()
@@ -301,7 +309,7 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
                 # util.go:92): steady load below capacity is what the
                 # p99-latency target is ABOUT — a full-backlog dump makes
                 # p99 the backlog drain time by construction
-                chunk = max(1, int(rate) // 20)  # 50ms ticks
+                chunk = max(1, int(rate) // 100)  # 10ms ticks
                 next_t = time.monotonic()
                 for lo in range(0, op["count"], chunk):
                     hi = min(lo + chunk, op["count"])
@@ -361,9 +369,13 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
 
 
 def run_named_workload(config: dict, tpu: bool = False, caps=None,
-                       batch_size: int = 512) -> tuple[ThroughputSummary, dict]:
+                       batch_size: int = 512, pipeline_depth: int = 1,
+                       admission_interval: float = 0.0
+                       ) -> tuple[ThroughputSummary, dict]:
     """Run one workload config end to end; returns (throughput, stats)."""
-    cluster = setup_cluster(tpu=tpu, caps=caps, batch_size=batch_size)
+    cluster = setup_cluster(tpu=tpu, caps=caps, batch_size=batch_size,
+                            pipeline_depth=pipeline_depth,
+                            admission_interval=admission_interval)
     collector = ThroughputCollector(cluster.store)
     try:
         ops = config["workloadTemplate"]

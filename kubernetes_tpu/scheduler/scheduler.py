@@ -186,7 +186,9 @@ class Scheduler:
                  informer_factory: SharedInformerFactory,
                  profiles: dict[str, Profile],
                  next_start_node_index_random: bool = False,
-                 extenders: Sequence | None = None):
+                 extenders: Sequence | None = None,
+                 pipeline_depth: int = 1,
+                 admission_interval: float = 0.0):
         self.client = client
         self.informer_factory = informer_factory
         self.profiles = profiles
@@ -220,7 +222,18 @@ class Scheduler:
                 if hasattr(plugin, "preemption_observer"):
                     plugin.preemption_observer = self.metrics.observe_preemption
         self._stop = threading.Event()
-        self._pending = None  # in-flight dispatched batch (depth-1 pipeline)
+        # Batch pipeline: dispatched-but-unfinished batches, oldest first.
+        # pipeline_depth bounds how many ride the device queue at once;
+        # depth 1 == the classic dispatch-k+1-then-finish-k overlap.
+        # Latency mode (p99-targeted): depth ~4 + a small
+        # admission_interval — micro-batches dispatch every few ms and
+        # their ~70ms tunnel round trips overlap, so a pod's end-to-end
+        # latency is one round trip, not one per queued batch
+        # (pkg/scheduler/metrics pod_scheduling_duration is the metric
+        # this shapes).
+        self._pending: list = []
+        self.pipeline_depth = max(1, pipeline_depth)
+        self.admission_interval = admission_interval
         self._deferred: list[QueuedPodInfo] = []  # per-pod pods awaiting a quiescent cache
         self._binder_pool = ThreadPoolExecutor(max_workers=16,
                                                thread_name_prefix="bind")
@@ -385,7 +398,14 @@ class Scheduler:
         batch_profile = next((p for p in self.profiles.values()
                               if p.batch_backend is not None), None)
         if batch_profile is not None:
-            t = 0.0 if self._pending is not None else timeout
+            if not self._pending:
+                t = timeout
+            elif len(self._pending) < self.pipeline_depth:
+                # room in the pipeline: wait at most the admission
+                # interval so a trickle of pods still dispatches promptly
+                t = self.admission_interval
+            else:
+                t = 0.0
             batch = self.queue.pop_batch(batch_profile.batch_size, t)
             mine: list[QueuedPodInfo] = []
             perpod: list[QueuedPodInfo] = []
@@ -393,7 +413,7 @@ class Scheduler:
                 for q in batch:
                     (mine if self._profile_for(q.pod) is batch_profile
                      else perpod).append(q)
-            if not batch and self._pending is None and not self._deferred:
+            if not batch and not self._pending and not self._deferred:
                 # truly idle: let the backend absorb node churn into its
                 # host tensors now, so a later dispatch doesn't pay the
                 # whole re-encode (at 100k nodes the creation flood costs
@@ -409,9 +429,17 @@ class Scheduler:
                 deferred, self._deferred = self._deferred, []
                 for q in deferred + perpod:
                     self.schedule_one(q)
-            pending = self._dispatch_batch(batch_profile, mine) if mine else None
-            self._flush_pending()
-            self._pending = pending
+            if mine:
+                pending = self._dispatch_batch(batch_profile, mine)
+                if pending is not None:
+                    self._pending.append(pending)
+                while len(self._pending) > self.pipeline_depth:
+                    self._finish_batch(*self._pending.pop(0))
+            elif self._pending:
+                # queue momentarily empty: retire the oldest in-flight
+                # batch (blocks on its device result; pods accumulate in
+                # the queue meanwhile — the pipeline's natural pacing)
+                self._finish_batch(*self._pending.pop(0))
             return len(batch)
         qpi = self.queue.pop(timeout)
         if qpi is None:
@@ -420,11 +448,10 @@ class Scheduler:
         return 1
 
     def _flush_pending(self) -> None:
-        """Resolve the in-flight batch (blocks on device) and run its tail."""
-        pending = self._pending
-        self._pending = None
-        if pending is not None:
-            self._finish_batch(*pending)
+        """Resolve every in-flight batch (blocks on device), oldest first,
+        and run their tails."""
+        while self._pending:
+            self._finish_batch(*self._pending.pop(0))
 
     def _profile_for(self, pod: Obj) -> Profile | None:
         name = (pod.get("spec") or {}).get("schedulerName", "default-scheduler")
