@@ -73,11 +73,12 @@ EXTRA_CONFIGS = {
                        "nodes": 100_000, "pods": 200_000, "batch": 16384,
                        "depth": 2, "timeout": 1200.0},
     "SchedulingPodAntiAffinity": {"workload": "SchedulingPodAntiAffinity",
-                                  "batch": 4096, "timeout": 900.0},
+                                  "batch": 4096, "depth": 2,
+                                  "timeout": 900.0},
     "TopologySpreading": {"workload": "TopologySpreading", "batch": 4096,
-                          "timeout": 900.0},
+                          "depth": 2, "timeout": 900.0},
     "CoschedulingGang": {"workload": "CoschedulingGang", "batch": 4096,
-                         "timeout": 900.0},
+                         "depth": 2, "timeout": 900.0},
 }
 
 

@@ -50,6 +50,7 @@ batch.
 
 from __future__ import annotations
 
+import os
 import functools
 from typing import Any
 
@@ -64,7 +65,15 @@ from ..ops.flatten import (
 )
 
 NEG = -1e9
-TIE_NOISE = 1e-3  # breaks exact score ties only (real score deltas >> this)
+_WAVE_DEBUG: list = []  # populated only under KTPU_WAVE_DEBUG + eager mode
+TIE_NOISE = 0.05  # breaks exact score ties only (real score deltas >> this).
+# Must stay ABOVE f32 resolution at score scale (~200 * 1.2e-7 * n_cap per
+# whole-axis gradient): at 1e-3 the per-node deltas rounded away at
+# n_cap >= ~1k and every same-preference claimant argmaxed onto the same
+# first node (node-capacity serialization).
+COHORT_ITERS = 3  # spread water-filling fixpoint rounds per wave (round 1
+# fills to the legal level in one shot; extra rounds catch stragglers
+# whose level rose with round-1 commits)
 
 # Kernel feature flags.  The device endpoint has high per-op overhead, so
 # the backend compiles specialized variants: a batch with no selectors /
@@ -244,6 +253,14 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
         # (reference: selectHost reservoir sample breaks ties randomly)
         gn = (offset + jnp.arange(n_loc)).astype(jnp.float32)
         pp = jnp.arange(P, dtype=jnp.float32)
+        # pseudo-random tie-break keyed on (pod, GLOBAL node): uniform per
+        # cell, so claims stay spread under ANY occupancy pattern (a
+        # structured cyclic gradient was tried — 1 wave on an empty
+        # cluster — but under fragmentation every claimant's
+        # first-feasible collapsed to the same few nodes and
+        # anti-affinity serialized to ~1 pod/wave).  Deterministic and
+        # shard-invariant, same contract as the reference's selectHost
+        # random tie-break (schedule_one.go:777).
         h = jnp.sin(pp[:, None] * 12.9898 + gn[None, :] * 78.233) * 43758.5453
         noise = (h - jnp.floor(h)) * TIE_NOISE
         alloc = node["alloc"]
@@ -387,6 +404,9 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                 port_conf = jnp.zeros(P, bool)
 
             conf = jnp.zeros(P, bool)
+            spread_over_any = jnp.zeros(P, bool)   # failed the static quota
+            spread_static_ok = jnp.ones(P, bool)   # count+self-min <= skew
+            spread_over_slots = []                 # [P] per slot
             both = (has[:, None] & has[None, :]).astype(jnp.float32) * earlier
             for c in range(caps.c_cap if f_cons else 0):
                 kind = pod["c_kind"][:, c]
@@ -401,19 +421,23 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                 # required anti-affinity: both entrants see gathered==0, so
                 # any earlier same-domain incrementer must serialize
                 conf |= (kind == C_ANTI_AFFINITY) & (k_same > 0)
-                # HARD spread admits a whole cohort per wave as long as the
-                # headroom holds: min domain count can only RISE as other
-                # claims commit, so count + self + k_earlier - min <=
-                # maxSkew keeps every wave-mate's accept valid (the old
-                # one-per-domain-per-wave rule made 3-zone spreading
-                # O(batch/zones) waves — pathological at bench shapes)
+                # HARD spread static quota: count + self + k_earlier - min
+                # <= maxSkew is valid at ANY interleaving (the min can only
+                # rise as other claims commit).  Pods over the static quota
+                # are NOT immediately conflicted — the cohort pass below
+                # re-admits ranks that a round-robin interleaving covers.
                 own = Dpq[p_iota, p_iota]                     # [P] own domain
                 cnt_own = cd_sg[jnp.clip(sg, 0), jnp.clip(own, 0)
                                 .astype(jnp.int32)]           # [P]
                 minm = minmatches[c][:, 0]
-                over = (cnt_own + pod["c_selfmatch"][:, c] + k_same
-                        - minm) > pod["c_maxskew"][:, c]
-                conf |= (kind == C_SPREAD_HARD) & (own >= 0) & over
+                selfm_c = pod["c_selfmatch"][:, c]
+                skew_c = pod["c_maxskew"][:, c]
+                over = (cnt_own + selfm_c + k_same - minm) > skew_c
+                is_spread = (kind == C_SPREAD_HARD) & (own >= 0)
+                spread_over_slots.append(is_spread & over)
+                spread_over_any |= is_spread & over
+                spread_static_ok &= jnp.where(
+                    is_spread, (cnt_own + selfm_c - minm) <= skew_c, True)
                 # affinity bootstrap: serialize against any incrementing q
                 conf |= boot_flags[c] & (jnp.sum(both * q_incs, axis=1) > 0)
             for a in range(caps.asg_cap if f_asg else 0):
@@ -423,7 +447,83 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                 conf |= (pod["match_asg"][:, a] > 0) & (
                     jnp.sum(both * same_a * pod["inc_asg"][None, :, a], axis=1) > 0)
 
-            accept = has & active & res_ok & ~port_conf & ~conf
+            accept = has & active & res_ok & ~port_conf & ~conf \
+                & ~spread_over_any
+            if f_cons:
+                # ---- spread cohort (water-filling) admission ----
+                # The static quota admits ~maxSkew pods per domain per
+                # wave -> O(batch/(domains*skew)) waves (measured 1377
+                # for 4096 pods / 3 zones / skew 1).  Water-filling: a
+                # pour that lands on a current-minimum domain is ALWAYS
+                # sequentially valid (count+1-min = 1 <= maxSkew), so any
+                # end state reachable by filling lowest-domains-first is
+                # valid.  Pours can raise every domain to
+                #   L = min over eligible domains of
+                #         (count + committed + pool) + maxSkew
+                # (the stuck minimum after every pool drains is >= the
+                # min term, and levels above it stay within the skew).
+                # A candidate at new-rank r' in domain d therefore admits
+                # when count_d + committed_d + r' + self <= L.  Pods with
+                # more than one hard-spread slot are excluded from pools
+                # and cohort (their commit depends on the OTHER slot, so
+                # counting them could overstate a pool); they fall back
+                # to the static quota.  Two fixpoint rounds let the first
+                # round's commits raise the second round's levels.
+                other_ok = has & active & res_ok & ~port_conf & ~conf
+                n_hard = jnp.zeros(P, jnp.int32)
+                for c in range(caps.c_cap):
+                    n_hard = n_hard + (
+                        pod["c_kind"][:, c] == C_SPREAD_HARD).astype(
+                        jnp.int32)
+                cand = other_ok & spread_over_any & (n_hard <= 1)
+                dom_acc0 = comm.gather_cols(dom_sg, claims, offset, n_loc,
+                                            fill=-1.0)        # [SG,P]
+                sg_iota2 = jnp.arange(caps.sg_cap)[:, None]
+                dom_acc0_ix = jnp.clip(dom_acc0, 0).astype(jnp.int32)
+                committed = accept
+                for _it in range(COHORT_ITERS):
+                    new_ok = cand & ~committed
+                    comm_f = committed.astype(jnp.float32)
+                    new_f = new_ok.astype(jnp.float32)
+                    ok_all = new_ok
+                    for c in range(caps.c_cap):
+                        kind = pod["c_kind"][:, c]
+                        sg = jnp.clip(pod["c_sg"][:, c], 0)
+                        dom_rows = dom_sg[sg]
+                        w = pod["inc_sg"].T * comm_f[None, :] * (
+                            dom_acc0 >= 0)
+                        m_sg = jnp.zeros_like(cd_sg).at[
+                            sg_iota2, dom_acc0_ix].add(w)     # [SG,N-dom]
+                        wp = pod["inc_sg"].T * new_f[None, :] * (
+                            dom_acc0 >= 0)
+                        pool_sg = jnp.zeros_like(cd_sg).at[
+                            sg_iota2, dom_acc0_ix].add(wp)
+                        fill = cd_sg + m_sg + pool_sg
+                        gath = jnp.where(
+                            dom_sg >= 0,
+                            jnp.take_along_axis(fill, jnp.clip(dom_sg, 0),
+                                                axis=1),
+                            jnp.inf)                          # [SG,N]
+                        Dpq = comm.gather_cols(dom_rows, claims, offset,
+                                               n_loc, fill=-1.0)
+                        own = Dpq[p_iota, p_iota]
+                        same_dom = (Dpq == own[:, None]) & (own[:, None] >= 0)
+                        q_incs = pod["inc_sg"].T[sg]
+                        rprime = jnp.sum(both * same_dom * q_incs
+                                         * new_f[None, :], axis=1)
+                        own_ix = jnp.clip(own, 0).astype(jnp.int32)
+                        m_own = m_sg[sg, own_ix]
+                        elig_c = sel_mask & (dom_rows >= 0)
+                        floor = comm.rowmin(gath[sg], elig_c, jnp.inf)[:, 0]
+                        floor = jnp.where(jnp.isfinite(floor), floor, 0.0)
+                        level = floor + pod["c_maxskew"][:, c]
+                        cnt_own = cd_sg[sg, own_ix]
+                        cond = (cnt_own + m_own + rprime
+                                + pod["c_selfmatch"][:, c]) <= level
+                        is_spread = (kind == C_SPREAD_HARD) & (own >= 0)
+                        ok_all &= (~is_spread) | cond
+                    committed = committed | (new_ok & ok_all)
+                accept = committed
 
             # ---- commit ----
             acc_oh = onehot * accept[:, None]                 # [P,N] local rows
@@ -446,6 +546,15 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                 cd_asg = cd_asg.at[jnp.arange(caps.asg_cap)[:, None],
                                    jnp.clip(dom_acc_a, 0).astype(jnp.int32)].add(w_asg)
 
+            if os.environ.get("KTPU_WAVE_DEBUG") and not isinstance(
+                    claims, jax.core.Tracer):  # pragma: no cover - debug
+                _WAVE_DEBUG.append({
+                    "claims": np.asarray(claims), "has": np.asarray(has),
+                    "res_ok": np.asarray(res_ok),
+                    "conf": np.asarray(conf),
+                    "over": np.asarray(spread_over_any),
+                    "accept": np.asarray(accept),
+                    "active": np.asarray(active)})
             assigned = jnp.where(accept, claims, assigned)
             progress = jnp.any(accept)
             active = active & ~accept & progress  # no progress -> give up
