@@ -106,9 +106,12 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
                    if op["opcode"] == "createNodes")
 
     n_cap = max(1024, -(-int(n_nodes * 1.1) // 256) * 256)  # ~10% headroom
+    # c_cap=2: every tracked workload carries <=1 constraint per pod, and
+    # each constraint slot costs [P,P] conflict work per wave in the full
+    # kernel; pods with more constraints escape to the per-pod oracle
     caps = Caps(n_cap=n_cap,
                 l_cap=256, kl_cap=62, t_cap=16, pt_cap=16, s_cap=3,
-                sg_cap=16, asg_cap=16)
+                sg_cap=16, asg_cap=16, c_cap=2)
     t0 = time.monotonic()
     summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
                                         batch_size=batch,
