@@ -34,9 +34,10 @@ from __future__ import annotations
 
 import json
 import logging
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, urlencode, urlparse
 
 from .. import __version__
 from ..api import meta
@@ -61,6 +62,11 @@ from ..client.clientset import CLUSTER_SCOPED_RESOURCES
 CLUSTER_SCOPED = CLUSTER_SCOPED_RESOURCES
 
 SUBRESOURCES = {"status", "binding", "eviction", "scale"}
+
+# pod-only subresources served by tunneling to the pod's kubelet
+# (pkg/registry/core/pod/rest/subresources.go -> UpgradeAwareProxy);
+# routed only for pods and only on GET/POST — never as write targets
+NODE_STREAM_SUBRESOURCES = {"log", "exec", "attach", "portforward"}
 
 # built-in group routing (/apis/{group}/{version}); all resources share the
 # flat store namespace, so the group prefix is addressing only
@@ -374,9 +380,12 @@ class APIServer:
                     if len(rest) > 3:
                         r.name = rest[3]
                     if len(rest) > 4:
-                        if rest[4] in SUBRESOURCES and len(rest) == 5:
+                        known = SUBRESOURCES | (
+                            NODE_STREAM_SUBRESOURCES
+                            if r.resource == "pods" else set())
+                        if rest[4] in known and len(rest) == 5:
                             r.subresource = rest[4]
-                        else:  # unknown subresource (exec/log/...) -> 404
+                        else:  # unknown subresource -> 404
                             r.resource = None
                 elif rest:
                     r.resource = rest[0]
@@ -400,13 +409,16 @@ class APIServer:
                     return None
                 r = self._route()
                 ticket = None
-                # long-running requests (watches) are exempt from APF —
-                # a held seat for a stream's lifetime would starve the
-                # level (upstream longRunningRequestCheck does the same)
+                # long-running requests (watches, kubelet streams) are
+                # exempt from APF — a held seat for a stream's lifetime
+                # would starve the level (upstream longRunningRequestCheck
+                # exempts watch + exec/attach/portforward/log the same way)
                 is_watch = bool(r) and r.query.get("watch",
                                                    ["false"])[0] == "true"
+                is_long = is_watch or (
+                    bool(r) and r.subresource in NODE_STREAM_SUBRESOURCES)
                 if server.flow is not None and r and r.resource \
-                        and not is_watch:
+                        and not is_long:
                     try:
                         ticket = server.flow.admit(self._user(), verb,
                                                    r.resource)
@@ -518,7 +530,10 @@ class APIServer:
                     self._send_json(404, status_error(404, "NotFound", path))
                     return
                 try:
-                    if r.query.get("watch", ["false"])[0] == "true":
+                    if r.resource == "pods" \
+                            and r.subresource in NODE_STREAM_SUBRESOURCES:
+                        self._node_stream(r)
+                    elif r.query.get("watch", ["false"])[0] == "true":
                         self._serve_watch(r.resource, r.query)
                     elif r.name is not None and r.subresource == "scale":
                         obj = server.store.get(r.resource, r.ns or "", r.name)
@@ -583,6 +598,196 @@ class APIServer:
                     pass
                 self.close_connection = True
 
+            # ---- kubelet tunnel (exec/attach/portforward/log) ----
+
+            def _kubelet_endpoint(self, r: _Route):
+                """Resolve the pod's kubelet (host, port, pod spec) from
+                node status daemonEndpoints, or write the error."""
+                try:
+                    pod = server.store.get("pods", r.ns or "", r.name)
+                except kv.NotFoundError as e:
+                    self._send_json(404, status_error(404, "NotFound",
+                                                      str(e)))
+                    return None
+                node_name = (pod.get("spec") or {}).get("nodeName")
+                if not node_name:
+                    self._send_json(400, status_error(
+                        400, "BadRequest",
+                        f"pod {r.name!r} is not scheduled"))
+                    return None
+                try:
+                    node = server.store.get("nodes", "", node_name)
+                except kv.NotFoundError:
+                    self._send_json(502, status_error(
+                        502, "BadGateway", f"node {node_name!r} gone"))
+                    return None
+                status = node.get("status") or {}
+                port = ((status.get("daemonEndpoints") or {})
+                        .get("kubeletEndpoint") or {}).get("Port")
+                addr = next((a.get("address")
+                             for a in status.get("addresses") or ()
+                             if a.get("type") == "InternalIP"), None)
+                if not port or not addr:
+                    self._send_json(502, status_error(
+                        502, "BadGateway",
+                        f"node {node_name!r} has no kubelet endpoint"))
+                    return None
+                return addr, int(port), pod
+
+            def _node_stream(self, r: _Route) -> None:
+                """Proxy a pod log/exec/attach/portforward subresource to
+                the pod's kubelet.  Plain responses (log) are relayed as a
+                stream; 101 upgrades hand the connection over to a blind
+                two-way byte pump — the apiserver never parses frames,
+                exactly the reference's UpgradeAwareProxy contract."""
+                got = self._kubelet_endpoint(r)
+                if got is None:
+                    return
+                addr, port, pod = got
+                q = dict(r.query)
+                if r.subresource == "portforward":
+                    path = f"/portForward/{r.ns}/{r.name}"
+                else:
+                    container = (q.pop("container", [None]))[0]
+                    if container is None:
+                        spec = [c["name"] for c in
+                                (pod.get("spec") or {}).get("containers")
+                                or ()]
+                        if len(spec) != 1:
+                            self._send_json(400, status_error(
+                                400, "BadRequest",
+                                "container name required"))
+                            return
+                        container = spec[0]
+                    seg = {"log": "containerLogs"}.get(r.subresource,
+                                                      r.subresource)
+                    path = f"/{seg}/{r.ns}/{r.name}/{container}"
+                query = urlencode([(k, v) for k, vs in q.items()
+                                   for v in vs])
+                if query:
+                    path += "?" + query
+                verb = "create" if self.command == "POST" else "get"
+                try:
+                    upstream = socket.create_connection((addr, port),
+                                                        timeout=30.0)
+                except OSError as e:
+                    self._send_json(502, status_error(
+                        502, "BadGateway", f"kubelet dial failed: {e}"))
+                    self._audit(r, verb, 502)
+                    return
+                try:
+                    req = [f"{self.command} {path} HTTP/1.1",
+                           f"Host: {addr}:{port}"]
+                    for h in ("Upgrade", "Connection"):
+                        v = self.headers.get(h)
+                        if v:
+                            req.append(f"{h}: {v}")
+                    upstream.sendall(("\r\n".join(req) + "\r\n\r\n")
+                                     .encode())
+                    # relay the kubelet's response head verbatim
+                    head = b""
+                    while b"\r\n\r\n" not in head:
+                        chunk = upstream.recv(65536)
+                        if not chunk:
+                            self._send_json(502, status_error(
+                                502, "BadGateway",
+                                "kubelet closed during handshake"))
+                            self._audit(r, verb, 502)
+                            return
+                        head += chunk
+                    # handshake done: an interactive stream may sit idle
+                    # far longer than the 30s dial timeout
+                    upstream.settimeout(None)
+                    head_bytes, _, early = head.partition(b"\r\n\r\n")
+                    self.wfile.write(head_bytes + b"\r\n\r\n" + early)
+                    self.wfile.flush()
+                    is_upgrade = head_bytes.startswith(b"HTTP/1.1 101")
+                    try:
+                        upstream_code = int(head_bytes.split()[1])
+                    except (IndexError, ValueError):
+                        upstream_code = 502
+                    self._audit(r, verb, upstream_code)
+                    self.close_connection = True
+                    if is_upgrade:
+                        self._pump_sockets(self.connection, upstream)
+                    else:
+                        self._relay_plain(head_bytes, early, upstream)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                finally:
+                    try:
+                        upstream.close()
+                    except OSError:
+                        pass
+
+            def _relay_plain(self, head_bytes: bytes, early: bytes,
+                             upstream: socket.socket) -> None:
+                """Relay a non-upgrade kubelet response.  Error replies
+                are keep-alive with Content-Length — relay exactly that
+                many bytes or this thread blocks forever on a socket the
+                kubelet never closes.  Length-less responses (log
+                streams) relay until EOF, probing the client socket each
+                idle beat so an abandoned `logs -f` doesn't pin this
+                thread until container exit."""
+                import select
+                length = None
+                for ln in head_bytes.split(b"\r\n")[1:]:
+                    k, _, v = ln.partition(b":")
+                    if k.strip().lower() == b"content-length":
+                        try:
+                            length = int(v.strip())
+                        except ValueError:
+                            pass
+                sent = len(early)
+                if length is not None and sent >= length:
+                    return
+                while True:
+                    readable, _, _ = select.select(
+                        [upstream, self.connection], [], [], 5.0)
+                    if self.connection in readable:
+                        # half-duplex stream: client bytes here mean EOF
+                        try:
+                            if self.connection.recv(
+                                    1, socket.MSG_PEEK) == b"":
+                                return
+                        except OSError:
+                            return
+                    if upstream not in readable:
+                        continue
+                    chunk = upstream.recv(65536)
+                    if not chunk:
+                        return
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                    sent += len(chunk)
+                    if length is not None and sent >= length:
+                        return
+
+            @staticmethod
+            def _pump_sockets(a: socket.socket, b: socket.socket) -> None:
+                """Two-way blind byte pump until either side closes."""
+                def one_way(src, dst):
+                    try:
+                        while True:
+                            data = src.recv(65536)
+                            if not data:
+                                break
+                            dst.sendall(data)
+                    except OSError:
+                        pass
+                    for s, how in ((dst, socket.SHUT_WR),
+                                   (src, socket.SHUT_RD)):
+                        try:
+                            s.shutdown(how)
+                        except OSError:
+                            pass
+
+                t = threading.Thread(target=one_way, args=(b, a),
+                                     daemon=True)
+                t.start()
+                one_way(a, b)
+                t.join(timeout=30.0)
+
             def _read_body(self) -> dict | list | None:
                 length = int(self.headers.get("Content-Length", 0))
                 try:
@@ -644,6 +849,12 @@ class APIServer:
             def _do_post(self, r: _Route) -> None:
                 if r.resource is None:
                     self._send_json(404, status_error(404, "NotFound", r.path))
+                    return
+                if r.resource == "pods" \
+                        and r.subresource in NODE_STREAM_SUBRESOURCES:
+                    # upgrade requests carry no body — tunnel before any
+                    # body read would eat the first stream frames
+                    self._node_stream(r)
                     return
                 obj = self._read_body()
                 if obj is None:
@@ -792,6 +1003,14 @@ class APIServer:
                 if r.resource is None or r.name is None:
                     self._send_json(404, status_error(404, "NotFound", r.path))
                     return
+                if r.subresource in NODE_STREAM_SUBRESOURCES:
+                    # stream subresources are GET/POST tunnels only —
+                    # a write here must never touch the parent object
+                    self._drain_body()
+                    self._send_json(405, status_error(
+                        405, "MethodNotAllowed",
+                        f"{r.subresource} does not support this verb"))
+                    return
                 obj = self._read_body()
                 if obj is None:
                     return
@@ -856,6 +1075,14 @@ class APIServer:
             def _do_patch(self, r: _Route) -> None:
                 if r.resource is None or r.name is None:
                     self._send_json(404, status_error(404, "NotFound", r.path))
+                    return
+                if r.subresource in NODE_STREAM_SUBRESOURCES:
+                    # stream subresources are GET/POST tunnels only —
+                    # a write here must never touch the parent object
+                    self._drain_body()
+                    self._send_json(405, status_error(
+                        405, "MethodNotAllowed",
+                        f"{r.subresource} does not support this verb"))
                     return
                 body = self._read_body()
                 if body is None:
@@ -1002,6 +1229,14 @@ class APIServer:
             def _do_delete(self, r: _Route) -> None:
                 if r.resource is None or r.name is None:
                     self._send_json(404, status_error(404, "NotFound", r.path))
+                    return
+                if r.subresource in NODE_STREAM_SUBRESOURCES:
+                    # stream subresources are GET/POST tunnels only —
+                    # a write here must never touch the parent object
+                    self._drain_body()
+                    self._send_json(405, status_error(
+                        405, "MethodNotAllowed",
+                        f"{r.subresource} does not support this verb"))
                     return
                 attrs = adm.Attributes(adm.DELETE, r.resource, None,
                                        namespace=r.ns or "", name=r.name)

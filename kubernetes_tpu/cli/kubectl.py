@@ -63,6 +63,14 @@ def resolve_resource(arg: str) -> str:
     return ALIASES.get(arg.lower(), arg.lower())
 
 
+def _status_message(body: str) -> str:
+    """message out of a Status-shaped error body, else the raw text."""
+    try:
+        return json.loads(body).get("message", body)
+    except (json.JSONDecodeError, AttributeError):
+        return body
+
+
 def age(obj: dict) -> str:
     ts = meta.creation_timestamp(obj)
     if not ts:
@@ -320,7 +328,48 @@ class Kubectl:
         print_table(rows, ["NAME", "CPU", "CPU%", "MEMORY", "MEMORY%"], self.out)
         return 0
 
-    def logs(self, name: str, namespace: str) -> int:
+    def logs(self, name: str, namespace: str, container: str | None = None,
+             follow: bool = False, tail: int | None = None) -> int:
+        """Container logs via the apiserver's kubelet tunnel
+        (kubectl/pkg/cmd/logs); falls back to printing container states
+        when no kubelet endpoint serves the pod (LocalClient clusters)."""
+        http = self._http_client()
+        if http is not None:
+            q = []
+            if container:
+                q.append(("container", container))
+            if follow:
+                q.append(("follow", "true"))
+            if tail is not None:
+                q.append(("tailLines", str(tail)))
+            from urllib.parse import urlencode
+            path = (f"/api/v1/namespaces/{namespace}/pods/{name}/log"
+                    + ("?" + urlencode(q) if q else ""))
+            import http.client as hc
+            # no socket timeout: -f follows a stream that may stay
+            # silent indefinitely; the server closing ends the read
+            conn = hc.HTTPConnection(http.host, http.port)
+            try:
+                conn.request("GET", path, headers=http._headers)
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    while True:
+                        chunk = resp.read(4096)
+                        if not chunk:
+                            return 0
+                        self.out.write(chunk.decode(errors="replace"))
+                        try:
+                            self.out.flush()
+                        except (AttributeError, OSError):
+                            pass
+                body = resp.read().decode(errors="replace")
+                if resp.status != 502:
+                    self.out.write(f"Error: {_status_message(body)}\n")
+                    return 1
+                # 502: no kubelet endpoint behind this pod — fall
+                # through to the container-state print
+            finally:
+                conn.close()
         try:
             pod = self.client.get(PODS, namespace, name)
         except kv.NotFoundError as e:
@@ -330,6 +379,219 @@ class Kubectl:
             self.out.write(f"[{c.get('name')}] state={c.get('state')} "
                            f"exitCode={c.get('exitCode')}\n")
         return 0
+
+    # -- interactive streams (exec / attach / port-forward) ---------------
+
+    def _http_client(self):
+        """The HTTPClient behind this kubectl, or None (LocalClient)."""
+        return self.client if isinstance(self.client, HTTPClient) else None
+
+    def _open_stream(self, path: str):
+        from ..kubelet import streams
+        http = self._http_client()
+        if http is None:
+            self.out.write("Error: this command needs --server "
+                           "(interactive streams ride the HTTP API)\n")
+            return None
+        try:
+            return streams.open_upgrade(http.host, http.port, path,
+                                        headers=http._headers)
+        except streams.StreamError as e:
+            self.out.write(f"Error: {e}\n")
+            return None
+
+    def exec(self, name: str, namespace: str, command: list[str],
+             container: str | None = None, stdin: bytes | None = None,
+             interactive: bool = False, tty: bool = False,
+             err=None) -> int:
+        """kubectl exec (kubectl/pkg/cmd/exec/exec.go): POST the exec
+        subresource, upgrade, pump channels.  `stdin` carries input bytes
+        (CLI -i reads the real stdin)."""
+        from urllib.parse import urlencode
+
+        from ..kubelet import streams
+        q = [("command", c) for c in command] + [("stdout", "true"),
+                                                 ("stderr", "true")]
+        if container:
+            q.append(("container", container))
+        if interactive or stdin is not None:
+            q.append(("stdin", "true"))
+        if tty:
+            q.append(("tty", "true"))
+        fs = self._open_stream(
+            f"/api/v1/namespaces/{namespace}/pods/{name}/exec?"
+            + urlencode(q))
+        if fs is None:
+            return 1
+        err = err or self.out
+
+        def pump_stdin():
+            if stdin is not None:
+                fs.send(streams.STDIN, stdin)
+            elif interactive:
+                while True:
+                    data = sys.stdin.buffer.read(4096)
+                    if not data:
+                        break
+                    fs.send(streams.STDIN, data)
+            fs.send_close(streams.STDIN)
+
+        import threading
+        threading.Thread(target=pump_stdin, daemon=True).start()
+        code = 1
+        try:
+            while True:
+                frame = fs.recv()
+                if frame is None:
+                    break
+                ch, payload = frame
+                if ch == streams.STDOUT:
+                    self.out.write(payload.decode(errors="replace"))
+                elif ch == streams.STDERR:
+                    err.write(payload.decode(errors="replace"))
+                elif ch == streams.ERROR:
+                    code, msg = streams.parse_exit_status(payload)
+                    if code and msg:
+                        err.write(msg + "\n")
+                    break
+        finally:
+            fs.close()
+        return code
+
+    def attach(self, name: str, namespace: str,
+               container: str | None = None, stdin: bytes | None = None,
+               tty: bool = False) -> int:
+        """kubectl attach: same stream contract as exec, no command —
+        the kubelet attaches to the running entrypoint's console."""
+        from urllib.parse import urlencode
+
+        from ..kubelet import streams
+        q = [("stdout", "true"), ("stderr", "true")]
+        if container:
+            q.append(("container", container))
+        if stdin is not None:
+            q.append(("stdin", "true"))
+        if tty:
+            q.append(("tty", "true"))
+        fs = self._open_stream(
+            f"/api/v1/namespaces/{namespace}/pods/{name}/attach?"
+            + urlencode(q))
+        if fs is None:
+            return 1
+        if stdin is not None:
+            fs.send(streams.STDIN, stdin)
+        code = 0
+        try:
+            while True:
+                frame = fs.recv()
+                if frame is None:
+                    break
+                ch, payload = frame
+                if ch == streams.STDOUT:
+                    self.out.write(payload.decode(errors="replace"))
+                elif ch == streams.ERROR:
+                    code, _ = streams.parse_exit_status(payload)
+                    break
+        finally:
+            fs.close()
+        return code
+
+    def port_forward(self, name: str, namespace: str, mapping: str,
+                     ready=None, once: bool = False) -> int:
+        """kubectl port-forward pod [local:]remote — a real local
+        listener; each accepted connection gets its own upgraded stream
+        to the kubelet (the per-connection stream pair of
+        kubectl/pkg/cmd/portforward)."""
+        import socket as socketlib
+        import threading
+
+        from ..kubelet import streams
+        local_s, _, remote_s = mapping.partition(":")
+        if not remote_s:
+            local_s, remote_s = "", local_s
+        try:
+            remote = int(remote_s)
+            local = int(local_s) if local_s else 0
+        except ValueError:
+            self.out.write(f"Error: bad port mapping {mapping!r}\n")
+            return 1
+        http = self._http_client()
+        if http is None:
+            self.out.write("Error: this command needs --server\n")
+            return 1
+        listener = socketlib.socket()
+        listener.setsockopt(socketlib.SOL_SOCKET,
+                            socketlib.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", local))
+        listener.listen(8)
+        bound = listener.getsockname()[1]
+        self.out.write(f"Forwarding from 127.0.0.1:{bound} -> {remote}\n")
+        if ready is not None:
+            ready(bound)
+
+        path = (f"/api/v1/namespaces/{namespace}/pods/{name}/portforward"
+                f"?port={remote}")
+
+        def serve(conn: socketlib.socket) -> None:
+            try:
+                fs = streams.open_upgrade(http.host, http.port, path,
+                                          headers=http._headers)
+            except streams.StreamError as e:
+                conn.close()
+                self.out.write(f"Error: {e}\n")
+                return
+            done = threading.Event()
+
+            def local_to_stream():
+                try:
+                    while True:
+                        data = conn.recv(65536)
+                        if not data:
+                            break
+                        fs.send(streams.PF_DATA, data)
+                    fs.send_close(streams.PF_DATA)
+                except OSError:
+                    pass
+
+            t = threading.Thread(target=local_to_stream, daemon=True)
+            t.start()
+            try:
+                while True:
+                    frame = fs.recv()
+                    if frame is None:
+                        break
+                    ch, payload = frame
+                    if ch == streams.PF_DATA:
+                        conn.sendall(payload)
+                    elif ch == streams.PF_ERROR:
+                        self.out.write(
+                            f"Error: {payload.decode(errors='replace')}\n")
+                        break
+            except OSError:
+                pass
+            finally:
+                done.set()
+                fs.close()
+                # shutdown first: close() alone leaves the FIN unsent
+                # while local_to_stream sits in recv on this socket
+                try:
+                    conn.shutdown(socketlib.SHUT_RDWR)
+                except OSError:
+                    pass
+                conn.close()
+
+        try:
+            while True:
+                conn, _ = listener.accept()
+                if once:
+                    serve(conn)
+                    return 0
+                threading.Thread(target=serve, args=(conn,),
+                                 daemon=True).start()
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            listener.close()
 
     # -- rollout / label / annotate / patch / wait ------------------------
 
@@ -658,6 +920,22 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("what", choices=["nodes"])
     lg = sub.add_parser("logs")
     lg.add_argument("name")
+    lg.add_argument("-c", "--container", default=None)
+    lg.add_argument("-f", "--follow", action="store_true")
+    lg.add_argument("--tail", type=int, default=None)
+    ex = sub.add_parser("exec")
+    ex.add_argument("name")
+    ex.add_argument("-c", "--container", default=None)
+    ex.add_argument("-i", "--stdin", action="store_true", dest="interactive")
+    ex.add_argument("-t", "--tty", action="store_true")
+    ex.add_argument("command", nargs="*", help="-- COMMAND [args...]")
+    at = sub.add_parser("attach")
+    at.add_argument("name")
+    at.add_argument("-c", "--container", default=None)
+    at.add_argument("-t", "--tty", action="store_true")
+    pf = sub.add_parser("port-forward")
+    pf.add_argument("name")
+    pf.add_argument("mapping", help="[local:]remote")
     ro = sub.add_parser("rollout")
     ro.add_argument("action", choices=["status", "restart", "undo"])
     ro.add_argument("resource")
@@ -694,6 +972,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(argv: list[str] | None = None, client: Client | None = None,
         out=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # split at the first bare "--": flags like -i must bind to kubectl
+    # even after the pod name (argparse REMAINDER would swallow them)
+    tail: list[str] = []
+    if "--" in argv:
+        cut = argv.index("--")
+        argv, tail = argv[:cut], argv[cut + 1:]
     args = build_parser().parse_args(argv)
     out = out or sys.stdout
     if client is None:
@@ -721,7 +1007,21 @@ def run(argv: list[str] | None = None, client: Client | None = None,
     if args.cmd == "top":
         return k.top_nodes()
     if args.cmd == "logs":
-        return k.logs(args.name, args.namespace)
+        return k.logs(args.name, args.namespace, container=args.container,
+                      follow=args.follow, tail=args.tail)
+    if args.cmd == "exec":
+        command = args.command or tail
+        if not command:
+            out.write("Error: exec needs -- COMMAND\n")
+            return 1
+        return k.exec(args.name, args.namespace, command,
+                      container=args.container,
+                      interactive=args.interactive, tty=args.tty)
+    if args.cmd == "attach":
+        return k.attach(args.name, args.namespace,
+                        container=args.container, tty=args.tty)
+    if args.cmd == "port-forward":
+        return k.port_forward(args.name, args.namespace, args.mapping)
     if args.cmd == "rollout":
         return k.rollout(args.action, args.resource, args.name,
                          args.namespace, args.timeout)

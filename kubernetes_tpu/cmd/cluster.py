@@ -40,7 +40,7 @@ def main(argv=None) -> None:
     from ..client.informer import SharedInformerFactory
     from ..controllers import ControllerManager
     from ..controllers.endpoints import EndpointsController
-    from ..kubelet import start_hollow_nodes
+    from ..kubelet import KubeletServer, start_hollow_nodes
     from ..scheduler import Profile, Scheduler, new_default_framework
     from ..store import kv
 
@@ -73,6 +73,7 @@ def main(argv=None) -> None:
     sched.run()
     mgr.run()
     endpoints.run()
+    kubelet_server = KubeletServer().start()
     if args.devices_per_node > 0:
         from ..kubelet import HollowKubelet
         from ..kubelet.cm import ContainerManager, DevicePlugin
@@ -84,10 +85,12 @@ def main(argv=None) -> None:
             cmgr.devices.register(DevicePlugin("google.com/tpu", {
                 f"tpu{d}": d * num_numa // args.devices_per_node
                 for d in range(args.devices_per_node)}))
-            kubelets.append(HollowKubelet(client, factory, f"hollow-{i}",
-                                          container_manager=cmgr).start())
+            kubelets.append(HollowKubelet(
+                client, factory, f"hollow-{i}", container_manager=cmgr,
+                kubelet_server=kubelet_server).start())
     else:
-        kubelets = start_hollow_nodes(client, factory, args.nodes)
+        kubelets = start_hollow_nodes(client, factory, args.nodes,
+                                      kubelet_server=kubelet_server)
 
     print(f"cluster up: apiserver={server.url} nodes={args.nodes} "
           f"scheduler={'tpu-batch' if args.tpu_batch else 'per-pod'}")
@@ -101,6 +104,7 @@ def main(argv=None) -> None:
     stop.wait()
     for k in kubelets:
         k.stop()
+    kubelet_server.stop()
     endpoints.stop()
     mgr.stop()
     sched.stop()

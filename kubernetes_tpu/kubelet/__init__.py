@@ -2,3 +2,4 @@
 
 from .cri import FakeRuntimeService  # noqa: F401
 from .hollow import HollowKubelet, start_hollow_nodes  # noqa: F401
+from .server import KubeletServer  # noqa: F401

@@ -30,6 +30,10 @@ class FakeRuntimeService:
         self._containers: dict[str, dict] = {}
         self._images: set[str] = set()
         self.start_latency = start_latency
+        # streaming seam (api.proto Exec/Attach/PortForward rpcs): log
+        # lines + a condvar for `follow`, checkpoint archives
+        self._log_cond = threading.Condition(self._lock)
+        self._checkpoints: dict[str, dict] = {}
 
     # -- RuntimeService --------------------------------------------------
 
@@ -76,6 +80,20 @@ class FakeRuntimeService:
             c = self._containers[container_id]
             c["state"] = RUNNING
             c["startedAt"] = time.time()
+            name = c["name"] or container_id
+            c["logs"] = [f"{name} starting\n", f"{name} ready\n"]
+            interval = (c["config"].get("annotations") or {}).get(
+                "hollow/log-interval-seconds")
+            if interval is not None:
+                try:
+                    every = float(interval)
+                except (TypeError, ValueError):
+                    every = 0.0
+                if every > 0:  # <=0 would spin _advance_clock forever
+                    c["logEvery"] = every
+                    c["nextLogAt"] = c["startedAt"] + every
+                    c["logSeq"] = 0
+            self._log_cond.notify_all()
             # hollow semantics: a container may declare it exits by itself
             run_for = (c["config"].get("annotations") or {}).get("hollow/run-seconds")
             if run_for is not None:
@@ -107,10 +125,197 @@ class FakeRuntimeService:
 
     def _advance_clock(self) -> None:
         now = time.time()
+        logged = False
         for c in self._containers.values():
             if c["state"] == RUNNING and c.get("exitAt") and now >= c["exitAt"]:
                 c["state"] = EXITED
                 c["exitCode"] = c.get("plannedExitCode", 0)
+            while (c["state"] == RUNNING and c.get("logEvery")
+                   and now >= c["nextLogAt"]):
+                c["logs"].append(f"tick {c['logSeq']}\n")
+                c["logSeq"] += 1
+                c["nextLogAt"] += c["logEvery"]
+                logged = True
+        if logged:
+            self._log_cond.notify_all()
+
+    # -- streaming (api.proto Exec/Attach/PortForward/ReattachContainer;
+    # the reference runtime returns a streaming-server URL from these
+    # rpcs — in-process, the seam is a direct call taking an IO adapter
+    # with read_stdin()/write_stdout()/write_stderr()) ------------------
+
+    def read_logs(self, container_id: str, follow: bool = False,
+                  tail: int | None = None, stop=None, poll: float = 0.1,
+                  since_index: int | None = None):
+        """Yield log lines; with follow, block for appends until the
+        container exits or `stop` (an Event) is set.  `since_index`
+        pins the start position eagerly captured by the caller (attach
+        must snapshot the tail BEFORE it starts pumping stdin, or an
+        immediate write lands in the skipped prefix)."""
+        sent = 0
+        with self._log_cond:
+            c = self._containers.get(container_id)
+            if c is None:
+                raise KeyError(container_id)
+            logs = c.setdefault("logs", [])
+            if since_index is not None:
+                sent = min(since_index, len(logs))
+            elif tail is not None:
+                sent = max(0, len(logs) - tail)
+        while True:
+            with self._log_cond:
+                self._advance_clock()
+                c = self._containers.get(container_id)
+                if c is None:
+                    return
+                batch = c["logs"][sent:]
+                sent += len(batch)
+                done = not follow or c["state"] != RUNNING
+                if not batch and not done:
+                    # timed wait doubles as the tick/exit poll
+                    self._log_cond.wait(poll)
+            yield from batch
+            if batch:
+                continue
+            if done or (stop is not None and stop.is_set()):
+                return
+
+    def exec_stream(self, container_id: str, command: list[str], io,
+                    tty: bool = False) -> int:
+        """Scripted in-container shell; returns the exit code.
+
+        The hollow runtime executes nothing, so exec semantics are a
+        deterministic script over the container's config — enough to
+        exercise the full kubectl<->apiserver<->kubelet plumbing the
+        reference drives through a real shell."""
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is None or c["state"] != RUNNING:
+                io.write_stderr(b"container not running\n")
+                return 126
+            cfg = dict(c["config"])
+            sandbox = self._sandboxes.get(c["sandboxId"]) or {}
+            hostname = (sandbox.get("config") or {}).get("name", "")
+        return self._run_scripted(command, io, cfg, hostname)
+
+    def _run_scripted(self, argv: list[str], io, cfg: dict,
+                      hostname: str) -> int:
+        if not argv:
+            io.write_stderr(b"no command\n")
+            return 126
+        cmd, args = argv[0], argv[1:]
+        if cmd in ("sh", "/bin/sh", "bash") and len(args) >= 2 \
+                and args[0] == "-c":
+            inner = args[1].split()
+            if inner[:1] == ["exit"]:
+                try:
+                    return int(inner[1]) if len(inner) > 1 else 0
+                except ValueError:
+                    return 2
+            return self._run_scripted(inner, io, cfg, hostname)
+        if cmd == "echo":
+            io.write_stdout((" ".join(args) + "\n").encode())
+            return 0
+        if cmd == "cat" and not args:
+            while True:
+                data = io.read_stdin()
+                if data is None:
+                    return 0
+                io.write_stdout(data)
+        if cmd == "env":
+            env = cfg.get("env") or [{"name": "PATH", "value": "/usr/bin"}]
+            for e in env:
+                io.write_stdout(f"{e['name']}={e.get('value', '')}\n".encode())
+            io.write_stdout(f"HOSTNAME={hostname}\n".encode())
+            return 0
+        if cmd == "hostname":
+            io.write_stdout((hostname + "\n").encode())
+            return 0
+        if cmd == "true":
+            return 0
+        if cmd == "false":
+            return 1
+        if cmd == "exit":
+            try:
+                return int(args[0]) if args else 0
+            except ValueError:
+                return 2
+        if cmd == "sleep":
+            try:
+                time.sleep(min(float(args[0]), 10.0) if args else 0.0)
+            except ValueError:
+                return 2
+            return 0
+        io.write_stderr(f"sh: {cmd}: command not found\n".encode())
+        return 127
+
+    def attach_stream(self, container_id: str, io, stop=None,
+                      tty: bool = False) -> int:
+        """Attach to the scripted console: stream log appends to stdout;
+        stdin lines are appended to the log (as if the entrypoint read
+        them) and echoed back when tty."""
+        import threading
+        done = threading.Event()
+
+        def pump_stdin():
+            while not done.is_set():
+                data = io.read_stdin()
+                if data is None:
+                    return
+                with self._log_cond:
+                    c = self._containers.get(container_id)
+                    if c is None:
+                        return
+                    c.setdefault("logs", []).append(
+                        data.decode(errors="replace"))
+                    self._log_cond.notify_all()
+
+        with self._log_cond:
+            c = self._containers.get(container_id)
+            start = len(c.get("logs") or ()) if c else 0
+        t = threading.Thread(target=pump_stdin, daemon=True)
+        t.start()
+        try:
+            for line in self.read_logs(container_id, follow=True,
+                                       since_index=start, stop=stop):
+                io.write_stdout(line.encode())
+        finally:
+            done.set()
+        return 0
+
+    def portforward_stream(self, sandbox_id: str, port: int, io) -> None:
+        """Scripted pod network: a declared containerPort answers with a
+        banner then echoes; anything else refuses (the contract a real
+        CRI implements by dialing the pod's netns)."""
+        with self._lock:
+            declared = {
+                p.get("containerPort")
+                for c in self._containers.values()
+                if c["sandboxId"] == sandbox_id and c["state"] == RUNNING
+                for p in (c["config"].get("ports") or ())}
+        if port not in declared:
+            io.error(f"connection refused: port {port} not declared")
+            return
+        io.write_data(f"hollow-port {port}\n".encode())
+        while True:
+            data = io.read_data()
+            if data is None:
+                return
+            io.write_data(data)
+
+    def checkpoint_container(self, container_id: str) -> str:
+        """CRI CheckpointContainer (api.proto): snapshot the container's
+        fake state; returns the archive name the kubelet reports."""
+        with self._lock:
+            c = self._containers.get(container_id)
+            if c is None:
+                raise KeyError(container_id)
+            archive = (f"checkpoint-{c['name'] or container_id}-"
+                       f"{int(time.time())}.tar")
+            self._checkpoints[archive] = {
+                "container": dict(c, config=dict(c["config"])),
+                "at": time.time()}
+        return archive
 
     # -- ImageService ----------------------------------------------------
 

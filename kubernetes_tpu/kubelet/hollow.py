@@ -34,7 +34,7 @@ class HollowKubelet:
                  pods: int = 110, labels: dict[str, str] | None = None,
                  heartbeat_interval: float = 10.0,
                  runtime: FakeRuntimeService | None = None,
-                 container_manager=None):
+                 container_manager=None, kubelet_server=None):
         self.client = client
         self.node_name = node_name
         self.cpu, self.memory, self.max_pods = cpu, memory, pods
@@ -44,16 +44,22 @@ class HollowKubelet:
         # optional cm.ContainerManager: runs resource admission (cpu/memory/
         # device/topology managers) before containers start
         self.container_manager = container_manager
+        # optional server.KubeletServer: serves logs/exec/attach/
+        # portForward for this node; its port lands in node status
+        self.kubelet_server = kubelet_server
         self.pod_informer = factory.informer(PODS)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
-        # pod uid -> {"sandbox": id, "containers": {name: id}}
+        # pod uid -> {"sandbox": id, "containers": {name: id},
+        #             "key": (ns, podname)}
         self._pod_state: dict[str, dict] = {}
         self._lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> "HollowKubelet":
+        if self.kubelet_server is not None:
+            self.kubelet_server.register(self)
         self._register_node()
         if self.container_manager is not None:
             # reconcile checkpointed allocations against live pods: anything
@@ -75,6 +81,8 @@ class HollowKubelet:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.kubelet_server is not None:
+            self.kubelet_server.unregister(self)
 
     # -- node registration + heartbeats ----------------------------------
 
@@ -97,6 +105,14 @@ class HollowKubelet:
             "nodeInfo": {"kubeletVersion": "hollow", "architecture": "tpu"},
             "lastHeartbeatTime": time.time(),
         }
+        if self.kubelet_server is not None:
+            # nodestatus daemonEndpoints: how the apiserver's node tunnel
+            # finds this kubelet (pkg/kubelet/nodestatus/setters.go)
+            node["status"]["addresses"] = [
+                {"type": "InternalIP",
+                 "address": self.kubelet_server.host}]
+            node["status"]["daemonEndpoints"] = {
+                "kubeletEndpoint": {"Port": self.kubelet_server.port}}
         try:
             self.client.create(NODES, node)
         except kv.AlreadyExistsError:
@@ -160,14 +176,17 @@ class HollowKubelet:
             if st is None:
                 sandbox = self.runtime.run_pod_sandbox(
                     {"name": meta.name(pod), "uid": uid})
-                st = self._pod_state[uid] = {"sandbox": sandbox, "containers": {}}
+                st = self._pod_state[uid] = {
+                    "sandbox": sandbox, "containers": {},
+                    "key": (meta.namespace(pod), meta.name(pod))}
             for c in (pod.get("spec") or {}).get("containers") or ():
                 if c["name"] in st["containers"]:
                     continue
                 self.runtime.pull_image(c.get("image", ""))
                 cid = self.runtime.create_container(st["sandbox"], {
                     "name": c["name"], "image": c.get("image", ""),
-                    "annotations": meta.annotations(pod)})
+                    "annotations": meta.annotations(pod),
+                    "env": c.get("env"), "ports": c.get("ports")})
                 self.runtime.start_container(cid)
                 st["containers"][c["name"]] = cid
         self._report_status(pod)
@@ -262,6 +281,22 @@ class HollowKubelet:
             if meta.uid(p) == uid:
                 return p
         return None
+
+    # -- streaming-server lookups ---------------------------------------
+
+    def lookup_pod(self, ns: str, name: str) -> dict | None:
+        """(sandbox id, container name->id) for a pod this node runs."""
+        with self._lock:
+            for st in self._pod_state.values():
+                if st.get("key") == (ns, name):
+                    return {"sandbox": st["sandbox"],
+                            "containers": dict(st["containers"])}
+        return None
+
+    def list_pod_keys(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return [st["key"] for st in self._pod_state.values()
+                    if "key" in st]
 
 
 def start_hollow_nodes(client: Client, factory: SharedInformerFactory,

@@ -580,10 +580,17 @@ class TestReviewRegressions:
         srv, store, client = server
         client.create("pods", make_pod("sub"))
         req = urllib.request.Request(
-            srv.url + "/api/v1/namespaces/default/pods/sub/exec")
+            srv.url + "/api/v1/namespaces/default/pods/sub/bogus")
         with pytest.raises(urllib.error.HTTPError) as exc:
             urllib.request.urlopen(req)
         assert exc.value.code == 404
+        # exec IS a subresource now (kubelet tunnel) — an unscheduled
+        # pod gets a 400, not a 404 route miss
+        req = urllib.request.Request(
+            srv.url + "/api/v1/namespaces/default/pods/sub/exec")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 400
         # DELETE on a bogus subresource must NOT delete the parent
         req = urllib.request.Request(
             srv.url + "/api/v1/namespaces/default/pods/sub/anything",

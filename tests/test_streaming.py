@@ -1,0 +1,345 @@
+"""Interactive streaming: kubelet server + apiserver tunnel + kubectl
+exec / attach / port-forward / logs -f.
+
+Reference behaviors under test: pkg/kubelet/server/server.go:949-967
+(the kubelet's containerLogs/exec/attach/portForward/checkpoint
+endpoints), pkg/registry/core/pod/rest/subresources.go (the apiserver
+proxying pod subresources to the node), and kubectl/pkg/cmd/{exec,
+attach,portforward,logs} (the client verbs).  Everything rides the real
+HTTP surfaces: kubectl -> apiserver -> kubelet -> fake CRI.
+"""
+
+import io
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.cli.kubectl import Kubectl
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import PODS
+from kubernetes_tpu.client.http_client import HTTPClient
+from kubernetes_tpu.kubelet import KubeletServer, start_hollow_nodes
+from kubernetes_tpu.kubelet import streams
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import wait_for
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    store = kv.MemoryStore(history=100_000)
+    server = APIServer(store).start()
+    local = LocalClient(store)
+    factory = SharedInformerFactory(local)
+    factory.start()
+    factory.wait_for_cache_sync()
+    kubelet_server = KubeletServer().start()
+    kubelets = start_hollow_nodes(local, factory, 2,
+                                  kubelet_server=kubelet_server)
+    http = HTTPClient.from_url(server.url)
+    yield http, local, kubelet_server
+    for k in kubelets:
+        k.stop()
+    kubelet_server.stop()
+    factory.stop()
+    server.stop()
+    local.close()
+
+
+def run_pod(local, name, node="hollow-0", containers=None,
+            annotations=None):
+    """A pod pre-bound to `node` (no scheduler in this harness); waits
+    until the kubelet has started its containers."""
+    pod = meta.new_object("Pod", name, "default")
+    if annotations:
+        pod["metadata"]["annotations"] = annotations
+    pod["spec"] = {"nodeName": node,
+                   "containers": containers or [{"name": "c0",
+                                                 "image": "img"}]}
+    local.create(PODS, pod)
+    assert wait_for(lambda: (local.get(PODS, "default", name)
+                             .get("status") or {}).get("phase") == "Running")
+    return pod
+
+
+def kubectl(http) -> tuple[Kubectl, io.StringIO]:
+    out = io.StringIO()
+    return Kubectl(http, out), out
+
+
+class TestExec:
+    def test_echo_round_trip(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "exec-echo")
+        k, out = kubectl(http)
+        rc = k.exec("exec-echo", "default", ["echo", "hello", "tpu"])
+        assert rc == 0
+        assert out.getvalue() == "hello tpu\n"
+
+    def test_stdin_cat(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "exec-cat")
+        k, out = kubectl(http)
+        rc = k.exec("exec-cat", "default", ["cat"],
+                    stdin=b"line1\nline2\n")
+        assert rc == 0
+        assert out.getvalue() == "line1\nline2\n"
+
+    def test_exit_codes_and_stderr(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "exec-codes")
+        k, _ = kubectl(http)
+        assert k.exec("exec-codes", "default", ["true"]) == 0
+        err = io.StringIO()
+        assert k.exec("exec-codes", "default", ["false"], err=err) == 1
+        err = io.StringIO()
+        rc = k.exec("exec-codes", "default", ["no-such-binary"], err=err)
+        assert rc == 127
+        assert "command not found" in err.getvalue()
+        assert k.exec("exec-codes", "default",
+                      ["sh", "-c", "exit 42"]) == 42
+
+    def test_env_and_hostname(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "exec-env", containers=[{
+            "name": "c0", "image": "img",
+            "env": [{"name": "MODE", "value": "tpu"}]}])
+        k, out = kubectl(http)
+        assert k.exec("exec-env", "default", ["env"]) == 0
+        assert "MODE=tpu" in out.getvalue()
+        k2, out2 = kubectl(http)
+        assert k2.exec("exec-env", "default", ["hostname"]) == 0
+        assert out2.getvalue().strip() == "exec-env"
+
+    def test_missing_pod_and_container(self, cluster):
+        http, local, _ = cluster
+        k, out = kubectl(http)
+        assert k.exec("nope", "default", ["true"]) == 1
+        assert "Error" in out.getvalue()
+        run_pod(local, "exec-badctr")
+        k2, out2 = kubectl(http)
+        assert k2.exec("exec-badctr", "default", ["true"],
+                       container="zz") == 1
+        assert "not found" in out2.getvalue()
+
+    def test_unscheduled_pod_rejected(self, cluster):
+        http, local, _ = cluster
+        pod = meta.new_object("Pod", "exec-pending", "default")
+        pod["spec"] = {"containers": [{"name": "c0", "image": "img"}]}
+        local.create(PODS, pod)
+        k, out = kubectl(http)
+        assert k.exec("exec-pending", "default", ["true"]) == 1
+        assert "not scheduled" in out.getvalue()
+
+
+class TestLogs:
+    def test_basic_and_tail(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "logs-basic")
+        k, out = kubectl(http)
+        assert k.logs("logs-basic", "default") == 0
+        assert out.getvalue() == "c0 starting\nc0 ready\n"
+        k2, out2 = kubectl(http)
+        assert k2.logs("logs-basic", "default", tail=1) == 0
+        assert out2.getvalue() == "c0 ready\n"
+
+    def test_follow_sees_ticks_until_exit(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "logs-follow", annotations={
+            "hollow/run-seconds": "1.2",
+            "hollow/log-interval-seconds": "0.25"})
+        k, out = kubectl(http)
+        t0 = time.monotonic()
+        assert k.logs("logs-follow", "default", follow=True) == 0
+        took = time.monotonic() - t0
+        text = out.getvalue()
+        assert "tick 0" in text and "tick 1" in text
+        # follow blocked until the container exited, then terminated
+        assert took >= 0.8
+
+    def test_container_selection(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "logs-two", containers=[
+            {"name": "a", "image": "img"}, {"name": "b", "image": "img"}])
+        k, out = kubectl(http)
+        assert k.logs("logs-two", "default", container="b") == 0
+        assert out.getvalue() == "b starting\nb ready\n"
+        # ambiguous without -c
+        k2, out2 = kubectl(http)
+        rc = k2.logs("logs-two", "default")
+        assert rc != 0 or "container name required" in out2.getvalue()
+
+
+class TestAttach:
+    def test_attach_streams_console(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "attach-1", annotations={
+            "hollow/run-seconds": "1.0",
+            "hollow/log-interval-seconds": "0.2"})
+        k, out = kubectl(http)
+        rc = k.attach("attach-1", "default", stdin=b"typed\n")
+        assert rc == 0
+        text = out.getvalue()
+        # attach begins at the log tail: sees ticks + the echoed stdin,
+        # not the startup lines
+        assert "tick" in text
+        assert "typed" in text
+        assert "starting" not in text
+
+
+class TestPortForward:
+    def test_round_trip(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "pf-1", containers=[{
+            "name": "c0", "image": "img",
+            "ports": [{"containerPort": 9090}]}])
+        k, _ = kubectl(http)
+        got_port = []
+        ready = threading.Event()
+
+        def go():
+            k.port_forward("pf-1", "default", ":9090",
+                           ready=lambda p: (got_port.append(p),
+                                            ready.set()),
+                           once=True)
+
+        t = threading.Thread(target=go, daemon=True)
+        t.start()
+        assert ready.wait(10.0)
+        with socket.create_connection(("127.0.0.1", got_port[0]),
+                                      timeout=10.0) as conn:
+            banner = conn.recv(1024)
+            assert banner == b"hollow-port 9090\n"
+            conn.sendall(b"ping")
+            assert conn.recv(1024) == b"ping"
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+
+    def test_undeclared_port_refused(self, cluster):
+        http, local, _ = cluster
+        run_pod(local, "pf-2")
+        k, out = kubectl(http)
+        ready = threading.Event()
+
+        def go():
+            k.port_forward("pf-2", "default", ":7777",
+                           ready=lambda p: (ready.set(),
+                                            setattr(go, "port", p)),
+                           once=True)
+
+        t = threading.Thread(target=go, daemon=True)
+        t.start()
+        assert ready.wait(10.0)
+        with socket.create_connection(("127.0.0.1", go.port),
+                                      timeout=10.0) as conn:
+            assert conn.recv(1024) == b""  # closed, no banner
+        t.join(timeout=10.0)
+        assert "connection refused" in k.out.getvalue()
+
+
+class TestKubeletEndpoints:
+    """Direct kubelet-server surface (server.go:949 route list)."""
+
+    def _request(self, ks, method, path):
+        conn = socket.create_connection((ks.host, ks.port), timeout=10.0)
+        conn.sendall(f"{method} {path} HTTP/1.1\r\n"
+                     f"Host: x\r\nConnection: close\r\n\r\n".encode())
+        data = b""
+        while True:
+            chunk = conn.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+        conn.close()
+        head, _, body = data.partition(b"\r\n\r\n")
+        return int(head.split()[1]), body
+
+    def test_healthz_pods_stats(self, cluster):
+        http, local, ks = cluster
+        run_pod(local, "ep-1")
+        code, body = self._request(ks, "GET", "/healthz")
+        assert code == 200 and body == b"ok"
+        code, body = self._request(ks, "GET", "/pods?node=hollow-0")
+        assert code == 200
+        names = {i["name"] for i in json.loads(body)["items"]}
+        assert "ep-1" in names
+        code, body = self._request(ks, "GET", "/stats/summary")
+        assert code == 200
+        assert any(n["numPods"] for n in json.loads(body)["nodes"])
+
+    def test_checkpoint(self, cluster):
+        http, local, ks = cluster
+        run_pod(local, "ep-ckpt")
+        code, body = self._request(
+            ks, "POST", "/checkpoint/default/ep-ckpt/c0")
+        assert code == 200
+        items = json.loads(body)["items"]
+        assert len(items) == 1 and items[0].startswith("checkpoint-c0")
+        code, _ = self._request(ks, "GET", "/checkpoint/default/ep-ckpt/c0")
+        assert code == 405
+
+    def test_upgrade_required_without_header(self, cluster):
+        http, local, ks = cluster
+        run_pod(local, "ep-up")
+        code, body = self._request(
+            ks, "POST", "/exec/default/ep-up/c0?command=true")
+        assert code == 400
+        assert b"upgrade" in body.lower()
+
+
+class TestSubresourceRouting:
+    def test_write_verbs_rejected_and_parent_safe(self, cluster):
+        """DELETE/PUT/PATCH on a stream subresource must 405 and never
+        touch the parent pod (the parent-mutation hazard the apiserver
+        depth tests guard for bogus subresources)."""
+        import urllib.error
+        import urllib.request
+        http, local, _ = cluster
+        run_pod(local, "sub-guard")
+        base = (f"http://{http.host}:{http.port}"
+                f"/api/v1/namespaces/default/pods/sub-guard")
+        for verb, sub in (("DELETE", "exec"), ("PUT", "log"),
+                          ("PATCH", "attach"), ("DELETE", "portforward")):
+            req = urllib.request.Request(f"{base}/{sub}", method=verb,
+                                         data=b"{}")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req)
+            assert exc.value.code == 405, (verb, sub)
+        local.get(PODS, "default", "sub-guard")  # parent untouched
+
+    def test_stream_subresources_are_pods_only(self, cluster):
+        import urllib.error
+        import urllib.request
+        http, local, _ = cluster
+        req = urllib.request.Request(
+            f"http://{http.host}:{http.port}"
+            f"/api/v1/namespaces/default/configmaps/x/log")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 404
+
+
+class TestStreamProtocol:
+    def test_frame_round_trip(self):
+        a, b = socket.socketpair()
+        fa, fb = streams.FrameSock(a), streams.FrameSock(b)
+        fa.send(streams.STDOUT, b"x" * 70000)  # multi-recv payload
+        fa.send_close(streams.STDIN)
+        assert fb.recv() == (streams.STDOUT, b"x" * 70000)
+        assert fb.recv() == (streams.CLOSE, bytes([streams.STDIN]))
+        fa.close()
+        assert fb.recv() is None
+        fb.close()
+
+    def test_exit_status_parse(self):
+        assert streams.parse_exit_status(
+            json.dumps({"status": "Success"}).encode()) == (0, "")
+        code, msg = streams.parse_exit_status(json.dumps({
+            "status": "Failure", "message": "boom",
+            "details": {"causes": [{"reason": "ExitCode",
+                                    "message": "7"}]}}).encode())
+        assert code == 7 and msg == "boom"
