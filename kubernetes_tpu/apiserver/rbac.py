@@ -295,11 +295,11 @@ def bootstrap_policy(store: kv.MemoryStore) -> None:
                  [_user("system:kube-controller-manager")]),
         _binding("system:node", "system:node",
                  [_group("system:nodes"),
-                  # plain-HTTP serving has no TLS client-cert authn, so a
-                  # joined kubelet keeps speaking with its bootstrap-token
-                  # identity; the issued CSR certificate is its identity
-                  # artifact (documented divergence from the reference's
-                  # cert-rotating node authn)
+                  # a TLS cluster (kubeadm init) authenticates joined
+                  # kubelets by their issued client cert (system:nodes
+                  # group via the O field); plain-HTTP clusters have no
+                  # cert authn, so the bootstrap-token identity keeps
+                  # node rights there
                   _group("system:bootstrappers")]),
         _binding("system:node-bootstrapper", "system:node-bootstrapper",
                  [_group("system:bootstrappers")]),

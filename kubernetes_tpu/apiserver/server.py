@@ -45,6 +45,7 @@ from ..component_base import configz
 from ..store import kv
 from . import admission as adm
 from . import audit as auditlib
+from . import authn as authnlib
 from . import crd as crdlib
 from . import flowcontrol
 from . import managedfields as mflib
@@ -67,6 +68,11 @@ SUBRESOURCES = {"status", "binding", "eviction", "scale"}
 # (pkg/registry/core/pod/rest/subresources.go -> UpgradeAwareProxy);
 # routed only for pods and only on GET/POST — never as write targets
 NODE_STREAM_SUBRESOURCES = {"log", "exec", "attach", "portforward"}
+
+# subresources with no stored object behind them: tunnels + token minting
+# (serviceaccounts/{name}/token is POST-only, token.go) — a write verb
+# must never fall through to the parent object
+VIRTUAL_SUBRESOURCES = NODE_STREAM_SUBRESOURCES | {"token"}
 
 # built-in group routing (/apis/{group}/{version}); all resources share the
 # flat store namespace, so the group prefix is addressing only
@@ -97,6 +103,23 @@ def status_error(code: int, reason: str, message: str) -> dict:
             "reason": reason, "message": message, "code": code}
 
 
+class _QuietTLSServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that doesn't spray tracebacks when a TLS
+    handshake fails (wrong client CA, plain-HTTP probe, port scan) —
+    those are client errors, not server bugs.  Genuine server faults
+    (bare OSError: ENOSPC, EMFILE) still get the full report."""
+
+    def handle_error(self, request, client_address):
+        import ssl
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ssl.SSLError, ConnectionError, TimeoutError)):
+            logger.debug("connection error from %s: %s",
+                         client_address, exc)
+            return
+        super().handle_error(request, client_address)
+
+
 class _Route:
     __slots__ = ("resource", "ns", "name", "subresource", "group", "version",
                  "query", "path")
@@ -122,7 +145,9 @@ class APIServer:
                  admission_chain: adm.Chain | None = None,
                  enable_default_admission: bool = False,
                  flow_dispatcher: flowcontrol.Dispatcher | None = None,
-                 audit_logger: auditlib.AuditLogger | None = None):
+                 audit_logger: auditlib.AuditLogger | None = None,
+                 tls: dict | None = None,
+                 enable_service_accounts: bool = False):
         self.store = store
         self.token = token
         # static bearer tokens -> identity (the reference's token-auth
@@ -163,9 +188,34 @@ class APIServer:
                                    meta.name(obj))
         except Exception:  # noqa: BLE001 — store without that resource yet
             pass
+        # ServiceAccount token issuer (TokenRequest + SA JWT authn —
+        # pkg/serviceaccount/jwt.go); opt-in: it persists a signing-key
+        # Secret in kube-system
+        self.sa_issuer = (authnlib.ServiceAccountIssuer(store)
+                          if enable_service_accounts else None)
         handler = self._make_handler()
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd = _QuietTLSServer((host, port), handler)
         self.httpd.daemon_threads = True
+        # TLS serving + X.509 client-cert authn (x509.go): wrap the
+        # listening socket; a client cert chained to client_ca_file
+        # authenticates as CN/O
+        self.tls = tls
+        self.client_ca_auth = bool(tls and tls.get("client_ca_file"))
+        if tls:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=tls["cert_file"],
+                                keyfile=tls["key_file"])
+            if self.client_ca_auth:
+                ctx.load_verify_locations(cafile=tls["client_ca_file"])
+                ctx.verify_mode = ssl.CERT_OPTIONAL
+            # handshake deferred to the per-request handler thread
+            # (Handler.setup): with do_handshake_on_connect=True a single
+            # silent client would stall the accept loop — and every
+            # other connection — for the duration of its handshake
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
 
@@ -240,7 +290,8 @@ class APIServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self.httpd.server_address[0]}:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.httpd.server_address[0]}:{self.port}"
 
     # -- request handling ------------------------------------------------
 
@@ -249,6 +300,18 @@ class APIServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                # deferred TLS handshake (see the wrap_socket call):
+                # bounded so a silent peer costs one handler thread for
+                # 30s, not the accept loop.  self.connection doesn't
+                # exist until super().setup(); the raw socket is
+                # self.request here.
+                if hasattr(self.request, "do_handshake"):
+                    self.request.settimeout(30.0)
+                    self.request.do_handshake()
+                    self.request.settimeout(None)
+                super().setup()
 
             def log_message(self, fmt, *args):  # route through logging
                 logger.debug("apiserver: " + fmt, *args)
@@ -271,8 +334,24 @@ class APIServer:
                 join` fetch kube-public/cluster-info before it has any
                 credential); with token-auth but no authorizer, anonymous
                 would mean unrestricted, so it stays a 401."""
+                # X.509 client cert (request/x509/x509.go): the TLS
+                # layer already verified the chain against the client
+                # CA; CN/O become the identity
+                if server.client_ca_auth:
+                    try:
+                        ident = authnlib.x509_identity(
+                            self.connection.getpeercert())
+                    except (ValueError, OSError):
+                        ident = None
+                    if ident is not None:
+                        user, groups = ident
+                        return (user, tuple(groups)
+                                + ("system:authenticated",))
                 auth = self.headers.get("Authorization", "")
-                authn_on = bool(server.tokens) or server.bootstrap_token_auth
+                authn_on = (bool(server.tokens)
+                            or server.bootstrap_token_auth
+                            or server.client_ca_auth
+                            or server.sa_issuer is not None)
                 if not authn_on or (not auth
                                     and server.authorizer is not None):
                     return ("system:anonymous", ("system:unauthenticated",))
@@ -282,6 +361,9 @@ class APIServer:
                     if ident is None and server.bootstrap_token_auth \
                             and "." in bearer:
                         ident = server._bootstrap_identity(bearer)
+                    if ident is None and server.sa_issuer is not None \
+                            and bearer.count(".") == 2:
+                        ident = server.sa_issuer.verify(bearer)
                     if ident is not None:
                         # every real credential is in system:authenticated
                         # (the group system:basic-user rights bind to)
@@ -380,9 +462,11 @@ class APIServer:
                     if len(rest) > 3:
                         r.name = rest[3]
                     if len(rest) > 4:
-                        known = SUBRESOURCES | (
-                            NODE_STREAM_SUBRESOURCES
-                            if r.resource == "pods" else set())
+                        known = SUBRESOURCES
+                        if r.resource == "pods":
+                            known = known | NODE_STREAM_SUBRESOURCES
+                        elif r.resource == "serviceaccounts":
+                            known = known | {"token"}
                         if rest[4] in known and len(rest) == 5:
                             r.subresource = rest[4]
                         else:  # unknown subresource -> 404
@@ -530,7 +614,11 @@ class APIServer:
                     self._send_json(404, status_error(404, "NotFound", path))
                     return
                 try:
-                    if r.resource == "pods" \
+                    if r.subresource == "token":
+                        self._send_json(405, status_error(
+                            405, "MethodNotAllowed",
+                            "token requests are POST-only"))
+                    elif r.resource == "pods" \
                             and r.subresource in NODE_STREAM_SUBRESOURCES:
                         self._node_stream(r)
                     elif r.query.get("watch", ["false"])[0] == "true":
@@ -866,6 +954,9 @@ class APIServer:
                 if r.subresource == "eviction":
                     self._post_eviction(r, obj)
                     return
+                if r.subresource == "token":
+                    self._post_token(r, obj)
+                    return
                 if r.resource == "selfsubjectaccessreviews":
                     # authorization.k8s.io SelfSubjectAccessReview: answer
                     # "can I?" for the REQUESTING identity; never persisted
@@ -921,6 +1012,51 @@ class APIServer:
                 except kv.AlreadyExistsError as e:
                     self._send_json(409, status_error(409, "AlreadyExists",
                                                       str(e)))
+
+            def _post_token(self, r: _Route, req: dict) -> None:
+                """POST serviceaccounts/{name}/token (TokenRequest,
+                pkg/registry/core/serviceaccount/storage/token.go):
+                mint a bound SA JWT for an existing account."""
+                if server.sa_issuer is None:
+                    self._send_json(404, status_error(
+                        404, "NotFound",
+                        "service account tokens are not enabled"))
+                    return
+                try:
+                    sa = server.store.get("serviceaccounts", r.ns or "",
+                                          r.name)
+                except kv.NotFoundError as e:
+                    self._send_json(404, status_error(404, "NotFound",
+                                                      str(e)))
+                    return
+                spec = (req or {}).get("spec") or {}
+                try:
+                    seconds = int(spec.get("expirationSeconds") or 3600)
+                except (TypeError, ValueError):
+                    seconds = -1
+                if seconds < 600:
+                    # token.go: "may not specify a duration less than
+                    # 10 minutes" — reject, never silently extend
+                    self._send_json(400, status_error(
+                        400, "BadRequest",
+                        "expirationSeconds must be an integer >= 600"))
+                    return
+                audiences = tuple(spec.get("audiences") or ())
+                token, exp = server.sa_issuer.issue(
+                    r.ns or "", r.name, uid=meta.uid(sa) or "",
+                    expiration_seconds=seconds, audiences=audiences)
+                import time as timelib
+                stamp = timelib.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         timelib.gmtime(exp))
+                self._send_json(201, {
+                    "kind": "TokenRequest",
+                    "apiVersion": "authentication.k8s.io/v1",
+                    "metadata": {"name": r.name, "namespace": r.ns},
+                    "spec": {"expirationSeconds": seconds,
+                             "audiences": list(audiences)},
+                    "status": {"token": token,
+                               "expirationTimestamp": stamp}})
+                self._audit(r, "create", 201)
 
             def _post_binding(self, r: _Route, binding: dict) -> None:
                 """POST pods/{name}/binding (registry/core/pod/storage
@@ -1003,9 +1139,9 @@ class APIServer:
                 if r.resource is None or r.name is None:
                     self._send_json(404, status_error(404, "NotFound", r.path))
                     return
-                if r.subresource in NODE_STREAM_SUBRESOURCES:
-                    # stream subresources are GET/POST tunnels only —
-                    # a write here must never touch the parent object
+                if r.subresource in VIRTUAL_SUBRESOURCES:
+                    # virtual subresources are GET/POST-only — a write
+                    # here must never touch the parent object
                     self._drain_body()
                     self._send_json(405, status_error(
                         405, "MethodNotAllowed",
@@ -1076,9 +1212,9 @@ class APIServer:
                 if r.resource is None or r.name is None:
                     self._send_json(404, status_error(404, "NotFound", r.path))
                     return
-                if r.subresource in NODE_STREAM_SUBRESOURCES:
-                    # stream subresources are GET/POST tunnels only —
-                    # a write here must never touch the parent object
+                if r.subresource in VIRTUAL_SUBRESOURCES:
+                    # virtual subresources are GET/POST-only — a write
+                    # here must never touch the parent object
                     self._drain_body()
                     self._send_json(405, status_error(
                         405, "MethodNotAllowed",
@@ -1230,9 +1366,9 @@ class APIServer:
                 if r.resource is None or r.name is None:
                     self._send_json(404, status_error(404, "NotFound", r.path))
                     return
-                if r.subresource in NODE_STREAM_SUBRESOURCES:
-                    # stream subresources are GET/POST tunnels only —
-                    # a write here must never touch the parent object
+                if r.subresource in VIRTUAL_SUBRESOURCES:
+                    # virtual subresources are GET/POST-only — a write
+                    # here must never touch the parent object
                     self._drain_body()
                     self._send_json(405, status_error(
                         405, "MethodNotAllowed",

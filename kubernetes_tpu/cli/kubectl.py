@@ -345,10 +345,11 @@ class Kubectl:
             from urllib.parse import urlencode
             path = (f"/api/v1/namespaces/{namespace}/pods/{name}/log"
                     + ("?" + urlencode(q) if q else ""))
-            import http.client as hc
+            from ..client.http_client import make_connection
             # no socket timeout: -f follows a stream that may stay
             # silent indefinitely; the server closing ends the read
-            conn = hc.HTTPConnection(http.host, http.port)
+            conn = make_connection(http.host, http.port,
+                                   getattr(http, "_ssl_context", None))
             try:
                 conn.request("GET", path, headers=http._headers)
                 resp = conn.getresponse()
@@ -394,8 +395,9 @@ class Kubectl:
                            "(interactive streams ride the HTTP API)\n")
             return None
         try:
-            return streams.open_upgrade(http.host, http.port, path,
-                                        headers=http._headers)
+            return streams.open_upgrade(
+                http.host, http.port, path, headers=http._headers,
+                ssl_context=getattr(http, "_ssl_context", None))
         except streams.StreamError as e:
             self.out.write(f"Error: {e}\n")
             return None
@@ -534,8 +536,9 @@ class Kubectl:
 
         def serve(conn: socketlib.socket) -> None:
             try:
-                fs = streams.open_upgrade(http.host, http.port, path,
-                                          headers=http._headers)
+                fs = streams.open_upgrade(
+                    http.host, http.port, path, headers=http._headers,
+                    ssl_context=getattr(http, "_ssl_context", None))
             except streams.StreamError as e:
                 conn.close()
                 self.out.write(f"Error: {e}\n")
@@ -891,6 +894,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="kubectl", description=__doc__)
     ap.add_argument("--server", "-s", default="http://127.0.0.1:8080")
     ap.add_argument("--token", default=None)
+    ap.add_argument("--kubeconfig", default=None,
+                    help="kubeconfig file (kubeadm output): endpoint + "
+                         "pinned CA + client-cert or token credentials")
     ap.add_argument("--namespace", "-n", default="default")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -983,7 +989,10 @@ def run(argv: list[str] | None = None, client: Client | None = None,
     args = build_parser().parse_args(argv)
     out = out or sys.stdout
     if client is None:
-        client = HTTPClient.from_url(args.server, args.token)
+        if args.kubeconfig:
+            client = HTTPClient.from_kubeconfig(args.kubeconfig)
+        else:
+            client = HTTPClient.from_url(args.server, args.token)
     k = Kubectl(client, out)
     if args.cmd == "get":
         return k.get(args.resource, args.name, args.namespace, args.output)

@@ -36,12 +36,21 @@ def _raise_for(code: int, body: dict) -> None:
     raise HTTPError(code, msg)
 
 
+def make_connection(host: str, port: int,
+                    ssl_context=None) -> http.client.HTTPConnection:
+    """The one place HTTP-vs-HTTPS connection choice lives."""
+    if ssl_context is not None:
+        return http.client.HTTPSConnection(host, port,
+                                           context=ssl_context)
+    return http.client.HTTPConnection(host, port)
+
+
 class HTTPWatch:
     """Consumes the newline-delimited JSON watch stream; quacks like kv.Watch."""
 
     def __init__(self, host: str, port: int, path: str,
-                 headers: dict[str, str]):
-        self._conn = http.client.HTTPConnection(host, port)
+                 headers: dict[str, str], ssl_context=None):
+        self._conn = make_connection(host, port, ssl_context)
         self._conn.request("GET", path, headers=headers)
         self._resp = self._conn.getresponse()
         if self._resp.status != 200:
@@ -104,27 +113,93 @@ class HTTPWatch:
 
 class HTTPClient(Client):
     def __init__(self, host: str, port: int, token: str | None = None,
-                 cluster_scoped: frozenset[str] = CLUSTER_SCOPED_RESOURCES):
+                 cluster_scoped: frozenset[str] = CLUSTER_SCOPED_RESOURCES,
+                 tls: dict | None = None):
+        """`tls` switches to HTTPS: {"ca_file": pinned server CA or None
+        (unverified), "cert_file"/"key_file": optional client cert —
+        the X.509 identity the apiserver's client-CA authn reads}."""
         self.host, self.port = host, port
         self._headers = {"Content-Type": "application/json"}
         if token:
             self._headers["Authorization"] = f"Bearer {token}"
         self._cluster_scoped = cluster_scoped
         self._local = threading.local()
+        self._ssl_context = None
+        if tls is not None:
+            import ssl
+            if tls.get("ca_file") or tls.get("ca_data"):
+                ctx = ssl.create_default_context(
+                    cafile=tls.get("ca_file"), cadata=tls.get("ca_data"))
+                ctx.check_hostname = False  # pinned CA, IP endpoints
+            else:
+                ctx = ssl._create_unverified_context()
+            if tls.get("cert_file"):
+                ctx.load_cert_chain(tls["cert_file"],
+                                    keyfile=tls.get("key_file"))
+            self._ssl_context = ctx
 
     @classmethod
-    def from_url(cls, url: str, token: str | None = None) -> "HTTPClient":
-        hostport = url.split("//", 1)[-1].rstrip("/")
+    def from_url(cls, url: str, token: str | None = None,
+                 tls: dict | None = None) -> "HTTPClient":
+        scheme, _, hostport = url.rstrip("/").rpartition("//")
         host, _, port = hostport.partition(":")
-        return cls(host, int(port or 80), token)
+        if scheme.startswith("https") and tls is None:
+            tls = {}  # unverified TLS — callers pin via tls["ca_file"]
+        return cls(host, int(port or (443 if tls is not None else 80)),
+                   token, tls=tls)
+
+    @classmethod
+    def from_kubeconfig(cls, path: str) -> "HTTPClient":
+        """Build a client from a kubeconfig: endpoint + pinned CA +
+        either a bearer token or a client cert/key (kubeadm output)."""
+        import base64
+        import os
+        import tempfile
+
+        import yaml
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        cluster = (doc.get("clusters") or [{}])[0].get("cluster") or {}
+        user = (doc.get("users") or [{}])[0].get("user") or {}
+        server = cluster.get("server", "http://127.0.0.1:8080")
+        tls = None
+        tmpdir = None
+        if server.startswith("https"):
+            tls = {}
+            if cluster.get("certificate-authority-data"):
+                # CA goes straight into the ssl context — no file
+                tls["ca_data"] = base64.b64decode(
+                    cluster["certificate-authority-data"]).decode()
+            if user.get("client-certificate-data"):
+                if not user.get("client-key-data"):
+                    raise ValueError(
+                        f"kubeconfig {path}: user has "
+                        "client-certificate-data but no client-key-data")
+                # ssl.load_cert_chain only takes paths: materialize into
+                # a TemporaryDirectory whose finalizer removes the key
+                # when the client is garbage-collected
+                tmpdir = tempfile.TemporaryDirectory(
+                    prefix="ktpu-kubeconfig-")
+                tls["cert_file"] = os.path.join(tmpdir.name, "client.crt")
+                tls["key_file"] = os.path.join(tmpdir.name, "client.key")
+                with open(tls["cert_file"], "wb") as f:
+                    f.write(base64.b64decode(
+                        user["client-certificate-data"]))
+                fd = os.open(tls["key_file"],
+                             os.O_WRONLY | os.O_CREAT, 0o600)
+                with os.fdopen(fd, "wb") as f:
+                    f.write(base64.b64decode(user["client-key-data"]))
+        client = cls.from_url(server, token=user.get("token"), tls=tls)
+        client._tls_tmpdir = tmpdir  # keep the finalizer alive
+        return client
 
     # -- plumbing --------------------------------------------------------
 
     def _conn(self) -> http.client.HTTPConnection:
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = self._local.conn = http.client.HTTPConnection(
-                self.host, self.port)
+            conn = self._local.conn = make_connection(
+                self.host, self.port, self._ssl_context)
         return conn
 
     def _request(self, method: str, path: str, body: Obj | None = None,
@@ -196,7 +271,8 @@ class HTTPClient(Client):
         path = self._path(resource) + "?watch=true"
         if since_rv is not None:
             path += f"&resourceVersion={since_rv}"
-        return HTTPWatch(self.host, self.port, path, self._headers)
+        return HTTPWatch(self.host, self.port, path, self._headers,
+                         ssl_context=self._ssl_context)
 
     # -- patch + subresources (endpoints/handlers/patch.go; pod storage) --
 

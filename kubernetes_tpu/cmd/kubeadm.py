@@ -38,16 +38,27 @@ def _phase(name: str, msg: str) -> None:
     print(f"[{name}] {msg}")
 
 
-def _kubeconfig(server_url: str, ca_pem: str, user: str, token: str) -> dict:
+def _kubeconfig(server_url: str, ca_pem: str, user: str,
+                token: str | None = None, cert_pem: str | None = None,
+                key_pem: str | None = None) -> dict:
     """A kubeconfig document binding endpoint + CA + credential (the
-    reference's kubeconfig phase: app/phases/kubeconfig)."""
+    reference's kubeconfig phase: app/phases/kubeconfig) — client-cert
+    credentials by default, bearer token for bootstrap identities."""
+    cred: dict = {}
+    if cert_pem is not None:
+        cred["client-certificate-data"] = base64.b64encode(
+            cert_pem.encode()).decode()
+        cred["client-key-data"] = base64.b64encode(
+            (key_pem or "").encode()).decode()
+    if token is not None:
+        cred["token"] = token
     return {
         "apiVersion": "v1", "kind": "Config",
         "clusters": [{"name": "kubernetes", "cluster": {
             "server": server_url,
             "certificate-authority-data": base64.b64encode(
                 ca_pem.encode()).decode()}}],
-        "users": [{"name": user, "user": {"token": token}}],
+        "users": [{"name": user, "user": cred}],
         "contexts": [{"name": f"{user}@kubernetes", "context": {
             "cluster": "kubernetes", "user": user}}],
         "current-context": f"{user}@kubernetes",
@@ -87,30 +98,29 @@ def init(args) -> None:
 
     import os
 
-    _phase("certs", "generating cluster CA")
+    _phase("certs", "generating cluster CA + apiserver serving cert")
+    from ..apiserver import authn as authnlib
     ca = ClusterCA.shared()  # materialized here; published by root-ca ctrl
     os.makedirs(args.cert_dir, exist_ok=True)
-    ca_path = os.path.join(args.cert_dir, "ca.crt")
-    with open(ca_path, "w") as f:
-        f.write(ca.ca_pem())
-    _phase("certs", f"wrote {ca_path}")
+    tls = authnlib.write_serving_bundle(ca, args.cert_dir)
+    _phase("certs", f"wrote {tls['client_ca_file']}, {tls['cert_file']}")
 
-    _phase("control-plane", "starting apiserver (RBAC), scheduler, "
-           "controller-manager")
+    _phase("control-plane", "starting apiserver (TLS + client-cert authn "
+           "+ RBAC + SA tokens), scheduler, controller-manager")
     # component credentials: each control-plane identity gets its own
-    # bearer token, enforced by the RBAC bootstrap roles
-    comp_tokens = {
-        "admin": (pysecrets.token_urlsafe(16),
-                  ("kubernetes-admin", ("system:masters",))),
-        "scheduler": (pysecrets.token_urlsafe(16),
-                      ("system:kube-scheduler", ())),
-        "controller-manager": (pysecrets.token_urlsafe(16),
-                               ("system:kube-controller-manager", ())),
+    # client certificate signed by the cluster CA (app/phases/kubeconfig);
+    # the apiserver authenticates them via the client-CA x509 path
+    comp_certs = {
+        "admin": authnlib.issue_cert(ca, "kubernetes-admin",
+                                     ("system:masters",)),
+        "scheduler": authnlib.issue_cert(ca, "system:kube-scheduler"),
+        "controller-manager": authnlib.issue_cert(
+            ca, "system:kube-controller-manager"),
     }
-    tokens = {tok: ident for tok, ident in comp_tokens.values()}
     store = kv.MemoryStore(history=1_000_000)
-    server = APIServer(store, port=args.secure_port, tokens=tokens,
-                       enable_rbac=True, bootstrap_token_auth=True).start()
+    server = APIServer(store, port=args.secure_port, tls=tls,
+                       enable_rbac=True, bootstrap_token_auth=True,
+                       enable_service_accounts=True).start()
     client = LocalClient(store)
     factory = SharedInformerFactory(client)
     fw = new_default_framework(client, factory)
@@ -125,16 +135,17 @@ def init(args) -> None:
     signer.run()
 
     _phase("kubeconfig", "writing admin/scheduler/controller-manager "
-           "kubeconfig files")
+           "kubeconfig files (client-cert credentials)")
     for comp, fname, user in (("admin", "admin.conf", "kubernetes-admin"),
                               ("scheduler", "scheduler.conf",
                                "system:kube-scheduler"),
                               ("controller-manager",
                                "controller-manager.conf",
                                "system:kube-controller-manager")):
-        tok, _ident = comp_tokens[comp]
+        cert_pem, key_pem = comp_certs[comp]
         path = _write_kubeconfig(args.cert_dir, fname, _kubeconfig(
-            server.url, ca.ca_pem(), user, tok))
+            server.url, ca.ca_pem(), user,
+            cert_pem=cert_pem, key_pem=key_pem))
         _phase("kubeconfig", f"wrote {path}")
 
     _phase("upload-config", "storing kubeadm-config ConfigMap")
@@ -188,7 +199,16 @@ def join(args) -> None:
     _phase("discovery", f"fetching cluster-info from {args.server}")
     url = (f"{args.server}/api/v1/namespaces/kube-public/"
            f"configmaps/cluster-info")
-    with urllib.request.urlopen(url, timeout=10) as resp:
+    # pre-trust fetch: no CA is known yet, so TLS verification is off —
+    # trust comes from the JWS signature + endpoint pin below, after
+    # which the embedded CA is pinned for every subsequent connection
+    # (the reference's --discovery-token-unsafe-skip-ca-verification
+    # bootstrap, app/discovery/token)
+    import ssl as ssllib
+    insecure_ctx = (ssllib._create_unverified_context()
+                    if args.server.startswith("https") else None)
+    with urllib.request.urlopen(url, timeout=10,
+                                context=insecure_ctx) as resp:
         info = json.loads(resp.read())
     data = info.get("data") or {}
     sig = data.get(f"jws-kubeconfig-{token_id}")
@@ -231,7 +251,16 @@ def join(args) -> None:
     # sign controllers, keep the issued certificate as the node's identity
     # material
     import os
-    client = HTTPClient.from_url(args.server, token=args.token)
+    ca_file = None
+    if ca_b64 and args.server.startswith("https"):
+        os.makedirs(args.cert_dir, exist_ok=True)
+        ca_file = os.path.join(args.cert_dir, "pinned-ca.crt")
+        with open(ca_file, "w") as f:
+            f.write(base64.b64decode(ca_b64).decode())
+    tls_pin = {"ca_file": ca_file} if ca_file else (
+        {} if args.server.startswith("https") else None)
+    client = HTTPClient.from_url(args.server, token=args.token,
+                                 tls=tls_pin)
     _phase("kubelet-tls-bootstrap",
            f"submitting CSR for node {args.node_name}")
     try:
@@ -294,17 +323,33 @@ def join(args) -> None:
                 serialization.Encoding.PEM,
                 serialization.PrivateFormat.PKCS8,
                 serialization.NoEncryption()))
+        key_pem_text = key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()).decode()
         if ca_b64:
             kubeconfig_path = _write_kubeconfig(
                 args.cert_dir, f"kubelet-{args.node_name}.conf",
                 _kubeconfig(args.server,
                             base64.b64decode(ca_b64).decode(),
-                            f"system:node:{args.node_name}", args.token))
+                            f"system:node:{args.node_name}",
+                            cert_pem=cert_pem.decode(),
+                            key_pem=key_pem_text))
             _phase("kubelet-tls-bootstrap",
                    f"wrote {cert_path}, {key_path}, {kubeconfig_path}")
         else:
             _phase("kubelet-tls-bootstrap",
                    f"wrote {cert_path}, {key_path}")
+        if args.server.startswith("https"):
+            # drop the bootstrap token: from here the node speaks with
+            # its ISSUED certificate — system:node:<name> in
+            # system:nodes, scoped by the system:node RBAC role
+            client = HTTPClient.from_url(args.server, tls={
+                "ca_file": ca_file, "cert_file": cert_path,
+                "key_file": key_path})
+            _phase("kubelet-tls-bootstrap",
+                   "switched to certificate credentials "
+                   f"(system:node:{args.node_name})")
     except ImportError:
         _phase("kubelet-tls-bootstrap",
                "cryptography unavailable; skipping CSR flow")

@@ -169,10 +169,13 @@ def accept_upgrade(handler) -> FrameSock | None:
 
 def open_upgrade(host: str, port: int, path: str,
                  headers: dict[str, str] | None = None,
-                 timeout: float = 30.0) -> FrameSock:
+                 timeout: float = 30.0, ssl_context=None) -> FrameSock:
     """POST `path` with an upgrade request; raise StreamError carrying
-    the server's error body on anything but 101."""
+    the server's error body on anything but 101.  `ssl_context` wraps
+    the connection for a TLS apiserver."""
     sock = socket.create_connection((host, port), timeout=timeout)
+    if ssl_context is not None:
+        sock = ssl_context.wrap_socket(sock, server_hostname=host)
     try:
         req = [f"POST {path} HTTP/1.1", f"Host: {host}:{port}",
                "Connection: Upgrade", f"Upgrade: {PROTOCOL}"]

@@ -1,0 +1,271 @@
+"""X.509 client-cert + ServiceAccount token authentication.
+
+Reference behaviors: staging/src/k8s.io/apiserver/pkg/authentication/
+request/x509/x509.go (CN=user, O=groups against --client-ca-file),
+pkg/serviceaccount/jwt.go + the TokenRequest subresource
+(pkg/registry/core/serviceaccount/storage/token.go).  The apiserver
+serves real TLS here; every request in these tests crosses the wire.
+"""
+
+import json
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver import authn as authnlib
+from kubernetes_tpu.client.http_client import HTTPClient, HTTPError
+from kubernetes_tpu.controllers.certificates import ClusterCA
+from kubernetes_tpu.store import kv
+
+
+@pytest.fixture(scope="module")
+def tls_cluster(tmp_path_factory):
+    """TLS apiserver (client-CA authn + RBAC + SA tokens) + cert files."""
+    d = tmp_path_factory.mktemp("pki")
+    ca = ClusterCA()
+    tls = authnlib.write_serving_bundle(ca, str(d))
+    store = kv.MemoryStore()
+    server = APIServer(store, tls=tls, enable_rbac=True,
+                       enable_service_accounts=True).start()
+
+    def client_for(cn, orgs=(), tls_extra=None):
+        cert_pem, key_pem = authnlib.issue_cert(ca, cn, tuple(orgs))
+        cert_f = d / f"{cn.replace(':', '_').replace('/', '_')}.crt"
+        key_f = d / f"{cn.replace(':', '_').replace('/', '_')}.key"
+        cert_f.write_text(cert_pem)
+        key_f.write_text(key_pem)
+        return HTTPClient(server.httpd.server_address[0], server.port,
+                          tls={"ca_file": tls["client_ca_file"],
+                               "cert_file": str(cert_f),
+                               "key_file": str(key_f),
+                               **(tls_extra or {})})
+
+    yield server, store, ca, tls, client_for, d
+    server.stop()
+
+
+def anon_client(server, tls):
+    return HTTPClient(server.httpd.server_address[0], server.port,
+                      tls={"ca_file": tls["client_ca_file"]})
+
+
+class TestX509:
+    def test_admin_cert_is_superuser(self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        admin = client_for("kubernetes-admin", ["system:masters"])
+        pod = meta.new_object("Pod", "by-cert", "default")
+        pod["spec"] = {"containers": [{"name": "c", "image": "i"}]}
+        created = admin.create("pods", pod)
+        assert meta.name(created) == "by-cert"
+        assert admin.get("pods", "default", "by-cert")
+
+    def test_no_cert_is_anonymous(self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        anon = anon_client(server, tls)
+        pod = meta.new_object("Pod", "anon-pod", "default")
+        with pytest.raises(HTTPError) as exc:
+            anon.create("pods", pod)
+        assert exc.value.code == 403
+        assert "system:anonymous" in str(exc.value)
+
+    def test_wrong_ca_cert_rejected(self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        rogue_ca = ClusterCA()
+        cert_pem, key_pem = authnlib.issue_cert(
+            rogue_ca, "kubernetes-admin", ("system:masters",))
+        (d / "rogue.crt").write_text(cert_pem)
+        (d / "rogue.key").write_text(key_pem)
+        rogue = HTTPClient(server.httpd.server_address[0], server.port,
+                           tls={"ca_file": tls["client_ca_file"],
+                                "cert_file": str(d / "rogue.crt"),
+                                "key_file": str(d / "rogue.key")})
+        with pytest.raises(OSError):  # TLS alert: unknown CA
+            rogue.list("pods", "default")
+
+    def test_node_cert_is_rbac_scoped(self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        node = client_for("system:node:n1", ["system:nodes"])
+        node.list("pods", "default")  # read allowed by system:node
+        pod = meta.new_object("Pod", "node-made", "default")
+        with pytest.raises(HTTPError) as exc:
+            node.create("pods", pod)  # pod create is not in the role
+        assert exc.value.code == 403
+        assert "system:node:n1" in str(exc.value)
+
+
+class TestServiceAccountTokens:
+    def _mint(self, admin, ns, name, seconds=3600):
+        sa = meta.new_object("ServiceAccount", name, ns)
+        try:
+            admin.create("serviceaccounts", sa)
+        except kv.AlreadyExistsError:
+            pass
+        return admin._request(
+            "POST", f"/api/v1/namespaces/{ns}/serviceaccounts/{name}/token",
+            {"spec": {"expirationSeconds": seconds}})
+
+    def test_token_request_and_authn(self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        admin = client_for("kubernetes-admin", ["system:masters"])
+        tr = self._mint(admin, "default", "app-sa")
+        token = tr["status"]["token"]
+        assert tr["kind"] == "TokenRequest"
+        assert token.count(".") == 2
+        sa_client = HTTPClient(server.httpd.server_address[0],
+                               server.port, token=token,
+                               tls={"ca_file": tls["client_ca_file"]})
+        # authenticated (basic-user) but unprivileged
+        with pytest.raises(HTTPError) as exc:
+            sa_client.create("pods", meta.new_object("Pod", "x", "default"))
+        assert exc.value.code == 403
+        assert "system:serviceaccount:default:app-sa" in str(exc.value)
+
+    def test_deleted_sa_invalidates_token(self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        admin = client_for("kubernetes-admin", ["system:masters"])
+        tr = self._mint(admin, "default", "doomed-sa")
+        token = tr["status"]["token"]
+        sa_client = HTTPClient(server.httpd.server_address[0],
+                               server.port, token=token,
+                               tls={"ca_file": tls["client_ca_file"]})
+        with pytest.raises(HTTPError) as exc:
+            sa_client.create("pods", meta.new_object("Pod", "y", "default"))
+        assert "doomed-sa" in str(exc.value)  # live token worked
+        admin.delete("serviceaccounts", "default", "doomed-sa")
+        with pytest.raises(HTTPError) as exc:
+            sa_client.list("pods", "default")
+        assert exc.value.code == 401  # jwt.go: deleted account -> invalid
+
+    def test_short_expiration_rejected(self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        admin = client_for("kubernetes-admin", ["system:masters"])
+        sa = meta.new_object("ServiceAccount", "short-sa", "default")
+        admin.create("serviceaccounts", sa)
+        with pytest.raises(HTTPError) as exc:
+            admin._request(
+                "POST",
+                "/api/v1/namespaces/default/serviceaccounts/"
+                "short-sa/token",
+                {"spec": {"expirationSeconds": 60}})
+        assert exc.value.code == 400
+        assert ">= 600" in str(exc.value)
+
+    def test_external_audience_token_rejected_by_apiserver(
+            self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        admin = client_for("kubernetes-admin", ["system:masters"])
+        sa = meta.new_object("ServiceAccount", "aud-sa", "default")
+        admin.create("serviceaccounts", sa)
+        tr = admin._request(
+            "POST",
+            "/api/v1/namespaces/default/serviceaccounts/aud-sa/token",
+            {"spec": {"audiences": ["vault"]}})
+        ext_client = HTTPClient(server.httpd.server_address[0],
+                                server.port, token=tr["status"]["token"],
+                                tls={"ca_file": tls["client_ca_file"]})
+        with pytest.raises(HTTPError) as exc:
+            ext_client.list("pods", "default")
+        assert exc.value.code == 401
+
+    def test_token_for_missing_sa_404(self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        admin = client_for("kubernetes-admin", ["system:masters"])
+        with pytest.raises(kv.NotFoundError):
+            admin._request(
+                "POST",
+                "/api/v1/namespaces/default/serviceaccounts/ghost/token",
+                {"spec": {}})
+
+    def test_token_subresource_verbs(self, tls_cluster):
+        server, store, ca, tls, client_for, d = tls_cluster
+        admin = client_for("kubernetes-admin", ["system:masters"])
+        self._mint(admin, "default", "verb-sa")
+        for method in ("GET", "PUT", "DELETE"):
+            with pytest.raises(HTTPError) as exc:
+                admin._request(
+                    method,
+                    "/api/v1/namespaces/default/serviceaccounts/"
+                    "verb-sa/token",
+                    {} if method == "PUT" else None)
+            assert exc.value.code == 405, method
+        # the parent SA survived the rejected verbs
+        admin.get("serviceaccounts", "default", "verb-sa")
+
+
+class TestJWTValidation:
+    def test_tamper_and_expiry(self):
+        store = kv.MemoryStore()
+        sa = meta.new_object("ServiceAccount", "s", "ns1")
+        store.create("serviceaccounts", sa)
+        issuer = authnlib.ServiceAccountIssuer(store)
+        token, _ = issuer.issue("ns1", "s")
+        assert issuer.verify(token) is not None
+        # tampered payload
+        h, p, s_ = token.split(".")
+        forged = json.loads(authnlib._unb64url(p))
+        forged["sub"] = "system:serviceaccount:kube-system:root"
+        assert issuer.verify(
+            f"{h}.{authnlib._b64url(json.dumps(forged).encode())}.{s_}"
+        ) is None
+        # expired (aud valid, so expiry is what rejects it)
+        expired_claims = {"iss": authnlib.SA_ISSUER,
+                          "sub": "system:serviceaccount:ns1:s",
+                          "aud": [authnlib.API_AUDIENCE],
+                          "exp": int(time.time()) - 10}
+        payload = authnlib._b64url(json.dumps(expired_claims).encode())
+        header = h
+        sig = issuer._sign(f"{header}.{payload}".encode())
+        assert issuer.verify(f"{header}.{payload}.{sig}") is None
+        # audience-bound to an external service: not valid here
+        ext, _ = issuer.issue("ns1", "s", audiences=("vault",))
+        assert issuer.verify(ext) is None
+        # restart with the same store: key persists, token still valid
+        issuer2 = authnlib.ServiceAccountIssuer(store)
+        assert issuer2.verify(token) is not None
+
+    def test_x509_identity_parse(self):
+        cert = {"subject": ((("commonName", "jane"),),
+                            (("organizationName", "dev"),),
+                            (("organizationName", "ops"),))}
+        assert authnlib.x509_identity(cert) == ("jane", ("dev", "ops"))
+        assert authnlib.x509_identity({}) is None
+        assert authnlib.x509_identity(None) is None
+        assert authnlib.x509_identity(
+            {"subject": ((("organizationName", "dev"),),)}) is None
+
+
+class TestKubeconfigClient:
+    def test_cert_kubeconfig_round_trip(self, tls_cluster, tmp_path):
+        server, store, ca, tls, client_for, d = tls_cluster
+        from kubernetes_tpu.cmd.kubeadm import (_kubeconfig,
+                                                _write_kubeconfig)
+        cert_pem, key_pem = authnlib.issue_cert(
+            ca, "kubernetes-admin", ("system:masters",))
+        path = _write_kubeconfig(
+            str(tmp_path), "admin.conf",
+            _kubeconfig(server.url, ca.ca_pem(), "kubernetes-admin",
+                        cert_pem=cert_pem, key_pem=key_pem))
+        client = HTTPClient.from_kubeconfig(path)
+        pod = meta.new_object("Pod", "via-kubeconfig", "default")
+        pod["spec"] = {"containers": [{"name": "c", "image": "i"}]}
+        client.create("pods", pod)
+        assert client.get("pods", "default", "via-kubeconfig")
+
+    def test_kubectl_kubeconfig_flag(self, tls_cluster, tmp_path):
+        server, store, ca, tls, client_for, d = tls_cluster
+        import io
+
+        from kubernetes_tpu.cli.kubectl import run
+        from kubernetes_tpu.cmd.kubeadm import (_kubeconfig,
+                                                _write_kubeconfig)
+        cert_pem, key_pem = authnlib.issue_cert(
+            ca, "kubernetes-admin", ("system:masters",))
+        path = _write_kubeconfig(
+            str(tmp_path), "admin.conf",
+            _kubeconfig(server.url, ca.ca_pem(), "kubernetes-admin",
+                        cert_pem=cert_pem, key_pem=key_pem))
+        out = io.StringIO()
+        rc = run(["--kubeconfig", path, "get", "pods"], out=out)
+        assert rc == 0
