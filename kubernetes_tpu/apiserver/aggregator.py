@@ -69,6 +69,16 @@ class AggregatorRegistry:
     def stop(self) -> None:
         self._stop.set()
 
+    def known_group_versions(self) -> dict[str, set[str]]:
+        """group -> versions served by registered (service-backed)
+        APIServices — merged into /apis discovery alongside builtins and
+        CRDs so advertised groups are reachable at their real versions."""
+        out: dict[str, set[str]] = {}
+        with self._lock:
+            for group, version in self._routes:
+                out.setdefault(group, set()).add(version)
+        return out
+
     def _parse(self, obj: dict) -> tuple[str, str] | None:
         spec = obj.get("spec") or {}
         group, version = spec.get("group"), spec.get("version")

@@ -81,8 +81,13 @@ class CRDRegistry:
         self._lock = threading.Lock()
         self._by_plural: Dict[str, dict] = {}
 
-    def establish(self, crd_obj: dict) -> dict:
-        """Validate + index a CRD object; returns it with status set."""
+    def establish(self, crd_obj: dict, dry_run: bool = False) -> dict:
+        """Validate + index a CRD object; returns it with status set.
+
+        dry_run validates and stamps status WITHOUT indexing — callers
+        run that before the store write (422 on bad spec) and commit
+        the index only after the write succeeds, so a CAS-rejected
+        update can't change what the server serves."""
         spec = crd_obj.get("spec") or {}
         group = spec.get("group")
         names = spec.get("names") or {}
@@ -106,10 +111,11 @@ class CRDRegistry:
                                     .get("openAPIV3Schema") or {})
                         for v in served},
         }
-        with self._lock:
-            self._by_plural[plural] = info
-            for short in info["short_names"]:
-                self._by_plural.setdefault(short, info)
+        if not dry_run:
+            with self._lock:
+                self._by_plural[plural] = info
+                for short in info["short_names"]:
+                    self._by_plural.setdefault(short, info)
         crd_obj.setdefault("status", {})["conditions"] = [
             {"type": "Established", "status": "True"}]
         return crd_obj

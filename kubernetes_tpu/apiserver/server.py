@@ -602,13 +602,7 @@ class APIServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                if path == "/apis":
-                    groups = sorted(set(BUILTIN_GROUPS)
-                                    | {i["group"]
-                                       for i in server.crds.resources()})
-                    self._send_json(200, {"kind": "APIGroupList",
-                                          "groups": [{"name": g}
-                                                     for g in groups]})
+                if self._maybe_discovery(path):
                     return
                 if r.resource is None:
                     self._send_json(404, status_error(404, "NotFound", path))
@@ -646,6 +640,42 @@ class APIServer:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
                 except kv.TooOldError as e:
                     self._send_json(410, status_error(410, "Expired", str(e)))
+
+            def _maybe_discovery(self, path: str) -> bool:
+                """GET /api, /api/v1, /apis[...], /openapi/v2 (endpoints/
+                discovery/): resolve groups/versions/resources from the
+                server, not a client-side table."""
+                from . import discovery as disc
+                parts = [p for p in path.split("/") if p]
+                doc = None
+                if path == "/api":
+                    doc = disc.api_versions()
+                elif path == "/api/v1":
+                    doc = disc.core_resource_list(CLUSTER_SCOPED,
+                                                  SCALABLE)
+                elif path == "/apis":
+                    doc = disc.group_list(
+                        BUILTIN_GROUPS, server.crds,
+                        extra=server.aggregator.known_group_versions())
+                elif path == "/openapi/v2":
+                    doc = disc.openapi_v2(BUILTIN_GROUPS, CLUSTER_SCOPED,
+                                          server.crds)
+                elif len(parts) == 2 and parts[0] == "apis":
+                    doc = disc.api_group(
+                        parts[1], BUILTIN_GROUPS, server.crds,
+                        extra=server.aggregator.known_group_versions())
+                elif len(parts) == 3 and parts[0] == "apis":
+                    doc = disc.group_resource_list(
+                        parts[1], parts[2], BUILTIN_GROUPS,
+                        CLUSTER_SCOPED, SCALABLE, server.crds)
+                else:
+                    return False
+                if doc is None:
+                    self._send_json(404, status_error(
+                        404, "NotFound", path))
+                else:
+                    self._send_json(200, doc)
+                return True
 
             def _serve_watch(self, resource: str, q) -> None:
                 raw = q.get("resourceVersion", [""])[0]
@@ -1000,13 +1030,15 @@ class APIServer:
                     return
                 if r.resource == crdlib.CRDS:
                     try:
-                        obj = server.crds.establish(obj)
+                        obj = server.crds.establish(obj, dry_run=True)
                     except crdlib.ValidationError as e:
                         self._send_json(422, status_error(422, "Invalid",
                                                           str(e)))
                         return
                 try:
                     created = server.store.create(r.resource, obj)
+                    if r.resource == crdlib.CRDS:
+                        server.crds.establish(created)
                     self._send_json(201, created)
                     self._audit(r, "create", 201, created)
                 except kv.AlreadyExistsError as e:
@@ -1186,8 +1218,17 @@ class APIServer:
                         return
                     if not self._validate_custom(r, obj):
                         return
+                    if r.resource == crdlib.CRDS:
+                        try:
+                            obj = server.crds.establish(obj, dry_run=True)
+                        except crdlib.ValidationError as e:
+                            self._send_json(422, status_error(
+                                422, "Invalid", str(e)))
+                            return
                     mflib.track_update(old, obj, self._field_manager())
                     updated = server.store.update(r.resource, obj)
+                    if r.resource == crdlib.CRDS:
+                        server.crds.establish(updated)
                     self._send_json(200, updated)
                     self._audit(r, "update", 200, updated)
                 except kv.NotFoundError as e:
@@ -1252,9 +1293,14 @@ class APIServer:
                         if r.group is not None and r.group not in BUILTIN_GROUPS:
                             server.crds.validate_object(r.resource, r.version,
                                                         patched)
+                        if r.resource == crdlib.CRDS:
+                            patched = server.crds.establish(patched,
+                                                            dry_run=True)
                         return patched
                     updated = server.store.guaranteed_update(
                         r.resource, r.ns or "", r.name, apply)
+                    if r.resource == crdlib.CRDS:
+                        server.crds.establish(updated)
                     self._send_json(200, updated)
                     self._audit(r, "patch", 200)
                 except (patchlib.PatchError, crdlib.ValidationError) as e:
@@ -1299,8 +1345,15 @@ class APIServer:
                             return
                         if not self._validate_custom(r, new):
                             return
+                        if r.resource == crdlib.CRDS:
+                            # a CRD applied (SSA) must establish exactly
+                            # like one POSTed, or it never serves
+                            new = server.crds.establish(new,
+                                                        dry_run=True)
                         try:
                             created = server.store.create(r.resource, new)
+                            if r.resource == crdlib.CRDS:
+                                server.crds.establish(created)
                         except kv.AlreadyExistsError:
                             # lost the create race to a concurrent first
                             # apply: fall through and MERGE with the
@@ -1324,9 +1377,13 @@ class APIServer:
                                 and r.group not in BUILTIN_GROUPS:
                             server.crds.validate_object(
                                 r.resource, r.version, new)
+                        if r.resource == crdlib.CRDS:
+                            new = server.crds.establish(new, dry_run=True)
                         return new
                     updated = server.store.guaranteed_update(
                         r.resource, r.ns or "", r.name, merge)
+                    if r.resource == crdlib.CRDS:
+                        server.crds.establish(updated)
                     self._send_json(200, updated)
                     self._audit(r, "apply", 200)
                 except mflib.ApplyConflict as e:

@@ -59,6 +59,11 @@ ALIASES = {
 }
 
 
+# plurals the static tables already know — anything else goes through
+# server discovery (every ALIASES value is also a KIND_TO_RESOURCE value)
+KNOWN_PLURALS = frozenset(KIND_TO_RESOURCE.values())
+
+
 def resolve_resource(arg: str) -> str:
     return ALIASES.get(arg.lower(), arg.lower())
 
@@ -143,12 +148,13 @@ class Kubectl:
     def __init__(self, client: Client, out=None):
         self.client = client
         self.out = out or sys.stdout
+        self._discovery: dict[str, str] | None = None
 
     # -- get / describe --------------------------------------------------
 
     def get(self, resource: str, name: str | None, namespace: str,
             output: str | None) -> int:
-        resource = resolve_resource(resource)
+        resource = self.resolve(resource)
         if name:
             try:
                 items = [self.client.get(resource, namespace, name)]
@@ -178,7 +184,7 @@ class Kubectl:
         return 0
 
     def describe(self, resource: str, name: str, namespace: str) -> int:
-        resource = resolve_resource(resource)
+        resource = self.resolve(resource)
         try:
             obj = self.client.get(resource, namespace, name)
         except kv.NotFoundError:
@@ -207,7 +213,7 @@ class Kubectl:
 
     def create(self, path: str, namespace: str) -> int:
         for obj in self._load_manifests(path):
-            res = KIND_TO_RESOURCE.get(obj.get("kind", ""), "")
+            res = self._kind_to_resource(obj.get("kind", ""))
             if not res:
                 self.out.write(f"error: unknown kind {obj.get('kind')}\n")
                 return 1
@@ -218,6 +224,9 @@ class Kubectl:
             except kv.AlreadyExistsError:
                 self.out.write(f"{res}/{meta.name(obj)} already exists\n")
                 return 1
+            if res == "customresourcedefinitions":
+                # the next manifest may be an instance of this CRD
+                self._discovery = None
         return 0
 
     def apply(self, path: str, namespace: str, force: bool = False) -> int:
@@ -228,7 +237,7 @@ class Kubectl:
         --server-side semantics — the only apply mode here; fields you
         stop applying are removed server-side)."""
         for obj in self._load_manifests(path):
-            res = KIND_TO_RESOURCE.get(obj.get("kind", ""), "")
+            res = self._kind_to_resource(obj.get("kind", ""))
             if not res:
                 self.out.write(f"error: unknown kind {obj.get('kind')}\n")
                 return 1
@@ -248,11 +257,14 @@ class Kubectl:
                     "hint: overwrite with --force-conflicts, or stop "
                     "managing the conflicting fields\n")
                 return 1
+            if res == "customresourcedefinitions":
+                # the next manifest may be an instance of this CRD
+                self._discovery = None
             self.out.write(f"{res}/{nm} {verb}\n")
         return 0
 
     def delete(self, resource: str, name: str, namespace: str) -> int:
-        resource = resolve_resource(resource)
+        resource = self.resolve(resource)
         try:
             self.client.delete(resource, namespace, name)
         except kv.NotFoundError:
@@ -267,7 +279,7 @@ class Kubectl:
     # -- scale / cordon / drain / top ------------------------------------
 
     def scale(self, resource: str, name: str, namespace: str, replicas: int) -> int:
-        resource = resolve_resource(resource)
+        resource = self.resolve(resource)
 
         def patch(o):
             o.setdefault("spec", {})["replicas"] = replicas
@@ -386,6 +398,69 @@ class Kubectl:
     def _http_client(self):
         """The HTTPClient behind this kubectl, or None (LocalClient)."""
         return self.client if isinstance(self.client, HTTPClient) else None
+
+    def resolve(self, arg: str) -> str:
+        """Resource name from an alias/kind/plural: the static table
+        first, then SERVER discovery (/api/v1, /apis/{g}/{v}) — which is
+        how CRD-defined kinds and shortNames resolve without kubectl
+        knowing them (kubectl/pkg/cmd/util restmapper over discovery)."""
+        got = resolve_resource(arg)
+        if got in KNOWN_PLURALS:
+            return got
+        return self._discovery_map().get(got, got)
+
+    def _discovery_map(self) -> dict[str, str]:
+        if self._discovery is None and self._http_client() is not None:
+            # cache only a successful load — an empty map means the
+            # fetch failed, and must not poison later lookups
+            self._discovery = self._load_discovery() or None
+        return self._discovery or {}
+
+    def _load_discovery(self) -> dict[str, str]:
+        """alias/kind/singular/shortName -> plural, from the server."""
+        mapping: dict[str, str] = {}
+        try:
+            for entry in self.client._request(
+                    "GET", "/api/v1").get("resources") or ():
+                self._index_resource(mapping, entry)
+            groups = self.client._request("GET", "/apis").get(
+                "groups") or ()
+        except (kv.StoreError, OSError):
+            return mapping
+        for g in groups:
+            # every served version, not just preferred: a kind can live
+            # exclusively at v1alpha1 while v1 is the group's preferred
+            for v in g.get("versions") or ():
+                gv = v.get("groupVersion")
+                if not gv:
+                    continue
+                try:
+                    rl = self.client._request("GET", f"/apis/{gv}")
+                except (kv.StoreError, OSError):
+                    continue  # one unhealthy group must not sink the rest
+                for entry in rl.get("resources") or ():
+                    self._index_resource(mapping, entry)
+        return mapping
+
+    def _kind_to_resource(self, kind: str) -> str:
+        """Manifest kind -> resource, via the static table then server
+        discovery (a just-applied CRD's kind resolves in the same
+        kubectl run: writing a CRD invalidates the discovery cache)."""
+        return (KIND_TO_RESOURCE.get(kind)
+                or self._discovery_map().get(kind.lower(), ""))
+
+    @staticmethod
+    def _index_resource(mapping: dict[str, str], entry: dict) -> None:
+        plural = entry.get("name", "")
+        if "/" in plural:  # subresources don't resolve as resources
+            return
+        mapping[plural] = plural
+        if entry.get("kind"):
+            mapping.setdefault(entry["kind"].lower(), plural)
+        if entry.get("singularName"):
+            mapping.setdefault(entry["singularName"], plural)
+        for short in entry.get("shortNames") or ():
+            mapping.setdefault(short, plural)
 
     def _open_stream(self, path: str):
         from ..kubelet import streams
@@ -601,7 +676,7 @@ class Kubectl:
     def rollout(self, action: str, resource: str, name: str,
                 namespace: str, timeout: float = 60.0) -> int:
         """rollout status|restart|undo (kubectl/pkg/cmd/rollout)."""
-        resource = resolve_resource(resource)
+        resource = self.resolve(resource)
         if action == "status":
             deadline = time.time() + timeout
             while time.time() < deadline:
@@ -703,7 +778,7 @@ class Kubectl:
     def _kv_patch(self, resource: str, name: str, namespace: str,
                   pairs: list[str], field: str) -> int:
         """Shared label/annotate implementation: k=v sets, k- removes."""
-        resource = resolve_resource(resource)
+        resource = self.resolve(resource)
 
         def patch(o):
             target = o["metadata"].setdefault(field, {})
@@ -734,7 +809,7 @@ class Kubectl:
                   "kind": "SelfSubjectAccessReview",
                   "spec": {"resourceAttributes": {
                       "verb": verb,
-                      "resource": resolve_resource(resource),
+                      "resource": self.resolve(resource),
                       "namespace": namespace or ""}}}
         try:
             out = self.client.create("selfsubjectaccessreviews", review)
@@ -754,7 +829,7 @@ class Kubectl:
         from ..apiserver import managedfields as mf
         rc = 0
         for obj in self._load_manifests(path):
-            res = KIND_TO_RESOURCE.get(obj.get("kind", ""), "")
+            res = self._kind_to_resource(obj.get("kind", ""))
             if not res:
                 self.out.write(f"error: unknown kind {obj.get('kind')}\n")
                 return 2
@@ -839,7 +914,7 @@ class Kubectl:
         the apiserver's merge-patch content type uses (apiserver/patch.py)
         so CLI and API semantics can't drift."""
         from ..apiserver.patch import json_merge_patch
-        resource = resolve_resource(resource)
+        resource = self.resolve(resource)
         try:
             delta = json.loads(patch_json)
         except json.JSONDecodeError as e:
@@ -857,7 +932,7 @@ class Kubectl:
     def wait(self, resource: str, name: str, namespace: str,
              condition: str, timeout: float = 30.0) -> int:
         """kubectl wait --for=condition=<Type> | --for=delete."""
-        resource = resolve_resource(resource)
+        resource = self.resolve(resource)
         want_delete = condition == "delete"
         cond_name = (condition.partition("=")[2]
                      if condition.startswith("condition=") else "")

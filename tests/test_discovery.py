@@ -1,0 +1,197 @@
+"""Server-side discovery + OpenAPI (endpoints/discovery/, kube-openapi).
+
+The contract under test: a client that knows NOTHING but the server URL
+can enumerate groups/versions/resources — including CRD-defined kinds —
+and kubectl resolves resources from these endpoints, not its baked-in
+table.
+"""
+
+import io
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.cli.kubectl import Kubectl
+from kubernetes_tpu.client.http_client import HTTPClient
+from kubernetes_tpu.store import kv
+
+
+@pytest.fixture(scope="module")
+def server():
+    store = kv.MemoryStore()
+    srv = APIServer(store).start()
+    http = HTTPClient.from_url(srv.url)
+    # a CRD so discovery covers dynamically-added resources
+    crd = meta.new_object("CustomResourceDefinition", "crontabs.stable.tpu",
+                          None)
+    crd["spec"] = {
+        "group": "stable.tpu",
+        "scope": "Namespaced",
+        "names": {"plural": "crontabs", "kind": "CronTab",
+                  "singular": "crontab", "shortNames": ["ct"]},
+        "versions": [{"name": "v1", "served": True, "storage": True,
+                      "schema": {"openAPIV3Schema": {
+                          "type": "object",
+                          "properties": {"spec": {"type": "object"}}}}}],
+    }
+    http.create("customresourcedefinitions", crd)
+    yield srv, http
+    srv.stop()
+
+
+def fetch(srv, path):
+    with urllib.request.urlopen(srv.url + path) as resp:
+        return json.loads(resp.read())
+
+
+class TestDiscovery:
+    def test_api_versions(self, server):
+        srv, _ = server
+        doc = fetch(srv, "/api")
+        assert doc == {"kind": "APIVersions", "versions": ["v1"]}
+
+    def test_core_resources(self, server):
+        srv, _ = server
+        doc = fetch(srv, "/api/v1")
+        assert doc["kind"] == "APIResourceList"
+        by_name = {r["name"]: r for r in doc["resources"]}
+        assert by_name["pods"]["kind"] == "Pod"
+        assert by_name["pods"]["namespaced"] is True
+        assert by_name["nodes"]["namespaced"] is False
+        assert "po" in by_name["pods"]["shortNames"]
+        # subresources surface (exec/log/token are real routes now)
+        assert by_name["pods/exec"]["verbs"] == ["create", "get"]
+        assert by_name["pods/log"]["verbs"] == ["get"]
+        assert by_name["serviceaccounts/token"]["verbs"] == ["create"]
+
+    def test_group_list_includes_crd_group(self, server):
+        srv, _ = server
+        doc = fetch(srv, "/apis")
+        groups = {g["name"]: g for g in doc["groups"]}
+        assert "apps" in groups
+        assert "stable.tpu" in groups
+        apps = groups["apps"]
+        assert apps["preferredVersion"]["groupVersion"] == "apps/v1"
+        assert {"groupVersion": "apps/v1", "version": "v1"} \
+            in apps["versions"]
+        assert groups["autoscaling"]["preferredVersion"][
+            "groupVersion"] == "autoscaling/v2"
+
+    def test_group_detail_and_resources(self, server):
+        srv, _ = server
+        doc = fetch(srv, "/apis/apps")
+        assert doc["kind"] == "APIGroup" and doc["name"] == "apps"
+        rl = fetch(srv, "/apis/apps/v1")
+        by_name = {r["name"]: r for r in rl["resources"]}
+        assert by_name["deployments"]["kind"] == "Deployment"
+        assert "deploy" in by_name["deployments"]["shortNames"]
+        assert by_name["deployments/scale"]["kind"] == "Scale"
+
+    def test_crd_resources_served(self, server):
+        srv, _ = server
+        rl = fetch(srv, "/apis/stable.tpu/v1")
+        by_name = {r["name"]: r for r in rl["resources"]}
+        assert by_name["crontabs"]["kind"] == "CronTab"
+        assert by_name["crontabs"]["shortNames"] == ["ct"]
+
+    def test_unknown_group_404(self, server):
+        srv, _ = server
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            fetch(srv, "/apis/no.such.group")
+        assert exc.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            fetch(srv, "/apis/apps/v9")
+        assert exc.value.code == 404
+
+    def test_openapi_v2(self, server):
+        srv, _ = server
+        doc = fetch(srv, "/openapi/v2")
+        assert doc["swagger"] == "2.0"
+        assert "/api/v1/namespaces/{namespace}/pods" in doc["paths"]
+        assert ("/apis/apps/v1/namespaces/{namespace}/deployments"
+                in doc["paths"])
+        # the CRD embeds its real schema
+        ct = doc["definitions"]["stable.tpu/v1.CronTab"]
+        assert ct["properties"]["spec"]["type"] == "object"
+
+
+class TestKubectlDiscovery:
+    def test_crd_kind_resolves_via_discovery(self, server):
+        srv, http = server
+        obj = meta.new_object("CronTab", "nightly", "default")
+        obj["spec"] = {}
+        http.create("crontabs", obj)
+        for alias in ("ct", "crontab", "CronTab", "crontabs"):
+            out = io.StringIO()
+            k = Kubectl(http, out)
+            assert k.get(alias, None, "default", None) == 0, alias
+            assert "nightly" in out.getvalue(), alias
+
+    def test_beta_only_crd_group_resolves(self, server):
+        """A group served ONLY at v1beta1 must advertise that version
+        as preferred (no phantom v1) and resolve through kubectl."""
+        srv, http = server
+        crd = meta.new_object("CustomResourceDefinition",
+                              "widgets.acme.io", None)
+        crd["spec"] = {
+            "group": "acme.io", "scope": "Namespaced",
+            "names": {"plural": "widgets", "kind": "Widget",
+                      "shortNames": ["wg"]},
+            "versions": [{"name": "v1beta1", "served": True,
+                          "storage": True}],
+        }
+        http.create("customresourcedefinitions", crd)
+        groups = {g["name"]: g for g in fetch(srv, "/apis")["groups"]}
+        assert groups["acme.io"]["preferredVersion"][
+            "groupVersion"] == "acme.io/v1beta1"
+        obj = meta.new_object("Widget", "w1", "default")
+        http.create("widgets", obj)
+        out = io.StringIO()
+        k = Kubectl(http, out)
+        assert k.get("wg", None, "default", None) == 0
+        assert "w1" in out.getvalue()
+        # ...and the bad group didn't truncate the rest of the map
+        assert k.resolve("CronTab") == "crontabs"
+        assert k.resolve("deploy") == "deployments"
+
+    def test_crd_applied_via_kubectl_establishes_and_serves(
+            self, server, tmp_path):
+        """kubectl apply of a CRD + an instance of it in sequence: the
+        SSA create path must establish the CRD (not just POST), and
+        kubectl must re-discover mid-run to resolve the new kind."""
+        srv, http = server
+        import yaml as yamllib
+        crd_f = tmp_path / "crd.yaml"
+        crd_f.write_text(yamllib.safe_dump({
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": "gadgets.apply.io"},
+            "spec": {"group": "apply.io", "scope": "Namespaced",
+                     "names": {"plural": "gadgets", "kind": "Gadget",
+                               "shortNames": ["gd"]},
+                     "versions": [{"name": "v1", "served": True,
+                                   "storage": True}]}}))
+        inst_f = tmp_path / "gadget.yaml"
+        inst_f.write_text(yamllib.safe_dump({
+            "apiVersion": "apply.io/v1", "kind": "Gadget",
+            "metadata": {"name": "g1"}, "spec": {}}))
+        out = io.StringIO()
+        k = Kubectl(http, out)
+        assert k.apply(str(crd_f), "default") == 0
+        assert k.apply(str(inst_f), "default") == 0, out.getvalue()
+        out2 = io.StringIO()
+        k2 = Kubectl(http, out2)
+        assert k2.get("gd", None, "default", None) == 0
+        assert "g1" in out2.getvalue()
+        rl = fetch(srv, "/apis/apply.io/v1")
+        assert any(r["name"] == "gadgets" for r in rl["resources"])
+
+    def test_static_aliases_need_no_request(self, server):
+        srv, http = server
+        k = Kubectl(http, io.StringIO())
+        assert k.resolve("po") == "pods"
+        assert k._discovery is None  # no discovery round-trip burned
