@@ -5,6 +5,23 @@ new REST resources at /apis/{group}/{version}/...; custom objects are
 validated against the CRD's openAPIV3Schema (structural-schema subset:
 type, required, properties, items, enum, minimum/maximum, pattern) and
 stored like any built-in.  The coscheduling PodGroup CRD rides this.
+
+Depth beyond the basic registry (each maps to an apiextensions
+subsystem):
+  - structural pruning + defaulting (pkg/apiserver/schema/pruning,
+    defaulting): unknown fields are dropped on write unless
+    x-kubernetes-preserve-unknown-fields; schema `default`s fill absent
+    fields
+  - CEL validation rules (pkg/apiserver/schema/cel):
+    x-kubernetes-validations [{rule, message}] evaluated against `self`
+    (+ `oldSelf` on update) at every schema level, via cel.py
+  - multi-version + conversion (pkg/apiserver/conversion): objects are
+    STORED at the single storage version and converted on the wire;
+    strategy None rewrites apiVersion, strategy Webhook POSTs a
+    ConversionReview to the configured URL
+  - status/scale subresources (pkg/registry/customresource): served
+    only when spec.subresources declares them; scale reads/writes
+    through the configured JSON paths
 """
 
 from __future__ import annotations
@@ -18,6 +35,102 @@ CRDS = "customresourcedefinitions"
 
 class ValidationError(ValueError):
     pass
+
+
+# -- structural schema: pruning + defaulting -----------------------------
+
+def prune(obj, schema: dict, root: bool = True):
+    """Drop fields not in the structural schema (pruning.Prune):
+    unknown fields vanish on write instead of persisting as junk.
+    x-kubernetes-preserve-unknown-fields or a non-False
+    additionalProperties keeps a subtree as-is."""
+    if not schema or schema.get("x-kubernetes-preserve-unknown-fields"):
+        return obj
+    if isinstance(obj, dict):
+        props = schema.get("properties")
+        addl = schema.get("additionalProperties")
+        if props is None and not isinstance(addl, dict):
+            return obj  # untyped object: nothing to prune against
+        out = {}
+        for key, val in obj.items():
+            if root and key in ("apiVersion", "kind", "metadata"):
+                out[key] = val  # ObjectMeta is never pruned
+            elif props is not None and key in props:
+                out[key] = prune(val, props[key], root=False)
+            elif isinstance(addl, dict):
+                # map values prune against the value schema
+                out[key] = prune(val, addl, root=False)
+            elif addl not in (None, False):
+                out[key] = val
+        return out
+    if isinstance(obj, list) and schema.get("items"):
+        return [prune(v, schema["items"], root=False) for v in obj]
+    return obj
+
+
+def apply_defaults(obj, schema: dict):
+    """Fill absent fields carrying a schema `default`
+    (defaulting.Default) — applied after pruning, before validation."""
+    if not schema:
+        return obj
+    if isinstance(obj, dict):
+        props = schema.get("properties") or {}
+        for key, sub in props.items():
+            if key not in obj and "default" in sub:
+                import copy
+                obj[key] = copy.deepcopy(sub["default"])
+            if key in obj:
+                obj[key] = apply_defaults(obj[key], sub)
+        addl = schema.get("additionalProperties")
+        if isinstance(addl, dict):
+            for key in obj:
+                if key not in props:
+                    obj[key] = apply_defaults(obj[key], addl)
+    elif isinstance(obj, list) and schema.get("items"):
+        obj = [apply_defaults(v, schema["items"]) for v in obj]
+    return obj
+
+
+def validate_rules(obj, schema: dict, old=None, path: str = "") -> None:
+    """x-kubernetes-validations: CEL rules hold at every schema level,
+    with `self` bound to the value at that level (schema/cel/validation
+    .go).  A rule error fails the write, same as a false rule."""
+    if not schema:
+        return
+    from . import cel
+    where = path or "<root>"
+    for entry in schema.get("x-kubernetes-validations") or ():
+        rule = entry.get("rule")
+        if not rule:
+            continue
+        if old is None and "oldSelf" in rule:
+            # transition rules only run where an old value exists to
+            # correlate against (cel/validation.go) — never on create
+            continue
+        try:
+            ok = cel.evaluate(rule, obj, old)
+        except cel.CELError as e:
+            raise ValidationError(
+                f"{where}: rule {rule!r} errored: {e}") from None
+        if not ok:
+            raise ValidationError(
+                f"{where}: {entry.get('message') or 'failed rule: ' + rule}")
+    if isinstance(obj, dict):
+        props = schema.get("properties") or {}
+        for key, sub in props.items():
+            if key in obj:
+                old_val = old.get(key) if isinstance(old, dict) else None
+                validate_rules(obj[key], sub, old_val, f"{path}.{key}")
+        addl = schema.get("additionalProperties")
+        if isinstance(addl, dict):
+            for key, val in obj.items():
+                if key not in props:
+                    old_val = (old.get(key)
+                               if isinstance(old, dict) else None)
+                    validate_rules(val, addl, old_val, f"{path}.{key}")
+    elif isinstance(obj, list) and schema.get("items"):
+        for i, val in enumerate(obj):
+            validate_rules(val, schema["items"], None, f"{path}[{i}]")
 
 
 def validate_schema(obj, schema: dict, path: str = "") -> None:
@@ -101,15 +214,40 @@ class CRDRegistry:
         served = [v for v in versions if v.get("served", True)]
         if not served:
             raise ValidationError("CRD has no served versions")
+        storage = [v["name"] for v in versions if v.get("storage")]
+        if len(storage) > 1:
+            raise ValidationError("CRD declares %d storage versions; "
+                                  "exactly one allowed" % len(storage))
+        if not storage and len(versions) > 1:
+            # a single version is unambiguously the storage version;
+            # multiple versions with none flagged would make the
+            # storage form arbitrary (apiextensions requires exactly
+            # one storage=true)
+            raise ValidationError(
+                "multi-version CRD must flag exactly one storage version")
+        conversion = spec.get("conversion") or {"strategy": "None"}
+        strategy = conversion.get("strategy", "None")
+        if strategy not in ("None", "Webhook"):
+            raise ValidationError(f"unknown conversion strategy "
+                                  f"{strategy!r}")
+        if strategy == "Webhook" and not ((conversion.get("webhook") or {})
+                                          .get("clientConfig") or {}
+                                          ).get("url"):
+            raise ValidationError(
+                "Webhook conversion needs webhook.clientConfig.url")
         info = {
             "group": group, "plural": plural, "kind": kind,
             "singular": names.get("singular", kind.lower()),
             "short_names": names.get("shortNames", []),
             "namespaced": spec.get("scope", "Namespaced") == "Namespaced",
             "versions": [v["name"] for v in served],
+            "storage_version": storage[0] if storage
+            else served[0]["name"],
             "schemas": {v["name"]: ((v.get("schema") or {})
                                     .get("openAPIV3Schema") or {})
                         for v in served},
+            "conversion": conversion,
+            "subresources": spec.get("subresources") or {},
         }
         if not dry_run:
             with self._lock:
@@ -157,3 +295,116 @@ class CRDRegistry:
                                   % (version, plural))
         schema = info["schemas"].get(version) or {}
         validate_schema(obj, schema)
+
+    def coerce(self, plural: str, version: str, obj: dict,
+               old: dict | None = None) -> dict:
+        """The full custom-resource write pipeline: prune unknown
+        fields, apply defaults, validate the structural schema, then
+        the CEL rules (with oldSelf on update).  Returns the object to
+        persist."""
+        info = self.lookup(plural)
+        if info is None:
+            raise ValidationError("no CRD for resource %r" % plural)
+        if version not in info["versions"]:
+            raise ValidationError("version %r not served for %r"
+                                  % (version, plural))
+        schema = info["schemas"].get(version) or {}
+        obj = prune(obj, schema)
+        obj = apply_defaults(obj, schema)
+        validate_schema(obj, schema)
+        if old is not None:
+            # transition rules compare same-shaped objects: the stored
+            # old object converts to the REQUEST version first
+            old = self.convert(plural, old, version)
+        validate_rules(obj, schema, old)
+        return obj
+
+    # -- multi-version conversion ----------------------------------------
+
+    def convert(self, plural: str, obj: dict, target_version: str) -> dict:
+        """Serve `obj` at target_version (conversion/converter.go).
+
+        None strategy: same schema at every version — only apiVersion
+        is rewritten.  Webhook: POST a ConversionReview to the CRD's
+        configured URL and take the returned converted object."""
+        return self.convert_many(plural, [obj], target_version)[0]
+
+    def convert_many(self, plural: str, objs: list[dict],
+                     target_version: str) -> list[dict]:
+        """Batch conversion: one ConversionReview for every object that
+        needs converting (the protocol's `objects` list), so a list of
+        N webhook-strategy objects costs one round trip, not N."""
+        info = self.lookup(plural)
+        if info is None:
+            return objs
+        need = [i for i, o in enumerate(objs)
+                if (o.get("apiVersion") or "").rpartition("/")[2]
+                not in ("", target_version)]
+        if not need:
+            return objs
+        out = list(objs)
+        if info["conversion"].get("strategy", "None") == "None":
+            for i in need:
+                converted = dict(objs[i])
+                converted["apiVersion"] = \
+                    f"{info['group']}/{target_version}"
+                out[i] = converted
+            return out
+        converted = self._webhook_convert(info, [objs[i] for i in need],
+                                          target_version)
+        for slot, obj in zip(need, converted):
+            out[slot] = obj
+        return out
+
+    def _webhook_convert(self, info: dict, objs: list[dict],
+                         target_version: str) -> list[dict]:
+        import json
+        import urllib.request
+        import uuid
+        url = info["conversion"]["webhook"]["clientConfig"]["url"]
+        review = {
+            "kind": "ConversionReview",
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "request": {"uid": uuid.uuid4().hex,
+                        "desiredAPIVersion":
+                            f"{info['group']}/{target_version}",
+                        "objects": objs},
+        }
+        req = urllib.request.Request(
+            url, data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                body = json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            raise ValidationError(
+                f"conversion webhook {url} failed: {e}") from None
+        response = body.get("response") or {}
+        if (response.get("result") or {}).get("status") == "Failure":
+            raise ValidationError(
+                "conversion webhook rejected: "
+                + str((response.get("result") or {}).get("message")))
+        converted = response.get("convertedObjects") or []
+        if len(converted) != len(objs):
+            raise ValidationError(
+                "conversion webhook returned %d objects for %d inputs"
+                % (len(converted), len(objs)))
+        return converted
+
+    def to_storage(self, plural: str, obj: dict) -> dict:
+        info = self.lookup(plural)
+        if info is None:
+            return obj
+        return self.convert(plural, obj, info["storage_version"])
+
+    # -- subresource declarations ----------------------------------------
+
+    def has_status_subresource(self, plural: str) -> bool:
+        info = self.lookup(plural)
+        return bool(info and "status" in info["subresources"])
+
+    def scale_paths(self, plural: str) -> Optional[dict]:
+        info = self.lookup(plural)
+        if info is None:
+            return None
+        return info["subresources"].get("scale")

@@ -616,14 +616,28 @@ class APIServer:
                             and r.subresource in NODE_STREAM_SUBRESOURCES:
                         self._node_stream(r)
                     elif r.query.get("watch", ["false"])[0] == "true":
-                        self._serve_watch(r.resource, r.query)
+                        self._serve_watch(r.resource, r.query, r)
                     elif r.name is not None and r.subresource == "scale":
+                        is_custom = self._is_custom(r)
+                        paths = (server.crds.scale_paths(r.resource)
+                                 if is_custom else None)
+                        if is_custom and paths is None:
+                            # GET and PUT must agree the subresource
+                            # doesn't exist when undeclared
+                            self._send_json(404, status_error(
+                                404, "NotFound",
+                                f"{r.resource} has no scale subresource"))
+                            return
                         obj = server.store.get(r.resource, r.ns or "", r.name)
-                        self._send_json(200, _scale_of(obj, r.resource))
+                        if paths is not None:
+                            self._send_json(200, _crd_scale(obj, paths))
+                        else:
+                            self._send_json(200,
+                                            _scale_of(obj, r.resource))
                         self._audit(r, "get", 200)
                     elif r.name is not None:
                         obj = server.store.get(r.resource, r.ns or "", r.name)
-                        self._send_json(200, obj)
+                        self._send_json(200, self._serve_custom(r, obj))
                         self._audit(r, "get", 200)
                     else:
                         sel = r.query.get("labelSelector", [None])[0]
@@ -631,6 +645,11 @@ class APIServer:
                         if sel:
                             items = [o for o in items
                                      if _matches_selector(o, sel)]
+                        if self._is_custom(r):
+                            # one batched ConversionReview, not N
+                            items = server.crds.convert_many(
+                                r.resource, items,
+                                self._custom_version(r))
                         self._send_json(200, {
                             "kind": "List", "apiVersion": "v1",
                             "metadata": {"resourceVersion": str(rv)},
@@ -640,6 +659,10 @@ class APIServer:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
                 except kv.TooOldError as e:
                     self._send_json(410, status_error(410, "Expired", str(e)))
+                except crdlib.ValidationError as e:
+                    # read-path conversion failure (webhook down/refusing)
+                    self._send_json(500, status_error(
+                        500, "InternalError", str(e)))
 
             def _maybe_discovery(self, path: str) -> bool:
                 """GET /api, /api/v1, /apis[...], /openapi/v2 (endpoints/
@@ -677,7 +700,8 @@ class APIServer:
                     self._send_json(200, doc)
                 return True
 
-            def _serve_watch(self, resource: str, q) -> None:
+            def _serve_watch(self, resource: str, q,
+                             r: _Route | None = None) -> None:
                 raw = q.get("resourceVersion", [""])[0]
                 try:
                     since = int(raw) if raw != "" else None
@@ -701,7 +725,16 @@ class APIServer:
                             payload = {"type": kv.BOOKMARK,
                                        "object": {"metadata": {}}}
                         else:
-                            payload = {"type": ev.type, "object": ev.object}
+                            obj = ev.object
+                            if r is not None and self._is_custom(r):
+                                try:
+                                    obj = self._serve_custom(r, obj)
+                                except crdlib.ValidationError:
+                                    # conversion webhook failure mid-
+                                    # stream: end the watch cleanly so
+                                    # the client relists
+                                    break
+                            payload = {"type": ev.type, "object": obj}
                         data = (json.dumps(payload) + "\n").encode()
                         self.wfile.write(f"{len(data):x}\r\n".encode()
                                          + data + b"\r\n")
@@ -940,16 +973,57 @@ class APIServer:
                     return None
                 return attrs.obj
 
-            def _validate_custom(self, r: _Route, obj: dict) -> bool:
-                """CRD schema validation for custom resources."""
-                if r.group is None or r.group in BUILTIN_GROUPS:
-                    return True
+            def _is_custom(self, r: _Route) -> bool:
+                """CRD-backed resource?  True for BOTH addressing forms:
+                the grouped /apis/{g}/{v} path AND the flat /api/v1 path
+                (the store is flat, so clients may write custom objects
+                there) — a flat-path write must still get the full
+                prune/default/validate/CEL pipeline."""
+                if r.group in BUILTIN_GROUPS:
+                    return False
+                return server.crds.lookup(r.resource) is not None
+
+            def _custom_version(self, r: _Route) -> str:
+                """The serving version for this request: the URL's on a
+                grouped path; the CRD's storage version on the flat path
+                (which serves objects in storage form)."""
+                if r.group is not None:
+                    return r.version
+                info = server.crds.lookup(r.resource) or {}
+                return info.get("storage_version", r.version)
+
+            def _coerce_custom(self, r: _Route, obj: dict,
+                               old: dict | None = None) -> dict | None:
+                """Custom-resource write pipeline: prune -> default ->
+                schema -> CEL rules, then convert to the CRD's storage
+                version (the reference stores ONE version and converts
+                on the wire).  None = rejected (422 already sent)."""
+                if not self._is_custom(r):
+                    if r.group is not None \
+                            and r.group not in BUILTIN_GROUPS:
+                        # grouped path, no CRD behind it: the resource
+                        # does not exist — never silently persist
+                        self._send_json(422, status_error(
+                            422, "Invalid",
+                            f"no CRD for resource {r.resource!r}"))
+                        return None
+                    return obj
                 try:
-                    server.crds.validate_object(r.resource, r.version, obj)
-                    return True
+                    obj = server.crds.coerce(r.resource,
+                                             self._custom_version(r),
+                                             obj, old)
+                    return server.crds.to_storage(r.resource, obj)
                 except crdlib.ValidationError as e:
                     self._send_json(422, status_error(422, "Invalid", str(e)))
-                    return False
+                    return None
+
+            def _serve_custom(self, r: _Route, obj: dict) -> dict:
+                """Convert a stored custom object to the requested
+                serving version on the way out."""
+                if self._is_custom(r):
+                    return server.crds.convert(r.resource, obj,
+                                               self._custom_version(r))
+                return obj
 
             def do_POST(self):
                 begun = self._begin("create")
@@ -1026,7 +1100,8 @@ class APIServer:
                 obj = self._admit(adm.CREATE, r, obj)
                 if obj is None:
                     return
-                if not self._validate_custom(r, obj):
+                obj = self._coerce_custom(r, obj)
+                if obj is None:
                     return
                 if r.resource == crdlib.CRDS:
                     try:
@@ -1039,7 +1114,7 @@ class APIServer:
                     created = server.store.create(r.resource, obj)
                     if r.resource == crdlib.CRDS:
                         server.crds.establish(created)
-                    self._send_json(201, created)
+                    self._send_json(201, self._serve_custom(r, created))
                     self._audit(r, "create", 201, created)
                 except kv.AlreadyExistsError as e:
                     self._send_json(409, status_error(409, "AlreadyExists",
@@ -1185,27 +1260,78 @@ class APIServer:
                 try:
                     if r.subresource == "status":
                         # status strategy: only .status moves (registry
-                        # strategies split spec/status writes)
+                        # strategies split spec/status writes).  Custom
+                        # resources only serve it when their CRD
+                        # declares spec.subresources.status
+                        # (customresource_handler.go).
+                        if self._is_custom(r) \
+                                and not server.crds.has_status_subresource(
+                                    r.resource):
+                            self._send_json(404, status_error(
+                                404, "NotFound",
+                                f"{r.resource} has no status subresource"))
+                            return
                         new_status = obj.get("status")
 
                         def set_status(cur):
+                            if self._is_custom(r):
+                                # the status write passes the same
+                                # schema/CEL pipeline as a spec write
+                                version = self._custom_version(r)
+                                cur = server.crds.convert(
+                                    r.resource, cur, version)
+                                candidate = dict(cur,
+                                                 status=new_status)
+                                candidate = server.crds.coerce(
+                                    r.resource, version, candidate, cur)
+                                return server.crds.to_storage(
+                                    r.resource, candidate)
                             cur["status"] = new_status
                             return cur
-                        updated = server.store.guaranteed_update(
-                            r.resource, r.ns or "", r.name, set_status)
-                        self._send_json(200, updated)
+                        try:
+                            updated = server.store.guaranteed_update(
+                                r.resource, r.ns or "", r.name,
+                                set_status)
+                        except crdlib.ValidationError as e:
+                            self._send_json(422, status_error(
+                                422, "Invalid", str(e)))
+                            return
+                        self._send_json(200,
+                                        self._serve_custom(r, updated))
                         self._audit(r, "update", 200)
                         return
                     if r.subresource == "scale":
+                        paths = (server.crds.scale_paths(r.resource)
+                                 if self._is_custom(r) else None)
+                        if self._is_custom(r) and paths is None:
+                            self._send_json(404, status_error(
+                                404, "NotFound",
+                                f"{r.resource} has no scale subresource"))
+                            return
                         replicas = int((obj.get("spec") or {})
                                        .get("replicas", 0))
 
                         def set_scale(cur):
-                            cur.setdefault("spec", {})["replicas"] = replicas
+                            if paths is not None:
+                                _set_path(cur, paths.get(
+                                    "specReplicasPath",
+                                    ".spec.replicas"), replicas)
+                            else:
+                                cur.setdefault("spec", {})["replicas"] \
+                                    = replicas
                             return cur
-                        updated = server.store.guaranteed_update(
-                            r.resource, r.ns or "", r.name, set_scale)
-                        self._send_json(200, _scale_of(updated, r.resource))
+                        try:
+                            updated = server.store.guaranteed_update(
+                                r.resource, r.ns or "", r.name,
+                                set_scale)
+                        except crdlib.ValidationError as e:
+                            self._send_json(422, status_error(
+                                422, "Invalid", str(e)))
+                            return
+                        self._send_json(200, _crd_scale(updated, paths)
+                                        if paths is not None
+                                        else _scale_of(updated,
+                                                       r.resource))
                         self._audit(r, "update", 200)
                         return
                     old = None
@@ -1216,7 +1342,8 @@ class APIServer:
                     obj = self._admit(adm.UPDATE, r, obj, old)
                     if obj is None:
                         return
-                    if not self._validate_custom(r, obj):
+                    obj = self._coerce_custom(r, obj, old)
+                    if obj is None:
                         return
                     if r.resource == crdlib.CRDS:
                         try:
@@ -1229,7 +1356,7 @@ class APIServer:
                     updated = server.store.update(r.resource, obj)
                     if r.resource == crdlib.CRDS:
                         server.crds.establish(updated)
-                    self._send_json(200, updated)
+                    self._send_json(200, self._serve_custom(r, updated))
                     self._audit(r, "update", 200, updated)
                 except kv.NotFoundError as e:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
@@ -1269,8 +1396,22 @@ class APIServer:
                 if ctype.split(";")[0].strip() == mflib.APPLY_CONTENT_TYPE:
                     self._do_apply(r, body)
                     return
+                if r.subresource == "status" and self._is_custom(r) \
+                        and not server.crds.has_status_subresource(
+                            r.resource):
+                    # PUT and PATCH must agree it doesn't exist
+                    self._send_json(404, status_error(
+                        404, "NotFound",
+                        f"{r.resource} has no status subresource"))
+                    return
                 try:
                     def apply(cur):
+                        if self._is_custom(r):
+                            # patch against the REQUEST-version shape:
+                            # patching the storage form and pruning with
+                            # the request schema silently drops fields
+                            cur = server.crds.convert(
+                                r.resource, cur, self._custom_version(r))
                         patched = patchlib.apply_patch(ctype, cur, body)
                         if r.subresource == "status":
                             # status patch may only change .status
@@ -1290,9 +1431,12 @@ class APIServer:
                             adm.UPDATE, r.resource, patched, cur,
                             namespace=r.ns or "", name=r.name,
                             subresource=r.subresource or ""))
-                        if r.group is not None and r.group not in BUILTIN_GROUPS:
-                            server.crds.validate_object(r.resource, r.version,
-                                                        patched)
+                        if self._is_custom(r):
+                            patched = server.crds.coerce(
+                                r.resource, self._custom_version(r),
+                                patched, cur)
+                            patched = server.crds.to_storage(r.resource,
+                                                             patched)
                         if r.resource == crdlib.CRDS:
                             patched = server.crds.establish(patched,
                                                             dry_run=True)
@@ -1301,7 +1445,7 @@ class APIServer:
                         r.resource, r.ns or "", r.name, apply)
                     if r.resource == crdlib.CRDS:
                         server.crds.establish(updated)
-                    self._send_json(200, updated)
+                    self._send_json(200, self._serve_custom(r, updated))
                     self._audit(r, "patch", 200)
                 except (patchlib.PatchError, crdlib.ValidationError) as e:
                     self._send_json(422, status_error(422, "Invalid", str(e)))
@@ -1343,7 +1487,8 @@ class APIServer:
                         new = self._admit(adm.CREATE, r, new, None)
                         if new is None:
                             return
-                        if not self._validate_custom(r, new):
+                        new = self._coerce_custom(r, new)
+                        if new is None:
                             return
                         if r.resource == crdlib.CRDS:
                             # a CRD applied (SSA) must establish exactly
@@ -1360,11 +1505,17 @@ class APIServer:
                             # winner (apply-to-existing is well-defined)
                             created = None
                         if created is not None:
-                            self._send_json(201, created)
+                            self._send_json(201,
+                                            self._serve_custom(r, created))
                             self._audit(r, "apply", 201, created)
                             return
 
                     def merge(cur):
+                        if self._is_custom(r):
+                            # merge in the request-version shape (see
+                            # the PATCH closure's rationale)
+                            cur = server.crds.convert(
+                                r.resource, cur, self._custom_version(r))
                         new = mflib.apply_merge(cur, applied, manager,
                                                 force=force)
                         new["metadata"]["resourceVersion"] = \
@@ -1373,10 +1524,11 @@ class APIServer:
                             adm.UPDATE, r.resource, new, cur,
                             namespace=r.ns or "", name=r.name,
                             subresource=r.subresource or ""))
-                        if r.group is not None \
-                                and r.group not in BUILTIN_GROUPS:
-                            server.crds.validate_object(
-                                r.resource, r.version, new)
+                        if self._is_custom(r):
+                            new = server.crds.coerce(
+                                r.resource, self._custom_version(r),
+                                new, cur)
+                            new = server.crds.to_storage(r.resource, new)
                         if r.resource == crdlib.CRDS:
                             new = server.crds.establish(new, dry_run=True)
                         return new
@@ -1384,7 +1536,7 @@ class APIServer:
                         r.resource, r.ns or "", r.name, merge)
                     if r.resource == crdlib.CRDS:
                         server.crds.establish(updated)
-                    self._send_json(200, updated)
+                    self._send_json(200, self._serve_custom(r, updated))
                     self._audit(r, "apply", 200)
                 except mflib.ApplyConflict as e:
                     body = status_error(409, "Conflict", str(e))
@@ -1468,6 +1620,47 @@ class APIServer:
 
 
 # -- helpers ---------------------------------------------------------------
+
+def _get_path(obj: dict, path: str):
+    """'.spec.replicas'-style JSON path lookup (customresource scale
+    paths)."""
+    cur = obj
+    for part in path.strip(".").split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _set_path(obj: dict, path: str, value) -> None:
+    parts = path.strip(".").split(".")
+    cur = obj
+    for part in parts[:-1]:
+        nxt = cur.get(part)
+        if nxt is None:
+            nxt = cur[part] = {}
+        elif not isinstance(nxt, dict):
+            raise crdlib.ValidationError(
+                f"cannot set {path}: {part!r} is not an object")
+        cur = nxt
+    cur[parts[-1]] = value
+
+
+def _crd_scale(obj: dict, paths: dict) -> dict:
+    """Scale projection through a CRD's declared subresource paths
+    (customresource/status_strategy.go scale handling)."""
+    return {"kind": "Scale", "apiVersion": "autoscaling/v1",
+            "metadata": {"name": meta.name(obj),
+                         "namespace": meta.namespace(obj)},
+            "spec": {"replicas": _get_path(
+                obj, paths.get("specReplicasPath", ".spec.replicas"))
+                or 0},
+            "status": {"replicas": _get_path(
+                obj, paths.get("statusReplicasPath",
+                               ".status.replicas")) or 0,
+                       "selector": _get_path(
+                obj, paths.get("labelSelectorPath", "")) or ""}}
+
 
 def _scale_of(obj: dict, resource: str) -> dict:
     """autoscaling/v1 Scale subresource projection."""

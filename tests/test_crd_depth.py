@@ -1,0 +1,383 @@
+"""CRD depth: structural pruning/defaulting, CEL rules, multi-version
+conversion (None + Webhook), status/scale subresources.
+
+Reference subsystems: apiextensions-apiserver pkg/apiserver/schema/
+{pruning,defaulting,cel}, pkg/apiserver/conversion, and the
+customresource registry's subresource handling.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver import cel
+from kubernetes_tpu.client.http_client import HTTPClient, HTTPError
+from kubernetes_tpu.store import kv
+
+
+@pytest.fixture()
+def server():
+    store = kv.MemoryStore()
+    srv = APIServer(store).start()
+    http = HTTPClient.from_url(srv.url)
+    yield srv, http
+    srv.stop()
+
+
+def make_crd(http, name, group, plural, kind, versions, extra_spec=None):
+    crd = meta.new_object("CustomResourceDefinition", name, None)
+    crd["spec"] = {"group": group, "scope": "Namespaced",
+                   "names": {"plural": plural, "kind": kind},
+                   "versions": versions, **(extra_spec or {})}
+    http.create("customresourcedefinitions", crd)
+    return crd
+
+
+def gv_request(http, method, group, version, plural, ns="default",
+               name=None, body=None, subresource=None):
+    path = f"/apis/{group}/{version}/namespaces/{ns}/{plural}"
+    if name:
+        path += f"/{name}"
+    if subresource:
+        path += f"/{subresource}"
+    return http._request(method, path, body)
+
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "spec": {
+            "type": "object",
+            "properties": {
+                "replicas": {"type": "integer", "default": 1},
+                "mode": {"type": "string", "default": "auto"},
+                "limit": {"type": "integer"},
+                "blob": {"type": "object",
+                         "x-kubernetes-preserve-unknown-fields": True},
+            },
+            "x-kubernetes-validations": [
+                {"rule": "self.replicas <= 10",
+                 "message": "replicas must be at most 10"},
+                {"rule": "!has(self.limit) || self.replicas <= self.limit"},
+            ],
+        },
+        "status": {"type": "object",
+                   "properties": {"replicas": {"type": "integer"}}},
+    },
+}
+
+
+class TestPruningDefaultingCEL:
+    def _establish(self, http):
+        make_crd(http, "things.d.io", "d.io", "things", "Thing",
+                 [{"name": "v1", "served": True, "storage": True,
+                   "schema": {"openAPIV3Schema": SCHEMA}}])
+
+    def test_unknown_fields_pruned_defaults_applied(self, server):
+        srv, http = server
+        self._establish(http)
+        obj = meta.new_object("Thing", "t1", "default")
+        obj["spec"] = {"junk": "dropme", "limit": 5,
+                       "blob": {"anything": {"goes": 1}}}
+        created = gv_request(http, "POST", "d.io", "v1",
+                             "things", body=obj)
+        assert "junk" not in created["spec"]          # pruned
+        assert created["spec"]["replicas"] == 1       # defaulted
+        assert created["spec"]["mode"] == "auto"      # defaulted
+        assert created["spec"]["blob"] == {"anything": {"goes": 1}}
+
+    def test_cel_rule_rejects_write(self, server):
+        srv, http = server
+        self._establish(http)
+        obj = meta.new_object("Thing", "t2", "default")
+        obj["spec"] = {"replicas": 11}
+        with pytest.raises(HTTPError) as exc:
+            gv_request(http, "POST", "d.io", "v1", "things", body=obj)
+        assert exc.value.code == 422
+        assert "at most 10" in str(exc.value)
+        # cross-field rule
+        obj["spec"] = {"replicas": 5, "limit": 3}
+        with pytest.raises(HTTPError) as exc:
+            gv_request(http, "POST", "d.io", "v1", "things", body=obj)
+        assert exc.value.code == 422
+
+    def test_cel_rule_on_update_sees_old_self(self, server):
+        srv, http = server
+        make_crd(http, "counters.d.io", "d.io", "counters", "Counter",
+                 [{"name": "v1", "served": True, "storage": True,
+                   "schema": {"openAPIV3Schema": {
+                       "type": "object",
+                       "properties": {"spec": {
+                           "type": "object",
+                           "properties": {"value": {"type": "integer"}},
+                           "x-kubernetes-validations": [
+                               {"rule": "!has(oldSelf.value) || "
+                                        "self.value >= oldSelf.value",
+                                "message": "value may only grow"}],
+                       }}}}}])
+        obj = meta.new_object("Counter", "c1", "default")
+        obj["spec"] = {"value": 5}
+        created = gv_request(http, "POST", "d.io", "v1", "counters",
+                             body=obj)
+        created["spec"]["value"] = 7
+        updated = gv_request(http, "PUT", "d.io", "v1", "counters",
+                             name="c1", body=created)
+        updated["spec"]["value"] = 3  # shrink: transition rule fires
+        with pytest.raises(HTTPError) as exc:
+            gv_request(http, "PUT", "d.io", "v1", "counters",
+                       name="c1", body=updated)
+        assert exc.value.code == 422
+        assert "only grow" in str(exc.value)
+
+    def test_unserved_version_rejected(self, server):
+        srv, http = server
+        self._establish(http)
+        obj = meta.new_object("Thing", "t3", "default")
+        obj["spec"] = {}
+        with pytest.raises(HTTPError) as exc:
+            gv_request(http, "POST", "d.io", "v2", "things", body=obj)
+        assert exc.value.code == 422
+
+
+class TestMultiVersion:
+    def test_none_strategy_serves_both_versions(self, server):
+        srv, http = server
+        schema = {"type": "object", "properties": {
+            "spec": {"type": "object", "properties": {
+                "size": {"type": "integer"}}}}}
+        make_crd(http, "boxes.mv.io", "mv.io", "boxes", "Box",
+                 [{"name": "v1beta1", "served": True, "storage": True,
+                   "schema": {"openAPIV3Schema": schema}},
+                  {"name": "v1", "served": True, "storage": False,
+                   "schema": {"openAPIV3Schema": schema}}])
+        obj = meta.new_object("Box", "b1", "default")
+        obj["apiVersion"] = "mv.io/v1"
+        obj["spec"] = {"size": 3}
+        created = gv_request(http, "POST", "mv.io", "v1", "boxes",
+                             body=obj)
+        # stored at the storage version...
+        raw = srv.store.get("boxes", "default", "b1")
+        assert raw["apiVersion"] == "mv.io/v1beta1"
+        # ...served back at whichever version is asked
+        at_v1 = gv_request(http, "GET", "mv.io", "v1", "boxes",
+                           name="b1")
+        assert at_v1["apiVersion"] == "mv.io/v1"
+        at_beta = gv_request(http, "GET", "mv.io", "v1beta1", "boxes",
+                             name="b1")
+        assert at_beta["apiVersion"] == "mv.io/v1beta1"
+        assert at_v1["spec"]["size"] == 3
+
+    def test_two_storage_versions_rejected(self, server):
+        srv, http = server
+        with pytest.raises(HTTPError) as exc:
+            make_crd(http, "bad.mv.io", "mv.io", "bads", "Bad",
+                     [{"name": "v1", "served": True, "storage": True},
+                      {"name": "v2", "served": True, "storage": True}])
+        assert exc.value.code == 422
+
+    def test_webhook_conversion(self, server):
+        """A conversion webhook that renames spec.size <-> spec.count
+        between versions (conversion/converter.go webhook path)."""
+        srv, http = server
+
+        class Hook(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers["Content-Length"])
+                review = json.loads(self.rfile.read(length))
+                want = review["request"]["desiredAPIVersion"]
+                out = []
+                for obj in review["request"]["objects"]:
+                    obj = dict(obj, apiVersion=want)
+                    spec = dict(obj.get("spec") or {})
+                    if want.endswith("/v2") and "size" in spec:
+                        spec["count"] = spec.pop("size")
+                    elif want.endswith("/v1") and "count" in spec:
+                        spec["size"] = spec.pop("count")
+                    obj["spec"] = spec
+                    out.append(obj)
+                body = json.dumps({"response": {
+                    "uid": review["request"]["uid"],
+                    "convertedObjects": out,
+                    "result": {"status": "Success"}}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        hook_server = HTTPServer(("127.0.0.1", 0), Hook)
+        threading.Thread(target=hook_server.serve_forever,
+                         daemon=True).start()
+        url = f"http://127.0.0.1:{hook_server.server_address[1]}/convert"
+        try:
+            make_crd(http, "jars.wh.io", "wh.io", "jars", "Jar",
+                     [{"name": "v1", "served": True, "storage": True},
+                      {"name": "v2", "served": True, "storage": False}],
+                     extra_spec={"conversion": {
+                         "strategy": "Webhook",
+                         "webhook": {"clientConfig": {"url": url}}}})
+            obj = meta.new_object("Jar", "j1", "default")
+            obj["apiVersion"] = "wh.io/v2"
+            obj["spec"] = {"count": 4}
+            gv_request(http, "POST", "wh.io", "v2", "jars", body=obj)
+            raw = srv.store.get("jars", "default", "j1")
+            assert raw["apiVersion"] == "wh.io/v1"
+            assert raw["spec"] == {"size": 4}  # webhook renamed on store
+            at_v2 = gv_request(http, "GET", "wh.io", "v2", "jars",
+                               name="j1")
+            assert at_v2["apiVersion"] == "wh.io/v2"
+            assert at_v2["spec"] == {"count": 4}  # renamed back on read
+        finally:
+            hook_server.shutdown()
+            hook_server.server_close()
+
+
+class TestCRDSubresources:
+    def test_status_gated_by_declaration(self, server):
+        srv, http = server
+        make_crd(http, "plain.sub.io", "sub.io", "plains", "Plain",
+                 [{"name": "v1", "served": True, "storage": True}])
+        make_crd(http, "rich.sub.io", "sub.io", "riches", "Rich",
+                 [{"name": "v1", "served": True, "storage": True}],
+                 extra_spec={"subresources": {
+                     "status": {},
+                     "scale": {"specReplicasPath": ".spec.replicas",
+                               "statusReplicasPath": ".status.replicas"}}})
+        for plural, kind, name in (("plains", "Plain", "p1"),
+                                   ("riches", "Rich", "r1")):
+            obj = meta.new_object(kind, name, "default")
+            obj["spec"] = {"replicas": 2}
+            gv_request(http, "POST", "sub.io", "v1", plural, body=obj)
+        # undeclared -> 404
+        with pytest.raises(kv.NotFoundError):
+            gv_request(http, "PUT", "sub.io", "v1", "plains", name="p1",
+                       body={"status": {"replicas": 2}},
+                       subresource="status")
+        # declared -> works
+        updated = gv_request(http, "PUT", "sub.io", "v1", "riches",
+                             name="r1",
+                             body={"status": {"replicas": 2}},
+                             subresource="status")
+        assert updated["status"]["replicas"] == 2
+
+    def test_scale_paths(self, server):
+        srv, http = server
+        make_crd(http, "flocks.sub.io", "sub.io", "flocks", "Flock",
+                 [{"name": "v1", "served": True, "storage": True}],
+                 extra_spec={"subresources": {
+                     "scale": {"specReplicasPath": ".spec.instances",
+                               "statusReplicasPath":
+                                   ".status.readyInstances"}}})
+        obj = meta.new_object("Flock", "f1", "default")
+        obj["spec"] = {"instances": 3}
+        obj["status"] = {"readyInstances": 1}
+        gv_request(http, "POST", "sub.io", "v1", "flocks", body=obj)
+        scale = gv_request(http, "GET", "sub.io", "v1", "flocks",
+                           name="f1", subresource="scale")
+        assert scale["kind"] == "Scale"
+        assert scale["spec"]["replicas"] == 3
+        assert scale["status"]["replicas"] == 1
+        gv_request(http, "PUT", "sub.io", "v1", "flocks", name="f1",
+                   body={"spec": {"replicas": 7}}, subresource="scale")
+        raw = srv.store.get("flocks", "default", "f1")
+        assert raw["spec"]["instances"] == 7
+
+
+class TestReviewRegressions:
+    def test_transition_rule_skipped_on_create(self, server):
+        """A rule referencing oldSelf must not block CREATE."""
+        srv, http = server
+        make_crd(http, "grows.rr.io", "rr.io", "grows", "Grow",
+                 [{"name": "v1", "served": True, "storage": True,
+                   "schema": {"openAPIV3Schema": {
+                       "type": "object",
+                       "properties": {"spec": {
+                           "type": "object",
+                           "properties": {"n": {"type": "integer"}},
+                           "x-kubernetes-validations": [
+                               {"rule": "self.n >= oldSelf.n"}]}}}}}])
+        obj = meta.new_object("Grow", "g1", "default")
+        obj["spec"] = {"n": 1}
+        created = gv_request(http, "POST", "rr.io", "v1", "grows",
+                             body=obj)  # must not 422
+        created["spec"]["n"] = 0
+        with pytest.raises(HTTPError):  # but the update rule still bites
+            gv_request(http, "PUT", "rr.io", "v1", "grows", name="g1",
+                       body=created)
+
+    def test_map_values_pruned_and_defaulted(self, server):
+        srv, http = server
+        make_crd(http, "maps.rr.io", "rr.io", "mapthings", "MapThing",
+                 [{"name": "v1", "served": True, "storage": True,
+                   "schema": {"openAPIV3Schema": {
+                       "type": "object",
+                       "properties": {"spec": {
+                           "type": "object",
+                           "additionalProperties": {
+                               "type": "object",
+                               "properties": {
+                                   "weight": {"type": "integer",
+                                              "default": 10}}}}}}}}])
+        obj = meta.new_object("MapThing", "m1", "default")
+        obj["spec"] = {"zone-a": {"weight": 2, "junk": True},
+                       "zone-b": {}}
+        created = gv_request(http, "POST", "rr.io", "v1", "mapthings",
+                             body=obj)
+        assert created["spec"]["zone-a"] == {"weight": 2}  # junk pruned
+        assert created["spec"]["zone-b"] == {"weight": 10}  # defaulted
+
+    def test_get_scale_undeclared_404(self, server):
+        srv, http = server
+        make_crd(http, "nos.rr.io", "rr.io", "nos", "No",
+                 [{"name": "v1", "served": True, "storage": True}])
+        obj = meta.new_object("No", "n1", "default")
+        obj["spec"] = {"replicas": 1}
+        gv_request(http, "POST", "rr.io", "v1", "nos", body=obj)
+        with pytest.raises(kv.NotFoundError):
+            gv_request(http, "GET", "rr.io", "v1", "nos", name="n1",
+                       subresource="scale")
+
+    def test_webhook_down_read_is_500_not_crash(self, server):
+        srv, http = server
+        make_crd(http, "deads.rr.io", "rr.io", "deads", "Dead",
+                 [{"name": "v1", "served": True, "storage": True},
+                  {"name": "v2", "served": True, "storage": False}],
+                 extra_spec={"conversion": {
+                     "strategy": "Webhook",
+                     "webhook": {"clientConfig": {
+                         "url": "http://127.0.0.1:1/convert"}}}})
+        obj = meta.new_object("Dead", "d1", "default")
+        obj["apiVersion"] = "rr.io/v1"
+        obj["spec"] = {}
+        gv_request(http, "POST", "rr.io", "v1", "deads", body=obj)
+        with pytest.raises(HTTPError) as exc:  # not a dropped conn
+            gv_request(http, "GET", "rr.io", "v2", "deads", name="d1")
+        assert exc.value.code == 500
+
+
+class TestCELUnit:
+    def test_subset_behaviors(self):
+        obj = {"a": [1, 2, 3], "s": "hello", "m": {"k": True}}
+        assert cel.evaluate("self.a.map(x, x * 2) == [2, 4, 6]", obj)
+        assert cel.evaluate("self.a.filter(x, x > 1) == [2, 3]", obj)
+        assert cel.evaluate("self.a.exists_one(x, x == 2)", obj)
+        assert cel.evaluate("self.s.contains('ell')", obj)
+        assert cel.evaluate("size(self.m) == 1", obj)
+        assert cel.evaluate("'x' + 'y' == 'xy'", obj)
+        assert cel.evaluate("7 / 2 == 3 && 7 % 2 == 1", obj)
+        with pytest.raises(cel.CELError):
+            cel.evaluate("1 / 0 == 1", obj)
+        with pytest.raises(cel.CELError):
+            cel.evaluate("self.a", obj)  # non-boolean result
+
+    def test_division_truncates_toward_zero(self):
+        # CEL is C-like: -7/2 == -3 (Python floor would say -4)
+        assert cel.evaluate("0 - 7 / 2 == 0 - 3", {})
+        assert cel.evaluate("(0 - 7) % 2 == 0 - 1", {})
+        assert cel.evaluate("7 / 2 == 3 && 7 % 2 == 1", {})
