@@ -58,6 +58,8 @@ GROUP_KINDS: dict[str, tuple[str, tuple[str, ...]]] = {
     "certificatesigningrequests": ("CertificateSigningRequest", ("csr",)),
     "endpointslices": ("EndpointSlice", ()),
     "apiservices": ("APIService", ()),
+    "flowschemas": ("FlowSchema", ()),
+    "prioritylevelconfigurations": ("PriorityLevelConfiguration", ()),
 }
 
 # non-v1 preferred versions (everything else serves v1)

@@ -88,6 +88,8 @@ BUILTIN_GROUPS = {
     "certificates.k8s.io": {"certificatesigningrequests"},
     "discovery.k8s.io": {"endpointslices"},
     "apiregistration.k8s.io": {"apiservices"},
+    "flowcontrol.apiserver.k8s.io": {"flowschemas",
+                                     "prioritylevelconfigurations"},
 }
 
 SCALABLE = {"deployments", "replicasets", "statefulsets",
@@ -251,6 +253,10 @@ class APIServer:
 
     def start(self) -> "APIServer":
         self.bootstrap_system()
+        if self.flow is not None:
+            # FlowSchema/PriorityLevelConfiguration objects drive the
+            # dispatcher from here on (apf_controller.go)
+            self.flow.bind_store(self.store)
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         name="apiserver", daemon=True)
         self._thread.start()
@@ -284,6 +290,8 @@ class APIServer:
     def stop(self) -> None:
         if self.authorizer is not None:
             self.authorizer.stop()
+        if self.flow is not None:
+            self.flow.stop()
         self.aggregator.stop()
         self.httpd.shutdown()
         self.httpd.server_close()  # release the listening socket
@@ -503,9 +511,11 @@ class APIServer:
                     bool(r) and r.subresource in NODE_STREAM_SUBRESOURCES)
                 if server.flow is not None and r and r.resource \
                         and not is_long:
+                    ident = self._identity() or ("system:anonymous", ())
                     try:
-                        ticket = server.flow.admit(self._user(), verb,
-                                                   r.resource)
+                        ticket = server.flow.admit(ident[0], verb,
+                                                   r.resource,
+                                                   tuple(ident[1]))
                     except flowcontrol.RejectedError as e:
                         with server._metrics_lock:
                             server.metrics["requests_rejected_total"] += 1

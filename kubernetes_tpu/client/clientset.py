@@ -65,6 +65,7 @@ CLUSTER_SCOPED_RESOURCES = frozenset({
     NODES, PVS, NAMESPACES, PRIORITYCLASSES, STORAGECLASSES, CSINODES,
     CSRS, VOLUMEATTACHMENTS, CLUSTERROLES, CLUSTERROLEBINDINGS,
     "apiservices", "customresourcedefinitions", "storageversions",
+    "flowschemas", "prioritylevelconfigurations",
 })
 
 

@@ -1,0 +1,351 @@
+"""APF fair queuing: shuffle sharding, round-robin dispatch,
+API-object-driven configuration.
+
+Reference: staging/src/k8s.io/apiserver/pkg/util/flowcontrol/
+fairqueuing/queueset/queueset.go (dispatch fairness),
+shufflesharding/dealer.go (hand dealing), apf_controller.go
+(FlowSchema/PriorityLevelConfiguration as config source).
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import flowcontrol as fc
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import wait_for
+
+
+class TestShuffleSharding:
+    def test_hand_properties(self):
+        hand = fc.shuffle_shard_hand("alice", 128, 8)
+        assert len(hand) == 8
+        assert len(set(hand)) == 8              # distinct queues
+        assert all(0 <= i < 128 for i in hand)
+        assert fc.shuffle_shard_hand("alice", 128, 8) == hand  # stable
+        assert fc.shuffle_shard_hand("bob", 128, 8) != hand
+
+    def test_small_pool_degenerates_to_all(self):
+        assert sorted(fc.shuffle_shard_hand("x", 4, 8)) == [0, 1, 2, 3]
+
+    def test_hands_spread(self):
+        """Two flows' hands should rarely fully collide — with 32
+        queues / hand 4, distinct users land on distinct queue sets."""
+        hands = [set(fc.shuffle_shard_hand(f"user-{i}", 32, 4))
+                 for i in range(50)]
+        full_collisions = sum(1 for i in range(50) for j in range(i)
+                              if hands[i] == hands[j])
+        assert full_collisions <= 1
+
+
+class TestDrowningFlow:
+    def test_noisy_flow_cannot_starve_peer(self):
+        """One elephant flow with 30 queued requests; a mouse flow's
+        single request must be admitted within the first few dispatches
+        (round-robin across queues), NOT after the elephant drains."""
+        lvl = fc.PriorityLevel("t", seats=1, queues=16, queue_length=50,
+                               hand_size=4)
+        order: list[str] = []
+        order_lock = threading.Lock()
+        assert lvl.acquire(flow_key="warm")  # hold the only seat
+
+        def worker(flow, tag):
+            lvl.acquire(flow_key=flow, timeout=30.0)
+            with order_lock:
+                order.append(tag)
+            lvl.release()
+
+        threads = []
+        for i in range(30):
+            t = threading.Thread(target=worker,
+                                 args=("elephant", "E"), daemon=True)
+            t.start()
+            threads.append(t)
+        # let the elephants enqueue first — worst case for the mouse
+        assert wait_for(lambda: lvl.stats()["waiting"] == 30)
+        t = threading.Thread(target=worker, args=("mouse", "M"),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+        assert wait_for(lambda: lvl.stats()["waiting"] == 31)
+        lvl.release()  # open the floodgate
+        for t in threads:
+            t.join(timeout=30.0)
+        assert len(order) == 31
+        mouse_pos = order.index("M")
+        # elephant hand <= 4 queues, mouse picks a different/shorter
+        # queue: round-robin must reach it within one sweep
+        assert mouse_pos <= 4, f"mouse dispatched at position {mouse_pos}"
+
+    def test_elephant_fills_only_its_hand(self):
+        """Queue-full rejection hits the elephant (its hand saturated)
+        while a fresh flow still queues fine."""
+        lvl = fc.PriorityLevel("t", seats=1, queues=8, queue_length=2,
+                               hand_size=2)
+        lvl.acquire(flow_key="warm")
+        accepted = 0
+        with pytest.raises(fc.RejectedError):
+            for _ in range(50):
+                threading.Thread(
+                    target=lambda: (lvl.acquire("elephant", timeout=20),
+                                    lvl.release()),
+                    daemon=True).start()
+                time.sleep(0.005)
+                accepted += 1
+                # force synchronous rejection check
+                if lvl.stats()["waiting"] >= 4:
+                    lvl.acquire("elephant", timeout=20)
+        # elephant saturated its 2-queue hand (2*2 slots), but...
+        assert 4 <= accepted <= 6
+        mouse_done = threading.Event()
+        threading.Thread(
+            target=lambda: (lvl.acquire("mouse", timeout=20),
+                            mouse_done.set(), lvl.release()),
+            daemon=True).start()
+        time.sleep(0.05)
+        lvl.release()
+        assert mouse_done.wait(10.0)  # mouse unaffected by the 429s
+
+
+class TestAPIObjectConfig:
+    def _plc(self, name, seats, queues=8, qlen=5, hand=2):
+        obj = meta.new_object("PriorityLevelConfiguration", name, None)
+        obj["spec"] = {"type": "Limited", "limited": {
+            "nominalConcurrencyShares": seats,
+            "limitResponse": {"type": "Queue", "queuing": {
+                "queues": queues, "queueLengthLimit": qlen,
+                "handSize": hand}}}}
+        return obj
+
+    def _schema(self, name, level, precedence, user=None, group=None,
+                resources=None):
+        obj = meta.new_object("FlowSchema", name, None)
+        subjects = []
+        if user:
+            subjects.append({"kind": "User", "name": user})
+        if group:
+            subjects.append({"kind": "Group", "name": group})
+        rule = {"subjects": subjects}
+        if resources:
+            rule["resourceRules"] = [{"verbs": ["*"],
+                                      "resources": resources}]
+        obj["spec"] = {
+            "priorityLevelConfiguration": {"name": level},
+            "matchingPrecedence": precedence,
+            "rules": [rule]}
+        return obj
+
+    def test_stored_objects_drive_dispatch(self):
+        store = kv.MemoryStore()
+        store.create(fc.PRIORITYLEVELS, self._plc("batch-lane", 3))
+        store.create(fc.FLOWSCHEMAS,
+                     self._schema("batch-users", "batch-lane", 50,
+                                  group="batch-jobs"))
+        d = fc.Dispatcher()
+        d.bind_store(store)
+        try:
+            lvl = d.classify("worker-7", "create", "jobs",
+                             groups=("batch-jobs",))
+            assert lvl.name == "batch-lane"
+            assert lvl.seats == 3
+            # non-members keep the default routing
+            assert d.classify("alice", "get", "pods",
+                              groups=()).name == "global-default"
+        finally:
+            d.stop()
+
+    def test_config_watch_applies_new_objects(self):
+        store = kv.MemoryStore()
+        d = fc.Dispatcher()
+        d.bind_store(store)
+        try:
+            assert d.classify("vip", "get", "pods").name == \
+                "global-default"
+            store.create(fc.PRIORITYLEVELS, self._plc("vip-lane", 9))
+            store.create(fc.FLOWSCHEMAS,
+                         self._schema("vip-schema", "vip-lane", 10,
+                                      user="vip"))
+            assert wait_for(lambda: d.classify(
+                "vip", "get", "pods").name == "vip-lane", timeout=5.0)
+            assert d.levels["vip-lane"].seats == 9
+        finally:
+            d.stop()
+
+    def test_exempt_level_object(self):
+        store = kv.MemoryStore()
+        obj = meta.new_object("PriorityLevelConfiguration", "sys-exempt",
+                              None)
+        obj["spec"] = {"type": "Exempt"}
+        store.create(fc.PRIORITYLEVELS, obj)
+        store.create(fc.FLOWSCHEMAS,
+                     self._schema("root", "sys-exempt", 1, user="root"))
+        d = fc.Dispatcher()
+        d.bind_store(store)
+        try:
+            lvl = d.classify("root", "delete", "nodes")
+            assert lvl.exempt
+            for _ in range(100):
+                assert lvl.acquire("root")  # never blocks
+        finally:
+            d.stop()
+
+    def test_resource_rule_scoping(self):
+        store = kv.MemoryStore()
+        store.create(fc.PRIORITYLEVELS, self._plc("pods-only", 2))
+        store.create(fc.FLOWSCHEMAS,
+                     self._schema("pods-only-s", "pods-only", 20,
+                                  user="*", resources=["pods"]))
+        d = fc.Dispatcher()
+        d.bind_store(store)
+        try:
+            assert d.classify("x", "get", "pods").name == "pods-only"
+            assert d.classify("x", "get", "nodes").name != "pods-only"
+        finally:
+            d.stop()
+
+
+class TestConfigLifecycle:
+    def test_reload_keeps_live_level_object(self):
+        """A config update must reconfigure the EXISTING level — a
+        replacement object would leak the seats held by in-flight
+        tickets that release() on the old one."""
+        store = kv.MemoryStore()
+        d = fc.Dispatcher()
+        d.bind_store(store)
+        try:
+            before = d.levels["global-default"]
+            ticket = d.admit("alice", "get", "pods")  # holds a seat
+            plc = meta.new_object("PriorityLevelConfiguration",
+                                  "global-default", None)
+            plc["spec"] = {"type": "Limited", "limited": {
+                "nominalConcurrencyShares": 2,
+                "limitResponse": {"type": "Queue", "queuing": {
+                    "queues": 4, "queueLengthLimit": 3,
+                    "handSize": 2}}}}
+            store.create(fc.PRIORITYLEVELS, plc)
+            assert wait_for(
+                lambda: d.levels["global-default"].seats == 2)
+            assert d.levels["global-default"] is before  # same object
+            assert before.stats()["in_flight"] == 1
+            ticket.__exit__()
+            assert before.stats()["in_flight"] == 0  # seat came back
+        finally:
+            d.stop()
+
+    def test_deleting_objects_reverts_to_defaults(self):
+        store = kv.MemoryStore()
+        d = fc.Dispatcher()
+        d.bind_store(store)
+        try:
+            plc = meta.new_object("PriorityLevelConfiguration",
+                                  "global-default", None)
+            plc["spec"] = {"type": "Limited",
+                           "limited": {"nominalConcurrencyShares": 1}}
+            store.create(fc.PRIORITYLEVELS, plc)
+            fs_obj = meta.new_object("FlowSchema", "route-bob", None)
+            fs_obj["spec"] = {
+                "priorityLevelConfiguration": {"name": "leader-election"},
+                "matchingPrecedence": 5,
+                "rules": [{"subjects": [{"kind": "User",
+                                         "name": "bob"}]}]}
+            store.create(fc.FLOWSCHEMAS, fs_obj)
+            assert wait_for(
+                lambda: d.levels["global-default"].seats == 1)
+            assert wait_for(lambda: d.classify(
+                "bob", "get", "pods").name == "leader-election")
+            store.delete(fc.PRIORITYLEVELS, "", "global-default")
+            store.delete(fc.FLOWSCHEMAS, "", "route-bob")
+            assert wait_for(
+                lambda: d.levels["global-default"].seats == 20)
+            assert wait_for(lambda: d.classify(
+                "bob", "get", "pods").name == "global-default")
+        finally:
+            d.stop()
+
+    def test_reject_limit_response(self):
+        store = kv.MemoryStore()
+        plc = meta.new_object("PriorityLevelConfiguration", "shed", None)
+        plc["spec"] = {"type": "Limited", "limited": {
+            "nominalConcurrencyShares": 1,
+            "limitResponse": {"type": "Reject"}}}
+        store.create(fc.PRIORITYLEVELS, plc)
+        fs_obj = meta.new_object("FlowSchema", "shed-all", None)
+        fs_obj["spec"] = {"priorityLevelConfiguration": {"name": "shed"},
+                          "matchingPrecedence": 1, "rules": []}
+        store.create(fc.FLOWSCHEMAS, fs_obj)
+        d = fc.Dispatcher()
+        d.bind_store(store)
+        try:
+            lvl = d.classify("x", "get", "pods")
+            assert lvl.name == "shed"
+            lvl.acquire("x")
+            t0 = time.monotonic()
+            with pytest.raises(fc.RejectedError):
+                lvl.acquire("x", timeout=10.0)  # rejects NOW, no wait
+            assert time.monotonic() - t0 < 1.0
+        finally:
+            d.stop()
+
+    def test_non_resource_rules_do_not_match_resources(self):
+        obj = meta.new_object("FlowSchema", "probes", None)
+        obj["spec"] = {
+            "priorityLevelConfiguration": {"name": "exempt"},
+            "matchingPrecedence": 2,
+            "rules": [{"subjects": [{"kind": "Group", "name": "*"}],
+                       "nonResourceRules": [
+                           {"verbs": ["get"],
+                            "nonResourceURLs": ["/healthz"]}]}]}
+        fs = fc._schema_from_object(obj)
+        assert not fs.match_with_groups("anyone", "get", "pods",
+                                        ("system:authenticated",))
+
+
+class TestServerIntegration:
+    def test_drowning_flow_through_http(self):
+        """Two users at the same 1-seat level over real HTTP: the noisy
+        user's backlog must not starve the quiet one."""
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client.http_client import HTTPClient
+        from kubernetes_tpu.testing import make_pod
+        store = kv.MemoryStore()
+        levels = (("tiny", 1, 8, 20, False), ("catch-all", 5, 1, 50,
+                                              False))
+        schemas = [fc.FlowSchema("all", "tiny", 1)]
+        tokens = {"tok-noisy": ("noisy", ()),
+                  "tok-quiet": ("quiet", ())}
+        srv = APIServer(store, tokens=tokens,
+                        flow_dispatcher=fc.Dispatcher(
+                            levels=levels, schemas=schemas,
+                            queue_timeout=20.0)).start()
+        try:
+            noisy = HTTPClient.from_url(srv.url, token="tok-noisy")
+            quiet = HTTPClient.from_url(srv.url, token="tok-quiet")
+            results = []
+            lock = threading.Lock()
+
+            def do(client, tag, name):
+                t0 = time.monotonic()
+                client.create("pods", make_pod(name).build())
+                with lock:
+                    results.append((tag, time.monotonic() - t0))
+
+            threads = [threading.Thread(
+                target=do, args=(noisy, "N", f"noisy-{i}"), daemon=True)
+                for i in range(12)]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            tq = threading.Thread(target=do,
+                                  args=(quiet, "Q", "quiet-0"),
+                                  daemon=True)
+            tq.start()
+            threads.append(tq)
+            for t in threads:
+                t.join(timeout=30.0)
+            assert len(results) == 13  # nobody starved/429ed
+            quiet_time = next(d for tag, d in results if tag == "Q")
+            assert quiet_time < 5.0
+        finally:
+            srv.stop()
