@@ -673,6 +673,11 @@ class PodBatch:
             setattr(self, name, arr)
         return arr
 
+    def materialized(self, caps: "Caps", keys) -> dict:
+        """Dense arrays for `keys` — the ONE place consumers that need
+        every field (mesh upload, dryrun, tests) densify a batch."""
+        return {k: self.ensure(caps, k) for k in keys}
+
 
 def slice_pod_batch(batch: "PodBatch", lo: int, hi: int,
                     p_cap: int) -> "PodBatch":

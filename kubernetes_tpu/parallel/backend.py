@@ -123,10 +123,9 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         (None == zeros) PodBatch fields first."""
         import jax
         pshard = self._shardings[2]
-        always = ("req", "req_nz", "p_valid", "untol_hard")
-        return {k: jax.device_put(
-            getattr(batch, k) if k in always else batch.ensure(self.caps, k),
-            pshard[k]) for k in POD_KEYS}
+        return {k: jax.device_put(v, pshard[k])
+                for k, v in batch.materialized(self.caps,
+                                               POD_KEYS).items()}
 
     def _upload_static(self) -> None:
         import jax

@@ -43,13 +43,9 @@ def build_inputs(caps, nodes, pods, batch_size):
         "dom_asg": jnp.asarray(tensors.dom_asg),
         "cd_sg": jnp.asarray(cd_sg), "cd_asg": jnp.asarray(cd_asg),
     }
-    pod_arrays = {k: jnp.asarray(batch.ensure(caps, k) if k not in (
-        "req", "req_nz", "p_valid", "untol_hard") else getattr(batch, k))
-        for k in [
-        "req", "req_nz", "p_valid", "untol_hard", "untol_prefer", "sel_any",
-        "sel_any_active", "sel_forb", "key_any", "key_any_active", "key_forb",
-        "ports", "node_row", "c_kind", "c_sg", "c_maxskew", "c_selfmatch",
-        "c_weight", "inc_sg", "inc_asg", "match_asg"]}
+    from kubernetes_tpu.parallel.mesh import pod_specs
+    pod_arrays = {k: jnp.asarray(v) for k, v in
+                  batch.materialized(caps, tuple(pod_specs())).items()}
     return tensors, node_arrays, pod_arrays
 
 

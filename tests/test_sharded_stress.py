@@ -1,0 +1,232 @@
+"""Sharded-backend stress: the cross-shard rules that make a mesh
+placement correct, not just fast (VERDICT r2 weak #4).
+
+All on the 8-virtual-device CPU mesh from conftest:
+  - anti-affinity / topology-spread domains SPLIT across shards — the
+    replicated domain-count tables (cd_sg/cd_asg + psum coherence) are
+    what keeps a domain consistent when its member nodes live on
+    different shards
+  - FLUSH_FIRST under node churn while a batch is in flight
+  - external-writer races through the row-patch path
+  - placement parity with the single-chip backend on a constraint
+    workload
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.ops.backend import FLUSH_FIRST, TPUBatchBackend
+from kubernetes_tpu.ops.flatten import Caps
+from kubernetes_tpu.parallel.backend import ShardedTPUBatchBackend
+from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler.types import PodInfo
+from kubernetes_tpu.testing import make_node, make_pod
+
+CAPS = dict(l_cap=64, kl_cap=32, t_cap=8, pt_cap=8, s_cap=2,
+            sg_cap=16, asg_cap=16)
+
+
+def build_cluster(n_nodes, zones=4, cpu="8", mem="32Gi"):
+    """Nodes round-robin over zones: consecutive rows land on the SAME
+    shard (contiguous slabs), so a zone's members span ALL shards."""
+    cache = Cache()
+    for i in range(n_nodes):
+        cache.add_node(make_node(f"s{i}").zone("zabcdefgh"[i % zones])
+                       .labels(**{"kubernetes.io/hostname": f"s{i}"})
+                       .capacity(cpu=cpu, mem=mem).build())
+    return cache, cache.update_snapshot(Snapshot())
+
+
+def placements(results):
+    return [nm for nm, _st in results]
+
+
+class TestCrossShardDomains:
+    def test_spread_across_shard_split_zones(self):
+        """64 nodes / 4 zones / 8 shards: every zone spans every shard.
+        maxSkew=1 spread over 32 pods must stay balanced globally, not
+        per shard."""
+        caps = Caps(n_cap=64, **CAPS)
+        backend = ShardedTPUBatchBackend(caps, batch_size=32)
+        cache, snap = build_cluster(64, zones=4)
+        pods = [PodInfo(make_pod(f"sp{i}").labels(app="web")
+                        .req(cpu="100m")
+                        .topology_spread("topology.kubernetes.io/zone",
+                                         max_skew=1,
+                                         match_labels={"app": "web"})
+                        .build())
+                for i in range(32)]
+        got = backend.assign(pods, snap)
+        names = placements(got)
+        assert all(names), [st for _nm, st in got]
+        per_zone = {}
+        for nm in names:
+            zone = "zabcdefgh"[int(nm[1:]) % 4]
+            per_zone[zone] = per_zone.get(zone, 0) + 1
+        assert max(per_zone.values()) - min(per_zone.values()) <= 1, \
+            per_zone
+
+    def test_anti_affinity_hostname_cross_shard(self):
+        """One pod per hostname-domain: with 24 nodes over 8 shards,
+        anti-affinity self-conflicts must hold across shard boundaries
+        within a single batch."""
+        caps = Caps(n_cap=24, **CAPS)
+        backend = ShardedTPUBatchBackend(caps, batch_size=24)
+        cache, snap = build_cluster(24)
+        pods = [PodInfo(make_pod(f"aa{i}").labels(app="solo")
+                        .req(cpu="100m")
+                        .pod_affinity("kubernetes.io/hostname",
+                                      {"app": "solo"}, anti=True).build())
+                for i in range(24)]
+        names = placements(backend.assign(pods, snap))
+        assert all(names)
+        assert len(set(names)) == 24  # pairwise distinct hosts
+
+    def test_anti_affinity_saturation_rejects_rest(self):
+        """More anti-affinity pods than hosts: exactly n_nodes place,
+        the overflow is rejected — globally, not per shard."""
+        caps = Caps(n_cap=16, **CAPS)
+        backend = ShardedTPUBatchBackend(caps, batch_size=24)
+        cache, snap = build_cluster(16)
+        pods = [PodInfo(make_pod(f"ov{i}").labels(app="solo")
+                        .req(cpu="100m")
+                        .pod_affinity("kubernetes.io/hostname",
+                                      {"app": "solo"}, anti=True).build())
+                for i in range(24)]
+        got = backend.assign(pods, snap)
+        names = [nm for nm, _ in got if nm]
+        assert len(names) == 16
+        assert len(set(names)) == 16
+
+    def test_spread_state_persists_across_batches(self):
+        """Domain counts committed by batch k constrain batch k+1 —
+        the replicated cd tables must stay coherent with the sharded
+        node state between batches."""
+        caps = Caps(n_cap=64, **CAPS)
+        backend = ShardedTPUBatchBackend(caps, batch_size=16)
+        cache, snap = build_cluster(64, zones=4)
+
+        def spread_pods(tag, n):
+            return [PodInfo(make_pod(f"{tag}{i}").labels(app="web")
+                            .req(cpu="100m")
+                            .topology_spread(
+                                "topology.kubernetes.io/zone", max_skew=1,
+                                match_labels={"app": "web"}).build())
+                    for i in range(n)]
+
+        all_names = []
+        for r in range(4):
+            names = placements(backend.assign(spread_pods(f"b{r}-", 16),
+                                              snap))
+            assert all(names)
+            all_names += names
+        per_zone = {}
+        for nm in all_names:
+            zone = "zabcdefgh"[int(nm[1:]) % 4]
+            per_zone[zone] = per_zone.get(zone, 0) + 1
+        assert max(per_zone.values()) - min(per_zone.values()) <= 1, \
+            per_zone
+
+
+class TestFlushFirstAndPatches:
+    def test_flush_first_under_node_churn(self):
+        """Pipelined dispatch: while batch k is unresolved, a node
+        appears — the next dispatch must refuse (FLUSH_FIRST), then
+        succeed after k resolves, and the new node must be usable."""
+        caps = Caps(n_cap=32, **CAPS)
+        backend = ShardedTPUBatchBackend(caps, batch_size=8)
+        backend.warmup()
+        cache, snap = build_cluster(8, cpu="2")
+        pods = lambda tag: [PodInfo(make_pod(f"{tag}{i}")  # noqa: E731
+                                    .req(cpu="1").build())
+                            for i in range(8)]
+        resolve1 = backend.dispatch(pods("k"), snap)
+        assert resolve1 is not FLUSH_FIRST
+        # churn: a fat new node lands while k is in flight
+        cache.add_node(make_node("late-node")
+                       .capacity(cpu="64", mem="64Gi").build())
+        snap2 = cache.update_snapshot(Snapshot())
+        got = backend.dispatch(pods("j"), snap2)
+        assert got is FLUSH_FIRST
+        assert backend.stats["flush_first"] >= 1
+        assert all(placements(resolve1()))
+        resolve2 = backend.dispatch(pods("j"), snap2)
+        assert resolve2 is not FLUSH_FIRST
+        names2 = placements(resolve2())
+        # 8 nodes x 2cpu are exhausted by batch k: batch j fits only
+        # because the churned-in node was patched into the shard slabs
+        assert names2.count("late-node") == 8, names2
+
+    def test_external_writer_rides_patch_path(self):
+        """Another writer binds pods onto a node between batches: the
+        diff lands as row patches (no full refresh), and the kernel
+        sees the reduced capacity."""
+        caps = Caps(n_cap=32, **CAPS)
+        backend = ShardedTPUBatchBackend(caps, batch_size=4)
+        cache, snap = build_cluster(4, cpu="2")
+        assert all(placements(backend.assign(
+            [PodInfo(make_pod("w0").req(cpu="100m").build())], snap)))
+        refreshes = backend.stats["full_refresh"]
+        # external scheduler stuffs s0 full (2 cpu worth)
+        for i in range(2):
+            cache.add_pod(make_pod(f"ext{i}").req(cpu="1")
+                          .node("s0").build())
+        snap2 = cache.update_snapshot(Snapshot())
+        got = backend.assign(
+            [PodInfo(make_pod(f"w1-{i}").req(cpu="1").build())
+             for i in range(4)], snap2)
+        names = placements(got)
+        assert all(names)
+        assert "s0" not in names  # patched rows show s0 is full
+        assert backend.stats["full_refresh"] == refreshes  # patch, not refresh
+        assert backend.stats["patched_rows"] >= 1
+
+    def test_pipelined_epoch_skip_no_patches(self):
+        """Back-to-back batches with NO external changes must ride the
+        epoch fast path: zero patches, zero refreshes after the first."""
+        caps = Caps(n_cap=32, **CAPS)
+        backend = ShardedTPUBatchBackend(caps, batch_size=8)
+        cache, snap = build_cluster(8)
+        backend.assign([PodInfo(make_pod("e0").req(cpu="100m").build())],
+                       snap)
+        refreshes = backend.stats["full_refresh"]
+        patched = backend.stats["patched_rows"]
+        for r in range(3):
+            got = backend.assign(
+                [PodInfo(make_pod(f"e{r}-{i}").req(cpu="100m").build())
+                 for i in range(8)], snap)
+            assert all(placements(got))
+        assert backend.stats["full_refresh"] == refreshes
+        assert backend.stats["patched_rows"] == patched
+
+
+class TestShardedParity:
+    def test_constraint_workload_matches_single_chip(self):
+        """Identical mixed constraint workload through both backends:
+        identical placements (the sharded kernel is the same math,
+        sharded)."""
+        caps = Caps(n_cap=32, **CAPS)
+        cache, snap = build_cluster(32, zones=4)
+        pods = []
+        for i in range(24):
+            if i % 3 == 0:
+                p = (make_pod(f"px{i}").labels(app="web").req(cpu="200m")
+                     .topology_spread("topology.kubernetes.io/zone",
+                                      max_skew=1,
+                                      match_labels={"app": "web"})
+                     .build())
+            elif i % 3 == 1:
+                p = (make_pod(f"px{i}").labels(app=f"s{i % 5}")
+                     .req(cpu="100m")
+                     .pod_affinity("kubernetes.io/hostname",
+                                   {"app": f"s{i % 5}"}, anti=True)
+                     .build())
+            else:
+                p = make_pod(f"px{i}").req(cpu="300m").build()
+            pods.append(PodInfo(p))
+        sharded = ShardedTPUBatchBackend(caps, batch_size=24)
+        single = TPUBatchBackend(caps, batch_size=24)
+        got_sh = placements(sharded.assign(pods, snap))
+        got_si = placements(single.assign(pods, snap))
+        assert got_sh == got_si
+        assert all(got_sh)
