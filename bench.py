@@ -79,13 +79,20 @@ EXTRA_CONFIGS = {
                           "depth": 2, "timeout": 900.0},
     "CoschedulingGang": {"workload": "CoschedulingGang", "batch": 4096,
                          "depth": 2, "timeout": 900.0},
+    # the front door: same workload THROUGH a real apiserver with RBAC
+    # + admission + WAL, every component speaking HTTP (the reference
+    # harness schedules via a real apiserver, util.go:79-108).  The
+    # gap vs the LocalClient headline quantifies the REST tax.
+    "SchedulingBasicHTTP": {"workload": "SchedulingBasicLarge",
+                            "nodes": 5000, "pods": 10_000, "batch": 4096,
+                            "depth": 2, "timeout": 900.0, "http": True},
 }
 
 
 def run_once(workload: str, nodes: int | None, pods: int | None,
              batch: int, barrier_timeout: float = 900.0,
              rate: float | None = None, depth: int = 1,
-             admission_ms: float = 0.0) -> dict:
+             admission_ms: float = 0.0, via_http: bool = False) -> dict:
     """One full workload pass in this process; returns the result dict."""
     import copy
 
@@ -116,7 +123,8 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
     summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
                                         batch_size=batch,
                                         pipeline_depth=depth,
-                                        admission_interval=admission_ms / 1e3)
+                                        admission_interval=admission_ms / 1e3,
+                                        via_http=via_http)
     wall = time.monotonic() - t0
     if not stats.get("barrier_ok", False):
         return {"error": "pods left unscheduled", "value": 0.0,
@@ -177,7 +185,8 @@ def child_main() -> None:
                    rate=float(rate) if rate else None,
                    depth=int(os.environ.get("_BENCH_W_DEPTH", "1")),
                    admission_ms=float(os.environ.get("_BENCH_W_ADMISSION_MS",
-                                                     "0")))
+                                                     "0")),
+                   via_http=os.environ.get("_BENCH_W_HTTP") == "1")
     if "error" in res:
         emit(0.0, {"error": res["error"], **res["detail"]})
         sys.exit(1)
@@ -233,6 +242,8 @@ def main() -> None:
                 env["_BENCH_W_DEPTH"] = str(c["depth"])
             if "admission_ms" in c:
                 env["_BENCH_W_ADMISSION_MS"] = str(c["admission_ms"])
+            if c.get("http"):
+                env["_BENCH_W_HTTP"] = "1"
             got = _spawn_child(env, timeout=c.get("timeout", 900.0) + 300)
             if got is None:
                 configs[cname] = {"error": "failed"}

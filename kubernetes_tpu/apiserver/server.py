@@ -308,6 +308,11 @@ class APIServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # response head and body go out as separate writes; with
+            # Nagle on, the body waits for the client's delayed ACK —
+            # a measured 40ms stall PER REQUEST on loopback (23 ->
+            # 2700 req/s when disabled)
+            disable_nagle_algorithm = True
 
             def setup(self):
                 # deferred TLS handshake (see the wrap_socket call):
@@ -639,16 +644,18 @@ class APIServer:
                                 f"{r.resource} has no scale subresource"))
                             return
                         obj = server.store.get(r.resource, r.ns or "", r.name)
+                        self._audit(r, "get", 200)
                         if paths is not None:
                             self._send_json(200, _crd_scale(obj, paths))
                         else:
                             self._send_json(200,
                                             _scale_of(obj, r.resource))
-                        self._audit(r, "get", 200)
                     elif r.name is not None:
-                        obj = server.store.get(r.resource, r.ns or "", r.name)
-                        self._send_json(200, self._serve_custom(r, obj))
+                        obj = self._serve_custom(
+                            r, server.store.get(r.resource, r.ns or "",
+                                                r.name))
                         self._audit(r, "get", 200)
+                        self._send_json(200, obj)
                     else:
                         sel = r.query.get("labelSelector", [None])[0]
                         items, rv = server.store.list(r.resource, r.ns)
@@ -660,11 +667,11 @@ class APIServer:
                             items = server.crds.convert_many(
                                 r.resource, items,
                                 self._custom_version(r))
+                        self._audit(r, "list", 200)
                         self._send_json(200, {
                             "kind": "List", "apiVersion": "v1",
                             "metadata": {"resourceVersion": str(rv)},
                             "items": items})
-                        self._audit(r, "list", 200)
                 except kv.NotFoundError as e:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
                 except kv.TooOldError as e:
@@ -832,9 +839,9 @@ class APIServer:
                     upstream = socket.create_connection((addr, port),
                                                         timeout=30.0)
                 except OSError as e:
+                    self._audit(r, verb, 502)
                     self._send_json(502, status_error(
                         502, "BadGateway", f"kubelet dial failed: {e}"))
-                    self._audit(r, verb, 502)
                     return
                 try:
                     req = [f"{self.command} {path} HTTP/1.1",
@@ -850,10 +857,10 @@ class APIServer:
                     while b"\r\n\r\n" not in head:
                         chunk = upstream.recv(65536)
                         if not chunk:
+                            self._audit(r, verb, 502)
                             self._send_json(502, status_error(
                                 502, "BadGateway",
                                 "kubelet closed during handshake"))
-                            self._audit(r, verb, 502)
                             return
                         head += chunk
                     # handshake done: an interactive stream may sit idle
@@ -1124,8 +1131,9 @@ class APIServer:
                     created = server.store.create(r.resource, obj)
                     if r.resource == crdlib.CRDS:
                         server.crds.establish(created)
-                    self._send_json(201, self._serve_custom(r, created))
+                    body = self._serve_custom(r, created)
                     self._audit(r, "create", 201, created)
+                    self._send_json(201, body)
                 except kv.AlreadyExistsError as e:
                     self._send_json(409, status_error(409, "AlreadyExists",
                                                       str(e)))
@@ -1165,6 +1173,7 @@ class APIServer:
                 import time as timelib
                 stamp = timelib.strftime("%Y-%m-%dT%H:%M:%SZ",
                                          timelib.gmtime(exp))
+                self._audit(r, "create", 201)
                 self._send_json(201, {
                     "kind": "TokenRequest",
                     "apiVersion": "authentication.k8s.io/v1",
@@ -1173,7 +1182,6 @@ class APIServer:
                              "audiences": list(audiences)},
                     "status": {"token": token,
                                "expirationTimestamp": stamp}})
-                self._audit(r, "create", 201)
 
             def _post_binding(self, r: _Route, binding: dict) -> None:
                 """POST pods/{name}/binding (registry/core/pod/storage
@@ -1194,8 +1202,8 @@ class APIServer:
                         return pod
                     server.store.guaranteed_update(
                         "pods", r.ns or "default", r.name, bind)
-                    self._send_json(201, {"kind": "Status", "status": "Success"})
                     self._audit(r, "create", 201)
+                    self._send_json(201, {"kind": "Status", "status": "Success"})
                 except kv.NotFoundError as e:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
                 except kv.ConflictError as e:
@@ -1236,8 +1244,8 @@ class APIServer:
                                 (pdb.get("metadata") or {}).get("name"), dec)
                         except kv.NotFoundError:
                             pass
-                self._send_json(201, {"kind": "Status", "status": "Success"})
                 self._audit(r, "delete", 201)
+                self._send_json(201, {"kind": "Status", "status": "Success"})
 
             def do_PUT(self):
                 begun = self._begin("update")
@@ -1306,9 +1314,9 @@ class APIServer:
                             self._send_json(422, status_error(
                                 422, "Invalid", str(e)))
                             return
-                        self._send_json(200,
-                                        self._serve_custom(r, updated))
+                        body = self._serve_custom(r, updated)
                         self._audit(r, "update", 200)
+                        self._send_json(200, body)
                         return
                     if r.subresource == "scale":
                         paths = (server.crds.scale_paths(r.resource)
@@ -1338,11 +1346,11 @@ class APIServer:
                             self._send_json(422, status_error(
                                 422, "Invalid", str(e)))
                             return
+                        self._audit(r, "update", 200)
                         self._send_json(200, _crd_scale(updated, paths)
                                         if paths is not None
                                         else _scale_of(updated,
                                                        r.resource))
-                        self._audit(r, "update", 200)
                         return
                     old = None
                     try:
@@ -1366,8 +1374,9 @@ class APIServer:
                     updated = server.store.update(r.resource, obj)
                     if r.resource == crdlib.CRDS:
                         server.crds.establish(updated)
-                    self._send_json(200, self._serve_custom(r, updated))
+                    body = self._serve_custom(r, updated)
                     self._audit(r, "update", 200, updated)
+                    self._send_json(200, body)
                 except kv.NotFoundError as e:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
                 except kv.ConflictError as e:
@@ -1455,8 +1464,9 @@ class APIServer:
                         r.resource, r.ns or "", r.name, apply)
                     if r.resource == crdlib.CRDS:
                         server.crds.establish(updated)
-                    self._send_json(200, self._serve_custom(r, updated))
+                    body = self._serve_custom(r, updated)
                     self._audit(r, "patch", 200)
+                    self._send_json(200, body)
                 except (patchlib.PatchError, crdlib.ValidationError) as e:
                     self._send_json(422, status_error(422, "Invalid", str(e)))
                 except adm.AdmissionDenied as e:
@@ -1515,9 +1525,9 @@ class APIServer:
                             # winner (apply-to-existing is well-defined)
                             created = None
                         if created is not None:
-                            self._send_json(201,
-                                            self._serve_custom(r, created))
+                            body = self._serve_custom(r, created)
                             self._audit(r, "apply", 201, created)
+                            self._send_json(201, body)
                             return
 
                     def merge(cur):
@@ -1546,8 +1556,9 @@ class APIServer:
                         r.resource, r.ns or "", r.name, merge)
                     if r.resource == crdlib.CRDS:
                         server.crds.establish(updated)
-                    self._send_json(200, self._serve_custom(r, updated))
+                    body = self._serve_custom(r, updated)
                     self._audit(r, "apply", 200)
+                    self._send_json(200, body)
                 except mflib.ApplyConflict as e:
                     body = status_error(409, "Conflict", str(e))
                     body["details"] = {"conflicts": [
@@ -1621,8 +1632,8 @@ class APIServer:
                                                   r.name)
                     if r.resource == crdlib.CRDS:
                         server.crds.remove(deleted)
-                    self._send_json(200, deleted)
                     self._audit(r, "delete", 200)
+                    self._send_json(200, deleted)
                 except kv.NotFoundError as e:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
 

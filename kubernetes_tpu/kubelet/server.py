@@ -126,6 +126,9 @@ class KubeletServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # see apiserver/server.py: Nagle + delayed ACK costs 40ms
+            # per request on two-write responses
+            disable_nagle_algorithm = True
 
             def log_message(self, *a):  # quiet
                 pass

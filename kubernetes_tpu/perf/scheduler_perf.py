@@ -147,26 +147,57 @@ class PerfCluster:
     client: LocalClient
     factory: SharedInformerFactory
     scheduler: Scheduler
+    server: object = None       # APIServer when via_http
+    _tmpdir: object = None      # WAL dir lifetime
 
     def shutdown(self) -> None:
         self.scheduler.stop()
         self.factory.stop()
         self.client.close()  # event-broadcaster thread
+        if self.server is not None:
+            self.server.stop()
+        if self._tmpdir is not None:
+            self._tmpdir.cleanup()
 
 
 def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                   store: kv.MemoryStore | None = None,
                   pipeline_depth: int = 1,
-                  admission_interval: float = 0.0) -> PerfCluster:
+                  admission_interval: float = 0.0,
+                  via_http: bool = False) -> PerfCluster:
     """mustSetupScheduler (util.go:79): in-proc everything, no kubelet.
 
     pipeline_depth/admission_interval select latency mode (scheduler.py):
     depth ~4 + a few-ms admission interval turns the batch path into
-    overlapped micro-batches for p99-targeted runs."""
+    overlapped micro-batches for p99-targeted runs.
+
+    via_http runs the FRONT DOOR: a real apiserver with RBAC +
+    admission + WAL durability, and the scheduler (informers, binds,
+    events) plus the workload submitter all speaking HTTP to it — the
+    reference harness's shape (util.go:79-108 schedules via a real
+    apiserver), quantifying what LocalClient bypasses."""
     from ..utils.gctune import tune_for_throughput
     tune_for_throughput()  # CPython gen-2 pauses cost ~35% at bench scale
-    store = store or kv.MemoryStore(history=1_000_000)
-    client = LocalClient(store)
+    server = tmpdir = None
+    if via_http:
+        if store is not None:
+            raise ValueError("via_http builds its own WAL-backed store; "
+                             "a caller-provided store would be ignored")
+        import secrets as pysecrets
+        import tempfile
+
+        from ..apiserver import APIServer
+        from ..client.http_client import HTTPClient
+        tmpdir = tempfile.TemporaryDirectory(prefix="bench-wal-")
+        store = kv.MemoryStore(history=1_000_000,
+                               durable_dir=tmpdir.name)
+        token = pysecrets.token_urlsafe(16)
+        server = APIServer(store, token=token, enable_rbac=True,
+                           enable_default_admission=True).start()
+        client = HTTPClient.from_url(server.url, token=token)
+    else:
+        store = store or kv.MemoryStore(history=1_000_000)
+        client = LocalClient(store)
     factory = SharedInformerFactory(client)
     if tpu:
         from ..ops.backend import TPUBatchBackend
@@ -184,7 +215,8 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
     factory.start()
     factory.wait_for_cache_sync()
     sched.run()
-    return PerfCluster(store, client, factory, sched)
+    return PerfCluster(store, client, factory, sched, server=server,
+                       _tmpdir=tmpdir)
 
 
 # -- workload ops (scheduler_perf_test.go opcodes) -------------------------
@@ -262,6 +294,16 @@ def _bulk_create(client, resource: str, count: int, offset: int,
         for lo in range(0, count, chunk):
             creator(resource, [build(offset + i, op)
                                for i in range(lo, min(lo + chunk, count))])
+    elif creator is None and count >= 64:
+        # remote client (HTTP): fan the submission over a few
+        # connections — the reference harness pumps through a
+        # concurrent rate-limited client the same way (util.go:92);
+        # HTTPClient keeps one keep-alive connection per thread
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            list(pool.map(
+                lambda i: client.create(resource, build(offset + i, op)),
+                range(count)))
     else:
         for i in range(count):
             client.create(resource, build(offset + i, op))
@@ -370,12 +412,14 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
 
 def run_named_workload(config: dict, tpu: bool = False, caps=None,
                        batch_size: int = 512, pipeline_depth: int = 1,
-                       admission_interval: float = 0.0
+                       admission_interval: float = 0.0,
+                       via_http: bool = False
                        ) -> tuple[ThroughputSummary, dict]:
     """Run one workload config end to end; returns (throughput, stats)."""
     cluster = setup_cluster(tpu=tpu, caps=caps, batch_size=batch_size,
                             pipeline_depth=pipeline_depth,
-                            admission_interval=admission_interval)
+                            admission_interval=admission_interval,
+                            via_http=via_http)
     collector = ThroughputCollector(cluster.store)
     try:
         ops = config["workloadTemplate"]

@@ -292,6 +292,15 @@ class Framework:
         self.bind: list[BindPlugin] = []
         self.post_bind: list[PostBindPlugin] = []
         self.all_plugins: list[Plugin] = list(plugins)
+        # host-side gates the BATCH path must honor: the device kernel
+        # covers resource/affinity semantics but not group-membership
+        # gates like Coscheduling's minMember PreFilter — without this,
+        # an incomplete gang cycles assume -> Permit-wait -> timeout ->
+        # Unreserve forever, starving competitors between cycles
+        self.batch_gates: list[Plugin] = [
+            p for p in plugins
+            if getattr(p, "supports_batch_gate", False)
+            and allow(p.name, "preFilter")]  # the gate IS the PreFilter
         for p in plugins:
             if isinstance(p, QueueSortPlugin) and allow(p.name, "queueSort"):
                 self.queue_sort = p

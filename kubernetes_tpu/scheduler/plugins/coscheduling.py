@@ -35,10 +35,34 @@ def pod_group_name(pod_info: PodInfo) -> str | None:
 
 class Coscheduling(PreFilterPlugin, PermitPlugin, PostBindPlugin):
     name = "Coscheduling"
+    # the device batch path must run this plugin's membership gate on
+    # the host before encoding (scheduler._dispatch_batch): an
+    # incomplete gang that reaches Permit live-locks through
+    # assume/wait/timeout/Unreserve cycles, starving competitors
+    supports_batch_gate = True
 
     def __init__(self, client=None, handle=None):
         self.client = client
         self.handle = handle
+
+    def batch_gate(self, pod_info: PodInfo, cache: dict | None = None):
+        """Cheap host gate for the batch path: ~one dict lookup for
+        non-gang pods; the PreFilter membership check ONCE PER GROUP
+        per batch (`cache` is the dispatcher's per-batch memo — the
+        membership scan is O(total pods) and identical for every
+        member of a group in the same batch)."""
+        group = pod_group_name(pod_info)
+        if group is None:
+            return None
+        key = (self.name, meta.namespace(pod_info.pod), group)
+        if cache is not None and key in cache:
+            return cache[key]
+        _result, status = self.pre_filter(CycleState(), pod_info, None)
+        if status is not None and status.is_skip():
+            status = None
+        if cache is not None:
+            cache[key] = status
+        return status
 
     def events_to_register(self):
         return [ClusterEvent("Pod", "Add"), ClusterEvent("AssignedPod", "Add"),

@@ -858,6 +858,28 @@ class Scheduler:
         cycle = self.queue.scheduling_cycle()
         start = time.monotonic()
         live = [q for q in batch if not self._skip_schedule(q.pod)]
+        gates = profile.framework.batch_gates
+        if gates and live:
+            # host-side plugin gates (Coscheduling minMember): a pod a
+            # gate rejects must never reach the device batch — it would
+            # assume capacity it can only hold until a Permit timeout
+            passed = []
+            gate_cache: dict = {}  # per-batch memo (per-group checks)
+            for q in live:
+                failed = None
+                for gate in gates:
+                    s = gate.batch_gate(q.pod_info, gate_cache)
+                    if s is not None and not s.is_success():
+                        failed = s
+                        break
+                if failed is None:
+                    passed.append(q)
+                else:
+                    self._handle_failure(
+                        profile.framework, q, failed, cycle,
+                        {failed.plugin} if failed.plugin else set(),
+                        start)
+            live = passed
         if self.extenders:
             # extender webhooks are per-pod HTTP calls: route interested
             # pods through the oracle path (deferred to a quiescent moment)

@@ -12,9 +12,6 @@ All on the 8-virtual-device CPU mesh from conftest:
     workload
 """
 
-import numpy as np
-import pytest
-
 from kubernetes_tpu.ops.backend import FLUSH_FIRST, TPUBatchBackend
 from kubernetes_tpu.ops.flatten import Caps
 from kubernetes_tpu.parallel.backend import ShardedTPUBatchBackend
