@@ -178,6 +178,133 @@ class RBACAuthorizer:
         return False
 
 
+NODE_USER_PREFIX = "system:node:"
+NODES_GROUP = "system:nodes"
+
+# what a kubelet may read broadly (informers watch cluster-wide; field-
+# selector-scoped watches are a non-goal here)
+_NODE_READABLE = frozenset({
+    "pods", "nodes", "services", "endpointslices", "configmaps",
+    "persistentvolumeclaims", "persistentvolumes", "leases", "podgroups",
+})
+
+
+class NodeAuthorizer:
+    """Scope a kubelet credential to ITS OWN node's objects.
+
+    Reference: plugin/pkg/auth/authorizer/node/ — the node authorizer
+    walks a graph from the node to the objects its pods reference, and
+    the NodeRestriction admission plugin pins writes to the node's own
+    identity.  Reduced here to the load-bearing rules:
+
+      - writes to nodes/leases only for the node's OWN name
+      - pod writes (status reports) only for pods BOUND to this node
+      - secret gets only when a pod on this node references the secret
+        (volumes or env); secret list/watch denied
+      - broad reads for the informer-watched resources
+      - event creation allowed (kubelets report)
+
+    Handles ONLY system:node:* users in system:nodes; everything else
+    falls through (False) to the next authorizer in the union."""
+
+    def __init__(self, store: kv.MemoryStore):
+        self._store = store
+
+    def _pod_on_node(self, namespace: str, name: str, node: str) -> bool:
+        try:
+            pod = self._store.get("pods", namespace, name)
+        except kv.NotFoundError:
+            return False
+        return (pod.get("spec") or {}).get("nodeName") == node
+
+    def _secret_referenced(self, namespace: str, name: str,
+                           node: str) -> bool:
+        """graph.go lite: is `name` referenced by any pod on `node`?"""
+        try:
+            pods, _ = self._store.list("pods", namespace)
+        except kv.StoreError:
+            return False
+        for pod in pods:
+            spec = pod.get("spec") or {}
+            if spec.get("nodeName") != node:
+                continue
+            for ref in spec.get("imagePullSecrets") or ():
+                if ref.get("name") == name:
+                    return True
+            for vol in spec.get("volumes") or ():
+                if ((vol.get("secret") or {}).get("secretName")) == name:
+                    return True
+                for src in ((vol.get("projected") or {})
+                            .get("sources")) or ():
+                    if ((src.get("secret") or {}).get("name")) == name:
+                        return True
+            containers = list(spec.get("containers") or ())
+            containers += list(spec.get("initContainers") or ())
+            for c in containers:
+                for env in c.get("env") or ():
+                    ref = ((env.get("valueFrom") or {})
+                           .get("secretKeyRef") or {})
+                    if ref.get("name") == name:
+                        return True
+                for src in c.get("envFrom") or ():
+                    if ((src.get("secretRef") or {}).get("name")) == name:
+                        return True
+        return False
+
+    def authorize(self, attrs: Attributes) -> bool:
+        if not attrs.user.startswith(NODE_USER_PREFIX) \
+                or NODES_GROUP not in attrs.groups:
+            return False
+        node = attrs.user[len(NODE_USER_PREFIX):]
+        verb, res = attrs.verb, attrs.resource
+        if verb in ("get", "list", "watch"):
+            if res in _NODE_READABLE:
+                return True
+            if res == "secrets" and verb == "get":
+                return self._secret_referenced(attrs.namespace,
+                                               attrs.name, node)
+            return False
+        if res == "events":
+            return verb == "create"
+        if res == "nodes":
+            # update/patch/delete pinned to own name; create has no
+            # name at authz time (NodeRestriction admission would pin
+            # it) — allow, registration is the join flow
+            return verb == "create" or attrs.name == node
+        if res == "leases":
+            # node heartbeat leases live ONLY in kube-node-lease
+            # (upstream pins the namespace the same way) — a kubelet
+            # cert must not forge identity leases elsewhere
+            if attrs.namespace != "kube-node-lease":
+                return False
+            return verb == "create" or attrs.name == node
+        if res == "pods":
+            if verb in ("update", "patch"):
+                return self._pod_on_node(attrs.namespace, attrs.name,
+                                         node)
+            return False
+        if res == "certificatesigningrequests":
+            return verb == "create"
+        return False
+
+
+class CompositeAuthorizer:
+    """Union of authorization modes (--authorization-mode=Node,RBAC):
+    any module granting wins; all abstaining/denying denies."""
+
+    def __init__(self, authorizers: list):
+        self.authorizers = authorizers
+
+    def authorize(self, attrs: Attributes) -> bool:
+        return any(a.authorize(attrs) for a in self.authorizers)
+
+    def stop(self) -> None:
+        for a in self.authorizers:
+            stop = getattr(a, "stop", None)
+            if stop is not None:
+                stop()
+
+
 # -- bootstrap policy ----------------------------------------------------
 
 def _role(name: str, rules: list[dict]) -> dict:
@@ -294,13 +421,12 @@ def bootstrap_policy(store: kv.MemoryStore) -> None:
                  "system:kube-controller-manager",
                  [_user("system:kube-controller-manager")]),
         _binding("system:node", "system:node",
-                 [_group("system:nodes"),
-                  # a TLS cluster (kubeadm init) authenticates joined
-                  # kubelets by their issued client cert (system:nodes
-                  # group via the O field); plain-HTTP clusters have no
-                  # cert authn, so the bootstrap-token identity keeps
-                  # node rights there
-                  _group("system:bootstrappers")]),
+                 # cert-authenticated kubelets (system:nodes via the
+                 # cert's O field) are scoped by the NodeAuthorizer,
+                 # not this broad role; plain-HTTP clusters have no
+                 # cert authn, so the bootstrap-token identity keeps
+                 # node rights here
+                 [_group("system:bootstrappers")]),
         _binding("system:node-bootstrapper", "system:node-bootstrapper",
                  [_group("system:bootstrappers")]),
         _binding("system:basic-user", "system:basic-user",

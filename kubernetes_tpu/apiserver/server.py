@@ -159,8 +159,11 @@ class APIServer:
         if token is not None:
             self.tokens.setdefault(
                 token, ("system:admin", (rbaclib.SUPERUSER_GROUP,)))
-        self.authorizer = rbaclib.RBACAuthorizer(store) if enable_rbac \
-            else None
+        # --authorization-mode=Node,RBAC: the node authorizer scopes
+        # kubelet certs to their own node's objects; RBAC covers the rest
+        self.authorizer = rbaclib.CompositeAuthorizer(
+            [rbaclib.NodeAuthorizer(store),
+             rbaclib.RBACAuthorizer(store)]) if enable_rbac else None
         # bootstrap token authenticator (plugin/pkg/auth/authenticator/
         # token/bootstrap): live lookup of kube-system bootstrap Secrets,
         # so `kubeadm join --token` credentials work without restarting

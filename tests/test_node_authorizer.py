@@ -1,0 +1,168 @@
+"""Node authorizer: a kubelet certificate is scoped to ITS node.
+
+Reference: plugin/pkg/auth/authorizer/node/ (the graph-based node
+authorizer) + the NodeRestriction write pinning, running as the Node
+half of --authorization-mode=Node,RBAC.  Everything here crosses the
+real TLS wire with real client certs.
+"""
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.apiserver import authn as authnlib
+from kubernetes_tpu.client.http_client import HTTPClient, HTTPError
+from kubernetes_tpu.controllers.certificates import ClusterCA
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    d = tmp_path_factory.mktemp("node-pki")
+    ca = ClusterCA()
+    tls = authnlib.write_serving_bundle(ca, str(d))
+    store = kv.MemoryStore()
+    server = APIServer(store, tls=tls, enable_rbac=True).start()
+
+    def client_for(cn, orgs=()):
+        cert_pem, key_pem = authnlib.issue_cert(ca, cn, tuple(orgs))
+        slug = cn.replace(":", "_")
+        (d / f"{slug}.crt").write_text(cert_pem)
+        (d / f"{slug}.key").write_text(key_pem)
+        return HTTPClient(server.httpd.server_address[0], server.port,
+                          tls={"ca_file": tls["client_ca_file"],
+                               "cert_file": str(d / f"{slug}.crt"),
+                               "key_file": str(d / f"{slug}.key")})
+
+    admin = client_for("kubernetes-admin", ["system:masters"])
+    kubelet_a = client_for("system:node:node-a", ["system:nodes"])
+    kubelet_b = client_for("system:node:node-b", ["system:nodes"])
+    for n in ("node-a", "node-b"):
+        admin.create("nodes", make_node(n).build())
+    yield admin, kubelet_a, kubelet_b, store
+    server.stop()
+
+
+class TestNodeScoping:
+    def test_own_node_writes_allowed(self, cluster):
+        admin, ka, kb, store = cluster
+        ka.guaranteed_update(
+            "nodes", "", "node-a",
+            lambda n: {**n, "status": {**(n.get("status") or {}),
+                                       "lastHeartbeatTime": 1.0}})
+
+    def test_other_node_writes_denied(self, cluster):
+        admin, ka, kb, store = cluster
+        with pytest.raises(HTTPError) as exc:
+            ka.guaranteed_update(
+                "nodes", "", "node-b",
+                lambda n: {**n, "status": {"hacked": True}})
+        assert exc.value.code == 403
+
+    def test_lease_scoping(self, cluster):
+        admin, ka, kb, store = cluster
+        for owner, node in ((ka, "node-a"), (kb, "node-b")):
+            lease = meta.new_object("Lease", node, "kube-node-lease")
+            lease["spec"] = {"holderIdentity": node}
+            owner.create("leases", lease)
+        ka.guaranteed_update(
+            "leases", "kube-node-lease", "node-a",
+            lambda l: {**l, "spec": {**l["spec"], "renewTime": 2.0}})
+        with pytest.raises(HTTPError) as exc:
+            ka.guaranteed_update(
+                "leases", "kube-node-lease", "node-b",
+                lambda l: {**l, "spec": {**l["spec"], "renewTime": 2.0}})
+        assert exc.value.code == 403
+
+    def test_pod_status_only_for_bound_pods(self, cluster):
+        admin, ka, kb, store = cluster
+        for name, node in (("pa", "node-a"), ("pb", "node-b")):
+            pod = make_pod(name).node(node).build()
+            admin.create("pods", pod)
+        ka.guaranteed_update(
+            "pods", "default", "pa",
+            lambda p: {**p, "status": {"phase": "Running"}})
+        with pytest.raises(HTTPError) as exc:
+            ka.guaranteed_update(
+                "pods", "default", "pb",
+                lambda p: {**p, "status": {"phase": "Failed"}})
+        assert exc.value.code == 403
+
+    def test_pod_create_denied(self, cluster):
+        admin, ka, kb, store = cluster
+        with pytest.raises(HTTPError) as exc:
+            ka.create("pods", make_pod("rogue").build())
+        assert exc.value.code == 403
+
+    def test_reads_allowed(self, cluster):
+        admin, ka, kb, store = cluster
+        ka.list("pods", "default")
+        ka.list("nodes")
+        ka.get("nodes", "", "node-b")  # reads are not name-scoped
+
+
+class TestSecretGraph:
+    def test_secret_gated_on_pod_reference(self, cluster):
+        admin, ka, kb, store = cluster
+        for name in ("app-secret", "unrelated-secret"):
+            sec = meta.new_object("Secret", name, "default")
+            sec["data"] = {"k": "djNsdWU="}
+            admin.create("secrets", sec)
+        pod = make_pod("secret-user").node("node-a").build()
+        pod["spec"]["volumes"] = [{"name": "v", "secret":
+                                   {"secretName": "app-secret"}}]
+        admin.create("pods", pod)
+        assert ka.get("secrets", "default", "app-secret")
+        with pytest.raises(HTTPError) as exc:
+            ka.get("secrets", "default", "unrelated-secret")
+        assert exc.value.code == 403
+        # the pod is on node-a, so node-b's kubelet gets nothing
+        with pytest.raises(HTTPError) as exc:
+            kb.get("secrets", "default", "app-secret")
+        assert exc.value.code == 403
+        # and list/watch of secrets is never granted to kubelets
+        with pytest.raises(HTTPError) as exc:
+            ka.list("secrets", "default")
+        assert exc.value.code == 403
+
+    def test_lease_outside_node_lease_ns_denied(self, cluster):
+        admin, ka, kb, store = cluster
+        lease = meta.new_object("Lease", "apiserver-x", "kube-system")
+        lease["spec"] = {"holderIdentity": "forged"}
+        with pytest.raises(HTTPError) as exc:
+            ka.create("leases", lease)
+        assert exc.value.code == 403
+        # even a name collision with the node name stays out of reach
+        lease2 = meta.new_object("Lease", "node-a", "kube-system")
+        lease2["spec"] = {"holderIdentity": "forged"}
+        with pytest.raises(HTTPError) as exc:
+            ka.create("leases", lease2)
+        assert exc.value.code == 403
+
+    def test_envfrom_and_pull_secrets_count(self, cluster):
+        admin, ka, kb, store = cluster
+        for name in ("envfrom-secret", "pull-secret"):
+            sec = meta.new_object("Secret", name, "default")
+            sec["data"] = {"k": "eA=="}
+            admin.create("secrets", sec)
+        pod = make_pod("wide-ref").node("node-a").build()
+        pod["spec"]["imagePullSecrets"] = [{"name": "pull-secret"}]
+        pod["spec"]["containers"][0]["envFrom"] = [
+            {"secretRef": {"name": "envfrom-secret"}}]
+        admin.create("pods", pod)
+        assert ka.get("secrets", "default", "envfrom-secret")
+        assert ka.get("secrets", "default", "pull-secret")
+
+    def test_env_ref_also_counts(self, cluster):
+        admin, ka, kb, store = cluster
+        sec = meta.new_object("Secret", "env-secret", "default")
+        sec["data"] = {"k": "eA=="}
+        admin.create("secrets", sec)
+        pod = make_pod("env-user").node("node-a").build()
+        pod["spec"]["containers"][0]["env"] = [
+            {"name": "TOKEN", "valueFrom": {"secretKeyRef":
+                                            {"name": "env-secret",
+                                             "key": "k"}}}]
+        admin.create("pods", pod)
+        assert ka.get("secrets", "default", "env-secret")
