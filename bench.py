@@ -193,10 +193,30 @@ def child_main() -> None:
     emit(res["value"], {"wall_s": res["wall_s"], **res["detail"]})
 
 
+def _device_reachable(timeout: float = 180.0) -> bool:
+    """Probe the device in a subprocess BEFORE spending child timeouts.
+
+    A dead chip tunnel blocks jax.devices() forever (observed: a full
+    day of make_c_api_client hangs); without this probe every bench
+    child would burn its entire barrier timeout twice before failing."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     if os.environ.get("_BENCH_CHILD") == "1":
         child_main()
         return
+    if not _device_reachable():
+        emit(0.0, {"error": "device unreachable: jax.devices() did not "
+                            "return within 180s (chip tunnel down?)"})
+        sys.exit(1)
     n_runs = max(1, int(os.environ.get("BENCH_RUNS", "3")))
     if n_runs == 1:
         res = run_once("SchedulingBasicLarge", N_NODES, N_PODS, BATCH,

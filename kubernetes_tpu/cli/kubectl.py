@@ -864,6 +864,109 @@ class Kubectl:
                 self.out.writelines(delta)
         return rc
 
+    def edit(self, resource: str, name: str, namespace: str,
+             editor: str | None = None) -> int:
+        """kubectl edit (kubectl/pkg/cmd/edit): dump the live object to
+        a temp YAML file, run $EDITOR on it, PUT the result back.  The
+        live resourceVersion rides along so a concurrent change
+        surfaces as a 409 instead of a silent overwrite."""
+        import os
+        import subprocess
+        import tempfile
+        resource = self.resolve(resource)
+        try:
+            obj = self.client.get(resource, namespace, name)
+        except kv.NotFoundError:
+            try:
+                obj = self.client.get(resource, "", name)
+            except kv.NotFoundError as e:
+                self.out.write(f"Error: {e}\n")
+                return 1
+        editor = editor or os.environ.get("EDITOR") or "vi"
+        with tempfile.NamedTemporaryFile(
+                "w+", suffix=".yaml", prefix=f"kubectl-edit-{name}-",
+                delete=False) as f:
+            yaml.safe_dump(obj, f, sort_keys=False)
+            path = f.name
+        try:
+            proc = subprocess.run([*editor.split(), path])
+            if proc.returncode != 0:
+                self.out.write("Edit cancelled (editor exited "
+                               f"{proc.returncode})\n")
+                return 1
+            try:
+                with open(path) as f:
+                    edited = yaml.safe_load(f)
+            except yaml.YAMLError as e:
+                self.out.write(f"Error: edited file is not valid YAML: "
+                               f"{e}\n")
+                return 1
+        finally:
+            os.unlink(path)
+        if edited is None:
+            # an emptied buffer is the standard "abort the edit" gesture
+            self.out.write("Edit cancelled (empty file)\n")
+            return 0
+        if edited == obj:
+            self.out.write(f"{resource}/{name} unchanged\n")
+            return 0
+        try:
+            self.client.update(resource, edited)
+        except kv.ConflictError as e:
+            self.out.write(f"Error: {e}\nhint: the object changed while "
+                           "you edited; re-run kubectl edit\n")
+            return 1
+        except kv.StoreError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        self.out.write(f"{resource}/{name} edited\n")
+        return 0
+
+    def debug(self, name: str, namespace: str, image: str,
+              copy_to: str | None = None,
+              command: list[str] | None = None) -> int:
+        """kubectl debug (kubectl/pkg/cmd/debug): pod-copy mode — clone
+        the target pod, add a debug container, strip probes so the copy
+        stays alive for inspection."""
+        try:
+            pod = self.client.get(PODS, namespace, name)
+        except kv.NotFoundError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        copy_name = copy_to or f"{name}-debug"
+        dbg = meta.deep_copy(pod)
+        # the copy deliberately carries NO workload labels: the source's
+        # selector labels would get it adopted by its ReplicaSet (which
+        # then kills a surplus replica) and routed to by Services whose
+        # probes were just stripped — real kubectl omits them the same way
+        dbg["metadata"] = {
+            "name": copy_name, "namespace": namespace,
+            "labels": {"debug.kubernetes.io/source": name}}
+        dbg.pop("status", None)
+        spec = dbg.setdefault("spec", {})
+        spec.pop("nodeName", None)  # reschedule the copy
+        taken = set()
+        for c in spec.get("containers") or ():
+            c.pop("livenessProbe", None)
+            c.pop("readinessProbe", None)
+            taken.add(c.get("name"))
+        dbg_name = "debugger"
+        n = 1
+        while dbg_name in taken:
+            dbg_name = f"debugger-{n}"
+            n += 1
+        spec.setdefault("containers", []).append({
+            "name": dbg_name, "image": image,
+            "command": command or ["sh"], "stdin": True, "tty": True})
+        try:
+            self.client.create(PODS, dbg)
+        except kv.AlreadyExistsError:
+            self.out.write(f"Error: pod {copy_name!r} already exists\n")
+            return 1
+        self.out.write(f"pod/{copy_name} created (debug copy of {name} "
+                       f"with container 'debugger')\n")
+        return 0
+
     def taint(self, node: str, spec: str) -> int:
         """kubectl taint nodes <node> key[=value]:Effect | key-"""
         if spec.endswith("-"):
@@ -1043,6 +1146,13 @@ def build_parser() -> argparse.ArgumentParser:
     au.add_argument("resource")
     df = sub.add_parser("diff")
     df.add_argument("-f", "--filename", required=True)
+    ed = sub.add_parser("edit")
+    ed.add_argument("resource")
+    ed.add_argument("name")
+    db = sub.add_parser("debug")
+    db.add_argument("name")
+    db.add_argument("--image", default="busybox")
+    db.add_argument("--copy-to", dest="copy_to", default=None)
     tn = sub.add_parser("taint")
     tn.add_argument("resource", choices=["nodes", "node"])
     tn.add_argument("node")
@@ -1123,6 +1233,11 @@ def run(argv: list[str] | None = None, client: Client | None = None,
         return k.auth_can_i(args.verb, args.resource, args.namespace)
     if args.cmd == "diff":
         return k.diff(args.filename, args.namespace)
+    if args.cmd == "edit":
+        return k.edit(args.resource, args.name, args.namespace)
+    if args.cmd == "debug":
+        return k.debug(args.name, args.namespace, args.image,
+                       copy_to=args.copy_to, command=tail or None)
     if args.cmd == "taint":
         return k.taint(args.node, args.spec)
     if args.cmd == "version":

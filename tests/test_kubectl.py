@@ -245,3 +245,67 @@ class TestKubectlBreadth:
         rc, out = kubectl(client, "wait", "pod", "waity",
                           "--for", "delete", "--timeout", "5")
         assert rc == 0
+
+
+class TestEditDebug:
+    def test_edit_applies_changes(self, cluster, tmp_path):
+        """EDITOR is a script that rewrites a label; the PUT must land."""
+        client = cluster
+        cm = meta.new_object("ConfigMap", "editable", "default")
+        cm["data"] = {"k": "v"}
+        client.create("configmaps", cm)
+        editor = tmp_path / "ed.sh"
+        editor.write_text("#!/bin/sh\n"
+                          "python3 - \"$1\" <<'PY'\n"
+                          "import sys, yaml\n"
+                          "doc = yaml.safe_load(open(sys.argv[1]))\n"
+                          "doc['metadata'].setdefault('labels', {})"
+                          "['edited'] = 'yes'\n"
+                          "yaml.safe_dump(doc, open(sys.argv[1], 'w'))\n"
+                          "PY\n")
+        editor.chmod(0o755)
+        import io
+
+        from kubernetes_tpu.cli.kubectl import Kubectl
+        out = io.StringIO()
+        k = Kubectl(client, out)
+        assert k.edit("cm", "editable", "default",
+                      editor=str(editor)) == 0, out.getvalue()
+        assert "edited" in out.getvalue()
+        assert meta.labels(client.get("configmaps", "default",
+                                      "editable"))["edited"] == "yes"
+
+    def test_edit_no_change_is_noop(self, cluster, tmp_path):
+        client = cluster
+        cm = meta.new_object("ConfigMap", "steady", "default")
+        client.create("configmaps", cm)
+        rv_before = meta.resource_version(
+            client.get("configmaps", "default", "steady"))
+        import io
+
+        from kubernetes_tpu.cli.kubectl import Kubectl
+        out = io.StringIO()
+        k = Kubectl(client, out)
+        assert k.edit("cm", "steady", "default", editor="true") == 0
+        assert "unchanged" in out.getvalue()
+        assert meta.resource_version(
+            client.get("configmaps", "default", "steady")) == rv_before
+
+    def test_debug_creates_pod_copy(self, cluster):
+        client = cluster
+        client.create(NODES, make_node("dbg-node").build())
+        client.create(PODS, make_pod("prod-pod").req(cpu="100m").build())
+        rc, out = kubectl(client, "debug", "prod-pod",
+                          "--image", "tools:v1")
+        assert rc == 0, out
+        copy = client.get(PODS, "default", "prod-pod-debug")
+        names = [c["name"] for c in copy["spec"]["containers"]]
+        assert "debugger" in names
+        dbg = next(c for c in copy["spec"]["containers"]
+                   if c["name"] == "debugger")
+        assert dbg["image"] == "tools:v1"
+        assert meta.labels(copy)["debug.kubernetes.io/source"] == \
+            "prod-pod"
+        # the copy reschedules on its own
+        assert wait_for(lambda: meta.pod_node_name(
+            client.get(PODS, "default", "prod-pod-debug")))
