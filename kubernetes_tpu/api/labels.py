@@ -78,6 +78,63 @@ EVERYTHING = Selector()
 NOTHING = Selector(match_nothing=True)
 
 
+def parse_selector(text: str) -> Selector:
+    """String selector -> Selector (labels.Parse subset): comma-joined
+    requirements of the forms `k=v`/`k==v`, `k!=v`, `k`, `!k`,
+    `k in (a,b)`, `k notin (a,b)`, `k > n`, `k < n`.  This keeps the
+    CLI's -l flag on the same Requirement semantics as everything else
+    (NotIn matches absent keys, etc.)."""
+    import re
+
+    set_re = re.compile(
+        r"^\s*(?P<key>[^\s!=<>,()]+)\s+(?P<op>in|notin)\s*"
+        r"\(\s*(?P<vals>[^()]*)\)\s*$")
+    reqs: list[Requirement] = []
+    # split on commas NOT inside parentheses (set expressions)
+    parts, depth, cur = [], 0, []
+    for ch in text:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        m = set_re.match(part)
+        if m:
+            values = tuple(v.strip() for v in m.group("vals").split(",")
+                           if v.strip())
+            reqs.append(Requirement(
+                m.group("key"), IN if m.group("op") == "in" else NOT_IN,
+                values))
+            continue
+        if "!=" in part:
+            key, _, value = part.partition("!=")
+            reqs.append(Requirement(key.strip(), NOT_IN,
+                                    (value.strip(),)))
+        elif "==" in part or "=" in part:
+            key, _, value = part.partition("==" if "==" in part else "=")
+            reqs.append(Requirement(key.strip(), IN, (value.strip(),)))
+        elif ">" in part:
+            key, _, value = part.partition(">")
+            reqs.append(Requirement(key.strip(), GT, (value.strip(),)))
+        elif "<" in part:
+            key, _, value = part.partition("<")
+            reqs.append(Requirement(key.strip(), LT, (value.strip(),)))
+        elif part.startswith("!"):
+            reqs.append(Requirement(part[1:].strip(), DOES_NOT_EXIST))
+        else:
+            reqs.append(Requirement(part, EXISTS))
+    return Selector(tuple(reqs))
+
+
 def selector_from_dict(spec: dict | None) -> Selector:
     """Compile a metav1.LabelSelector JSON dict into a Selector.
 

@@ -153,8 +153,15 @@ class Kubectl:
     # -- get / describe --------------------------------------------------
 
     def get(self, resource: str, name: str | None, namespace: str,
-            output: str | None) -> int:
+            output: str | None, selector: str | None = None,
+            all_namespaces: bool = False) -> int:
         resource = self.resolve(resource)
+        if name and (selector or all_namespaces):
+            # matches kubectl: name + -l/-A is a usage error, not a
+            # silently-dropped flag
+            self.out.write("Error: a resource cannot be retrieved by "
+                           "name together with -l/-A\n")
+            return 1
         if name:
             try:
                 items = [self.client.get(resource, namespace, name)]
@@ -165,9 +172,15 @@ class Kubectl:
                     self.out.write(f"Error: {e}\n")
                     return 1
         else:
-            ns = None if resource == "nodes" else namespace
+            ns = (None if resource == "nodes" or all_namespaces
+                  else namespace)
             items, _ = self.client.list(resource, ns)
-            items.sort(key=meta.name)
+            items.sort(key=lambda o: (meta.namespace(o) or "",
+                                      meta.name(o)))
+        if selector:
+            from ..api.labels import parse_selector
+            compiled = parse_selector(selector)
+            items = [o for o in items if compiled.matches(meta.labels(o))]
         if output == "json":
             self.out.write(json.dumps(items if not name else items[0],
                                       indent=2, default=str) + "\n")
@@ -180,7 +193,12 @@ class Kubectl:
             resource, (["NAME", "STATUS", "AGE"], ["NAME", "STATUS", "AGE"],
                        generic_row))
         headers = wide_h if wide else narrow_h
-        print_table([rowfn(o, wide) for o in items], headers, self.out)
+        rows = [rowfn(o, wide) for o in items]
+        if all_namespaces:
+            headers = ["NAMESPACE"] + headers
+            rows = [[meta.namespace(o) or ""] + r
+                    for o, r in zip(items, rows)]
+        print_table(rows, headers, self.out)
         return 0
 
     def describe(self, resource: str, name: str, namespace: str) -> int:
@@ -1082,6 +1100,9 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("resource")
     g.add_argument("name", nargs="?")
     g.add_argument("-o", "--output", choices=["json", "yaml", "wide"])
+    g.add_argument("-l", "--selector", default=None)
+    g.add_argument("-A", "--all-namespaces", action="store_true",
+                   dest="all_namespaces")
     d = sub.add_parser("describe")
     d.add_argument("resource")
     d.add_argument("name")
@@ -1180,7 +1201,9 @@ def run(argv: list[str] | None = None, client: Client | None = None,
             client = HTTPClient.from_url(args.server, args.token)
     k = Kubectl(client, out)
     if args.cmd == "get":
-        return k.get(args.resource, args.name, args.namespace, args.output)
+        return k.get(args.resource, args.name, args.namespace, args.output,
+                     selector=args.selector,
+                     all_namespaces=args.all_namespaces)
     if args.cmd == "describe":
         return k.describe(args.resource, args.name, args.namespace)
     if args.cmd == "create":

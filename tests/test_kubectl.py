@@ -309,3 +309,64 @@ class TestEditDebug:
         # the copy reschedules on its own
         assert wait_for(lambda: meta.pod_node_name(
             client.get(PODS, "default", "prod-pod-debug")))
+
+
+class TestGetSelectors:
+    def test_label_selector(self, cluster):
+        client = cluster
+        client.create(PODS, make_pod("web-1").labels(app="web").build())
+        client.create(PODS, make_pod("web-2").labels(app="web",
+                                                     tier="x").build())
+        client.create(PODS, make_pod("db-1").labels(app="db").build())
+        rc, out = kubectl(client, "get", "pods", "-l", "app=web")
+        assert rc == 0
+        assert "web-1" in out and "web-2" in out and "db-1" not in out
+        rc, out = kubectl(client, "get", "pods", "-l", "app=web,tier")
+        assert "web-2" in out and "web-1" not in out
+        rc, out = kubectl(client, "get", "pods", "-l", "app!=web")
+        assert "db-1" in out and "web-1" not in out
+
+    def test_all_namespaces(self, cluster):
+        client = cluster
+        ns = meta.new_object("Namespace", "other", None)
+        client.create("namespaces", ns)
+        client.create(PODS, make_pod("here").build())
+        client.create(PODS, make_pod("there", namespace="other").build())
+        rc, out = kubectl(client, "get", "pods", "-A")
+        assert rc == 0
+        assert "here" in out and "there" in out
+        rc, out = kubectl(client, "get", "pods")
+        assert "here" in out and "there" not in out
+
+
+class TestSelectorParsing:
+    def test_set_expressions_and_guards(self, cluster):
+        client = cluster
+        client.create(PODS, make_pod("in-a").labels(env="a").build())
+        client.create(PODS, make_pod("in-b").labels(env="b").build())
+        client.create(PODS, make_pod("in-c").labels(env="c").build())
+        rc, out = kubectl(client, "get", "pods", "-l", "env in (a, b)")
+        assert rc == 0
+        assert "in-a" in out and "in-b" in out and "in-c" not in out
+        rc, out = kubectl(client, "get", "pods", "-l", "env notin (a)")
+        assert "in-a" not in out and "in-b" in out
+        # name + -l is a usage error, not a silent filter
+        rc, out = kubectl(client, "get", "pods", "in-a", "-l", "env=a")
+        assert rc == 1 and "cannot" in out
+        rc, out = kubectl(client, "get", "pods", "in-a", "-A")
+        assert rc == 1
+
+    def test_all_namespaces_column(self, cluster):
+        client = cluster
+        ns = meta.new_object("Namespace", "col-ns", None)
+        client.create("namespaces", ns)
+        client.create(PODS, make_pod("same-name").build())
+        client.create(PODS, make_pod("same-name",
+                                     namespace="col-ns").build())
+        rc, out = kubectl(client, "get", "pods", "-A")
+        assert rc == 0
+        assert "NAMESPACE" in out.splitlines()[0]
+        rows = [ln for ln in out.splitlines() if "same-name" in ln]
+        assert len(rows) == 2
+        assert any(ln.startswith("col-ns") for ln in rows)
+        assert any(ln.startswith("default") for ln in rows)
