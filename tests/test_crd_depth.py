@@ -381,3 +381,41 @@ class TestCELUnit:
         assert cel.evaluate("0 - 7 / 2 == 0 - 3", {})
         assert cel.evaluate("(0 - 7) % 2 == 0 - 1", {})
         assert cel.evaluate("7 / 2 == 3 && 7 % 2 == 1", {})
+
+
+class TestWatchConversion:
+    def test_watch_serves_requested_version(self, server):
+        """A watch at the non-storage version must deliver events whose
+        objects are converted on the way out (conversion applies to the
+        whole read surface, watches included)."""
+        import time
+
+        from kubernetes_tpu.client.http_client import HTTPWatch
+        srv, http = server
+        schema = {"type": "object", "properties": {
+            "spec": {"type": "object", "properties": {
+                "n": {"type": "integer"}}}}}
+        make_crd(http, "streams.wc.io", "wc.io", "streamers", "Streamer",
+                 [{"name": "v1beta1", "served": True, "storage": True,
+                   "schema": {"openAPIV3Schema": schema}},
+                  {"name": "v1", "served": True, "storage": False,
+                   "schema": {"openAPIV3Schema": schema}}])
+        w = HTTPWatch(srv.httpd.server_address[0], srv.port,
+                      "/apis/wc.io/v1/namespaces/default/streamers"
+                      "?watch=true", {})
+        try:
+            obj = meta.new_object("Streamer", "s1", "default")
+            obj["apiVersion"] = "wc.io/v1beta1"
+            obj["spec"] = {"n": 1}
+            gv_request(http, "POST", "wc.io", "v1beta1", "streamers",
+                       body=obj)
+            deadline = time.monotonic() + 15
+            ev = None
+            while ev is None and time.monotonic() < deadline:
+                ev = w.next(timeout=1.0)
+            assert ev is not None, "watch event never arrived"
+            # stored at v1beta1, but THIS watch asked for v1
+            assert ev.object["apiVersion"] == "wc.io/v1"
+            assert ev.object["spec"]["n"] == 1
+        finally:
+            w.stop()
