@@ -232,6 +232,8 @@ def openapi_v2(builtin_groups: dict, cluster_scoped: frozenset[str],
                 f"{info['group']}/{version}", info["plural"],
                 info["kind"], info["namespaced"],
                 schema=info["schemas"].get(version) or None)
+    from .openapi_schemas import install
+    install(definitions)  # real field trees for the load-bearing kinds
     return {"swagger": "2.0",
             "info": {"title": "kubernetes-tpu", "version": __version__},
             "paths": paths, "definitions": definitions}

@@ -411,6 +411,284 @@ class Kubectl:
                            f"exitCode={c.get('exitCode')}\n")
         return 0
 
+    # -- discovery-driven commands ----------------------------------------
+
+    def api_versions(self) -> int:
+        """kubectl api-versions: every served groupVersion, sorted
+        (kubectl/pkg/cmd/apiresources/apiversions.go)."""
+        http = self._http_client()
+        if http is None:
+            self.out.write("Error: this command needs --server\n")
+            return 1
+        gvs = ["v1"]
+        try:
+            for g in self.client._request("GET", "/apis").get("groups") or ():
+                for v in g.get("versions") or ():
+                    if v.get("groupVersion"):
+                        gvs.append(v["groupVersion"])
+        except (kv.StoreError, OSError) as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        for gv in sorted(set(gvs)):
+            self.out.write(gv + "\n")
+        return 0
+
+    def api_resources(self, namespaced: bool | None = None) -> int:
+        """kubectl api-resources: the server's resource tables
+        (kubectl/pkg/cmd/apiresources/apiresources.go)."""
+        http = self._http_client()
+        if http is None:
+            self.out.write("Error: this command needs --server\n")
+            return 1
+        rows: list[list[str]] = []
+
+        def collect(gv: str, resources) -> None:
+            for e in resources or ():
+                if "/" in e.get("name", ""):
+                    continue  # subresources are not rows
+                if namespaced is not None \
+                        and bool(e.get("namespaced")) != namespaced:
+                    continue
+                rows.append([e.get("name", ""),
+                             ",".join(e.get("shortNames") or ()),
+                             gv, str(bool(e.get("namespaced"))).lower(),
+                             e.get("kind", "")])
+        try:
+            collect("v1", self.client._request(
+                "GET", "/api/v1").get("resources"))
+            for g in self.client._request("GET", "/apis").get("groups") or ():
+                for v in g.get("versions") or ():
+                    gv = v.get("groupVersion")
+                    if not gv:
+                        continue
+                    try:
+                        collect(gv, self.client._request(
+                            "GET", f"/apis/{gv}").get("resources"))
+                    except (kv.StoreError, OSError):
+                        continue
+        except (kv.StoreError, OSError) as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        rows.sort(key=lambda r: (r[2], r[0]))
+        print_table(rows, ["NAME", "SHORTNAMES", "APIVERSION", "NAMESPACED",
+                           "KIND"], self.out)
+        return 0
+
+    def explain(self, dotted: str) -> int:
+        """kubectl explain pod[.spec.containers...]: field docs from the
+        server's OpenAPI definitions (kubectl/pkg/cmd/explain over
+        /openapi/v2 — CRDs carry their real openAPIV3Schema)."""
+        http = self._http_client()
+        if http is None:
+            self.out.write("Error: this command needs --server\n")
+            return 1
+        first, _, rest = dotted.partition(".")
+        resource = self.resolve(first)
+        try:
+            spec = self.client._request("GET", "/openapi/v2")
+        except (kv.StoreError, OSError) as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        defs = spec.get("definitions") or {}
+        hit_key, schema = None, None
+        dmap = self._discovery_map()
+        for key, d in defs.items():
+            for gvk in d.get("x-kubernetes-group-version-kind") or ():
+                kind = gvk.get("kind", "").lower()
+                plural = dmap.get(kind) or KIND_TO_RESOURCE.get(
+                    gvk.get("kind", ""), kind + "s")
+                if resource in (plural, kind):
+                    hit_key, schema = key, d
+                    break
+            if schema is not None:
+                break
+        if schema is None:
+            self.out.write(
+                f"error: couldn't find resource for {first!r}\n")
+            return 1
+        path = [p for p in rest.split(".") if p]
+        # definition keys are "<gv>.<Kind>" where gv may itself be dotted
+        # (CRD groups are domain-shaped: "example.com/v1.Widget")
+        gv, _, kind_part = hit_key.rpartition(".")
+        walked = ["KIND:     " + kind_part,
+                  "VERSION:  " + (gv.rpartition("/")[2] if gv else "")]
+        for fieldname in path:
+            props = schema.get("properties") or {}
+            nxt = props.get(fieldname)
+            if nxt is None:
+                self.out.write(
+                    f"error: field {fieldname!r} does not exist\n")
+                return 1
+            # arrays explain their item schema (kubectl does the same)
+            while nxt.get("type") == "array" and "items" in nxt:
+                nxt = nxt["items"]
+            ref = nxt.get("$ref", "")
+            if ref.startswith("#/definitions/"):
+                nxt = {**defs.get(ref[len("#/definitions/"):], {}),
+                       "description": nxt.get("description", "")}
+            schema = nxt
+        self.out.write(walked[0] + "\n")
+        if walked[1]:
+            self.out.write(walked[1] + "\n")
+        if path:
+            self.out.write("FIELD:    " + path[-1]
+                           + f" <{schema.get('type', 'Object')}>\n")
+        self.out.write("\nDESCRIPTION:\n     "
+                       + (schema.get("description")
+                          or "<no description>") + "\n")
+        props = schema.get("properties") or {}
+        if props:
+            self.out.write("\nFIELDS:\n")
+            for fname in sorted(props):
+                fs = props[fname]
+                ftype = fs.get("type") or (
+                    "Object" if "$ref" in fs else "Object")
+                if ftype == "array":
+                    items = fs.get("items") or {}
+                    ftype = f"[]{items.get('type', 'Object')}"
+                self.out.write(f"   {fname}\t<{ftype}>\n")
+                desc = fs.get("description")
+                if desc:
+                    self.out.write(f"     {desc}\n")
+        return 0
+
+    # -- expose / autoscale / set -----------------------------------------
+
+    def expose(self, resource: str, name: str, namespace: str,
+               port: int, target_port: int | None = None,
+               svc_name: str | None = None, svc_type: str = "ClusterIP",
+               protocol: str = "TCP") -> int:
+        """kubectl expose: derive a Service selector from the exposed
+        object (kubectl/pkg/cmd/expose/exposeservice.go)."""
+        resource = self.resolve(resource)
+        try:
+            obj = self.client.get(resource, namespace, name)
+        except kv.NotFoundError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        spec = obj.get("spec") or {}
+        if resource == "services":
+            selector = spec.get("selector") or {}
+        elif resource == "pods":
+            selector = meta.labels(obj)
+        else:  # deployments / replicasets / jobs ...: their pod selector
+            selector = ((spec.get("selector") or {}).get("matchLabels")
+                        or (spec.get("template") or {}).get(
+                            "metadata", {}).get("labels") or {})
+        if not selector:
+            self.out.write(f"error: couldn't find a selector on "
+                           f"{resource}/{name}\n")
+            return 1
+        svc = {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": svc_name or name, "namespace": namespace,
+                         "labels": dict(meta.labels(obj))},
+            "spec": {"selector": dict(selector), "type": svc_type,
+                     "ports": [{"port": port, "protocol": protocol,
+                                "targetPort": target_port or port}]},
+        }
+        try:
+            created = self.client.create("services", svc)
+        except kv.AlreadyExistsError:
+            self.out.write(f"Error: services/{svc_name or name} already "
+                           "exists\n")
+            return 1
+        self.out.write(f"service/{meta.name(created)} exposed\n")
+        return 0
+
+    def autoscale(self, resource: str, name: str, namespace: str,
+                  min_replicas: int, max_replicas: int,
+                  cpu_percent: int | None = None) -> int:
+        """kubectl autoscale: create an HPA targeting the object
+        (kubectl/pkg/cmd/autoscale/autoscale.go)."""
+        resource = self.resolve(resource)
+        try:
+            obj = self.client.get(resource, namespace, name)
+        except kv.NotFoundError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        hpa = {
+            "apiVersion": "autoscaling/v2", "kind": "HorizontalPodAutoscaler",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "scaleTargetRef": {
+                    "apiVersion": obj.get("apiVersion", "apps/v1"),
+                    # stored objects may lack 'kind'; resolve through the
+                    # static table so casing matches SCALE_TARGETS
+                    # (Statefulset != StatefulSet)
+                    "kind": obj.get("kind") or next(
+                        (k for k, r in KIND_TO_RESOURCE.items()
+                         if r == resource), resource[:-1].title()),
+                    "name": name},
+                "minReplicas": min_replicas, "maxReplicas": max_replicas,
+            },
+        }
+        if cpu_percent is not None:
+            hpa["spec"]["metrics"] = [{
+                "type": "Resource",
+                "resource": {"name": "cpu", "target": {
+                    "type": "Utilization",
+                    "averageUtilization": cpu_percent}}}]
+        try:
+            self.client.create("horizontalpodautoscalers", hpa)
+        except kv.AlreadyExistsError:
+            self.out.write(f"Error: horizontalpodautoscalers/{name} "
+                           "already exists\n")
+            return 1
+        self.out.write(f"horizontalpodautoscaler/{name} autoscaled\n")
+        return 0
+
+    def set_cmd(self, what: str, resource: str, name: str, namespace: str,
+                kvs: list[str]) -> int:
+        """kubectl set image|env (kubectl/pkg/cmd/set): guaranteed-update
+        the workload's pod template containers."""
+        resource = self.resolve(resource)
+        if what not in ("image", "env"):
+            self.out.write(f"error: unknown set subcommand {what!r}\n")
+            return 1
+        pairs = []
+        for s in kvs:
+            k, sep, v = s.partition("=")
+            if not sep:
+                self.out.write(f"error: expected KEY=VALUE, got {s!r}\n")
+                return 1
+            pairs.append((k, v))
+
+        def containers_of(o):
+            if resource == "pods":
+                return (o.get("spec") or {}).get("containers") or []
+            return (((o.get("spec") or {}).get("template") or {})
+                    .get("spec", {}).get("containers") or [])
+
+        def patch(o):
+            cs = containers_of(o)
+            if what == "image":
+                for cname, img in pairs:
+                    hit = False
+                    for c in cs:
+                        if cname == "*" or c.get("name") == cname:
+                            c["image"] = img
+                            hit = True
+                    if not hit:
+                        raise ValueError(f"container {cname!r} not found")
+            else:
+                for c in cs:
+                    env = c.setdefault("env", [])
+                    for k, v in pairs:
+                        env[:] = [e for e in env if e.get("name") != k]
+                        env.append({"name": k, "value": v})
+            return o
+        try:
+            self.client.guaranteed_update(resource, namespace, name, patch)
+        except kv.NotFoundError as e:
+            self.out.write(f"Error: {e}\n")
+            return 1
+        except ValueError as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+        self.out.write(f"{resource}/{name} {what} updated\n")
+        return 0
+
     # -- interactive streams (exec / attach / port-forward) ---------------
 
     def _http_client(self):
@@ -552,6 +830,231 @@ class Kubectl:
         finally:
             fs.close()
         return code
+
+    def _exec_capture(self, name: str, namespace: str, command: list[str],
+                      container: str | None = None,
+                      stdin: bytes | None = None) -> tuple[int, bytes, str]:
+        """exec with BINARY stdout capture (cp needs the tar bytes
+        undecoded): returns (exit_code, stdout_bytes, stderr_text)."""
+        from urllib.parse import urlencode
+
+        from ..kubelet import streams
+        q = [("command", c) for c in command] + [("stdout", "true"),
+                                                 ("stderr", "true")]
+        if container:
+            q.append(("container", container))
+        if stdin is not None:
+            q.append(("stdin", "true"))
+        fs = self._open_stream(
+            f"/api/v1/namespaces/{namespace}/pods/{name}/exec?"
+            + urlencode(q))
+        if fs is None:
+            return 1, b"", "stream open failed"
+        if stdin is not None:
+            # stay under the stream frame cap (streams.MAX_FRAME)
+            step = 1 << 20
+            for at in range(0, len(stdin), step):
+                fs.send(streams.STDIN, stdin[at:at + step])
+            fs.send_close(streams.STDIN)
+        code, out, err = 0, [], []
+        try:
+            while True:
+                frame = fs.recv()
+                if frame is None:
+                    break
+                ch, payload = frame
+                if ch == streams.STDOUT:
+                    out.append(payload)
+                elif ch == streams.STDERR:
+                    err.append(payload.decode(errors="replace"))
+                elif ch == streams.ERROR:
+                    code, msg = streams.parse_exit_status(payload)
+                    if msg:
+                        err.append(msg)
+                    break
+        finally:
+            fs.close()
+        return code, b"".join(out), "".join(err)
+
+    @staticmethod
+    def _parse_cp_spec(spec: str, default_ns: str):
+        """[[namespace/]pod:]path -> (pod or None, namespace, path)
+        (kubectl/pkg/cmd/cp/cp.go extractFileSpec)."""
+        before, sep, after = spec.partition(":")
+        if not sep:
+            return None, default_ns, spec
+        ns, slash, pod = before.partition("/")
+        if slash:
+            return pod, ns, after
+        return before, default_ns, after
+
+    def cp(self, src: str, dst: str, namespace: str,
+           container: str | None = None) -> int:
+        """kubectl cp: tar over the exec tunnel, both directions
+        (kubectl/pkg/cmd/cp/cp.go copyToPod/copyFromPod)."""
+        import io as pyio
+        import os
+        import posixpath
+        import tarfile
+
+        s_pod, s_ns, s_path = self._parse_cp_spec(src, namespace)
+        d_pod, d_ns, d_path = self._parse_cp_spec(dst, namespace)
+        if (s_pod is None) == (d_pod is None):
+            self.out.write("Error: one of src/dest must be a remote spec "
+                           "(pod:path) and the other local\n")
+            return 1
+        if s_pod is not None:
+            # pod -> local: tar cf - <path> in the container, untar here
+            code, data, err = self._exec_capture(
+                s_pod, s_ns, ["tar", "cf", "-", s_path], container)
+            if code != 0:
+                self.out.write(f"Error: {err or 'tar failed'}\n")
+                return 1
+            try:
+                with tarfile.open(fileobj=pyio.BytesIO(data)) as tf:
+                    members = [m for m in tf.getmembers() if m.isfile()]
+                    for m in members:
+                        if len(members) == 1 and not os.path.isdir(dst):
+                            target = dst
+                        else:
+                            rel = posixpath.relpath(
+                                "/" + m.name, posixpath.dirname(
+                                    "/" + s_path.lstrip("/")) or "/")
+                            target = os.path.join(dst, rel)
+                        os.makedirs(os.path.dirname(target) or ".",
+                                    exist_ok=True)
+                        with open(target, "wb") as f:
+                            f.write(tf.extractfile(m).read())
+            except tarfile.TarError as e:
+                self.out.write(f"Error: bad tar stream: {e}\n")
+                return 1
+            return 0
+        # local -> pod: tar the local file(s), tar xmf - -C <dir> there
+        if not os.path.exists(src):
+            self.out.write(f"Error: {src}: no such file\n")
+            return 1
+        if d_path.endswith("/"):
+            # trailing slash == directory destination: keep the source name
+            dest_dir = posixpath.normpath("/" + d_path.lstrip("/"))
+            dest_name = os.path.basename(src.rstrip("/"))
+        else:
+            dest_dir = posixpath.dirname("/" + d_path.lstrip("/")) or "/"
+            dest_name = posixpath.basename(d_path) or os.path.basename(
+                src.rstrip("/"))
+        buf = pyio.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            if os.path.isdir(src):
+                for root, _dirs, names in os.walk(src):
+                    for nm in names:
+                        full = os.path.join(root, nm)
+                        rel = os.path.join(
+                            dest_name, os.path.relpath(full, src))
+                        ti = tarfile.TarInfo(rel)
+                        ti.size = os.path.getsize(full)
+                        with open(full, "rb") as f:
+                            tf.addfile(ti, f)
+            else:
+                ti = tarfile.TarInfo(dest_name)
+                ti.size = os.path.getsize(src)
+                with open(src, "rb") as f:
+                    tf.addfile(ti, f)
+        code, _, err = self._exec_capture(
+            d_pod, d_ns, ["tar", "xmf", "-", "-C", dest_dir], container,
+            stdin=buf.getvalue())
+        if code != 0:
+            self.out.write(f"Error: {err or 'tar failed'}\n")
+            return 1
+        return 0
+
+    def proxy(self, port: int = 8001, ready=None, once: bool = False) -> int:
+        """kubectl proxy: local plain-HTTP listener forwarding every
+        request to the apiserver with this kubectl's credentials attached
+        (kubectl/pkg/cmd/proxy)."""
+        import http.server
+
+        http_client = self._http_client()
+        if http_client is None:
+            self.out.write("Error: this command needs --server\n")
+            return 1
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            # Nagle + delayed ACK cost ~40ms per request on loopback
+            disable_nagle_algorithm = True
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _forward(self):
+                from ..client.http_client import make_connection
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else None
+                conn = make_connection(
+                    http_client.host, http_client.port,
+                    getattr(http_client, "_ssl_context", None))
+                try:
+                    headers = dict(http_client._headers)
+                    ct = self.headers.get("Content-Type")
+                    if ct:
+                        headers["Content-Type"] = ct
+                    conn.request(self.command, self.path, body=body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type",
+                                     resp.getheader("Content-Type",
+                                                    "application/json"))
+                    length = resp.getheader("Content-Length")
+                    if length is not None:
+                        self.send_header("Content-Length", length)
+                        self.end_headers()
+                        remaining = int(length)
+                        while remaining > 0:
+                            chunk = resp.read(min(remaining, 65536))
+                            if not chunk:
+                                break
+                            self.wfile.write(chunk)
+                            remaining -= len(chunk)
+                        return
+                    # unknown length (watch streams): re-chunk through,
+                    # flushing each piece so events arrive live
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    while True:
+                        chunk = resp.read1(65536)
+                        if not chunk:
+                            break
+                        self.wfile.write(b"%x\r\n%s\r\n"
+                                         % (len(chunk), chunk))
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError as e:
+                    try:
+                        self.send_error(502, str(e))
+                    except OSError:  # pragma: no cover - client gone
+                        pass
+                finally:
+                    conn.close()
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = _forward
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                 Handler)
+        bound = server.server_address[1]
+        self.out.write(f"Starting to serve on 127.0.0.1:{bound}\n")
+        if ready is not None:
+            ready(bound)
+        try:
+            if once:
+                server.handle_request()
+            else:
+                server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.server_close()
+        return 0
 
     def attach(self, name: str, namespace: str,
                container: str | None = None, stdin: bytes | None = None,
@@ -1179,6 +1682,40 @@ def build_parser() -> argparse.ArgumentParser:
     tn.add_argument("node")
     tn.add_argument("spec", help="key[=value]:Effect to add, key- to remove")
     sub.add_parser("version")
+    sub.add_parser("api-versions")
+    ar = sub.add_parser("api-resources")
+    ar.add_argument("--namespaced", default=None,
+                    choices=["true", "false"])
+    xp = sub.add_parser("explain")
+    xp.add_argument("dotted", help="resource[.field.path]")
+    ep = sub.add_parser("expose")
+    ep.add_argument("resource")
+    ep.add_argument("name")
+    ep.add_argument("--port", type=int, required=True)
+    ep.add_argument("--target-port", dest="target_port", type=int,
+                    default=None)
+    ep.add_argument("--name", dest="svc_name", default=None)
+    ep.add_argument("--type", dest="svc_type", default="ClusterIP")
+    ep.add_argument("--protocol", default="TCP")
+    asc = sub.add_parser("autoscale")
+    asc.add_argument("resource")
+    asc.add_argument("name")
+    asc.add_argument("--min", dest="min_replicas", type=int, required=True)
+    asc.add_argument("--max", dest="max_replicas", type=int, required=True)
+    asc.add_argument("--cpu-percent", dest="cpu_percent", type=int,
+                     default=None)
+    st = sub.add_parser("set")
+    st.add_argument("what", choices=["image", "env"])
+    st.add_argument("resource")
+    st.add_argument("name")
+    st.add_argument("kvs", nargs="+",
+                    help="image: CONTAINER=IMAGE...; env: KEY=VALUE...")
+    cp = sub.add_parser("cp")
+    cp.add_argument("src", help="local path or [[ns/]pod:]path")
+    cp.add_argument("dst", help="local path or [[ns/]pod:]path")
+    cp.add_argument("-c", "--container", default=None)
+    px = sub.add_parser("proxy")
+    px.add_argument("--port", type=int, default=8001)
     return ap
 
 
@@ -1263,6 +1800,29 @@ def run(argv: list[str] | None = None, client: Client | None = None,
                        copy_to=args.copy_to, command=tail or None)
     if args.cmd == "taint":
         return k.taint(args.node, args.spec)
+    if args.cmd == "api-versions":
+        return k.api_versions()
+    if args.cmd == "api-resources":
+        ns = None if args.namespaced is None else args.namespaced == "true"
+        return k.api_resources(namespaced=ns)
+    if args.cmd == "explain":
+        return k.explain(args.dotted)
+    if args.cmd == "expose":
+        return k.expose(args.resource, args.name, args.namespace,
+                        args.port, args.target_port, args.svc_name,
+                        args.svc_type, args.protocol)
+    if args.cmd == "autoscale":
+        return k.autoscale(args.resource, args.name, args.namespace,
+                           args.min_replicas, args.max_replicas,
+                           args.cpu_percent)
+    if args.cmd == "set":
+        return k.set_cmd(args.what, args.resource, args.name,
+                         args.namespace, args.kvs)
+    if args.cmd == "cp":
+        return k.cp(args.src, args.dst, args.namespace,
+                    container=args.container)
+    if args.cmd == "proxy":
+        return k.proxy(args.port)
     if args.cmd == "version":
         out.write(f"kubectl-tpu v{__version__}\n")
         return 0

@@ -194,12 +194,15 @@ class FakeRuntimeService:
                 io.write_stderr(b"container not running\n")
                 return 126
             cfg = dict(c["config"])
+            # the container's in-memory filesystem persists across execs
+            # (lives on the container entry, not the config copy)
+            files = c.setdefault("files", {})
             sandbox = self._sandboxes.get(c["sandboxId"]) or {}
             hostname = (sandbox.get("config") or {}).get("name", "")
-        return self._run_scripted(command, io, cfg, hostname)
+        return self._run_scripted(command, io, cfg, hostname, files)
 
     def _run_scripted(self, argv: list[str], io, cfg: dict,
-                      hostname: str) -> int:
+                      hostname: str, files: dict | None = None) -> int:
         if not argv:
             io.write_stderr(b"no command\n")
             return 126
@@ -212,7 +215,7 @@ class FakeRuntimeService:
                     return int(inner[1]) if len(inner) > 1 else 0
                 except ValueError:
                     return 2
-            return self._run_scripted(inner, io, cfg, hostname)
+            return self._run_scripted(inner, io, cfg, hostname, files)
         if cmd == "echo":
             io.write_stdout((" ".join(args) + "\n").encode())
             return 0
@@ -246,8 +249,104 @@ class FakeRuntimeService:
             except ValueError:
                 return 2
             return 0
+        if cmd == "tar":
+            return self._run_tar(args, io, files if files is not None else {})
+        if cmd == "cat":
+            files = files if files is not None else {}
+            code = 0
+            for path in args:
+                data = files.get(self._normpath(path))
+                if data is None:
+                    io.write_stderr(
+                        f"cat: {path}: No such file or directory\n".encode())
+                    code = 1
+                else:
+                    io.write_stdout(data)
+            return code
+        if cmd == "ls":
+            files = files if files is not None else {}
+            prefix = self._normpath(args[0]) if args else "/"
+            names = sorted(p for p in files
+                           if p == prefix or p.startswith(
+                               prefix.rstrip("/") + "/"))
+            if args and not names:
+                io.write_stderr(
+                    f"ls: {args[0]}: No such file or directory\n".encode())
+                return 1
+            io.write_stdout(("\n".join(names) + "\n").encode())
+            return 0
         io.write_stderr(f"sh: {cmd}: command not found\n".encode())
         return 127
+
+    @staticmethod
+    def _normpath(path: str) -> str:
+        import posixpath
+        return posixpath.normpath("/" + path.lstrip("/"))
+
+    def _run_tar(self, args: list[str], io, files: dict) -> int:
+        """Scripted `tar` over the container's in-memory files — the
+        transport kubectl cp rides (reference: kubectl/pkg/cmd/cp/cp.go
+        execs `tar cf -` / `tar xmf -` in the container)."""
+        import io as pyio
+        import tarfile
+
+        flags = args[0].lstrip("-") if args else ""
+        rest = args[1:]
+        chdir = "/"
+        members: list[str] = []
+        i = 0
+        while i < len(rest):
+            if rest[i] == "-C" and i + 1 < len(rest):
+                chdir = rest[i + 1]
+                i += 2
+            elif rest[i] == "-":
+                i += 1  # archive == stdin/stdout, implied
+            else:
+                members.append(rest[i])
+                i += 1
+        if "c" in flags:
+            buf = pyio.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w") as tf:
+                for m in members:
+                    full = self._normpath(
+                        m if m.startswith("/") else chdir + "/" + m)
+                    hits = {p: d for p, d in files.items()
+                            if p == full or p.startswith(
+                                full.rstrip("/") + "/")}
+                    if not hits:
+                        io.write_stderr(
+                            f"tar: {m}: No such file or directory\n".encode())
+                        return 2
+                    for p, d in sorted(hits.items()):
+                        ti = tarfile.TarInfo(p.lstrip("/"))
+                        ti.size = len(d)
+                        tf.addfile(ti, pyio.BytesIO(d))
+            data = buf.getvalue()
+            step = 1 << 20  # stream frame cap (streams.MAX_FRAME)
+            for at in range(0, len(data), step):
+                io.write_stdout(data[at:at + step])
+            return 0
+        if "x" in flags:
+            chunks = []
+            while True:
+                data = io.read_stdin()
+                if data is None:
+                    break
+                chunks.append(data)
+            try:
+                with tarfile.open(fileobj=pyio.BytesIO(b"".join(chunks)),
+                                  mode="r") as tf:
+                    for ti in tf.getmembers():
+                        if not ti.isfile():
+                            continue
+                        dest = self._normpath(chdir + "/" + ti.name)
+                        files[dest] = tf.extractfile(ti).read()
+            except tarfile.TarError as e:
+                io.write_stderr(f"tar: {e}\n".encode())
+                return 2
+            return 0
+        io.write_stderr(b"tar: need c or x\n")
+        return 2
 
     def attach_stream(self, container_id: str, io, stop=None,
                       tty: bool = False) -> int:
