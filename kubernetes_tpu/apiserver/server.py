@@ -738,27 +738,40 @@ class APIServer:
                 self.end_headers()
                 try:
                     while True:
-                        ev = w.next(timeout=5.0)
-                        if ev is None:
+                        evs = w.next_batch(timeout=5.0)
+                        if not evs:
                             if w.stopped:
                                 break
-                            payload = {"type": kv.BOOKMARK,
-                                       "object": {"metadata": {}}}
-                        else:
-                            obj = ev.object
-                            if r is not None and self._is_custom(r):
-                                try:
-                                    obj = self._serve_custom(r, obj)
-                                except crdlib.ValidationError:
-                                    # conversion webhook failure mid-
-                                    # stream: end the watch cleanly so
-                                    # the client relists
-                                    break
-                            payload = {"type": ev.type, "object": obj}
-                        data = (json.dumps(payload) + "\n").encode()
-                        self.wfile.write(f"{len(data):x}\r\n".encode()
-                                         + data + b"\r\n")
-                        self.wfile.flush()
+                            evs = [None]  # heartbeat below
+                        lines = []
+                        relist = False
+                        for ev in evs:
+                            if ev is None:
+                                payload = {"type": kv.BOOKMARK,
+                                           "object": {"metadata": {}}}
+                            else:
+                                obj = ev.object
+                                if r is not None and self._is_custom(r):
+                                    try:
+                                        obj = self._serve_custom(r, obj)
+                                    except crdlib.ValidationError:
+                                        # conversion webhook failure mid-
+                                        # stream: end the watch cleanly
+                                        # so the client relists
+                                        relist = True
+                                        break
+                                payload = {"type": ev.type, "object": obj}
+                            lines.append(json.dumps(payload) + "\n")
+                        if lines:
+                            # a burst is ONE chunk write + flush, not one
+                            # syscall pair per event (a 16k-bind batch
+                            # fans out to every pod watcher)
+                            data = "".join(lines).encode()
+                            self.wfile.write(f"{len(data):x}\r\n".encode()
+                                             + data + b"\r\n")
+                            self.wfile.flush()
+                        if relist:
+                            break
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass
                 finally:
@@ -968,30 +981,47 @@ class APIServer:
                                                       "invalid JSON body"))
                     return None
 
-            def _admit(self, verb: str, r: _Route, obj: dict,
-                       old: dict | None = None) -> dict | None:
-                """Run legacy hooks + the admission chain; None = rejected
-                (response already written)."""
+            def _admit_quiet(self, verb: str, r: _Route, obj: dict,
+                             old: dict | None = None,
+                             namespace: str | None = None
+                             ) -> tuple[dict | None, dict | None]:
+                """Run legacy hooks + the admission chain WITHOUT writing
+                a response: (admitted_obj, None) or (None, status_error)
+                — the bulk paths report per-item.
+
+                `namespace` defaults to the URL namespace (the
+                single-object contract: a body claiming another namespace
+                must not shift which policy admits it); the bulk path
+                passes each item's own namespace explicitly."""
                 for hook in server.admission_hooks:
                     try:
                         obj = hook(verb, r.resource, obj) or obj
                     except AdmissionError as e:
-                        self._send_json(400, status_error(
-                            400, "AdmissionDenied", str(e)))
-                        return None
-                attrs = adm.Attributes(verb, r.resource, obj, old,
-                                       namespace=r.ns or "",
-                                       name=r.name or meta.name(obj) or "",
-                                       subresource=r.subresource or "")
+                        return None, status_error(400, "AdmissionDenied",
+                                                  str(e))
+                attrs = adm.Attributes(
+                    verb, r.resource, obj, old,
+                    namespace=(namespace if namespace is not None
+                               else r.ns or ""),
+                    name=r.name or meta.name(obj) or "",
+                    subresource=r.subresource or "")
                 try:
                     server.admission_chain.run(attrs)
                 except adm.AdmissionDenied as e:
-                    self._send_json(403, status_error(
+                    return None, status_error(
                         403, "Forbidden",
                         "admission plugin %s denied the request: %s"
-                        % (e.plugin, e)))
-                    return None
-                return attrs.obj
+                        % (e.plugin, e))
+                return attrs.obj, None
+
+            def _admit(self, verb: str, r: _Route, obj: dict,
+                       old: dict | None = None) -> dict | None:
+                """Run legacy hooks + the admission chain; None = rejected
+                (response already written)."""
+                admitted, err = self._admit_quiet(verb, r, obj, old)
+                if err is not None:
+                    self._send_json(err["code"], err)
+                return admitted
 
             def _is_custom(self, r: _Route) -> bool:
                 """CRD-backed resource?  True for BOTH addressing forms:
@@ -1074,6 +1104,18 @@ class APIServer:
                 # -- subresources --
                 if r.subresource == "binding":
                     self._post_binding(r, obj)
+                    return
+                if r.resource == "bindings":
+                    # collection-level Binding (upstream supports a single
+                    # POST .../bindings); BindingList extends it to the
+                    # batch-scheduler write (store.bind_many, one
+                    # transaction) — the front-door equivalent of the
+                    # LocalClient bulk bind
+                    self._post_bindings(r, obj)
+                    return
+                if isinstance(obj, dict) and obj.get("kind") == "List" \
+                        and isinstance(obj.get("items"), list):
+                    self._post_bulk_create(r, obj)
                     return
                 if r.subresource == "eviction":
                     self._post_eviction(r, obj)
@@ -1185,6 +1227,128 @@ class APIServer:
                              "audiences": list(audiences)},
                     "status": {"token": token,
                                "expirationTimestamp": stamp}})
+
+            def _post_bindings(self, r: _Route, body: dict) -> None:
+                """POST .../bindings with a Binding (single) or
+                BindingList (bulk): each item names its pod
+                (metadata.namespace/name) and target node (target.name).
+                Bulk rides ONE store transaction (kv.bind_many) — the
+                server-side verb that keeps the scheduler's batched
+                assignment from serializing into per-pod round trips."""
+                if body.get("kind") == "BindingList" \
+                        or isinstance(body.get("items"), list):
+                    items = body.get("items") or []
+                else:
+                    items = [body]
+                triples = []
+                for it in items:
+                    md = it.get("metadata") or {}
+                    node = ((it.get("target") or {}).get("name")
+                            or it.get("nodeName"))
+                    if not md.get("name") or not node:
+                        self._send_json(400, status_error(
+                            400, "BadRequest",
+                            "each binding needs metadata.name and "
+                            "target.name"))
+                        return
+                    triples.append((md.get("namespace") or r.ns
+                                    or "default", md["name"], node))
+                results = server.store.bind_many("pods", triples)
+                out = []
+                for _obj, err in results:
+                    if err is None:
+                        out.append({"kind": "Status", "status": "Success"})
+                    elif isinstance(err, kv.ConflictError):
+                        out.append(status_error(409, "Conflict", str(err)))
+                    elif isinstance(err, kv.NotFoundError):
+                        out.append(status_error(404, "NotFound", str(err)))
+                    else:  # pragma: no cover - other store errors
+                        out.append(status_error(500, "InternalError",
+                                                str(err)))
+                self._audit(r, "create", 201)
+                self._send_json(201, {"kind": "BindingResultList",
+                                      "items": out})
+
+            def _post_bulk_create(self, r: _Route, body: dict) -> None:
+                """POST a {kind: List, items: [...]} body on a resource
+                collection: per-item admission, then ONE store
+                transaction (kv.create_many) with per-item results —
+                the bulk sibling of create, used by the event
+                broadcaster's flush so a 4096-event burst is one round
+                trip, not 4096."""
+                if r.resource == crdlib.CRDS:
+                    # CRDs need establish() side effects per object; the
+                    # singular path is the only one that carries them
+                    self._send_json(400, status_error(
+                        400, "BadRequest",
+                        "bulk create is not supported for "
+                        "customresourcedefinitions"))
+                    return
+                custom = self._is_custom(r)
+                items = body.get("items") or []
+                prepared: list = []
+                statuses: list[dict | None] = []
+                for obj in items:
+                    md = obj.get("metadata") \
+                        if isinstance(obj, dict) else None
+                    if not isinstance(md, dict) \
+                            or not isinstance(md.get("name"), str):
+                        statuses.append(status_error(
+                            400, "BadRequest",
+                            "item without metadata.name"))
+                        prepared.append(None)
+                        continue
+                    if r.resource in CLUSTER_SCOPED:
+                        md.pop("namespace", None)
+                    elif r.ns:
+                        md.setdefault("namespace", r.ns)
+                    try:
+                        admitted, err = self._admit_quiet(
+                            adm.CREATE, r, obj,
+                            namespace=md.get("namespace", ""))
+                        if admitted is not None and custom:
+                            # same prune/default/validate/CEL + storage-
+                            # version conversion the singular path runs
+                            try:
+                                admitted = server.crds.to_storage(
+                                    r.resource, server.crds.coerce(
+                                        r.resource,
+                                        self._custom_version(r),
+                                        admitted, None))
+                            except crdlib.ValidationError as e:
+                                admitted, err = None, status_error(
+                                    422, "Invalid", str(e))
+                    except Exception as e:  # noqa: BLE001 - per-item wall
+                        admitted, err = None, status_error(
+                            400, "BadRequest", f"bad item: {e}")
+                    if admitted is None:
+                        statuses.append(err)
+                        prepared.append(None)
+                        continue
+                    prepared.append(admitted)
+                    statuses.append(None)
+                live = [o for o in prepared if o is not None]
+                results = iter(server.store.create_many(r.resource, live))
+                out = []
+                for st in statuses:
+                    if st is not None:
+                        out.append(st)
+                        continue
+                    created, err = next(results)
+                    if err is None:
+                        out.append({"kind": "Status", "status": "Success",
+                                    "metadata": {
+                                        "resourceVersion":
+                                        meta.resource_version(created)}})
+                    elif isinstance(err, kv.AlreadyExistsError):
+                        out.append(status_error(409, "AlreadyExists",
+                                                str(err)))
+                    else:  # pragma: no cover - other store errors
+                        out.append(status_error(500, "InternalError",
+                                                str(err)))
+                self._audit(r, "create", 201)
+                self._send_json(201, {"kind": "CreateResultList",
+                                      "items": out})
 
             def _post_binding(self, r: _Route, binding: dict) -> None:
                 """POST pods/{name}/binding (registry/core/pod/storage

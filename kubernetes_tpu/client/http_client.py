@@ -45,8 +45,78 @@ def make_connection(host: str, port: int,
     return http.client.HTTPConnection(host, port)
 
 
+class _ChunkDecoder:
+    """Incremental HTTP/1.1 chunked-transfer decoder.
+
+    http.client's own chunked reader is unusable for a live stream: its
+    BufferedReader slurps wire bytes into a Python-level buffer that
+    select() on the raw socket cannot see, so a delivered event can sit
+    invisible until the NEXT write arrives (measured: a 3k-pod burst
+    surfaced only when the 5s server heartbeat pushed it out).  Decoding
+    the framing ourselves over raw recv() makes readability == select.
+    """
+
+    _HEADER, _PAYLOAD, _TRAILER_CRLF, _DONE = range(4)
+
+    def __init__(self, chunked: bool):
+        self.chunked = chunked
+        self.raw = bytearray()
+        self._state = self._HEADER
+        self._left = 0  # payload bytes remaining in the current chunk
+
+    def feed(self, data: bytes) -> bytes:
+        """Decode more wire bytes; returns the payload bytes produced."""
+        if not self.chunked:
+            return data
+        self.raw += data
+        out = bytearray()
+        while True:
+            if self._state == self._HEADER:
+                i = self.raw.find(b"\r\n")
+                if i < 0:
+                    break
+                try:
+                    size = int(bytes(self.raw[:i]).split(b";")[0], 16)
+                except ValueError:
+                    self._state = self._DONE  # corrupt framing: EOF
+                    break
+                del self.raw[:i + 2]
+                if size == 0:
+                    self._state = self._DONE
+                    break
+                self._left = size
+                self._state = self._PAYLOAD
+            elif self._state == self._PAYLOAD:
+                if not self.raw:
+                    break
+                take = min(self._left, len(self.raw))
+                out += self.raw[:take]
+                del self.raw[:take]
+                self._left -= take
+                if self._left == 0:
+                    self._state = self._TRAILER_CRLF
+            elif self._state == self._TRAILER_CRLF:
+                if len(self.raw) < 2:
+                    break
+                del self.raw[:2]
+                self._state = self._HEADER
+            else:  # _DONE
+                break
+        return bytes(out)
+
+    @property
+    def done(self) -> bool:
+        return self._state == self._DONE
+
+
 class HTTPWatch:
-    """Consumes the newline-delimited JSON watch stream; quacks like kv.Watch."""
+    """Consumes the newline-delimited JSON watch stream; quacks like kv.Watch.
+
+    Framing is managed explicitly: raw socket recv -> _ChunkDecoder ->
+    line buffer.  A poll timeout (select) leaves partial lines/chunks
+    intact, and buffered-but-unparsed data can never hide from the
+    readability check — see _ChunkDecoder's docstring for why
+    http.client's reader cannot be used here."""
 
     def __init__(self, host: str, port: int, path: str,
                  headers: dict[str, str], ssl_context=None):
@@ -57,45 +127,132 @@ class HTTPWatch:
             body = json.loads(self._resp.read() or b"{}")
             self._conn.close()
             _raise_for(self._resp.status, body)
-        self._buf = b""
+        chunked = (self._resp.getheader("Transfer-Encoding", "")
+                   .lower() == "chunked")
+        self._decoder = _ChunkDecoder(chunked)
+        self._buf = bytearray()
         self._stopped = False
         self._lock = threading.Lock()
+        self._sock = self._resp.fp.raw._sock \
+            if hasattr(self._resp.fp, "raw") else None
+        # getresponse()'s header reads may have overshot into the body:
+        # drain the BufferedReader's residue without blocking, then stop
+        # using it entirely
+        if self._sock is not None:
+            self._sock.setblocking(False)
+            try:
+                while True:
+                    residue = self._resp.fp.read1(1 << 20)
+                    if not residue:
+                        break
+                    self._buf += self._decoder.feed(residue)
+            except (BlockingIOError, OSError):
+                pass
+            finally:
+                self._sock.setblocking(True)
 
-    def next_batch(self, timeout: float | None = None):
-        """kv.Watch.next_batch parity for bulk informer consumption: over
-        HTTP we read one framed event per call (the socket stream has no
-        cheap drain), so a batch is just 0-or-1 events."""
-        ev = self.next(timeout)
-        return [ev] if ev is not None else []
+    def _fill(self, timeout: float | None) -> bool:
+        """One raw recv into the line buffer. False on timeout/EOF/error
+        (EOF/error also set _stopped).
+
+        NEVER sets a socket timeout: SocketIO poisons itself permanently
+        after one timed-out read ("cannot read from timed out object").
+        Readiness comes from select; the recv itself runs on the
+        blocking socket and returns promptly because data is there."""
+        if not self._wait_readable(timeout):
+            return False  # poll timeout: stream is still alive
+        try:
+            data = self._sock.recv(65536) if self._sock is not None \
+                else self._resp.read1(65536)
+        except OSError:
+            self._stopped = True
+            return False
+        if not data:
+            self._stopped = True
+            return False
+        self._buf += self._decoder.feed(data)
+        if self._decoder.done:
+            self._stopped = True
+        return True
+
+    def _wait_readable(self, timeout: float | None) -> bool:
+        import select
+        sock = self._sock
+        if sock is None:  # no raw socket handle: read blocking
+            return True
+        pending = getattr(sock, "pending", None)  # TLS-layer buffer
+        if pending is not None and pending():
+            return True
+        try:
+            return bool(select.select([sock], [], [], timeout)[0])
+        except (OSError, ValueError):
+            self._stopped = True
+            return False
+
+    @staticmethod
+    def _parse(line: bytes):
+        """WatchEvent, kv.BOOKMARK for heartbeats, or None for junk."""
+        try:
+            payload = json.loads(line)
+        except json.JSONDecodeError:
+            return None
+        if payload.get("type") == kv.BOOKMARK:
+            return kv.BOOKMARK
+        return kv.WatchEvent(payload["type"], payload["object"],
+                             meta.resource_version(payload["object"]))
+
+    def _next_buffered(self):
+        """Next event already in the line buffer: a WatchEvent, the
+        kv.BOOKMARK sentinel (heartbeat), or None when the buffer holds
+        no complete line.  No socket reads."""
+        while True:
+            i = self._buf.find(b"\n")
+            if i < 0:
+                return None
+            line = bytes(self._buf[:i + 1])
+            del self._buf[:i + 1]
+            ev = self._parse(line)
+            if ev is None:
+                continue  # junk line
+            return ev
 
     def next(self, timeout: float | None = None):
         if self._stopped:
             return None
-        sock = self._resp.fp.raw._sock if hasattr(self._resp.fp, "raw") else None
-        try:
-            if sock is not None:
-                sock.settimeout(timeout)
-            while True:
-                line = self._resp.readline()
-                if not line:
-                    self._stopped = True
-                    return None
-                try:
-                    payload = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if payload.get("type") == kv.BOOKMARK:
-                    return None  # heartbeat; caller polls again
-                return kv.WatchEvent(
-                    payload["type"], payload["object"],
-                    meta.resource_version(payload["object"]))
-        except TimeoutError:
-            return None  # poll timeout: stream is still alive
-        except OSError:
-            # connection died (reset/refused/closed): mark the stream
-            # stopped so the reflector relists instead of polling a corpse
-            self._stopped = True
-            return None
+        while True:
+            ev = self._next_buffered()
+            if ev is kv.BOOKMARK:
+                return None  # heartbeat: caller polls again
+            if ev is not None:
+                return ev
+            if not self._fill(timeout):
+                return None
+
+    BATCH_MAX = 4096
+
+    def next_batch(self, timeout: float | None = None):
+        """kv.Watch.next_batch parity for bulk informer consumption:
+        block for the first event, then drain complete buffered lines
+        plus whatever the socket can hand over without blocking — a
+        server-side flood arrives as one batch, so the informer's bulk
+        handlers take one lock round per burst instead of one per
+        event."""
+        ev = self.next(timeout)
+        if ev is None:
+            return []
+        batch = [ev]
+        while len(batch) < self.BATCH_MAX:
+            ev = self._next_buffered()
+            if ev is kv.BOOKMARK:
+                continue
+            if ev is None:
+                if self._stopped or not self._wait_readable(0):
+                    break
+                if not self._fill(0):
+                    break
+                continue
+            batch.append(ev)
+        return batch
 
     def stop(self) -> None:
         with self._lock:
@@ -305,6 +462,68 @@ class HTTPClient(Client):
             "kind": "Binding", "apiVersion": "v1",
             "metadata": {"name": meta.name(pod)},
             "target": {"kind": "Node", "name": node_name}})
+
+    _BULK_ERRORS = {"Conflict": kv.ConflictError,
+                    "NotFound": kv.NotFoundError,
+                    "AlreadyExists": kv.AlreadyExistsError}
+
+    def bind_many(self, bindings: list[tuple[str, str, str]]
+                  ) -> list[tuple[Obj | None, Exception | None]]:
+        """Bulk bind through ONE request: POST a BindingList to the
+        bindings collection (server: _post_bindings -> kv.bind_many).
+        Per-pod fallback when the server predates the bulk verb."""
+        body = {"kind": "BindingList", "apiVersion": "v1", "items": [
+            {"metadata": {"namespace": ns, "name": nm},
+             "target": {"kind": "Node", "name": node}}
+            for ns, nm, node in bindings]}
+        try:
+            resp = self._request("POST", "/api/v1/bindings", body)
+        except kv.NotFoundError:
+            # server predates the bulk route (404 maps to NotFoundError;
+            # a server WITH the route reports per-item errors in-band)
+            return super().bind_many(bindings)
+        out: list[tuple[Obj | None, Exception | None]] = []
+        for item in resp.get("items") or ():
+            if item.get("status") == "Success":
+                out.append(({}, None))
+            else:
+                err = self._BULK_ERRORS.get(item.get("reason"), HTTPError)
+                msg = item.get("message", "")
+                out.append((None, err(item.get("code", 500), msg)
+                            if err is HTTPError else err(msg)))
+        while len(out) < len(bindings):  # pragma: no cover - short reply
+            out.append((None, HTTPError(500, "missing bulk result")))
+        return out
+
+    def create_events(self, events: list[Obj]) -> None:
+        """Event broadcaster flush: one bulk POST per burst (the generic
+        base writes one by one)."""
+        self.create_bulk("events", events)
+
+    def create_bulk(self, resource: str, objs: list[Obj]) -> None:
+        """Bulk create through ONE request: POST {kind: List, items} to
+        the collection (server: _post_bulk_create -> kv.create_many).
+        Raises on the first per-item failure, matching
+        LocalClient.create_bulk's contract (the event broadcaster's
+        flush catches StoreError, keeping events fire-and-forget)."""
+        if not objs:
+            return
+        try:
+            resp = self._request("POST",
+                                 self._path(resource,
+                                            meta.namespace(objs[0])),
+                                 {"kind": "List", "apiVersion": "v1",
+                                  "items": objs})
+        except kv.NotFoundError:
+            for o in objs:  # server predates the bulk route
+                self.create(resource, o)
+            return
+        for item in resp.get("items") or ():
+            if item.get("status") != "Success":
+                err = self._BULK_ERRORS.get(item.get("reason"))
+                msg = item.get("message", "")
+                raise err(msg) if err is not None else HTTPError(
+                    item.get("code", 500), msg)
 
     def evict(self, namespace: str, name: str) -> Obj:
         """POST pods/{name}/eviction — PDB-gated delete (429 when blocked)."""

@@ -294,7 +294,7 @@ def _bulk_create(client, resource: str, count: int, offset: int,
         for lo in range(0, count, chunk):
             creator(resource, [build(offset + i, op)
                                for i in range(lo, min(lo + chunk, count))])
-    elif creator is None and count >= 64:
+    elif count >= 64:
         # remote client (HTTP): fan the submission over a few
         # connections — the reference harness pumps through a
         # concurrent rate-limited client the same way (util.go:92);

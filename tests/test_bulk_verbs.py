@@ -1,0 +1,216 @@
+"""Front-door bulk verbs: BindingList POST, bulk create (List POST),
+and HTTPWatch burst batching — the server-side halves of the scheduler's
+batched write path (store.bind_many / store.create_many were already
+transactional; these tests pin the HTTP surfaces over them).
+
+Reference anchors: pkg/registry/core/pod/storage (BindingREST
+semantics per entry), scheduler_perf util.go:92 (the reference harness
+drives the real REST surface).
+"""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client.clientset import PODS
+from kubernetes_tpu.client.http_client import HTTPClient
+from kubernetes_tpu.store import kv
+
+
+@pytest.fixture()
+def server():
+    store = kv.MemoryStore(history=10_000)
+    srv = APIServer(store).start()
+    http = HTTPClient.from_url(srv.url)
+    yield http, store
+    srv.stop()
+
+
+def mkpod(name, ns="default"):
+    pod = meta.new_object("Pod", name, ns)
+    pod["spec"] = {"containers": [{"name": "c", "image": "i"}]}
+    return pod
+
+
+class TestBulkBind:
+    def test_bind_many_one_request(self, server):
+        http, store = server
+        for i in range(5):
+            http.create(PODS, mkpod(f"bb-{i}"))
+        results = http.bind_many([("default", f"bb-{i}", f"node-{i % 2}")
+                                  for i in range(5)])
+        assert len(results) == 5
+        assert all(err is None for _, err in results)
+        for i in range(5):
+            pod = store.get(PODS, "default", f"bb-{i}")
+            assert pod["spec"]["nodeName"] == f"node-{i % 2}"
+            assert any(c["type"] == "PodScheduled"
+                       for c in pod["status"]["conditions"])
+
+    def test_per_entry_failures_dont_poison(self, server):
+        http, store = server
+        http.create(PODS, mkpod("bf-ok"))
+        http.create(PODS, mkpod("bf-bound"))
+        http.bind_many([("default", "bf-bound", "n0")])
+        results = http.bind_many([
+            ("default", "bf-ok", "n1"),
+            ("default", "bf-bound", "n1"),   # already bound -> Conflict
+            ("default", "bf-missing", "n1"),  # -> NotFound
+        ])
+        assert results[0][1] is None
+        assert isinstance(results[1][1], kv.ConflictError)
+        assert isinstance(results[2][1], kv.NotFoundError)
+        assert store.get(PODS, "default", "bf-ok")["spec"][
+            "nodeName"] == "n1"
+        assert store.get(PODS, "default", "bf-bound")["spec"][
+            "nodeName"] == "n0"
+
+    def test_single_binding_collection_post(self, server):
+        """Upstream shape: POST one Binding to the collection."""
+        http, store = server
+        http.create(PODS, mkpod("bs-one"))
+        http._request("POST", "/api/v1/namespaces/default/bindings", {
+            "kind": "Binding", "apiVersion": "v1",
+            "metadata": {"name": "bs-one"},
+            "target": {"kind": "Node", "name": "n7"}})
+        assert store.get(PODS, "default", "bs-one")["spec"][
+            "nodeName"] == "n7"
+
+    def test_cross_namespace_batch(self, server):
+        http, store = server
+        ns2 = meta.new_object("Namespace", "other", "")
+        http.create("namespaces", ns2)
+        http.create(PODS, mkpod("cn-a"))
+        http.create(PODS, mkpod("cn-b", ns="other"))
+        results = http.bind_many([("default", "cn-a", "nA"),
+                                  ("other", "cn-b", "nB")])
+        assert all(err is None for _, err in results)
+        assert store.get(PODS, "other", "cn-b")["spec"]["nodeName"] == "nB"
+
+
+class TestBulkCreate:
+    def test_events_one_request(self, server):
+        http, store = server
+        events = []
+        for i in range(50):
+            ev = meta.new_object("Event", f"ev-{i}", "default")
+            ev["reason"] = "Scheduled"
+            events.append(ev)
+        http.create_bulk("events", events)
+        items, _ = store.list("events", "default")
+        assert len(items) == 50
+
+    def test_malformed_items_get_per_item_statuses(self, server):
+        """Items with null/absent metadata.name must produce per-item
+        400s, not abort the whole request."""
+        http, store = server
+        resp = http._request(
+            "POST", "/api/v1/namespaces/default/configmaps",
+            {"kind": "List", "apiVersion": "v1", "items": [
+                {"metadata": None},
+                "not-a-dict",
+                {"metadata": {"name": "good-one"}},
+                {"metadata": {}}]})
+        st = resp["items"]
+        assert st[0]["code"] == 400
+        assert st[1]["code"] == 400
+        assert st[2]["status"] == "Success"
+        assert st[3]["code"] == 400
+        assert store.get("configmaps", "default", "good-one")
+
+    def test_client_raises_on_item_failure(self, server):
+        http, store = server
+        cm = meta.new_object("ConfigMap", "taken", "default")
+        http.create("configmaps", cm)
+        with pytest.raises(kv.AlreadyExistsError):
+            http.create_bulk("configmaps", [
+                meta.new_object("ConfigMap", "taken", "default")])
+
+    def test_bulk_custom_objects_get_crd_pipeline(self, server):
+        """Bulk-POSTed custom objects run the same prune/default/
+        validate pipeline as singular creates."""
+        http, store = server
+        schema = {"type": "object", "properties": {
+            "spec": {"type": "object", "properties": {
+                "size": {"type": "integer", "default": 3}}}}}
+        crd = {"apiVersion": "apiextensions.k8s.io/v1",
+               "kind": "CustomResourceDefinition",
+               "metadata": {"name": "widgets.example.com"},
+               "spec": {"group": "example.com",
+                        "names": {"plural": "widgets", "kind": "Widget"},
+                        "scope": "Namespaced",
+                        "versions": [{"name": "v1", "served": True,
+                                      "storage": True,
+                                      "schema": {
+                                          "openAPIV3Schema": schema}}]}}
+        http.create("customresourcedefinitions", crd)
+        resp = http._request(
+            "POST", "/apis/example.com/v1/namespaces/default/widgets",
+            {"kind": "List", "apiVersion": "v1", "items": [
+                {"metadata": {"name": "w1"}, "spec": {}},
+                {"metadata": {"name": "w2"},
+                 "spec": {"size": "not-an-int"}}]})
+        st = resp["items"]
+        assert st[0]["status"] == "Success"
+        assert st[1]["status"] == "Failure"  # schema rejected
+        w1 = http._request(
+            "GET", "/apis/example.com/v1/namespaces/default/widgets/w1")
+        assert w1["spec"]["size"] == 3  # defaulting applied
+
+    def test_bulk_crds_rejected(self, server):
+        http, _ = server
+        from kubernetes_tpu.client.http_client import HTTPError
+        with pytest.raises(HTTPError):
+            http._request(
+                "POST", "/api/v1/customresourcedefinitions",
+                {"kind": "List", "apiVersion": "v1",
+                 "items": [{"metadata": {"name": "x.example.com"}}]})
+
+    def test_per_entry_duplicate_reported_not_fatal(self, server):
+        http, store = server
+        a = meta.new_object("ConfigMap", "dup", "default")
+        http.create("configmaps", a)
+        resp = http._request(
+            "POST", "/api/v1/namespaces/default/configmaps",
+            {"kind": "List", "apiVersion": "v1", "items": [
+                {"metadata": {"name": "dup"}},
+                {"metadata": {"name": "fresh"}}]})
+        st = resp["items"]
+        assert st[0]["reason"] == "AlreadyExists"
+        assert st[1]["status"] == "Success"
+        assert store.get("configmaps", "default", "fresh")
+
+
+class TestWatchBatching:
+    def test_burst_arrives_as_one_batch(self, server):
+        http, store = server
+        w = http.watch(PODS)
+        # server-side burst: one transactional create_many
+        store.create_many(PODS, [mkpod(f"wb-{i}") for i in range(64)])
+        batch = w.next_batch(timeout=5.0)
+        # the drain must deliver substantially more than one event per
+        # call (exact count can split across TCP segments)
+        total = len(batch)
+        while total < 64:
+            more = w.next_batch(timeout=2.0)
+            assert more, f"stream dried up at {total}/64"
+            total += len(more)
+        assert total == 64
+        assert not w.stopped
+
+    def test_partial_line_survives_timeout(self, server):
+        """A poll timeout must not corrupt framing: events arriving
+        after quiet polls still parse."""
+        http, store = server
+        w = http.watch(PODS)
+        assert w.next(timeout=0.05) is None  # quiet poll
+        store.create(PODS, mkpod("pl-1"))
+        ev = w.next(timeout=5.0)
+        assert ev is not None and meta.name(ev.object) == "pl-1"
+        assert w.next(timeout=0.05) is None
+        store.create(PODS, mkpod("pl-2"))
+        ev = w.next(timeout=5.0)
+        assert ev is not None and meta.name(ev.object) == "pl-2"
+        assert not w.stopped
