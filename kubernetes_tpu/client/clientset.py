@@ -162,6 +162,45 @@ class Client:
                           md["name"], md.get("uid", ""), reason, message,
                           type_))
 
+    def create_event_burst(self, items: list[tuple[Obj, str, str]]) -> None:
+        """create_event for a whole batch with ONE queue round:
+        (regarding, reason, message) triples.  The bulk bind tail emits
+        one Scheduled event per pod — per-pod create_event costs ~7µs of
+        binder-thread time each at 100k-tier scale; the burst enqueue is
+        one deque.extend."""
+        recs = []
+        for regarding, reason, message in items:
+            md = regarding["metadata"]
+            recs.append((regarding.get("kind"), md.get("namespace", ""),
+                         md["name"], md.get("uid", ""), reason, message,
+                         "Normal"))
+        self._event_sink_many(recs)
+
+    def _event_sink_many(self, recs: list[tuple]) -> None:
+        if not recs:
+            return
+        q = getattr(self, "_event_queue", None)
+        if q is None:
+            self._event_sink(recs[0])  # starts the broadcaster thread
+            recs = recs[1:]
+            q = self._event_queue
+            if q is None or not recs:  # racing close()
+                return
+        room = self.EVENT_BUF_MAX - len(q)
+        if room > 0:
+            q.extend(recs[:room])
+            # racing producers can overshoot the cap by up to one burst
+            # each (room was read non-atomically); shed our own newest
+            # records so the bounded-queue contract holds
+            while len(q) > self.EVENT_BUF_MAX:
+                try:
+                    q.pop()
+                except IndexError:  # pragma: no cover - consumer drained
+                    break
+            wake = self._event_wake
+            if not wake.is_set():
+                wake.set()
+
     _event_init_lock = __import__("threading").Lock()
 
     EVENT_BUF_MAX = 50_000

@@ -169,6 +169,11 @@ class ClusterTensors:
         self.gen = np.zeros(c.n_cap, np.int64)
         self.node_gen = np.full(c.n_cap, -1, np.int64)  # last static encode
         self._free = list(range(c.n_cap - 1, -1, -1))
+        # rows that have EVER held data: a pristine row's arrays are still
+        # their init zeros, so the fresh-flood encode can skip the ~360
+        # floats/row of zero-fills (at 100k nodes those writes alone cost
+        # ~0.3s inside the first scheduling window)
+        self._ever_used = np.zeros(c.n_cap, bool)
         # static_version tracks arrays that rarely change (labels, taints,
         # alloc, domains); the device cache keys off it so binding a pod —
         # which dirties used/npods only — doesn't trigger a multi-MB
@@ -331,10 +336,15 @@ class ClusterTensors:
         node_infos = self.node_infos
         for row, ni in pairs:
             node_infos[row] = ni
-        for arr in (self.used, self.used_nz, self.port_mask, self.alloc,
-                    self.taint_mask, self.label_mask, self.key_mask):
-            arr[rows] = 0.0
-        self.npods[rows] = 0.0
+        # zero-fill only rows that have ever held data; pristine rows are
+        # still their init zeros (the 100k-registration flood writes none)
+        stale = rows[self._ever_used[rows]]
+        if len(stale):
+            for arr in (self.used, self.used_nz, self.port_mask, self.alloc,
+                        self.taint_mask, self.label_mask, self.key_mask):
+                arr[stale] = 0.0
+            self.npods[stale] = 0.0
+        self._ever_used[rows] = True
         self.alloc[rows, 0] = [ni.allocatable.milli_cpu for ni in infos]
         self.alloc[rows, 1] = [ni.allocatable.memory for ni in infos]
         self.alloc[rows, 2] = [ni.allocatable.ephemeral_storage
@@ -414,6 +424,7 @@ class ClusterTensors:
         node_infos = self.node_infos
         for (row, ni) in pairs:  # snapshot paths clone NodeInfos per update
             node_infos[row] = ni
+        self._ever_used[rows] = True
         self.used[rows, 0] = [ni.requested.milli_cpu for ni in infos]
         self.used[rows, 1] = [ni.requested.memory for ni in infos]
         self.used[rows, 2] = [ni.requested.ephemeral_storage for ni in infos]
@@ -442,6 +453,7 @@ class ClusterTensors:
         c = self.caps
         node = ni.node
         self.node_infos[row] = ni
+        self._ever_used[row] = True
 
         # ---- dynamic fields (change on every bind; cheap to upload) ----
         self._encode_resource(self.used[row], ni.requested)

@@ -73,6 +73,9 @@ class ThroughputCollector:
         self._count_lock = threading.Lock()
         self._scheduled: set[str] = set()
         self._watch: kv.Watch | None = None
+        self._frozen_at = 0.0     # freeze(): end of the measured window
+        self._frozen_count = 0
+        self._frozen_samples: list[float] = []
 
     def scheduled_total(self) -> int:
         """Pods bound since start() (drain-backed; cheap)."""
@@ -101,6 +104,14 @@ class ThroughputCollector:
             with self._count_lock:
                 self._count += new
 
+    @property
+    def started(self) -> bool:
+        return self._start_time != 0.0
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen_at != 0.0
+
     def start(self) -> None:
         self._start_time = time.monotonic()
         # watch BEFORE the workload's first create: nothing is in flight,
@@ -108,6 +119,18 @@ class ThroughputCollector:
         self._watch = self.store.watch(PODS)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    def freeze(self) -> None:
+        """Close the measurement window NOW (the measured op's barrier
+        completed): samples/duration after this point are excluded, like
+        the reference cancelling its collector right after the measured
+        createPods' waitUntil (scheduler_perf_test.go:744-751).  Watch
+        draining continues so scheduled_total stays usable for later
+        barriers."""
+        self._drain()
+        self._frozen_count = self.scheduled_total()
+        self._frozen_samples = list(self.samples)
+        self._frozen_at = time.monotonic()
 
     def _run(self) -> None:
         window_start = time.monotonic()
@@ -128,9 +151,16 @@ class ThroughputCollector:
         self._drain()  # pick up the tail
         if self._watch is not None:
             self._watch.stop()
-        end = time.monotonic()
-        total = self.scheduled_total()
-        dur = max(end - self._start_time, 1e-9)
+        if self.frozen:
+            # window closed at the measured barrier; trailing ops
+            # (sleep/churn/later floods) are excluded
+            total = self._frozen_count
+            dur = max(self._frozen_at - self._start_time, 1e-9)
+            self.samples = self._frozen_samples
+        else:
+            end = time.monotonic()
+            total = self.scheduled_total()
+            dur = max(end - self._start_time, 1e-9)
         s = ThroughputSummary(total_pods=total, duration=dur,
                               average=total / dur)
         if self.samples:
@@ -345,6 +375,10 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
                          _default_node, op)
             created_nodes += op["count"]
         elif opcode == "createPods":
+            if collector is not None and not collector.started:
+                # measurement window opens with the first measured pods
+                # (reference: CollectMetrics on the createPods op)
+                collector.start()
             rate = op.get("ratePerSecond")
             if rate:
                 # paced arrival (the reference harness's client-QPS knob,
@@ -380,6 +414,12 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
                                          timeout=op.get("timeout", 600.0),
                                          collector=collector)
             stats["barrier_ok"] = ok
+            if collector is not None and collector.started \
+                    and not collector.frozen:
+                # measured window closes at the barrier that covers the
+                # measured createPods (reference: collectorCancel right
+                # after waitUntilPodsScheduled)
+                collector.freeze()
         elif opcode == "sleep":
             time.sleep(op.get("duration", 1.0))
         elif opcode == "churn":
@@ -424,8 +464,14 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
     try:
         ops = config["workloadTemplate"]
         t0 = time.monotonic()
-        collector.start()
+        # The collector starts AT the first createPods op, not here: the
+        # reference runs its throughputCollector only while the measured
+        # createPods op is in flight (scheduler_perf_test.go:716-751,
+        # CollectMetrics gates collector run/cancel around createPods +
+        # waitUntil), so node-preparation floods are outside the window.
         stats = run_workload(cluster, ops, collector)
+        if not collector.started:  # no createPods op in workload
+            collector.start()
         summary = collector.stop()
         stats["wall"] = time.monotonic() - t0
         stats["e2e"] = cluster.scheduler.metrics.e2e_summary()

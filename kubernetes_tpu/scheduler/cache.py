@@ -283,15 +283,25 @@ class Cache:
     # -- nodes -----------------------------------------------------------
 
     def add_node(self, node: Obj) -> None:
-        name = meta.name(node)
+        self.add_nodes([node])
+
+    def add_nodes(self, nodes: list[Obj]) -> None:
+        """Bulk add/update: one lock round for a registration flood (a
+        100k-node creation burst otherwise pays a lock acquire + epoch
+        bump per node on the informer thread)."""
         with self._lock:
             self.mutation_epoch += 1
-            ni = self._nodes.get(name)
-            if ni is None:
-                ni = self._nodes[name] = NodeInfo()
-            ni.set_node(node)
-            self._dirty_nodes.add(name)
-            self._removed_nodes.discard(name)
+            table = self._nodes
+            dirty = self._dirty_nodes
+            removed = self._removed_nodes
+            for node in nodes:
+                name = meta.name(node)
+                ni = table.get(name)
+                if ni is None:
+                    ni = table[name] = NodeInfo()
+                ni.set_node(node)
+                dirty.add(name)
+                removed.discard(name)
 
     def update_node(self, node: Obj) -> None:
         self.add_node(node)

@@ -259,7 +259,32 @@ class Scheduler:
             pods.add_bulk_event_handler(self._on_pod_events)
         else:  # pragma: no cover - non-bulk informer stand-ins
             pods.add_event_handler(self._on_pod_event)
-        nodes.add_event_handler(self._on_node_event)
+        if hasattr(nodes, "add_bulk_event_handler"):
+            nodes.add_bulk_event_handler(self._on_node_events)
+        else:  # pragma: no cover - non-bulk informer stand-ins
+            nodes.add_event_handler(self._on_node_event)
+
+    def _on_node_events(self, triples: list) -> None:
+        """Bulk node-event handler: a registration flood (100k createNodes)
+        lands as ADDED bursts — absorb each burst with ONE cache lock
+        round and ONE queue move instead of one per node."""
+        adds: list[Obj] = []
+
+        def flush() -> None:
+            if adds:
+                self.cache.add_nodes(adds)
+                self.queue.move_all_to_active_or_backoff(
+                    ClusterEvent("Node", "Add"))
+                adds.clear()
+
+        ADDED = kv.ADDED
+        for t, node, old in triples:
+            if t == ADDED:
+                adds.append(node)
+            else:
+                flush()  # preserve same-node event ordering
+                self._on_node_event(t, node, old)
+        flush()
 
     def _on_pod_events(self, triples: list) -> None:
         """Bulk pod-event handler: the two burst-dominant cases — new
@@ -1088,14 +1113,16 @@ class Scheduler:
         self.metrics.observe_e2e(
             [(now - q.initial_attempt_timestamp, q.attempts)
              for _, q, _, _ in bound])
-        for state, qpi, node_name, assumed in bound:
-            if run_post_bind:
+        if run_post_bind:
+            for state, qpi, node_name, assumed in bound:
                 try:
                     fw.run_post_bind_plugins(state, qpi.pod_info, node_name)
                 except Exception:
                     logger.exception("post-bind tail failed for %s (pod stays "
                                      "bound to %s)", qpi.key, node_name)
-            self.client.create_event(qpi.pod, "Scheduled",
-                                     f"Successfully assigned {qpi.key} to {node_name}")
+        self.client.create_event_burst(
+            [(qpi.pod, "Scheduled",
+              f"Successfully assigned {qpi.key} to {node_name}")
+             for _, qpi, node_name, _ in bound])
         self.metrics.observe_attempts("scheduled", [latency] * len(bound),
                                       fw.profile_name)
