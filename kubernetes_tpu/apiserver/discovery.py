@@ -92,7 +92,29 @@ def _resource_entry(plural: str, kind: str, namespaced: bool,
 
 
 def api_versions() -> dict:
-    return {"kind": "APIVersions", "versions": ["v1"]}
+    from ..api import core_versions as corever
+    return {"kind": "APIVersions",
+            "versions": list(corever.SERVED_VERSIONS)}
+
+
+def core_versioned_resource_list(version: str,
+                                 cluster_scoped: frozenset[str]) -> dict:
+    """Resource list for a NON-hub core version: only the resources the
+    conversion seam serves there (api/core_versions)."""
+    from ..api import core_versions as corever
+    resources = []
+    served = set()
+    for plural, (kind, shorts) in sorted(CORE_KINDS.items()):
+        if corever.handles(plural, version):
+            served.add(plural)
+            resources.append(_resource_entry(
+                plural, kind, plural not in cluster_scoped, shorts))
+    for parent, sub, kind, verbs in _SUBRESOURCES:
+        if parent in served:
+            resources.append({"name": f"{parent}/{sub}", "kind": kind,
+                              "namespaced": True, "verbs": verbs})
+    return {"kind": "APIResourceList", "groupVersion": version,
+            "resources": resources}
 
 
 def core_resource_list(cluster_scoped: frozenset[str],

@@ -40,6 +40,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlencode, urlparse
 
 from .. import __version__
+from ..api import core_versions as corever
 from ..api import meta
 from ..component_base import configz
 from ..store import kv
@@ -467,7 +468,14 @@ class APIServer:
                 if not parts:
                     return r
                 if parts[0] == "api":
-                    rest = parts[2:]  # skip version "v1"
+                    # core ("legacy") group: /api/{version}/... — v1 is
+                    # the hub/storage form; additional served versions go
+                    # through the core conversion seam (api/core_versions)
+                    if len(parts) >= 2:
+                        r.version = parts[1]
+                        if r.version not in corever.SERVED_VERSIONS:
+                            return r  # unknown core version -> 404
+                    rest = parts[2:]
                 elif parts[0] == "apis" and len(parts) >= 3:
                     r.group, r.version = parts[1], parts[2]
                     rest = parts[3:]
@@ -496,6 +504,10 @@ class APIServer:
                             r.subresource = rest[2]
                         else:
                             r.resource = None
+                if (parts[0] == "api" and r.resource
+                        and r.version not in (None, corever.HUB)
+                        and not corever.handles(r.resource, r.version)):
+                    r.resource = None  # resource not served at this version
                 return r
 
             # ---- shared filters ----
@@ -670,6 +682,9 @@ class APIServer:
                             items = server.crds.convert_many(
                                 r.resource, items,
                                 self._custom_version(r))
+                        elif self._core_target(r) is not None:
+                            items = corever.convert_many(
+                                r.resource, items, self._core_target(r))
                         self._audit(r, "list", 200)
                         self._send_json(200, {
                             "kind": "List", "apiVersion": "v1",
@@ -696,6 +711,10 @@ class APIServer:
                 elif path == "/api/v1":
                     doc = disc.core_resource_list(CLUSTER_SCOPED,
                                                   SCALABLE)
+                elif (len(parts) == 2 and parts[0] == "api"
+                        and parts[1] in corever.SERVED_VERSIONS):
+                    doc = disc.core_versioned_resource_list(
+                        parts[1], CLUSTER_SCOPED)
                 elif path == "/apis":
                     doc = disc.group_list(
                         BUILTIN_GROUPS, server.crds,
@@ -751,7 +770,9 @@ class APIServer:
                                            "object": {"metadata": {}}}
                             else:
                                 obj = ev.object
-                                if r is not None and self._is_custom(r):
+                                if r is not None and (
+                                        self._is_custom(r)
+                                        or self._core_target(r)):
                                     try:
                                         obj = self._serve_custom(r, obj)
                                     except crdlib.ValidationError:
@@ -1057,6 +1078,11 @@ class APIServer:
                             422, "Invalid",
                             f"no CRD for resource {r.resource!r}"))
                         return None
+                    tv = self._core_target(r)
+                    if tv is not None:
+                        # versioned core write: default in the request
+                        # version, then convert to the v1 hub for storage
+                        return corever.to_storage(r.resource, obj, tv)
                     return obj
                 try:
                     obj = server.crds.coerce(r.resource,
@@ -1067,12 +1093,25 @@ class APIServer:
                     self._send_json(422, status_error(422, "Invalid", str(e)))
                     return None
 
+            def _core_target(self, r: _Route) -> str | None:
+                """The non-hub core serving version for this request, or
+                None (hub/v1 requests and grouped paths pass through)."""
+                if (r.group is None and r.resource
+                        and r.version not in (None, corever.HUB)
+                        and corever.handles(r.resource, r.version)):
+                    return r.version
+                return None
+
             def _serve_custom(self, r: _Route, obj: dict) -> dict:
-                """Convert a stored custom object to the requested
-                serving version on the way out."""
+                """Convert a stored object to the requested serving
+                version on the way out (CRDs via the CRD converter, core
+                resources via api/core_versions — the same seam)."""
                 if self._is_custom(r):
                     return server.crds.convert(r.resource, obj,
                                                self._custom_version(r))
+                tv = self._core_target(r)
+                if tv is not None:
+                    return corever.convert(r.resource, obj, tv)
                 return obj
 
             def do_POST(self):
@@ -1306,6 +1345,12 @@ class APIServer:
                         admitted, err = self._admit_quiet(
                             adm.CREATE, r, obj,
                             namespace=md.get("namespace", ""))
+                        core_tv = self._core_target(r)
+                        if admitted is not None and core_tv is not None:
+                            # versioned core items store in hub form,
+                            # same as the singular POST path
+                            admitted = corever.to_storage(
+                                r.resource, admitted, core_tv)
                         if admitted is not None and custom:
                             # same prune/default/validate/CEL + storage-
                             # version conversion the singular path runs
@@ -1471,6 +1516,18 @@ class APIServer:
                                     r.resource, version, candidate, cur)
                                 return server.crds.to_storage(
                                     r.resource, candidate)
+                            tv = self._core_target(r)
+                            if tv is not None:
+                                # status arrives in the request-version
+                                # shape: convert ONLY the status stanza to
+                                # hub form and splice it in — a full
+                                # convert/default round trip would mutate
+                                # .spec from a status endpoint
+                                hub_status = corever.to_storage(
+                                    r.resource, {"status": new_status},
+                                    tv, default=False).get("status")
+                                cur["status"] = hub_status
+                                return cur
                             cur["status"] = new_status
                             return cur
                         try:
@@ -1592,12 +1649,19 @@ class APIServer:
                     return
                 try:
                     def apply(cur):
+                        core_tv = self._core_target(r)
+                        hub_cur = cur
                         if self._is_custom(r):
                             # patch against the REQUEST-version shape:
                             # patching the storage form and pruning with
                             # the request schema silently drops fields
                             cur = server.crds.convert(
                                 r.resource, cur, self._custom_version(r))
+                        elif core_tv is not None:
+                            # no defaulting: injected defaults on the
+                            # patch base would persist as if user-written
+                            cur = corever.convert(r.resource, cur,
+                                                  core_tv, default=False)
                         patched = patchlib.apply_patch(ctype, cur, body)
                         if r.subresource == "status":
                             # status patch may only change .status
@@ -1623,6 +1687,18 @@ class APIServer:
                                 patched, cur)
                             patched = server.crds.to_storage(r.resource,
                                                              patched)
+                        elif core_tv is not None:
+                            patched = corever.to_storage(
+                                r.resource, patched, core_tv,
+                                # spec patches get write-time defaulting;
+                                # status patches must not touch spec at
+                                # all — splice status onto the hub base
+                                default=r.subresource != "status")
+                            if r.subresource == "status":
+                                patched = dict(
+                                    hub_cur,
+                                    status=patched.get("status"),
+                                    metadata=patched.get("metadata"))
                         if r.resource == crdlib.CRDS:
                             patched = server.crds.establish(patched,
                                                             dry_run=True)
@@ -1698,11 +1774,15 @@ class APIServer:
                             return
 
                     def merge(cur):
+                        core_tv = self._core_target(r)
                         if self._is_custom(r):
                             # merge in the request-version shape (see
                             # the PATCH closure's rationale)
                             cur = server.crds.convert(
                                 r.resource, cur, self._custom_version(r))
+                        elif core_tv is not None:
+                            cur = corever.convert(r.resource, cur,
+                                                  core_tv, default=False)
                         new = mflib.apply_merge(cur, applied, manager,
                                                 force=force)
                         new["metadata"]["resourceVersion"] = \
@@ -1716,6 +1796,10 @@ class APIServer:
                                 r.resource, self._custom_version(r),
                                 new, cur)
                             new = server.crds.to_storage(r.resource, new)
+                        elif core_tv is not None:
+                            new = corever.to_storage(r.resource, new,
+                                                     core_tv,
+                                                     default=False)
                         if r.resource == crdlib.CRDS:
                             new = server.crds.establish(new, dry_run=True)
                         return new

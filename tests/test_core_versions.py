@@ -1,0 +1,256 @@
+"""Versioned CORE API serving: pods at v1 (hub/storage) + v2alpha1
+through the same conversion seam CRDs use.
+
+Reference anchors: pkg/apis/core/v1/conversion.go + defaults.go (the
+hub-and-spoke conversion that makes versioned evolution possible),
+apimachinery/pkg/runtime/scheme.go (convert-on-serve), and the CRD
+multi-version serving behavior in apiextensions-apiserver.
+"""
+
+import random
+import string
+
+import pytest
+
+from kubernetes_tpu.api import core_versions as corever
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.apiserver import APIServer
+from kubernetes_tpu.client.http_client import HTTPClient, HTTPWatch
+from kubernetes_tpu.store import kv
+
+
+@pytest.fixture()
+def server():
+    store = kv.MemoryStore(history=10_000)
+    srv = APIServer(store).start()
+    http = HTTPClient.from_url(srv.url)
+    yield http, store
+    srv.stop()
+
+
+def v1_pod(name, **spec_extra):
+    pod = meta.new_object("Pod", name, "default")
+    pod["spec"] = {"containers": [{"name": "c", "image": "i"}],
+                   "schedulerName": "default-scheduler",
+                   "priority": 7, **spec_extra}
+    return pod
+
+
+class TestConversionFunctions:
+    def test_v1_to_v2_regroups_scheduling(self):
+        pod = v1_pod("a", priorityClassName="high")
+        out = corever.convert("pods", pod, "v2alpha1")
+        assert out["apiVersion"] == "v2alpha1"
+        sched = out["spec"]["scheduling"]
+        assert sched == {"schedulerName": "default-scheduler",
+                         "priority": 7, "priorityClassName": "high"}
+        assert "schedulerName" not in out["spec"]
+        assert "priority" not in out["spec"]
+        # input not mutated (pure conversion)
+        assert pod["spec"]["priority"] == 7
+
+    def test_round_trip_identity(self):
+        pod = v1_pod("b", preemptionPolicy="Never")
+        pod["status"] = {"phase": "Pending", "nominatedNodeName": "n1"}
+        back = corever.to_storage(
+            "pods", corever.convert("pods", pod, "v2alpha1"), "v2alpha1")
+        assert back["spec"] == pod["spec"]
+        assert back["status"] == pod["status"]
+
+    def test_unknown_fields_survive_both_directions(self):
+        pod = v1_pod("c")
+        pod["spec"]["futureField"] = {"x": 1}
+        v2 = corever.convert("pods", pod, "v2alpha1")
+        assert v2["spec"]["futureField"] == {"x": 1}
+        v2["spec"]["scheduling"]["futureKnob"] = "y"
+        v1 = corever.to_storage("pods", v2, "v2alpha1")
+        assert v1["spec"]["scheduling"] == {"futureKnob": "y"}
+        # and it survives ANOTHER trip out
+        v2b = corever.convert("pods", v1, "v2alpha1")
+        assert v2b["spec"]["scheduling"]["futureKnob"] == "y"
+
+    def test_v2_defaulting_fills_scheduler_name(self):
+        v2 = {"apiVersion": "v2alpha1", "kind": "Pod",
+              "metadata": {"name": "d", "namespace": "default"},
+              "spec": {"containers": []}}
+        stored = corever.to_storage("pods", v2, "v2alpha1")
+        assert stored["spec"]["schedulerName"] == "default-scheduler"
+
+    def test_fuzz_round_trip(self):
+        """Arbitrary pods with random subsets of the moved fields and
+        random extra fields round-trip exactly (v1 -> v2 -> v1)."""
+        rng = random.Random(42)
+        moved = ["schedulerName", "priority", "priorityClassName",
+                 "preemptionPolicy"]
+        for trial in range(200):
+            pod = meta.new_object("Pod", f"f{trial}", "default")
+            spec = {"containers": [{"name": "c"}]}
+            for f in moved:
+                if rng.random() < 0.5:
+                    spec[f] = rng.choice([0, 5, "x", "default-scheduler"])
+            for _ in range(rng.randrange(3)):
+                k = "".join(rng.choices(string.ascii_lowercase, k=6))
+                spec[k] = rng.choice([1, "v", {"n": True}, [1, 2]])
+            pod["spec"] = spec
+            if rng.random() < 0.5:
+                pod["status"] = {"phase": "Pending"}
+                if rng.random() < 0.5:
+                    pod["status"]["nominatedNodeName"] = "n"
+            snap = meta.deep_copy(pod)
+            back = corever.to_storage(
+                "pods", corever.convert("pods", pod, "v2alpha1"),
+                "v2alpha1")
+            # defaulting may ADD schedulerName; remove it for comparison
+            # when the original lacked it
+            if "schedulerName" not in snap["spec"]:
+                back["spec"].pop("schedulerName", None)
+            assert back["spec"] == snap["spec"], trial
+            assert back.get("status") == snap.get("status"), trial
+            assert pod == snap, f"input mutated in trial {trial}"
+
+
+class TestServedVersions:
+    def test_discovery_lists_both_versions(self, server):
+        http, _ = server
+        doc = http._request("GET", "/api")
+        assert set(doc["versions"]) == {"v1", "v2alpha1"}
+        rl = http._request("GET", "/api/v2alpha1")
+        names = [e["name"] for e in rl["resources"]]
+        assert "pods" in names
+        assert "pods/status" in names  # served subresources advertised
+        assert not any(n.split("/")[0] == "nodes" for n in names)
+
+    def test_create_at_v2_read_at_v1(self, server):
+        http, store = server
+        v2 = {"apiVersion": "v2alpha1", "kind": "Pod",
+              "metadata": {"name": "cv2", "namespace": "default"},
+              "spec": {"containers": [{"name": "c"}],
+                       "scheduling": {"priority": 9}}}
+        created = http._request(
+            "POST", "/api/v2alpha1/namespaces/default/pods", v2)
+        # response comes back in the REQUEST version
+        assert created["spec"]["scheduling"]["priority"] == 9
+        # stored (and v1-served) in hub form
+        stored = store.get("pods", "default", "cv2")
+        assert stored["spec"]["priority"] == 9
+        assert "scheduling" not in stored["spec"]
+        v1 = http.get("pods", "default", "cv2")
+        assert v1["spec"]["priority"] == 9
+
+    def test_create_at_v1_read_at_v2(self, server):
+        http, _ = server
+        http.create("pods", v1_pod("cv1"))
+        got = http._request(
+            "GET", "/api/v2alpha1/namespaces/default/pods/cv1")
+        assert got["apiVersion"] == "v2alpha1"
+        assert got["spec"]["scheduling"]["priority"] == 7
+        assert "priority" not in got["spec"]
+
+    def test_list_converts(self, server):
+        http, _ = server
+        http.create("pods", v1_pod("l1"))
+        http.create("pods", v1_pod("l2"))
+        lst = http._request("GET", "/api/v2alpha1/namespaces/default/pods")
+        assert len(lst["items"]) == 2
+        for item in lst["items"]:
+            assert "scheduling" in item["spec"]
+            assert "priority" not in item["spec"]
+
+    def test_watch_events_convert(self, server):
+        http, store = server
+        w = HTTPWatch(http.host, http.port,
+                      "/api/v2alpha1/namespaces/default/pods?watch=true",
+                      http._headers)
+        store.create("pods", v1_pod("wv2"))
+        ev = w.next(timeout=5.0)
+        assert ev is not None
+        assert ev.object["apiVersion"] == "v2alpha1"
+        assert ev.object["spec"]["scheduling"]["priority"] == 7
+        w.stop()
+
+    def test_patch_at_v2_against_v2_shape(self, server):
+        http, store = server
+        http.create("pods", v1_pod("pv2"))
+        http._request(
+            "PATCH", "/api/v2alpha1/namespaces/default/pods/pv2",
+            {"spec": {"scheduling": {"priority": 42}}},
+            content_type="application/strategic-merge-patch+json")
+        stored = store.get("pods", "default", "pv2")
+        assert stored["spec"]["priority"] == 42
+        assert stored["spec"]["schedulerName"] == "default-scheduler"
+
+    def test_status_put_at_v2(self, server):
+        http, store = server
+        http.create("pods", v1_pod("sv2"))
+        got = http._request(
+            "GET", "/api/v2alpha1/namespaces/default/pods/sv2")
+        got["status"] = {"phase": "Running",
+                        "scheduling": {"nominatedNodeName": "nom"}}
+        http._request(
+            "PUT", "/api/v2alpha1/namespaces/default/pods/sv2/status",
+            got)
+        stored = store.get("pods", "default", "sv2")
+        assert stored["status"]["nominatedNodeName"] == "nom"
+        assert "scheduling" not in stored["status"]
+
+    def test_status_write_does_not_touch_spec(self, server):
+        """A v1 pod with NO schedulerName: a v2 status write must not
+        smuggle the v2 default into spec (status endpoints only move
+        .status)."""
+        http, store = server
+        pod = meta.new_object("Pod", "nospec", "default")
+        pod["spec"] = {"containers": [{"name": "c"}]}
+        http.create("pods", pod)
+        http._request(
+            "PUT", "/api/v2alpha1/namespaces/default/pods/nospec/status",
+            {"status": {"phase": "Running"}})
+        stored = store.get("pods", "default", "nospec")
+        assert stored["status"]["phase"] == "Running"
+        assert "schedulerName" not in stored["spec"]
+        # status PATCH at v2: same invariant
+        http._request(
+            "PATCH",
+            "/api/v2alpha1/namespaces/default/pods/nospec/status",
+            {"status": {"scheduling": {"nominatedNodeName": "n9"}}},
+            content_type="application/strategic-merge-patch+json")
+        stored = store.get("pods", "default", "nospec")
+        assert stored["status"]["nominatedNodeName"] == "n9"
+        assert "schedulerName" not in stored["spec"]
+
+    def test_ssa_apply_at_v2_stores_hub_form(self, server):
+        http, store = server
+        http.create("pods", v1_pod("ssa2"))
+        body = {"apiVersion": "v2alpha1", "kind": "Pod",
+                "metadata": {"name": "ssa2", "namespace": "default"},
+                "spec": {"scheduling": {"priorityClassName": "crit"}}}
+        http._request(
+            "PATCH", "/api/v2alpha1/namespaces/default/pods/ssa2"
+            "?fieldManager=tester&force=true", body,
+            content_type="application/apply-patch+yaml")
+        stored = store.get("pods", "default", "ssa2")
+        assert stored["spec"].get("priorityClassName") == "crit"
+        assert "scheduling" not in stored["spec"]  # hub form, not mixed
+
+    def test_bulk_create_at_v2_stores_hub_form(self, server):
+        http, store = server
+        resp = http._request(
+            "POST", "/api/v2alpha1/namespaces/default/pods",
+            {"kind": "List", "apiVersion": "v2alpha1", "items": [
+                {"metadata": {"name": "blk2"},
+                 "spec": {"containers": [{"name": "c"}],
+                          "scheduling": {"priority": 5}}}]})
+        assert resp["items"][0]["status"] == "Success"
+        stored = store.get("pods", "default", "blk2")
+        assert stored["spec"]["priority"] == 5
+        assert "scheduling" not in stored["spec"]
+
+    def test_unknown_version_404(self, server):
+        http, _ = server
+        from kubernetes_tpu.client.http_client import HTTPError
+        with pytest.raises((kv.NotFoundError, HTTPError)):
+            http._request("GET", "/api/v9/namespaces/default/pods")
+
+    def test_unversioned_resource_404_at_v2(self, server):
+        http, _ = server
+        with pytest.raises(kv.NotFoundError):
+            http._request("GET", "/api/v2alpha1/nodes")

@@ -52,7 +52,8 @@ class TestDiscovery:
     def test_api_versions(self, server):
         srv, _ = server
         doc = fetch(srv, "/api")
-        assert doc == {"kind": "APIVersions", "versions": ["v1"]}
+        assert doc["kind"] == "APIVersions"
+        assert "v1" in doc["versions"]  # hub; extra served versions OK
 
     def test_core_resources(self, server):
         srv, _ = server
