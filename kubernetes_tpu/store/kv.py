@@ -176,6 +176,7 @@ class MemoryStore:
         # etcd compaction semantics).
         self._floor = 0
         self._wal = None
+        self._repl = None  # replication hub (replica.ReplicationHub)
         self._compact_every = compact_every
         self._snapshot_thread: threading.Thread | None = None
         if durable_dir is not None:
@@ -230,6 +231,33 @@ class MemoryStore:
         if isinstance(obj_or_ns, dict):
             return meta.namespaced_name(obj_or_ns)
         return f"{obj_or_ns}/{nm}" if obj_or_ns else (nm or "")
+
+    @property
+    def _logging(self) -> bool:
+        """Should mutation sites build commit records?"""
+        return self._wal is not None or self._repl is not None
+
+    def _commit(self, recs: list[tuple]) -> None:
+        """Route committed mutation records (op, rev, resource, key, obj)
+        to the WAL and any attached replication hub, under the store
+        lock.  DELETE records carry the tombstone for the hub (follower
+        watches need it); the WAL stores only the key.  Tombstones of
+        encrypted-at-rest resources are stripped to metadata before they
+        leave the process: PUTs ship sealed, and a plaintext delete tomb
+        would defeat the envelope exactly once per object."""
+        if self._wal is not None:
+            self._wal.append_many(
+                [r if r[0] == wal_mod.PUT else r[:4] for r in recs])
+            self._maybe_compact()
+        if self._repl is not None:
+            if self._transformers:
+                recs = [
+                    r if (r[0] == wal_mod.PUT
+                          or r[2] not in self._transformers
+                          or len(r) < 5 or r[4] is None)
+                    else (*r[:4], {"metadata": dict(r[4]["metadata"])})
+                    for r in recs]
+            self._repl.ship(recs)
 
     def _maybe_compact(self) -> None:
         """Kick off a snapshot once the log holds enough records that a
@@ -302,9 +330,9 @@ class MemoryStore:
             meta.set_resource_version(obj, self._rev)
             sealed = self._seal(resource, obj)
             table[key] = sealed
-            if self._wal is not None:
-                self._wal.append_put(self._rev, resource, key, sealed)
-                self._maybe_compact()
+            if self._logging:
+                self._commit([(wal_mod.PUT, self._rev, resource, key,
+                               sealed)])
             self._emit(resource, ADDED, obj)
             return obj
 
@@ -325,6 +353,7 @@ class MemoryStore:
         now = time.time()  # one clock read per burst (finalize semantics)
         transform = self._transformers.get(resource)
         with self._lock:
+            logging_on = self._logging  # invariant while the lock is held
             table = self._table(resource)
             rev = self._rev
             for obj in objs:
@@ -347,14 +376,13 @@ class MemoryStore:
                 sealed = (transform.encrypt_obj(obj)
                           if transform is not None else obj)
                 table[key] = sealed
-                if self._wal is not None:
+                if logging_on:
                     recs.append((wal_mod.PUT, rev, resource, key, sealed))
                 evs.append(WatchEvent(ADDED, obj, rev))
                 out.append((obj, None))
             self._rev = rev
             if recs:
-                self._wal.append_many(recs)
-                self._maybe_compact()
+                self._commit(recs)
             self._emit_many(resource, evs)
         return out
 
@@ -388,16 +416,16 @@ class MemoryStore:
             if (obj["metadata"].get("deletionTimestamp")
                     and not obj["metadata"].get("finalizers")):
                 del table[key]
-                if self._wal is not None:
-                    self._wal.append_delete(self._rev, resource, key)
-                    self._maybe_compact()
+                if self._logging:
+                    self._commit([(wal_mod.DELETE, self._rev, resource,
+                                   key, obj)])
                 self._emit(resource, DELETED, obj)
                 return obj
             sealed = self._seal(resource, obj)
             table[key] = sealed
-            if self._wal is not None:
-                self._wal.append_put(self._rev, resource, key, sealed)
-                self._maybe_compact()
+            if self._logging:
+                self._commit([(wal_mod.PUT, self._rev, resource, key,
+                               sealed)])
             self._emit(resource, MODIFIED, obj)
             return obj
 
@@ -437,21 +465,21 @@ class MemoryStore:
                 meta.set_resource_version(marked, self._rev)
                 sealed = self._seal(resource, marked)
                 table[key] = sealed
-                if self._wal is not None:
-                    self._wal.append_put(self._rev, resource, key, sealed)
-                    self._maybe_compact()
+                if self._logging:
+                    self._commit([(wal_mod.PUT, self._rev, resource, key,
+                                   sealed)])
                 self._emit(resource, MODIFIED, marked)
                 return marked
             del table[key]
             self._rev += 1
-            if self._wal is not None:
-                self._wal.append_delete(self._rev, resource, key)
-                self._maybe_compact()
             # tombstone: shallow copy with fresh metadata (readers may still
             # hold the stored object; never mutate it in place)
             tomb = dict(self._open(resource, cur))
             tomb["metadata"] = dict(cur["metadata"])
             meta.set_resource_version(tomb, self._rev)
+            if self._logging:
+                self._commit([(wal_mod.DELETE, self._rev, resource, key,
+                               tomb)])
             self._emit(resource, DELETED, tomb)
             return tomb
 
@@ -472,6 +500,7 @@ class MemoryStore:
         recs: list[tuple] = []
         transform = self._transformers.get(resource)
         with self._lock:
+            logging_on = self._logging  # invariant while the lock is held
             table = self._table(resource)
             rev = self._rev
             for ns, nm, node in bindings:
@@ -506,14 +535,13 @@ class MemoryStore:
                 sealed = (transform.encrypt_obj(obj)
                           if transform is not None else obj)
                 table[key] = sealed
-                if self._wal is not None:
+                if logging_on:
                     recs.append((wal_mod.PUT, rev, resource, key, sealed))
                 evs.append(WatchEvent(MODIFIED, obj, rev))
                 out.append((obj, None))
             self._rev = rev
             if recs:
-                self._wal.append_many(recs)
-                self._maybe_compact()
+                self._commit(recs)
             self._emit_many(resource, evs)
         return out
 

@@ -1,0 +1,401 @@
+"""WAL-shipping store replication: a follower that serves reads/watches
+and can be promoted when the primary dies.
+
+Reference: the reference's storage.Interface lands on a raft-replicated
+etcd quorum (staging/.../storage/etcd3/store.go; etcd's raft log), so a
+member loss never loses committed writes and watches survive failover.
+This is the single-follower equivalent for the single-writer store
+(store/kv.py): every committed mutation record (the same tuples the WAL
+appends) is SHIPPED to connected followers; in sync mode (default) the
+primary's commit blocks until the follower acknowledges the record's
+revision, so an acknowledged client write is on at least two stores —
+kill the primary, promote the follower, and informers relist against it
+with zero lost committed writes (tests/test_store_replica.py runs that
+chaos sequence).
+
+Protocol (length-prefixed JSON frames over TCP):
+  follower -> primary   {"type": "hello", "rev": <highest applied>}
+  primary  -> follower  {"type": "snapshot", "rev": N, "data": {...}}
+  primary  -> follower  {"type": "recs", "recs": [[op, rev, res, key,
+                         obj], ...]}
+  follower -> primary   {"type": "ack", "rev": N}
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+
+from . import kv
+from . import wal as wal_mod
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 512 << 20
+
+
+def _send_frame(sock: socket.socket, payload: dict) -> None:
+    data = json.dumps(payload).encode()
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> dict | None:
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (size,) = _LEN.unpack(head)
+    if size > MAX_FRAME:
+        raise OSError(f"replication frame {size} exceeds cap")
+    body = _recv_exact(sock, size)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+class _FollowerConn:
+    """Primary-side state for one connected follower."""
+
+    def __init__(self, sock: socket.socket, addr):
+        self.sock = sock
+        self.addr = addr
+        self.acked_rev = 0
+        self.lock = threading.Lock()  # serializes sends
+        self.dead = False
+
+
+class ReplicationHub:
+    """Attached to the PRIMARY store: accepts follower connections,
+    bootstraps them with a snapshot, ships commit records, and (in sync
+    mode) blocks the committing writer until the newest record is
+    acknowledged.
+
+    sync_timeout bounds how long a commit waits for a follower: a dead
+    or lagging follower degrades the primary to async shipping (logged)
+    instead of freezing the cluster — the availability/durability trade
+    etcd resolves with quorum, degraded here to primary-keeps-serving.
+    """
+
+    def __init__(self, store: kv.MemoryStore, host: str = "127.0.0.1",
+                 port: int = 0, sync: bool = True,
+                 sync_timeout: float = 2.0):
+        self.store = store
+        self.sync = sync
+        self.sync_timeout = sync_timeout
+        self._followers: list[_FollowerConn] = []
+        self._flock = threading.Lock()
+        self._ack_cond = threading.Condition(self._flock)
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(4)
+        self.address = self._listener.getsockname()
+        self._stopped = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="repl-accept", daemon=True)
+
+    def start(self) -> "ReplicationHub":
+        self.store._repl = self
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self.store._repl is self:
+            self.store._repl = None
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._flock:
+            for f in self._followers:
+                f.dead = True
+                try:
+                    f.sock.close()
+                except OSError:
+                    pass
+            self._followers.clear()
+            self._ack_cond.notify_all()
+
+    @property
+    def follower_count(self) -> int:
+        with self._flock:
+            return len(self._followers)
+
+    # -- primary side -----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_follower,
+                             args=(sock, addr), daemon=True,
+                             name="repl-follower").start()
+
+    def _serve_follower(self, sock: socket.socket, addr) -> None:
+        conn = _FollowerConn(sock, addr)
+        try:
+            hello = _recv_frame(sock)
+            if not hello or hello.get("type") != "hello":
+                sock.close()
+                return
+            # Registration and the snapshot send happen under conn.lock:
+            # a commit racing the bootstrap blocks in ship() on that lock
+            # until the snapshot frame is fully on the wire, so the
+            # stream can neither interleave bytes mid-frame nor deliver
+            # 'recs' before 'snapshot'.  The image itself is captured
+            # under the store lock (consistent at one revision), and the
+            # follower registers before that lock drops, so nothing
+            # committed after the image can be missed.
+            with conn.lock:
+                with self.store._lock:
+                    image = {res: dict(tbl)
+                             for res, tbl in self.store._data.items()}
+                    rev = self.store._rev
+                    with self._flock:
+                        self._followers.append(conn)
+                _send_frame(sock, {"type": "snapshot", "rev": rev,
+                                   "data": image})
+            conn.acked_rev = rev
+        except OSError:
+            self._drop(conn)
+            return
+        # ack reader loop
+        try:
+            while not conn.dead:
+                try:
+                    frame = _recv_frame(sock)
+                except TimeoutError:
+                    # a concurrent ship() temporarily put a send timeout
+                    # on the shared socket; ack frames are single-write
+                    # tiny, so a quiet-stream timeout is retryable
+                    continue
+                if frame is None:
+                    break
+                if frame.get("type") == "ack":
+                    with self._flock:
+                        conn.acked_rev = max(conn.acked_rev,
+                                             int(frame.get("rev", 0)))
+                        self._ack_cond.notify_all()
+        except OSError:
+            pass
+        finally:
+            self._drop(conn)
+
+    def _drop(self, conn: _FollowerConn) -> None:
+        conn.dead = True
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        with self._flock:
+            if conn in self._followers:
+                self._followers.remove(conn)
+                logger.warning("replication follower %s dropped",
+                               conn.addr)
+            self._ack_cond.notify_all()
+
+    def ship(self, recs: list[tuple]) -> None:
+        """Called by the store under ITS lock for every commit.  Sends
+        the records to every follower; in sync mode, waits until some
+        follower acknowledges the newest revision (or the timeout
+        passes — degraded async, logged)."""
+        with self._flock:
+            followers = list(self._followers)
+        if not followers:
+            return
+        top_rev = max(r[1] for r in recs)
+        payload = {"type": "recs", "recs": [list(r) for r in recs]}
+        for f in followers:
+            try:
+                with f.lock:
+                    # bound the SEND too: a stalled (SIGSTOPped) follower
+                    # fills its TCP window and an untimed sendall would
+                    # freeze the whole store under its lock.  The ack
+                    # reader tolerates the transient recv timeout this
+                    # may impose (frames are tiny/atomic in practice).
+                    f.sock.settimeout(self.sync_timeout)
+                    try:
+                        _send_frame(f.sock, payload)
+                    finally:
+                        try:
+                            f.sock.settimeout(None)
+                        except OSError:
+                            pass
+            except OSError:
+                self._drop(f)
+        if not self.sync:
+            return
+        import time
+        deadline = time.monotonic() + self.sync_timeout
+        with self._flock:
+            while not self._stopped:
+                live = [f for f in self._followers if not f.dead]
+                if not live:
+                    return  # no follower left: primary-only, keep serving
+                if any(f.acked_rev >= top_rev for f in live):
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    logger.warning(
+                        "replication sync ack timed out at rev %d; "
+                        "degrading this commit to async", top_rev)
+                    return
+                self._ack_cond.wait(remaining)
+
+
+class FollowerStore(kv.MemoryStore):
+    """A read-only replica fed by a ReplicationHub stream.
+
+    Serves get/list/watch like any MemoryStore (informers point at it
+    via LocalClient or an APIServer); every write verb raises until
+    promote() flips it into a writable primary that continues from the
+    last applied revision.  A promoted follower can carry its own WAL
+    (durable_dir) and its own ReplicationHub — the next follower in the
+    chain."""
+
+    def __init__(self, history: int = 100_000,
+                 transformers: dict | None = None,
+                 durable_dir: str | None = None):
+        super().__init__(history=history, transformers=transformers,
+                         durable_dir=durable_dir)
+        self._promoted = False
+        self._conn: socket.socket | None = None
+        self._follow_thread: threading.Thread | None = None
+        self._synced = threading.Event()
+
+    # -- write fencing ----------------------------------------------------
+
+    def _check_writable(self) -> None:
+        if not self._promoted:
+            raise kv.StoreError("store is a read-only replica "
+                                "(promote() to accept writes)")
+
+    def create(self, *a, **k):
+        self._check_writable()
+        return super().create(*a, **k)
+
+    def create_many(self, *a, **k):
+        self._check_writable()
+        return super().create_many(*a, **k)
+
+    def update(self, *a, **k):
+        self._check_writable()
+        return super().update(*a, **k)
+
+    def delete(self, *a, **k):
+        self._check_writable()
+        return super().delete(*a, **k)
+
+    def bind_many(self, *a, **k):
+        self._check_writable()
+        return super().bind_many(*a, **k)
+
+    def guaranteed_update(self, *a, **k):
+        self._check_writable()
+        return super().guaranteed_update(*a, **k)
+
+    # -- following --------------------------------------------------------
+
+    def follow(self, host: str, port: int,
+               timeout: float = 10.0) -> "FollowerStore":
+        """Connect to the primary's ReplicationHub and start applying
+        its stream; returns once the bootstrap snapshot is installed."""
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._conn = sock
+        _send_frame(sock, {"type": "hello", "rev": self._rev})
+        snap = _recv_frame(sock)
+        if not snap or snap.get("type") != "snapshot":
+            raise kv.StoreError("replication bootstrap failed")
+        with self._lock:
+            self._data = {res: dict(tbl)
+                          for res, tbl in (snap.get("data") or {}).items()}
+            self._rev = int(snap.get("rev", 0))
+            self._floor = self._rev  # pre-snapshot revisions unobservable
+        sock.settimeout(None)
+        self._synced.set()
+        self._follow_thread = threading.Thread(
+            target=self._follow_loop, name="repl-follow", daemon=True)
+        self._follow_thread.start()
+        return self
+
+    def _follow_loop(self) -> None:
+        sock = self._conn
+        try:
+            while not self._promoted:
+                frame = _recv_frame(sock)
+                if frame is None:
+                    logger.warning("replication stream closed by primary")
+                    return
+                if frame.get("type") != "recs":
+                    continue
+                recs = frame.get("recs") or []
+                self._apply_records(recs)
+                top = max((int(r[1]) for r in recs), default=0)
+                if top:
+                    _send_frame(sock, {"type": "ack", "rev": top})
+        except OSError as e:
+            if not self._promoted:
+                logger.warning("replication stream error: %s", e)
+
+    def _apply_records(self, recs: list) -> None:
+        """Replay shipped commit records: table writes + watch emission,
+        exactly the primary's commit effects (objects arrive sealed; the
+        watch ring serves opened plaintext like the primary's).  The
+        records also re-enter _commit, so a follower with its own WAL
+        persists them and a chained downstream follower receives them."""
+        with self._lock:
+            for rec in recs:
+                op, rev, resource, key = rec[0], int(rec[1]), rec[2], rec[3]
+                obj = rec[4] if len(rec) > 4 else None
+                table = self._table(resource)
+                self._rev = max(self._rev, rev)
+                if op == wal_mod.PUT:
+                    existed = key in table
+                    table[key] = obj
+                    self._emit(resource,
+                               kv.MODIFIED if existed else kv.ADDED,
+                               self._open(resource, obj))
+                else:  # DELETE; obj is the tombstone (may be None from
+                    table.pop(key, None)       # an old-format primary)
+                    tomb = obj or {"metadata": {
+                        "name": key.rpartition("/")[2],
+                        "namespace": key.rpartition("/")[0],
+                        "resourceVersion": rev}}
+                    self._emit(resource, kv.DELETED, tomb)
+            if self._logging:
+                self._commit([tuple(r) for r in recs])
+
+    # -- promotion --------------------------------------------------------
+
+    def promote(self) -> "FollowerStore":
+        """Become the writable primary: stop following, accept writes,
+        continue the revision sequence from the last applied record.
+        Watches opened against this store stay attached; informers of
+        clients that re-point here relist and resume."""
+        self._promoted = True
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+        logger.warning("follower promoted to primary at rev %d", self._rev)
+        return self
