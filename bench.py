@@ -75,6 +75,12 @@ EXTRA_CONFIGS = {
     "SchedulingPodAntiAffinity": {"workload": "SchedulingPodAntiAffinity",
                                   "batch": 4096, "depth": 2,
                                   "timeout": 900.0},
+    # 2000 DISTINCT per-service anti-affinity selectors through a few
+    # dozen hash-shared tensor slots (flatten.GroupBucket); the result's
+    # escape_rate reports the escaped-to-oracle fraction (target <5%)
+    "SchedulingHighCardinality": {"workload": "SchedulingHighCardinality",
+                                  "batch": 4096, "depth": 2,
+                                  "timeout": 900.0},
     "TopologySpreading": {"workload": "TopologySpreading", "batch": 4096,
                           "depth": 2, "timeout": 900.0},
     "CoschedulingGang": {"workload": "CoschedulingGang", "batch": 4096,
@@ -134,6 +140,9 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
     if e2e:
         detail["pod_e2e_p50_ms"] = e2e.get("p50_ms")
         detail["pod_e2e_p99_ms"] = e2e.get("p99_ms")
+    if "escape_rate" in stats:
+        # escaped-to-oracle fraction (tensor-path coverage; target <5%)
+        detail["escape_rate"] = stats["escape_rate"]
     return {"value": summary.average, "wall_s": round(wall, 1),
             "detail": detail}
 

@@ -135,12 +135,19 @@ FLUSH_FIRST = object()
 
 
 def decode_results(assignments, n: int, batch_size: int, escapes: set,
-                   row_infos: list, no_fit_msg: str
+                   row_infos: list, no_fit_msg: str,
+                   nofit_escapes: set | None = None
                    ) -> list[tuple[str | None, Status | None]]:
     """Shared assignment decode (single-chip + sharded backends): map each
     pod slot to (node_name, status).  `row_infos` is the node_infos list
     CAPTURED AT DISPATCH — a later dispatch may recycle rows, so names must
-    resolve against the batch's own view."""
+    resolve against the batch's own view.
+
+    `nofit_escapes`: pods whose constraints rode COLLIDED (shared)
+    selector-group buckets — for them a no-fit verdict is an upper-bound
+    artifact, so they go to the per-pod oracle instead of
+    UNSCHEDULABLE.  A placement is always sound; only no-fit needs the
+    re-proof (flatten.GroupBucket)."""
     rows = np.asarray(assignments).tolist()  # ONE bulk convert, not
     # int(arr[i]) per pod (np scalar indexing costs ~0.5µs each)
     results: list[tuple[str | None, Status | None]] = []
@@ -149,6 +156,11 @@ def decode_results(assignments, n: int, batch_size: int, escapes: set,
             results.append((None, Status(SKIP, "escape to per-pod path")))
             continue
         row = rows[i]
+        if row < 0 and nofit_escapes and i in nofit_escapes:
+            results.append((None, Status(
+                SKIP, "no-fit under shared constraint buckets; "
+                      "per-pod re-proof")))
+            continue
         if row < 0:
             results.append((None, Status(UNSCHEDULABLE, no_fit_msg)))
             continue
@@ -164,6 +176,17 @@ def decode_results(assignments, n: int, batch_size: int, escapes: set,
         else:
             results.append((ni.name, None))
     return results
+
+
+def record_batch_stats(stats: dict, lock, results, n: int) -> None:
+    """Escape accounting shared by the single-chip and sharded backends:
+    pods seen / pods skipped to the per-pod oracle (encoder escapes +
+    collided-bucket no-fit re-proofs) — the coverage metric the
+    high-cardinality bench reports."""
+    esc = sum(1 for _nm, s in results if s is not None and s.is_skip())
+    with lock:
+        stats["pods"] = stats.get("pods", 0) + n
+        stats["escaped"] = stats.get("escaped", 0) + esc
 
 
 class ResidentHostMirror:
@@ -627,9 +650,12 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     self._unresolved.remove(holder)
                 except ValueError:  # pragma: no cover - double resolve
                     pass
-            return decode_results(assignments, n, self.batch_size,
-                                  set(batch.escape), row_infos,
-                                  "no feasible node (TPU batch filter)")
+            out = decode_results(assignments, n, self.batch_size,
+                                 set(batch.escape), row_infos,
+                                 "no feasible node (TPU batch filter)",
+                                 nofit_escapes=set(batch.nofit_oracle))
+            record_batch_stats(self.stats, self._lock, out, n)
+            return out
 
         return resolve
 

@@ -107,6 +107,62 @@ class SelectorGroup:
                 and self.selector.matches(pi.labels))
 
 
+class GroupBucket:
+    """One sg/asg tensor slot, possibly shared by several DISTINCT
+    selector groups (hash-bucketed once the cap is full — the
+    high-label-cardinality regime: thousands of per-service
+    anti-affinity selectors vs a few dozen tensor slots).
+
+    A shared bucket's counts are the UNION over its member groups —
+    an UPPER BOUND on any single member's true count.  Sharing is only
+    sound for constraints that treat counts as BLOCKERS (required
+    anti-affinity; preferred terms, where inflation merely distorts a
+    score): over-counts then only over-block, so a device-allowed
+    placement is always truly legal, and a no-fit verdict for a pod
+    riding a collided bucket escapes to the per-pod oracle.  Required
+    AFFINITY and DoNotSchedule spread treat counts as ENABLERS — a
+    union count could falsely satisfy them — so those constraints only
+    ever use EXCLUSIVE (single-group) slots and keep the old
+    full-registry escape behavior (allow_share gating in
+    register_sg).  Wrong answers stay structurally impossible; only
+    the escape rate varies with cardinality (backend stats)."""
+
+    __slots__ = ("topology_key", "groups", "allow_share")
+
+    def __init__(self, group: SelectorGroup, allow_share: bool = False):
+        self.topology_key = group.topology_key
+        self.groups = [group]
+        self.allow_share = allow_share
+
+    @property
+    def collided(self) -> bool:
+        return len(self.groups) > 1
+
+    def matches_pod(self, pi: PodInfo) -> bool:
+        return any(g.matches_pod(pi) for g in self.groups)
+
+
+def _stable_group_hash(group: SelectorGroup) -> int:
+    """Deterministic bucket seed (hash() is per-process randomized,
+    which would make escape sets differ run to run)."""
+    import zlib
+    reqs = tuple(sorted(
+        (r.key, r.operator, tuple(sorted(r.values or ())))
+        for r in group.selector.requirements))
+    return zlib.crc32(repr((group.topology_key, reqs,
+                            tuple(sorted(group.namespaces)))).encode())
+
+
+def _exact_kv(group: SelectorGroup) -> tuple[str, str] | None:
+    """(key, value) when the group's selector is a single exact match —
+    the dominant shape (per-service matchLabels) — else None."""
+    reqs = group.selector.requirements
+    if (len(reqs) == 1 and reqs[0].operator == IN
+            and len(reqs[0].values or ()) == 1):
+        return (reqs[0].key, reqs[0].values[0])
+    return None
+
+
 @dataclass
 class Caps:
     """Static tensor capacities. All jitted shapes derive from these."""
@@ -159,10 +215,19 @@ class ClusterTensors:
         self.port_vocab = Vocab(c.pt_cap)   # entries: (protocol, port)
         self.domain_vocabs: dict[str, Vocab] = {}  # topo key -> value vocab
 
-        self.sgs: list[SelectorGroup] = []
+        # sg/asg slots are BUCKETS: one group each until the cap fills,
+        # then distinct groups hash-share slots (see GroupBucket)
+        self.sgs: list[GroupBucket] = []
         self._sg_ids: dict = {}
-        self.asgs: list[SelectorGroup] = []
+        self.asgs: list[GroupBucket] = []
         self._asg_ids: dict = {}
+        # (key, value) -> [(idx, group)] for single-exact-kv selectors
+        # (cross-pod matching in O(pod labels), not O(groups));
+        # non-exact selectors go to the short linear-scan lists
+        self._sg_kv_index: dict = {}
+        self._sg_complex: list = []
+        self._asg_kv_index: dict = {}
+        self._asg_complex: list = []
 
         self.row_of: dict[str, int] = {}
         self.node_infos: list[NodeInfo | None] = [None] * c.n_cap
@@ -225,17 +290,64 @@ class ClusterTensors:
             vocab = self.domain_vocabs[topo_key] = Vocab(self.caps.n_cap)
         return vocab.get(value)
 
-    def register_sg(self, group: SelectorGroup) -> int | None:
+    @staticmethod
+    def _probe_bucket(buckets: list[GroupBucket],
+                      group: SelectorGroup) -> int | None:
+        """Slot for a group once the cap is full: hash start + linear
+        probe to a SHAREABLE bucket with the SAME topology key (dom
+        rows are per-topology-key, so cross-key sharing would corrupt
+        domain ids; exclusive slots serve count-as-enabler constraints
+        and must never be joined).  None when no compatible bucket
+        exists."""
+        cap = len(buckets)
+        start = _stable_group_hash(group) % cap
+        for probe in range(cap):
+            b = buckets[(start + probe) % cap]
+            if b.allow_share and b.topology_key == group.topology_key:
+                return (start + probe) % cap
+        return None
+
+    def _index_group(self, kv_index: dict, complex_list: list,
+                     idx: int, group: SelectorGroup) -> None:
+        kv = _exact_kv(group)
+        if kv is not None:
+            kv_index.setdefault(kv, []).append((idx, group))
+        else:
+            complex_list.append((idx, group))
+
+    def register_sg(self, group: SelectorGroup,
+                    shareable: bool = False) -> int | None:
         """Returns sg index, backfilling counts for all live rows.
-        None if the registry is full (escape hatch)."""
+
+        shareable=True (count-as-BLOCKER constraints: required
+        anti-affinity, preferred/score terms): beyond the cap the group
+        hash-shares a bucket (GroupBucket upper-bound semantics).
+        shareable=False (count-as-ENABLER: required affinity,
+        DoNotSchedule spread): the group needs an exclusive slot —
+        None when the registry is full OR the group already lives in a
+        shared bucket (escape hatch, exactly the pre-bucketing
+        behavior)."""
         idx = self._sg_ids.get(group.key())
         if idx is not None:
+            if not shareable:
+                if self.sgs[idx].collided:
+                    return None  # exact counts required; slot is shared
+                # pin the slot: an enabler-constraint user means no
+                # later overflow group may join it
+                self.sgs[idx].allow_share = False
             return idx
-        if len(self.sgs) >= self.caps.sg_cap:
-            return None
-        idx = len(self.sgs)
-        self.sgs.append(group)
+        if len(self.sgs) < self.caps.sg_cap:
+            idx = len(self.sgs)
+            self.sgs.append(GroupBucket(group, allow_share=shareable))
+        else:
+            if not shareable:
+                return None
+            idx = self._probe_bucket(self.sgs, group)
+            if idx is None:
+                return None
+            self.sgs[idx].groups.append(group)
         self._sg_ids[group.key()] = idx
+        self._index_group(self._sg_kv_index, self._sg_complex, idx, group)
         for row, ni in enumerate(self.node_infos):
             if ni is not None and self.valid[row]:
                 self._encode_sg_row(idx, row, ni)
@@ -248,11 +360,19 @@ class ClusterTensors:
         idx = self._asg_ids.get(group.key())
         if idx is not None:
             return idx
-        if len(self.asgs) >= self.caps.asg_cap:
-            return None
-        idx = len(self.asgs)
-        self.asgs.append(group)
+        if len(self.asgs) < self.caps.asg_cap:
+            idx = len(self.asgs)
+            # asg counts only ever BLOCK (existing-pod anti-affinity),
+            # so every asg slot is shareable
+            self.asgs.append(GroupBucket(group, allow_share=True))
+        else:
+            idx = self._probe_bucket(self.asgs, group)
+            if idx is None:
+                return None
+            self.asgs[idx].groups.append(group)
         self._asg_ids[group.key()] = idx
+        self._index_group(self._asg_kv_index, self._asg_complex, idx,
+                          group)
         for row, ni in enumerate(self.node_infos):
             if ni is not None and self.valid[row]:
                 self._encode_asg_row(idx, row, ni)
@@ -551,28 +671,34 @@ class ClusterTensors:
         self.node_gen[row] = ni.node_generation
 
     def _encode_sg_row(self, sg_idx: int, row: int, ni: NodeInfo) -> None:
-        sg = self.sgs[sg_idx]
+        bucket = self.sgs[sg_idx]
         labels = meta.labels(ni.node) if ni.node else {}
-        val = labels.get(sg.topology_key)
-        self.dom_sg[sg_idx, row] = (self.domain_id(sg.topology_key, val)
+        val = labels.get(bucket.topology_key)
+        self.dom_sg[sg_idx, row] = (self.domain_id(bucket.topology_key, val)
                                     if val is not None else -1)
+        # each pod counts ONCE if it matches ANY member (the same
+        # per-pod semantics as encode()'s inc_sg and the mirror replay —
+        # per-(pod,group) counting would diverge between full refresh
+        # and replay on shared buckets)
         self.cnt_sg[sg_idx, row] = sum(
             1 for pi in ni.pods
-            if not meta.deletion_timestamp(pi.pod) and sg.matches_pod(pi))
+            if not meta.deletion_timestamp(pi.pod)
+            and bucket.matches_pod(pi))
 
     def _encode_asg_row(self, asg_idx: int, row: int, ni: NodeInfo) -> None:
-        asg = self.asgs[asg_idx]
+        bucket = self.asgs[asg_idx]
         labels = meta.labels(ni.node) if ni.node else {}
-        val = labels.get(asg.topology_key)
-        self.dom_asg[asg_idx, row] = (self.domain_id(asg.topology_key, val)
+        val = labels.get(bucket.topology_key)
+        self.dom_asg[asg_idx, row] = (self.domain_id(bucket.topology_key,
+                                                     val)
                                       if val is not None else -1)
-        # count pods on this node carrying an anti-affinity term == this group
+        # pods on this node carrying an anti-affinity term == any member
+        ids = self._asg_ids
         n = 0
         for pi in ni.pods_with_required_anti_affinity:
             for term in pi.required_anti_affinity_terms:
-                if (term.topology_key == asg.topology_key
-                        and term.selector == asg.selector
-                        and term.namespaces == asg.namespaces):
+                if ids.get((term.topology_key, term.selector,
+                            term.namespaces)) == asg_idx:
                     n += 1
         self.cnt_asg[asg_idx, row] = n
 
@@ -647,6 +773,11 @@ class PodBatch:
     sel_forb_ids: np.ndarray = None   # i32[P, 8]
     key_ids: np.ndarray = None        # i32[P, KG, 4]
     escape: list[int] = field(default_factory=list)  # batch positions for oracle path
+    # positions whose constraints touch a COLLIDED bucket (shared sg/asg
+    # slot): a no-fit verdict for these is an upper-bound artifact, not
+    # proof — the scheduler re-proves them on the per-pod oracle instead
+    # of declaring unschedulable
+    nofit_oracle: list[int] = field(default_factory=list)
 
     _SHAPES = None  # caps-dependent; filled by shapes()
 
@@ -702,7 +833,7 @@ def slice_pod_batch(batch: "PodBatch", lo: int, hi: int,
     n = hi - lo
     fields = {}
     for f in dataclasses.fields(PodBatch):
-        if f.name in ("p_cap", "escape"):
+        if f.name in ("p_cap", "escape", "nofit_oracle"):
             continue
         arr = getattr(batch, f.name)
         if arr is None:
@@ -714,6 +845,8 @@ def slice_pod_batch(batch: "PodBatch", lo: int, hi: int,
     if fields.get("node_row") is not None:
         fields["node_row"][n:] = -1
     fields["escape"] = [e - lo for e in batch.escape if lo <= e < hi]
+    fields["nofit_oracle"] = [e - lo for e in batch.nofit_oracle
+                              if lo <= e < hi]
     return PodBatch(p_cap=p_cap, **fields)
 
 
@@ -786,25 +919,60 @@ class BatchEncoder:
                 b.p_valid[i] = True
             else:
                 b.escape.append(i)
-        # cross-pod: inc/match rows vs ALL registered groups
+        # cross-pod: inc/match rows vs the registered groups — via the
+        # exact-kv index (O(pod labels)) + the short complex-selector
+        # scan, so 2000 per-service groups don't cost 2000 matches/pod
         if t.sgs or t.asgs:
             inc_sg = b.ensure(c, "inc_sg") if t.sgs else None
             match_asg = b.ensure(c, "match_asg") if t.asgs else None
             inc_asg = b.ensure(c, "inc_asg") if t.asgs else None
+            kvi_sg, cx_sg = t._sg_kv_index, t._sg_complex
+            kvi_asg, cx_asg = t._asg_kv_index, t._asg_complex
+            asg_ids = t._asg_ids
             for i, pi in enumerate(pods):
                 if not b.p_valid[i]:
                     continue
-                for sg_idx, sg in enumerate(t.sgs):
-                    if sg.matches_pod(pi):
-                        inc_sg[i, sg_idx] = 1.0
-                for asg_idx, asg in enumerate(t.asgs):
-                    if asg.matches_pod(pi):
-                        match_asg[i, asg_idx] = 1.0
+                if inc_sg is not None:
+                    for kv in pi.labels.items():
+                        for idx, g in kvi_sg.get(kv, ()):
+                            if g.matches_pod(pi):
+                                inc_sg[i, idx] = 1.0
+                    for idx, g in cx_sg:
+                        if g.matches_pod(pi):
+                            inc_sg[i, idx] = 1.0
+                if match_asg is not None:
+                    for kv in pi.labels.items():
+                        for idx, g in kvi_asg.get(kv, ()):
+                            if g.matches_pod(pi):
+                                match_asg[i, idx] = 1.0
+                    for idx, g in cx_asg:
+                        if g.matches_pod(pi):
+                            match_asg[i, idx] = 1.0
                     for term in pi.required_anti_affinity_terms:
-                        if (term.topology_key == asg.topology_key
-                                and term.selector == asg.selector
-                                and term.namespaces == asg.namespaces):
-                            inc_asg[i, asg_idx] += 1.0
+                        idx = asg_ids.get((term.topology_key,
+                                           term.selector,
+                                           term.namespaces))
+                        if idx is not None:
+                            inc_asg[i, idx] += 1.0
+        # collided-bucket post-pass (AFTER all registrations, so buckets
+        # that became shared mid-batch are seen): any pod whose
+        # constraints reference a shared slot — or that matches a shared
+        # anti-affinity bucket — gets the no-fit-means-oracle marker,
+        # because its device verdict rides upper-bound counts
+        col_sg = [i for i, bk in enumerate(t.sgs) if bk.collided]
+        col_asg = [i for i, bk in enumerate(t.asgs) if bk.collided]
+        if col_sg or col_asg:
+            flagged = np.zeros(P, bool)
+            if col_sg and b.c_sg is not None:
+                # only HARD blocker constraints can turn an inflated
+                # count into a false no-fit; preferred/score slots on a
+                # shared bucket distort a score, never feasibility
+                hard = b.c_kind == C_ANTI_AFFINITY
+                flagged |= (np.isin(b.c_sg, col_sg) & hard).any(axis=1)
+            if col_asg and b.match_asg is not None:
+                flagged |= (b.match_asg[:, col_asg] > 0).any(axis=1)
+            flagged &= b.p_valid
+            b.nofit_oracle.extend(np.nonzero(flagged)[0].tolist())
         return b
 
     @staticmethod
@@ -955,25 +1123,35 @@ class BatchEncoder:
             kind = (C_SPREAD_HARD
                     if tsc.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"
                     else C_SPREAD_SCORE)
-            add_constraint(kind, t.register_sg(sg),
-                           maxskew=tsc.get("maxSkew", 1),
-                           selfmatch=1.0 if sel.matches(pi.labels) else 0.0)
+            # DoNotSchedule treats counts as enablers of admission
+            # (skew vs min) -> exclusive slot; ScheduleAnyway is
+            # scoring-only -> shareable
+            add_constraint(kind, t.register_sg(
+                sg, shareable=kind == C_SPREAD_SCORE),
+                maxskew=tsc.get("maxSkew", 1),
+                selfmatch=1.0 if sel.matches(pi.labels) else 0.0)
         for term in pi.required_affinity_terms:
             sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
+            # counts ENABLE here (gathered>0 satisfies): exclusive only
             add_constraint(C_AFFINITY, t.register_sg(sg),
                            selfmatch=1.0 if sg.matches_pod(pi) else 0.0)
         for term in pi.required_anti_affinity_terms:
             sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
-            add_constraint(C_ANTI_AFFINITY, t.register_sg(sg))
+            # counts BLOCK here: sharing is sound (upper bounds)
+            add_constraint(C_ANTI_AFFINITY, t.register_sg(sg,
+                                                          shareable=True))
             if t.register_asg(sg) is None:
                 return False
         for term in pi.preferred_affinity_terms:
             sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
-            add_constraint(C_PREF_AFFINITY, t.register_sg(sg),
+            # scoring only: inflation distorts a score, never legality
+            add_constraint(C_PREF_AFFINITY,
+                           t.register_sg(sg, shareable=True),
                            weight=float(term.weight))
         for term in pi.preferred_anti_affinity_terms:
             sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
-            add_constraint(C_PREF_AFFINITY, t.register_sg(sg),
+            add_constraint(C_PREF_AFFINITY,
+                           t.register_sg(sg, shareable=True),
                            weight=-float(term.weight))
         return True
 

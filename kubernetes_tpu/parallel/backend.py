@@ -28,7 +28,7 @@ from typing import Sequence
 import numpy as np
 
 from ..ops.backend import (
-    FLUSH_FIRST, ResidentHostMirror, decode_results,
+    FLUSH_FIRST, ResidentHostMirror, decode_results, record_batch_stats,
 )
 from ..ops.flatten import BatchEncoder, Caps, ClusterTensors, VocabFullError
 from ..scheduler.cache import Snapshot
@@ -262,9 +262,12 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                     self._unresolved.remove(holder)
                 except ValueError:  # pragma: no cover - double resolve
                     pass
-            return decode_results(assignments, n, self.batch_size,
-                                  set(batch.escape), row_infos,
-                                  "no feasible node (sharded batch filter)")
+            out = decode_results(
+                assignments, n, self.batch_size, set(batch.escape),
+                row_infos, "no feasible node (sharded batch filter)",
+                nofit_escapes=set(batch.nofit_oracle))
+            record_batch_stats(self.stats, self._lock, out, n)
+            return out
 
         return resolve
 

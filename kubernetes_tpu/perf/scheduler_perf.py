@@ -280,6 +280,21 @@ def _default_pod(i: int, params: dict) -> dict:
         tmpl = params["_pod_tmpl_cache"] = pod
     pod = meta.deep_copy(tmpl)
     pod["metadata"]["name"] = params.get("podNamePrefix", "pod-") + str(i)
+    ds = params.get("distinctServices")
+    if ds:
+        # high-label-cardinality shape: pod #i belongs to service
+        # svc-{i%ds}; its labels AND its (anti-)affinity selectors track
+        # the service, so the workload carries `ds` distinct selector
+        # groups (the regime that overflows fixed selector-group caps)
+        svc = f"svc-{i % int(ds)}"
+        pod["metadata"].setdefault("labels", {})["app"] = svc
+        aff = (pod.get("spec") or {}).get("affinity") or {}
+        for side in ("podAntiAffinity", "podAffinity"):
+            for term in (aff.get(side) or {}).get(
+                    "requiredDuringSchedulingIgnoredDuringExecution") or ():
+                sel = term.get("labelSelector")
+                if sel and "matchLabels" in sel:
+                    sel["matchLabels"] = {"app": svc}
     pg = params.get("podGroups")
     if pg:
         # gang membership: contiguous blocks of minMember pods per group
@@ -475,6 +490,14 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         summary = collector.stop()
         stats["wall"] = time.monotonic() - t0
         stats["e2e"] = cluster.scheduler.metrics.e2e_summary()
+        for p in cluster.scheduler.profiles.values():
+            if p.batch_backend is not None:
+                stats["backend_stats"] = dict(p.batch_backend.stats)
+                pods = stats["backend_stats"].get("pods", 0)
+                esc = stats["backend_stats"].get("escaped", 0)
+                if pods:
+                    stats["escape_rate"] = round(esc / pods, 4)
+                break
         return summary, stats
     finally:
         cluster.shutdown()
