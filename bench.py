@@ -92,7 +92,68 @@ EXTRA_CONFIGS = {
     "SchedulingBasicHTTP": {"workload": "SchedulingBasicLarge",
                             "nodes": 5000, "pods": 10_000, "batch": 4096,
                             "depth": 2, "timeout": 900.0, "http": True},
+    # the device-worker seam cost: identical plain batches through the
+    # in-process backend vs through a gRPC DeviceWorker (ops/remote.py)
+    # in steady state — quantifies what crossing the north star's shim
+    # costs per step
+    "RemoteSeamGrpc": {"seam": "grpc", "timeout": 600.0},
 }
+
+
+def run_seam_micro(kind: str = "grpc") -> dict:
+    """Steady-state assign() through the in-process backend vs the same
+    batches through a DeviceWorker seam; returns pods/s both ways."""
+    import time as _t
+
+    from kubernetes_tpu.ops.backend import TPUBatchBackend
+    from kubernetes_tpu.ops.flatten import Caps
+    from kubernetes_tpu.ops.remote import (
+        DeviceWorker, GrpcDeviceWorker, RemoteTPUBatchBackend,
+    )
+    from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+    from kubernetes_tpu.scheduler.types import PodInfo
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    n_nodes = int(os.environ.get("BENCH_SEAM_NODES", "5000"))
+    caps = Caps(n_cap=max(1024, -(-int(n_nodes * 1.1) // 256) * 256),
+                l_cap=128, kl_cap=62, t_cap=16, pt_cap=16,
+                s_cap=3, sg_cap=16, asg_cap=16)
+    BATCH = int(os.environ.get("BENCH_SEAM_BATCH", "4096"))
+    ROUNDS = 6
+    cache = Cache()
+    for i in range(n_nodes):
+        cache.add_node(make_node(f"n{i}")
+                       .capacity(cpu="64", mem="256Gi", pods=1000).build())
+    snap = cache.update_snapshot(Snapshot())
+
+    def drive(backend, tag):
+        backend.warmup()
+        batches = [[PodInfo(make_pod(f"{tag}{r}-{i}")
+                            .req(cpu="10m", mem="16Mi").build())
+                    for i in range(BATCH)] for r in range(ROUNDS)]
+        backend.assign(batches[0], snap)  # warm round
+        t0 = _t.monotonic()
+        placed = 0
+        for r in range(1, ROUNDS):
+            placed += sum(1 for nm, _ in backend.assign(batches[r], snap)
+                          if nm)
+        rate = (ROUNDS - 1) * BATCH / (_t.monotonic() - t0)
+        return placed, rate
+
+    worker = (GrpcDeviceWorker() if kind == "grpc"
+              else DeviceWorker()).start()
+    try:
+        _, remote_rate = drive(
+            RemoteTPUBatchBackend(worker.url, caps, batch_size=BATCH),
+            "r")
+    finally:
+        worker.stop()
+    _, local_rate = drive(TPUBatchBackend(caps, batch_size=BATCH), "l")
+    return {"seam": kind,
+            "inproc_pods_per_s": round(local_rate, 1),
+            "remote_pods_per_s": round(remote_rate, 1),
+            "seam_cost_ratio": round(local_rate / max(remote_rate, 1e-9),
+                                     2)}
 
 
 def run_once(workload: str, nodes: int | None, pods: int | None,
@@ -183,6 +244,11 @@ def _spawn_child(env_extra: dict, timeout: float) -> dict | None:
 
 
 def child_main() -> None:
+    seam = os.environ.get("_BENCH_W_SEAM")
+    if seam:
+        res = run_seam_micro(seam)
+        emit(res["remote_pods_per_s"], {"seam": seam, **res})
+        return
     name = os.environ.get("_BENCH_WORKLOAD", "SchedulingBasicLarge")
     nodes = os.environ.get("_BENCH_W_NODES")
     pods = os.environ.get("_BENCH_W_PODS")
@@ -258,6 +324,13 @@ def main() -> None:
     configs: dict[str, dict] = {}
     if os.environ.get("BENCH_SUITE", "full") != "basic":
         for cname, c in EXTRA_CONFIGS.items():
+            if "seam" in c:
+                env = {"_BENCH_W_SEAM": c["seam"]}
+                got = _spawn_child(env,
+                                   timeout=c.get("timeout", 600.0) + 300)
+                configs[cname] = (got.get("detail", {"error": "failed"})
+                                  if got else {"error": "failed"})
+                continue
             env = {"_BENCH_WORKLOAD": c["workload"],
                    "_BENCH_W_BATCH": str(c["batch"]),
                    "_BENCH_W_TIMEOUT": str(c.get("timeout", 900.0))}
@@ -284,6 +357,8 @@ def main() -> None:
                 "p99_ms": d.get("pod_e2e_p99_ms"),
                 "total_pods": d.get("TotalPods"),
             }
+            if "escape_rate" in d:
+                configs[cname]["escape_rate"] = d["escape_rate"]
 
     wall = time.monotonic() - t0
     results.sort(key=lambda r: r["value"])

@@ -47,9 +47,15 @@ def wait_for(pred, timeout=60.0):
     return False
 
 
-@pytest.fixture(scope="module")
-def worker():
-    w = DeviceWorker().start()
+@pytest.fixture(scope="module", params=["http", "grpc"])
+def worker(request):
+    """Every test runs over BOTH transports: the HTTP/1.1 seam and the
+    gRPC (HTTP/2) seam the north star names — same verbs, same bytes."""
+    if request.param == "grpc":
+        from kubernetes_tpu.ops.remote import GrpcDeviceWorker
+        w = GrpcDeviceWorker().start()
+    else:
+        w = DeviceWorker().start()
     yield w
     w.stop()
 
