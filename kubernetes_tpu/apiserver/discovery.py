@@ -259,3 +259,74 @@ def openapi_v2(builtin_groups: dict, cluster_scoped: frozenset[str],
     return {"swagger": "2.0",
             "info": {"title": "kubernetes-tpu", "version": __version__},
             "paths": paths, "definitions": definitions}
+
+
+# -- OpenAPI v3 (kube-openapi handler3: a discovery index of per-
+# group-version documents, lazily fetched by clients) ------------------
+
+def openapi_v3_index(builtin_groups: dict, crd_registry) -> dict:
+    """GET /openapi/v3: group-version -> server-relative doc URL."""
+    from ..api import core_versions as corever
+    gvs = [f"api/{v}" for v in corever.SERVED_VERSIONS]
+    for group in builtin_groups:
+        version = GROUP_PREFERRED_VERSION.get(group, "v1")
+        gvs.append(f"apis/{group}/{version}")
+    for info in crd_registry.resources():
+        for version in info["versions"]:
+            gvs.append(f"apis/{info['group']}/{version}")
+    return {"paths": {gv: {"serverRelativeURL": f"/openapi/v3/{gv}"}
+                      for gv in sorted(set(gvs))}}
+
+
+def _v2_schema_to_v3(node):
+    """Rewrite swagger-2 $refs into OpenAPI-3 component refs, deep."""
+    if isinstance(node, dict):
+        out = {}
+        for k, v in node.items():
+            if k == "$ref" and isinstance(v, str) \
+                    and v.startswith("#/definitions/"):
+                out[k] = "#/components/schemas/" + v[len("#/definitions/"):]
+            else:
+                out[k] = _v2_schema_to_v3(v)
+        return out
+    if isinstance(node, list):
+        return [_v2_schema_to_v3(x) for x in node]
+    return node
+
+
+def openapi_v3_group(gv: str, builtin_groups: dict,
+                     cluster_scoped: frozenset[str],
+                     crd_registry) -> dict | None:
+    """GET /openapi/v3/{gv}: an OpenAPI 3.0 document for one
+    group-version, built from the same source of truth as the v2 doc
+    (paths filtered to the gv; definitions -> components.schemas with
+    rewritten refs).  None for anything not in the /openapi/v3 index
+    (a real apiserver 404s un-indexed keys — 'apis' or 'apis/apps'
+    must not return a merged catch-all document)."""
+    index = openapi_v3_index(builtin_groups, crd_registry)["paths"]
+    if gv not in index:
+        return None
+    full = openapi_v2(builtin_groups, cluster_scoped, crd_registry)
+    prefix = "/" + gv + "/"
+    paths = {p: spec for p, spec in full["paths"].items()
+             if p.startswith(prefix)}
+    if not paths and gv.startswith("api/"):
+        # non-hub core version: the v2 doc only carries hub paths;
+        # synthesize this version's routes from the conversion seam's
+        # served-resource table so the doc is never empty
+        from ..api import core_versions as corever
+        version = gv[len("api/"):]
+        for plural, (kind, _s) in CORE_KINDS.items():
+            if not corever.handles(plural, version):
+                continue
+            namespaced = plural not in cluster_scoped
+            base = (f"/api/{version}/namespaces/{{namespace}}/{plural}"
+                    if namespaced else f"/api/{version}/{plural}")
+            paths[base] = {"get": {}, "post": {}}
+            paths[base + "/{name}"] = {"get": {}, "put": {},
+                                       "patch": {}, "delete": {}}
+    schemas = _v2_schema_to_v3(full["definitions"])
+    return {"openapi": "3.0.0",
+            "info": {"title": "kubernetes-tpu", "version": __version__},
+            "paths": paths,
+            "components": {"schemas": schemas}}

@@ -719,6 +719,13 @@ class APIServer:
                     doc = disc.group_list(
                         BUILTIN_GROUPS, server.crds,
                         extra=server.aggregator.known_group_versions())
+                elif path == "/openapi/v3":
+                    doc = disc.openapi_v3_index(BUILTIN_GROUPS,
+                                                server.crds)
+                elif path.startswith("/openapi/v3/"):
+                    doc = disc.openapi_v3_group(
+                        path[len("/openapi/v3/"):], BUILTIN_GROUPS,
+                        CLUSTER_SCOPED, server.crds)
                 elif path == "/openapi/v2":
                     doc = disc.openapi_v2(BUILTIN_GROUPS, CLUSTER_SCOPED,
                                           server.crds)

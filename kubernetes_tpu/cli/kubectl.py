@@ -247,6 +247,30 @@ class Kubectl:
                 self._discovery = None
         return 0
 
+    def kustomize(self, directory: str) -> int:
+        """kubectl kustomize DIR: print the resolved object stream
+        (kustomize build)."""
+        from .kustomize import KustomizeError, build
+        try:
+            objs = build(directory)
+        except KustomizeError as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+        self.out.write(yaml.safe_dump_all(objs, sort_keys=False))
+        return 0
+
+    def apply_kustomize(self, directory: str, namespace: str,
+                        force: bool = False) -> int:
+        """kubectl apply -k DIR: kustomize build, then server-side
+        apply the resolved objects (kubectl/pkg/cmd/apply with -k)."""
+        from .kustomize import KustomizeError, build
+        try:
+            objs = build(directory)
+        except KustomizeError as e:
+            self.out.write(f"error: {e}\n")
+            return 1
+        return self._apply_objs(objs, namespace, force)
+
     def apply(self, path: str, namespace: str, force: bool = False) -> int:
         """Server-side apply: each manifest is merged by managedFields
         ownership under the 'kubectl' field manager; conflicting fields
@@ -254,7 +278,12 @@ class Kubectl:
         hint unless --force-conflicts (kubectl pkg/cmd/apply with
         --server-side semantics — the only apply mode here; fields you
         stop applying are removed server-side)."""
-        for obj in self._load_manifests(path):
+        return self._apply_objs(self._load_manifests(path), namespace,
+                                force)
+
+    def _apply_objs(self, objs: list[dict], namespace: str,
+                    force: bool = False) -> int:
+        for obj in objs:
             res = self._kind_to_resource(obj.get("kind", ""))
             if not res:
                 self.out.write(f"error: unknown kind {obj.get('kind')}\n")
@@ -1611,9 +1640,14 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("name")
     for verb in ("create", "apply"):
         c = sub.add_parser(verb)
-        c.add_argument("-f", "--filename", required=True)
+        c.add_argument("-f", "--filename", default=None,
+                       required=verb == "create")
         if verb == "apply":
+            c.add_argument("-k", "--kustomize", default=None,
+                           help="kustomization directory")
             c.add_argument("--force-conflicts", action="store_true")
+    ks = sub.add_parser("kustomize")
+    ks.add_argument("dir")
     dl = sub.add_parser("delete")
     dl.add_argument("resource")
     dl.add_argument("name")
@@ -1746,8 +1780,19 @@ def run(argv: list[str] | None = None, client: Client | None = None,
     if args.cmd == "create":
         return k.create(args.filename, args.namespace)
     if args.cmd == "apply":
+        if args.kustomize and args.filename:
+            out.write("error: cannot specify -f and -k together\n")
+            return 1
+        if args.kustomize:
+            return k.apply_kustomize(args.kustomize, args.namespace,
+                                     force=args.force_conflicts)
+        if not args.filename:
+            out.write("error: apply needs -f FILE or -k DIR\n")
+            return 1
         return k.apply(args.filename, args.namespace,
                        force=args.force_conflicts)
+    if args.cmd == "kustomize":
+        return k.kustomize(args.dir)
     if args.cmd == "delete":
         return k.delete(args.resource, args.name, args.namespace)
     if args.cmd == "scale":

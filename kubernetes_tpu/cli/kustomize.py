@@ -1,0 +1,215 @@
+"""kustomize build — the load-bearing subset.
+
+Reference: sigs.k8s.io/kustomize as vendored by kubectl
+(staging/src/k8s.io/kubectl/pkg/cmd/apply with -k; cli-runtime's
+resource builder).  Supported kustomization.yaml fields, applied in
+kustomize's documented transform order:
+
+  resources:            files (multi-doc YAML) and directories (each a
+                        sub-kustomization, recursively built)
+  bases:                legacy alias for directory resources
+  patchesStrategicMerge: per-file strategic-merge patches matched by
+                        (apiVersion-group, kind, name, namespace)
+  patches:              [{path|patch, target:{kind,name,...}}] with
+                        strategic-merge payloads
+  images:               [{name, newName, newTag}] container image rewrites
+  namePrefix/nameSuffix: metadata.name decoration
+  namespace:            set on namespaced objects
+  commonLabels:         metadata.labels + the workload selector/template
+                        labels (kustomize updates selectors too)
+  commonAnnotations:    metadata.annotations
+
+Everything else (generators, replacements, vars, components) is out of
+scope; unknown fields raise so a kustomization is never silently
+half-applied.
+"""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+from ..apiserver import patch as patchlib
+
+_SUPPORTED = {
+    "apiVersion", "kind", "metadata",  # kustomization self-description
+    "resources", "bases", "patchesStrategicMerge", "patches", "images",
+    "namePrefix", "nameSuffix", "namespace", "commonLabels",
+    "commonAnnotations",
+}
+
+_STRATEGIC = "application/strategic-merge-patch+json"
+
+
+class KustomizeError(Exception):
+    pass
+
+
+def _load_kustomization(directory: str) -> dict:
+    for name in ("kustomization.yaml", "kustomization.yml",
+                 "Kustomization"):
+        path = os.path.join(directory, name)
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    doc = yaml.safe_load(f) or {}
+            except OSError as e:
+                raise KustomizeError(
+                    f"cannot read {path!r}: {e}") from e
+            except yaml.YAMLError as e:
+                raise KustomizeError(
+                    f"bad YAML in {path!r}: {e}") from e
+            unknown = set(doc) - _SUPPORTED
+            if unknown:
+                raise KustomizeError(
+                    f"{path}: unsupported kustomization fields "
+                    f"{sorted(unknown)}")
+            return doc
+    raise KustomizeError(f"no kustomization.yaml in {directory!r}")
+
+
+def _load_docs(path: str) -> list[dict]:
+    try:
+        with open(path) as f:
+            return [d for d in yaml.safe_load_all(f) if d]
+    except OSError as e:
+        raise KustomizeError(f"cannot read {path!r}: {e}") from e
+    except yaml.YAMLError as e:
+        raise KustomizeError(f"bad YAML in {path!r}: {e}") from e
+
+
+def _split_image(ref: str) -> tuple[str, str, str]:
+    """image ref -> (name, tag, digest).  The tag colon is the one AFTER
+    the last slash (registries carry ports: myreg.io:5000/web:1.0)."""
+    base, _, digest = ref.partition("@")
+    slash = base.rfind("/")
+    colon = base.rfind(":")
+    if colon > slash:
+        return base[:colon], base[colon + 1:], digest
+    return base, "", digest
+
+
+def _gk(obj: dict) -> tuple[str, str]:
+    group = (obj.get("apiVersion") or "").partition("/")[0] \
+        if "/" in (obj.get("apiVersion") or "") else ""
+    return group, obj.get("kind") or ""
+
+
+def _matches(obj: dict, target: dict) -> bool:
+    md = obj.get("metadata") or {}
+    og, ok = _gk(obj)
+    if target.get("kind") and target["kind"] != ok:
+        return False
+    if target.get("group") is not None and target.get("group") != og:
+        return False
+    if target.get("name") and target["name"] != md.get("name"):
+        return False
+    if target.get("namespace") \
+            and target["namespace"] != md.get("namespace"):
+        return False
+    return True
+
+
+def build(directory: str, _seen: frozenset = frozenset()) -> list[dict]:
+    """Resolve a kustomization directory to its final object list.
+
+    Kinds not in the builtin scope table are treated as NAMESPACED for
+    the namespace transform — kustomize's own default when it has no
+    openapi data for a type."""
+    real = os.path.realpath(directory)
+    if real in _seen:
+        raise KustomizeError(
+            f"kustomization cycle detected at {directory!r}")
+    _seen = _seen | {real}
+    k = _load_kustomization(directory)
+    objs: list[dict] = []
+    for entry in list(k.get("resources") or ()) + list(k.get("bases")
+                                                       or ()):
+        path = os.path.join(directory, entry)
+        if os.path.isdir(path):
+            objs.extend(build(path, _seen))
+        elif os.path.exists(path):
+            objs.extend(_load_docs(path))
+        else:
+            raise KustomizeError(f"resource {entry!r} not found under "
+                                 f"{directory!r}")
+
+    # -- strategic merge patches -----------------------------------------
+    patch_docs: list[tuple[dict, dict | None]] = []  # (patch, target|None)
+    for entry in k.get("patchesStrategicMerge") or ():
+        for p in _load_docs(os.path.join(directory, entry)):
+            patch_docs.append((p, None))
+    for entry in k.get("patches") or ():
+        if "path" in entry:
+            loaded = _load_docs(os.path.join(directory, entry["path"]))
+        else:
+            loaded = [d for d in yaml.safe_load_all(
+                entry.get("patch") or "") if d]
+        for p in loaded:
+            patch_docs.append((p, entry.get("target")))
+    for p, target in patch_docs:
+        tgt = target or {
+            "kind": p.get("kind"),
+            "name": (p.get("metadata") or {}).get("name"),
+            "namespace": (p.get("metadata") or {}).get("namespace"),
+        }
+        hit = False
+        for i, obj in enumerate(objs):
+            if _matches(obj, tgt):
+                objs[i] = patchlib.apply_patch(_STRATEGIC, obj, p)
+                hit = True
+        if not hit:
+            raise KustomizeError(
+                f"patch targets no resource: {tgt}")
+
+    # -- image rewrites ---------------------------------------------------
+    for img in k.get("images") or ():
+        name = img.get("name", "")
+        for obj in objs:
+            spec = ((obj.get("spec") or {}).get("template")
+                    or {}).get("spec") or obj.get("spec") or {}
+            for c in (list(spec.get("containers") or ())
+                      + list(spec.get("initContainers") or ())):
+                base, tag, digest = _split_image(c.get("image") or "")
+                if base != name:
+                    continue
+                new_base = img.get("newName", base)
+                if "newTag" in img:
+                    c["image"] = f"{new_base}:{img['newTag']}"
+                elif digest:
+                    c["image"] = f"{new_base}@{digest}"
+                else:
+                    c["image"] = (f"{new_base}:{tag}" if tag
+                                  else new_base)
+
+    # -- name/namespace/labels/annotations -------------------------------
+    prefix = k.get("namePrefix") or ""
+    suffix = k.get("nameSuffix") or ""
+    namespace = k.get("namespace")
+    labels = k.get("commonLabels") or {}
+    annotations = k.get("commonAnnotations") or {}
+    from ..client.clientset import CLUSTER_SCOPED_RESOURCES
+    from .kubectl import KIND_TO_RESOURCE
+    for obj in objs:
+        md = obj.setdefault("metadata", {})
+        if prefix or suffix:
+            md["name"] = f"{prefix}{md.get('name', '')}{suffix}"
+        if namespace:
+            res = KIND_TO_RESOURCE.get(obj.get("kind") or "")
+            if res not in CLUSTER_SCOPED_RESOURCES:
+                md["namespace"] = namespace
+        if labels:
+            md.setdefault("labels", {}).update(labels)
+            spec = obj.get("spec") or {}
+            sel = spec.get("selector")
+            if isinstance(sel, dict) and "matchLabels" in sel:
+                sel["matchLabels"].update(labels)
+            elif isinstance(sel, dict) and obj.get("kind") == "Service":
+                sel.update(labels)
+            tmpl_md = (spec.get("template") or {}).get("metadata")
+            if isinstance(tmpl_md, dict):
+                tmpl_md.setdefault("labels", {}).update(labels)
+        if annotations:
+            md.setdefault("annotations", {}).update(annotations)
+    return objs

@@ -119,6 +119,42 @@ class TestDiscovery:
         ct = doc["definitions"]["stable.tpu/v1.CronTab"]
         assert ct["properties"]["spec"]["type"] == "object"
 
+    def test_openapi_v3_index_and_group_docs(self, server):
+        """kube-openapi handler3 shape: /openapi/v3 is a discovery index
+        of per-group-version documents; each doc is OpenAPI 3.0 with
+        components.schemas and rewritten $refs."""
+        srv, _ = server
+        idx = fetch(srv, "/openapi/v3")
+        paths = idx["paths"]
+        assert paths["api/v1"]["serverRelativeURL"] == "/openapi/v3/api/v1"
+        assert "apis/apps/v1" in paths
+        assert "apis/stable.tpu/v1" in paths  # CRD group listed
+        assert "api/v2alpha1" in paths        # versioned core group
+        doc = fetch(srv, "/openapi/v3/apis/apps/v1")
+        assert doc["openapi"] == "3.0.0"
+        assert ("/apis/apps/v1/namespaces/{namespace}/deployments"
+                in doc["paths"])
+        schemas = doc["components"]["schemas"]
+        # refs rewritten from swagger-2 definitions to v3 components
+        dep = schemas["apps/v1.Deployment"]
+        ref = dep["properties"]["metadata"]["$ref"]
+        assert ref == "#/components/schemas/v1.ObjectMeta"
+        core = fetch(srv, "/openapi/v3/api/v1")
+        assert "/api/v1/namespaces/{namespace}/pods" in core["paths"]
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            fetch(srv, "/openapi/v3/apis/no.such/v1")
+        assert exc.value.code == 404
+        # non-index keys must 404, not return merged catch-all docs
+        for bad in ("/openapi/v3/apis", "/openapi/v3/apis/apps"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                fetch(srv, bad)
+            assert exc.value.code == 404, bad
+        # non-hub core versions carry their real routes, never empty
+        v2a = fetch(srv, "/openapi/v3/api/v2alpha1")
+        assert ("/api/v2alpha1/namespaces/{namespace}/pods"
+                in v2a["paths"])
+
 
 class TestKubectlDiscovery:
     def test_crd_kind_resolves_via_discovery(self, server):

@@ -266,6 +266,156 @@ class TestCp:
                     "default") == 1
 
 
+class TestKustomize:
+    def _overlay(self, tmp_path):
+        """base (deployment+service) + overlay (prefix, namespace,
+        labels, image rewrite, replica patch) — the canonical kustomize
+        layout."""
+        base = tmp_path / "base"
+        base.mkdir()
+        (base / "app.yaml").write_text("""\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+spec:
+  replicas: 1
+  selector:
+    matchLabels: {app: web}
+  template:
+    metadata:
+      labels: {app: web}
+    spec:
+      containers:
+      - name: web
+        image: registry/web:1.0
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: web
+spec:
+  selector: {app: web}
+  ports:
+  - port: 80
+""")
+        (base / "kustomization.yaml").write_text(
+            "resources:\n- app.yaml\n")
+        overlay = tmp_path / "prod"
+        overlay.mkdir()
+        (overlay / "replicas.yaml").write_text("""\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+spec:
+  replicas: 5
+""")
+        (overlay / "kustomization.yaml").write_text("""\
+resources:
+- ../base
+namePrefix: prod-
+namespace: production
+commonLabels: {env: prod}
+commonAnnotations: {team: core}
+images:
+- name: registry/web
+  newTag: "2.0"
+patchesStrategicMerge:
+- replicas.yaml
+""")
+        return overlay
+
+    def test_build_applies_all_transforms(self, cluster, tmp_path):
+        from kubernetes_tpu.cli.kustomize import build
+        objs = build(str(self._overlay(tmp_path)))
+        dep = next(o for o in objs if o["kind"] == "Deployment")
+        svc = next(o for o in objs if o["kind"] == "Service")
+        assert dep["metadata"]["name"] == "prod-web"
+        assert dep["metadata"]["namespace"] == "production"
+        assert dep["spec"]["replicas"] == 5  # patch applied
+        assert dep["spec"]["template"]["spec"]["containers"][0][
+            "image"] == "registry/web:2.0"
+        assert dep["metadata"]["labels"]["env"] == "prod"
+        assert dep["spec"]["selector"]["matchLabels"]["env"] == "prod"
+        assert dep["spec"]["template"]["metadata"]["labels"][
+            "env"] == "prod"
+        assert svc["spec"]["selector"]["env"] == "prod"
+        assert dep["metadata"]["annotations"]["team"] == "core"
+
+    def test_apply_k_round_trips_server(self, cluster, tmp_path):
+        http, _ = cluster
+        ns = meta.new_object("Namespace", "production", "")
+        try:
+            http.create("namespaces", ns)
+        except kv.AlreadyExistsError:
+            pass
+        k, out = kubectl(http)
+        rc = k.apply_kustomize(str(self._overlay(tmp_path)), "default")
+        assert rc == 0, out.getvalue()
+        dep = http.get("deployments", "production", "prod-web")
+        assert dep["spec"]["replicas"] == 5
+        assert dep["spec"]["template"]["spec"]["containers"][0][
+            "image"] == "registry/web:2.0"
+
+    def test_unknown_field_rejected(self, cluster, tmp_path):
+        from kubernetes_tpu.cli.kustomize import (
+            KustomizeError, build,
+        )
+        d = tmp_path / "bad"
+        d.mkdir()
+        (d / "kustomization.yaml").write_text(
+            "resources: []\nconfigMapGenerator: []\n")
+        with pytest.raises(KustomizeError):
+            build(str(d))
+
+    def test_missing_patch_file_is_clean_error(self, cluster, tmp_path):
+        from kubernetes_tpu.cli.kustomize import KustomizeError, build
+        d = tmp_path / "mp"
+        d.mkdir()
+        (d / "kustomization.yaml").write_text(
+            "resources: []\npatchesStrategicMerge:\n- typo.yaml\n")
+        with pytest.raises(KustomizeError):
+            build(str(d))
+
+    def test_registry_port_image_rewrite(self, cluster, tmp_path):
+        from kubernetes_tpu.cli.kustomize import build
+        d = tmp_path / "img"
+        d.mkdir()
+        (d / "p.yaml").write_text(
+            "apiVersion: v1\nkind: Pod\nmetadata: {name: p}\n"
+            "spec:\n  containers:\n  - name: c\n"
+            "    image: myreg.io:5000/web:1.0\n")
+        (d / "kustomization.yaml").write_text(
+            "resources: [p.yaml]\nimages:\n"
+            "- name: myreg.io:5000/web\n  newTag: \"2.0\"\n")
+        pod = build(str(d))[0]
+        assert pod["spec"]["containers"][0]["image"] \
+            == "myreg.io:5000/web:2.0"
+
+    def test_cycle_detected(self, cluster, tmp_path):
+        from kubernetes_tpu.cli.kustomize import KustomizeError, build
+        a = tmp_path / "a"; b = tmp_path / "b"
+        a.mkdir(); b.mkdir()
+        (a / "kustomization.yaml").write_text("resources: [../b]\n")
+        (b / "kustomization.yaml").write_text("resources: [../a]\n")
+        with pytest.raises(KustomizeError, match="cycle"):
+            build(str(a))
+
+    def test_unmatched_patch_rejected(self, cluster, tmp_path):
+        from kubernetes_tpu.cli.kustomize import (
+            KustomizeError, build,
+        )
+        d = tmp_path / "orphan"
+        d.mkdir()
+        (d / "p.yaml").write_text(
+            "kind: Deployment\nmetadata: {name: nope}\n")
+        (d / "kustomization.yaml").write_text(
+            "resources: []\npatchesStrategicMerge:\n- p.yaml\n")
+        with pytest.raises(KustomizeError):
+            build(str(d))
+
+
 class TestProxy:
     def test_forwards_with_credentials(self, cluster):
         http, _ = cluster
