@@ -159,6 +159,61 @@ class TestEndToEndCorrectness:
             factory.stop()
             client.close()
 
+    def test_fuzz_no_violation_no_false_unschedulable(self):
+        """Randomized trials: random service counts, pods per service,
+        node counts and (tiny) bucket caps — every workload is feasible
+        by construction (pods-per-service <= nodes), so the invariant
+        is exact: ALL pods schedule, and no node hosts two pods of one
+        service.  Catches kernel/bucket interactions a fixed shape
+        misses."""
+        import random
+        rng = random.Random(7)
+        for trial in range(4):
+            n_nodes = rng.randrange(6, 14)
+            n_svc = rng.randrange(3, 10)
+            per_svc = rng.randrange(2, min(5, n_nodes) + 1)
+            caps = Caps(n_cap=16, l_cap=64, kl_cap=32, t_cap=8,
+                        pt_cap=8, s_cap=2,
+                        sg_cap=rng.randrange(1, 5),
+                        asg_cap=rng.randrange(1, 4))
+            store, client, factory, sched, backend = self._cluster(caps)
+            try:
+                for i in range(n_nodes):
+                    client.create(
+                        "nodes", make_node(f"n{i}")
+                        .labels(**{"kubernetes.io/hostname": f"n{i}"})
+                        .capacity(cpu="64", mem="256Gi").build())
+                for s in range(n_svc):
+                    for j in range(per_svc):
+                        client.create(
+                            PODS, make_pod(f"t{trial}-s{s}-p{j}")
+                            .labels(app=f"svc-{s}").req(cpu="100m")
+                            .pod_affinity("kubernetes.io/hostname",
+                                          {"app": f"svc-{s}"},
+                                          anti=True).build())
+                total = n_svc * per_svc
+
+                def all_bound():
+                    pods, _ = client.list(PODS, "default")
+                    return sum(1 for p in pods
+                               if meta.pod_node_name(p)) == total
+                assert wait_for(all_bound, timeout=90.0), (
+                    f"trial {trial}: false unschedulable "
+                    f"(nodes={n_nodes} svc={n_svc} per={per_svc} "
+                    f"sg={caps.sg_cap} asg={caps.asg_cap})")
+                pods, _ = client.list(PODS, "default")
+                seen = set()
+                for p in pods:
+                    key = (meta.pod_node_name(p),
+                           p["metadata"]["labels"]["app"])
+                    assert key not in seen, (
+                        f"trial {trial}: violation {key}")
+                    seen.add(key)
+            finally:
+                sched.stop()
+                factory.stop()
+                client.close()
+
     def test_escape_stats_exposed(self):
         caps = Caps(n_cap=16, sg_cap=4, asg_cap=2)
         store, client, factory, sched, backend = self._cluster(caps)
