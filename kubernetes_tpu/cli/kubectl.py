@@ -229,6 +229,181 @@ class Kubectl:
         with open(path) as f:
             return [d for d in yaml.safe_load_all(f) if d]
 
+    _GEN_FLAGS = {
+        "deployment": {"image", "replicas"},
+        "configmap": {"from-literal"},
+        "secret": {"from-literal"},
+        "namespace": set(),
+        "service": {"tcp"},
+        "job": {"image"},
+    }
+
+    def create_generated(self, kind: str, rest: list[str],
+                         namespace: str,
+                         command: list[str] | None = None) -> int:
+        """kubectl create <kind> NAME [flags] [-- CMD...] generators
+        (kubectl/pkg/cmd/create/create_*.go): deployment, configmap,
+        secret generic, namespace, service clusterip|nodeport, job.
+        Unknown flags and stray positionals are errors, like kubectl;
+        `command` is everything after a bare `--` (job containers)."""
+        allowed = self._GEN_FLAGS.get(kind)
+        if allowed is None:
+            self.out.write(f"error: unsupported create generator "
+                           f"{kind!r}\n")
+            return 1
+
+        def flags(args):
+            name, out, err = None, {}, None
+            i = 0
+            while i < len(args):
+                a = args[i]
+                if a in ("-n", "--namespace"):
+                    # argparse.REMAINDER swallowed the global flag;
+                    # honor kubectl's canonical trailing placement
+                    if i + 1 >= len(args):
+                        return None, None, "error: -n needs a value"
+                    out["namespace"] = [args[i + 1]]
+                    i += 2
+                    continue
+                if a.startswith("--"):
+                    k, eq, v = a[2:].partition("=")
+                    if k not in allowed:
+                        return None, None, f"error: unknown flag --{k}"
+                    if not eq:
+                        if i + 1 >= len(args) \
+                                or args[i + 1].startswith("--"):
+                            return None, None, \
+                                f"error: --{k} needs a value"
+                        v = args[i + 1]
+                        i += 1
+                    out.setdefault(k, []).append(v)
+                elif name is None:
+                    name = a
+                else:
+                    return None, None, \
+                        f"error: unexpected argument {a!r}"
+                i += 1
+            return name, out, err
+
+        if kind in ("secret", "service"):
+            if not rest:
+                self.out.write(f"error: create {kind} needs a subtype\n")
+                return 1
+            subtype, rest = rest[0], rest[1:]
+        else:
+            subtype = None
+        name, fl, err = flags(rest)
+        if err:
+            self.out.write(err + "\n")
+            return 1
+        if not name:
+            self.out.write("error: NAME is required\n")
+            return 1
+        if "namespace" in (fl or {}):
+            namespace = fl.pop("namespace")[0]
+
+        def literals(key="from-literal"):
+            data = {}
+            for ent in fl.get(key, ()):
+                k, _, v = ent.partition("=")
+                data[k] = v
+            return data
+
+        def as_int(s: str, flag: str) -> int | None:
+            try:
+                return int(s)
+            except ValueError:
+                self.out.write(f"error: --{flag} must be an integer, "
+                               f"got {s!r}\n")
+                return None
+
+        if kind == "deployment":
+            image = (fl.get("image") or [None])[0]
+            if not image:
+                self.out.write("error: --image is required\n")
+                return 1
+            replicas = as_int((fl.get("replicas") or ["1"])[0],
+                              "replicas")
+            if replicas is None:
+                return 1
+            obj = {"apiVersion": "apps/v1", "kind": "Deployment",
+                   "metadata": {"name": name, "namespace": namespace,
+                                "labels": {"app": name}},
+                   "spec": {
+                       "replicas": replicas,
+                       "selector": {"matchLabels": {"app": name}},
+                       "template": {
+                           "metadata": {"labels": {"app": name}},
+                           "spec": {"containers": [
+                               {"name": name, "image": image}]}}}}
+            res = "deployments"
+        elif kind == "configmap":
+            obj = {"apiVersion": "v1", "kind": "ConfigMap",
+                   "metadata": {"name": name, "namespace": namespace},
+                   "data": literals()}
+            res = "configmaps"
+        elif kind == "secret" and subtype == "generic":
+            import base64
+            obj = {"apiVersion": "v1", "kind": "Secret",
+                   "metadata": {"name": name, "namespace": namespace},
+                   "type": "Opaque",
+                   "data": {k: base64.b64encode(v.encode()).decode()
+                            for k, v in literals().items()}}
+            res = "secrets"
+        elif kind == "namespace":
+            obj = {"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": name}}
+            res = "namespaces"
+        elif kind == "service" and subtype in ("clusterip", "nodeport"):
+            if not fl.get("tcp"):
+                self.out.write("error: at least one --tcp=PORT[:TARGET] "
+                               "is required\n")
+                return 1
+            ports = []
+            for spec in fl.get("tcp", ()):
+                port_s, _, target_s = spec.partition(":")
+                port = as_int(port_s, "tcp")
+                target = as_int(target_s, "tcp") if target_s else port
+                if port is None or target is None:
+                    return 1
+                ports.append({"port": port, "protocol": "TCP",
+                              "targetPort": target})
+            obj = {"apiVersion": "v1", "kind": "Service",
+                   "metadata": {"name": name, "namespace": namespace,
+                                "labels": {"app": name}},
+                   "spec": {"selector": {"app": name},
+                            "type": ("NodePort" if subtype == "nodeport"
+                                     else "ClusterIP"),
+                            "ports": ports}}
+            res = "services"
+        elif kind == "job":
+            image = (fl.get("image") or [None])[0]
+            if not image:
+                self.out.write("error: --image is required\n")
+                return 1
+            container = {"name": name, "image": image}
+            if command:
+                container["command"] = list(command)
+            obj = {"apiVersion": "batch/v1", "kind": "Job",
+                   "metadata": {"name": name, "namespace": namespace},
+                   "spec": {"template": {
+                       "metadata": {"labels": {"job-name": name}},
+                       "spec": {"restartPolicy": "Never",
+                                "containers": [container]}}}}
+            res = "jobs"
+        else:
+            self.out.write(f"error: unsupported create generator "
+                           f"{kind!r}"
+                           + (f" {subtype!r}" if subtype else "") + "\n")
+            return 1
+        try:
+            created = self.client.create(res, obj)
+        except kv.AlreadyExistsError:
+            self.out.write(f"Error: {res}/{name} already exists\n")
+            return 1
+        self.out.write(f"{res}/{meta.name(created)} created\n")
+        return 0
+
     def create(self, path: str, namespace: str) -> int:
         for obj in self._load_manifests(path):
             res = self._kind_to_resource(obj.get("kind", ""))
@@ -1640,12 +1815,16 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("name")
     for verb in ("create", "apply"):
         c = sub.add_parser(verb)
-        c.add_argument("-f", "--filename", default=None,
-                       required=verb == "create")
+        c.add_argument("-f", "--filename", default=None)
         if verb == "apply":
             c.add_argument("-k", "--kustomize", default=None,
                            help="kustomization directory")
             c.add_argument("--force-conflicts", action="store_true")
+        else:
+            c.add_argument("gen", nargs=argparse.REMAINDER,
+                           help="generator: deployment|configmap|"
+                                "secret generic|namespace|service "
+                                "clusterip|nodeport|job NAME [flags]")
     ks = sub.add_parser("kustomize")
     ks.add_argument("dir")
     dl = sub.add_parser("delete")
@@ -1778,7 +1957,17 @@ def run(argv: list[str] | None = None, client: Client | None = None,
     if args.cmd == "describe":
         return k.describe(args.resource, args.name, args.namespace)
     if args.cmd == "create":
-        return k.create(args.filename, args.namespace)
+        if args.filename:
+            return k.create(args.filename, args.namespace)
+        if args.gen:
+            # `tail` is everything after a bare `--`: the job command
+            return k.create_generated(args.gen[0], args.gen[1:],
+                                      args.namespace,
+                                      command=tail or None)
+        out.write("error: create needs -f FILE or a generator "
+                  "(deployment, configmap, secret generic, namespace, "
+                  "service clusterip|nodeport, job)\n")
+        return 1
     if args.cmd == "apply":
         if args.kustomize and args.filename:
             out.write("error: cannot specify -f and -k together\n")

@@ -266,6 +266,108 @@ class TestCp:
                     "default") == 1
 
 
+class TestCreateGenerators:
+    def test_create_deployment(self, cluster):
+        http, _ = cluster
+        k, out = kubectl(http)
+        rc = k.create_generated(
+            "deployment", ["genweb", "--image=img:3", "--replicas=2"],
+            "default")
+        assert rc == 0, out.getvalue()
+        dep = http.get("deployments", "default", "genweb")
+        assert dep["spec"]["replicas"] == 2
+        assert dep["spec"]["template"]["spec"]["containers"][0][
+            "image"] == "img:3"
+        assert dep["spec"]["selector"]["matchLabels"] == {"app": "genweb"}
+
+    def test_create_configmap_and_secret(self, cluster):
+        http, _ = cluster
+        k, _ = kubectl(http)
+        assert k.create_generated(
+            "configmap", ["gencm", "--from-literal=a=1",
+                          "--from-literal=b=2"], "default") == 0
+        cm = http.get("configmaps", "default", "gencm")
+        assert cm["data"] == {"a": "1", "b": "2"}
+        assert k.create_generated(
+            "secret", ["generic", "gensec", "--from-literal=pw=x"],
+            "default") == 0
+        import base64
+        sec = http.get("secrets", "default", "gensec")
+        assert base64.b64decode(sec["data"]["pw"]).decode() == "x"
+
+    def test_create_namespace_and_service(self, cluster):
+        http, _ = cluster
+        k, _ = kubectl(http)
+        assert k.create_generated("namespace", ["genns"], "default") == 0
+        assert http.get("namespaces", "", "genns")
+        assert k.create_generated(
+            "service", ["clusterip", "gensvc", "--tcp=80:8080"],
+            "default") == 0
+        svc = http.get("services", "default", "gensvc")
+        assert svc["spec"]["ports"][0] == {
+            "port": 80, "protocol": "TCP", "targetPort": 8080}
+
+    def test_create_job_with_command(self, cluster):
+        """Canonical CLI form: create job NAME --image=I -- CMD ARGS
+        (the `--` split happens in run())."""
+        from kubernetes_tpu.cli.kubectl import run
+        http, _ = cluster
+        out = io.StringIO()
+        rc = run(["create", "job", "genjob", "--image=busybox",
+                  "--", "echo", "hi"], client=http, out=out)
+        assert rc == 0, out.getvalue()
+        job = http.get("jobs", "default", "genjob")
+        c = job["spec"]["template"]["spec"]["containers"][0]
+        assert c["command"] == ["echo", "hi"]
+
+    def test_trailing_namespace_flag_honored(self, cluster):
+        """kubectl's canonical `-n NS` after the generator args must not
+        be swallowed by REMAINDER parsing."""
+        from kubernetes_tpu.cli.kubectl import run
+        http, _ = cluster
+        http.create("namespaces", meta.new_object("Namespace",
+                                                  "genprod", ""))
+        out = io.StringIO()
+        rc = run(["create", "configmap", "nscm", "--from-literal=a=1",
+                  "-n", "genprod"], client=http, out=out)
+        assert rc == 0, out.getvalue()
+        assert http.get("configmaps", "genprod", "nscm")
+
+    def test_bad_flags_are_errors_not_silent(self, cluster):
+        http, _ = cluster
+        k, out = kubectl(http)
+        # typo'd flag
+        assert k.create_generated(
+            "deployment", ["d1", "--image=x", "--replica=3"],
+            "default") == 1
+        assert "unknown flag" in out.getvalue()
+        # non-integer replicas
+        k2, out2 = kubectl(http)
+        assert k2.create_generated(
+            "deployment", ["d2", "--image=x", "--replicas=two"],
+            "default") == 1
+        assert "integer" in out2.getvalue()
+        # stray positional
+        k3, out3 = kubectl(http)
+        assert k3.create_generated(
+            "configmap", ["cmx", "a=1"], "default") == 1
+        assert "unexpected argument" in out3.getvalue()
+        # portless service
+        k4, out4 = kubectl(http)
+        assert k4.create_generated(
+            "service", ["clusterip", "s1"], "default") == 1
+        assert "--tcp" in out4.getvalue()
+        # nothing was created by any of the failed commands
+        with pytest.raises(kv.NotFoundError):
+            http.get("deployments", "default", "d1")
+
+    def test_unknown_generator_errors(self, cluster):
+        http, _ = cluster
+        k, out = kubectl(http)
+        assert k.create_generated("cronjob", ["x"], "default") == 1
+        assert "unsupported" in out.getvalue()
+
+
 class TestKustomize:
     def _overlay(self, tmp_path):
         """base (deployment+service) + overlay (prefix, namespace,
