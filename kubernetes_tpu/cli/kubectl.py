@@ -562,6 +562,29 @@ class Kubectl:
         print_table(rows, ["NAME", "CPU", "CPU%", "MEMORY", "MEMORY%"], self.out)
         return 0
 
+    def top_pods(self, namespace: str,
+                 all_namespaces: bool = False) -> int:
+        """kubectl top pods (kubectl/pkg/cmd/top): requested resources
+        per pod — the hollow runtime executes nothing, so requests ARE
+        the usage signal, exactly what the scheduler accounts."""
+        from ..api.resources import pod_request
+        pods, _ = self.client.list(PODS, None if all_namespaces
+                                   else namespace)
+        rows = []
+        for p in sorted(pods, key=lambda o: (meta.namespace(o) or "",
+                                             meta.name(o))):
+            r = pod_request(p)
+            row = [meta.name(p), f"{r.milli_cpu}m",
+                   f"{r.memory // (1 << 20)}Mi"]
+            if all_namespaces:
+                row.insert(0, meta.namespace(p) or "")
+            rows.append(row)
+        headers = ["NAME", "CPU(cores)", "MEMORY(bytes)"]
+        if all_namespaces:
+            headers = ["NAMESPACE"] + headers
+        print_table(rows, headers, self.out)
+        return 0
+
     def logs(self, name: str, namespace: str, container: str | None = None,
              follow: bool = False, tail: int | None = None) -> int:
         """Container logs via the apiserver's kubelet tunnel
@@ -1838,7 +1861,9 @@ def build_parser() -> argparse.ArgumentParser:
         cn = sub.add_parser(verb)
         cn.add_argument("node")
     tp = sub.add_parser("top")
-    tp.add_argument("what", choices=["nodes"])
+    tp.add_argument("what", choices=["nodes", "pods", "pod", "node"])
+    tp.add_argument("-A", "--all-namespaces", action="store_true",
+                    dest="all_namespaces")
     lg = sub.add_parser("logs")
     lg.add_argument("name")
     lg.add_argument("-c", "--container", default=None)
@@ -1993,6 +2018,9 @@ def run(argv: list[str] | None = None, client: Client | None = None,
     if args.cmd == "drain":
         return k.drain(args.node)
     if args.cmd == "top":
+        if args.what in ("pods", "pod"):
+            return k.top_pods(args.namespace,
+                              all_namespaces=args.all_namespaces)
         return k.top_nodes()
     if args.cmd == "logs":
         return k.logs(args.name, args.namespace, container=args.container,

@@ -266,6 +266,20 @@ class TestCp:
                     "default") == 1
 
 
+class TestTopPods:
+    def test_top_pods_lists_requests(self, cluster):
+        http, local = cluster
+        pod = meta.new_object("Pod", "top-a", "default")
+        pod["spec"] = {"containers": [{"name": "c", "resources": {
+            "requests": {"cpu": "250m", "memory": "256Mi"}}}]}
+        http.create(PODS, pod)
+        from kubernetes_tpu.cli.kubectl import run
+        out = io.StringIO()
+        assert run(["top", "pods"], client=http, out=out) == 0
+        text = out.getvalue()
+        assert "top-a" in text and "250m" in text and "256Mi" in text
+
+
 class TestCreateGenerators:
     def test_create_deployment(self, cluster):
         http, _ = cluster
