@@ -38,10 +38,12 @@ from .replication import ReplicationControllerController
 from .rootca import RootCACertPublisher
 from .ttl import TTLController
 from .ttlafterfinished import TTLAfterFinishedController
+from .clusterroleaggregation import ClusterRoleAggregationController
+from .endpointslicemirroring import EndpointSliceMirroringController
 from .volume import (
     AttachDetachController, EphemeralVolumeController,
     PersistentVolumeController, PVCProtectionController,
-    PVProtectionController,
+    PVProtectionController, VolumeExpandController,
 )
 
 logger = logging.getLogger(__name__)
@@ -59,7 +61,8 @@ DEFAULT_CONTROLLERS = ("deployment", "replicaset", "statefulset", "daemonset",
                        "csrcleaner", "ttl", "root-ca-cert-publisher",
                        "persistentvolume-binder", "pvc-protection",
                        "pv-protection", "attachdetach", "ephemeral-volume",
-                       "storage-version-gc")
+                       "storage-version-gc", "clusterrole-aggregation",
+                       "endpointslicemirroring", "persistentvolume-expander")
 
 
 class ControllerManager:
@@ -95,6 +98,9 @@ class ControllerManager:
             "pv-protection": PVProtectionController,
             "attachdetach": AttachDetachController,
             "ephemeral-volume": EphemeralVolumeController,
+            "clusterrole-aggregation": ClusterRoleAggregationController,
+            "endpointslicemirroring": EndpointSliceMirroringController,
+            "persistentvolume-expander": VolumeExpandController,
             # registered but disabled by default (reference parity):
             "nodeipam": NodeIpamController,
             "tokencleaner": TokenCleaner,

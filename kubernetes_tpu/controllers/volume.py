@@ -509,3 +509,84 @@ class EphemeralVolumeController(Controller):
                 self.client.create(PVCS, pvc)
             except kv.AlreadyExistsError:
                 pass
+
+
+class VolumeExpandController(Controller):
+    """PVC expansion (pkg/controller/volume/expand/expand_controller.go):
+    a bound claim whose requested storage grew past its status capacity
+    gets its PV resized — gated on the StorageClass declaring
+    `allowVolumeExpansion: true`, like the reference.  The simulated
+    volume plane "resizes" instantly: PV capacity and PVC
+    status.capacity follow the new request and the
+    FileSystemResizePending dance collapses to one status write."""
+
+    name = "persistentvolume-expander"
+
+    def __init__(self, client, factory):
+        super().__init__(client, factory)
+        self.pvc_informer = factory.informer(PVCS)
+        self.sc_informer = factory.informer(STORAGECLASSES)
+        self.pvc_informer.add_event_handler(
+            lambda t, pvc, old: self.enqueue(pvc))
+        # allowVolumeExpansion flipping true must wake claims that were
+        # rejected at the gate: there is no periodic resync backstop
+        self.sc_informer.add_event_handler(self._on_class)
+
+    def _on_class(self, type_, sc: Obj, old: Obj | None) -> None:
+        name = meta.name(sc)
+        for pvc in self.pvc_informer.list(None):
+            if (pvc.get("spec") or {}).get("storageClassName") == name:
+                self.enqueue(pvc)
+
+    def _expandable(self, pvc: Obj) -> bool:
+        sc_name = (pvc.get("spec") or {}).get("storageClassName")
+        if not sc_name:
+            return False
+        sc = self.sc_informer.get("", sc_name)
+        return bool(sc and sc.get("allowVolumeExpansion"))
+
+    def sync(self, key: str) -> None:
+        ns, name = split_key(key)
+        pvc = self.pvc_informer.get(ns, name)
+        if pvc is None or meta.deletion_timestamp(pvc):
+            return
+        spec = pvc.get("spec") or {}
+        vol_name = spec.get("volumeName")
+        status = pvc.get("status") or {}
+        if not vol_name or status.get("phase") != "Bound":
+            return
+        pv = self.factory.informer(PVS).get("", vol_name)
+        if pv is None:
+            try:
+                pv = self.client.get(PVS, "", vol_name)
+            except kv.NotFoundError:
+                return
+        # compare against the VOLUME's capacity, never pvc.status (the
+        # binder doesn't maintain status.capacity; a status-derived
+        # `have` of 0 would shrink every statically-bound oversized PV
+        # down to its claim's request on first sync)
+        want = _capacity(pvc, "pvc")
+        have = _capacity(pv, "pv")
+        if want <= have:
+            return
+        if not self._expandable(pvc):
+            return  # reference: rejected unless the class allows it
+        new_size = (spec.get("resources") or {})["requests"]["storage"]
+
+        def grow_pv(pv: Obj) -> Obj:
+            pv.setdefault("spec", {}).setdefault(
+                "capacity", {})["storage"] = new_size
+            return pv
+
+        def grow_claim_status(c: Obj) -> Obj:
+            c.setdefault("status", {}).setdefault(
+                "capacity", {})["storage"] = new_size
+            return c
+        try:
+            self.client.guaranteed_update(PVS, "", vol_name, grow_pv)
+            self.client.guaranteed_update(PVCS, ns, name,
+                                          grow_claim_status)
+        except kv.NotFoundError:
+            return
+        self.client.create_event(pvc, "VolumeResizeSuccessful",
+                                 f"expanded to {new_size}")
