@@ -92,6 +92,13 @@ EXTRA_CONFIGS = {
     "SchedulingBasicHTTP": {"workload": "SchedulingBasicLarge",
                             "nodes": 5000, "pods": 10_000, "batch": 4096,
                             "depth": 2, "timeout": 900.0, "http": True},
+    # the front door with the apiserver as a SEPARATE PROCESS — the
+    # reference's actual deployment shape (separate binaries, no shared
+    # GIL between server and scheduler)
+    "SchedulingBasicHTTPProc": {"workload": "SchedulingBasicLarge",
+                                "nodes": 5000, "pods": 10_000,
+                                "batch": 4096, "depth": 2,
+                                "timeout": 900.0, "http": "proc"},
     # the device-worker seam cost: identical plain batches through the
     # in-process backend vs through a gRPC DeviceWorker (ops/remote.py)
     # in steady state — quantifies what crossing the north star's shim
@@ -261,7 +268,9 @@ def child_main() -> None:
                    depth=int(os.environ.get("_BENCH_W_DEPTH", "1")),
                    admission_ms=float(os.environ.get("_BENCH_W_ADMISSION_MS",
                                                      "0")),
-                   via_http=os.environ.get("_BENCH_W_HTTP") == "1")
+                   via_http=("process"
+                             if os.environ.get("_BENCH_W_HTTP") == "proc"
+                             else os.environ.get("_BENCH_W_HTTP") == "1"))
     if "error" in res:
         emit(0.0, {"error": res["error"], **res["detail"]})
         sys.exit(1)
@@ -345,7 +354,8 @@ def main() -> None:
             if "admission_ms" in c:
                 env["_BENCH_W_ADMISSION_MS"] = str(c["admission_ms"])
             if c.get("http"):
-                env["_BENCH_W_HTTP"] = "1"
+                env["_BENCH_W_HTTP"] = ("proc" if c["http"] == "proc"
+                                        else "1")
             got = _spawn_child(env, timeout=c.get("timeout", 900.0) + 300)
             if got is None:
                 configs[cname] = {"error": "failed"}

@@ -24,6 +24,9 @@ def main(argv=None) -> None:
     ap.add_argument("--data-dir", default=None,
                     help="directory for the store's WAL + snapshots; "
                          "omitting it runs memory-only (no durability)")
+    ap.add_argument("--enable-default-admission", action="store_true",
+                    help="run the in-tree admission chain (the bench's "
+                         "front-door configuration)")
     ap.add_argument("-v", "--verbosity", type=int, default=1)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
@@ -55,10 +58,12 @@ def main(argv=None) -> None:
                 tokens[tok] = (user, groups)
     store = kv.MemoryStore(history=1_000_000, transformers=transformers,
                            durable_dir=args.data_dir)
-    server = APIServer(store, host=args.bind_address, port=args.secure_port,
-                       token=args.token, tokens=tokens,
-                       enable_rbac=args.authorization_mode == "RBAC").start()
-    print(f"apiserver listening on {server.url}")
+    server = APIServer(
+        store, host=args.bind_address, port=args.secure_port,
+        token=args.token, tokens=tokens,
+        enable_rbac=args.authorization_mode == "RBAC",
+        enable_default_admission=args.enable_default_admission).start()
+    print(f"apiserver listening on {server.url}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())

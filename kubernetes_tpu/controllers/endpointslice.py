@@ -84,8 +84,15 @@ class EndpointSliceController(Controller):
     def sync(self, key: str) -> None:
         ns, name = split_key(key)
         svc = self.svc_informer.get(ns, name)
+        # slices owned by ANOTHER manager (the mirroring controller's
+        # managed-by label) are never ours to reconcile or delete —
+        # reference reconciler filters on managed-by the same way
         existing = [sl for sl in self.slice_informer.list(ns)
-                    if meta.labels(sl).get(SERVICE_NAME_LABEL) == name]
+                    if meta.labels(sl).get(SERVICE_NAME_LABEL) == name
+                    and meta.labels(sl).get(
+                        "endpointslice.kubernetes.io/managed-by",
+                        "endpointslice-controller.k8s.io")
+                    == "endpointslice-controller.k8s.io"]
         if svc is None or not (svc.get("spec") or {}).get("selector"):
             for sl in existing:
                 self._delete(ns, meta.name(sl))

@@ -40,6 +40,16 @@ class EndpointSliceMirroringController(Controller):
             lambda t, ep, old: self.enqueue(ep))
         self.svc_informer.add_event_handler(
             lambda t, svc, old: self.enqueue(svc))
+        # recover mirrors that something else deleted/modified
+        factory.informer(ENDPOINTSLICES).add_event_handler(
+            self._on_slice)
+
+    def _on_slice(self, type_, sl: Obj, old: Obj | None) -> None:
+        labels = meta.labels(sl)
+        if labels.get(MANAGED_BY_LABEL) == MANAGED_BY \
+                and labels.get(SERVICE_NAME_LABEL):
+            self.enqueue_key(f"{meta.namespace(sl)}/"
+                             f"{labels[SERVICE_NAME_LABEL]}")
 
     def _mirror_slices(self, ep: Obj) -> list[Obj]:
         """Desired slices for one Endpoints object: one slice per

@@ -568,6 +568,23 @@ class VolumeExpandController(Controller):
         want = _capacity(pvc, "pvc")
         have = _capacity(pv, "pv")
         if want <= have:
+            # catch up a stale status.capacity: the PV write and the
+            # claim-status write are two transactions, and a crash or
+            # transient failure between them must converge on retry
+            pv_size = ((pv.get("spec") or {}).get("capacity")
+                       or {}).get("storage")
+            tracked = (status.get("capacity") or {}).get("storage")
+            if tracked is not None and pv_size is not None \
+                    and tracked != pv_size:
+                def catch_up(c: Obj) -> Obj:
+                    c.setdefault("status", {}).setdefault(
+                        "capacity", {})["storage"] = pv_size
+                    return c
+                try:
+                    self.client.guaranteed_update(PVCS, ns, name,
+                                                  catch_up)
+                except kv.NotFoundError:
+                    pass
             return
         if not self._expandable(pvc):
             return  # reference: rejected unless the class allows it

@@ -173,12 +173,13 @@ class ThroughputCollector:
 
 @dataclass
 class PerfCluster:
-    store: kv.MemoryStore
-    client: LocalClient
-    factory: SharedInformerFactory
+    store: object               # MemoryStore, or the HTTPClient when the
+    client: object              # apiserver runs out of process (it only
+    factory: SharedInformerFactory  # needs .watch()/.list())
     scheduler: Scheduler
     server: object = None       # APIServer when via_http
     _tmpdir: object = None      # WAL dir lifetime
+    _proc: object = None        # subprocess.Popen when via_http="process"
 
     def shutdown(self) -> None:
         self.scheduler.stop()
@@ -186,6 +187,13 @@ class PerfCluster:
         self.client.close()  # event-broadcaster thread
         if self.server is not None:
             self.server.stop()
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 - then kill + reap
+                self._proc.kill()
+                self._proc.wait()
         if self._tmpdir is not None:
             self._tmpdir.cleanup()
 
@@ -205,11 +213,55 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
     admission + WAL durability, and the scheduler (informers, binds,
     events) plus the workload submitter all speaking HTTP to it — the
     reference harness's shape (util.go:79-108 schedules via a real
-    apiserver), quantifying what LocalClient bypasses."""
+    apiserver), quantifying what LocalClient bypasses.
+    via_http="process" goes further and runs the apiserver as a
+    SEPARATE OS PROCESS (`python -m kubernetes_tpu.cmd.apiserver`),
+    the reference's actual deployment shape (separate binaries): the
+    server's JSON/admission/WAL work then runs on its own interpreter
+    and cores instead of sharing the scheduler's GIL."""
     from ..utils.gctune import tune_for_throughput
     tune_for_throughput()  # CPython gen-2 pauses cost ~35% at bench scale
-    server = tmpdir = None
-    if via_http:
+    server = tmpdir = proc = None
+    if via_http == "process":
+        if store is not None:
+            raise ValueError("via_http builds its own store")
+        import secrets as pysecrets
+        import socket as socketlib
+        import subprocess
+        import sys
+        import tempfile
+
+        from ..client.http_client import HTTPClient
+        tmpdir = tempfile.TemporaryDirectory(prefix="bench-wal-")
+        token = pysecrets.token_urlsafe(16)
+        with socketlib.socket() as s:  # pick a free port
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.cmd.apiserver",
+             "--secure-port", str(port), "--token", token,
+             "--authorization-mode", "RBAC",
+             "--enable-default-admission",
+             "--data-dir", tmpdir.name],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        client = HTTPClient.from_url(f"http://127.0.0.1:{port}",
+                                     token=token)
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                client._request("GET", "/healthz")
+                break
+            except Exception:  # noqa: BLE001 - still starting
+                if proc.poll() is not None \
+                        or time.monotonic() > deadline:
+                    proc.kill()
+                    proc.wait()
+                    tmpdir.cleanup()
+                    raise RuntimeError("apiserver process failed to "
+                                       "start")
+                time.sleep(0.1)
+        store = client  # collector watches through the HTTP client
+    elif via_http:
         if store is not None:
             raise ValueError("via_http builds its own WAL-backed store; "
                              "a caller-provided store would be ignored")
@@ -246,7 +298,7 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
     factory.wait_for_cache_sync()
     sched.run()
     return PerfCluster(store, client, factory, sched, server=server,
-                       _tmpdir=tmpdir)
+                       _tmpdir=tmpdir, _proc=proc)
 
 
 # -- workload ops (scheduler_perf_test.go opcodes) -------------------------

@@ -131,6 +131,34 @@ class TestEndpointSliceMirroring:
         assert not [s for s in slices if meta.labels(s).get(
             "kubernetes.io/service-name") == "skip-svc"]
 
+    def test_mirror_survives_service_events_and_slice_deletion(
+            self, cluster):
+        """A Service event must not let the normal EndpointSlice
+        controller delete the mirror (managed-by filter), and a mirror
+        deleted by hand must be recreated (the mirroring controller
+        watches slices)."""
+        _, client, _ = cluster
+        self._custom_endpoints(client, "live-svc")
+
+        def mirror_names():
+            return [meta.name(s) for s in
+                    client.list(ENDPOINTSLICES, "default")[0]
+                    if meta.labels(s).get(
+                        "kubernetes.io/service-name") == "live-svc"]
+        assert wait_for(mirror_names)
+        # poke the Service: annotation edit fires the normal controller
+        def annotate(cur):
+            cur["metadata"].setdefault("annotations", {})["x"] = "y"
+            return cur
+        client.guaranteed_update(SERVICES, "default", "live-svc",
+                                 annotate)
+        time.sleep(0.5)
+        assert mirror_names(), "service event deleted the mirror"
+        # delete the mirror by hand: must come back
+        for nm in mirror_names():
+            client.delete(ENDPOINTSLICES, "default", nm)
+        assert wait_for(mirror_names), "mirror not recreated"
+
     def test_deleting_endpoints_removes_mirror(self, cluster):
         _, client, _ = cluster
         self._custom_endpoints(client, "gone-svc")

@@ -44,3 +44,16 @@ def test_throughput_summary_shape():
     d = summary.to_dict()
     assert {"Average", "Perc50", "Perc90", "Perc99", "TotalPods",
             "DurationSeconds"} <= set(d)
+
+
+def test_front_door_apiserver_process():
+    """via_http="process" runs the apiserver as a separate OS process
+    (the reference's separate-binaries deployment shape): the workload
+    must schedule end-to-end through it, and shutdown must reap the
+    child."""
+    cfg = scale_down(load_workloads()["SchedulingBasic"], 20, 20)
+    summary, stats = run_named_workload(cfg, tpu=True, caps=CAPS,
+                                        batch_size=16,
+                                        via_http="process")
+    assert stats["barrier_ok"]
+    assert summary.total_pods == 20
