@@ -188,6 +188,11 @@ class Kubectl:
         if output == "yaml":
             self.out.write(yaml.safe_dump(items if not name else items[0]))
             return 0
+        if output == "name":
+            # script staple: resource/name lines (cli-runtime -o name)
+            for o in items:
+                self.out.write(f"{resource}/{meta.name(o)}\n")
+            return 0
         wide = output == "wide"
         narrow_h, wide_h, rowfn = PRINTERS.get(
             resource, (["NAME", "STATUS", "AGE"], ["NAME", "STATUS", "AGE"],
@@ -496,6 +501,47 @@ class Kubectl:
                 self.out.write(f"Error: {e}\n")
                 return 1
         self.out.write(f"{resource}/{name} deleted\n")
+        return 0
+
+    def delete_file(self, path: str, namespace: str) -> int:
+        """kubectl delete -f FILE: every object in the manifest stream."""
+        rc = 0
+        for obj in self._load_manifests(path):
+            res = self._kind_to_resource(obj.get("kind", ""))
+            if not res:
+                self.out.write(f"error: unknown kind {obj.get('kind')}\n")
+                rc = 1
+                continue
+            ns = (obj.get("metadata") or {}).get("namespace") or namespace
+            nm = meta.name(obj)
+            try:
+                self.client.delete(res, ns, nm)
+                self.out.write(f"{res}/{nm} deleted\n")
+            except kv.NotFoundError as e:
+                self.out.write(f"Error: {e}\n")
+                rc = 1
+        return rc
+
+    def delete_selector(self, resource: str, selector: str,
+                        namespace: str) -> int:
+        """kubectl delete RESOURCE -l SELECTOR (cli-runtime's selector
+        deletes)."""
+        from ..api.labels import parse_selector
+        resource = self.resolve(resource)
+        compiled = parse_selector(selector)
+        ns = None if resource in ("nodes",) else namespace
+        items, _ = self.client.list(resource, ns)
+        victims = [o for o in items if compiled.matches(meta.labels(o))]
+        if not victims:
+            self.out.write("No resources found\n")
+            return 0
+        for o in victims:
+            try:
+                self.client.delete(resource, meta.namespace(o) or "",
+                                   meta.name(o))
+                self.out.write(f"{resource}/{meta.name(o)} deleted\n")
+            except kv.NotFoundError:
+                pass  # raced another deleter; outcome identical
         return 0
 
     # -- scale / cordon / drain / top ------------------------------------
@@ -1829,7 +1875,7 @@ def build_parser() -> argparse.ArgumentParser:
     g = sub.add_parser("get")
     g.add_argument("resource")
     g.add_argument("name", nargs="?")
-    g.add_argument("-o", "--output", choices=["json", "yaml", "wide"])
+    g.add_argument("-o", "--output", choices=["json", "yaml", "wide", "name"])
     g.add_argument("-l", "--selector", default=None)
     g.add_argument("-A", "--all-namespaces", action="store_true",
                    dest="all_namespaces")
@@ -1851,8 +1897,10 @@ def build_parser() -> argparse.ArgumentParser:
     ks = sub.add_parser("kustomize")
     ks.add_argument("dir")
     dl = sub.add_parser("delete")
-    dl.add_argument("resource")
-    dl.add_argument("name")
+    dl.add_argument("resource", nargs="?")
+    dl.add_argument("name", nargs="?")
+    dl.add_argument("-f", "--filename", default=None)
+    dl.add_argument("-l", "--selector", default=None)
     sc = sub.add_parser("scale")
     sc.add_argument("resource")
     sc.add_argument("name")
@@ -2008,6 +2056,18 @@ def run(argv: list[str] | None = None, client: Client | None = None,
     if args.cmd == "kustomize":
         return k.kustomize(args.dir)
     if args.cmd == "delete":
+        if args.filename:
+            return k.delete_file(args.filename, args.namespace)
+        if args.selector is not None:
+            if not args.resource:
+                out.write("error: delete -l needs a resource\n")
+                return 1
+            return k.delete_selector(args.resource, args.selector,
+                                     args.namespace)
+        if not args.resource or not args.name:
+            out.write("error: delete needs RESOURCE NAME, -f FILE, "
+                      "or RESOURCE -l SELECTOR\n")
+            return 1
         return k.delete(args.resource, args.name, args.namespace)
     if args.cmd == "scale":
         return k.scale(args.resource, args.name, args.namespace, args.replicas)

@@ -266,6 +266,43 @@ class TestCp:
                     "default") == 1
 
 
+class TestDeleteVariants:
+    def test_delete_by_file_and_selector_and_o_name(self, cluster,
+                                                    tmp_path):
+        from kubernetes_tpu.cli.kubectl import run
+        http, _ = cluster
+        mf = tmp_path / "objs.yaml"
+        mf.write_text(
+            "apiVersion: v1\nkind: ConfigMap\n"
+            "metadata: {name: del-a, labels: {grp: del}}\n---\n"
+            "apiVersion: v1\nkind: ConfigMap\n"
+            "metadata: {name: del-b, labels: {grp: del}}\n")
+        out = io.StringIO()
+        assert run(["apply", "-f", str(mf)], client=http, out=out) == 0
+        # -o name output
+        out = io.StringIO()
+        assert run(["get", "configmaps", "-o", "name"],
+                   client=http, out=out) == 0
+        assert "configmaps/del-a" in out.getvalue()
+        # delete -l
+        out = io.StringIO()
+        assert run(["delete", "configmaps", "-l", "grp=del"],
+                   client=http, out=out) == 0
+        assert "del-a" in out.getvalue() and "del-b" in out.getvalue()
+        with pytest.raises(kv.NotFoundError):
+            http.get("configmaps", "default", "del-a")
+        # delete -f round trip
+        out = io.StringIO()
+        assert run(["apply", "-f", str(mf)], client=http, out=out) == 0
+        out = io.StringIO()
+        assert run(["delete", "-f", str(mf)], client=http, out=out) == 0
+        with pytest.raises(kv.NotFoundError):
+            http.get("configmaps", "default", "del-b")
+        # bad invocation
+        out = io.StringIO()
+        assert run(["delete"], client=http, out=out) == 1
+
+
 class TestTopPods:
     def test_top_pods_lists_requests(self, cluster):
         http, local = cluster
