@@ -673,10 +673,21 @@ class APIServer:
                         self._send_json(200, obj)
                     else:
                         sel = r.query.get("labelSelector", [None])[0]
+                        fsel = r.query.get("fieldSelector", [None])[0]
                         items, rv = server.store.list(r.resource, r.ns)
                         if sel:
                             items = [o for o in items
                                      if _matches_selector(o, sel)]
+                        if fsel:
+                            try:
+                                validate_field_selector(fsel)
+                                items = [o for o in items
+                                         if _matches_field_selector(
+                                             o, fsel)]
+                            except ValueError as e:
+                                self._send_json(400, status_error(
+                                    400, "BadRequest", str(e)))
+                                return
                         if self._is_custom(r):
                             # one batched ConversionReview, not N
                             items = server.crds.convert_many(
@@ -755,7 +766,32 @@ class APIServer:
                     self._send_json(400, status_error(
                         400, "BadRequest", f"invalid resourceVersion {raw!r}"))
                     return
+                fsel = q.get("fieldSelector", [None])[0]
+                if fsel:
+                    try:
+                        validate_field_selector(fsel)
+                    except ValueError as e:
+                        self._send_json(400, status_error(
+                            400, "BadRequest", str(e)))
+                        return
                 w = server.store.watch(resource, since_rv=since)
+                # field-filtered watch: a MODIFIED that ENTERS the
+                # selection serves as ADDED, one that LEAVES serves as
+                # DELETED (the reference cacher's watchFilter contract —
+                # the kubelet's spec.nodeName watch sees its pods
+                # "appear" when the scheduler binds them).  Seed the
+                # matched set from current state (AFTER the watch is
+                # registered, so nothing falls between): a client that
+                # listed-then-watched must get leave/delete events for
+                # objects that matched before the stream opened.
+                fsel_matched: set[str] = set()
+                if fsel:
+                    seed_items, _seed_rv = server.store.list(resource,
+                                                             r.ns if r
+                                                             else None)
+                    for o in seed_items:
+                        if _matches_field_selector(o, fsel):
+                            fsel_matched.add(meta.namespaced_name(o))
                 with server._metrics_lock:
                     server.metrics["watch_streams"] += 1
                 self.send_response(200)
@@ -777,6 +813,25 @@ class APIServer:
                                            "object": {"metadata": {}}}
                             else:
                                 obj = ev.object
+                                etype = ev.type
+                                if fsel:
+                                    key = meta.namespaced_name(obj)
+                                    hit = _matches_field_selector(obj,
+                                                                  fsel)
+                                    if etype == kv.DELETED:
+                                        if key not in fsel_matched:
+                                            continue
+                                        fsel_matched.discard(key)
+                                    elif hit and key not in fsel_matched:
+                                        fsel_matched.add(key)
+                                        etype = kv.ADDED  # entered
+                                    elif hit:
+                                        pass  # stays MODIFIED/ADDED
+                                    elif key in fsel_matched:
+                                        fsel_matched.discard(key)
+                                        etype = kv.DELETED  # left
+                                    else:
+                                        continue  # never matched
                                 if r is not None and (
                                         self._is_custom(r)
                                         or self._core_target(r)):
@@ -788,7 +843,7 @@ class APIServer:
                                         # so the client relists
                                         relist = True
                                         break
-                                payload = {"type": ev.type, "object": obj}
+                                payload = {"type": etype, "object": obj}
                             lines.append(json.dumps(payload) + "\n")
                         if lines:
                             # a burst is ONE chunk write + flush, not one
@@ -1952,6 +2007,10 @@ def _scale_of(obj: dict, resource: str) -> dict:
             "status": {"replicas": status.get("replicas", 0),
                        "selector": (spec.get("selector") or {})
                        .get("matchLabels", {})}}
+
+
+from ..api.fields import matches_field_selector as _matches_field_selector
+from ..api.fields import validate_field_selector
 
 
 def _matches_selector(obj: dict, selector: str) -> bool:

@@ -154,13 +154,14 @@ class Kubectl:
 
     def get(self, resource: str, name: str | None, namespace: str,
             output: str | None, selector: str | None = None,
-            all_namespaces: bool = False) -> int:
+            all_namespaces: bool = False,
+            field_selector: str | None = None) -> int:
         resource = self.resolve(resource)
-        if name and (selector or all_namespaces):
-            # matches kubectl: name + -l/-A is a usage error, not a
-            # silently-dropped flag
+        if name and (selector or all_namespaces or field_selector):
+            # matches kubectl: name + -l/-A/--field-selector is a usage
+            # error, not a silently-dropped flag
             self.out.write("Error: a resource cannot be retrieved by "
-                           "name together with -l/-A\n")
+                           "name together with -l/-A/--field-selector\n")
             return 1
         if name:
             try:
@@ -181,6 +182,14 @@ class Kubectl:
             from ..api.labels import parse_selector
             compiled = parse_selector(selector)
             items = [o for o in items if compiled.matches(meta.labels(o))]
+        if field_selector:
+            from ..api.fields import matches_field_selector
+            try:
+                items = [o for o in items
+                         if matches_field_selector(o, field_selector)]
+            except ValueError as e:
+                self.out.write(f"error: {e}\n")
+                return 1
         if output == "json":
             self.out.write(json.dumps(items if not name else items[0],
                                       indent=2, default=str) + "\n")
@@ -1877,6 +1886,8 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("name", nargs="?")
     g.add_argument("-o", "--output", choices=["json", "yaml", "wide", "name"])
     g.add_argument("-l", "--selector", default=None)
+    g.add_argument("--field-selector", dest="field_selector",
+                   default=None)
     g.add_argument("-A", "--all-namespaces", action="store_true",
                    dest="all_namespaces")
     d = sub.add_parser("describe")
@@ -2026,7 +2037,8 @@ def run(argv: list[str] | None = None, client: Client | None = None,
     if args.cmd == "get":
         return k.get(args.resource, args.name, args.namespace, args.output,
                      selector=args.selector,
-                     all_namespaces=args.all_namespaces)
+                     all_namespaces=args.all_namespaces,
+                     field_selector=args.field_selector)
     if args.cmd == "describe":
         return k.describe(args.resource, args.name, args.namespace)
     if args.cmd == "create":

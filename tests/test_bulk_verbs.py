@@ -183,6 +183,106 @@ class TestBulkCreate:
         assert store.get("configmaps", "default", "fresh")
 
 
+class TestFieldSelector:
+    def test_list_filters_by_field(self, server):
+        http, store = server
+        for i in range(4):
+            p = mkpod(f"fs-{i}")
+            if i % 2 == 0:
+                p["spec"]["nodeName"] = "node-a"
+            store.create(PODS, p)
+        got = http._request(
+            "GET", "/api/v1/namespaces/default/pods"
+                   "?fieldSelector=spec.nodeName%3Dnode-a")
+        names = {meta.name(p) for p in got["items"]}
+        assert names == {"fs-0", "fs-2"}
+        got = http._request(
+            "GET", "/api/v1/namespaces/default/pods"
+                   "?fieldSelector=spec.nodeName!%3Dnode-a")
+        names = {meta.name(p) for p in got["items"]}
+        assert names == {"fs-1", "fs-3"}
+        # metadata.name works too (the other common field)
+        got = http._request(
+            "GET", "/api/v1/namespaces/default/pods"
+                   "?fieldSelector=metadata.name%3Dfs-3")
+        assert [meta.name(p) for p in got["items"]] == ["fs-3"]
+
+    def test_watch_translates_enter_and_leave(self, server):
+        """The kubelet contract (kubelet/config/apiserver.go:38): a
+        spec.nodeName=X watch sees a pod APPEAR (ADDED) when the
+        scheduler binds it to X, and DISAPPEAR (DELETED) when it moves
+        away — even though the store event is MODIFIED."""
+        from kubernetes_tpu.client.http_client import HTTPWatch
+        http, store = server
+        w = HTTPWatch(http.host, http.port,
+                      "/api/v1/namespaces/default/pods?watch=true"
+                      "&fieldSelector=spec.nodeName%3Dnode-w",
+                      http._headers)
+        other = mkpod("fw-other")
+        other["spec"]["nodeName"] = "node-z"
+        store.create(PODS, other)       # never matches: invisible
+        store.create(PODS, mkpod("fw-1"))  # unbound: invisible
+        store.bind_many(PODS, [("default", "fw-1", "node-w")])  # enters
+        ev = w.next(timeout=5.0)
+        assert ev is not None
+        assert (ev.type, meta.name(ev.object)) == ("ADDED", "fw-1")
+        # a plain update while matching stays MODIFIED
+        store.guaranteed_update(
+            PODS, "default", "fw-1",
+            lambda p: (p["metadata"].setdefault(
+                "labels", {}).update(x="y") or p))
+        ev = w.next(timeout=5.0)
+        assert ev is not None and ev.type == "MODIFIED"
+        # leaving the selection serves as DELETED
+        store.guaranteed_update(
+            PODS, "default", "fw-1",
+            lambda p: (p["spec"].__setitem__("nodeName", "node-z") or p))
+        ev = w.next(timeout=5.0)
+        assert ev is not None
+        assert (ev.type, meta.name(ev.object)) == ("DELETED", "fw-1")
+        w.stop()
+
+
+    def test_watch_seeded_for_preexisting_matches(self, server):
+        """List-then-watch: an object that matched BEFORE the stream
+        opened must produce leave/delete events (the matched set is
+        seeded, not built only from observed events)."""
+        from kubernetes_tpu.client.http_client import HTTPWatch
+        http, store = server
+        pre = mkpod("fw-pre")
+        pre["spec"]["nodeName"] = "node-s"
+        created = store.create(PODS, pre)
+        rv = meta.resource_version(created)
+        w = HTTPWatch(http.host, http.port,
+                      f"/api/v1/namespaces/default/pods?watch=true"
+                      f"&resourceVersion={rv}"
+                      f"&fieldSelector=spec.nodeName%3Dnode-s",
+                      http._headers)
+        store.delete(PODS, "default", "fw-pre")
+        ev = w.next(timeout=5.0)
+        assert ev is not None
+        assert (ev.type, meta.name(ev.object)) == ("DELETED", "fw-pre")
+        w.stop()
+
+    def test_falsy_present_values_match(self, server):
+        http, store = server
+        p = mkpod("fz")
+        p["spec"]["priority"] = 0
+        store.create(PODS, p)
+        got = http._request(
+            "GET", "/api/v1/namespaces/default/pods"
+                   "?fieldSelector=spec.priority%3D0")
+        assert [meta.name(o) for o in got["items"]] == ["fz"]
+
+    def test_malformed_selector_is_400(self, server):
+        http, _ = server
+        from kubernetes_tpu.client.http_client import HTTPError
+        with pytest.raises(HTTPError):
+            http._request(
+                "GET", "/api/v1/namespaces/default/pods"
+                       "?fieldSelector=nosuchoperator")
+
+
 class TestWatchBatching:
     def test_burst_arrives_as_one_batch(self, server):
         http, store = server
