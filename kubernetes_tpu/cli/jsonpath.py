@@ -1,0 +1,118 @@
+"""kubectl's JSONPath output dialect — the load-bearing subset.
+
+Reference: client-go util/jsonpath (kubectl -o jsonpath=TEMPLATE).
+Supported:
+  {.path.to.field}            dotted lookups
+  {.items[0].metadata.name}   array indexing
+  {.items[*].metadata.name}   wildcard (results joined by spaces)
+  {range .items[*]}...{end}   iteration; inner {.x} paths are relative
+  {"literal"}                 quoted literals ("\n", "\t" unescaped)
+  plain text between expressions passes through
+
+Unsupported syntax raises JSONPathError — a typo'd template must not
+silently print nothing.
+"""
+
+from __future__ import annotations
+
+import re
+
+
+class JSONPathError(Exception):
+    pass
+
+
+_TOKEN = re.compile(r"\{([^{}]*)\}")
+_STEP = re.compile(r"\.([^.\[\]]+)|\[(\*|-?\d+)\]")
+
+
+def _walk(nodes: list, path: str) -> list:
+    """Apply a path expression ('.a.b[*].c') to a node list."""
+    path = path.strip()
+    if path in ("", "."):
+        return nodes
+    if not (path.startswith(".") or path.startswith("[")):
+        raise JSONPathError(f"path must start with '.': {path!r}")
+    pos = 0
+    while pos < len(path):
+        m = _STEP.match(path, pos)
+        if m is None:
+            raise JSONPathError(f"bad path segment at {path[pos:]!r}")
+        pos = m.end()
+        key, idx = m.group(1), m.group(2)
+        out = []
+        for n in nodes:
+            if key is not None:
+                if isinstance(n, dict) and key in n:
+                    out.append(n[key])
+            elif idx == "*":
+                if isinstance(n, list):
+                    out.extend(n)
+                elif isinstance(n, dict):
+                    out.extend(n.values())
+            else:
+                if isinstance(n, list):
+                    try:
+                        out.append(n[int(idx)])
+                    except IndexError:
+                        pass
+        nodes = out
+    return nodes
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return ""
+    if isinstance(v, (dict, list)):
+        import json
+        return json.dumps(v)
+    return str(v)
+
+
+def evaluate(template: str, obj) -> str:
+    """Render a jsonpath template against obj."""
+    out: list[str] = []
+    pos = 0
+    tokens: list[tuple[str, str]] = []  # ("text"|"expr", payload)
+    for m in _TOKEN.finditer(template):
+        if m.start() > pos:
+            tokens.append(("text", template[pos:m.start()]))
+        tokens.append(("expr", m.group(1).strip()))
+        pos = m.end()
+    if pos < len(template):
+        tokens.append(("text", template[pos:]))
+
+    def emit(kind: str, payload: str, scope: list) -> None:
+        if kind == "text":
+            out.append(payload)
+        elif payload.startswith('"') and payload.endswith('"'):
+            out.append(payload[1:-1]
+                       .replace("\\n", "\n").replace("\\t", "\t"))
+        else:
+            out.append(" ".join(_fmt(v)
+                                for v in _walk(scope, payload)))
+
+    i = 0
+    while i < len(tokens):
+        kind, payload = tokens[i]
+        if kind == "expr" and payload.startswith("range"):
+            loop_path = payload[len("range"):].strip()
+            # find the matching {end} (no nesting in the subset)
+            try:
+                end = next(j for j in range(i + 1, len(tokens))
+                           if tokens[j] == ("expr", "end"))
+            except StopIteration:
+                raise JSONPathError("range without matching {end}")
+            body = tokens[i + 1:end]
+            for item in _walk([obj], loop_path):
+                for k, p in body:
+                    emit(k, p, [item])
+            i = end + 1
+            continue
+        if kind == "expr" and payload == "end":
+            raise JSONPathError("{end} without {range}")
+        emit(kind, payload, [obj])
+        i += 1
+    return "".join(out)

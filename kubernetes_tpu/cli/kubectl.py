@@ -202,6 +202,22 @@ class Kubectl:
             for o in items:
                 self.out.write(f"{resource}/{meta.name(o)}\n")
             return 0
+        if output and output.startswith("jsonpath="):
+            from .jsonpath import JSONPathError, evaluate
+            root = items[0] if name else {
+                "kind": "List", "apiVersion": "v1", "items": items}
+            try:
+                text = evaluate(output[len("jsonpath="):], root)
+            except JSONPathError as e:
+                self.out.write(f"error: {e}\n")
+                return 1
+            self.out.write(text)
+            if text and not text.endswith("\n"):
+                self.out.write("\n")
+            return 0
+        if output not in (None, "wide"):
+            self.out.write(f"error: unknown output format {output!r}\n")
+            return 1
         wide = output == "wide"
         narrow_h, wide_h, rowfn = PRINTERS.get(
             resource, (["NAME", "STATUS", "AGE"], ["NAME", "STATUS", "AGE"],
@@ -1884,7 +1900,8 @@ def build_parser() -> argparse.ArgumentParser:
     g = sub.add_parser("get")
     g.add_argument("resource")
     g.add_argument("name", nargs="?")
-    g.add_argument("-o", "--output", choices=["json", "yaml", "wide", "name"])
+    g.add_argument("-o", "--output",
+                   help="json|yaml|wide|name|jsonpath=TEMPLATE")
     g.add_argument("-l", "--selector", default=None)
     g.add_argument("--field-selector", dest="field_selector",
                    default=None)

@@ -266,6 +266,50 @@ class TestCp:
                     "default") == 1
 
 
+class TestJsonPath:
+    def test_jsonpath_outputs(self, cluster):
+        """Runs in its own namespace — the module-scoped cluster holds
+        other tests' configmaps."""
+        from kubernetes_tpu.cli.kubectl import run
+        http, _ = cluster
+        try:
+            http.create("namespaces",
+                        meta.new_object("Namespace", "jp", ""))
+        except kv.AlreadyExistsError:
+            pass
+        for i in range(3):
+            cm = meta.new_object("ConfigMap", f"jp-{i}", "jp")
+            cm["data"] = {"n": str(i)}
+            http.create("configmaps", cm)
+        out = io.StringIO()
+        assert run(["-n", "jp", "get", "configmaps", "-o",
+                    "jsonpath={.items[*].metadata.name}"],
+                   client=http, out=out) == 0
+        assert out.getvalue().strip() == "jp-0 jp-1 jp-2"
+        # range/end with literal newline
+        out = io.StringIO()
+        assert run(["-n", "jp", "get", "configmaps", "-o",
+                    'jsonpath={range .items[*]}{.metadata.name}'
+                    '{"\\n"}{end}'], client=http, out=out) == 0
+        lines = [l for l in out.getvalue().splitlines() if l]
+        assert lines == ["jp-0", "jp-1", "jp-2"]
+        # single object + index
+        out = io.StringIO()
+        assert run(["-n", "jp", "get", "configmaps", "jp-1", "-o",
+                    "jsonpath={.data.n}"], client=http, out=out) == 0
+        assert out.getvalue().strip() == "1"
+        # malformed template errors (never silently empty)
+        out = io.StringIO()
+        assert run(["-n", "jp", "get", "configmaps", "-o",
+                    "jsonpath={range .items[*]}{.x}"],
+                   client=http, out=out) == 1
+        assert "range" in out.getvalue()
+        # unknown -o rejected
+        out = io.StringIO()
+        assert run(["-n", "jp", "get", "configmaps", "-o", "banana"],
+                   client=http, out=out) == 1
+
+
 class TestDeleteVariants:
     def test_delete_by_file_and_selector_and_o_name(self, cluster,
                                                     tmp_path):
