@@ -20,6 +20,12 @@ from kubernetes_tpu.testing import make_pod, wait_for
 
 
 def mkpair(**hub_kw):
+    # generous sync timeout: the PRODUCT degrades to async when a
+    # follower is slow (by design), but on this 1-CPU box under
+    # full-suite load the follower thread can legitimately take >2s to
+    # be scheduled — the zero-loss tests must never hit the degradation
+    # path, or the "acked write" premise stops holding
+    hub_kw.setdefault("sync_timeout", 30.0)
     primary = kv.MemoryStore(history=10_000)
     hub = ReplicationHub(primary, **hub_kw).start()
     follower = FollowerStore(history=10_000)
@@ -160,7 +166,7 @@ class TestFailover:
         replicated state (replicated records re-enter the follower's
         WAL, not just its tables)."""
         primary = kv.MemoryStore(history=10_000)
-        hub = ReplicationHub(primary).start()
+        hub = ReplicationHub(primary, sync_timeout=30.0).start()
         follower = FollowerStore(durable_dir=str(tmp_path))
         follower.follow(*hub.address)
         for i in range(25):
