@@ -1,6 +1,7 @@
 """kubectl's JSONPath output dialect — the load-bearing subset.
 
-Reference: client-go util/jsonpath (kubectl -o jsonpath=TEMPLATE).
+Reference: staging/src/k8s.io/client-go/util/jsonpath/jsonpath.go
+(kubectl -o jsonpath=TEMPLATE).
 Supported:
   {.path.to.field}            dotted lookups
   {.items[0].metadata.name}   array indexing

@@ -290,6 +290,30 @@ class ClusterTensors:
             vocab = self.domain_vocabs[topo_key] = Vocab(self.caps.n_cap)
         return vocab.get(value)
 
+    def _dom_row_for_key(self, key: str,
+                         exclude: GroupBucket | None = None) -> np.ndarray:
+        """[n_cap] domain-id row for a topology key.
+
+        The row depends only on (key, node labels) — never on the group —
+        so any existing bucket with the same key already holds it; copy
+        instead of touching every node again (a high-cardinality flood
+        registers thousands of same-key groups in one encode pass).
+        `exclude` is the bucket being registered (its row is not yet
+        encoded)."""
+        for arr, buckets in ((self.dom_sg, self.sgs),
+                             (self.dom_asg, self.asgs)):
+            for j, b in enumerate(buckets):
+                if b.topology_key == key and b is not exclude:
+                    return arr[j].copy()
+        row = np.full(self.caps.n_cap, -1, np.int32)
+        for r, ni in enumerate(self.node_infos):
+            if ni is None or not self.valid[r] or ni.node is None:
+                continue
+            val = meta.labels(ni.node).get(key)
+            if val is not None:
+                row[r] = self.domain_id(key, val)
+        return row
+
     @staticmethod
     def _probe_bucket(buckets: list[GroupBucket],
                       group: SelectorGroup) -> int | None:
@@ -339,6 +363,7 @@ class ClusterTensors:
         if len(self.sgs) < self.caps.sg_cap:
             idx = len(self.sgs)
             self.sgs.append(GroupBucket(group, allow_share=shareable))
+            is_new_bucket = True
         else:
             if not shareable:
                 return None
@@ -346,14 +371,35 @@ class ClusterTensors:
             if idx is None:
                 return None
             self.sgs[idx].groups.append(group)
+            is_new_bucket = False
         self._sg_ids[group.key()] = idx
         self._index_group(self._sg_kv_index, self._sg_complex, idx, group)
+        # Registration cost discipline (a 2000-service flood registers
+        # its whole vocabulary inside ONE batch encode): a new bucket
+        # copies/derives its dom row in one vectorized step; a JOIN can
+        # only change counts on nodes that hold pods matching the new
+        # member, so empty nodes are skipped and nothing is bumped when
+        # nothing changed (the bump would force a static re-upload and a
+        # pipeline flush PER REGISTRATION — measured 26s of a 26s
+        # high-cardinality run before this).
+        bucket = self.sgs[idx]
+        if is_new_bucket:
+            self.dom_sg[idx] = self._dom_row_for_key(bucket.topology_key,
+                                                     exclude=bucket)
+        changed = is_new_bucket
         for row, ni in enumerate(self.node_infos):
-            if ni is not None and self.valid[row]:
-                self._encode_sg_row(idx, row, ni)
-        self.version += 1
-        self.static_version += 1  # dom_sg rows changed
-        self.static_full = True
+            if ni is None or not self.valid[row] or not ni.pods:
+                continue
+            new = sum(1 for pi in ni.pods
+                      if not meta.deletion_timestamp(pi.pod)
+                      and bucket.matches_pod(pi))
+            if new != self.cnt_sg[idx, row]:
+                self.cnt_sg[idx, row] = new
+                changed = True
+        if changed:
+            self.version += 1
+            self.static_version += 1  # dom_sg/cnt_sg rows changed
+            self.static_full = True
         return idx
 
     def register_asg(self, group: SelectorGroup) -> int | None:
@@ -365,20 +411,41 @@ class ClusterTensors:
             # asg counts only ever BLOCK (existing-pod anti-affinity),
             # so every asg slot is shareable
             self.asgs.append(GroupBucket(group, allow_share=True))
+            is_new_bucket = True
         else:
             idx = self._probe_bucket(self.asgs, group)
             if idx is None:
                 return None
             self.asgs[idx].groups.append(group)
+            is_new_bucket = False
         self._asg_ids[group.key()] = idx
         self._index_group(self._asg_kv_index, self._asg_complex, idx,
                           group)
+        # same registration cost discipline as register_sg: vectorized
+        # dom row for new buckets, count deltas only on nodes that hold
+        # anti-affinity pods, version bumps only when something changed
+        if is_new_bucket:
+            self.dom_asg[idx] = self._dom_row_for_key(
+                group.topology_key, exclude=self.asgs[idx])
+        ids = self._asg_ids
+        changed = is_new_bucket
         for row, ni in enumerate(self.node_infos):
-            if ni is not None and self.valid[row]:
-                self._encode_asg_row(idx, row, ni)
-        self.version += 1
-        self.static_version += 1  # dom_asg rows changed
-        self.static_full = True
+            if (ni is None or not self.valid[row]
+                    or not ni.pods_with_required_anti_affinity):
+                continue
+            n = 0
+            for pi in ni.pods_with_required_anti_affinity:
+                for term in pi.required_anti_affinity_terms:
+                    if ids.get((term.topology_key, term.selector,
+                                term.namespaces)) == idx:
+                        n += 1
+            if n != self.cnt_asg[idx, row]:
+                self.cnt_asg[idx, row] = n
+                changed = True
+        if changed:
+            self.version += 1
+            self.static_version += 1  # dom_asg/cnt_asg rows changed
+            self.static_full = True
         return idx
 
     # -- node encoding ---------------------------------------------------

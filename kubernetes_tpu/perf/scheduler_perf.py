@@ -542,6 +542,10 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         summary = collector.stop()
         stats["wall"] = time.monotonic() - t0
         stats["e2e"] = cluster.scheduler.metrics.e2e_summary()
+        from ..utils import stagelat
+        if stagelat.ENABLED:
+            stats["stage_latency"] = stagelat.summary()
+            stagelat.reset()  # don't bleed into the next workload
         for p in cluster.scheduler.profiles.values():
             if p.batch_backend is not None:
                 stats["backend_stats"] = dict(p.batch_backend.stats)

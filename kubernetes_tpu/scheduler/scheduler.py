@@ -33,6 +33,7 @@ from ..api.meta import Obj
 from ..client.clientset import Client, NODES, PODS
 from ..client.informer import SharedInformerFactory
 from ..store import kv
+from ..utils import stagelat
 from . import metrics as _metrics
 from .cache import Cache, Snapshot
 from .framework import CycleState, Framework, Handle
@@ -232,6 +233,12 @@ class Scheduler:
         # (pkg/scheduler/metrics pod_scheduling_duration is the metric
         # this shapes).
         self._pending: list = []
+        # adaptive estimate of dispatch -> result-landed latency, used to
+        # time-gate eager batch retirement (see schedule_step); starts at
+        # the tunneled chip's typical ~2x round-trip flight
+        self._flight_est = 0.25
+        self._last_resolve_block = 0.0
+        self._last_resolve_waited = False
         self.pipeline_depth = max(1, pipeline_depth)
         self.admission_interval = admission_interval
         self._deferred: list[QueuedPodInfo] = []  # per-pod pods awaiting a quiescent cache
@@ -465,6 +472,36 @@ class Scheduler:
                 # batch (blocks on its device result; pods accumulate in
                 # the queue meanwhile — the pipeline's natural pacing)
                 self._finish_batch(*self._pending.pop(0))
+            # eager retirement (oldest-first, order preserved for the
+            # backend's resident-state chain): a batch whose device result
+            # has had time to land is retired now instead of riding the
+            # pipeline to the depth cap — cutting its pods' latency by the
+            # remaining pipeline residency.  Readiness is TIME-gated on an
+            # adaptive flight estimate rather than jax.Array.is_ready():
+            # on the tunneled device is_ready() is unreliable (observed
+            # lying True before the data exists) and polling it from this
+            # loop correlated with multi-second transfer stalls.  A low
+            # estimate just means _finish_batch briefly blocks on the
+            # pull; the estimate then adapts upward.
+            now = time.monotonic()
+            while self._pending and (now - self._pending[0][4]
+                                     >= self._flight_est):
+                age = now - self._pending[0][4]
+                self._finish_batch(*self._pending.pop(0))
+                # Adapt on whether resolve actually waited on the device
+                # (_last_resolve_waited separates device wait from host
+                # decode, which scales with batch size).  `age` is always
+                # >= the estimate inside this loop, so the raise branch
+                # alone would ratchet monotonically — the waited/landed
+                # distinction is what lets the estimate come back down
+                # toward the true flight when results land early.
+                if self._last_resolve_waited:
+                    self._flight_est = min(
+                        2.0, 0.5 * self._flight_est
+                        + 0.5 * (age + self._last_resolve_block))
+                else:
+                    self._flight_est = max(0.05, self._flight_est * 0.95)
+                now = time.monotonic()
             return len(batch)
         qpi = self.queue.pop(timeout)
         if qpi is None:
@@ -919,6 +956,9 @@ class Scheduler:
         # from cache NodeInfos under the cache lock — no Snapshot clone on
         # the batch path (the per-pod oracle keeps its immutable Snapshot)
         view = self.cache.flatten_view()
+        if stagelat.ENABLED:
+            stagelat.record("queue_wait",
+                            sum(start - q.timestamp for q in live) / len(live))
         resolve = backend.dispatch([q.pod_info for q in live], view)
         if resolve is FLUSH_FIRST:
             # the batch needs device-state repair; drain the in-flight batch
@@ -928,6 +968,10 @@ class Scheduler:
             resolve = backend.dispatch([q.pod_info for q in live], view)
             if resolve is FLUSH_FIRST:  # pragma: no cover - nothing in flight
                 raise RuntimeError("backend demanded flush with empty pipeline")
+        if stagelat.ENABLED:
+            # covers the FLUSH_FIRST re-dispatch too (the flush drain time
+            # lands here rather than in pipeline_wait)
+            stagelat.record("dispatch_host", time.monotonic() - start)
         return profile, live, resolve, cycle, start
 
     def _finish_batch(self, profile: Profile, live: list[QueuedPodInfo],
@@ -943,7 +987,21 @@ class Scheduler:
         written back through one bulk store transaction instead of one
         guaranteed-update per pod."""
         fw = profile.framework
+        t_enter = time.monotonic()
         results = resolve()
+        resolve_block = time.monotonic() - t_enter
+        # Did resolve actually WAIT on the device, or was the result
+        # already landed and the block pure host decode?  Decode cost
+        # scales with batch size (~2µs/pod of unpack/replay), so the
+        # threshold must too — a fixed few-ms cutoff misreads a large
+        # batch's decode as a device wait and the eager-retirement gate
+        # then ratchets upward until it self-disables.
+        self._last_resolve_waited = (
+            resolve_block > 0.002 + 2e-6 * len(live))
+        self._last_resolve_block = resolve_block
+        if stagelat.ENABLED:
+            stagelat.record("pipeline_wait", t_enter - start)
+            stagelat.record("resolve_block", resolve_block)
         bulk: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         # phase 1: collect placements; failures/escapes handled per pod
         placed: list[tuple[QueuedPodInfo, str, Obj, PodInfo]] = []
@@ -1110,6 +1168,8 @@ class Scheduler:
         self.cache.finish_bindings([a for _, _, _, a in bound])
         now = time.monotonic()
         latency = now - start
+        if stagelat.ENABLED:
+            stagelat.record("disp_to_bound", latency)
         self.metrics.observe_e2e(
             [(now - q.initial_attempt_timestamp, q.attempts)
              for _, q, _, _ in bound])
