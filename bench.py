@@ -173,15 +173,22 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
     from kubernetes_tpu.ops.flatten import Caps
     from kubernetes_tpu.perf import load_workloads, run_named_workload
 
+    from kubernetes_tpu.perf.scheduler_perf import is_measured
+
     cfg = copy.deepcopy(load_workloads()[workload])
-    for op in cfg["workloadTemplate"]:
+    tpl = cfg["workloadTemplate"]
+    # count/rate overrides target the MEASURED createPods only: warm-up
+    # ops (no collectMetrics; see performance-config.yaml) keep their
+    # small configured size
+    for op in tpl:
+        measured = is_measured(op, tpl)
         if op["opcode"] == "createNodes" and nodes is not None:
             op["count"] = nodes
-        elif op["opcode"] == "createPods" and pods is not None:
+        elif op["opcode"] == "createPods" and measured and pods is not None:
             op["count"] = pods
         elif op["opcode"] == "barrier":
             op["timeout"] = barrier_timeout
-        if op["opcode"] == "createPods" and rate:
+        if op["opcode"] == "createPods" and measured and rate:
             op["ratePerSecond"] = rate
     n_nodes = next(op["count"] for op in cfg["workloadTemplate"]
                    if op["opcode"] == "createNodes")

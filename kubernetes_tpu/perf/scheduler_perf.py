@@ -73,6 +73,7 @@ class ThroughputCollector:
         self._count_lock = threading.Lock()
         self._scheduled: set[str] = set()
         self._watch: kv.Watch | None = None
+        self._base = 0            # pods already bound when start() ran
         self._frozen_at = 0.0     # freeze(): end of the measured window
         self._frozen_count = 0
         self._frozen_samples: list[float] = []
@@ -81,6 +82,12 @@ class ThroughputCollector:
         """Pods bound since start() (drain-backed; cheap)."""
         with self._count_lock:
             return self._count
+
+    def bound_total(self) -> int:
+        """ALL bound pods: pre-start (warm-up ops) + since start().
+        Barriers use this; the throughput window uses scheduled_total."""
+        with self._count_lock:
+            return self._base + self._count
 
     def _drain(self) -> None:
         evs = self._watch.next_batch(timeout=0.05)
@@ -114,9 +121,19 @@ class ThroughputCollector:
 
     def start(self) -> None:
         self._start_time = time.monotonic()
-        # watch BEFORE the workload's first create: nothing is in flight,
-        # so "from now" misses no binds
+        # watch first, then count what was already bound (warm-up ops
+        # before the measured one): a bind landing between the two is
+        # seen by BOTH, so seed the dedup set from the scan — it can
+        # only overcount the base, never undercount bound_total
         self._watch = self.store.watch(PODS)
+        items, _rv = self.store.list(PODS, None)
+        for o in items:
+            if (o.get("spec") or {}).get("nodeName"):
+                md = o["metadata"]
+                ns = md.get("namespace", "")
+                self._scheduled.add(f"{ns}/{md['name']}" if ns
+                                    else md["name"])
+        self._base = len(self._scheduled)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -417,8 +434,8 @@ def wait_for_pods_scheduled(cluster: PerfCluster, want: int,
     pipeline at 100k+ pods."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
-        if collector is not None and namespace is None:
-            n = collector.scheduled_total()
+        if collector is not None and collector.started and namespace is None:
+            n = collector.bound_total()
         else:
             items, _ = cluster.store.list(PODS, namespace)
             n = sum(1 for p in items if meta.pod_node_name(p))
@@ -426,6 +443,20 @@ def wait_for_pods_scheduled(cluster: PerfCluster, want: int,
             return True
         time.sleep(0.05)
     return False
+
+
+def is_measured(op: dict, ops: list[dict]) -> bool:
+    """Reference collectMetrics semantics (scheduler_perf_test.go:716-751):
+    when any createPods op declares collectMetrics, ONLY those ops are
+    measured — earlier createPods are warm-up, outside the throughput
+    window.  Templates without the flag keep the old behavior (every
+    createPods measured, the first opens the window).  Shared by the
+    harness and bench.py's count/rate overrides so they can't diverge."""
+    if op.get("opcode", "createPods") != "createPods":
+        return False
+    any_cm = any(o.get("collectMetrics") for o in ops
+                 if o.get("opcode") == "createPods")
+    return op.get("collectMetrics", not any_cm)
 
 
 def run_workload(cluster: PerfCluster, ops: list[dict],
@@ -442,10 +473,23 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
                          _default_node, op)
             created_nodes += op["count"]
         elif opcode == "createPods":
-            if collector is not None and not collector.started:
+            if collector is not None and not collector.started \
+                    and is_measured(op, ops):
                 # measurement window opens with the first measured pods
                 # (reference: CollectMetrics on the createPods op)
                 collector.start()
+                if hasattr(cluster.scheduler, "metrics"):
+                    # the warm-up barrier saw the binds in the STORE; the
+                    # scheduler records each e2e entry only after its bulk
+                    # commit returns, so briefly wait for the metric to
+                    # catch up or in-flight warm-up latencies would land
+                    # after the watermark and pollute the measured e2e
+                    m = cluster.scheduler.metrics
+                    deadline = time.monotonic() + 5.0
+                    while (m.e2e_mark() < created_pods
+                           and time.monotonic() < deadline):
+                        time.sleep(0.005)
+                    stats["e2e_mark"] = m.e2e_mark()
             rate = op.get("ratePerSecond")
             if rate:
                 # paced arrival (the reference harness's client-QPS knob,
@@ -541,7 +585,8 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
             collector.start()
         summary = collector.stop()
         stats["wall"] = time.monotonic() - t0
-        stats["e2e"] = cluster.scheduler.metrics.e2e_summary()
+        stats["e2e"] = cluster.scheduler.metrics.e2e_summary(
+            since=stats.get("e2e_mark", 0))
         from ..utils import stagelat
         if stagelat.ENABLED:
             stats["stage_latency"] = stagelat.summary()

@@ -110,10 +110,19 @@ class SchedulerMetrics:
         for att, ls in by_attempts.items():
             self.prom.pod_scheduling_duration.observe_many(ls, att)
 
-    def e2e_summary(self) -> dict:
-        """Percentiles over all recorded submit->bind latencies (ms)."""
+    def e2e_mark(self) -> int:
+        """Watermark into the e2e buffer; pass to e2e_summary(since=...)
+        to report only pods bound after this point (the perf harness
+        excludes warm-up pods this way, like the reference's
+        collectMetrics gating)."""
         with self.lock:
-            xs = sorted(self.pod_e2e_latencies)
+            return len(self.pod_e2e_latencies)
+
+    def e2e_summary(self, since: int = 0) -> dict:
+        """Percentiles over recorded submit->bind latencies (ms),
+        optionally only entries recorded after the `since` watermark."""
+        with self.lock:
+            xs = sorted(self.pod_e2e_latencies[since:])
         if not xs:
             return {}
         def pct(p: float) -> float:

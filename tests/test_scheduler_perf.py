@@ -38,6 +38,26 @@ def test_workloads_small(name, tpu):
     assert summary.average > 0
 
 
+@pytest.mark.parametrize("tpu", [False, True], ids=["per-pod", "tpu-batch"])
+def test_warmup_pods_outside_measured_window(tpu):
+    """collectMetrics gating (scheduler_perf_test.go:716-751): warm-up
+    createPods run BEFORE the window opens — the throughput summary and
+    the e2e percentiles cover only the measured op's pods."""
+    cfg = {"workloadTemplate": [
+        {"opcode": "createNodes", "count": 40},
+        {"opcode": "createPods", "count": 25},          # warm-up
+        {"opcode": "barrier", "timeout": 60.0},
+        {"opcode": "createPods", "count": 30, "collectMetrics": True},
+        {"opcode": "barrier", "timeout": 60.0},
+    ]}
+    summary, stats = run_named_workload(cfg, tpu=tpu, caps=CAPS,
+                                        batch_size=16)
+    assert stats["barrier_ok"]            # ALL 55 pods bound...
+    assert stats["created_pods"] == 55
+    assert summary.total_pods == 30       # ...but only 30 measured
+    assert stats["e2e"]["count"] == 30    # e2e excludes warm-up binds
+
+
 def test_throughput_summary_shape():
     cfg = scale_down(load_workloads()["SchedulingBasic"], 10, 10)
     summary, _ = run_named_workload(cfg, tpu=False)
