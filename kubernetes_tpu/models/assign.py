@@ -950,7 +950,8 @@ def _apply_patches(state: dict, prow, pval, caps: Caps):
 
 def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
                            weights: dict[str, float] | None = None,
-                           features: frozenset = ALL_FEATURES):
+                           features: frozenset = ALL_FEATURES,
+                           max_waves: int | None = None):
     """fn(state, static_node, buf) -> (new_state, result).
     `state` is device-resident and donated; `buf` is the single per-batch
     upload produced by pack_pod_batch.  `result` is int32[p_cap+1]:
@@ -959,15 +960,20 @@ def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
     transfer (a second scalar pull costs a full tunnel round trip).
     `features` selects a specialized kernel variant (the backend keeps one
     per feature set and picks per batch based on what the batch actually
-    uses)."""
+    uses).  `max_waves` overrides the wave ceiling: the backend caps the
+    MAIN constraint kernel at a few waves and drains the straggler tail
+    through a small retry kernel instead (a tail wave at full [P,N] cost
+    admits a handful of pods; see TPUBatchBackend retry path)."""
     spec = PackSpec(caps, p_cap, k_cap, plain=(features == PLAIN_FEATURES))
-    # wave ceiling: constraint batches can legitimately need many waves
-    # (hard spread admits ~domains*maxSkew pods per wave), and the loop
-    # exits the moment nothing is active or progress stops — so for the
-    # constraint-carrying variant the cap is p_cap (the absolute worst
-    # case of one forced serialization per wave), while the plain variant
-    # converges in O(contention) and keeps a tight bound
-    max_waves = 128 if features == PLAIN_FEATURES else max(128, p_cap)
+    if max_waves is None:
+        # wave ceiling: constraint batches can legitimately need many
+        # waves (hard spread admits ~domains*maxSkew pods per wave), and
+        # the loop exits the moment nothing is active or progress stops —
+        # so for the constraint-carrying variant the cap is p_cap (the
+        # absolute worst case of one forced serialization per wave),
+        # while the plain variant converges in O(contention) and keeps a
+        # tight bound
+        max_waves = 128 if features == PLAIN_FEATURES else max(128, p_cap)
     core = _make_wave_core(caps, {"fit": 1.0, "balanced": 1.0, "spread": 2.0,
                                   "affinity": 1.0, "taint": 1.0,
                                   **(weights or {})}, _Comm(None), max_waves,

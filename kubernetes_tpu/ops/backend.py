@@ -339,6 +339,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         self.full_cap = min(full_batch_cap, batch_size)
         self._fn_full = None   # built lazily / in warmup
         self._spec_full = None
+        self._fn_full_small = None   # straggler retry kernel (lazy)
+        self._spec_full_small = None
         self._spec_plain = None
         self._static_sel = None   # selector-side static arrays (lazy)
         self._sel_stale = True
@@ -388,6 +390,11 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             a = self._device_step("full", pack_pod_batch(
                 slice_pod_batch(batch, 0, 0, self.full_cap),
                 self._spec_full, *empty))
+            if self.FULL_MAIN_WAVES:
+                self._ensure_full_small()
+                a = self._device_step("full_small", pack_pod_batch(
+                    slice_pod_batch(batch, 0, 0, self._retry_cap()),
+                    self._spec_full_small, *empty))
             self._ensure_plain()
             a = self._device_step("plain", pack_pod_batch(
                 batch, self._spec_plain, *empty))
@@ -405,6 +412,10 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         if variant == "full":
             self._ensure_sel()
             fn = self._fn_full
+            static = {**self._static_node, **self._static_sel}
+        elif variant == "full_small":
+            self._ensure_sel()
+            fn = self._ensure_full_small()
             static = {**self._static_node, **self._static_sel}
         else:
             fn = self._fn_plain
@@ -430,11 +441,47 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                                 for k in STATIC_SEL}
             self._sel_stale = False
 
+    # MAIN constraint-kernel wave cap: the first couple of waves admit
+    # ~98% of a batch (water-filling + multi-claim prefix sums); the tail
+    # waves each admit a handful of stragglers at full [P,N] cost.
+    # Setting a cap (e.g. 3) drains that tail through the small retry
+    # kernel (resolve()) instead — a win ONLY when a device call is
+    # cheap: each retry chunk is its own device round trip, so over the
+    # ~100-300ms tunnel the extra RTs cost more than the in-call tail
+    # waves they replace (A/B on the tunnel: TopologySpreading 9.1k
+    # pods/s uncapped vs 3.6k with cap 3).  Default 0 = uncapped main
+    # kernel, no retry; direct-attached deployments (~0.1ms dispatch)
+    # should set KTPU_FULL_MAIN_WAVES=3.
+    FULL_MAIN_WAVES = int(os.environ.get("KTPU_FULL_MAIN_WAVES", "0"))
+    RETRY_ROUNDS_MAX = 32  # defensive bound; rounds stop at no-progress
+
     def _ensure_full(self):
         if self._fn_full is None:
             self._fn_full, self._spec_full = build_packed_assign_fn(
-                self.caps, self.full_cap, self._k_cap, self._weights)
+                self.caps, self.full_cap, self._k_cap, self._weights,
+                max_waves=self.FULL_MAIN_WAVES or None)
         return self._fn_full
+
+    def _retry_cap(self) -> int:
+        # Small: straggler waves serialize hard when every leftover
+        # claims the current-min spread domain (the level floor is held
+        # by domains with no candidates, so ~maxSkew pods admit per
+        # wave) — P=128 makes such a wave ~16x cheaper than P=512, and
+        # chunk-to-chunk state chaining re-balances claims between
+        # chunks anyway.
+        return min(128, self.full_cap)
+
+    def _ensure_full_small(self):
+        """The straggler retry kernel: same constraint wave body at a
+        small P with the EXHAUSTIVE wave budget, so capped-main leftovers
+        drain at ~(P_small/P)^2 of a main wave's cost and the
+        no-progress fixpoint guarantee is preserved."""
+        if self._fn_full_small is None:
+            self._fn_full_small, self._spec_full_small = \
+                build_packed_assign_fn(
+                    self.caps, self._retry_cap(), self._k_cap,
+                    self._weights)
+        return self._fn_full_small
 
     def _ensure_plain(self):
         if self._fn_plain is None:
@@ -638,6 +685,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             # the live tensors
             row_infos = list(self.tensors.node_infos)
 
+        was_full = self._needs_full(batch)
+
         def resolve() -> list[tuple[str | None, Status | None]]:
             with self._lock:
                 assignments = np.full(self.batch_size, -1, np.int64)
@@ -646,6 +695,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     assignments[lo:hi] = result[:-1][:hi - lo]
                     self.stats["waves"] += int(result[-1])
                 self._replay(batch, assignments)
+                if was_full and self.FULL_MAIN_WAVES:
+                    self._retry_stragglers(batch, assignments, n)
                 try:
                     self._unresolved.remove(holder)
                 except ValueError:  # pragma: no cover - double resolve
@@ -658,6 +709,56 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             return out
 
         return resolve
+
+    def _retry_stragglers(self, batch, assignments: np.ndarray,
+                          n: int) -> None:
+        """Drain a capped main run's leftovers through the small retry
+        kernel (caller holds the lock; mutates `assignments` in place).
+
+        The main constraint kernel stops after FULL_MAIN_WAVES waves —
+        by then ~98% of a batch is placed and each further full-[P,N]
+        wave admits a handful of stragglers (claims that landed in an
+        over-level spread domain re-claim toward the min domain next
+        wave).  Re-offering the leftovers at a small P costs
+        ~(P_small/P)^2 per wave and runs the EXHAUSTIVE wave budget, so
+        the overall fixpoint (retry until no progress) matches the
+        uncapped kernel's placements-or-stuck guarantee.  Retry steps
+        chain the same resident device state as ordinary batches, and
+        the mirror replay is purely additive, so commit order between an
+        already-inflight next batch and these retries cannot diverge."""
+        from ..ops.flatten import gather_pod_batch
+        self._ensure_full_small()  # spec needed below before the step
+        skip = set(batch.escape)
+        cap = self._retry_cap()
+        empty = (np.empty(0, np.int32),
+                 np.empty((0, self._f_patch), np.float32))
+        for _round in range(self.RETRY_ROUNDS_MAX):
+            left = [i for i in range(min(n, self.batch_size))
+                    if assignments[i] < 0 and i not in skip]
+            if not left:
+                return
+            one_chunk = len(left) <= cap
+            placed_this_round = 0
+            for lo in range(0, len(left), cap):
+                idx = left[lo:lo + cap]
+                rb = gather_pod_batch(batch, idx, cap)
+                buf = pack_pod_batch(rb, self._spec_full_small, *empty)
+                res = np.asarray(self._device_step("full_small", buf))
+                self.stats["waves"] += int(res[-1])
+                sub = res[:-1]
+                self._replay(rb, sub)
+                for j, orig in enumerate(idx):
+                    if sub[j] >= 0:
+                        assignments[orig] = sub[j]
+                        placed_this_round += 1
+            self.stats["retries"] = self.stats.get("retries", 0) + 1
+            if not placed_this_round or one_chunk:
+                # a single chunk ran the EXHAUSTIVE wave budget over the
+                # entire leftover set — that IS the fixpoint; another
+                # round would re-dispatch the identical set to place
+                # nothing (cross-round progress only exists when earlier
+                # CHUNKS' placements unblock later ones)
+                return
 
     def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot
                ) -> list[tuple[str | None, Status | None]]:

@@ -894,10 +894,18 @@ def slice_pod_batch(batch: "PodBatch", lo: int, hi: int,
     """Rows [lo, hi) of a PodBatch re-padded to p_cap — the chunking
     primitive for running an oversized batch through a kernel compiled at
     a smaller P (the constraint-carrying variant's HBM cap at large
-    n_cap).  Padding rows are invalid; escape positions are remapped to
-    chunk-local indices."""
+    n_cap).  The contiguous special case of gather_pod_batch."""
+    return gather_pod_batch(batch, range(lo, hi), p_cap)
+
+
+def gather_pod_batch(batch: "PodBatch", idx: list[int],
+                     p_cap: int) -> "PodBatch":
+    """Arbitrary rows of a PodBatch re-padded to p_cap — the retry
+    primitive: the straggler pods a capped main kernel left unplaced are
+    scattered positions, not a contiguous range (cf. slice_pod_batch)."""
     import dataclasses
-    n = hi - lo
+    n = len(idx)
+    ix = np.asarray(idx, np.int64)
     fields = {}
     for f in dataclasses.fields(PodBatch):
         if f.name in ("p_cap", "escape", "nofit_oracle"):
@@ -907,13 +915,14 @@ def slice_pod_batch(batch: "PodBatch", lo: int, hi: int,
             fields[f.name] = None
             continue
         out = np.zeros((p_cap,) + arr.shape[1:], arr.dtype)
-        out[:n] = arr[lo:hi]
+        out[:n] = arr[ix]
         fields[f.name] = out
     if fields.get("node_row") is not None:
         fields["node_row"][n:] = -1
-    fields["escape"] = [e - lo for e in batch.escape if lo <= e < hi]
-    fields["nofit_oracle"] = [e - lo for e in batch.nofit_oracle
-                              if lo <= e < hi]
+    pos = {orig: j for j, orig in enumerate(idx)}
+    fields["escape"] = [pos[e] for e in batch.escape if e in pos]
+    fields["nofit_oracle"] = [pos[e] for e in batch.nofit_oracle
+                              if e in pos]
     return PodBatch(p_cap=p_cap, **fields)
 
 

@@ -86,7 +86,13 @@ class _WorkerCore:
                 caps, batch_size=cfg["batch_size"],
                 weights=cfg.get("weights"), k_cap=cfg.get("k_cap", 1024),
                 full_batch_cap=cfg.get("full_batch_cap"))
+            # instance override: the CLIENT's setting wins (its resolve()
+            # is the half that must retry what a capped kernel leaves)
+            self._backend.FULL_MAIN_WAVES = cfg.get(
+                "full_main_waves", self._backend.FULL_MAIN_WAVES)
             self._backend._ensure_full()
+            if self._backend.FULL_MAIN_WAVES:
+                self._backend._ensure_full_small()
             self._backend._ensure_plain()
             return {"ok": True, "full_cap": self._backend.full_cap}
         b = self._backend
@@ -184,6 +190,7 @@ _GRPC_VERBS = {
     "Static": "/static",
     "Refresh": "/refresh",
     "StepFull": "/step?variant=full",
+    "StepFullSmall": "/step?variant=full_small",
     "StepPlain": "/step?variant=plain",
 }
 _GRPC_MSG_CAP = 512 << 20
@@ -291,7 +298,12 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
         got = self._post("/init", json.dumps({
             "caps": vars(self.caps), "batch_size": batch_size,
             "weights": weights, "k_cap": k_cap,
-            "full_batch_cap": self.full_cap}).encode())
+            "full_batch_cap": self.full_cap,
+            # the CLIENT's wave-cap/retry setting governs both halves: the
+            # worker must build its main kernel with the same cap the
+            # client's resolve() compensates for, or capped-kernel
+            # leftovers decode as UNSCHEDULABLE with no retry
+            "full_main_waves": self.FULL_MAIN_WAVES}).encode())
         self.full_cap = json.loads(got)["full_cap"]
 
     def _post(self, verb: str, body: bytes) -> bytes:
@@ -309,6 +321,13 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
             from ..models.assign import PackSpec
             self._spec_full = PackSpec(self.caps, self.full_cap,
                                        self._k_cap)
+        return None  # the worker holds the fns
+
+    def _ensure_full_small(self):
+        if self._spec_full_small is None:
+            from ..models.assign import PackSpec
+            self._spec_full_small = PackSpec(self.caps, self._retry_cap(),
+                                             self._k_cap)
         return None  # the worker holds the fns
 
     def _ensure_plain(self):
@@ -360,6 +379,13 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
             self._device_step("full", pack_pod_batch(
                 slice_pod_batch(batch, 0, 0, self.full_cap),
                 self._spec_full, *empty))
+            if self.FULL_MAIN_WAVES:
+                # compile the straggler retry kernel now, not inside the
+                # first straggler-carrying resolve()
+                self._ensure_full_small()
+                self._device_step("full_small", pack_pod_batch(
+                    slice_pod_batch(batch, 0, 0, self._retry_cap()),
+                    self._spec_full_small, *empty))
             self._ensure_plain()
             self._device_step("plain", pack_pod_batch(
                 batch, self._spec_plain, *empty))

@@ -1011,6 +1011,7 @@ class Scheduler:
         if stagelat.ENABLED:
             stagelat.record("pipeline_wait", t_enter - start)
             stagelat.record("resolve_block", resolve_block)
+        t_phase = time.monotonic()
         bulk: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         # phase 1: collect placements; failures/escapes handled per pod
         placed: list[tuple[QueuedPodInfo, str, Obj, PodInfo]] = []
@@ -1040,8 +1041,13 @@ class Scheduler:
                                        "nodeName": node_name}}
             placed.append((qpi, node_name, assumed,
                            qpi.pod_info.clone_with_pod(assumed)))
+        if stagelat.ENABLED:
+            stagelat.record("finish_collect", time.monotonic() - t_phase)
+            t_phase = time.monotonic()
         # phase 2: ONE bulk assume (single cache lock for the whole batch)
         errs = self.cache.assume_pods([(a, pi) for _, _, a, pi in placed])
+        if stagelat.ENABLED:
+            stagelat.record("finish_assume", time.monotonic() - t_phase)
         ok: list[tuple[QueuedPodInfo, str, Obj]] = []
         for (qpi, node_name, assumed, _pi), err in zip(placed, errs):
             if err is not None:
@@ -1155,11 +1161,14 @@ class Scheduler:
         requeue)."""
         bindings = [(meta.namespace(q.pod), meta.name(q.pod), node)
                     for _, q, node, _ in ready]
+        t_phase = time.monotonic()
         try:
             results = self.client.bind_many(bindings)
         except Exception as e:  # pragma: no cover
             logger.exception("bulk bind failed")
             results = [(None, e)] * len(ready)
+        if stagelat.ENABLED:
+            stagelat.record("bind_store_write", time.monotonic() - t_phase)
         bound: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         for (state, qpi, node_name, assumed), (obj, err) in zip(ready, results):
             if err is not None:
@@ -1174,10 +1183,12 @@ class Scheduler:
         # confirm/PostBind tail must not abort the rest of the batch or
         # route an already-bound pod through _bind_failure (which would
         # forget + requeue it)
+        t_phase = time.monotonic()
         self.cache.finish_bindings([a for _, _, _, a in bound])
         now = time.monotonic()
         latency = now - start
         if stagelat.ENABLED:
+            stagelat.record("bind_confirm", now - t_phase)
             stagelat.record("disp_to_bound", latency)
         self.metrics.observe_e2e(
             [(now - q.initial_attempt_timestamp, q.attempts)
