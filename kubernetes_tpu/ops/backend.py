@@ -337,6 +337,21 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             while full_batch_cap > 256 and full_batch_cap > fit:
                 full_batch_cap //= 2
         self.full_cap = min(full_batch_cap, batch_size)
+        # MAIN constraint-kernel wave cap: the first couple of waves
+        # admit ~98% of a batch (water-filling + multi-claim prefix
+        # sums); the tail waves each admit a handful of stragglers at
+        # full [P,N] cost.  Setting a cap (e.g. 3) drains that tail
+        # through the small retry kernel (resolve()) instead — a win
+        # ONLY when a device call is cheap: each retry chunk is its own
+        # device round trip, so over the ~100-300ms tunnel the extra
+        # RTs cost more than the in-call tail waves they replace (A/B
+        # on the tunnel: TopologySpreading 9.1k pods/s uncapped vs 3.6k
+        # with cap 3).  Default 0 = uncapped main kernel, no retry;
+        # direct-attached deployments (~0.1ms dispatch) should set
+        # KTPU_FULL_MAIN_WAVES=3.  Read per-instance (like the HBM
+        # budget above), not at import.
+        self.FULL_MAIN_WAVES = int(
+            os.environ.get("KTPU_FULL_MAIN_WAVES", "0"))
         self._fn_full = None   # built lazily / in warmup
         self._spec_full = None
         self._fn_full_small = None   # straggler retry kernel (lazy)
@@ -441,18 +456,6 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                                 for k in STATIC_SEL}
             self._sel_stale = False
 
-    # MAIN constraint-kernel wave cap: the first couple of waves admit
-    # ~98% of a batch (water-filling + multi-claim prefix sums); the tail
-    # waves each admit a handful of stragglers at full [P,N] cost.
-    # Setting a cap (e.g. 3) drains that tail through the small retry
-    # kernel (resolve()) instead — a win ONLY when a device call is
-    # cheap: each retry chunk is its own device round trip, so over the
-    # ~100-300ms tunnel the extra RTs cost more than the in-call tail
-    # waves they replace (A/B on the tunnel: TopologySpreading 9.1k
-    # pods/s uncapped vs 3.6k with cap 3).  Default 0 = uncapped main
-    # kernel, no retry; direct-attached deployments (~0.1ms dispatch)
-    # should set KTPU_FULL_MAIN_WAVES=3.
-    FULL_MAIN_WAVES = int(os.environ.get("KTPU_FULL_MAIN_WAVES", "0"))
     RETRY_ROUNDS_MAX = 32  # defensive bound; rounds stop at no-progress
 
     def _ensure_full(self):

@@ -898,14 +898,15 @@ def slice_pod_batch(batch: "PodBatch", lo: int, hi: int,
     return gather_pod_batch(batch, range(lo, hi), p_cap)
 
 
-def gather_pod_batch(batch: "PodBatch", idx: list[int],
-                     p_cap: int) -> "PodBatch":
-    """Arbitrary rows of a PodBatch re-padded to p_cap — the retry
-    primitive: the straggler pods a capped main kernel left unplaced are
-    scattered positions, not a contiguous range (cf. slice_pod_batch)."""
+def gather_pod_batch(batch: "PodBatch", idx, p_cap: int) -> "PodBatch":
+    """Rows `idx` of a PodBatch re-padded to p_cap.  Two callers: the
+    chunking path (contiguous `range`, one view-copy per field — the
+    hot path for oversized constraint batches) and the straggler retry
+    (scattered positions, fancy indexing)."""
     import dataclasses
     n = len(idx)
-    ix = np.asarray(idx, np.int64)
+    contiguous = isinstance(idx, range) and idx.step == 1
+    ix = None if contiguous else np.asarray(idx, np.int64)
     fields = {}
     for f in dataclasses.fields(PodBatch):
         if f.name in ("p_cap", "escape", "nofit_oracle"):
@@ -915,14 +916,23 @@ def gather_pod_batch(batch: "PodBatch", idx: list[int],
             fields[f.name] = None
             continue
         out = np.zeros((p_cap,) + arr.shape[1:], arr.dtype)
-        out[:n] = arr[ix]
+        if contiguous:
+            out[:n] = arr[idx.start:idx.stop]
+        else:
+            out[:n] = arr[ix]
         fields[f.name] = out
     if fields.get("node_row") is not None:
         fields["node_row"][n:] = -1
-    pos = {orig: j for j, orig in enumerate(idx)}
-    fields["escape"] = [pos[e] for e in batch.escape if e in pos]
-    fields["nofit_oracle"] = [pos[e] for e in batch.nofit_oracle
-                              if e in pos]
+    if contiguous:
+        lo, hi = idx.start, idx.stop
+        fields["escape"] = [e - lo for e in batch.escape if lo <= e < hi]
+        fields["nofit_oracle"] = [e - lo for e in batch.nofit_oracle
+                                  if lo <= e < hi]
+    else:
+        pos = {orig: j for j, orig in enumerate(idx)}
+        fields["escape"] = [pos[e] for e in batch.escape if e in pos]
+        fields["nofit_oracle"] = [pos[e] for e in batch.nofit_oracle
+                                  if e in pos]
     return PodBatch(p_cap=p_cap, **fields)
 
 

@@ -345,7 +345,7 @@ class TestStragglerRetryKernel:
         small retry kernel (backend._retry_stragglers).  Fixpoint parity:
         the retry configuration must place every pod the exhaustive
         kernel places, with zero spread/anti-affinity violations."""
-        monkeypatch.setattr(TPUBatchBackend, "FULL_MAIN_WAVES", 2)
+        monkeypatch.setenv("KTPU_FULL_MAIN_WAVES", "2")
         caps = small_caps(n_cap=64, sg_cap=8, asg_cap=8)
         nodes = [make_node(f"n{i}").zone("abc"[i % 3])
                  .capacity(cpu="64", mem="256Gi", pods=200).build()
