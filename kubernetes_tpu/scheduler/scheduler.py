@@ -246,8 +246,6 @@ class Scheduler:
         # time-gate eager batch retirement (see schedule_step); starts at
         # the tunneled chip's typical ~2x round-trip flight
         self._flight_est = 0.25
-        self._last_resolve_block = 0.0
-        self._last_resolve_waited = False
         self.pipeline_depth = max(1, pipeline_depth)
         self.admission_interval = admission_interval
         self._deferred: list[QueuedPodInfo] = []  # per-pod pods awaiting a quiescent cache
@@ -492,24 +490,12 @@ class Scheduler:
             # loop correlated with multi-second transfer stalls.  A low
             # estimate just means _finish_batch briefly blocks on the
             # pull; the estimate then adapts upward.
+            # (the estimate itself adapts inside _finish_batch, from
+            # every retirement path)
             now = time.monotonic()
             while self._pending and (now - self._pending[0][4]
                                      >= self._flight_est):
-                age = now - self._pending[0][4]
                 self._finish_batch(*self._pending.pop(0))
-                # Adapt on whether resolve actually waited on the device
-                # (_last_resolve_waited separates device wait from host
-                # decode, which scales with batch size).  `age` is always
-                # >= the estimate inside this loop, so the raise branch
-                # alone would ratchet monotonically — the waited/landed
-                # distinction is what lets the estimate come back down
-                # toward the true flight when results land early.
-                if self._last_resolve_waited:
-                    self._flight_est = min(
-                        2.0, 0.5 * self._flight_est
-                        + 0.5 * (age + self._last_resolve_block))
-                else:
-                    self._flight_est = max(0.05, self._flight_est * 0.95)
                 now = time.monotonic()
             return len(batch)
         qpi = self.queue.pop(timeout)
@@ -999,15 +985,34 @@ class Scheduler:
         t_enter = time.monotonic()
         results = resolve()
         resolve_block = time.monotonic() - t_enter
-        # Did resolve actually WAIT on the device, or was the result
-        # already landed and the block pure host decode?  Decode cost
-        # scales with batch size (~2µs/pod of unpack/replay), so the
-        # threshold must too — a fixed few-ms cutoff misreads a large
-        # batch's decode as a device wait and the eager-retirement gate
-        # then ratchets upward until it self-disables.
-        self._last_resolve_waited = (
-            resolve_block > 0.002 + 2e-6 * len(live))
-        self._last_resolve_block = resolve_block
+        # Adapt the eager-retirement flight estimate HERE, whichever
+        # path retired the batch (eager gate, depth overflow, queue-empty
+        # block, or a flush) — adapting only from the eager loop froze
+        # the estimate wherever another path did the retiring (age there
+        # is always >= the estimate, so the estimate could ratchet up on
+        # a compile spike and never come back down).  Did resolve WAIT on
+        # the device, or was the result landed and the block pure host
+        # decode?  Decode cost scales with batch size (~2µs/pod), so the
+        # threshold must too.  When it waited, pipeline residency + block
+        # IS the observed flight — a direct, path-independent sample
+        # that can pull the estimate in either direction; when it did
+        # not, the flight ended somewhere earlier and the estimate decays.
+        waited = resolve_block > 0.002 + 2e-6 * len(live)
+        if waited:
+            self._flight_est = min(
+                2.0, 0.5 * self._flight_est
+                + 0.5 * (t_enter - start + resolve_block))
+        else:
+            # result was ready when resolve began: the true flight is AT
+            # MOST the batch's residency so far — average toward that
+            # upper bound (recovers in a few batches from a compile-spike
+            # estimate that plain multiplicative decay would need dozens
+            # of samples to unwind), with a slow decay floor for the
+            # eager path where residency ~= the estimate by construction
+            upper = t_enter - start
+            self._flight_est = max(0.05, min(
+                self._flight_est * 0.95,
+                0.5 * self._flight_est + 0.5 * upper))
         if stagelat.ENABLED:
             stagelat.record("pipeline_wait", t_enter - start)
             stagelat.record("resolve_block", resolve_block)

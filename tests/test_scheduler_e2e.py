@@ -170,3 +170,50 @@ class TestBasicScheduling:
         assert wait_for(pod_bound(client, "p1"))
         assert wait_for(lambda: sched.cache.assumed_pod_count() == 0)
         assert sched.cache.pod_count() == 1
+
+
+class TestEagerRetirement:
+    def test_flight_estimate_adapts_down_on_fast_device(self):
+        """Eager batch retirement (scheduler.py schedule_step): on a
+        backend whose results land immediately, the adaptive flight
+        estimate must decay from its 250ms tunnel prior toward the 50ms
+        floor — i.e. batches retire by the time gate, not the depth cap
+        — while every pod still binds."""
+        from kubernetes_tpu.ops.backend import TPUBatchBackend
+        from kubernetes_tpu.ops.flatten import Caps
+        from kubernetes_tpu.scheduler import (
+            Profile, Scheduler, new_default_framework,
+        )
+
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        factory = SharedInformerFactory(client)
+        fw = new_default_framework(client, factory)
+        caps = Caps(n_cap=32, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                    s_cap=2, sg_cap=8, asg_cap=8)
+        backend = TPUBatchBackend(caps, batch_size=16)
+        sched = Scheduler(client, factory,
+                          {"default-scheduler": Profile(
+                              fw, batch_backend=backend, batch_size=16)},
+                          pipeline_depth=8)
+        factory.start()
+        factory.wait_for_cache_sync()
+        sched.run()
+        try:
+            for i in range(8):
+                client.create(NODES, make_node(f"n{i}")
+                              .capacity(cpu="8", mem="32Gi").build())
+            # trickle pods so many small batches flow through the
+            # pipeline and the estimate gets retire events to adapt on
+            for i in range(40):
+                client.create(PODS,
+                              make_pod(f"e{i}").req(cpu="50m").build())
+                time.sleep(0.02)
+            assert wait_for(lambda: all(
+                pod_bound(client, f"e{i}")() for i in range(40)))
+            assert sched._flight_est < 0.25, (
+                "estimate never adapted down from the tunnel prior: "
+                f"{sched._flight_est}")
+        finally:
+            sched.stop()
+            factory.stop()
