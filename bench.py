@@ -109,6 +109,43 @@ EXTRA_CONFIGS = {
     # in steady state — quantifies what crossing the north star's shim
     # costs per step
     "RemoteSeamGrpc": {"seam": "grpc", "timeout": 600.0},
+    # the HOST CEILING: the identical pipeline with the device step
+    # nulled (ops/nullbackend.py) — every pod/s here is host work, so
+    # this row tracks the single-interpreter wall (VERDICT r4 #1) and
+    # any native/multi-process host improvement in isolation from
+    # tunnel weather.  No chip involved; tunnel drift cannot touch it.
+    "SchedulingHostNull": {"workload": "SchedulingBasicLarge",
+                           "nodes": 5000, "pods": 50_000, "batch": 16384,
+                           "depth": 1, "timeout": 900.0, "null": True},
+    # ---- round-5 workload breadth (each is an existing code path that
+    # had no number attached; reference performance-config.yaml:52-598).
+    # Configs run at their YAML-configured reference scales.
+    "PreemptionBasic": {"workload": "PreemptionBasic", "batch": 1024,
+                        "depth": 1, "timeout": 900.0},
+    "Unschedulable": {"workload": "Unschedulable", "batch": 4096,
+                      "depth": 2, "timeout": 900.0},
+    "SchedulingWithMixedChurn": {"workload": "SchedulingWithMixedChurn",
+                                 "batch": 4096, "depth": 2,
+                                 "timeout": 900.0},
+    "SchedulingPodAffinity": {"workload": "SchedulingPodAffinity",
+                              "batch": 4096, "depth": 2, "timeout": 900.0},
+    "SchedulingNodeAffinity": {"workload": "SchedulingNodeAffinity",
+                               "batch": 4096, "depth": 2,
+                               "timeout": 900.0},
+    "SchedulingPreferredPodAffinity": {
+        "workload": "SchedulingPreferredPodAffinity",
+        "batch": 4096, "depth": 2, "timeout": 900.0},
+    "SchedulingPreferredPodAntiAffinity": {
+        "workload": "SchedulingPreferredPodAntiAffinity",
+        "batch": 4096, "depth": 2, "timeout": 900.0},
+    "PreferredTopologySpreading": {
+        "workload": "PreferredTopologySpreading",
+        "batch": 4096, "depth": 2, "timeout": 900.0},
+    "MixedSchedulingBasePod": {"workload": "MixedSchedulingBasePod",
+                               "batch": 4096, "depth": 2,
+                               "timeout": 900.0},
+    "SchedulingSecrets": {"workload": "SchedulingSecrets", "batch": 4096,
+                          "depth": 2, "timeout": 900.0},
 }
 
 
@@ -171,13 +208,14 @@ def run_seam_micro(kind: str = "grpc") -> dict:
 def run_once(workload: str, nodes: int | None, pods: int | None,
              batch: int, barrier_timeout: float = 900.0,
              rate: float | None = None, depth: int = 1,
-             admission_ms: float = 0.0, via_http: bool = False) -> dict:
+             admission_ms: float = 0.0, via_http: bool = False,
+             null_device: bool = False) -> dict:
     """One full workload pass in this process; returns the result dict."""
     import copy
 
-    from kubernetes_tpu.ops.flatten import Caps
-    from kubernetes_tpu.perf import load_workloads, run_named_workload
-
+    from kubernetes_tpu.perf import (
+        caps_for_nodes, load_workloads, run_named_workload,
+    )
     from kubernetes_tpu.perf.scheduler_perf import is_measured
 
     cfg = copy.deepcopy(load_workloads()[workload])
@@ -198,19 +236,14 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
     n_nodes = next(op["count"] for op in cfg["workloadTemplate"]
                    if op["opcode"] == "createNodes")
 
-    n_cap = max(1024, -(-int(n_nodes * 1.1) // 256) * 256)  # ~10% headroom
-    # c_cap=2: every tracked workload carries <=1 constraint per pod, and
-    # each constraint slot costs [P,P] conflict work per wave in the full
-    # kernel; pods with more constraints escape to the per-pod oracle
-    caps = Caps(n_cap=n_cap,
-                l_cap=256, kl_cap=62, t_cap=16, pt_cap=16, s_cap=3,
-                sg_cap=16, asg_cap=16, c_cap=2)
+    caps = caps_for_nodes(n_nodes)  # THE shared cap policy (perf/__init__)
     t0 = time.monotonic()
     summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
                                         batch_size=batch,
                                         pipeline_depth=depth,
                                         admission_interval=admission_ms / 1e3,
-                                        via_http=via_http)
+                                        via_http=via_http,
+                                        null_device=null_device)
     wall = time.monotonic() - t0
     if not stats.get("barrier_ok", False):
         return {"error": "pods left unscheduled", "value": 0.0,
@@ -223,6 +256,8 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
     if "escape_rate" in stats:
         # escaped-to-oracle fraction (tensor-path coverage; target <5%)
         detail["escape_rate"] = stats["escape_rate"]
+    if "preemption_attempts" in stats:
+        detail["preemption_attempts"] = stats["preemption_attempts"]
     return {"value": summary.average, "wall_s": round(wall, 1),
             "detail": detail}
 
@@ -282,7 +317,8 @@ def child_main() -> None:
                                                      "0")),
                    via_http=("process"
                              if os.environ.get("_BENCH_W_HTTP") == "proc"
-                             else os.environ.get("_BENCH_W_HTTP") == "1"))
+                             else os.environ.get("_BENCH_W_HTTP") == "1"),
+                   null_device=os.environ.get("_BENCH_W_NULL") == "1")
     if "error" in res:
         emit(0.0, {"error": res["error"], **res["detail"]})
         sys.exit(1)
@@ -305,13 +341,49 @@ def _device_reachable(timeout: float = 180.0) -> bool:
         return False
 
 
+def _config_env(c: dict) -> dict:
+    env = {"_BENCH_WORKLOAD": c["workload"],
+           "_BENCH_W_BATCH": str(c["batch"]),
+           "_BENCH_W_TIMEOUT": str(c.get("timeout", 900.0))}
+    if "nodes" in c:
+        env["_BENCH_W_NODES"] = str(c["nodes"])
+    if "pods" in c:
+        env["_BENCH_W_PODS"] = str(c["pods"])
+    if "rate" in c:
+        env["_BENCH_W_RATE"] = str(c["rate"])
+    if "depth" in c:
+        env["_BENCH_W_DEPTH"] = str(c["depth"])
+    if "admission_ms" in c:
+        env["_BENCH_W_ADMISSION_MS"] = str(c["admission_ms"])
+    if c.get("http"):
+        env["_BENCH_W_HTTP"] = "proc" if c["http"] == "proc" else "1"
+    if c.get("null"):
+        env["_BENCH_W_NULL"] = "1"
+    return env
+
+
 def main() -> None:
     if os.environ.get("_BENCH_CHILD") == "1":
         child_main()
         return
     if not _device_reachable():
+        # The chip tunnel is down — but null-device configs measure the
+        # HOST ceiling and never touch jax: they must not go dark with
+        # the tunnel (they are the row that keeps tracking the
+        # single-interpreter wall through bad weather).
+        configs: dict[str, dict] = {}
+        for cname, c in EXTRA_CONFIGS.items():
+            if not c.get("null"):
+                continue
+            got = _spawn_child(_config_env(c),
+                               timeout=c.get("timeout", 900.0) + 300)
+            d = (got or {}).get("detail", {})
+            configs[cname] = ({"pods_per_s": got.get("value", 0.0),
+                               "total_pods": d.get("TotalPods")}
+                              if got else {"error": "failed"})
         emit(0.0, {"error": "device unreachable: jax.devices() did not "
-                            "return within 180s (chip tunnel down?)"})
+                            "return within 180s (chip tunnel down?)",
+                   "configs": configs})
         sys.exit(1)
     n_runs = max(1, int(os.environ.get("BENCH_RUNS", "3")))
     if n_runs == 1:
@@ -352,22 +424,7 @@ def main() -> None:
                 configs[cname] = (got.get("detail", {"error": "failed"})
                                   if got else {"error": "failed"})
                 continue
-            env = {"_BENCH_WORKLOAD": c["workload"],
-                   "_BENCH_W_BATCH": str(c["batch"]),
-                   "_BENCH_W_TIMEOUT": str(c.get("timeout", 900.0))}
-            if "nodes" in c:
-                env["_BENCH_W_NODES"] = str(c["nodes"])
-            if "pods" in c:
-                env["_BENCH_W_PODS"] = str(c["pods"])
-            if "rate" in c:
-                env["_BENCH_W_RATE"] = str(c["rate"])
-            if "depth" in c:
-                env["_BENCH_W_DEPTH"] = str(c["depth"])
-            if "admission_ms" in c:
-                env["_BENCH_W_ADMISSION_MS"] = str(c["admission_ms"])
-            if c.get("http"):
-                env["_BENCH_W_HTTP"] = ("proc" if c["http"] == "proc"
-                                        else "1")
+            env = _config_env(c)
             got = _spawn_child(env, timeout=c.get("timeout", 900.0) + 300)
             # best-of-2 for the quick configs that opt in ("two_pass"):
             # the tunnel's round-trip latency drifts 2-3x over minutes,
@@ -377,7 +434,14 @@ def main() -> None:
             # configs hold throughput at the pacing rate by design, so
             # for them "better" means lower p99 latency, not higher
             # pods/s.  Both passes are recorded.
-            if got is not None and c.get("two_pass"):
+            if got is None and c.get("two_pass"):
+                # a first pass lost entirely to a transient failure is
+                # the same weather the two-pass feature targets: give
+                # the config its second attempt instead of reporting
+                # {"error": "failed"} without one
+                got = _spawn_child(env, timeout=c.get("timeout", 900.0)
+                                   + 300)
+            elif got is not None and c.get("two_pass"):
                 got2 = _spawn_child(env, timeout=c.get("timeout", 900.0)
                                     + 300)
                 if got2 is not None:
@@ -406,6 +470,8 @@ def main() -> None:
             }
             if "escape_rate" in d:
                 configs[cname]["escape_rate"] = d["escape_rate"]
+            if "preemption_attempts" in d:
+                configs[cname]["preemption_attempts"] = d["preemption_attempts"]
             if "second_pass" in d:
                 configs[cname]["second_pass"] = d["second_pass"]
 

@@ -1,0 +1,157 @@
+"""NullBatchBackend — the host pipeline with the device step nulled.
+
+Measurement tool (NOT a production backend): runs the ENTIRE host side
+of the batch path — store -> watch -> informer -> queue -> encode ->
+assume -> bulk bind — with the device kernel replaced by an instant
+vectorized capacity fill.  Every millisecond on the clock is host work,
+which makes this the reproducible source for:
+
+  * LATENCY.md's host-only latency rows (the direct-attached projection
+    subtracts the tunnel by measuring exactly this configuration);
+  * the host-throughput ceiling (the single-interpreter wall the
+    100k-tier numbers hit; VERDICT r4 item #1) and any improvement to
+    it (native helpers, multi-process host) in isolation from tunnel
+    weather;
+  * cProfile runs locating where host µs/pod goes.
+
+Scope: PLAIN pods only (no selectors/affinity/constraints/ports/pins).
+Anything else escapes to the per-pod oracle with SKIP — the null
+"device" has no constraint solver, and silently placing constraint
+pods by capacity alone would produce placements the real kernel would
+never emit.  supports_pipelining=False: with an instant device there is
+no flight to overlap, and the flush-before-dispatch ordering means each
+dispatch's re-encode sees the previous batch's assumed claims (the
+sync-path contract in scheduler.BatchBackend).
+
+Reference analog: scheduler_perf's null-kubelet shape (hollow nodes,
+test/integration/scheduler_perf/util.go:79) — the harness isolates the
+control loop from the execution substrate the same way.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+import numpy as np
+
+from ..scheduler.scheduler import BatchBackend
+from ..scheduler.types import PodInfo, Status
+from .backend import decode_results, record_batch_stats
+from .flatten import BatchEncoder, Caps, ClusterTensors, VocabFullError
+
+SKIP_MSG = "null backend: constraint pod -> per-pod oracle"
+
+
+class NullBatchBackend(BatchBackend):
+    supports_pipelining = False
+
+    def __init__(self, caps: Caps | None = None, batch_size: int = 256,
+                 weights: dict | None = None, k_cap: int = 1024):
+        self.caps = caps or Caps()
+        self.batch_size = batch_size
+        self.tensors = ClusterTensors(self.caps)
+        self.encoder = BatchEncoder(self.tensors, batch_size)
+        self._lock = threading.Lock()
+        # incremental per-row slot counts (see _assign_vectorized): the
+        # null device must cost O(dirty + pods), not O(n_cap), per
+        # dispatch — at 100k nodes a full-array capacity recompute per
+        # batch was ~30% of the sched-loop and polluted the host
+        # measurement this backend exists to take
+        self._cap = np.zeros(self.caps.n_cap, np.int64)
+        self._cap_maxreq: np.ndarray | None = None
+        self._carry_dirty: set[int] = set()
+        self.stats = {"batches": 0}
+
+    def warmup(self) -> None:
+        self.encoder.encode([])
+
+    def prefetch(self, view) -> None:
+        """Idle-time tensor sync (same contract as TPUBatchBackend); rows
+        synced here must still reach the next dispatch's capacity
+        recount, so they carry."""
+        with self._lock:
+            try:
+                self._carry_dirty |= set(
+                    self.tensors.update_from_snapshot_tracked(view))
+            except VocabFullError:
+                pass
+
+    def _recount_rows(self, rows: np.ndarray, maxreq: np.ndarray) -> None:
+        """Recompute remaining slot counts for `rows` given the reference
+        per-pod claim `maxreq`."""
+        t = self.tensors
+        remaining = t.alloc[rows] - t.used[rows]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per_res = np.where(maxreq > 0, remaining / maxreq, np.inf)
+        cap = np.floor(per_res.min(axis=1))
+        cap = np.minimum(cap, t.maxpods[rows] - t.npods[rows])
+        self._cap[rows] = np.clip(cap, 0, 1 << 40).astype(np.int64)
+        self._cap[rows[~t.valid[rows]]] = 0
+
+    def _assign_vectorized(self, batch, n: int,
+                           dirty: np.ndarray) -> np.ndarray:
+        """Capacity-aware fill, O(dirty rows + pods) per dispatch.
+
+        Per-pod claims use the batch's MAX request per resource (bench
+        batches are uniform, where this is exact; mixed batches
+        under-pack, never over-pack).  Slot counts live in self._cap,
+        recomputed only for rows whose encode changed this dispatch (or
+        everywhere when the reference claim changes) and decremented in
+        place for this batch's own placements — rows fill lowest-index
+        first; placement ORDER is not what this backend measures."""
+        t = self.tensors
+        assignments = np.full(self.batch_size, -1, np.int64)
+        if n == 0:
+            return assignments
+        maxreq = batch.req[:n].max(axis=0)
+        if (self._cap_maxreq is None
+                or not np.array_equal(maxreq, self._cap_maxreq)):
+            self._cap_maxreq = maxreq
+            self._recount_rows(np.nonzero(t.valid)[0], maxreq)
+        elif len(dirty):
+            self._recount_rows(dirty, maxreq)
+        rows = np.nonzero(self._cap > 0)[0]
+        if not len(rows):
+            return assignments
+        cap = np.minimum(self._cap[rows], n)
+        slots = np.repeat(rows, cap)
+        k = min(len(slots), n)
+        assignments[:k] = slots[:k]
+        used_rows, counts = np.unique(slots[:k], return_counts=True)
+        self._cap[used_rows] -= counts
+        return assignments
+
+    def dispatch(self, pod_infos: Sequence[PodInfo], snapshot):
+        with self._lock:
+            try:
+                dirty = set(self.tensors.update_from_snapshot_tracked(
+                    snapshot))
+                dirty |= self._carry_dirty
+                self._carry_dirty = set()
+                batch = self.encoder.encode(list(pod_infos))
+            except VocabFullError as e:
+                from ..scheduler.types import SKIP
+                results = [(None, Status(SKIP, str(e)))] * len(pod_infos)
+                return lambda: results
+            n = len(pod_infos)
+            # constraint pods escape: the null device has no solver
+            is_plain = self.encoder._is_plain
+            extra_escapes = {i for i, pi in enumerate(pod_infos[:self.batch_size])
+                             if not is_plain(pi)}
+            assignments = self._assign_vectorized(
+                batch, n, np.fromiter(dirty, np.int64, len(dirty)))
+            if extra_escapes:
+                assignments[list(extra_escapes)] = -1
+            row_infos = list(self.tensors.node_infos)
+            self.stats["batches"] += 1
+        escapes = set(batch.escape) | extra_escapes
+
+        def resolve():
+            out = decode_results(assignments, n, self.batch_size, escapes,
+                                 row_infos, "no feasible node (null backend)",
+                                 nofit_escapes=set(batch.nofit_oracle))
+            record_batch_stats(self.stats, self._lock, out, n)
+            return out
+
+        return resolve

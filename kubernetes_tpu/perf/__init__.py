@@ -17,3 +17,17 @@ def load_workloads(path: str | None = None) -> dict[str, dict]:
     with open(path or _CONFIG) as f:
         entries = yaml.safe_load(f)
     return {e["name"]: e for e in entries}
+
+
+def caps_for_nodes(n_nodes: int):
+    """THE bench cap policy (shared by bench.py and tools/profile_host.py
+    so the profiler always measures the configuration the bench runs):
+    node capacity rounded up to a 256 multiple with ~10% headroom;
+    c_cap=2 because every tracked workload carries <=1 constraint per
+    pod and each constraint slot costs [P,P] conflict work per wave in
+    the full kernel — pods with more constraints escape to the per-pod
+    oracle."""
+    from ..ops.flatten import Caps
+    n_cap = max(1024, -(-int(n_nodes * 1.1) // 256) * 256)
+    return Caps(n_cap=n_cap, l_cap=256, kl_cap=62, t_cap=16, pt_cap=16,
+                s_cap=3, sg_cap=16, asg_cap=16, c_cap=2)

@@ -120,7 +120,6 @@ class ThroughputCollector:
         return self._frozen_at != 0.0
 
     def start(self) -> None:
-        self._start_time = time.monotonic()
         # watch first, then count what was already bound (warm-up ops
         # before the measured one): a bind landing between the two is
         # seen by BOTH, so seed the dedup set from the scan — it can
@@ -134,6 +133,10 @@ class ThroughputCollector:
                 self._scheduled.add(f"{ns}/{md['name']}" if ns
                                     else md["name"])
         self._base = len(self._scheduled)
+        # the window opens AFTER the O(pods) seeding scan: the measured
+        # createPods haven't been created yet, so no bind can be missed,
+        # and the scan's duration must not deflate the reported rate
+        self._start_time = time.monotonic()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -219,7 +222,8 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                   store: kv.MemoryStore | None = None,
                   pipeline_depth: int = 1,
                   admission_interval: float = 0.0,
-                  via_http: bool = False) -> PerfCluster:
+                  via_http: bool = False,
+                  null_device: bool = False) -> PerfCluster:
     """mustSetupScheduler (util.go:79): in-proc everything, no kubelet.
 
     pipeline_depth/admission_interval select latency mode (scheduler.py):
@@ -299,9 +303,16 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
         client = LocalClient(store)
     factory = SharedInformerFactory(client)
     if tpu:
-        from ..ops.backend import TPUBatchBackend
         from ..ops.flatten import Caps
-        backend = TPUBatchBackend(caps or Caps(), batch_size=batch_size)
+        if null_device:
+            # host-only measurement: device step nulled (LATENCY.md's
+            # host-tail rows; the host-wall ceiling in isolation)
+            from ..ops.nullbackend import NullBatchBackend
+            backend = NullBatchBackend(caps or Caps(),
+                                       batch_size=batch_size)
+        else:
+            from ..ops.backend import TPUBatchBackend
+            backend = TPUBatchBackend(caps or Caps(), batch_size=batch_size)
         backend.warmup()
         fw = new_default_framework(client, factory)
         profiles = {"default-scheduler": Profile(
@@ -464,6 +475,10 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
     """Execute a workloadTemplate op list. Returns op stats."""
     created_pods = 0
     created_nodes = 0
+    # pods expected to actually schedule: createPods ops marked
+    # skipWaitToCompletion (the Unschedulable workload's parked pods,
+    # performance-config.yaml:437-443) are excluded from barrier targets
+    expected_scheduled = 0
     stats: dict[str, Any] = {}
     churn_stop: list[threading.Event] = []
     for op in ops:
@@ -472,23 +487,47 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
             _bulk_create(cluster.client, NODES, op["count"], created_nodes,
                          _default_node, op)
             created_nodes += op["count"]
+        elif opcode == "createNamespaces":
+            # namespace objects with labels (the NSSelector workloads'
+            # namespace-with-labels.yaml shape)
+            from ..client.clientset import NAMESPACES
+            prefix = op.get("prefix", "ns-")
+            for i in range(op["count"]):
+                nsobj = meta.new_object("Namespace", f"{prefix}{i}",
+                                        namespace=None)
+                if op.get("labels"):
+                    nsobj["metadata"]["labels"] = dict(op["labels"])
+                try:
+                    cluster.client.create(NAMESPACES, nsobj)
+                except kv.ConflictError:
+                    pass
         elif opcode == "createPods":
             if collector is not None and not collector.started \
                     and is_measured(op, ops):
                 # measurement window opens with the first measured pods
                 # (reference: CollectMetrics on the createPods op)
                 collector.start()
-                if hasattr(cluster.scheduler, "metrics"):
+                if hasattr(cluster.scheduler, "metrics") \
+                        and stats.get("barrier_ok", True):
                     # the warm-up barrier saw the binds in the STORE; the
                     # scheduler records each e2e entry only after its bulk
                     # commit returns, so briefly wait for the metric to
                     # catch up or in-flight warm-up latencies would land
-                    # after the watermark and pollute the measured e2e
+                    # after the watermark and pollute the measured e2e.
+                    # Skipped when the warm-up barrier already failed
+                    # (the mark can never reach the target), and bounded
+                    # by progress: a stalled mark exits early.
                     m = cluster.scheduler.metrics
                     deadline = time.monotonic() + 5.0
-                    while (m.e2e_mark() < created_pods
+                    last, last_change = m.e2e_mark(), time.monotonic()
+                    while (last < expected_scheduled
                            and time.monotonic() < deadline):
                         time.sleep(0.005)
+                        cur = m.e2e_mark()
+                        if cur != last:
+                            last, last_change = cur, time.monotonic()
+                        elif time.monotonic() - last_change > 0.25:
+                            break  # mark stopped advancing
                     stats["e2e_mark"] = m.e2e_mark()
             rate = op.get("ratePerSecond")
             if rate:
@@ -510,6 +549,8 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
                 _bulk_create(cluster.client, PODS, op["count"],
                              created_pods, _default_pod, op)
             created_pods += op["count"]
+            if not op.get("skipWaitToCompletion"):
+                expected_scheduled += op["count"]
         elif opcode == "createPodGroups":
             from ..client.clientset import PODGROUPS
             prefix = op.get("namePrefix", "pg-")
@@ -520,7 +561,7 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
                                   "scheduleTimeoutSeconds", 120)}
                 cluster.client.create(PODGROUPS, pg)
         elif opcode == "barrier":
-            want = op.get("count", created_pods)
+            want = op.get("count", expected_scheduled)
             ok = wait_for_pods_scheduled(cluster, want,
                                          timeout=op.get("timeout", 600.0),
                                          collector=collector)
@@ -534,21 +575,72 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
         elif opcode == "sleep":
             time.sleep(op.get("duration", 1.0))
         elif opcode == "churn":
-            # background create/delete loop (scheduler_perf churn op)
+            # background create/delete loop (scheduler_perf churn op,
+            # scheduler_perf_test.go churnOp).  mode=recreate keeps
+            # `number` live copies of each templated object, deleting the
+            # oldest as new ones land (SchedulingWithMixedChurn cycles a
+            # capacity-0 node, an unschedulable high-priority pod, and a
+            # service every interval — each a different event source for
+            # the scheduler's requeue gating).
             ev = threading.Event()
             churn_stop.append(ev)
             interval = op.get("intervalMilliseconds", 500) / 1000.0
+            mode = op.get("mode", "create")
+            objects = op.get("objects", ["pod"])
+            number = op.get("number", 1)
 
-            def churn_loop(ev=ev, interval=interval, op=op):
+            def churn_objects(i: int) -> list[tuple[str, str | None, str, dict]]:
+                from ..client.clientset import SERVICES
+                out = []
+                for kind in objects:
+                    name = f"churn-{kind}-{i}"
+                    if kind == "node":
+                        n = make_node(name).capacity(cpu="1", mem="1Gi",
+                                                     pods=0).build()
+                        out.append((NODES, None, name, n))
+                    elif kind == "service":
+                        svc = meta.new_object("Service", name, "churn")
+                        svc["spec"] = {"selector": {"app": "foo"},
+                                       "ports": [{"protocol": "TCP",
+                                                  "port": 8080}]}
+                        out.append((SERVICES, "churn", name, svc))
+                    elif mode == "recreate":
+                        # pod: high-priority, oversized (never schedules;
+                        # pod-high-priority-large-cpu.yaml shape)
+                        p = make_pod(name, "churn").req(cpu="9",
+                                                        mem="500Mi").build()
+                        p["spec"]["priority"] = 10
+                        out.append((PODS, "churn", name, p))
+                    else:  # legacy create-mode churn: tiny schedulable pod
+                        p = make_pod(name, "churn").req(cpu="1m").build()
+                        out.append((PODS, "churn", name, p))
+                return out
+
+            def churn_loop(ev=ev, interval=interval):
+                from collections import deque
+                live: deque = deque()
                 i = 0
                 while not ev.wait(interval):
-                    name = f"churn-{i}"
-                    try:
-                        cluster.client.create(
-                            PODS, make_pod(name, "churn").req(cpu="1m").build())
-                        cluster.client.delete(PODS, "churn", name)
-                    except kv.StoreError:
-                        pass
+                    for res, ns, name, obj in churn_objects(i):
+                        try:
+                            cluster.client.create(res, obj)
+                            live.append((res, ns, name))
+                        except kv.StoreError:
+                            pass
+                    while len(live) > number * len(objects):
+                        res, ns, name = live.popleft()
+                        try:
+                            cluster.client.delete(res, ns, name)
+                        except kv.StoreError:
+                            pass
+                    if mode != "recreate":
+                        # legacy create mode: delete immediately
+                        while live:
+                            res, ns, name = live.popleft()
+                            try:
+                                cluster.client.delete(res, ns, name)
+                            except kv.StoreError:
+                                pass
                     i += 1
 
             threading.Thread(target=churn_loop, daemon=True).start()
@@ -564,13 +656,14 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
 def run_named_workload(config: dict, tpu: bool = False, caps=None,
                        batch_size: int = 512, pipeline_depth: int = 1,
                        admission_interval: float = 0.0,
-                       via_http: bool = False
+                       via_http: bool = False,
+                       null_device: bool = False
                        ) -> tuple[ThroughputSummary, dict]:
     """Run one workload config end to end; returns (throughput, stats)."""
     cluster = setup_cluster(tpu=tpu, caps=caps, batch_size=batch_size,
                             pipeline_depth=pipeline_depth,
                             admission_interval=admission_interval,
-                            via_http=via_http)
+                            via_http=via_http, null_device=null_device)
     collector = ThroughputCollector(cluster.store)
     try:
         ops = config["workloadTemplate"]
@@ -587,6 +680,9 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         stats["wall"] = time.monotonic() - t0
         stats["e2e"] = cluster.scheduler.metrics.e2e_summary(
             since=stats.get("e2e_mark", 0))
+        if cluster.scheduler.metrics.preemption_attempts:
+            stats["preemption_attempts"] = (
+                cluster.scheduler.metrics.preemption_attempts)
         from ..utils import stagelat
         if stagelat.ENABLED:
             stats["stage_latency"] = stagelat.summary()

@@ -1,0 +1,112 @@
+"""Round-5 perf workload configs run end to end at toy scale.
+
+Each workload from perf/config/performance-config.yaml must complete
+(barrier_ok) through the REAL pipeline — store -> informers -> queue ->
+scheduler -> bind — with counts shrunk so the whole parametrized suite
+stays fast on CPU.  The per-pod oracle path is used (tpu=False): these
+tests prove the workload DEFINITIONS and harness opcodes
+(createNamespaces, skipWaitToCompletion, churn recreate mode), not the
+device kernel (bench.py measures that on hardware).
+
+Reference: test/integration/scheduler_perf/scheduler_perf_test.go
+(the integration test driver over performance-config.yaml).
+"""
+
+from __future__ import annotations
+
+import copy
+
+import pytest
+
+from kubernetes_tpu.perf import load_workloads
+from kubernetes_tpu.perf.scheduler_perf import (
+    ThroughputCollector, run_workload, setup_cluster,
+)
+
+# (workload, node_shrink, pod_shrink): counts divided by the shrink
+# factor (min 1 node / a few pods) so ratios that give the workload its
+# meaning survive — PreemptionBasic keeps ~4 low-prio pods per node so
+# high-priority pods still must evict.
+CASES = [
+    ("SchedulingSecrets", 100, 100),
+    ("SchedulingPodAffinity", 100, 100),
+    ("SchedulingPreferredPodAffinity", 100, 100),
+    ("SchedulingPreferredPodAntiAffinity", 100, 100),
+    ("SchedulingNodeAffinity", 100, 100),
+    ("PreferredTopologySpreading", 100, 100),
+    ("MixedSchedulingBasePod", 100, 100),
+    ("PreemptionBasic", 25, 25),
+    ("Unschedulable", 100, 100),
+    ("SchedulingWithMixedChurn", 100, 100),
+]
+
+
+def shrink(cfg: dict, node_div: int, pod_div: int) -> dict:
+    cfg = copy.deepcopy(cfg)
+    for op in cfg["workloadTemplate"]:
+        if op["opcode"] == "createNodes":
+            op["count"] = max(2, op["count"] // node_div)
+        elif op["opcode"] == "createPods":
+            op["count"] = max(4, op["count"] // pod_div)
+        elif op["opcode"] == "createNamespaces":
+            pass  # namespace counts are semantic, keep them
+        elif op["opcode"] == "barrier":
+            op["timeout"] = 120.0
+        elif op["opcode"] == "churn":
+            op["intervalMilliseconds"] = 100
+    return cfg
+
+
+@pytest.mark.parametrize("name,ndiv,pdiv", CASES,
+                         ids=[c[0] for c in CASES])
+def test_workload_completes(name, ndiv, pdiv):
+    cfg = shrink(load_workloads()[name], ndiv, pdiv)
+    cluster = setup_cluster(tpu=False)
+    collector = ThroughputCollector(cluster.store, interval=0.2)
+    try:
+        stats = run_workload(cluster, cfg["workloadTemplate"], collector)
+        assert stats.get("barrier_ok", False), stats
+    finally:
+        collector.stop()
+        cluster.shutdown()
+
+
+def test_unschedulable_pods_stay_parked():
+    """The skipWaitToCompletion pods must end WITHOUT nodeName while
+    every measured pod binds (the workload's entire point)."""
+    from kubernetes_tpu.api import meta
+    from kubernetes_tpu.client.clientset import PODS
+    cfg = shrink(load_workloads()["Unschedulable"], 100, 100)
+    cluster = setup_cluster(tpu=False)
+    collector = ThroughputCollector(cluster.store, interval=0.2)
+    try:
+        stats = run_workload(cluster, cfg["workloadTemplate"], collector)
+        assert stats.get("barrier_ok", False), stats
+        items, _ = cluster.store.list(PODS, None)
+        bound = sum(1 for p in items if meta.pod_node_name(p))
+        unbound = sum(1 for p in items if not meta.pod_node_name(p))
+        skip_count = next(
+            op["count"] for op in cfg["workloadTemplate"]
+            if op.get("skipWaitToCompletion"))
+        assert unbound == skip_count, (bound, unbound)
+    finally:
+        collector.stop()
+        cluster.shutdown()
+
+
+def test_preemption_evicts_victims():
+    """High-priority pods must displace low-priority ones: every
+    high-priority pod binds, and at least one low-priority pod was
+    evicted (deleted or rescheduled later)."""
+    from kubernetes_tpu.api import meta
+    from kubernetes_tpu.client.clientset import PODS
+    cfg = shrink(load_workloads()["PreemptionBasic"], 25, 25)
+    cluster = setup_cluster(tpu=False)
+    collector = ThroughputCollector(cluster.store, interval=0.2)
+    try:
+        stats = run_workload(cluster, cfg["workloadTemplate"], collector)
+        assert stats.get("barrier_ok", False), stats
+        assert cluster.scheduler.metrics.preemption_attempts > 0
+    finally:
+        collector.stop()
+        cluster.shutdown()
