@@ -116,6 +116,19 @@ def _request_pair_cached(key: tuple) -> tuple[Resource, Resource]:
     return r, nz
 
 
+def request_pair_from_requests(rl: dict | None) -> tuple[Resource, Resource]:
+    """(request, request_nonzero) straight from a single-container
+    requests dict — the native pod_scan fast path's entry (the scan
+    already proved the pod has exactly one container, no initContainers,
+    no overhead).  Same shared-frozen-instance contract as
+    pod_request_pair."""
+    try:
+        return _request_pair_cached(tuple(sorted(rl.items())) if rl else ())
+    except (TypeError, AttributeError):  # unhashable/malformed: private
+        r = _parse_resource_list_uncached(rl if isinstance(rl, dict) else {})
+        return r, pod_request_nonzero(None, r)
+
+
 def pod_request_pair(pod: dict) -> tuple[Resource, Resource]:
     """(pod_request, pod_request_nonzero) with a shared-instance fast path
     for the dominant pod shape (one container, no initContainers, no

@@ -45,6 +45,7 @@ from ..api.meta import Obj
 from ..scheduler.cache import Snapshot
 from ..scheduler.plugins.nodebasic import toleration_tolerates_taint
 from ..scheduler.types import NodeInfo, PodInfo
+from ..utils.fasthost import req_columns
 
 logger = logging.getLogger(__name__)
 
@@ -958,14 +959,10 @@ class BatchEncoder:
         if n:
             # request vectors column-wise in bulk (the rows are fresh
             # zeros, so only the core columns + rare scalars need writes;
-            # a per-pod _encode_resource pair cost ~3µs/pod)
-            b.req[:n, 0] = [pi.request.milli_cpu for pi in pods]
-            b.req[:n, 1] = [pi.request.memory for pi in pods]
-            b.req[:n, 2] = [pi.request.ephemeral_storage for pi in pods]
-            b.req_nz[:n, 0] = [pi.request_nonzero.milli_cpu for pi in pods]
-            b.req_nz[:n, 1] = [pi.request_nonzero.memory for pi in pods]
-            b.req_nz[:n, 2] = [pi.request_nonzero.ephemeral_storage
-                               for pi in pods]
+            # a per-pod _encode_resource pair cost ~3µs/pod); one native
+            # pass when built (utils/fasthost), list-comp columns otherwise
+            req_columns(pods if isinstance(pods, list) else list(pods),
+                        b.req, b.req_nz)
         # plain fast path: a pod with no selectors/affinity/constraints/
         # ports/pins/scalars needs NO per-field writes beyond the bulk
         # request columns above — p_valid plus (when the taint vocab is
@@ -1063,27 +1060,12 @@ class BatchEncoder:
 
     @staticmethod
     def _is_plain(pi: PodInfo) -> bool:
-        """True when the pod touches none of the constraint-side fields
-        (the checks mirror _encode_pod's write sites exactly; a pod that
-        fails any check takes the slow path, so divergence is impossible
-        for plain=True pods)."""
-        if (pi.nominated_node_name or pi.node_selector
-                or pi.node_affinity_required or pi.node_affinity_preferred
-                or pi.required_affinity_terms or pi.required_anti_affinity_terms
-                or pi.preferred_affinity_terms
-                or pi.preferred_anti_affinity_terms
-                or pi.topology_spread_constraints or pi.host_ports
-                or pi.request.scalar or pi.request_nonzero.scalar):
-            return False
-        spec = pi.pod.get("spec") or {}
-        if spec.get("nodeName"):
-            return False
-        for v in spec.get("volumes") or ():
-            if (v.get("persistentVolumeClaim") or v.get("gcePersistentDisk")
-                    or v.get("awsElasticBlockStore") or v.get("azureDisk")
-                    or v.get("iscsi") or v.get("csi")):
-                return False
-        return True
+        """True when the pod touches none of the constraint-side fields.
+        Precomputed by PodInfo.update (types.py) where every input is
+        already in hand — PodInfo.plain's checks mirror _encode_pod's
+        write sites exactly, so a plain=True pod can never diverge from
+        what the fast path assumes."""
+        return pi.plain
 
     def _encode_taints(self, b: PodBatch, i: int, pi: PodInfo) -> None:
         """Taint section of the pod encode (shared by slow path and the

@@ -61,6 +61,15 @@ class NullBatchBackend(BatchBackend):
         self._cap = np.zeros(self.caps.n_cap, np.int64)
         self._cap_maxreq: np.ndarray | None = None
         self._carry_dirty: set[int] = set()
+        # epoch fast path (same contract as TPUBatchBackend): when every
+        # cache change since the last sync was this backend's own bulk
+        # assume/confirm lifecycle, the O(dirty-rows) re-encode is
+        # skipped and this backend replays its own placements into the
+        # tensors directly (_replay_claims) — the dominant steady-state
+        # shape, and the biggest single host cost the null measurement
+        # was still paying (~7µs/pod of re-encode at the 100k tier)
+        self._last_epoch: int | None = None
+        self._synced = False
         self.stats = {"batches": 0}
 
     def warmup(self) -> None:
@@ -115,23 +124,58 @@ class NullBatchBackend(BatchBackend):
         if not len(rows):
             return assignments
         cap = np.minimum(self._cap[rows], n)
+        # materialize only the first n slots: at 100k nodes the full
+        # repeat would build a ~30M-element array per dispatch (~110ms —
+        # measured as 70% of the whole dispatch) for 16k placements
+        cum = np.cumsum(cap)
+        stop = int(np.searchsorted(cum, n))
+        if stop < len(rows):
+            cap = cap[:stop + 1].copy()
+            cap[stop] -= int(cum[stop]) - n  # partial last row
+            rows = rows[:stop + 1]
         slots = np.repeat(rows, cap)
         k = min(len(slots), n)
         assignments[:k] = slots[:k]
-        used_rows, counts = np.unique(slots[:k], return_counts=True)
-        self._cap[used_rows] -= counts
+        self._cap[rows] -= cap
         return assignments
+
+    def _replay_claims(self, batch, assignments: np.ndarray, n: int) -> None:
+        """Apply this batch's placements to the host tensors so the next
+        dispatch's epoch skip sees current used/npods without a cache
+        re-encode (the cache's authoritative re-encode overwrites these
+        rows with identical values whenever an external epoch bump forces
+        a real sync)."""
+        t = self.tensors
+        rows = assignments[:min(n, self.batch_size)]
+        placed = np.nonzero(rows >= 0)[0]
+        if placed.size == 0:
+            return
+        prow = rows[placed]
+        np.add.at(t.used, prow, batch.req[placed])
+        np.add.at(t.used_nz, prow, batch.req_nz[placed])
+        np.add.at(t.npods, prow, 1.0)
 
     def dispatch(self, pod_infos: Sequence[PodInfo], snapshot):
         with self._lock:
+            epoch_fn = getattr(snapshot, "epoch", None)
+            epoch = epoch_fn() if epoch_fn is not None else None
+            skip_sync = (epoch is not None and self._synced
+                         and epoch == self._last_epoch
+                         and not self._carry_dirty)
             try:
-                dirty = set(self.tensors.update_from_snapshot_tracked(
-                    snapshot))
-                dirty |= self._carry_dirty
-                self._carry_dirty = set()
+                if skip_sync:
+                    dirty = set()
+                else:
+                    dirty = set(self.tensors.update_from_snapshot_tracked(
+                        snapshot))
+                    dirty |= self._carry_dirty
+                    self._carry_dirty = set()
+                    self._last_epoch = epoch
+                    self._synced = True
                 batch = self.encoder.encode(list(pod_infos))
             except VocabFullError as e:
                 from ..scheduler.types import SKIP
+                self._synced = False  # partial sync: force a real one next
                 results = [(None, Status(SKIP, str(e)))] * len(pod_infos)
                 return lambda: results
             n = len(pod_infos)
@@ -143,8 +187,11 @@ class NullBatchBackend(BatchBackend):
                 batch, n, np.fromiter(dirty, np.int64, len(dirty)))
             if extra_escapes:
                 assignments[list(extra_escapes)] = -1
+            self._replay_claims(batch, assignments, n)
             row_infos = list(self.tensors.node_infos)
             self.stats["batches"] += 1
+            self.stats["epoch_skips"] = self.stats.get(
+                "epoch_skips", 0) + (1 if skip_sync else 0)
         escapes = set(batch.escape) | extra_escapes
 
         def resolve():

@@ -141,6 +141,30 @@ class _BucketQueue:
     def remove(self, key: str) -> QueuedPodInfo | None:
         return self._entries.pop(key, None)
 
+    def pop_n(self, max_n: int) -> list[QueuedPodInfo]:
+        """Drain up to max_n pods in priority/FIFO order.  The full-drain
+        case (the TPU batch path's dominant shape: the whole queue fits
+        one batch) validates ghosts against the entries dict bucket by
+        bucket and retires the dict with ONE clear() instead of a del
+        per pod — measurably cheaper at 16k-pod drains."""
+        entries = self._entries
+        if len(entries) <= max_n:
+            out: list[QueuedPodInfo] = []
+            while self._prios:
+                p = heapq.heappop(self._prios)
+                for qpi in self._buckets.pop(p):
+                    if entries.get(qpi.key) is qpi:
+                        out.append(qpi)
+            entries.clear()
+            return out
+        out = []
+        while len(out) < max_n:
+            qpi = self.pop()
+            if qpi is None:
+                break
+            out.append(qpi)
+        return out
+
     def __contains__(self, key: str) -> bool:
         return key in self._entries
 
@@ -303,13 +327,21 @@ class SchedulingQueue:
             return []
         batch = [first]
         with self._cond:
-            while len(batch) < max_n:
-                qpi = self._active.pop()
-                if qpi is None:
-                    break
-                qpi.attempts += 1
-                self._scheduling_cycle += 1
-                batch.append(qpi)
+            pop_n = getattr(self._active, "pop_n", None)
+            if pop_n is not None:
+                rest = pop_n(max_n - 1)
+                for qpi in rest:
+                    qpi.attempts += 1
+                self._scheduling_cycle += len(rest)
+                batch.extend(rest)
+            else:
+                while len(batch) < max_n:
+                    qpi = self._active.pop()
+                    if qpi is None:
+                        break
+                    qpi.attempts += 1
+                    self._scheduling_cycle += 1
+                    batch.append(qpi)
         return batch
 
     def add_unschedulable_if_not_present(self, qpi: QueuedPodInfo,

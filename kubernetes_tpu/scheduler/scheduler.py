@@ -33,7 +33,7 @@ from ..api.meta import Obj
 from ..client.clientset import Client, NODES, PODS
 from ..client.informer import SharedInformerFactory
 from ..store import kv
-from ..utils import stagelat
+from ..utils import fasthost, stagelat
 from . import metrics as _metrics
 from .cache import Cache, Snapshot
 from .framework import CycleState, Framework, Handle
@@ -1019,7 +1019,8 @@ class Scheduler:
         t_phase = time.monotonic()
         bulk: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         # phase 1: collect placements; failures/escapes handled per pod
-        placed: list[tuple[QueuedPodInfo, str, Obj, PodInfo]] = []
+        placed_q: list[QueuedPodInfo] = []
+        placed_names: list[str] = []
         fit_failures: list[tuple[QueuedPodInfo, Status]] = []
         for qpi, (node_name, s) in zip(live, results):
             if node_name is None:
@@ -1038,14 +1039,18 @@ class Scheduler:
                 self._handle_failure(fw, qpi, st, cycle,
                                      {st.plugin} if st.plugin else set(), start)
                 continue
-            pod = qpi.pod_info.pod
-            # 2-level shallow copy: only spec is replaced; nested values are
-            # never mutated in place (store reads hand out copies), so the
-            # deep copy the per-pod path does is pure overhead here
-            assumed = {**pod, "spec": {**(pod.get("spec") or {}),
-                                       "nodeName": node_name}}
-            placed.append((qpi, node_name, assumed,
-                           qpi.pod_info.clone_with_pod(assumed)))
+            placed_q.append(qpi)
+            placed_names.append(node_name)
+        # 2-level shallow copies in ONE native pass (utils/fasthost): only
+        # spec is replaced; nested values are never mutated in place on
+        # this path (store reads hand out copies), so the deep copy the
+        # per-pod path does is pure overhead here
+        assumed_objs = fasthost.build_assumed(
+            [q.pod_info.pod for q in placed_q], placed_names)
+        clones = fasthost.clone_podinfos(
+            [q.pod_info for q in placed_q], assumed_objs)
+        placed: list[tuple[QueuedPodInfo, str, Obj, PodInfo]] = list(
+            zip(placed_q, placed_names, assumed_objs, clones))
         if stagelat.ENABLED:
             stagelat.record("finish_collect", time.monotonic() - t_phase)
             t_phase = time.monotonic()

@@ -21,8 +21,9 @@ from ..api.labels import Selector, selector_from_dict
 from ..api.meta import Obj
 from ..api.resources import (
     Resource, node_allocatable, pod_request, pod_request_nonzero,
-    pod_request_pair,
+    pod_request_pair, request_pair_from_requests,
 )
+from ..utils import fasthost
 
 # --- Status codes (framework/interface.go:84-120) -------------------------
 
@@ -153,13 +154,32 @@ class PodInfo:
         "preferred_affinity_terms", "preferred_anti_affinity_terms",
         "tolerations", "node_selector", "node_affinity_required",
         "node_affinity_preferred", "host_ports", "topology_spread_constraints",
-        "scheduler_name", "nominated_node_name",
+        "scheduler_name", "nominated_node_name", "plain",
     )
 
     def __init__(self, pod: Obj):
         self.update(pod)
 
     def update(self, pod: Obj) -> None:
+        # native fast path: ONE C pass walks the pod dict and, when it
+        # proves the pod "simple" (no affinity/selector/spread/ports/
+        # special volumes/nomination/nodeName), fills every slot
+        # directly — only the request pair stays in Python (its shared
+        # lru-cached instances).  The C `simple` predicate mirrors this
+        # method's own branch conditions, so a pod the C side can't
+        # prove takes the full path and the two can never diverge on a
+        # fast-path pod (differential corpus: tests/test_fasthost.py).
+        requests = fasthost.pod_scan_into(pod, self, _FAST_DEFAULTS)
+        if requests is not False:
+            # `requests` is only a dict for the proven single-container
+            # shape; multi-container/initContainer pods still need the
+            # general sum/max computation
+            self.request, self.request_nonzero = (
+                request_pair_from_requests(requests)
+                if requests is not None else pod_request_pair(pod))
+            if self.request.scalar or self.request_nonzero.scalar:
+                self.plain = False
+            return
         spec = pod.get("spec") or {}
         self.pod = pod
         self.key = meta.namespaced_name(pod)
@@ -210,6 +230,32 @@ class PodInfo:
         self.tolerations = spec.get("tolerations") or []
         self.host_ports = _collect_host_ports(spec)
         self.topology_spread_constraints = spec.get("topologySpreadConstraints") or []
+        # plain == touches none of the constraint-side tensor fields:
+        # the TPU flattener's fast-path predicate, computed ONCE here
+        # (where every input is already in hand) instead of per encode.
+        # The checks mirror flatten._encode_pod's write sites exactly.
+        plain = not (
+            self.nominated_node_name or self.node_selector
+            or self.node_affinity_required or self.node_affinity_preferred
+            or self.required_affinity_terms
+            or self.required_anti_affinity_terms
+            or self.preferred_affinity_terms
+            or self.preferred_anti_affinity_terms
+            or self.topology_spread_constraints or self.host_ports
+            or self.request.scalar or self.request_nonzero.scalar
+            or spec.get("nodeName"))
+        if plain:
+            for v in spec.get("volumes") or ():
+                if (v.get("persistentVolumeClaim")
+                        or v.get("gcePersistentDisk")
+                        or v.get("awsElasticBlockStore")
+                        or v.get("azureDisk") or v.get("iscsi")
+                        or v.get("csi")):
+                    # volume binding/zones/limits are deeply stateful:
+                    # oracle path (flatten._encode_pod escapes these)
+                    plain = False
+                    break
+        self.plain = plain
 
     def clone_with_pod(self, pod: Obj) -> "PodInfo":
         """Copy of this PodInfo pointing at `pod` WITHOUT re-parsing.
@@ -262,6 +308,13 @@ def node_selector_terms_match(terms: list[tuple[Selector, Selector]], node: Obj)
 _EMPTY_PORTS: list[tuple[str, str, int]] = []
 # shared empties for the no-affinity fast path; treated as immutable
 _EMPTY_TERMS: list = []
+_EMPTY_DICT: dict = {}
+_EMPTY_LIST: list = []
+# singletons handed to the C fast path (fasthost.pod_scan_into): shared
+# across every simple PodInfo, read-only by the same contract as
+# _EMPTY_TERMS (consumers only iterate/read these fields)
+_FAST_DEFAULTS = (_EMPTY_TERMS, _EMPTY_PORTS, _EMPTY_DICT, _EMPTY_LIST,
+                  "default-scheduler")
 
 
 def _collect_host_ports(spec: Obj) -> list[tuple[str, str, int]]:

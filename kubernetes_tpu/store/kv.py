@@ -66,6 +66,14 @@ class AlreadyExistsError(StoreError):
     pass
 
 
+class FencedError(StoreError):
+    """The store was fenced: a newer primary epoch exists (replication
+    failover promoted a follower) and this instance must not accept
+    writes — the split-brain guard etcd gets from raft terms
+    (etcd3/store.go:798 sits on a raft log whose deposed leaders cannot
+    commit).  Reads keep serving; rejoin as a follower to resume."""
+
+
 class ConflictError(StoreError):
     """resourceVersion mismatch — caller should re-get and retry."""
 
@@ -177,6 +185,12 @@ class MemoryStore:
         self._floor = 0
         self._wal = None
         self._repl = None  # replication hub (replica.ReplicationHub)
+        # fencing state (replica.py failover): epoch is this store's
+        # primary term — promotion bumps it; a fenced store rejects
+        # writes until it rejoins the new primary as a follower
+        self.epoch = 0
+        self._fenced = False
+        self._fence_reason = ""
         self._compact_every = compact_every
         self._snapshot_thread: threading.Thread | None = None
         if durable_dir is not None:
@@ -236,6 +250,20 @@ class MemoryStore:
     def _logging(self) -> bool:
         """Should mutation sites build commit records?"""
         return self._wal is not None or self._repl is not None
+
+    def _check_fence(self) -> None:
+        """Raise on a fenced store (replica.py failover).  Called at the
+        top of every write verb; the flag read is GIL-atomic so the
+        un-fenced fast path costs one attribute load."""
+        if self._fenced:
+            raise FencedError(f"store fenced: {self._fence_reason}")
+
+    def fence(self, reason: str) -> None:
+        """Stop accepting writes (idempotent).  Reads/watches continue —
+        a fenced deposed primary can still serve stale reads while the
+        operator or failover logic re-points clients."""
+        self._fence_reason = reason
+        self._fenced = True
 
     def _commit(self, recs: list[tuple]) -> None:
         """Route committed mutation records (op, rev, resource, key, obj)
@@ -319,6 +347,7 @@ class MemoryStore:
             return self._rev
 
     def create(self, resource: str, obj: Obj) -> Obj:
+        self._check_fence()
         with self._lock:
             key = meta.namespaced_name(obj)
             table = self._table(resource)
@@ -347,6 +376,7 @@ class MemoryStore:
         copy=False skips the inbound deep copy for callers that hand over
         OWNERSHIP of freshly-built objects they never touch again (the
         event broadcaster); the caller must guarantee no later mutation."""
+        self._check_fence()
         out: list[tuple[Obj | None, StoreError | None]] = []
         evs: list[WatchEvent] = []
         recs: list[tuple] = []
@@ -396,6 +426,7 @@ class MemoryStore:
 
     def update(self, resource: str, obj: Obj, expect_rv: int | None = None) -> Obj:
         """CAS update: expect_rv defaults to the object's own resourceVersion."""
+        self._check_fence()
         with self._lock:
             table = self._table(resource)
             key = meta.namespaced_name(obj)
@@ -432,6 +463,7 @@ class MemoryStore:
     def guaranteed_update(self, resource: str, namespace: str, name: str,
                           fn: Callable[[Obj], Obj], max_retries: int = 16) -> Obj:
         """GuaranteedUpdate (etcd3/store.go:331): get -> transform -> CAS, retry on conflict."""
+        self._check_fence()
         for _ in range(max_retries):
             cur = self.get(resource, namespace, name)
             updated = fn(meta.deep_copy(cur))
@@ -443,6 +475,7 @@ class MemoryStore:
 
     def delete(self, resource: str, namespace: str, name: str,
                expect_rv: int | None = None) -> Obj:
+        self._check_fence()
         with self._lock:
             table = self._table(resource)
             key = self._key(namespace, name)
@@ -495,6 +528,7 @@ class MemoryStore:
         batched assignment makes the 1-write-per-pod pattern the bottleneck,
         so the store grows a transactional multi-bind instead.
         """
+        self._check_fence()
         out: list[tuple[Obj | None, StoreError | None]] = []
         evs: list[WatchEvent] = []
         recs: list[tuple] = []

@@ -1,0 +1,101 @@
+"""Native per-pod host helpers with pure-Python fallbacks.
+
+native/fasthost builds `_fasthost` (CPython C API) — one C pass each for
+the scheduler's per-pod host loops (see fasthost.c header for the
+inventory and the reference's goroutine/parallel-for analog).  Consumers:
+
+  scheduler/scheduler.py  _finish_batch  -> build_assumed, clone_podinfos
+  ops/flatten.py          encode         -> req_columns
+  scheduler/types.py      PodInfo.update -> pod_scan_into
+
+Every helper has a byte-identical pure-Python fallback so the framework
+runs unchanged where the toolchain is absent (KTPU_NO_NATIVE_BUILD=1
+skips the in-place build, like fastcopy)."""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+_native = None
+
+
+def _load_native():
+    global _native
+    here = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..",
+                                        "native", "fasthost"))
+    sos = glob.glob(os.path.join(here, "_fasthost*.so"))
+    if not sos and os.path.isdir(here) and not os.environ.get(
+            "KTPU_NO_NATIVE_BUILD"):
+        import subprocess
+        try:
+            subprocess.run([sys.executable, "setup.py", "build_ext",
+                            "--inplace"], cwd=here, capture_output=True,
+                           timeout=120, check=False)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        sos = glob.glob(os.path.join(here, "_fasthost*.so"))
+    for path in sos:
+        d = os.path.dirname(path)
+        if d not in sys.path:
+            sys.path.insert(0, d)
+    try:
+        import _fasthost  # type: ignore
+        _native = _fasthost
+    except ImportError:
+        _native = None
+
+
+_load_native()
+
+
+def is_native() -> bool:
+    return _native is not None
+
+
+def build_assumed(pods: list, node_names: list) -> list:
+    """[{**pod, "spec": {**spec, "nodeName": n}}] for each (pod, n).
+    2-level shallow copies: nested values are never mutated in place on
+    this path (store reads hand out copies), matching the Python
+    original in scheduler._finish_batch."""
+    if _native is not None:
+        return _native.build_assumed(pods, node_names)
+    return [{**pod, "spec": {**(pod.get("spec") or {}), "nodeName": n}}
+            for pod, n in zip(pods, node_names)]
+
+
+def req_columns(pod_infos: list, req, req_nz) -> None:
+    """Fill req[i,0:3] / req_nz[i,0:3] (float32 C-contiguous) from
+    pod_infos[i].request / .request_nonzero."""
+    if _native is not None:
+        _native.req_columns(pod_infos, req, req_nz)
+        return
+    req[:len(pod_infos), 0] = [pi.request.milli_cpu for pi in pod_infos]
+    req[:len(pod_infos), 1] = [pi.request.memory for pi in pod_infos]
+    req[:len(pod_infos), 2] = [pi.request.ephemeral_storage
+                               for pi in pod_infos]
+    req_nz[:len(pod_infos), 0] = [pi.request_nonzero.milli_cpu
+                                  for pi in pod_infos]
+    req_nz[:len(pod_infos), 1] = [pi.request_nonzero.memory
+                                  for pi in pod_infos]
+    req_nz[:len(pod_infos), 2] = [pi.request_nonzero.ephemeral_storage
+                                  for pi in pod_infos]
+
+
+def pod_scan_into(pod: dict, pi, defaults: tuple):
+    """Whole PodInfo fast path in C: fills pi's slots when the pod is
+    simple.  Returns False (not simple / native absent — take the full
+    Python path), a requests dict (single-container fast shape), or
+    None (simple but requests need the general computation)."""
+    if _native is not None:
+        return _native.pod_scan_into(pod, pi, defaults)
+    return False
+
+
+def clone_podinfos(infos: list, pods: list) -> list:
+    """Batch clone_with_pod (scheduler batch tail): one C pass when
+    built, per-pod Python clones otherwise."""
+    if _native is not None:
+        return _native.clone_podinfos(infos, pods)
+    return [pi.clone_with_pod(pod) for pi, pod in zip(infos, pods)]

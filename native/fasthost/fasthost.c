@@ -1,0 +1,479 @@
+/* fasthost — C helpers for the scheduler's per-pod host hot paths.
+ *
+ * The TPU moved the node-axis work off the host; what remains is a
+ * per-POD stream of small dict/attribute operations spread across the
+ * informer, sched-loop, and binder threads.  At 100k-node bench scale
+ * these Python-level loops are the single-interpreter wall's biggest
+ * line items (VERDICT r4 item #1); each helper here collapses one of
+ * them into a single C pass:
+ *
+ *   build_assumed(pods, node_names)  the batch tail's per-pod
+ *       {**pod, "spec": {**spec, "nodeName": n}} construction
+ *       (scheduler._finish_batch phase 1)
+ *   req_columns(infos, req, req_nz)  the encoder's six per-pod
+ *       attribute-read list comprehensions -> two [P,3]-ish float32
+ *       column fills (ops/flatten.BatchEncoder.encode)
+ *   pod_scan(pod)                    the informer-side PodInfo field
+ *       extraction: one dict walk instead of ~15 .get chains
+ *       (scheduler/types.PodInfo.update fast path)
+ *
+ * Reference context: the reference spreads this work over goroutines
+ * (one binding cycle each, pkg/scheduler/schedule_one.go:100) and a
+ * 16-worker parallel-for (parallelize/parallelism.go:13); CPython gets
+ * the equivalent throughput back by making the per-pod constant native.
+ *
+ * Falls back transparently: kubernetes_tpu/utils/fasthost.py uses the
+ * pure-Python paths when the extension isn't built.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+/* interned key cache (module-lifetime) */
+static PyObject *s_spec, *s_nodeName, *s_metadata, *s_name, *s_namespace,
+    *s_uid, *s_labels, *s_priority, *s_schedulerName, *s_status,
+    *s_nominatedNodeName, *s_affinity, *s_nodeSelector, *s_tolerations,
+    *s_topologySpreadConstraints, *s_containers, *s_initContainers,
+    *s_overhead, *s_volumes, *s_resources, *s_requests, *s_ports,
+    *s_request, *s_request_nonzero, *s_milli_cpu, *s_memory,
+    *s_ephemeral_storage, *s_deletionTimestamp;
+static PyObject *s_pvc, *s_gce, *s_aws, *s_azure, *s_iscsi, *s_csi;
+static PyObject *empty_unicode, *zero_long;
+
+static int
+intern_all(void)
+{
+#define I(var, str) if (!(var = PyUnicode_InternFromString(str))) return -1
+    I(s_spec, "spec"); I(s_nodeName, "nodeName"); I(s_metadata, "metadata");
+    I(s_name, "name"); I(s_namespace, "namespace"); I(s_uid, "uid");
+    I(s_labels, "labels"); I(s_priority, "priority");
+    I(s_schedulerName, "schedulerName"); I(s_status, "status");
+    I(s_nominatedNodeName, "nominatedNodeName"); I(s_affinity, "affinity");
+    I(s_nodeSelector, "nodeSelector"); I(s_tolerations, "tolerations");
+    I(s_topologySpreadConstraints, "topologySpreadConstraints");
+    I(s_containers, "containers"); I(s_initContainers, "initContainers");
+    I(s_overhead, "overhead"); I(s_volumes, "volumes");
+    I(s_resources, "resources"); I(s_requests, "requests");
+    I(s_ports, "ports");
+    I(s_request, "request"); I(s_request_nonzero, "request_nonzero");
+    I(s_milli_cpu, "milli_cpu"); I(s_memory, "memory");
+    I(s_ephemeral_storage, "ephemeral_storage");
+    I(s_deletionTimestamp, "deletionTimestamp");
+    I(s_pvc, "persistentVolumeClaim"); I(s_gce, "gcePersistentDisk");
+    I(s_aws, "awsElasticBlockStore"); I(s_azure, "azureDisk");
+    I(s_iscsi, "iscsi"); I(s_csi, "csi");
+#undef I
+    if (!(empty_unicode = PyUnicode_InternFromString("")))
+        return -1;
+    if (!(zero_long = PyLong_FromLong(0)))
+        return -1;
+    return 0;
+}
+
+/* dict.get(k) that tolerates a non-dict (returns NULL borrowed, no err) */
+static inline PyObject *
+dget(PyObject *d, PyObject *k)
+{
+    if (d == NULL || !PyDict_CheckExact(d))
+        return NULL;
+    return PyDict_GetItemWithError(d, k); /* borrowed */
+}
+
+/* ---- build_assumed(pods, node_names) -> list[dict] ------------------- */
+
+static PyObject *
+fasthost_build_assumed(PyObject *self, PyObject *args)
+{
+    PyObject *pods, *names;
+    if (!PyArg_ParseTuple(args, "OO", &pods, &names))
+        return NULL;
+    if (!PyList_CheckExact(pods) || !PyList_CheckExact(names)
+        || PyList_GET_SIZE(pods) != PyList_GET_SIZE(names)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "build_assumed: two equal-length lists required");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(pods);
+    PyObject *out = PyList_New(n);
+    if (out == NULL)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pod = PyList_GET_ITEM(pods, i);
+        PyObject *node = PyList_GET_ITEM(names, i);
+        if (!PyDict_CheckExact(pod)) {
+            PyErr_SetString(PyExc_TypeError, "build_assumed: pod not a dict");
+            goto fail;
+        }
+        PyObject *assumed = PyDict_Copy(pod);           /* 1-level copy */
+        if (assumed == NULL)
+            goto fail;
+        PyObject *spec = dget(pod, s_spec);             /* borrowed */
+        PyObject *nspec = spec != NULL && PyDict_CheckExact(spec)
+                              ? PyDict_Copy(spec) : PyDict_New();
+        if (nspec == NULL) {
+            Py_DECREF(assumed);
+            goto fail;
+        }
+        if (PyDict_SetItem(nspec, s_nodeName, node) < 0
+            || PyDict_SetItem(assumed, s_spec, nspec) < 0) {
+            Py_DECREF(nspec);
+            Py_DECREF(assumed);
+            goto fail;
+        }
+        Py_DECREF(nspec);
+        PyList_SET_ITEM(out, i, assumed);               /* steals */
+    }
+    return out;
+fail:
+    Py_DECREF(out);
+    return NULL;
+}
+
+/* ---- req_columns(pod_infos, req, req_nz) ----------------------------- */
+/* Fill req[i,0..2] and req_nz[i,0..2] (float32, C-contiguous, width >= 3)
+ * from pod_infos[i].request / .request_nonzero in one C loop. */
+
+static int
+fill_from(PyObject *res, float *row, Py_ssize_t stride_ok)
+{
+    (void)stride_ok;
+    PyObject *v;
+    v = PyObject_GetAttr(res, s_milli_cpu);
+    if (v == NULL) return -1;
+    row[0] = (float)PyLong_AsDouble(v);
+    Py_DECREF(v);
+    v = PyObject_GetAttr(res, s_memory);
+    if (v == NULL) return -1;
+    row[1] = (float)PyLong_AsDouble(v);
+    Py_DECREF(v);
+    v = PyObject_GetAttr(res, s_ephemeral_storage);
+    if (v == NULL) return -1;
+    row[2] = (float)PyLong_AsDouble(v);
+    Py_DECREF(v);
+    if (PyErr_Occurred()) return -1;
+    return 0;
+}
+
+static PyObject *
+fasthost_req_columns(PyObject *self, PyObject *args)
+{
+    PyObject *infos, *req_obj, *nz_obj;
+    if (!PyArg_ParseTuple(args, "OOO", &infos, &req_obj, &nz_obj))
+        return NULL;
+    if (!PyList_CheckExact(infos)) {
+        PyErr_SetString(PyExc_TypeError, "req_columns: infos must be a list");
+        return NULL;
+    }
+    Py_buffer req, nz;
+    if (PyObject_GetBuffer(req_obj, &req, PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE
+                                              | PyBUF_FORMAT) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(nz_obj, &nz, PyBUF_C_CONTIGUOUS | PyBUF_WRITABLE
+                                            | PyBUF_FORMAT) < 0) {
+        PyBuffer_Release(&req);
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(infos);
+    if (req.ndim != 2 || nz.ndim != 2 || req.shape[0] < n || nz.shape[0] < n
+        || req.shape[1] < 3 || nz.shape[1] < 3
+        || req.itemsize != 4 || nz.itemsize != 4) {
+        PyErr_SetString(PyExc_ValueError,
+                        "req_columns: need float32 [>=P, >=3] arrays");
+        goto fail;
+    }
+    Py_ssize_t wr = req.shape[1], wn = nz.shape[1];
+    float *rp = (float *)req.buf, *np_ = (float *)nz.buf;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pi = PyList_GET_ITEM(infos, i);
+        PyObject *r = PyObject_GetAttr(pi, s_request);
+        if (r == NULL)
+            goto fail;
+        int rc = fill_from(r, rp + i * wr, 0);
+        Py_DECREF(r);
+        if (rc < 0)
+            goto fail;
+        r = PyObject_GetAttr(pi, s_request_nonzero);
+        if (r == NULL)
+            goto fail;
+        rc = fill_from(r, np_ + i * wn, 0);
+        Py_DECREF(r);
+        if (rc < 0)
+            goto fail;
+    }
+    PyBuffer_Release(&req);
+    PyBuffer_Release(&nz);
+    Py_RETURN_NONE;
+fail:
+    PyBuffer_Release(&req);
+    PyBuffer_Release(&nz);
+    return NULL;
+}
+
+/* ---- pod_scan_into(pod, pi, defaults) -------------------------------- */
+/* The whole PodInfo.update fast path in one C pass: walks the pod dict
+ * (same predicate as pod_scan) and, when the pod is "simple", SETS the
+ * PodInfo slots directly — the Python side only computes the request
+ * pair from the returned requests dict.  Returns:
+ *     False          not simple — caller takes the full Python path
+ *     requests dict  simple, single-container fast shape
+ *     None           simple, but requests need the general computation
+ * `defaults` is (EMPTY_TERMS, EMPTY_PORTS, EMPTY_DICT, EMPTY_LIST,
+ * default_scheduler_name) — module-level singletons shared across pods
+ * (read-only by contract, like types._EMPTY_TERMS).
+ */
+
+static PyObject *s_a_pod, *s_a_key, *s_a_uid, *s_a_labels, *s_a_priority,
+    *s_a_scheduler_name, *s_a_nominated, *s_a_node_selector,
+    *s_a_tolerations, *s_a_host_ports, *s_a_tsc, *s_a_plain,
+    *s_a_req_aff, *s_a_req_anti, *s_a_pref_aff, *s_a_pref_anti,
+    *s_a_node_aff_req, *s_a_node_aff_pref;
+
+static int
+intern_attrs(void)
+{
+#define I(var, str) if (!(var = PyUnicode_InternFromString(str))) return -1
+    I(s_a_pod, "pod"); I(s_a_key, "key"); I(s_a_uid, "uid");
+    I(s_a_labels, "labels"); I(s_a_priority, "priority");
+    I(s_a_scheduler_name, "scheduler_name");
+    I(s_a_nominated, "nominated_node_name");
+    I(s_a_node_selector, "node_selector");
+    I(s_a_tolerations, "tolerations"); I(s_a_host_ports, "host_ports");
+    I(s_a_tsc, "topology_spread_constraints"); I(s_a_plain, "plain");
+    I(s_a_req_aff, "required_affinity_terms");
+    I(s_a_req_anti, "required_anti_affinity_terms");
+    I(s_a_pref_aff, "preferred_affinity_terms");
+    I(s_a_pref_anti, "preferred_anti_affinity_terms");
+    I(s_a_node_aff_req, "node_affinity_required");
+    I(s_a_node_aff_pref, "node_affinity_preferred");
+#undef I
+    return 0;
+}
+
+static PyObject *
+fasthost_pod_scan_into(PyObject *self, PyObject *args)
+{
+    PyObject *pod, *pi, *defaults;
+    if (!PyArg_ParseTuple(args, "OOO", &pod, &pi, &defaults))
+        return NULL;
+    if (!PyDict_CheckExact(pod) || !PyTuple_CheckExact(defaults)
+        || PyTuple_GET_SIZE(defaults) != 5) {
+        PyErr_SetString(PyExc_TypeError,
+                        "pod_scan_into(pod_dict, pi, 5-tuple defaults)");
+        return NULL;
+    }
+    PyObject *empty_terms = PyTuple_GET_ITEM(defaults, 0);
+    PyObject *empty_ports = PyTuple_GET_ITEM(defaults, 1);
+    PyObject *empty_dict = PyTuple_GET_ITEM(defaults, 2);
+    PyObject *empty_list = PyTuple_GET_ITEM(defaults, 3);
+    PyObject *default_sched = PyTuple_GET_ITEM(defaults, 4);
+
+    PyObject *md = dget(pod, s_metadata);
+    PyObject *spec = dget(pod, s_spec);
+    PyObject *status = dget(pod, s_status);
+    PyObject *name = dget(md, s_name);
+    PyObject *ns = dget(md, s_namespace);
+    PyObject *uid = dget(md, s_uid);
+    PyObject *labels = dget(md, s_labels);
+    PyObject *priority = dget(spec, s_priority);
+    PyObject *sched = dget(spec, s_schedulerName);
+    PyObject *nominated = dget(status, s_nominatedNodeName);
+    PyObject *affinity = dget(spec, s_affinity);
+    PyObject *nodesel = dget(spec, s_nodeSelector);
+    PyObject *tols = dget(spec, s_tolerations);
+    PyObject *tsc = dget(spec, s_topologySpreadConstraints);
+    PyObject *node_name = dget(spec, s_nodeName);
+    PyObject *containers = dget(spec, s_containers);
+    PyObject *inits = dget(spec, s_initContainers);
+    PyObject *overhead = dget(spec, s_overhead);
+    PyObject *volumes = dget(spec, s_volumes);
+    if (PyErr_Occurred())
+        return NULL;
+
+    PyObject *requests = NULL;
+    int has_ports = 0;
+    if (containers != NULL && PyList_CheckExact(containers)) {
+        Py_ssize_t nc = PyList_GET_SIZE(containers);
+        for (Py_ssize_t i = 0; i < nc && !has_ports; i++) {
+            PyObject *p = dget(PyList_GET_ITEM(containers, i), s_ports);
+            if (p != NULL && p != Py_None)
+                has_ports = 1;
+        }
+        if (nc == 1 && (inits == NULL || inits == Py_None)
+            && (overhead == NULL || overhead == Py_None)) {
+            PyObject *res = dget(PyList_GET_ITEM(containers, 0), s_resources);
+            requests = dget(res, s_requests);
+        }
+    }
+    /* initContainers can declare hostPorts too (_collect_host_ports
+     * chains them): a ports key on ANY of them disqualifies the fast
+     * path, same as for main containers */
+    if (inits != NULL && PyList_CheckExact(inits)) {
+        Py_ssize_t ni = PyList_GET_SIZE(inits);
+        for (Py_ssize_t i = 0; i < ni && !has_ports; i++) {
+            PyObject *p = dget(PyList_GET_ITEM(inits, i), s_ports);
+            if (p != NULL && p != Py_None)
+                has_ports = 1;
+        }
+    }
+    int special_vol = 0;
+    if (volumes != NULL && PyList_CheckExact(volumes)) {
+        Py_ssize_t nv = PyList_GET_SIZE(volumes);
+        for (Py_ssize_t i = 0; i < nv && !special_vol; i++) {
+            PyObject *v = PyList_GET_ITEM(volumes, i);
+            if (dget(v, s_pvc) || dget(v, s_gce) || dget(v, s_aws)
+                || dget(v, s_azure) || dget(v, s_iscsi) || dget(v, s_csi))
+                special_vol = 1;
+        }
+    }
+    if (PyErr_Occurred())
+        return NULL;
+    int truthy_nominated = nominated != NULL && nominated != Py_None
+                           && PyObject_IsTrue(nominated);
+    int simple = (affinity == NULL || affinity == Py_None)
+                 && (nodesel == NULL || nodesel == Py_None
+                     || (PyDict_CheckExact(nodesel)
+                         && PyDict_GET_SIZE(nodesel) == 0))
+                 && (tsc == NULL || tsc == Py_None
+                     || (PyList_CheckExact(tsc) && PyList_GET_SIZE(tsc) == 0))
+                 && !has_ports && !special_vol && !truthy_nominated
+                 && (node_name == NULL || node_name == Py_None
+                     || !PyObject_IsTrue(node_name));
+    if (PyErr_Occurred())
+        return NULL;
+    if (!simple)
+        Py_RETURN_FALSE;
+
+    /* key = "ns/name" (namespaced) or name */
+    PyObject *key;
+    if (name == NULL)
+        key = Py_NewRef(empty_unicode);
+    else if (ns != NULL && ns != Py_None && PyObject_IsTrue(ns))
+        key = PyUnicode_FromFormat("%U/%U", ns, name);
+    else
+        key = Py_NewRef(name);
+    if (key == NULL)
+        return NULL;
+
+    int rc = 0;
+    rc |= PyObject_SetAttr(pi, s_a_pod, pod);
+    rc |= PyObject_SetAttr(pi, s_a_key, key);
+    Py_DECREF(key);
+    rc |= PyObject_SetAttr(pi, s_a_uid,
+                           uid != NULL && uid != Py_None ? uid
+                                                         : empty_unicode);
+    rc |= PyObject_SetAttr(pi, s_a_labels,
+                           labels != NULL && labels != Py_None ? labels
+                                                               : empty_dict);
+    rc |= PyObject_SetAttr(pi, s_a_priority,
+                           priority != NULL && priority != Py_None
+                               ? priority : zero_long);
+    rc |= PyObject_SetAttr(pi, s_a_scheduler_name,
+                           sched != NULL && sched != Py_None ? sched
+                                                             : default_sched);
+    rc |= PyObject_SetAttr(pi, s_a_nominated, empty_unicode);
+    rc |= PyObject_SetAttr(pi, s_a_node_selector, empty_dict);
+    rc |= PyObject_SetAttr(pi, s_a_tolerations,
+                           tols != NULL && tols != Py_None ? tols
+                                                           : empty_list);
+    rc |= PyObject_SetAttr(pi, s_a_host_ports, empty_ports);
+    rc |= PyObject_SetAttr(pi, s_a_tsc, empty_list);
+    rc |= PyObject_SetAttr(pi, s_a_req_aff, empty_terms);
+    rc |= PyObject_SetAttr(pi, s_a_req_anti, empty_terms);
+    rc |= PyObject_SetAttr(pi, s_a_pref_aff, empty_terms);
+    rc |= PyObject_SetAttr(pi, s_a_pref_anti, empty_terms);
+    rc |= PyObject_SetAttr(pi, s_a_node_aff_req, empty_terms);
+    rc |= PyObject_SetAttr(pi, s_a_node_aff_pref, empty_terms);
+    rc |= PyObject_SetAttr(pi, s_a_plain, Py_True);
+    if (rc != 0)
+        return NULL;
+    if (requests != NULL)
+        return Py_NewRef(requests);
+    Py_RETURN_NONE;
+}
+
+/* ---- clone_podinfos(infos, pods) -> list[PodInfo] -------------------- */
+/* Batch clone_with_pod: for each (pi, pod) allocate a new instance of
+ * type(pi), copy every slot named in __slots__, then point .pod at the
+ * assumed object — the batch tail's per-pod PodInfo copy in one pass. */
+
+static PyObject *
+fasthost_clone_podinfos(PyObject *self, PyObject *args)
+{
+    PyObject *infos, *pods;
+    if (!PyArg_ParseTuple(args, "OO", &infos, &pods))
+        return NULL;
+    if (!PyList_CheckExact(infos) || !PyList_CheckExact(pods)
+        || PyList_GET_SIZE(infos) != PyList_GET_SIZE(pods)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "clone_podinfos: two equal-length lists required");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(infos);
+    PyObject *out = PyList_New(n);
+    if (out == NULL)
+        return NULL;
+    PyObject *slots = NULL;  /* borrowed from the first pi's type */
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pi = PyList_GET_ITEM(infos, i);
+        PyTypeObject *tp = Py_TYPE(pi);
+        if (slots == NULL) {
+            slots = PyObject_GetAttrString((PyObject *)tp, "__slots__");
+            if (slots == NULL)
+                goto fail;
+        }
+        PyObject *clone = tp->tp_alloc(tp, 0);
+        if (clone == NULL)
+            goto fail;
+        Py_ssize_t ns_ = PyTuple_Check(slots) ? PyTuple_GET_SIZE(slots) : 0;
+        for (Py_ssize_t j = 0; j < ns_; j++) {
+            PyObject *sname = PyTuple_GET_ITEM(slots, j);
+            PyObject *v = PyObject_GetAttr(pi, sname);
+            if (v == NULL) {
+                Py_DECREF(clone);
+                goto fail;
+            }
+            int rc = PyObject_SetAttr(clone, sname, v);
+            Py_DECREF(v);
+            if (rc < 0) {
+                Py_DECREF(clone);
+                goto fail;
+            }
+        }
+        if (PyObject_SetAttr(clone, s_a_pod, PyList_GET_ITEM(pods, i)) < 0) {
+            Py_DECREF(clone);
+            goto fail;
+        }
+        PyList_SET_ITEM(out, i, clone);
+    }
+    Py_XDECREF(slots);
+    return out;
+fail:
+    Py_XDECREF(slots);
+    Py_DECREF(out);
+    return NULL;
+}
+
+static PyMethodDef FasthostMethods[] = {
+    {"pod_scan_into", fasthost_pod_scan_into, METH_VARARGS,
+     "Fill a PodInfo's slots from a simple pod in one C pass."},
+    {"clone_podinfos", fasthost_clone_podinfos, METH_VARARGS,
+     "Batch clone_with_pod over slot classes."},
+    {"build_assumed", fasthost_build_assumed, METH_VARARGS,
+     "Per-pod 2-level copy with spec.nodeName set, in one C pass."},
+    {"req_columns", fasthost_req_columns, METH_VARARGS,
+     "Fill float32 request columns from PodInfo.request(_nonzero)."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef fasthostmodule = {
+    PyModuleDef_HEAD_INIT, "_fasthost",
+    "C helpers for scheduler per-pod host hot paths", -1, FasthostMethods,
+};
+
+PyMODINIT_FUNC
+PyInit__fasthost(void)
+{
+    if (intern_all() < 0 || intern_attrs() < 0)
+        return NULL;
+    return PyModule_Create(&fasthostmodule);
+}

@@ -1,0 +1,8 @@
+from setuptools import Extension, setup
+
+setup(
+    name="fasthost",
+    version="1.0",
+    ext_modules=[Extension("_fasthost", sources=["fasthost.c"],
+                           extra_compile_args=["-O2"])],
+)

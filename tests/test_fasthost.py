@@ -1,0 +1,149 @@
+"""Differential tests for the native host helpers (native/fasthost).
+
+Every helper has a pure-Python twin; these tests drive both over a
+corpus of pod shapes — plain, affinity-carrying, ported, scalar-
+resourced, pinned, malformed — and require byte-identical results, so
+the native fast path can never silently diverge from the semantics the
+rest of the tree is tested against.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.scheduler.types import PodInfo
+from kubernetes_tpu.utils import fasthost
+
+
+def pods_corpus() -> list[dict]:
+    base = {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "p", "namespace": "default", "uid": "u-1",
+                         "labels": {"app": "x"}},
+            "spec": {"containers": [{"name": "c0", "image": "img",
+                                     "resources": {"requests": {
+                                         "cpu": "100m", "memory": "128Mi"}}}]}}
+    import copy
+    out = [copy.deepcopy(base)]
+    p = copy.deepcopy(base)  # no namespace, no labels, no requests
+    del p["metadata"]["namespace"]
+    del p["metadata"]["labels"]
+    p["spec"]["containers"][0].pop("resources")
+    out.append(p)
+    p = copy.deepcopy(base)  # priority + schedulerName + tolerations
+    p["spec"]["priority"] = 10
+    p["spec"]["schedulerName"] = "other"
+    p["spec"]["tolerations"] = [{"key": "k", "operator": "Exists"}]
+    out.append(p)
+    p = copy.deepcopy(base)  # anti-affinity
+    p["spec"]["affinity"] = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"topologyKey": "kubernetes.io/hostname",
+             "labelSelector": {"matchLabels": {"app": "x"}}}]}}
+    out.append(p)
+    p = copy.deepcopy(base)  # node selector
+    p["spec"]["nodeSelector"] = {"zone": "a"}
+    out.append(p)
+    p = copy.deepcopy(base)  # host port
+    p["spec"]["containers"][0]["ports"] = [{"containerPort": 80,
+                                            "hostPort": 8080}]
+    out.append(p)
+    p = copy.deepcopy(base)  # container port, NO host port
+    p["spec"]["containers"][0]["ports"] = [{"containerPort": 80}]
+    out.append(p)
+    p = copy.deepcopy(base)  # PVC volume
+    p["spec"]["volumes"] = [{"name": "v",
+                             "persistentVolumeClaim": {"claimName": "c"}}]
+    out.append(p)
+    p = copy.deepcopy(base)  # secret volume (still plain)
+    p["spec"]["volumes"] = [{"name": "v", "secret": {"secretName": "s"}}]
+    out.append(p)
+    p = copy.deepcopy(base)  # pinned
+    p["spec"]["nodeName"] = "node-1"
+    out.append(p)
+    p = copy.deepcopy(base)  # nominated
+    p["status"] = {"nominatedNodeName": "node-2"}
+    out.append(p)
+    p = copy.deepcopy(base)  # scalar resource
+    p["spec"]["containers"][0]["resources"]["requests"]["example.com/gpu"] = "1"
+    out.append(p)
+    p = copy.deepcopy(base)  # two containers
+    p["spec"]["containers"].append({"name": "c1", "resources": {
+        "requests": {"cpu": "50m"}}})
+    out.append(p)
+    p = copy.deepcopy(base)  # initContainers
+    p["spec"]["initContainers"] = [{"name": "i0", "resources": {
+        "requests": {"cpu": "2"}}}]
+    out.append(p)
+    p = copy.deepcopy(base)  # initContainer with a HOST port (plain=False)
+    p["spec"]["initContainers"] = [{"name": "i0",
+                                    "ports": [{"containerPort": 53,
+                                               "hostPort": 5353}]}]
+    out.append(p)
+    p = copy.deepcopy(base)  # topology spread
+    p["spec"]["topologySpreadConstraints"] = [{
+        "maxSkew": 1, "topologyKey": "zone",
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {"app": "x"}}}]
+    out.append(p)
+    return out
+
+
+FIELDS = ["key", "uid", "labels", "priority", "scheduler_name",
+          "nominated_node_name", "node_selector", "tolerations",
+          "host_ports", "topology_spread_constraints", "plain"]
+
+
+@pytest.mark.skipif(not fasthost.is_native(), reason="extension not built")
+@pytest.mark.parametrize("i,pod", list(enumerate(pods_corpus())))
+def test_podinfo_native_vs_python(i, pod, monkeypatch):
+    fast = PodInfo(pod)
+    monkeypatch.setattr(fasthost, "_native", None)  # force Python path
+    slow = PodInfo(pod)
+    for f in FIELDS:
+        assert getattr(fast, f) == getattr(slow, f), (i, f)
+    for f in ("request", "request_nonzero"):
+        a, b = getattr(fast, f), getattr(slow, f)
+        assert (a.milli_cpu, a.memory, a.ephemeral_storage, a.scalar) == \
+               (b.milli_cpu, b.memory, b.ephemeral_storage, b.scalar), (i, f)
+    for f in ("required_affinity_terms", "required_anti_affinity_terms",
+              "preferred_affinity_terms", "preferred_anti_affinity_terms",
+              "node_affinity_required", "node_affinity_preferred"):
+        assert len(getattr(fast, f)) == len(getattr(slow, f)), (i, f)
+
+
+@pytest.mark.skipif(not fasthost.is_native(), reason="extension not built")
+def test_build_assumed_native_vs_python(monkeypatch):
+    pods = pods_corpus()
+    names = [f"node-{i}" for i in range(len(pods))]
+    fast = fasthost.build_assumed(pods, names)
+    monkeypatch.setattr(fasthost, "_native", None)
+    slow = fasthost.build_assumed(pods, names)
+    assert fast == slow
+    for orig, a, n in zip(pods, fast, names):
+        assert a["spec"]["nodeName"] == n
+        assert a is not orig and a["spec"] is not orig.get("spec")
+        assert orig.get("spec", {}).get("nodeName") != n or orig is None
+
+
+@pytest.mark.skipif(not fasthost.is_native(), reason="extension not built")
+def test_req_columns_native_vs_python(monkeypatch):
+    infos = [PodInfo(p) for p in pods_corpus()]
+    n = len(infos)
+    a_req = np.zeros((n + 2, 8), np.float32)
+    a_nz = np.zeros((n + 2, 8), np.float32)
+    fasthost.req_columns(infos, a_req, a_nz)
+    monkeypatch.setattr(fasthost, "_native", None)
+    b_req = np.zeros((n + 2, 8), np.float32)
+    b_nz = np.zeros((n + 2, 8), np.float32)
+    fasthost.req_columns(infos, b_req, b_nz)
+    np.testing.assert_array_equal(a_req[:, :3], b_req[:, :3])
+    np.testing.assert_array_equal(a_nz[:, :3], b_nz[:, :3])
+
+
+@pytest.mark.skipif(not fasthost.is_native(), reason="extension not built")
+def test_pod_scan_rejects_non_dict():
+    with pytest.raises(TypeError):
+        fasthost._native.pod_scan_into([1, 2], None, (None,) * 5)
+    with pytest.raises(TypeError):
+        fasthost._native.build_assumed([{"a": 1}], ["x", "y"])
