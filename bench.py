@@ -146,6 +146,11 @@ EXTRA_CONFIGS = {
                                "timeout": 900.0},
     "SchedulingSecrets": {"workload": "SchedulingSecrets", "batch": 4096,
                           "depth": 2, "timeout": 900.0},
+    # blended tensor+oracle: 5% Gt node-affinity escapes; the config
+    # whose escape_rate must be NON-zero (honest coverage)
+    "SchedulingMixedEscapes": {"workload": "SchedulingMixedEscapes",
+                               "batch": 4096, "depth": 2,
+                               "timeout": 900.0},
 }
 
 

@@ -104,6 +104,142 @@ _CONVERTERS: dict[str, dict[str, tuple]] = {
 }
 
 
+# ---- v1 (hub) write-time defaulting ---------------------------------------
+# pkg/apis/core/v1/defaults.go — the load-bearing defaults every
+# reference client may assume are present on a stored object.  All
+# functions MUTATE in place and only fill MISSING fields (idempotent),
+# so re-running on updates/patches can never clobber user intent.
+
+_VOLUME_MODE_RESOURCES = ("persistentvolumes", "persistentvolumeclaims")
+
+
+def _default_container(c: dict) -> None:
+    """SetDefaults_Container (defaults.go): pull policy by image tag,
+    termination message fields, port protocol, probe timings."""
+    if not c.get("imagePullPolicy"):
+        image = c.get("image") or ""
+        tag = image.rpartition(":")[2] if ":" in image.rpartition("/")[2] \
+            else ""
+        c["imagePullPolicy"] = ("Always" if tag in ("", "latest")
+                                else "IfNotPresent")
+    c.setdefault("terminationMessagePath", "/dev/termination-log")
+    c.setdefault("terminationMessagePolicy", "File")
+    for p in c.get("ports") or ():
+        p.setdefault("protocol", "TCP")
+    for probe_key in ("livenessProbe", "readinessProbe", "startupProbe"):
+        probe = c.get(probe_key)
+        if probe is not None:
+            probe.setdefault("timeoutSeconds", 1)
+            probe.setdefault("periodSeconds", 10)
+            probe.setdefault("successThreshold", 1)
+            probe.setdefault("failureThreshold", 3)
+            if "httpGet" in probe:
+                probe["httpGet"].setdefault("scheme", "HTTP")
+
+
+def _default_pod_v1(pod: dict) -> None:
+    """SetDefaults_Pod/PodSpec (defaults.go:118-199)."""
+    spec = pod.setdefault("spec", {})
+    spec.setdefault("restartPolicy", "Always")
+    spec.setdefault("dnsPolicy", "ClusterFirst")
+    spec.setdefault("schedulerName", "default-scheduler")
+    spec.setdefault("terminationGracePeriodSeconds", 30)
+    spec.setdefault("enableServiceLinks", True)
+    spec.setdefault("securityContext", {})
+    for c in list(spec.get("containers") or ()) + list(
+            spec.get("initContainers") or ()):
+        _default_container(c)
+    if spec.get("hostNetwork"):
+        # hostNetwork ports bind the node: hostPort defaults to
+        # containerPort, for init containers too (defaults.go
+        # SetDefaults_Pod defaultHostNetworkPorts on both lists)
+        for c in list(spec.get("containers") or ()) + list(
+                spec.get("initContainers") or ()):
+            for p in c.get("ports") or ():
+                if p.get("containerPort") and not p.get("hostPort"):
+                    p["hostPort"] = p["containerPort"]
+    for v in spec.get("volumes") or ():
+        # volume-source mode defaults (0644 == 420 decimal)
+        for key in ("secret", "configMap", "downwardAPI", "projected"):
+            if key in v and isinstance(v[key], dict):
+                v[key].setdefault("defaultMode", 420)
+        if "hostPath" in v and isinstance(v["hostPath"], dict):
+            v["hostPath"].setdefault("type", "")
+
+
+def _default_service_v1(svc: dict) -> None:
+    """SetDefaults_Service (defaults.go:80-117)."""
+    spec = svc.setdefault("spec", {})
+    spec.setdefault("sessionAffinity", "None")
+    spec.setdefault("type", "ClusterIP")
+    if spec["sessionAffinity"] == "ClientIP":
+        cfg = spec.setdefault("sessionAffinityConfig", {})
+        cfg.setdefault("clientIP", {}).setdefault("timeoutSeconds", 10800)
+    for p in spec.get("ports") or ():
+        p.setdefault("protocol", "TCP")
+        if "targetPort" not in p and "port" in p:
+            p["targetPort"] = p["port"]
+    if spec["type"] in ("NodePort", "LoadBalancer"):
+        spec.setdefault("externalTrafficPolicy", "Cluster")
+    spec.setdefault("internalTrafficPolicy", "Cluster")
+
+
+def _default_node_v1(node: dict) -> None:
+    """SetDefaults_NodeStatus: allocatable mirrors capacity when unset."""
+    status = node.get("status")
+    if status and status.get("capacity") and not status.get("allocatable"):
+        status["allocatable"] = dict(status["capacity"])
+
+
+def _default_pv_v1(pv: dict) -> None:
+    spec = pv.setdefault("spec", {})
+    spec.setdefault("persistentVolumeReclaimPolicy", "Retain")
+    spec.setdefault("volumeMode", "Filesystem")
+    pv.setdefault("status", {}).setdefault("phase", "Pending")
+
+
+def _default_pvc_v1(pvc: dict) -> None:
+    pvc.setdefault("spec", {}).setdefault("volumeMode", "Filesystem")
+    pvc.setdefault("status", {}).setdefault("phase", "Pending")
+
+
+def _default_secret_v1(secret: dict) -> None:
+    secret.setdefault("type", "Opaque")
+
+
+def _default_namespace_v1(ns: dict) -> None:
+    ns.setdefault("status", {}).setdefault("phase", "Active")
+
+
+def _default_endpoints_v1(ep: dict) -> None:
+    for subset in ep.get("subsets") or ():
+        for p in subset.get("ports") or ():
+            p.setdefault("protocol", "TCP")
+
+
+_V1_DEFAULTERS = {
+    "pods": _default_pod_v1,
+    "services": _default_service_v1,
+    "nodes": _default_node_v1,
+    "persistentvolumes": _default_pv_v1,
+    "persistentvolumeclaims": _default_pvc_v1,
+    "secrets": _default_secret_v1,
+    "namespaces": _default_namespace_v1,
+    "endpoints": _default_endpoints_v1,
+}
+
+
+def default_v1(resource: str, obj: dict) -> dict:
+    """Apply v1 write-time defaulting in place and return obj (the
+    apiserver's write pipeline calls this for every core hub-form
+    write; defaults.go runs at decode the same way).  Unknown resources
+    pass through."""
+    fn = _V1_DEFAULTERS.get(resource)
+    if fn is not None and isinstance(obj, dict):
+        fn(obj)
+    return obj
+
+
 def handles(resource: str, version: str) -> bool:
     """Is `resource` served at non-hub `version`?"""
     return version in _CONVERTERS.get(resource, ())

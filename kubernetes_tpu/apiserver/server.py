@@ -150,7 +150,8 @@ class APIServer:
                  flow_dispatcher: flowcontrol.Dispatcher | None = None,
                  audit_logger: auditlib.AuditLogger | None = None,
                  tls: dict | None = None,
-                 enable_service_accounts: bool = False):
+                 enable_service_accounts: bool = False,
+                 disable_admission_plugins: set | frozenset = frozenset()):
         self.store = store
         self.token = token
         # static bearer tokens -> identity (the reference's token-auth
@@ -170,9 +171,20 @@ class APIServer:
         # so `kubeadm join --token` credentials work without restarting
         self.bootstrap_token_auth = bootstrap_token_auth
         self.admission_hooks: list = []  # legacy fn(verb, resource, obj) hooks
+
+        def _authorize_for_admission(user, groups, verb, resource,
+                                     subresource, ns, name) -> bool:
+            """OwnerReferencesPermissionEnforcement's authorizer seam."""
+            if self.authorizer is None:
+                return True
+            return self.authorizer.authorize(rbaclib.Attributes(
+                user, tuple(groups), verb, resource, subresource, ns,
+                name))
+
         self.admission_chain = admission_chain or (
-            adm.default_chain(store) if enable_default_admission
-            else adm.Chain())
+            adm.default_chain(store, _authorize_for_admission,
+                              disable=disable_admission_plugins)
+            if enable_default_admission else adm.Chain())
         self.flow = flow_dispatcher  # None = APF filter disabled
         self.audit = audit_logger
         self.crds = crdlib.CRDRegistry()
@@ -1082,12 +1094,14 @@ class APIServer:
                     except AdmissionError as e:
                         return None, status_error(400, "AdmissionDenied",
                                                   str(e))
+                ident = self._identity() or ("", ())
                 attrs = adm.Attributes(
                     verb, r.resource, obj, old,
                     namespace=(namespace if namespace is not None
                                else r.ns or ""),
                     name=r.name or meta.name(obj) or "",
-                    subresource=r.subresource or "")
+                    subresource=r.subresource or "",
+                    user=ident[0], groups=tuple(ident[1]))
                 try:
                     server.admission_chain.run(attrs)
                 except adm.AdmissionDenied as e:
@@ -1143,9 +1157,17 @@ class APIServer:
                     tv = self._core_target(r)
                     if tv is not None:
                         # versioned core write: default in the request
-                        # version, then convert to the v1 hub for storage
-                        return corever.to_storage(r.resource, obj, tv)
-                    return obj
+                        # version, then convert to the v1 hub for
+                        # storage, then hub-side defaulting
+                        return corever.default_v1(
+                            r.resource,
+                            corever.to_storage(r.resource, obj, tv))
+                    if r.subresource:
+                        return obj  # status/scale splices onto a stored
+                        # (already-defaulted) base; nothing to fill
+                    # v1 write-time defaulting (defaults.go parity):
+                    # idempotent missing-field fills only
+                    return corever.default_v1(r.resource, obj)
                 try:
                     obj = server.crds.coerce(r.resource,
                                              self._custom_version(r),
@@ -1413,6 +1435,11 @@ class APIServer:
                             # same as the singular POST path
                             admitted = corever.to_storage(
                                 r.resource, admitted, core_tv)
+                        if admitted is not None and not custom:
+                            # hub-side v1 defaulting, like the singular
+                            # path's _coerce_custom tail
+                            admitted = corever.default_v1(r.resource,
+                                                          admitted)
                         if admitted is not None and custom:
                             # same prune/default/validate/CEL + storage-
                             # version conversion the singular path runs
@@ -1739,10 +1766,12 @@ class APIServer:
                         for hook in server.admission_hooks:
                             patched = hook(adm.UPDATE, r.resource,
                                            patched) or patched
+                        ident = self._identity() or ("", ())
                         server.admission_chain.run(adm.Attributes(
                             adm.UPDATE, r.resource, patched, cur,
                             namespace=r.ns or "", name=r.name,
-                            subresource=r.subresource or ""))
+                            subresource=r.subresource or "",
+                            user=ident[0], groups=tuple(ident[1])))
                         if self._is_custom(r):
                             patched = server.crds.coerce(
                                 r.resource, self._custom_version(r),
@@ -1917,14 +1946,34 @@ class APIServer:
                         405, "MethodNotAllowed",
                         f"{r.subresource} does not support this verb"))
                     return
-                attrs = adm.Attributes(adm.DELETE, r.resource, None,
-                                       namespace=r.ns or "", name=r.name)
+                # the object being deleted rides old_obj so plugins that
+                # decide on current state (NodeRestriction: whose node is
+                # this pod bound to?) can see it
                 try:
-                    server.admission_chain.run(attrs)
-                except adm.AdmissionDenied as e:
-                    self._send_json(403, status_error(
-                        403, "Forbidden", str(e)))
-                    return
+                    cur_obj = server.store.get(r.resource, r.ns or "",
+                                               r.name)
+                except kv.StoreError:
+                    cur_obj = None
+                if cur_obj is not None or r.resource == "namespaces":
+                    # a DELETE of a missing object must fall through to
+                    # the registry's 404, not die on a state-dependent
+                    # admission verdict (a kubelet retrying a delete the
+                    # GC won would otherwise loop on 403 forever).
+                    # Namespaces stay admitted even when implicit: the
+                    # immortal-namespace guard is name-based.
+                    ident = self._identity() or ("", ())
+                    attrs = adm.Attributes(adm.DELETE, r.resource, None,
+                                           cur_obj,
+                                           namespace=r.ns or "",
+                                           name=r.name,
+                                           user=ident[0],
+                                           groups=tuple(ident[1]))
+                    try:
+                        server.admission_chain.run(attrs)
+                    except adm.AdmissionDenied as e:
+                        self._send_json(403, status_error(
+                            403, "Forbidden", str(e)))
+                        return
                 try:
                     # DeleteOptions.propagationPolicy: Foreground/Orphan
                     # park the object with the matching finalizer for the

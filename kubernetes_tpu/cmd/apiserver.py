@@ -27,6 +27,12 @@ def main(argv=None) -> None:
     ap.add_argument("--enable-default-admission", action="store_true",
                     help="run the in-tree admission chain (the bench's "
                          "front-door configuration)")
+    ap.add_argument("--disable-admission-plugins", default="",
+                    help="comma-separated plugin names to remove from "
+                         "the default chain (the reference harness "
+                         "disables ServiceAccount,TaintNodesByCondition,"
+                         "Priority when no controllers run — "
+                         "scheduler_perf/util.go:84-85)")
     ap.add_argument("-v", "--verbosity", type=int, default=1)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.DEBUG if args.verbosity > 4 else logging.INFO)
@@ -62,7 +68,10 @@ def main(argv=None) -> None:
         store, host=args.bind_address, port=args.secure_port,
         token=args.token, tokens=tokens,
         enable_rbac=args.authorization_mode == "RBAC",
-        enable_default_admission=args.enable_default_admission).start()
+        enable_default_admission=args.enable_default_admission,
+        disable_admission_plugins=frozenset(
+            p for p in args.disable_admission_plugins.split(",")
+            if p)).start()
     print(f"apiserver listening on {server.url}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -22,6 +22,12 @@ logger = logging.getLogger(__name__)
 
 UNREACHABLE_TAINT = {"key": "node.kubernetes.io/unreachable",
                      "effect": "NoExecute"}
+# admission (TaintNodesByCondition) starts every node with this taint;
+# this controller lifts it on the first Ready observation and restores
+# it while the node is NotReady (pkg/controller/nodelifecycle
+# taint-based eviction's condition->taint mapping)
+NOT_READY_TAINT = {"key": "node.kubernetes.io/not-ready",
+                   "effect": "NoSchedule"}
 
 
 class NodeLifecycleController:
@@ -78,6 +84,12 @@ class NodeLifecycleController:
             elif not ready:
                 logger.info("node %s heartbeat recovered; marking Ready", name)
                 self._set_ready(node, True)
+            elif any(t.get("key") == NOT_READY_TAINT["key"]
+                     for t in (node.get("spec") or {}).get("taints") or ()):
+                # Ready and heartbeating but still carrying the
+                # admission-time not-ready taint: lift it (the node's
+                # first transition into service)
+                self._set_ready(node, True)
 
     @staticmethod
     def _is_ready(node: Obj) -> bool:
@@ -95,9 +107,11 @@ class NodeLifecycleController:
                           "status": "True" if ready else "False"})
             taints = n.setdefault("spec", {}).setdefault("taints", [])
             taints[:] = [t for t in taints
-                         if t.get("key") != UNREACHABLE_TAINT["key"]]
+                         if t.get("key") not in (UNREACHABLE_TAINT["key"],
+                                                 NOT_READY_TAINT["key"])]
             if not ready:
                 taints.append(dict(UNREACHABLE_TAINT))
+                taints.append(dict(NOT_READY_TAINT))
             return n
         try:
             self.client.guaranteed_update(NODES, "", meta.name(node), patch)

@@ -263,6 +263,11 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
              "--secure-port", str(port), "--token", token,
              "--authorization-mode", "RBAC",
              "--enable-default-admission",
+             # no controllers run in the harness, so the plugins that
+             # depend on them come off — the reference harness disables
+             # exactly these (scheduler_perf/util.go:84-85)
+             "--disable-admission-plugins",
+             "ServiceAccount,TaintNodesByCondition,Priority",
              "--data-dir", tmpdir.name],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         client = HTTPClient.from_url(f"http://127.0.0.1:{port}",
@@ -296,7 +301,12 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                                durable_dir=tmpdir.name)
         token = pysecrets.token_urlsafe(16)
         server = APIServer(store, token=token, enable_rbac=True,
-                           enable_default_admission=True).start()
+                           enable_default_admission=True,
+                           # scheduler_perf/util.go:84-85: the plugins
+                           # that need controllers come off
+                           disable_admission_plugins=frozenset(
+                               ("ServiceAccount", "TaintNodesByCondition",
+                                "Priority"))).start()
         client = HTTPClient.from_url(server.url, token=token)
     else:
         store = store or kv.MemoryStore(history=1_000_000)
@@ -375,6 +385,18 @@ def _default_pod(i: int, params: dict) -> dict:
                 sel = term.get("labelSelector")
                 if sel and "matchLabels" in sel:
                     sel["matchLabels"] = {"app": svc}
+    esc = params.get("escapeEvery")
+    if esc and i % int(esc) == 0:
+        # every Nth pod carries a Gt node-affinity term — one of the
+        # constraint shapes the tensor path deliberately does NOT encode
+        # (flatten._encode_affinity_terms escapes Gt/Lt), so these pods
+        # measure the blended tensor+oracle regime and a non-zero
+        # escape_rate (the honest-coverage bench config)
+        pod["spec"]["affinity"] = {"nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [{"matchExpressions": [
+                    {"key": "ktpu.io/rack", "operator": "Gt",
+                     "values": ["9"]}]}]}}}
     pg = params.get("podGroups")
     if pg:
         # gang membership: contiguous blocks of minMember pods per group
@@ -404,6 +426,11 @@ def _default_node(i: int, params: dict) -> dict:
     if params.get("zones"):
         zones = params["zones"]
         labels["topology.kubernetes.io/zone"] = zones[i % len(zones)]
+    if params.get("rackLabels"):
+        # numeric label for Gt/Lt node-affinity workloads (the operator
+        # pair the tensor encoding does NOT carry — those pods escape to
+        # the per-pod oracle by design)
+        labels["ktpu.io/rack"] = str(i % int(params["rackLabels"]))
     labels.setdefault("kubernetes.io/hostname", name)
     return node
 

@@ -1,5 +1,11 @@
 """Native per-pod host helpers with pure-Python fallbacks.
 
+Reference analog: the reference spends this per-pod host constant in
+parallel Go — one goroutine per binding cycle
+(pkg/scheduler/schedule_one.go:100-110) and a 16-worker parallel-for
+(pkg/scheduler/framework/parallelize/parallelism.go:13); CPython claws
+the throughput back by making the per-pod constant native instead.
+
 native/fasthost builds `_fasthost` (CPython C API) — one C pass each for
 the scheduler's per-pod host loops (see fasthost.c header for the
 inventory and the reference's goroutine/parallel-for analog).  Consumers:

@@ -1,0 +1,311 @@
+"""Round-5 admission plugins (VERDICT r4 item #7 + missing #4).
+
+Reference: pkg/kubeapiserver/options/plugins.go:64-101 ordering;
+plugin/pkg/admission/noderestriction/admission.go:199 (kubelet writes
+pinned to its own node), serviceaccount (token volume injection),
+storage/storageclass/setdefault, storageobjectinuseprotection,
+nodetaint, PodSecurity, gc (OwnerReferencesPermissionEnforcement).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.apiserver import admission as adm
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def attrs(verb, resource, obj, old=None, ns="default", name="",
+          user="", groups=()):
+    return adm.Attributes(verb, resource, obj, old, namespace=ns,
+                          name=name, user=user, groups=groups)
+
+
+KUBELET = dict(user="system:node:n1", groups=("system:nodes",))
+
+
+class TestNodeRestriction:
+    def setup_method(self):
+        self.p = adm.NodeRestriction()
+
+    def test_kubelet_creates_pod_bound_to_itself(self):
+        pod = make_pod("p").build()
+        pod["spec"]["nodeName"] = "n1"
+        self.p.admit(attrs(adm.CREATE, "pods", pod, **KUBELET))
+
+    def test_kubelet_cannot_create_pod_for_other_node(self):
+        pod = make_pod("p").build()
+        pod["spec"]["nodeName"] = "n2"
+        with pytest.raises(adm.AdmissionDenied):
+            self.p.admit(attrs(adm.CREATE, "pods", pod, **KUBELET))
+
+    def test_kubelet_cannot_update_other_nodes_pod_status(self):
+        cur = make_pod("p").build()
+        cur["spec"]["nodeName"] = "n2"
+        new = dict(cur)
+        with pytest.raises(adm.AdmissionDenied):
+            self.p.admit(attrs(adm.UPDATE, "pods", new, cur, name="p",
+                               **KUBELET))
+
+    def test_kubelet_updates_own_pod_status(self):
+        cur = make_pod("p").build()
+        cur["spec"]["nodeName"] = "n1"
+        self.p.admit(attrs(adm.UPDATE, "pods", dict(cur), cur, name="p",
+                           **KUBELET))
+
+    def test_kubelet_delete_scoped_by_current_binding(self):
+        cur = make_pod("p").build()
+        cur["spec"]["nodeName"] = "n2"
+        with pytest.raises(adm.AdmissionDenied):
+            self.p.admit(attrs(adm.DELETE, "pods", None, cur, name="p",
+                               **KUBELET))
+
+    def test_kubelet_cannot_touch_other_node_object(self):
+        node = make_node("n2").build()
+        with pytest.raises(adm.AdmissionDenied):
+            self.p.admit(attrs(adm.UPDATE, "nodes", node, ns="",
+                               name="n2", **KUBELET))
+        self.p.admit(attrs(adm.UPDATE, "nodes", make_node("n1").build(),
+                           ns="", name="n1", **KUBELET))
+
+    def test_non_kubelet_users_unrestricted(self):
+        pod = make_pod("p").build()
+        pod["spec"]["nodeName"] = "n2"
+        self.p.admit(attrs(adm.CREATE, "pods", pod, user="alice",
+                           groups=("system:authenticated",)))
+
+
+class TestServiceAccount:
+    def setup_method(self):
+        self.store = kv.MemoryStore()
+        self.p = adm.ServiceAccount(self.store)
+
+    def test_defaults_and_injects_token_volume(self):
+        pod = make_pod("p").build()
+        a = attrs(adm.CREATE, "pods", pod)
+        self.p.admit(a)
+        self.p.validate(a)
+        spec = a.obj["spec"]
+        assert spec["serviceAccountName"] == "default"
+        vols = [v for v in spec["volumes"]
+                if v["name"].startswith("kube-api-access")]
+        assert len(vols) == 1
+        srcs = vols[0]["projected"]["sources"]
+        assert any("serviceAccountToken" in s for s in srcs)
+        mounts = spec["containers"][0]["volumeMounts"]
+        assert any(m["mountPath"]
+                   == adm.ServiceAccount.MOUNT_PATH for m in mounts)
+
+    def test_named_missing_account_rejected(self):
+        pod = make_pod("p").build()
+        pod["spec"]["serviceAccountName"] = "builder"
+        a = attrs(adm.CREATE, "pods", pod)
+        self.p.admit(a)
+        with pytest.raises(adm.AdmissionDenied):
+            self.p.validate(a)
+
+    def test_named_existing_account_accepted(self):
+        self.store.create("serviceaccounts", {
+            "apiVersion": "v1", "kind": "ServiceAccount",
+            "metadata": {"name": "builder", "namespace": "default"}})
+        pod = make_pod("p").build()
+        pod["spec"]["serviceAccountName"] = "builder"
+        a = attrs(adm.CREATE, "pods", pod)
+        self.p.admit(a)
+        self.p.validate(a)
+
+    def test_automount_false_skips_injection(self):
+        pod = make_pod("p").build()
+        pod["spec"]["automountServiceAccountToken"] = False
+        a = attrs(adm.CREATE, "pods", pod)
+        self.p.admit(a)
+        assert not any(v["name"].startswith("kube-api-access")
+                       for v in a.obj["spec"].get("volumes", ()))
+
+
+class TestDefaultStorageClass:
+    def _pvc(self):
+        return {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                "metadata": {"name": "c", "namespace": "default"},
+                "spec": {"resources": {"requests": {"storage": "1Gi"}}}}
+
+    def test_default_class_applied(self):
+        store = kv.MemoryStore()
+        store.create("storageclasses", {
+            "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+            "metadata": {"name": "fast", "annotations": {
+                adm.DefaultStorageClass.DEFAULT_ANN: "true"}}})
+        p = adm.DefaultStorageClass(store)
+        a = attrs(adm.CREATE, "persistentvolumeclaims", self._pvc())
+        p.admit(a)
+        assert a.obj["spec"]["storageClassName"] == "fast"
+
+    def test_explicit_class_untouched(self):
+        store = kv.MemoryStore()
+        store.create("storageclasses", {
+            "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+            "metadata": {"name": "fast", "annotations": {
+                adm.DefaultStorageClass.DEFAULT_ANN: "true"}}})
+        p = adm.DefaultStorageClass(store)
+        pvc = self._pvc()
+        pvc["spec"]["storageClassName"] = ""  # explicit no-class
+        a = attrs(adm.CREATE, "persistentvolumeclaims", pvc)
+        p.admit(a)
+        assert a.obj["spec"]["storageClassName"] == ""
+
+    def test_no_default_leaves_unset(self):
+        p = adm.DefaultStorageClass(kv.MemoryStore())
+        a = attrs(adm.CREATE, "persistentvolumeclaims", self._pvc())
+        p.admit(a)
+        assert "storageClassName" not in a.obj["spec"]
+
+
+class TestStorageProtectionAndNodeTaint:
+    def test_pvc_pv_finalizers(self):
+        p = adm.StorageObjectInUseProtection()
+        pvc = {"metadata": {"name": "c", "namespace": "default"},
+               "spec": {}}
+        p.admit(attrs(adm.CREATE, "persistentvolumeclaims", pvc))
+        assert "kubernetes.io/pvc-protection" in \
+            pvc["metadata"]["finalizers"]
+        pv = {"metadata": {"name": "v"}, "spec": {}}
+        p.admit(attrs(adm.CREATE, "persistentvolumes", pv, ns=""))
+        assert "kubernetes.io/pv-protection" in pv["metadata"]["finalizers"]
+
+    def test_new_node_gets_not_ready_taint(self):
+        p = adm.TaintNodesByCondition()
+        node = make_node("n1").build()
+        p.admit(attrs(adm.CREATE, "nodes", node, ns=""))
+        assert any(t["key"] == "node.kubernetes.io/not-ready"
+                   and t["effect"] == "NoSchedule"
+                   for t in node["spec"]["taints"])
+        # idempotent
+        p.admit(attrs(adm.CREATE, "nodes", node, ns=""))
+        assert sum(1 for t in node["spec"]["taints"]
+                   if t["key"] == "node.kubernetes.io/not-ready") == 1
+
+
+class TestPodSecurity:
+    def _store_with_ns(self, level):
+        store = kv.MemoryStore()
+        store.create("namespaces", {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": "locked", "namespace": None,
+                         "labels": {adm.PodSecurity.ENFORCE_LABEL: level}}})
+        return store
+
+    def test_baseline_rejects_host_namespaces_and_privileged(self):
+        p = adm.PodSecurity(self._store_with_ns("baseline"))
+        pod = make_pod("p", "locked").build()
+        pod["spec"]["hostNetwork"] = True
+        with pytest.raises(adm.AdmissionDenied):
+            p.validate(attrs(adm.CREATE, "pods", pod, ns="locked"))
+        pod = make_pod("p", "locked").build()
+        pod["spec"]["containers"][0]["securityContext"] = {
+            "privileged": True}
+        with pytest.raises(adm.AdmissionDenied):
+            p.validate(attrs(adm.CREATE, "pods", pod, ns="locked"))
+        pod = make_pod("p", "locked").build()
+        pod["spec"]["volumes"] = [{"name": "h", "hostPath": {"path": "/"}}]
+        with pytest.raises(adm.AdmissionDenied):
+            p.validate(attrs(adm.CREATE, "pods", pod, ns="locked"))
+
+    def test_baseline_allows_plain_pod(self):
+        p = adm.PodSecurity(self._store_with_ns("baseline"))
+        pod = make_pod("p", "locked").build()
+        p.validate(attrs(adm.CREATE, "pods", pod, ns="locked"))
+
+    def test_restricted_requires_hardening(self):
+        p = adm.PodSecurity(self._store_with_ns("restricted"))
+        pod = make_pod("p", "locked").build()
+        with pytest.raises(adm.AdmissionDenied):
+            p.validate(attrs(adm.CREATE, "pods", pod, ns="locked"))
+        pod["spec"]["containers"][0]["securityContext"] = {
+            "runAsNonRoot": True, "allowPrivilegeEscalation": False,
+            "capabilities": {"drop": ["ALL"]}}
+        p.validate(attrs(adm.CREATE, "pods", pod, ns="locked"))
+
+    def test_unlabeled_namespace_is_privileged(self):
+        p = adm.PodSecurity(kv.MemoryStore())
+        pod = make_pod("p").build()
+        pod["spec"]["hostNetwork"] = True
+        p.validate(attrs(adm.CREATE, "pods", pod))
+
+
+class TestOwnerReferencesPermissionEnforcement:
+    def _pod_with_block(self):
+        pod = make_pod("p").build()
+        pod["metadata"]["ownerReferences"] = [{
+            "apiVersion": "apps/v1", "kind": "ReplicaSet", "name": "rs",
+            "uid": "u1", "blockOwnerDeletion": True}]
+        return pod
+
+    def test_denied_without_finalizer_permission(self):
+        p = adm.OwnerReferencesPermissionEnforcement(
+            lambda *a: False)
+        with pytest.raises(adm.AdmissionDenied):
+            p.validate(attrs(adm.CREATE, "pods", self._pod_with_block(),
+                             user="alice"))
+
+    def test_allowed_with_permission(self):
+        seen = []
+
+        def authorize(user, groups, verb, resource, sub, ns, name):
+            seen.append((verb, resource, sub, name))
+            return True
+
+        p = adm.OwnerReferencesPermissionEnforcement(authorize)
+        p.validate(attrs(adm.CREATE, "pods", self._pod_with_block(),
+                         user="alice"))
+        assert seen == [("update", "replicasets", "finalizers", "rs")]
+
+    def test_unchanged_block_allowed(self):
+        p = adm.OwnerReferencesPermissionEnforcement(lambda *a: False)
+        pod = self._pod_with_block()
+        p.validate(attrs(adm.UPDATE, "pods", pod, pod, user="alice"))
+
+    def test_no_authorizer_disables(self):
+        adm.OwnerReferencesPermissionEnforcement(None).validate(
+            attrs(adm.CREATE, "pods", self._pod_with_block()))
+
+
+class TestChainIntegration:
+    def test_default_chain_order_and_disable(self):
+        store = kv.MemoryStore()
+        chain = adm.default_chain(store)
+        names = [p.name for p in chain.plugins]
+        assert names[-1] == "ResourceQuota"  # quota last (plugins.go)
+        assert "NodeRestriction" in names and "ServiceAccount" in names
+        reduced = adm.default_chain(store, disable=frozenset(
+            ("ServiceAccount", "TaintNodesByCondition", "Priority")))
+        rnames = [p.name for p in reduced.plugins]
+        for gone in ("ServiceAccount", "TaintNodesByCondition", "Priority"):
+            assert gone not in rnames
+
+    def test_http_noderestriction_end_to_end(self):
+        """A kubelet token creating a pod for another node is rejected
+        by the real front door; for its own node it lands."""
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client.http_client import HTTPClient
+        store = kv.MemoryStore()
+        server = APIServer(
+            store,
+            tokens={"kubelet-tok": ("system:node:n1", ("system:nodes",)),
+                    "admin-tok": ("admin", ("system:masters",))},
+            enable_default_admission=True,
+            disable_admission_plugins=frozenset(
+                ("ServiceAccount", "TaintNodesByCondition"))).start()
+        try:
+            kubelet = HTTPClient.from_url(server.url, token="kubelet-tok")
+            bad = make_pod("mirror-bad").build()
+            bad["spec"]["nodeName"] = "n2"
+            with pytest.raises(Exception) as ei:
+                kubelet.create("pods", bad)
+            assert "NodeRestriction" in str(ei.value)
+            good = make_pod("mirror-good").build()
+            good["spec"]["nodeName"] = "n1"
+            created = kubelet.create("pods", good)
+            assert created["spec"]["nodeName"] == "n1"
+        finally:
+            server.stop()

@@ -110,3 +110,20 @@ def test_preemption_evicts_victims():
     finally:
         collector.stop()
         cluster.shutdown()
+
+
+def test_mixed_escapes_reports_nonzero_escape_rate():
+    """SchedulingMixedEscapes: the Gt node-affinity pods must escape to
+    the per-pod oracle (non-zero escape_rate) AND still schedule onto
+    rack>9 nodes only."""
+    from kubernetes_tpu.api import meta
+    from kubernetes_tpu.client.clientset import PODS
+    from kubernetes_tpu.ops.nullbackend import NullBatchBackend  # noqa: F401
+    from kubernetes_tpu.perf import caps_for_nodes
+    from kubernetes_tpu.perf.scheduler_perf import run_named_workload
+    cfg = shrink(load_workloads()["SchedulingMixedEscapes"], 10, 20)
+    summary, stats = run_named_workload(
+        cfg, tpu=True, caps=caps_for_nodes(500), batch_size=512,
+        null_device=True)
+    assert stats.get("barrier_ok"), stats
+    assert stats.get("escape_rate", 0) > 0
