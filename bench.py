@@ -148,9 +148,13 @@ EXTRA_CONFIGS = {
                           "depth": 2, "timeout": 900.0},
     # blended tensor+oracle: 5% Gt node-affinity escapes; the config
     # whose escape_rate must be NON-zero (honest coverage)
+    # pct_nodes=2: percentageOfNodesToScore for the ESCAPED pods'
+    # per-pod cycles (the reference's sampling knob; its adaptive
+    # default would score ~500 nodes per oracle pod and the blended
+    # number would measure Python scoring, not the mixed regime)
     "SchedulingMixedEscapes": {"workload": "SchedulingMixedEscapes",
-                               "batch": 4096, "depth": 2,
-                               "timeout": 900.0},
+                               "batch": 16384, "depth": 2,
+                               "timeout": 900.0, "pct_nodes": 2},
 }
 
 
@@ -214,7 +218,7 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
              batch: int, barrier_timeout: float = 900.0,
              rate: float | None = None, depth: int = 1,
              admission_ms: float = 0.0, via_http: bool = False,
-             null_device: bool = False) -> dict:
+             null_device: bool = False, pct_nodes: int = 0) -> dict:
     """One full workload pass in this process; returns the result dict."""
     import copy
 
@@ -248,7 +252,8 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
                                         pipeline_depth=depth,
                                         admission_interval=admission_ms / 1e3,
                                         via_http=via_http,
-                                        null_device=null_device)
+                                        null_device=null_device,
+                                        percentage_of_nodes_to_score=pct_nodes)
     wall = time.monotonic() - t0
     if not stats.get("barrier_ok", False):
         return {"error": "pods left unscheduled", "value": 0.0,
@@ -323,7 +328,8 @@ def child_main() -> None:
                    via_http=("process"
                              if os.environ.get("_BENCH_W_HTTP") == "proc"
                              else os.environ.get("_BENCH_W_HTTP") == "1"),
-                   null_device=os.environ.get("_BENCH_W_NULL") == "1")
+                   null_device=os.environ.get("_BENCH_W_NULL") == "1",
+                   pct_nodes=int(os.environ.get("_BENCH_W_PCT", "0")))
     if "error" in res:
         emit(0.0, {"error": res["error"], **res["detail"]})
         sys.exit(1)
@@ -364,6 +370,8 @@ def _config_env(c: dict) -> dict:
         env["_BENCH_W_HTTP"] = "proc" if c["http"] == "proc" else "1"
     if c.get("null"):
         env["_BENCH_W_NULL"] = "1"
+    if c.get("pct_nodes"):
+        env["_BENCH_W_PCT"] = str(c["pct_nodes"])
     return env
 
 

@@ -146,15 +146,15 @@ def _default_pod_v1(pod: dict) -> None:
     spec.setdefault("terminationGracePeriodSeconds", 30)
     spec.setdefault("enableServiceLinks", True)
     spec.setdefault("securityContext", {})
-    for c in list(spec.get("containers") or ()) + list(
-            spec.get("initContainers") or ()):
+    all_containers = list(spec.get("containers") or ()) + list(
+        spec.get("initContainers") or ())
+    for c in all_containers:
         _default_container(c)
     if spec.get("hostNetwork"):
         # hostNetwork ports bind the node: hostPort defaults to
         # containerPort, for init containers too (defaults.go
         # SetDefaults_Pod defaultHostNetworkPorts on both lists)
-        for c in list(spec.get("containers") or ()) + list(
-                spec.get("initContainers") or ()):
+        for c in all_containers:
             for p in c.get("ports") or ():
                 if p.get("containerPort") and not p.get("hostPort"):
                     p["hostPort"] = p["containerPort"]

@@ -353,6 +353,16 @@ class NodeRestriction(AdmissionPlugin):
                         f"node {node_name!r} cannot modify pod "
                         f"{attrs.namespace}/{attrs.name} bound to "
                         f"{bound(current)!r}")
+                if attrs.verb == UPDATE and attrs.obj is not None \
+                        and bound(attrs.obj) not in (None, "", node_name):
+                    # the NEW object may not move the binding either — a
+                    # node credential re-binding its pod elsewhere is the
+                    # exact escalation this plugin exists to stop
+                    raise AdmissionDenied(
+                        self.name,
+                        f"node {node_name!r} cannot re-bind pod "
+                        f"{attrs.namespace}/{attrs.name} to "
+                        f"{bound(attrs.obj)!r}")
         elif attrs.resource == "nodes":
             target = attrs.name or meta.name(attrs.obj or {})
             if target and target != node_name:

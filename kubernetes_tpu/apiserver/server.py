@@ -1590,6 +1590,18 @@ class APIServer:
                                 404, "NotFound",
                                 f"{r.resource} has no status subresource"))
                             return
+                        # status writes pass ADMISSION like any update
+                        # (NodeRestriction scopes a kubelet to its own
+                        # pods'/node's status; this path used to bypass
+                        # the chain entirely)
+                        try:
+                            old_for_adm = server.store.get(
+                                r.resource, r.ns or "", r.name)
+                        except kv.StoreError:
+                            old_for_adm = None
+                        if self._admit(adm.UPDATE, r, obj,
+                                       old_for_adm) is None:
+                            return
                         new_status = obj.get("status")
 
                         def set_status(cur):
@@ -1878,10 +1890,12 @@ class APIServer:
                                                 force=force)
                         new["metadata"]["resourceVersion"] = \
                             cur["metadata"].get("resourceVersion")
+                        ident = self._identity() or ("", ())
                         server.admission_chain.run(adm.Attributes(
                             adm.UPDATE, r.resource, new, cur,
                             namespace=r.ns or "", name=r.name,
-                            subresource=r.subresource or ""))
+                            subresource=r.subresource or "",
+                            user=ident[0], groups=tuple(ident[1])))
                         if self._is_custom(r):
                             new = server.crds.coerce(
                                 r.resource, self._custom_version(r),

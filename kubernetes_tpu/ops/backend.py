@@ -611,6 +611,32 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 results = [(None, Status(SKIP, str(e)))] * len(pod_infos)
                 return lambda: results
 
+            n_live = len(pod_infos)
+            if n_live and not batch.p_valid[:min(n_live,
+                                                self.batch_size)].any():
+                # every pod escaped at encode (p_valid False for a live
+                # slot <=> escape): nothing for the device — don't burn a
+                # tunnel round trip on an all-invalid batch.  Preemption
+                # retry waves land here: every nominated pod escapes to
+                # the per-pod oracle by design, and each backoff trickle
+                # used to cost a full device RT for zero placements.
+                # The synced dirty rows carry so the next REAL dispatch
+                # diffs them.
+                self._carry_dirty = dirty
+                self.stats["all_escape_skips"] = self.stats.get(
+                    "all_escape_skips", 0) + 1
+                results = [
+                    (None, Status(SKIP, "escape to per-pod path"))
+                    ] * n_live
+
+                def resolve_escaped():
+                    # stats record OUTSIDE the lock (it re-acquires it)
+                    record_batch_stats(self.stats, self._lock, results,
+                                       n_live)
+                    return results
+
+                return resolve_escaped
+
             inflight = bool(self._unresolved)
             static_changed = self._static_version != self.tensors.static_version
             if skip_sync and not static_changed:
@@ -772,7 +798,12 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
 
     # -- batched preemption (PostFilter's device half) -------------------
 
-    PREEMPT_P_CAP = 32   # failed pods per device call (padded)
+    # Failed pods per device call (padded).  Each chunk is a full device
+    # round trip (~120-300ms over the tunnel), so a 500-pod preemption
+    # wave at cap 32 paid 16 serial RTs — measured as the second-largest
+    # cost of the PreemptionBasic bench.  [P,N] working set at 256 and
+    # n_cap 110336 is ~113MB — comfortably inside HBM.
+    PREEMPT_P_CAP = 256
     PREEMPT_G_CAP = 8    # distinct priority groups per device call
 
     def _req_vec(self, res) -> np.ndarray:

@@ -223,7 +223,8 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                   pipeline_depth: int = 1,
                   admission_interval: float = 0.0,
                   via_http: bool = False,
-                  null_device: bool = False) -> PerfCluster:
+                  null_device: bool = False,
+                  percentage_of_nodes_to_score: int = 0) -> PerfCluster:
     """mustSetupScheduler (util.go:79): in-proc everything, no kubelet.
 
     pipeline_depth/admission_interval select latency mode (scheduler.py):
@@ -326,7 +327,8 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
         backend.warmup()
         fw = new_default_framework(client, factory)
         profiles = {"default-scheduler": Profile(
-            fw, batch_backend=backend, batch_size=batch_size)}
+            fw, batch_backend=backend, batch_size=batch_size,
+            percentage_of_nodes_to_score=percentage_of_nodes_to_score)}
         sched = Scheduler(client, factory, profiles,
                           pipeline_depth=pipeline_depth,
                           admission_interval=admission_interval)
@@ -684,13 +686,16 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                        batch_size: int = 512, pipeline_depth: int = 1,
                        admission_interval: float = 0.0,
                        via_http: bool = False,
-                       null_device: bool = False
+                       null_device: bool = False,
+                       percentage_of_nodes_to_score: int = 0
                        ) -> tuple[ThroughputSummary, dict]:
     """Run one workload config end to end; returns (throughput, stats)."""
-    cluster = setup_cluster(tpu=tpu, caps=caps, batch_size=batch_size,
-                            pipeline_depth=pipeline_depth,
-                            admission_interval=admission_interval,
-                            via_http=via_http, null_device=null_device)
+    cluster = setup_cluster(
+        tpu=tpu, caps=caps, batch_size=batch_size,
+        pipeline_depth=pipeline_depth,
+        admission_interval=admission_interval,
+        via_http=via_http, null_device=null_device,
+        percentage_of_nodes_to_score=percentage_of_nodes_to_score)
     collector = ThroughputCollector(cluster.store)
     try:
         ops = config["workloadTemplate"]

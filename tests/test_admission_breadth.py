@@ -309,3 +309,51 @@ class TestChainIntegration:
             assert created["spec"]["nodeName"] == "n1"
         finally:
             server.stop()
+
+
+class TestNodeRestrictionGapClosures:
+    """Round-5 review findings: rebind-via-update and the
+    status-subresource bypass."""
+
+    def test_kubelet_cannot_rebind_own_pod_elsewhere(self):
+        p = adm.NodeRestriction()
+        cur = make_pod("p").build()
+        cur["spec"]["nodeName"] = "n1"
+        new = {"metadata": dict(cur["metadata"]),
+               "spec": {**cur["spec"], "nodeName": "n2"}}
+        with pytest.raises(adm.AdmissionDenied):
+            p.admit(attrs(adm.UPDATE, "pods", new, cur, name="p",
+                          **KUBELET))
+
+    def test_status_put_passes_admission(self):
+        """A kubelet token PUTting another node's pod STATUS via the
+        real front door is rejected (the path used to bypass the
+        chain)."""
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.client.http_client import HTTPClient
+        store = kv.MemoryStore()
+        pod = make_pod("other-pod").build()
+        pod["spec"]["nodeName"] = "n2"
+        store.create("pods", pod)
+        server = APIServer(
+            store,
+            tokens={"kubelet-tok": ("system:node:n1", ("system:nodes",)),
+                    "admin-tok": ("admin", ("system:masters",))},
+            enable_default_admission=True,
+            disable_admission_plugins=frozenset(
+                ("ServiceAccount", "TaintNodesByCondition"))).start()
+        try:
+            kubelet = HTTPClient.from_url(server.url, token="kubelet-tok")
+            body = {"metadata": {"name": "other-pod",
+                                 "namespace": "default"},
+                    "status": {"phase": "Running"}}
+            with pytest.raises(Exception) as ei:
+                kubelet._request(
+                    "PUT",
+                    "/api/v1/namespaces/default/pods/other-pod/status",
+                    body)
+            assert "NodeRestriction" in str(ei.value)
+            stored = store.get("pods", "default", "other-pod")
+            assert (stored.get("status") or {}).get("phase") != "Running"
+        finally:
+            server.stop()
