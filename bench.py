@@ -73,23 +73,30 @@ EXTRA_CONFIGS = {
     "Scheduling100k": {"workload": "SchedulingBasicLarge",
                        "nodes": 100_000, "pods": 200_000, "batch": 16384,
                        "depth": 2, "timeout": 1200.0},
+    # constraint workloads: batch 8192 (full_cap chunks pipeline inside
+    # ONE dispatch -> fewer fixed per-call tunnel round trips) + a 50ms
+    # admission window so an arrival flood coalesces into ~2 dispatches
     "SchedulingPodAntiAffinity": {"two_pass": True,
                          "workload": "SchedulingPodAntiAffinity",
-                                  "batch": 4096, "depth": 2,
+                                  "batch": 8192, "depth": 2,
+                                  "admission_ms": 50.0,
                                   "timeout": 900.0},
     # 2000 DISTINCT per-service anti-affinity selectors through a few
     # dozen hash-shared tensor slots (flatten.GroupBucket); the result's
     # escape_rate reports the escaped-to-oracle fraction (target <5%)
     "SchedulingHighCardinality": {"two_pass": True,
                          "workload": "SchedulingHighCardinality",
-                                  "batch": 4096, "depth": 2,
+                                  "batch": 8192, "depth": 2,
+                                  "admission_ms": 50.0,
                                   "timeout": 900.0},
     "TopologySpreading": {"two_pass": True,
-                         "workload": "TopologySpreading", "batch": 4096,
-                          "depth": 2, "timeout": 900.0},
+                         "workload": "TopologySpreading", "batch": 8192,
+                          "depth": 2, "admission_ms": 50.0,
+                          "timeout": 900.0},
     "CoschedulingGang": {"two_pass": True,
-                         "workload": "CoschedulingGang", "batch": 4096,
-                         "depth": 2, "timeout": 900.0},
+                         "workload": "CoschedulingGang", "batch": 8192,
+                         "depth": 2, "admission_ms": 50.0,
+                         "timeout": 900.0},
     # the front door: same workload THROUGH a real apiserver with RBAC
     # + admission + WAL, every component speaking HTTP (the reference
     # harness schedules via a real apiserver, util.go:79-108).  The

@@ -810,6 +810,12 @@ class APIServer:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                # plain-identity streams (no field selector, no version
+                # conversion) may use the event's shared wire cache:
+                # one json.dumps per EVENT instead of one per watcher
+                plain_wire = not fsel and (
+                    r is None or not (self._is_custom(r)
+                                      or self._core_target(r)))
                 try:
                     while True:
                         evs = w.next_batch(timeout=5.0)
@@ -819,6 +825,27 @@ class APIServer:
                             evs = [None]  # heartbeat below
                         lines = []
                         relist = False
+                        if plain_wire:
+                            for ev in evs:
+                                if ev is None:
+                                    lines.append(
+                                        '{"type": "BOOKMARK", "object": '
+                                        '{"metadata": {}}}\n')
+                                    continue
+                                wire = ev._wire
+                                if wire is None:
+                                    wire = json.dumps(
+                                        {"type": ev.type,
+                                         "object": ev.object}) + "\n"
+                                    ev._wire = wire
+                                lines.append(wire)
+                            if lines:
+                                data = "".join(lines).encode()
+                                self.wfile.write(
+                                    f"{len(data):x}\r\n".encode()
+                                    + data + b"\r\n")
+                                self.wfile.flush()
+                            continue
                         for ev in evs:
                             if ev is None:
                                 payload = {"type": kv.BOOKMARK,

@@ -229,6 +229,9 @@ def make_assign_core(caps: Caps, weights: dict[str, float] | None = None,
 # WAVE solver
 # ---------------------------------------------------------------------------
 
+TAIL_P = 512  # compacted straggler sub-batch size (tail compaction)
+
+
 def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                     features: frozenset = ALL_FEATURES):
     f_ports = "ports" in features
@@ -267,313 +270,363 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
         # absent in the plain variant's static pytree (only f_cons/f_asg
         # blocks read them; those elide when the features are off)
         dom_sg, dom_asg = node.get("dom_sg"), node.get("dom_asg")
-        req, req_nz = pod["req"], pod["req_nz"]
-        earlier = jnp.tril(jnp.ones((P, P), jnp.float32), k=-1)  # q<p
-        p_iota = jnp.arange(P)
-        pk_static = (pk.prepare_static(req, req_nz, alloc, node["maxpods"],
-                                       static_mask)
+        pk_static = (pk.prepare_static(pod["req"], pod["req_nz"], alloc,
+                                       node["maxpods"], static_mask)
                      if use_pallas else None)
 
-        def wave(state):
-            (used, used_nz, npods, ports, cd_sg, cd_asg,
-             assigned, active, _progress, wcount) = state
+        # TAIL COMPACTION (constraint variants): the first wave of a hard-
+        # constraint batch admits ~98-99% (water-filling cohort); the
+        # straggler waves each re-ran the FULL [P,P] conflict matrices +
+        # [P,N] planes to admit a handful of pods (measured 26.5 ms/wave
+        # at P=4096/N=1280, 5 tail waves for the last ~50 pods).  When
+        # the active set fits TAIL_P, the remaining waves run on a
+        # COMPACTED sub-batch gathered to the front — [P,P] terms shrink
+        # 64x at P=4096 -> 512 — inside the SAME device call, so the
+        # host-side retry kernel's extra round trips (KTPU_FULL_MAIN_WAVES,
+        # a tunnel loss) are not needed.  Semantics are identical: the
+        # sub-batch reruns the same wave body against the same resident
+        # state, and queue-order fairness within a wave is preserved by
+        # the gather (top_k indices are ascending among equal activity).
+        tail_p = TAIL_P if ((f_cons or f_asg) and not use_pallas
+                            and P > TAIL_P) else 0
 
-            avail = alloc - used                              # [N,R]
-            if use_pallas:
-                # fused Pallas [P,N] pass straight to per-pod claims
-                claims, _best = pk.claims(pk_static, active, used, used_nz,
-                                          npods)
-                has = claims >= 0
-                return _resolve_and_commit(state, claims, has, [], [],
-                                           avail)
+        def mk_wave(podv, sel_maskv, static_maskv, static_scorev, noisev,
+                    pk_staticv):
+            Pv = podv["req"].shape[0]
+            req, req_nz = podv["req"], podv["req_nz"]
+            earlier = jnp.tril(jnp.ones((Pv, Pv), jnp.float32), k=-1)  # q<p
+            p_iota = jnp.arange(Pv)
+            pod, sel_mask, static_mask, static_score, noise = (
+                podv, sel_maskv, static_maskv, static_scorev, noisev)
+            P = Pv
 
-            # per-resource 2-D compares instead of one [P,N,R] broadcast
-            fit = (npods + 1.0 <= node["maxpods"])[None, :]
-            for r in range(caps.r):
-                fit &= req[:, None, r] <= avail[None, :, r]
-            mask = static_mask & fit
-            if f_ports:
-                mask &= (pod["ports"] @ ports.T) == 0         # [P,N]
+            def wave(state):
+                (used, used_nz, npods, ports, cd_sg, cd_asg,
+                 assigned, active, _progress, wcount) = state
 
-            if f_asg:
-                # existing anti-affinity groups block
-                adom = jnp.clip(dom_asg, 0)
-                acnt = jnp.take_along_axis(cd_asg, adom, axis=1)  # [ASG,N]
-                acnt = jnp.where(dom_asg >= 0, acnt, 0.0)
-                blocked = (pod["match_asg"] @ (acnt > 0).astype(jnp.float32)) > 0
-                mask &= ~blocked
+                avail = alloc - used                              # [N,R]
+                if use_pallas:
+                    # fused Pallas [P,N] pass straight to per-pod claims
+                    claims, _best = pk.claims(pk_staticv, active, used, used_nz,
+                                              npods)
+                    has = claims >= 0
+                    return _resolve_and_commit(state, claims, has, [], [],
+                                               avail)
 
-            least, balanced = _fit_scores_vec(req_nz, alloc, used_nz)
-            score = w["fit"] * least + w["balanced"] * balanced
-            score = score + w["taint"] * static_score
+                # per-resource 2-D compares instead of one [P,N,R] broadcast
+                fit = (npods + 1.0 <= node["maxpods"])[None, :]
+                for r in range(caps.r):
+                    fit &= req[:, None, r] <= avail[None, :, r]
+                mask = static_mask & fit
+                if f_ports:
+                    mask &= (pod["ports"] @ ports.T) == 0         # [P,N]
 
-            # constraints.  Domain counts are gathered ONCE per wave at
-            # the GROUP level ([SG,N] — 16 x n_loc elements), and each
-            # constraint slot row-selects by its sg index; the previous
-            # per-slot [P,N] element gather (take_along_axis with per-pod
-            # index planes) dominated wave time on TPU, where scattered
-            # gathers bypass the vector units (~375ms/wave at 1024x5632
-            # measured; row selects are plain copies).
-            if f_cons:
-                gath_sg_all = jnp.where(
-                    dom_sg >= 0,
-                    jnp.take_along_axis(cd_sg, jnp.clip(dom_sg, 0), axis=1),
-                    0.0)                                      # [SG,N]
-            boot_flags = []     # [P] per c: relies on bootstrap this wave
-            minmatches = []     # [P,1] per c: min domain count (spread)
-            for c in range(caps.c_cap if f_cons else 0):
-                kind = pod["c_kind"][:, c]                    # [P]
-                sg = jnp.clip(pod["c_sg"][:, c], 0)
-                dom_rows = dom_sg[sg]                         # [P,N] row sel
-                cnt_rows = cd_sg[sg]                          # [P,D] row sel
-                gathered = gath_sg_all[sg]                    # [P,N] row sel
-                has_dom = dom_rows >= 0
-                active_c = (kind != C_NONE)[:, None]
+                if f_asg:
+                    # existing anti-affinity groups block
+                    adom = jnp.clip(dom_asg, 0)
+                    acnt = jnp.take_along_axis(cd_asg, adom, axis=1)  # [ASG,N]
+                    acnt = jnp.where(dom_asg >= 0, acnt, 0.0)
+                    blocked = (pod["match_asg"] @ (acnt > 0).astype(jnp.float32)) > 0
+                    mask &= ~blocked
 
-                elig = sel_mask & has_dom
-                minmatch = comm.rowmin(gathered, elig, jnp.inf)
-                minmatch = jnp.where(jnp.isfinite(minmatch), minmatch, 0.0)
-                total = jnp.sum(cnt_rows, axis=-1, keepdims=True)  # cd replicated
+                least, balanced = _fit_scores_vec(req_nz, alloc, used_nz)
+                score = w["fit"] * least + w["balanced"] * balanced
+                score = score + w["taint"] * static_score
 
-                selfm = pod["c_selfmatch"][:, c:c + 1]
-                maxskew = pod["c_maxskew"][:, c:c + 1]
-                spread_ok = ((gathered + selfm - minmatch) <= maxskew) & has_dom
-                boot = (total[:, 0] == 0) & (selfm[:, 0] > 0)
-                aff_ok = ((gathered > 0) | boot[:, None]) & has_dom
-                anti_ok = jnp.where(has_dom, gathered == 0, True)
+                # constraints.  Domain counts are gathered ONCE per wave at
+                # the GROUP level ([SG,N] — 16 x n_loc elements), and each
+                # constraint slot row-selects by its sg index; the previous
+                # per-slot [P,N] element gather (take_along_axis with per-pod
+                # index planes) dominated wave time on TPU, where scattered
+                # gathers bypass the vector units (~375ms/wave at 1024x5632
+                # measured; row selects are plain copies).
+                if f_cons:
+                    gath_sg_all = jnp.where(
+                        dom_sg >= 0,
+                        jnp.take_along_axis(cd_sg, jnp.clip(dom_sg, 0), axis=1),
+                        0.0)                                      # [SG,N]
+                boot_flags = []     # [P] per c: relies on bootstrap this wave
+                minmatches = []     # [P,1] per c: min domain count (spread)
+                for c in range(caps.c_cap if f_cons else 0):
+                    kind = pod["c_kind"][:, c]                    # [P]
+                    sg = jnp.clip(pod["c_sg"][:, c], 0)
+                    dom_rows = dom_sg[sg]                         # [P,N] row sel
+                    cnt_rows = cd_sg[sg]                          # [P,D] row sel
+                    gathered = gath_sg_all[sg]                    # [P,N] row sel
+                    has_dom = dom_rows >= 0
+                    active_c = (kind != C_NONE)[:, None]
 
-                kindb = kind[:, None]
-                ok = jnp.where(kindb == C_SPREAD_HARD, spread_ok,
-                               jnp.where(kindb == C_AFFINITY, aff_ok,
-                                         jnp.where(kindb == C_ANTI_AFFINITY,
-                                                   anti_ok, True)))
-                mask &= ok | ~active_c
+                    elig = sel_mask & has_dom
+                    minmatch = comm.rowmin(gathered, elig, jnp.inf)
+                    minmatch = jnp.where(jnp.isfinite(minmatch), minmatch, 0.0)
+                    total = jnp.sum(cnt_rows, axis=-1, keepdims=True)  # cd replicated
 
-                smx = comm.rowmax(gathered, mask, 0.0)
-                smn = comm.rowmin(gathered, mask, jnp.inf)
-                smn = jnp.where(jnp.isfinite(smn), smn, 0.0)
-                rng = jnp.maximum(smx - smn, 1.0)
-                spread_score = (smx - gathered) * 100.0 / rng
-                score += jnp.where(kindb == C_SPREAD_SCORE,
-                                   w["spread"] * spread_score, 0.0)
-                score += jnp.where(kindb == C_PREF_AFFINITY,
-                                   w["affinity"] * pod["c_weight"][:, c:c + 1]
-                                   * gathered, 0.0)
-                boot_flags.append((kind == C_AFFINITY) & boot)
-                minmatches.append(minmatch)
+                    selfm = pod["c_selfmatch"][:, c:c + 1]
+                    maxskew = pod["c_maxskew"][:, c:c + 1]
+                    spread_ok = ((gathered + selfm - minmatch) <= maxskew) & has_dom
+                    boot = (total[:, 0] == 0) & (selfm[:, 0] > 0)
+                    aff_ok = ((gathered > 0) | boot[:, None]) & has_dom
+                    anti_ok = jnp.where(has_dom, gathered == 0, True)
 
-            feasible = mask & active[:, None]
-            has = comm.any_rows(feasible)                     # [P]
-            claims, _ = comm.row_argmax(
-                jnp.where(feasible, score + noise, NEG), n_loc)
-            claims = jnp.where(has, claims, -1)               # global idx
-            return _resolve_and_commit(state, claims, has, boot_flags,
-                                       minmatches, avail)
+                    kindb = kind[:, None]
+                    ok = jnp.where(kindb == C_SPREAD_HARD, spread_ok,
+                                   jnp.where(kindb == C_AFFINITY, aff_ok,
+                                             jnp.where(kindb == C_ANTI_AFFINITY,
+                                                       anti_ok, True)))
+                    mask &= ok | ~active_c
 
-        def _resolve_and_commit(state, claims, has, boot_flags, minmatches,
-                                avail):
-            """Wave tail shared by the Pallas and XLA paths: conflict
-            resolution in pod/queue order + aggregate commit."""
-            (used, used_nz, npods, ports, cd_sg, cd_asg,
-             assigned, active, _progress, wcount) = state
+                    smx = comm.rowmax(gathered, mask, 0.0)
+                    smn = comm.rowmin(gathered, mask, jnp.inf)
+                    smn = jnp.where(jnp.isfinite(smn), smn, 0.0)
+                    rng = jnp.maximum(smx - smn, 1.0)
+                    spread_score = (smx - gathered) * 100.0 / rng
+                    score += jnp.where(kindb == C_SPREAD_SCORE,
+                                       w["spread"] * spread_score, 0.0)
+                    score += jnp.where(kindb == C_PREF_AFFINITY,
+                                       w["affinity"] * pod["c_weight"][:, c:c + 1]
+                                       * gathered, 0.0)
+                    boot_flags.append((kind == C_AFFINITY) & boot)
+                    minmatches.append(minmatch)
 
-            # ---- conflict resolution (pod/queue order) ----
-            # claims are GLOBAL indices: same-node is a [P,P] outer equality,
-            # no N-sized contraction needed
-            loc_claims = claims - offset
-            in_shard = (loc_claims >= 0) & (loc_claims < n_loc) & has
-            onehot = ((loc_claims[:, None] == jnp.arange(n_loc)[None, :])
-                      & in_shard[:, None]).astype(jnp.float32)  # [P,N] local
-            SN = ((claims[:, None] == claims[None, :])
-                  & has[:, None] & has[None, :]).astype(jnp.float32)
-            E = SN * earlier                                  # earlier same-node
+                feasible = mask & active[:, None]
+                has = comm.any_rows(feasible)                     # [P]
+                claims, _ = comm.row_argmax(
+                    jnp.where(feasible, score + noise, NEG), n_loc)
+                claims = jnp.where(has, claims, -1)               # global idx
+                return _resolve_and_commit(state, claims, has, boot_flags,
+                                           minmatches, avail)
 
-            prefR = E @ req                                   # [P,R]
-            prefN = jnp.sum(E, axis=1)                        # [P]
-            avail_claim = comm.gather_cols(avail.T, claims, offset, n_loc)
-            avail_claim = jnp.moveaxis(avail_claim, -1, 0)    # [P,R]
-            npods_claim = comm.gather_cols(npods, claims, offset, n_loc)
-            maxp_claim = comm.gather_cols(node["maxpods"], claims, offset, n_loc)
-            res_ok = jnp.all(req + prefR <= avail_claim, axis=-1)
-            res_ok &= (npods_claim + prefN + 1.0 <= maxp_claim)
+            def _resolve_and_commit(state, claims, has, boot_flags, minmatches,
+                                    avail):
+                """Wave tail shared by the Pallas and XLA paths: conflict
+                resolution in pod/queue order + aggregate commit."""
+                (used, used_nz, npods, ports, cd_sg, cd_asg,
+                 assigned, active, _progress, wcount) = state
 
-            if f_ports:
-                overlap = (pod["ports"] @ pod["ports"].T) > 0  # [P,P]
-                port_conf = jnp.sum(E * overlap, axis=1) > 0
-            else:
-                port_conf = jnp.zeros(P, bool)
+                # ---- conflict resolution (pod/queue order) ----
+                # claims are GLOBAL indices: same-node is a [P,P] outer equality,
+                # no N-sized contraction needed
+                loc_claims = claims - offset
+                in_shard = (loc_claims >= 0) & (loc_claims < n_loc) & has
+                onehot = ((loc_claims[:, None] == jnp.arange(n_loc)[None, :])
+                          & in_shard[:, None]).astype(jnp.float32)  # [P,N] local
+                SN = ((claims[:, None] == claims[None, :])
+                      & has[:, None] & has[None, :]).astype(jnp.float32)
+                E = SN * earlier                                  # earlier same-node
 
-            conf = jnp.zeros(P, bool)
-            spread_over_any = jnp.zeros(P, bool)   # failed the static quota
-            spread_static_ok = jnp.ones(P, bool)   # count+self-min <= skew
-            spread_over_slots = []                 # [P] per slot
-            both = (has[:, None] & has[None, :]).astype(jnp.float32) * earlier
-            for c in range(caps.c_cap if f_cons else 0):
-                kind = pod["c_kind"][:, c]
-                sg = jnp.clip(pod["c_sg"][:, c], 0)
-                dom_rows = dom_sg[sg]                         # [P,N] local
-                Dpq = comm.gather_cols(dom_rows, claims, offset, n_loc,
-                                       fill=-1.0)             # [P,P]: dom of q's claim under p's sg
-                own = Dpq[p_iota, p_iota][:, None]            # [P,1] p's own domain
-                same_dom = (Dpq == own) & (own >= 0)
-                q_incs = pod["inc_sg"].T[sg]                  # [P,P]: inc of q for p's sg
-                k_same = jnp.sum(both * same_dom * q_incs, axis=1)  # [P]
-                # required anti-affinity: both entrants see gathered==0, so
-                # any earlier same-domain incrementer must serialize
-                conf |= (kind == C_ANTI_AFFINITY) & (k_same > 0)
-                # HARD spread static quota: count + self + k_earlier - min
-                # <= maxSkew is valid at ANY interleaving (the min can only
-                # rise as other claims commit).  Pods over the static quota
-                # are NOT immediately conflicted — the cohort pass below
-                # re-admits ranks that a round-robin interleaving covers.
-                own = Dpq[p_iota, p_iota]                     # [P] own domain
-                cnt_own = cd_sg[jnp.clip(sg, 0), jnp.clip(own, 0)
-                                .astype(jnp.int32)]           # [P]
-                minm = minmatches[c][:, 0]
-                selfm_c = pod["c_selfmatch"][:, c]
-                skew_c = pod["c_maxskew"][:, c]
-                over = (cnt_own + selfm_c + k_same - minm) > skew_c
-                is_spread = (kind == C_SPREAD_HARD) & (own >= 0)
-                spread_over_slots.append(is_spread & over)
-                spread_over_any |= is_spread & over
-                spread_static_ok &= jnp.where(
-                    is_spread, (cnt_own + selfm_c - minm) <= skew_c, True)
-                # affinity bootstrap: serialize against any incrementing q
-                conf |= boot_flags[c] & (jnp.sum(both * q_incs, axis=1) > 0)
-            for a in range(caps.asg_cap if f_asg else 0):
-                dom_a = comm.gather_cols(dom_asg[a], claims, offset, n_loc,
-                                         fill=-1.0)           # [P]
-                same_a = (dom_a[:, None] == dom_a[None, :]) & (dom_a[:, None] >= 0)
-                conf |= (pod["match_asg"][:, a] > 0) & (
-                    jnp.sum(both * same_a * pod["inc_asg"][None, :, a], axis=1) > 0)
+                prefR = E @ req                                   # [P,R]
+                prefN = jnp.sum(E, axis=1)                        # [P]
+                avail_claim = comm.gather_cols(avail.T, claims, offset, n_loc)
+                avail_claim = jnp.moveaxis(avail_claim, -1, 0)    # [P,R]
+                npods_claim = comm.gather_cols(npods, claims, offset, n_loc)
+                maxp_claim = comm.gather_cols(node["maxpods"], claims, offset, n_loc)
+                res_ok = jnp.all(req + prefR <= avail_claim, axis=-1)
+                res_ok &= (npods_claim + prefN + 1.0 <= maxp_claim)
 
-            accept = has & active & res_ok & ~port_conf & ~conf \
-                & ~spread_over_any
-            if f_cons:
-                # ---- spread cohort (water-filling) admission ----
-                # The static quota admits ~maxSkew pods per domain per
-                # wave -> O(batch/(domains*skew)) waves (measured 1377
-                # for 4096 pods / 3 zones / skew 1).  Water-filling: a
-                # pour that lands on a current-minimum domain is ALWAYS
-                # sequentially valid (count+1-min = 1 <= maxSkew), so any
-                # end state reachable by filling lowest-domains-first is
-                # valid.  Pours can raise every domain to
-                #   L = min over eligible domains of
-                #         (count + committed + pool) + maxSkew
-                # (the stuck minimum after every pool drains is >= the
-                # min term, and levels above it stay within the skew).
-                # A candidate at new-rank r' in domain d therefore admits
-                # when count_d + committed_d + r' + self <= L.  Pods with
-                # more than one hard-spread slot are excluded from pools
-                # and cohort (their commit depends on the OTHER slot, so
-                # counting them could overstate a pool); they fall back
-                # to the static quota.  Two fixpoint rounds let the first
-                # round's commits raise the second round's levels.
-                other_ok = has & active & res_ok & ~port_conf & ~conf
-                n_hard = jnp.zeros(P, jnp.int32)
-                for c in range(caps.c_cap):
-                    n_hard = n_hard + (
-                        pod["c_kind"][:, c] == C_SPREAD_HARD).astype(
-                        jnp.int32)
-                cand = other_ok & spread_over_any & (n_hard <= 1)
-                dom_acc0 = comm.gather_cols(dom_sg, claims, offset, n_loc,
-                                            fill=-1.0)        # [SG,P]
-                sg_iota2 = jnp.arange(caps.sg_cap)[:, None]
-                dom_acc0_ix = jnp.clip(dom_acc0, 0).astype(jnp.int32)
-                committed = accept
-                for _it in range(COHORT_ITERS):
-                    new_ok = cand & ~committed
-                    comm_f = committed.astype(jnp.float32)
-                    new_f = new_ok.astype(jnp.float32)
-                    ok_all = new_ok
+                if f_ports:
+                    overlap = (pod["ports"] @ pod["ports"].T) > 0  # [P,P]
+                    port_conf = jnp.sum(E * overlap, axis=1) > 0
+                else:
+                    port_conf = jnp.zeros(P, bool)
+
+                conf = jnp.zeros(P, bool)
+                spread_over_any = jnp.zeros(P, bool)   # failed the static quota
+                both = (has[:, None] & has[None, :]).astype(jnp.float32) * earlier
+                for c in range(caps.c_cap if f_cons else 0):
+                    kind = pod["c_kind"][:, c]
+                    sg = jnp.clip(pod["c_sg"][:, c], 0)
+                    dom_rows = dom_sg[sg]                         # [P,N] local
+                    Dpq = comm.gather_cols(dom_rows, claims, offset, n_loc,
+                                           fill=-1.0)             # [P,P]: dom of q's claim under p's sg
+                    own = Dpq[p_iota, p_iota][:, None]            # [P,1] p's own domain
+                    same_dom = (Dpq == own) & (own >= 0)
+                    q_incs = pod["inc_sg"].T[sg]                  # [P,P]: inc of q for p's sg
+                    k_same = jnp.sum(both * same_dom * q_incs, axis=1)  # [P]
+                    # required anti-affinity: both entrants see gathered==0, so
+                    # any earlier same-domain incrementer must serialize
+                    conf |= (kind == C_ANTI_AFFINITY) & (k_same > 0)
+                    # HARD spread static quota: count + self + k_earlier - min
+                    # <= maxSkew is valid at ANY interleaving (the min can only
+                    # rise as other claims commit).  Pods over the static quota
+                    # are NOT immediately conflicted — the cohort pass below
+                    # re-admits ranks that a round-robin interleaving covers.
+                    own = Dpq[p_iota, p_iota]                     # [P] own domain
+                    cnt_own = cd_sg[jnp.clip(sg, 0), jnp.clip(own, 0)
+                                    .astype(jnp.int32)]           # [P]
+                    minm = minmatches[c][:, 0]
+                    selfm_c = pod["c_selfmatch"][:, c]
+                    skew_c = pod["c_maxskew"][:, c]
+                    over = (cnt_own + selfm_c + k_same - minm) > skew_c
+                    is_spread = (kind == C_SPREAD_HARD) & (own >= 0)
+                    spread_over_any |= is_spread & over
+                    # affinity bootstrap: serialize against any incrementing q
+                    conf |= boot_flags[c] & (jnp.sum(both * q_incs, axis=1) > 0)
+                for a in range(caps.asg_cap if f_asg else 0):
+                    dom_a = comm.gather_cols(dom_asg[a], claims, offset, n_loc,
+                                             fill=-1.0)           # [P]
+                    same_a = (dom_a[:, None] == dom_a[None, :]) & (dom_a[:, None] >= 0)
+                    conf |= (pod["match_asg"][:, a] > 0) & (
+                        jnp.sum(both * same_a * pod["inc_asg"][None, :, a], axis=1) > 0)
+
+                accept = has & active & res_ok & ~port_conf & ~conf \
+                    & ~spread_over_any
+                if f_cons:
+                    # ---- spread cohort (water-filling) admission ----
+                    # The static quota admits ~maxSkew pods per domain per
+                    # wave -> O(batch/(domains*skew)) waves (measured 1377
+                    # for 4096 pods / 3 zones / skew 1).  Water-filling: a
+                    # pour that lands on a current-minimum domain is ALWAYS
+                    # sequentially valid (count+1-min = 1 <= maxSkew), so any
+                    # end state reachable by filling lowest-domains-first is
+                    # valid.  Pours can raise every domain to
+                    #   L = min over eligible domains of
+                    #         (count + committed + pool) + maxSkew
+                    # (the stuck minimum after every pool drains is >= the
+                    # min term, and levels above it stay within the skew).
+                    # A candidate at new-rank r' in domain d therefore admits
+                    # when count_d + committed_d + r' + self <= L.  Pods with
+                    # more than one hard-spread slot are excluded from pools
+                    # and cohort (their commit depends on the OTHER slot, so
+                    # counting them could overstate a pool); they fall back
+                    # to the static quota.  Two fixpoint rounds let the first
+                    # round's commits raise the second round's levels.
+                    other_ok = has & active & res_ok & ~port_conf & ~conf
+                    n_hard = jnp.zeros(P, jnp.int32)
                     for c in range(caps.c_cap):
-                        kind = pod["c_kind"][:, c]
-                        sg = jnp.clip(pod["c_sg"][:, c], 0)
-                        dom_rows = dom_sg[sg]
-                        w = pod["inc_sg"].T * comm_f[None, :] * (
-                            dom_acc0 >= 0)
-                        m_sg = jnp.zeros_like(cd_sg).at[
-                            sg_iota2, dom_acc0_ix].add(w)     # [SG,N-dom]
-                        wp = pod["inc_sg"].T * new_f[None, :] * (
-                            dom_acc0 >= 0)
-                        pool_sg = jnp.zeros_like(cd_sg).at[
-                            sg_iota2, dom_acc0_ix].add(wp)
-                        fill = cd_sg + m_sg + pool_sg
-                        gath = jnp.where(
-                            dom_sg >= 0,
-                            jnp.take_along_axis(fill, jnp.clip(dom_sg, 0),
-                                                axis=1),
-                            jnp.inf)                          # [SG,N]
-                        Dpq = comm.gather_cols(dom_rows, claims, offset,
-                                               n_loc, fill=-1.0)
-                        own = Dpq[p_iota, p_iota]
-                        same_dom = (Dpq == own[:, None]) & (own[:, None] >= 0)
-                        q_incs = pod["inc_sg"].T[sg]
-                        rprime = jnp.sum(both * same_dom * q_incs
-                                         * new_f[None, :], axis=1)
-                        own_ix = jnp.clip(own, 0).astype(jnp.int32)
-                        m_own = m_sg[sg, own_ix]
-                        elig_c = sel_mask & (dom_rows >= 0)
-                        floor = comm.rowmin(gath[sg], elig_c, jnp.inf)[:, 0]
-                        floor = jnp.where(jnp.isfinite(floor), floor, 0.0)
-                        level = floor + pod["c_maxskew"][:, c]
-                        cnt_own = cd_sg[sg, own_ix]
-                        cond = (cnt_own + m_own + rprime
-                                + pod["c_selfmatch"][:, c]) <= level
-                        is_spread = (kind == C_SPREAD_HARD) & (own >= 0)
-                        ok_all &= (~is_spread) | cond
-                    committed = committed | (new_ok & ok_all)
-                accept = committed
+                        n_hard = n_hard + (
+                            pod["c_kind"][:, c] == C_SPREAD_HARD).astype(
+                            jnp.int32)
+                    cand = other_ok & spread_over_any & (n_hard <= 1)
+                    dom_acc0 = comm.gather_cols(dom_sg, claims, offset, n_loc,
+                                                fill=-1.0)        # [SG,P]
+                    sg_iota2 = jnp.arange(caps.sg_cap)[:, None]
+                    dom_acc0_ix = jnp.clip(dom_acc0, 0).astype(jnp.int32)
+                    committed = accept
+                    for _it in range(COHORT_ITERS):
+                        new_ok = cand & ~committed
+                        comm_f = committed.astype(jnp.float32)
+                        new_f = new_ok.astype(jnp.float32)
+                        ok_all = new_ok
+                        for c in range(caps.c_cap):
+                            kind = pod["c_kind"][:, c]
+                            sg = jnp.clip(pod["c_sg"][:, c], 0)
+                            dom_rows = dom_sg[sg]
+                            w = pod["inc_sg"].T * comm_f[None, :] * (
+                                dom_acc0 >= 0)
+                            m_sg = jnp.zeros_like(cd_sg).at[
+                                sg_iota2, dom_acc0_ix].add(w)     # [SG,N-dom]
+                            wp = pod["inc_sg"].T * new_f[None, :] * (
+                                dom_acc0 >= 0)
+                            pool_sg = jnp.zeros_like(cd_sg).at[
+                                sg_iota2, dom_acc0_ix].add(wp)
+                            fill = cd_sg + m_sg + pool_sg
+                            gath = jnp.where(
+                                dom_sg >= 0,
+                                jnp.take_along_axis(fill, jnp.clip(dom_sg, 0),
+                                                    axis=1),
+                                jnp.inf)                          # [SG,N]
+                            Dpq = comm.gather_cols(dom_rows, claims, offset,
+                                                   n_loc, fill=-1.0)
+                            own = Dpq[p_iota, p_iota]
+                            same_dom = (Dpq == own[:, None]) & (own[:, None] >= 0)
+                            q_incs = pod["inc_sg"].T[sg]
+                            rprime = jnp.sum(both * same_dom * q_incs
+                                             * new_f[None, :], axis=1)
+                            own_ix = jnp.clip(own, 0).astype(jnp.int32)
+                            m_own = m_sg[sg, own_ix]
+                            elig_c = sel_mask & (dom_rows >= 0)
+                            floor = comm.rowmin(gath[sg], elig_c, jnp.inf)[:, 0]
+                            floor = jnp.where(jnp.isfinite(floor), floor, 0.0)
+                            level = floor + pod["c_maxskew"][:, c]
+                            cnt_own = cd_sg[sg, own_ix]
+                            cond = (cnt_own + m_own + rprime
+                                    + pod["c_selfmatch"][:, c]) <= level
+                            is_spread = (kind == C_SPREAD_HARD) & (own >= 0)
+                            ok_all &= (~is_spread) | cond
+                        committed = committed | (new_ok & ok_all)
+                    accept = committed
 
-            # ---- commit ----
-            acc_oh = onehot * accept[:, None]                 # [P,N] local rows
-            used = used + acc_oh.T @ req
-            used_nz = used_nz + acc_oh.T @ req_nz
-            npods = npods + jnp.sum(acc_oh, axis=0)
-            if f_ports:
-                ports = jnp.minimum(ports + acc_oh.T @ pod["ports"], 1.0)
+                # ---- commit ----
+                acc_oh = onehot * accept[:, None]                 # [P,N] local rows
+                used = used + acc_oh.T @ req
+                used_nz = used_nz + acc_oh.T @ req_nz
+                npods = npods + jnp.sum(acc_oh, axis=0)
+                if f_ports:
+                    ports = jnp.minimum(ports + acc_oh.T @ pod["ports"], 1.0)
 
-            if f_cons:
-                dom_acc = comm.gather_cols(dom_sg, claims, offset, n_loc,
-                                           fill=-1.0)         # [SG,P]
-                w_sg = (pod["inc_sg"].T * accept[None, :] * (dom_acc >= 0))
-                cd_sg = cd_sg.at[jnp.arange(caps.sg_cap)[:, None],
-                                 jnp.clip(dom_acc, 0).astype(jnp.int32)].add(w_sg)
-            if f_asg:
-                dom_acc_a = comm.gather_cols(dom_asg, claims, offset, n_loc,
-                                             fill=-1.0)       # [ASG,P]
-                w_asg = (pod["inc_asg"].T * accept[None, :] * (dom_acc_a >= 0))
-                cd_asg = cd_asg.at[jnp.arange(caps.asg_cap)[:, None],
-                                   jnp.clip(dom_acc_a, 0).astype(jnp.int32)].add(w_asg)
+                if f_cons:
+                    dom_acc = comm.gather_cols(dom_sg, claims, offset, n_loc,
+                                               fill=-1.0)         # [SG,P]
+                    w_sg = (pod["inc_sg"].T * accept[None, :] * (dom_acc >= 0))
+                    cd_sg = cd_sg.at[jnp.arange(caps.sg_cap)[:, None],
+                                     jnp.clip(dom_acc, 0).astype(jnp.int32)].add(w_sg)
+                if f_asg:
+                    dom_acc_a = comm.gather_cols(dom_asg, claims, offset, n_loc,
+                                                 fill=-1.0)       # [ASG,P]
+                    w_asg = (pod["inc_asg"].T * accept[None, :] * (dom_acc_a >= 0))
+                    cd_asg = cd_asg.at[jnp.arange(caps.asg_cap)[:, None],
+                                       jnp.clip(dom_acc_a, 0).astype(jnp.int32)].add(w_asg)
 
-            if os.environ.get("KTPU_WAVE_DEBUG") and not isinstance(
-                    claims, jax.core.Tracer):  # pragma: no cover - debug
-                _WAVE_DEBUG.append({
-                    "claims": np.asarray(claims), "has": np.asarray(has),
-                    "res_ok": np.asarray(res_ok),
-                    "conf": np.asarray(conf),
-                    "over": np.asarray(spread_over_any),
-                    "accept": np.asarray(accept),
-                    "active": np.asarray(active)})
-            assigned = jnp.where(accept, claims, assigned)
-            progress = jnp.any(accept)
-            active = active & ~accept & progress  # no progress -> give up
-            return (used, used_nz, npods, ports, cd_sg, cd_asg,
-                    assigned, active, progress, wcount + 1)
+                if os.environ.get("KTPU_WAVE_DEBUG") and not isinstance(
+                        claims, jax.core.Tracer):  # pragma: no cover - debug
+                    _WAVE_DEBUG.append({
+                        "claims": np.asarray(claims), "has": np.asarray(has),
+                        "res_ok": np.asarray(res_ok),
+                        "conf": np.asarray(conf),
+                        "over": np.asarray(spread_over_any),
+                        "accept": np.asarray(accept),
+                        "active": np.asarray(active)})
+                assigned = jnp.where(accept, claims, assigned)
+                progress = jnp.any(accept)
+                active = active & ~accept & progress  # no progress -> give up
+                return (used, used_nz, npods, ports, cd_sg, cd_asg,
+                        assigned, active, progress, wcount + 1)
+
+            return wave
+
+        wave = mk_wave(pod, sel_mask, static_mask, static_score, noise,
+                       pk_static)
 
         def cond(state):
             active = state[7]
             wcount = state[9]
-            return jnp.any(active) & (wcount < max_waves)
+            go = jnp.any(active) & (wcount < max_waves)
+            if tail_p:
+                # hand the stragglers to the compacted tail loop the
+                # moment they fit its sub-batch
+                go &= jnp.sum(active.astype(jnp.int32)) > tail_p
+            return go
 
         P_assigned = jnp.full((P,), -1, jnp.int32)
         state0 = (node["used"], node["used_nz"], node["npods"],
                   node["port_mask"], node["cd_sg"], node["cd_asg"],
                   P_assigned, pod["p_valid"], jnp.array(True), jnp.array(0))
         state = lax.while_loop(cond, wave, state0)
+        if tail_p:
+            (used, used_nz, npods, ports, cd_sg, cd_asg,
+             assigned, active, _progress, wcount) = state
+            # gather the (at most tail_p) still-active pods to the front;
+            # padding rows gather INACTIVE pods, whose active[idx] is
+            # False, so they commit nothing in the sub-loop
+            _vals, idx = lax.top_k(active.astype(jnp.float32), tail_p)
+            sub_pod = {k: v[idx] for k, v in pod.items()}
+            sub_wave = mk_wave(sub_pod, sel_mask[idx], static_mask[idx],
+                               static_score[idx], noise[idx], None)
+            sub0 = (used, used_nz, npods, ports, cd_sg, cd_asg,
+                    assigned[idx], active[idx], jnp.array(True), wcount)
+
+            def cond_tail(st):
+                return jnp.any(st[7]) & (st[9] < max_waves)
+
+            sub = lax.while_loop(cond_tail, sub_wave, sub0)
+            assigned = assigned.at[idx].set(sub[6])
+            active = active.at[idx].set(sub[7])
+            state = (sub[0], sub[1], sub[2], sub[3], sub[4], sub[5],
+                     assigned, active, sub[8], sub[9])
         return {"assignments": state[6], "waves": state[9],
                 "used": state[0], "used_nz": state[1], "npods": state[2],
                 "port_mask": state[3], "cd_sg": state[4], "cd_asg": state[5]}
+
 
     return assign
 

@@ -83,12 +83,20 @@ class TooOldError(StoreError):
 
 
 class WatchEvent:
-    __slots__ = ("type", "object", "revision")
+    # _wire: lazily-cached serialized form ({"type","object"} JSON line).
+    # One WatchEvent instance fans out to EVERY watcher of a resource
+    # (plus the history ring), so the apiserver's watch streams used to
+    # re-encode the same ~1KB object once per watcher per event; the
+    # cache makes it once per event (server.py _serve_watch fills it for
+    # the plain-identity case only — field-selected or version-converted
+    # streams bypass it).
+    __slots__ = ("type", "object", "revision", "_wire")
 
     def __init__(self, type_: str, obj: Obj, revision: int):
         self.type = type_
         self.object = obj
         self.revision = revision
+        self._wire = None
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"WatchEvent({self.type}, rv={self.revision}, {meta.namespaced_name(self.object)})"

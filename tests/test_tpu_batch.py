@@ -368,3 +368,135 @@ class TestStragglerRetryKernel:
         for _pi, nm in placed:
             counts[zone_of[nm]] += 1
         assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+
+class TestTailCompaction:
+    """The compacted straggler sub-batch (assign.py tail_p): with TAIL_P
+    monkeypatched tiny, a constraint batch larger than it must route its
+    stragglers through the compacted loop and still place everything the
+    exhaustive kernel would."""
+
+    def test_spread_batch_places_fully_through_tail(self, monkeypatch):
+        import numpy as np
+        from kubernetes_tpu.models import assign as assign_mod
+        from kubernetes_tpu.models.assign import (
+            build_packed_assign_fn, pack_pod_batch,
+        )
+        from kubernetes_tpu.ops.flatten import BatchEncoder, Caps, ClusterTensors
+        from kubernetes_tpu.scheduler.cache import Cache
+        from kubernetes_tpu.scheduler.types import PodInfo
+        from kubernetes_tpu.testing import make_node, make_pod
+        import jax.numpy as jnp
+
+        monkeypatch.setattr(assign_mod, "TAIL_P", 2)
+        caps = Caps(n_cap=16, l_cap=32, kl_cap=16, t_cap=4, pt_cap=4,
+                    s_cap=2, sg_cap=4, asg_cap=4, c_cap=2)
+        cache = Cache()
+        for i in range(9):
+            n = make_node(f"n{i}").capacity(cpu="8", mem="32Gi",
+                                            pods=100).build()
+            n["metadata"].setdefault("labels", {})[
+                "topology.kubernetes.io/zone"] = f"z{i % 3}"
+            cache.add_node(n)
+        t = ClusterTensors(caps)
+        t.update_from_snapshot_tracked(cache.flatten_view())
+        P = 12
+        enc = BatchEncoder(t, P)
+        tsc = [{"maxSkew": 1,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": "s"}}}]
+        pods = []
+        for i in range(P):
+            p = make_pod(f"p{i}").req(cpu="100m", mem="64Mi").build()
+            p["metadata"].setdefault("labels", {})["app"] = "s"
+            p["spec"]["topologySpreadConstraints"] = tsc
+            pods.append(PodInfo(p))
+        batch = enc.encode(pods)
+        assert not batch.escape
+        fn, spec = build_packed_assign_fn(caps, P, 8, None)
+        cd_sg, cd_asg = t.domain_base_counts()
+        state = {"used": jnp.asarray(t.used),
+                 "used_nz": jnp.asarray(t.used_nz),
+                 "npods": jnp.asarray(t.npods),
+                 "port_mask": jnp.asarray(t.port_mask),
+                 "cd_sg": jnp.asarray(cd_sg),
+                 "cd_asg": jnp.asarray(cd_asg)}
+        static = {k: jnp.asarray(getattr(t, k))
+                  for k in ("alloc", "maxpods", "valid", "taint_mask",
+                            "label_mask", "key_mask", "dom_sg", "dom_asg")}
+        empty = (np.empty(0, np.int32),
+                 np.empty((0, spec.f_patch), np.float32))
+        buf = pack_pod_batch(batch, spec, *empty)
+        _state, rd = fn(state, static, jnp.asarray(buf))
+        r = np.asarray(rd)
+        assignments = r[:-1]
+        assert (assignments >= 0).all(), assignments
+        # maxSkew=1 over 3 zones with 12 pods: 4 per zone exactly
+        zones = [int(t.dom_sg[0, row]) for row in assignments]
+        import collections
+        counts = collections.Counter(zones)
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    def test_anti_affinity_through_tail(self, monkeypatch):
+        """hostname anti-affinity (1 pod/node) serializes hard — with a
+        tiny TAIL_P the compacted loop must still place one per node."""
+        import numpy as np
+        from kubernetes_tpu.models import assign as assign_mod
+        from kubernetes_tpu.models.assign import (
+            build_packed_assign_fn, pack_pod_batch,
+        )
+        from kubernetes_tpu.ops.flatten import BatchEncoder, Caps, ClusterTensors
+        from kubernetes_tpu.scheduler.cache import Cache
+        from kubernetes_tpu.scheduler.types import PodInfo
+        from kubernetes_tpu.testing import make_node, make_pod
+        import jax.numpy as jnp
+
+        monkeypatch.setattr(assign_mod, "TAIL_P", 2)
+        caps = Caps(n_cap=16, l_cap=32, kl_cap=16, t_cap=4, pt_cap=4,
+                    s_cap=2, sg_cap=4, asg_cap=4, c_cap=2)
+        cache = Cache()
+        for i in range(8):
+            n = make_node(f"n{i}").capacity(cpu="8", mem="32Gi",
+                                            pods=100).build()
+            # hostname label = the anti-affinity topology domain; a node
+            # WITHOUT the key has no domain and anti-affinity cannot be
+            # violated there (reference filtering.go semantics)
+            n["metadata"].setdefault("labels", {})[
+                "kubernetes.io/hostname"] = f"n{i}"
+            cache.add_node(n)
+        t = ClusterTensors(caps)
+        t.update_from_snapshot_tracked(cache.flatten_view())
+        P = 8
+        enc = BatchEncoder(t, P)
+        anti = {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "kubernetes.io/hostname",
+                 "labelSelector": {"matchLabels": {"app": "a"}}}]}}
+        pods = []
+        for i in range(P):
+            p = make_pod(f"q{i}").req(cpu="100m", mem="64Mi").build()
+            p["metadata"].setdefault("labels", {})["app"] = "a"
+            p["spec"]["affinity"] = anti
+            pods.append(PodInfo(p))
+        batch = enc.encode(pods)
+        assert not batch.escape
+        fn, spec = build_packed_assign_fn(caps, P, 8, None)
+        cd_sg, cd_asg = t.domain_base_counts()
+        state = {"used": jnp.asarray(t.used),
+                 "used_nz": jnp.asarray(t.used_nz),
+                 "npods": jnp.asarray(t.npods),
+                 "port_mask": jnp.asarray(t.port_mask),
+                 "cd_sg": jnp.asarray(cd_sg),
+                 "cd_asg": jnp.asarray(cd_asg)}
+        static = {k: jnp.asarray(getattr(t, k))
+                  for k in ("alloc", "maxpods", "valid", "taint_mask",
+                            "label_mask", "key_mask", "dom_sg", "dom_asg")}
+        empty = (np.empty(0, np.int32),
+                 np.empty((0, spec.f_patch), np.float32))
+        buf = pack_pod_batch(batch, spec, *empty)
+        _state, rd = fn(state, static, jnp.asarray(buf))
+        r = np.asarray(rd)
+        assignments = r[:-1]
+        assert (assignments >= 0).all(), assignments
+        assert len(set(assignments.tolist())) == P  # one per node
