@@ -287,8 +287,13 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
         # sub-batch reruns the same wave body against the same resident
         # state, and queue-order fairness within a wave is preserved by
         # the gather (top_k indices are ascending among equal activity).
-        tail_p = TAIL_P if ((f_cons or f_asg) and not use_pallas
-                            and P > TAIL_P) else 0
+        # Applies to the PLAIN path too (round-5 measurement: a 16k-pod
+        # plain batch at 100k nodes averages ~1.6 waves, and wave 2 re-
+        # ran the whole [P,N] tile for a handful of stragglers at
+        # ~300-500ms); the compacted tail always runs the XLA wave body
+        # (pk_staticv=None below), so a Pallas main phase hands its
+        # stragglers to a cheap [TAIL_P,N] XLA loop.
+        tail_p = TAIL_P if P > TAIL_P else 0
 
         def mk_wave(podv, sel_maskv, static_maskv, static_scorev, noisev,
                     pk_staticv):
@@ -305,7 +310,8 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                  assigned, active, _progress, wcount) = state
 
                 avail = alloc - used                              # [N,R]
-                if use_pallas:
+                if pk_staticv is not None:  # Pallas main phase only; the
+                    # compacted tail runs the XLA body below
                     # fused Pallas [P,N] pass straight to per-pod claims
                     claims, _best = pk.claims(pk_staticv, active, used, used_nz,
                                               npods)

@@ -39,6 +39,9 @@ TIE_NOISE = 1e-3
 
 TP = 128   # pod-tile size
 TN = 512   # node-tile size (lane-dim multiple of 128)
+# Tile sizes were A/B'd at 256x1024 in round 5 (4x fewer grid steps);
+# same-window e2e at the 100k tier was NOT better on the tunneled chip,
+# so the original tiling stands.
 
 
 def _use_interpret() -> bool:
