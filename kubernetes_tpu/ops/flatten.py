@@ -230,6 +230,16 @@ class ClusterTensors:
         self._asg_kv_index: dict = {}
         self._asg_complex: list = []
 
+        # ns-anti guard (namespaceSelector anti-affinity): label pairs
+        # whose pods must ESCAPE to the oracle because a pod carrying a
+        # namespaceSelector anti term against them went through the
+        # escape hatch earlier in this process (the device can't check
+        # cross-namespace terms).  Conservative: armed at escape time,
+        # never disarmed.  Known residual: a restarted scheduler only
+        # re-arms when such a pod next passes through an encode.
+        self.ns_anti_kv: set[tuple[str, str]] = set()
+        self.ns_anti_complex = False
+
         self.row_of: dict[str, int] = {}
         self.node_infos: list[NodeInfo | None] = [None] * c.n_cap
         self.gen = np.zeros(c.n_cap, np.int64)
@@ -980,7 +990,21 @@ class BatchEncoder:
                     base_prefer[tid] = 1.0
             any_prefer = bool(base_prefer.any())
         is_plain = self._is_plain
+        # ns-anti guard: once armed (a namespaceSelector anti-affinity
+        # pod escaped), any pod whose labels could match one of those
+        # selectors must take the oracle too — zero cost while unarmed.
+        # Arming can happen MID-batch (the arming pod's _encode_pod runs
+        # inside this loop): the post-loop re-scan below retroactively
+        # escapes earlier same-batch pods the live guard missed.
+        guard_n0 = len(t.ns_anti_kv) + int(t.ns_anti_complex)
+        guard_kv = t.ns_anti_kv if guard_n0 else None
+        guard_all = t.ns_anti_complex
         for i, pi in enumerate(pods):
+            if guard_kv is not None and (
+                    guard_all
+                    or any(kv in guard_kv for kv in pi.labels.items())):
+                b.escape.append(i)
+                continue
             if is_plain(pi):
                 b.p_valid[i] = True
                 if taint_items and not pi.tolerations:
@@ -1002,6 +1026,17 @@ class BatchEncoder:
                 b.p_valid[i] = True
             else:
                 b.escape.append(i)
+        if len(t.ns_anti_kv) + int(t.ns_anti_complex) != guard_n0:
+            # the guard armed during THIS encode: retroactively escape
+            # earlier pods in the batch that the live check missed
+            esc = set(b.escape)
+            for i, pi in enumerate(pods):
+                if i in esc or not b.p_valid[i]:
+                    continue
+                if t.ns_anti_complex or any(
+                        kv in t.ns_anti_kv for kv in pi.labels.items()):
+                    b.p_valid[i] = False
+                    b.escape.append(i)
         # cross-pod: inc/match rows vs the registered groups — via the
         # exact-kv index (O(pod labels)) + the short complex-selector
         # scan, so 2000 per-service groups don't cost 2000 matches/pod
@@ -1092,9 +1127,29 @@ class BatchEncoder:
                 return True
         return False
 
+    def _arm_ns_anti_guard(self, pi: PodInfo) -> None:
+        """Record a pod's namespaceSelector ANTI terms in the guard —
+        called for EVERY non-plain pod before any escape path, so no
+        escape route (nominated, volumes, preferred terms, overflow)
+        can leave a later device placement unchecked against them."""
+        t = self.t
+        for term in pi.required_anti_affinity_terms:
+            if term.ns_selector is not None:
+                kv = _exact_kv(SelectorGroup("", term.selector,
+                                             frozenset()))
+                if kv is not None:
+                    t.ns_anti_kv.add(kv)
+                else:
+                    t.ns_anti_complex = True
+
     # returns False -> escape to oracle path
     def _encode_pod(self, b: PodBatch, i: int, pi: PodInfo) -> bool:
         t, c = self.t, self.t.caps
+        if pi.has_ns_selector_terms:
+            self._arm_ns_anti_guard(pi)
+            # namespaceSelector terms need per-cycle namespace-label
+            # resolution (a lister) the tensor encoding does not carry
+            return False
         if pi.nominated_node_name:
             return False  # preemption nominations go through the per-pod path
         for v in (pi.pod.get("spec") or {}).get("volumes") or ():

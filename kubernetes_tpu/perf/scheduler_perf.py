@@ -372,6 +372,12 @@ def _default_pod(i: int, params: dict) -> dict:
         tmpl = params["_pod_tmpl_cache"] = pod
     pod = meta.deep_copy(tmpl)
     pod["metadata"]["name"] = params.get("podNamePrefix", "pod-") + str(i)
+    nrr = params.get("namespaceRoundRobin")
+    if nrr:
+        # pod #i lands in {prefix}{i % count} — the NSSelector
+        # workloads' createPodSets analog (N pods per init namespace)
+        pod["metadata"]["namespace"] = (
+            f"{nrr.get('prefix', 'init-ns-')}{i % int(nrr['count'])}")
     ds = params.get("distinctServices")
     if ds:
         # high-label-cardinality shape: pod #i belongs to service

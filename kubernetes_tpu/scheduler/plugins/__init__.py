@@ -52,7 +52,7 @@ def in_tree_registry() -> Registry:
         "NodeResourcesBalancedAllocation":
             lambda args, h: NodeResourcesBalancedAllocation(**(args or {})),
         "PodTopologySpread": lambda args, h: PodTopologySpread(),
-        "InterPodAffinity": lambda args, h: InterPodAffinity(),
+        "InterPodAffinity": lambda args, h: InterPodAffinity(h),
         "DefaultBinder": lambda args, h: DefaultBinder(h.client),
         "DefaultPreemption": lambda args, h: DefaultPreemption(h.client),
         "Coscheduling": lambda args, h: Coscheduling(h.client, h),

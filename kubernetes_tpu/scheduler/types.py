@@ -108,15 +108,33 @@ PVC_ADD = ClusterEvent("PersistentVolumeClaim", "Add")
 
 @dataclass(slots=True)
 class AffinityTerm:
-    """A compiled v1.PodAffinityTerm (framework/types.go AffinityTerm)."""
+    """A compiled v1.PodAffinityTerm (framework/types.go AffinityTerm).
+
+    ns_selector is the term's namespaceSelector (PodAffinityNamespace-
+    Selector): the effective namespace set is `namespaces` UNION the
+    namespaces whose LABELS match ns_selector — resolved at match time
+    against a ns_labels map the caller supplies (the reference resolves
+    per cycle via a namespace lister, plugins/interpodaffinity).  An
+    EMPTY ns_selector matches every namespace.  Callers that cannot
+    supply ns_labels treat ns_selector terms as namespace-list-only
+    (the TPU encoder escapes such pods instead, flatten._encode_pod)."""
 
     selector: Selector
     topology_key: str
     namespaces: frozenset[str]
     weight: int = 0  # for preferred terms
+    ns_selector: Selector | None = None
 
-    def matches(self, pod: Obj, pod_labels: dict[str, str]) -> bool:
-        return meta.namespace(pod) in self.namespaces and self.selector.matches(pod_labels)
+    def matches(self, pod: Obj, pod_labels: dict[str, str],
+                ns_labels: dict[str, dict] | None = None) -> bool:
+        ns = meta.namespace(pod)
+        if ns not in self.namespaces:
+            if self.ns_selector is None or ns_labels is None:
+                return False
+            lbl = ns_labels.get(ns)
+            if lbl is None or not self.ns_selector.matches(lbl):
+                return False
+        return self.selector.matches(pod_labels)
 
 
 def _compile_terms(terms: list[Obj] | None, default_ns: str,
@@ -127,12 +145,21 @@ def _compile_terms(terms: list[Obj] | None, default_ns: str,
         if weighted:
             w = t.get("weight", 0)
             t = t.get("podAffinityTerm") or {}
-        namespaces = frozenset(t.get("namespaces") or [default_ns])
+        ns_sel = None
+        if "namespaceSelector" in t and t["namespaceSelector"] is not None:
+            # an explicit (possibly EMPTY = match-all) namespaceSelector;
+            # the listed namespaces then default to the empty set, not
+            # the pod's own namespace (reference conversion semantics)
+            ns_sel = selector_from_dict(t["namespaceSelector"])
+            namespaces = frozenset(t.get("namespaces") or ())
+        else:
+            namespaces = frozenset(t.get("namespaces") or [default_ns])
         out.append(AffinityTerm(
             selector=selector_from_dict(t.get("labelSelector")),
             topology_key=t.get("topologyKey", ""),
             namespaces=namespaces,
             weight=w,
+            ns_selector=ns_sel,
         ))
     return out
 
@@ -155,6 +182,7 @@ class PodInfo:
         "tolerations", "node_selector", "node_affinity_required",
         "node_affinity_preferred", "host_ports", "topology_spread_constraints",
         "scheduler_name", "nominated_node_name", "plain",
+        "has_ns_selector_terms",
     )
 
     def __init__(self, pod: Obj):
@@ -171,6 +199,10 @@ class PodInfo:
         # fast-path pod (differential corpus: tests/test_fasthost.py).
         requests = fasthost.pod_scan_into(pod, self, _FAST_DEFAULTS)
         if requests is not False:
+            # simple pods carry no affinity stanza, hence no
+            # namespaceSelector terms (the C fill covers only the slots
+            # it lists)
+            self.has_ns_selector_terms = False
             # `requests` is only a dict for the proven single-container
             # shape; multi-container/initContainer pods still need the
             # general sum/max computation
@@ -202,6 +234,7 @@ class PodInfo:
             self.preferred_anti_affinity_terms = _EMPTY_TERMS
             self.node_affinity_required = _EMPTY_TERMS
             self.node_affinity_preferred = _EMPTY_TERMS
+            self.has_ns_selector_terms = False
         else:
             ns = meta.namespace(pod)
             pa = affinity.get("podAffinity") or {}
@@ -227,6 +260,12 @@ class PodInfo:
                  _compile_node_selector_term(p.get("preference") or {}))
                 for p in na.get("preferredDuringSchedulingIgnoredDuringExecution") or ()]
 
+        self.has_ns_selector_terms = any(
+            t.ns_selector is not None
+            for t in self.required_affinity_terms
+            + self.required_anti_affinity_terms
+            + self.preferred_affinity_terms
+            + self.preferred_anti_affinity_terms)
         self.tolerations = spec.get("tolerations") or []
         self.host_ports = _collect_host_ports(spec)
         self.topology_spread_constraints = spec.get("topologySpreadConstraints") or []

@@ -38,6 +38,8 @@ CASES = [
     ("PreemptionBasic", 25, 25),
     ("Unschedulable", 100, 100),
     ("SchedulingWithMixedChurn", 100, 100),
+    ("SchedulingRequiredPodAntiAffinityWithNSSelector", 100, 100),
+    ("SchedulingPreferredAffinityWithNSSelector", 100, 100),
 ]
 
 

@@ -266,3 +266,136 @@ class TestInterPodAffinity:
         s1, _ = plugin.score(state, pod, n1)
         s2, _ = plugin.score(state, pod, n2)
         assert s1 > s2
+
+
+class TestNamespaceSelector:
+    """PodAffinityNamespaceSelector (round 5): terms select peer
+    namespaces by label; resolution happens per cycle through the
+    plugin's namespace snapshot (reference GetNamespaceLabelsSnapshot)."""
+
+    def _term(self, **kw):
+        from kubernetes_tpu.scheduler.types import _compile_terms
+        t = {"topologyKey": "kubernetes.io/hostname",
+             "labelSelector": {"matchLabels": {"app": "x"}}, **kw}
+        return _compile_terms([t], "default")[0]
+
+    def test_ns_selector_matches_labeled_namespace(self):
+        from kubernetes_tpu.testing import make_pod
+        term = self._term(namespaceSelector={"matchLabels": {"team": "dev"}})
+        pod = make_pod("p", "other-ns").build()
+        pod["metadata"]["labels"] = {"app": "x"}
+        labels = {"app": "x"}
+        ns_labels = {"other-ns": {"team": "dev"}}
+        assert term.matches(pod, labels, ns_labels)
+        assert not term.matches(pod, labels, {"other-ns": {"team": "ops"}})
+        # without a resolver the selector cannot widen the namespace set
+        assert not term.matches(pod, labels, None)
+
+    def test_empty_ns_selector_matches_all_namespaces(self):
+        from kubernetes_tpu.testing import make_pod
+        term = self._term(namespaceSelector={})
+        pod = make_pod("p", "anywhere").build()
+        assert term.matches(pod, {"app": "x"}, {"anywhere": {}})
+
+    def test_explicit_namespaces_still_work_alongside_selector(self):
+        from kubernetes_tpu.testing import make_pod
+        term = self._term(namespaces=["listed"],
+                          namespaceSelector={"matchLabels": {"t": "v"}})
+        pod = make_pod("p", "listed").build()
+        assert term.matches(pod, {"app": "x"}, {})  # via the list
+
+    def test_oracle_filter_blocks_cross_namespace_anti(self):
+        """End to end through the per-pod path: an anti-affinity pod in
+        ns-b (selected by label) blocks a peer in ns-a on the same
+        host."""
+        from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+        from kubernetes_tpu.scheduler import new_scheduler
+        from kubernetes_tpu.store import kv
+        from kubernetes_tpu.testing import make_node, make_pod, wait_for
+        from kubernetes_tpu.api import meta
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        for ns, lbl in (("ns-a", {"team": "dev"}), ("ns-b", {"team": "dev"})):
+            store.create("namespaces", {
+                "apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": ns, "labels": lbl}})
+        for i in range(2):
+            n = make_node(f"n{i}").capacity(cpu="4", mem="16Gi",
+                                            pods=10).build()
+            n["metadata"].setdefault("labels", {})[
+                "kubernetes.io/hostname"] = f"n{i}"
+            client.create("nodes", n)
+        factory = SharedInformerFactory(client)
+        sched = new_scheduler(client, factory)
+        factory.start()
+        factory.wait_for_cache_sync()
+        sched.run()
+        try:
+            anti = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"c": "g"}},
+                     "namespaceSelector": {"matchLabels": {"team": "dev"}}}]}}
+            for i, ns in enumerate(("ns-a", "ns-b", "ns-a")):
+                p = make_pod(f"g{i}", ns).req(cpu="100m").build()
+                p["metadata"]["labels"] = {"c": "g"}
+                p["spec"]["affinity"] = anti
+                client.create("pods", p)
+            assert wait_for(lambda: sum(
+                1 for o in store.list("pods", None)[0]
+                if meta.pod_node_name(o)) == 2, timeout=20.0)
+            import time
+            time.sleep(1.0)
+            bound = [o for o in store.list("pods", None)[0]
+                     if meta.pod_node_name(o)]
+            # only TWO of the three can bind (2 hosts, cross-namespace
+            # anti-affinity counts pods in BOTH dev-labeled namespaces)
+            assert len(bound) == 2
+            assert len({meta.pod_node_name(o) for o in bound}) == 2
+        finally:
+            sched.stop()
+            factory.stop()
+            client.close()
+
+    def test_encoder_escapes_and_arms_guard(self):
+        from kubernetes_tpu.ops.flatten import BatchEncoder, Caps, ClusterTensors
+        from kubernetes_tpu.scheduler.cache import Cache
+        from kubernetes_tpu.scheduler.types import PodInfo
+        from kubernetes_tpu.testing import make_node, make_pod
+        caps = Caps(n_cap=16, l_cap=32, kl_cap=16, t_cap=4, pt_cap=4,
+                    s_cap=2, sg_cap=4, asg_cap=4, c_cap=2)
+        cache = Cache()
+        for i in range(4):
+            n = make_node(f"n{i}").capacity(cpu="8", mem="32Gi",
+                                            pods=50).build()
+            n["metadata"].setdefault("labels", {})[
+                "kubernetes.io/hostname"] = f"n{i}"
+            cache.add_node(n)
+        t = ClusterTensors(caps)
+        t.update_from_snapshot_tracked(cache.flatten_view())
+        enc = BatchEncoder(t, 8)
+        anti_pod = make_pod("a").req(cpu="100m").build()
+        anti_pod["metadata"]["labels"] = {"c": "g"}
+        anti_pod["spec"]["affinity"] = {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"topologyKey": "kubernetes.io/hostname",
+                 "labelSelector": {"matchLabels": {"c": "g"}},
+                 "namespaceSelector": {"matchLabels": {"team": "dev"}}}]}}
+        plain_matching = make_pod("m").req(cpu="100m").build()
+        plain_matching["metadata"]["labels"] = {"c": "g"}
+        plain_other = make_pod("o").req(cpu="100m").build()
+        # arming pod FIRST in the batch, then a matching plain pod, then
+        # an unrelated plain pod
+        b = enc.encode([PodInfo(anti_pod), PodInfo(plain_matching),
+                        PodInfo(plain_other)])
+        assert 0 in b.escape            # ns-selector term -> oracle
+        assert 1 in b.escape            # guard: labels match the anti kv
+        assert 2 not in b.escape        # unrelated pod rides the device
+        assert ("c", "g") in t.ns_anti_kv
+        # mid-batch arming: matching pod BEFORE the arming pod must be
+        # retroactively escaped
+        t2 = ClusterTensors(caps)
+        t2.update_from_snapshot_tracked(cache.flatten_view())
+        enc2 = BatchEncoder(t2, 8)
+        b2 = enc2.encode([PodInfo(plain_matching), PodInfo(anti_pod)])
+        assert 0 in b2.escape and 1 in b2.escape
