@@ -135,19 +135,23 @@ EXTRA_CONFIGS = {
                                  "batch": 4096, "depth": 2,
                                  "timeout": 900.0},
     "SchedulingPodAffinity": {"workload": "SchedulingPodAffinity",
-                              "batch": 4096, "depth": 2, "timeout": 900.0},
+                              "batch": 8192, "depth": 2,
+                              "admission_ms": 50.0, "timeout": 900.0},
     "SchedulingNodeAffinity": {"workload": "SchedulingNodeAffinity",
                                "batch": 4096, "depth": 2,
                                "timeout": 900.0},
     "SchedulingPreferredPodAffinity": {
         "workload": "SchedulingPreferredPodAffinity",
-        "batch": 4096, "depth": 2, "timeout": 900.0},
+        "batch": 8192, "depth": 2, "admission_ms": 50.0,
+        "timeout": 900.0},
     "SchedulingPreferredPodAntiAffinity": {
         "workload": "SchedulingPreferredPodAntiAffinity",
-        "batch": 4096, "depth": 2, "timeout": 900.0},
+        "batch": 8192, "depth": 2, "admission_ms": 50.0,
+        "timeout": 900.0},
     "PreferredTopologySpreading": {
         "workload": "PreferredTopologySpreading",
-        "batch": 4096, "depth": 2, "timeout": 900.0},
+        "batch": 8192, "depth": 2, "admission_ms": 50.0,
+        "timeout": 900.0},
     "MixedSchedulingBasePod": {"workload": "MixedSchedulingBasePod",
                                "batch": 4096, "depth": 2,
                                "timeout": 900.0},
