@@ -37,11 +37,31 @@ from jax.experimental.pallas import tpu as pltpu
 NEG = -1e9
 TIE_NOISE = 1e-3
 
-TP = 128   # pod-tile size
-TN = 512   # node-tile size (lane-dim multiple of 128)
-# Tile sizes were A/B'd at 256x1024 in round 5 (4x fewer grid steps);
-# same-window e2e at the 100k tier was NOT better on the tunneled chip,
-# so the original tiling stands.
+# Tile sizes (lane-dim multiples of 128).  Env-overridable for A/B
+# tuning (KTPU_PALLAS_TP/TN) — tunnel weather swamps single-run
+# comparisons, so tile experiments must interleave runs in one window.
+# Recorded negative result: 256x1024 was interleave-A/B'd at the 100k
+# tier in round 5 (12.6/15.6k default vs 13.8/11.8k big tiles) — no
+# winner, weather dominates; don't re-run that experiment on a tunnel.
+
+
+def _tile_from_env(var: str, default: int) -> int:
+    raw = os.environ.get(var)
+    if raw is None:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{var}={raw!r}: must be an integer") from None
+    if v <= 0 or v % 128 != 0:
+        # Mosaic lane-dim contract; a bad value would pass interpret-mode
+        # CPU tests and only fail lowering on real TPU
+        raise ValueError(f"{var}={v}: must be a positive multiple of 128")
+    return v
+
+
+TP = _tile_from_env("KTPU_PALLAS_TP", 128)   # pod-tile size
+TN = _tile_from_env("KTPU_PALLAS_TN", 512)   # node-tile size
 
 
 def _use_interpret() -> bool:
