@@ -70,11 +70,10 @@ EXTRA_CONFIGS = {
                                "nodes": 5000, "pods": 6_000, "batch": 256,
                                "rate": 1000, "timeout": 900.0,
                                "depth": 12, "admission_ms": 1.0},
-    # two_pass: this tier's number swings 10-17k with tunnel weather
-    # (identical code, same hour — r5 measured); best-of-2 keeps a
-    # single bad window from defining the round, both passes recorded
-    "Scheduling100k": {"two_pass": True,
-                       "workload": "SchedulingBasicLarge",
+    # single pass despite the tier's 10-17k weather band: a second
+    # 100k pass costs up to ~25 min in bad weather and the driver's
+    # bench budget is finite — the band is documented in README/LATENCY
+    "Scheduling100k": {"workload": "SchedulingBasicLarge",
                        "nodes": 100_000, "pods": 200_000, "batch": 16384,
                        "depth": 2, "timeout": 1200.0},
     # constraint workloads: batch 8192 (full_cap chunks pipeline inside
