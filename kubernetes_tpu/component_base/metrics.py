@@ -97,6 +97,11 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(tuple(str(v) for v in label_values), 0.0)
 
+    def values(self) -> Dict[Tuple[str, ...], float]:
+        """Point-in-time snapshot of every label series (Registry.gather)."""
+        with self._lock:
+            return dict(self._values)
+
     def collect(self) -> List[str]:
         with self._lock:
             items = sorted(self._values.items())
@@ -142,6 +147,11 @@ class Gauge(_Metric):
     def value(self, *label_values: str) -> float:
         with self._lock:
             return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def values(self) -> Dict[Tuple[str, ...], float]:
+        """Point-in-time snapshot of every label series (Registry.gather)."""
+        with self._lock:
+            return dict(self._values)
 
     def collect(self) -> List[str]:
         with self._lock:
@@ -220,6 +230,11 @@ class Histogram(_Metric):
         with self._lock:
             s = self._series.get(tuple(str(v) for v in label_values))
             return s[1] if s else 0.0
+
+    def values(self) -> Dict[Tuple[str, ...], Tuple[int, float]]:
+        """Point-in-time {labels: (count, sum)} snapshot (Registry.gather)."""
+        with self._lock:
+            return {k: (s[2], s[1]) for k, s in self._series.items()}
 
     def quantile(self, q: float, *label_values: str) -> float:
         """Approximate quantile from bucket upper bounds (for tests/latency
@@ -312,6 +327,20 @@ class Registry:
                 continue
             lines.extend(m.collect())
         return "\n".join(lines) + "\n"
+
+    def gather(self) -> Dict[str, Dict[Tuple[str, ...], Any]]:
+        """Structured snapshot: {metric_name: {label_key: value}} — counters
+        and gauges yield floats, histograms (count, sum) pairs.  The
+        programmatic twin of expose(), for tests and the seam dashboards
+        (no text-format parsing)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: Dict[str, Dict[Tuple[str, ...], Any]] = {}
+        for m in metrics:
+            values = getattr(m, "values", None)
+            if values is not None:
+                out[m.name] = values()
+        return out
 
 
 # The default registry, shared across one process (legacyregistry analogue).

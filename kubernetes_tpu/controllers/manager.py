@@ -114,7 +114,12 @@ class ControllerManager:
         self.factory = factory
         self.controllers: dict[str, object] = {}
         for name in controllers:
-            self.controllers[name] = self.CTORS[name](client, factory)
+            try:
+                self.controllers[name] = self.CTORS[name](client, factory)
+            except ModuleNotFoundError as e:
+                # optional-dependency gate (e.g. the CSR signer needs the
+                # cryptography package): run degraded rather than not at all
+                logger.warning("controller %s disabled: %s", name, e)
         self._elector: LeaderElector | None = None
         self._leader_elect = leader_elect
         self._identity = identity

@@ -1,0 +1,139 @@
+"""Deterministic fault injection for the remote TPU seam.
+
+Reference: test/e2e's disruptive "chaosmonkey" pattern (test/e2e/chaosmonkey
+— register disruptions, run them against live components, assert the system
+converges) and SURVEY §5's resilience claims: a control plane is only as
+fault-tolerant as the faults it has demonstrably survived.  This module
+makes the seam's fault model EXECUTABLE: every failure mode the error
+ladder in ops/remote.py claims to handle (lost requests, slow requests,
+corrupted responses, a worker crash+restart) can be injected on a seeded,
+reproducible schedule and asserted on in tests/test_chaos_seam.py and the
+RemoteSeamFaulty bench config.
+
+Design: `FaultyTransport` wraps a real client transport (the _HttpTransport
+/ _GrpcTransport `post()` interface) and consults a `FaultSchedule` before
+forwarding each call.  The schedule is deterministic two ways:
+
+  * `script` — {call_index: action} pins an exact fault to an exact call
+    (e.g. "kill the worker right before call 17").  Scripted entries win.
+  * rates — drop/delay/corrupt probabilities drawn from a seeded
+    random.Random.  Exactly ONE draw happens per call, before the script
+    lookup, so adding a scripted entry never shifts the random stream of
+    the calls around it.
+
+Faults map to the seam's own vocabulary, so injected and organic failures
+exercise identical client paths:
+
+  DROP    -> raise TransientSeamError (request never reaches the worker);
+             the client's bounded backoff retry absorbs it.
+  DELAY   -> sleep, then forward (tail-latency; deadlines still apply).
+  CORRUPT -> forward, then flip bytes in the response frame; the CRC
+             framing detects it and the seq dedup makes the retry serve
+             the original bytes without re-applying the step.
+  KILL    -> call on_kill() (DeviceWorker.simulate_restart) BEFORE
+             forwarding: the call lands on a state-lost worker and the
+             client must run its checkpoint+journal resync.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from .remote import TransientSeamError
+
+DROP = "drop"
+DELAY = "delay"
+CORRUPT = "corrupt"
+KILL = "kill"
+NONE = "none"
+
+
+class FaultSchedule:
+    """Seeded, reproducible fault decisions, one per transport call.
+
+    `action(i)` is consulted with a global call index; subclass it for
+    stateful schedules (e.g. KillOnNthStep in the chaos tests keys on
+    the Nth /step rather than an absolute call index)."""
+
+    def __init__(self, seed: int = 0, drop_rate: float = 0.0,
+                 delay_rate: float = 0.0, corrupt_rate: float = 0.0,
+                 delay_s: float = 0.01,
+                 script: dict[int, str] | None = None):
+        self.rng = random.Random(seed)
+        self.drop_rate = drop_rate
+        self.delay_rate = delay_rate
+        self.corrupt_rate = corrupt_rate
+        self.delay_s = delay_s
+        self.script = dict(script or {})
+
+    def action(self, call_index: int, verb: str) -> str:
+        # one draw per call REGARDLESS of the script, so scripted entries
+        # don't shift the stream for later calls
+        u = self.rng.random()
+        scripted = self.script.get(call_index)
+        if scripted is not None:
+            return scripted
+        if u < self.drop_rate:
+            return DROP
+        if u < self.drop_rate + self.delay_rate:
+            return DELAY
+        if u < self.drop_rate + self.delay_rate + self.corrupt_rate:
+            return CORRUPT
+        return NONE
+
+
+def _corrupt(blob: bytes) -> bytes:
+    """Flip a spray of bytes across the frame header and early payload —
+    guaranteed to break either the magic or the CRC check."""
+    out = bytearray(blob)
+    for i in range(0, min(len(out), 33), 8):
+        out[i] ^= 0xFF
+    return bytes(out)
+
+
+class FaultyTransport:
+    """A client transport wrapper that injects schedule-driven faults.
+
+    Drop-in for the inner transport (same `post` signature), handed to
+    RemoteTPUBatchBackend via its `transport=` parameter.  `injected`
+    counts what actually fired, keyed by action, for test/bench
+    assertions; `calls` is the number of posts seen."""
+
+    def __init__(self, inner, schedule: FaultSchedule,
+                 on_kill=None):
+        self.inner = inner
+        self.kind = getattr(inner, "kind", "?")
+        self.schedule = schedule
+        self.on_kill = on_kill
+        self.calls = 0
+        self.injected = {DROP: 0, DELAY: 0, CORRUPT: 0, KILL: 0}
+        self._lock = threading.Lock()
+
+    def post(self, verb: str, body: bytes, *, timeout: float,
+             epoch: int | None = None, seq: int | None = None) -> bytes:
+        with self._lock:
+            i = self.calls
+            self.calls += 1
+            act = self.schedule.action(i, verb)
+        if act == DROP:
+            self.injected[DROP] += 1
+            raise TransientSeamError(verb, f"injected drop (call {i})")
+        if act == KILL and self.on_kill is not None:
+            # restart BEFORE forwarding: this very call arrives at a
+            # state-lost worker
+            self.injected[KILL] += 1
+            self.on_kill()
+        if act == DELAY:
+            self.injected[DELAY] += 1
+            time.sleep(self.schedule.delay_s)
+        out = self.inner.post(verb, body, timeout=timeout, epoch=epoch,
+                              seq=seq)
+        if act == CORRUPT:
+            self.injected[CORRUPT] += 1
+            return _corrupt(out)
+        return out
+
+    def close(self) -> None:
+        self.inner.close()

@@ -10,14 +10,14 @@ an in-process BatchBackend; this module restores the network seam
 without giving up the resident-state transport:
 
   * `_WorkerCore` owns the jitted kernels and the resident device state
-    (exactly TPUBatchBackend's device half) behind four verbs: /init
+    (exactly TPUBatchBackend's device half) behind the verbs: /init
     (shape config), /static (full static upload), /refresh (dynamic
     state reset), /step (ONE packed pod+patch buffer in, assignments
-    out).  `GrpcDeviceWorker` serves them over gRPC/HTTP-2 — the
-    transport the north star names (reference precedent:
-    staging/src/k8s.io/cri-api/.../api.proto), each packed buffer one
-    gRPC message with identity serializers; `DeviceWorker` is the same
-    core over plain HTTP/1.1.
+    out), /health (liveness + incarnation probe).  `GrpcDeviceWorker`
+    serves them over gRPC/HTTP-2 — the transport the north star names
+    (reference precedent: staging/src/k8s.io/cri-api/.../api.proto),
+    each packed buffer one gRPC message with identity serializers;
+    `DeviceWorker` is the same core over plain HTTP/1.1.
   * `RemoteTPUBatchBackend` IS TPUBatchBackend with the three
     device-touching methods overridden to send the same byte payloads
     (grpc:// or http:// targets) — all host bookkeeping
@@ -25,6 +25,36 @@ without giving up the resident-state transport:
     candidates fall back to local jax) is shared code, so wire format
     and semantics cannot drift.  bench.py's RemoteSeamGrpc config
     measures the seam cost vs in-process (~1.1x on a CPU mesh).
+
+Fault model (ISSUE 1; "The Tail at Scale", Dean & Barroso 2013 — tail
+latency is dominated by rare slow/failed RPCs; Borg, Verma 2015 —
+control-plane components must survive each other's failures):
+
+  * Worker errors are STRUCTURED: every failure carries an error class
+    (`state_lost` / `invalid_request` / `internal`), mapped to HTTP
+    409/400/500 and gRPC FAILED_PRECONDITION / INVALID_ARGUMENT /
+    INTERNAL.  The client's ladder distinguishes retryable transport
+    faults (TransientSeamError) from fatal protocol/shape bugs
+    (WorkerProtocolError) from a restarted, state-lost worker
+    (WorkerStateLostError).
+  * Every successful response is CRC-framed (magic + crc32 header): a
+    corrupt frame is detected, classified retryable, and the retry is
+    safe because every state-mutating post carries a SEQUENCE NUMBER —
+    the worker caches (last_seq, last_response) and serves a duplicate
+    delivery from the cache without re-applying the step.
+  * The worker holds an EPOCH (incarnation token, minted at process
+    start / reset).  Clients pin the epoch learned at /init on every
+    subsequent post; a restarted worker answers `state_lost` and the
+    client transparently resyncs: re-/init, replay the checkpointed
+    /static and /refresh bodies, then replay the journal of steps
+    posted since the checkpoint — deterministic kernels rebuild the
+    resident state bit-identical to an uninterrupted run.
+  * Per-verb deadlines + bounded retries with exponential backoff and
+    seeded jitter come from scheduler/config.RemoteSeamPolicy
+    (`remoteSeam:` stanza).  Exhausted retries raise
+    WorkerUnavailableError, a scheduler.BackendUnavailableError: the
+    scheduler requeues the batch (queue.requeue_backoff) and the
+    failover ladder (ops/failover.py) can trip its breaker.
 
 Transport: raw little-endian float32/int32 bodies (the packed buffer is
 already a single 1-D f32 array; np.save framing for the array dicts).
@@ -41,12 +71,21 @@ from __future__ import annotations
 import io
 import json
 import logging
+import os
+import random
+import struct
 import threading
+import time
+import urllib.error
 import urllib.request
+import zlib
+from dataclasses import replace
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..scheduler.config import RemoteSeamPolicy
+from ..scheduler.scheduler import BackendUnavailableError
 from .backend import TPUBatchBackend
 from .flatten import Caps
 
@@ -63,23 +102,176 @@ def _load_arrays(blob: bytes) -> dict[str, np.ndarray]:
     return dict(np.load(io.BytesIO(blob)))
 
 
+# -- response framing ----------------------------------------------------
+# Every SUCCESS payload travels behind an 8-byte header: magic u32 +
+# crc32(payload) u32, little-endian.  A flipped bit anywhere surfaces as
+# CorruptFrameError (retryable; the worker's seq cache makes the retry
+# exactly-once) instead of silently mis-decoding an assignment vector.
+
+_FRAME_MAGIC = 0x5550_544B  # b"KTPU" little-endian
+_FRAME_HEADER = struct.Struct("<II")
+
+
+def _frame(payload: bytes) -> bytes:
+    return _FRAME_HEADER.pack(_FRAME_MAGIC,
+                              zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def _unframe(blob: bytes, verb: str = "?") -> bytes:
+    if len(blob) < _FRAME_HEADER.size:
+        raise CorruptFrameError(verb, f"short frame ({len(blob)} bytes)")
+    magic, crc = _FRAME_HEADER.unpack_from(blob)
+    payload = blob[_FRAME_HEADER.size:]
+    if magic != _FRAME_MAGIC:
+        raise CorruptFrameError(verb, f"bad magic 0x{magic:08x}")
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise CorruptFrameError(verb, "crc mismatch")
+    return payload
+
+
+# -- error ladder --------------------------------------------------------
+
+class SeamError(BackendUnavailableError):
+    """Base for remote-seam failures.  Subclasses scheduler's
+    BackendUnavailableError so an error that escapes the backend makes
+    the scheduler requeue the batch instead of killing the loop."""
+
+    retryable = False
+    error_class = "transport"
+
+    def __init__(self, verb: str, msg: str):
+        super().__init__(f"{verb}: {msg}")
+        self.verb = verb
+
+
+class TransientSeamError(SeamError):
+    """Connection refused/reset, deadline exceeded, 5xx, UNAVAILABLE:
+    worth retrying against the same worker."""
+
+    retryable = True
+
+
+class CorruptFrameError(TransientSeamError):
+    error_class = "corrupt_frame"
+
+
+class WorkerStateLostError(SeamError):
+    """409 / FAILED_PRECONDITION: the worker answered but has no (or the
+    wrong incarnation of) resident state — it restarted.  Triggers the
+    client-side resync replay, not a plain retry."""
+
+    error_class = "state_lost"
+
+
+class WorkerProtocolError(SeamError):
+    """400 / INVALID_ARGUMENT: the request itself is malformed (shape or
+    framing bug).  Deterministic — retrying cannot help."""
+
+    error_class = "protocol"
+
+
+class WorkerUnavailableError(TransientSeamError):
+    """The retry budget is exhausted (or a restarted worker cannot be
+    resynced).  What dispatch/resolve raise upward to the scheduler and
+    the failover ladder."""
+
+    error_class = "unavailable"
+
+
+# -- worker side ---------------------------------------------------------
+
+# error classes on the wire (the `class` field of an error body / the
+# prefix of a gRPC details string)
+E_STATE_LOST = "state_lost"
+E_INVALID = "invalid_request"
+E_INTERNAL = "internal"
+
+
+class WorkerError(Exception):
+    """A classified handler failure; the serving layer maps error_class
+    to the transport's status vocabulary."""
+
+    def __init__(self, error_class: str, msg: str):
+        super().__init__(msg)
+        self.error_class = error_class
+
+
+def _new_epoch() -> int:
+    # incarnation token, not a counter: two workers (or one worker
+    # restarted) must not collide, so draw it from the OS
+    return int.from_bytes(os.urandom(4), "little") | 1
+
+
 class _WorkerCore:
     """The device half of TPUBatchBackend, transport-agnostic: both the
     HTTP DeviceWorker and the gRPC GrpcDeviceWorker serve exactly these
-    verbs over the same byte payloads."""
+    verbs over the same byte payloads.
+
+    State beyond the backend itself: `_epoch` (incarnation token; a
+    client pinning a stale epoch gets `state_lost`) and the one-deep
+    dedup cache `(_last_seq, _last_resp)` — the client is a single
+    ordered writer, so one slot makes every retried post exactly-once."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._backend: TPUBatchBackend | None = None
+        self._epoch = _new_epoch()
+        self._last_seq: int | None = None
+        self._last_resp = None
 
-    def handle(self, path: str, body: bytes):
+    def reset(self) -> None:
+        """Simulate a crash+restart in place: resident state, kernels and
+        the dedup cache are gone; a fresh epoch is minted.  The chaos
+        harness (ops/faults.py kill action) and DeviceWorker
+        .simulate_restart() use this — protocol-wise indistinguishable
+        from a real process restart on the same port."""
         with self._lock:
-            return self._handle(path, body)
+            self._backend = None
+            self._epoch = _new_epoch()
+            self._last_seq = None
+            self._last_resp = None
 
-    def _handle(self, path: str, body: bytes):
-        if path == "/init":
+    def handle(self, path: str, body: bytes, epoch: int | None = None,
+               seq: int | None = None):
+        """Returns (payload, worker_epoch); raises WorkerError with an
+        error class on any failure."""
+        with self._lock:
+            if path == "/health":
+                # liveness + incarnation, served before /init and without
+                # consuming a seq: the breaker's half-open probe
+                return ({"ok": True, "epoch": self._epoch,
+                         "initialized": self._backend is not None},
+                        self._epoch)
+            if seq is not None and seq == self._last_seq \
+                    and self._last_resp is not None:
+                # duplicate delivery (client retried after a lost or
+                # corrupt response): serve the cached response WITHOUT
+                # re-applying — re-running a /step would double-count
+                # the resident-state commit
+                return (self._last_resp, self._epoch)
+            if path == "/init":
+                out = self._init(body)
+            else:
+                if self._backend is None:
+                    raise WorkerError(E_STATE_LOST,
+                                      "worker not initialized (/init first)")
+                if epoch is not None and epoch != self._epoch:
+                    raise WorkerError(
+                        E_STATE_LOST,
+                        f"epoch mismatch (client {epoch}, worker "
+                        f"{self._epoch}): worker restarted")
+                out = self._apply(path, body)
+            if seq is not None:
+                self._last_seq, self._last_resp = seq, out
+            return (out, self._epoch)
+
+    def _init(self, body: bytes):
+        try:
             cfg = json.loads(body)
             caps = Caps(**cfg["caps"])
+        except (ValueError, TypeError, KeyError) as e:
+            raise WorkerError(E_INVALID, f"bad /init body: {e!r}")
+        try:
             # a plain TPUBatchBackend, used ONLY for its device half —
             # the remote client owns all host bookkeeping
             self._backend = TPUBatchBackend(
@@ -94,32 +286,56 @@ class _WorkerCore:
             if self._backend.FULL_MAIN_WAVES:
                 self._backend._ensure_full_small()
             self._backend._ensure_plain()
-            return {"ok": True, "full_cap": self._backend.full_cap}
+        except Exception as e:  # noqa: BLE001 — classify, don't die
+            self._backend = None
+            raise WorkerError(E_INTERNAL, f"/init failed: {e!r}")
+        return {"ok": True, "full_cap": self._backend.full_cap,
+                "epoch": self._epoch}
+
+    def _apply(self, path: str, body: bytes):
         b = self._backend
-        if b is None:
-            raise RuntimeError("worker not initialized (/init first)")
         if path == "/static":
             import jax.numpy as jnp
 
             from .backend import STATIC_CORE, STATIC_SEL
-            arrays = _load_arrays(body)
-            b._static_node = {k: jnp.asarray(arrays[k]) for k in STATIC_CORE}
+            try:
+                arrays = _load_arrays(body)
+                static_node = {k: jnp.asarray(arrays[k])
+                               for k in STATIC_CORE}
+                static_sel = {k: jnp.asarray(arrays[k]) for k in STATIC_SEL}
+            except (ValueError, KeyError, OSError) as e:
+                raise WorkerError(E_INVALID, f"bad /static body: {e!r}")
+            b._static_node = static_node
             # the worker holds BOTH halves resident (its tensors are empty,
             # so the base _ensure_sel must never try to rebuild from them)
-            b._static_sel = {k: jnp.asarray(arrays[k]) for k in STATIC_SEL}
+            b._static_sel = static_sel
             b._sel_stale = False
             return {"ok": True}
         if path == "/refresh":
             import jax.numpy as jnp
-            arrays = _load_arrays(body)
+            try:
+                arrays = _load_arrays(body)
+            except (ValueError, OSError) as e:
+                raise WorkerError(E_INVALID, f"bad /refresh body: {e!r}")
             b._state = {k: jnp.asarray(v) for k, v in arrays.items()}
             return {"ok": True}
         if path.startswith("/step"):
             variant = path.rsplit("=", 1)[-1]
-            buf = np.frombuffer(body, np.float32)
-            rd = b._device_step(variant, buf)
-            return np.asarray(rd).astype(np.int32).tobytes()
-        raise RuntimeError(f"unknown verb {path!r}")
+            if variant not in ("full", "full_small", "plain"):
+                raise WorkerError(E_INVALID, f"unknown variant {variant!r}")
+            try:
+                buf = np.frombuffer(body, np.float32)
+                rd = b._device_step(variant, buf)
+                return np.asarray(rd).astype(np.int32).tobytes()
+            except WorkerError:
+                raise
+            except (ValueError, TypeError, KeyError, IndexError) as e:
+                # wrong byte count / unpackable layout: the request is
+                # broken, not the worker
+                raise WorkerError(E_INVALID, f"malformed /step body: {e!r}")
+            except Exception as e:  # noqa: BLE001 — classify, don't die
+                raise WorkerError(E_INTERNAL, f"/step failed: {e!r}")
+        raise WorkerError(E_INVALID, f"unknown verb {path!r}")
 
 
 class DeviceWorker:
@@ -140,25 +356,43 @@ class DeviceWorker:
                 return self.rfile.read(n) if n else b""
 
             def _reply(self, code: int, body: bytes = b"{}",
-                       ctype: str = "application/json") -> None:
+                       ctype: str = "application/json",
+                       epoch: int | None = None) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if epoch is not None:
+                    self.send_header("X-KTPU-Epoch", str(epoch))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_POST(self):
                 try:
-                    out = server._core.handle(self.path, self._body())
+                    epoch = self.headers.get("X-KTPU-Epoch")
+                    seq = self.headers.get("X-KTPU-Seq")
+                    out, w_epoch = server._core.handle(
+                        self.path, self._body(),
+                        epoch=int(epoch) if epoch is not None else None,
+                        seq=int(seq) if seq is not None else None)
+                except WorkerError as e:
+                    code = {E_STATE_LOST: 409, E_INVALID: 400}.get(
+                        e.error_class, 500)
+                    logger.warning("tpu-worker: %s -> %d %s: %s",
+                                   self.path, code, e.error_class, e)
+                    self._reply(code, json.dumps(
+                        {"error": str(e), "class": e.error_class}).encode())
+                    return
                 except Exception as e:  # noqa: BLE001 — report, don't die
                     logger.exception("tpu-worker: %s failed", self.path)
                     self._reply(500, json.dumps(
-                        {"error": str(e)}).encode())
+                        {"error": str(e), "class": E_INTERNAL}).encode())
                     return
                 if isinstance(out, bytes):
-                    self._reply(200, out, "application/octet-stream")
+                    self._reply(200, _frame(out), "application/octet-stream",
+                                epoch=w_epoch)
                 else:
-                    self._reply(200, json.dumps(out or {}).encode())
+                    self._reply(200, _frame(json.dumps(out or {}).encode()),
+                                "application/octet-stream", epoch=w_epoch)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True
@@ -179,6 +413,11 @@ class DeviceWorker:
         self.httpd.shutdown()
         self.httpd.server_close()
 
+    def simulate_restart(self) -> None:
+        """Chaos hook: drop resident state + mint a new epoch, keeping the
+        socket (protocol-identical to a crash + same-port restart)."""
+        self._core.reset()
+
 
 # gRPC method name <-> worker verb (the reference's process-boundary
 # precedent is gRPC: staging/src/k8s.io/cri-api/.../api.proto; the
@@ -192,6 +431,7 @@ _GRPC_VERBS = {
     "StepFull": "/step?variant=full",
     "StepFullSmall": "/step?variant=full_small",
     "StepPlain": "/step?variant=plain",
+    "Health": "/health",
 }
 _GRPC_MSG_CAP = 512 << 20
 _GRPC_OPTIONS = [
@@ -208,23 +448,43 @@ class GrpcDeviceWorker:
     each packed buffer travels as ONE gRPC message with binary framing —
     no chunked-encoding or content-length ceremony per step."""
 
+    # WorkerError class -> status code (mirrors the HTTP 409/400/500 map)
+    _STATUS_OF = None  # filled lazily (grpc import)
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         import grpc
 
         self._core = _WorkerCore()
         core = self._core
+        status_of = {E_STATE_LOST: grpc.StatusCode.FAILED_PRECONDITION,
+                     E_INVALID: grpc.StatusCode.INVALID_ARGUMENT,
+                     E_INTERNAL: grpc.StatusCode.INTERNAL}
 
         def _unary(verb_path):
             def call(request: bytes, context) -> bytes:
+                md = dict(context.invocation_metadata() or ())
+                epoch = md.get("ktpu-epoch")
+                seq = md.get("ktpu-seq")
                 try:
-                    out = core.handle(verb_path, request)
+                    out, _w_epoch = core.handle(
+                        verb_path, request,
+                        epoch=int(epoch) if epoch is not None else None,
+                        seq=int(seq) if seq is not None else None)
+                except WorkerError as e:
+                    logger.warning("tpu-worker(grpc): %s -> %s: %s",
+                                   verb_path, e.error_class, e)
+                    context.abort(
+                        status_of.get(e.error_class,
+                                      grpc.StatusCode.INTERNAL),
+                        f"{e.error_class}: {e}")
                 except Exception as e:  # noqa: BLE001 — report, don't die
                     logger.exception("tpu-worker(grpc): %s failed",
                                      verb_path)
-                    context.abort(grpc.StatusCode.INTERNAL, str(e))
+                    context.abort(grpc.StatusCode.INTERNAL,
+                                  f"{E_INTERNAL}: {e}")
                 if isinstance(out, bytes):
-                    return out
-                return json.dumps(out or {}).encode()
+                    return _frame(out)
+                return _frame(json.dumps(out or {}).encode())
             return call
 
         handlers = {
@@ -251,51 +511,178 @@ class GrpcDeviceWorker:
     def stop(self) -> None:
         self._server.stop(grace=1.0)
 
+    def simulate_restart(self) -> None:
+        """Chaos hook: see DeviceWorker.simulate_restart."""
+        self._core.reset()
+
+
+# -- client transports ---------------------------------------------------
+# One interface: post(verb, body, timeout=, epoch=, seq=) -> framed bytes,
+# raising the classified SeamError ladder.  ops/faults.py FaultyTransport
+# wraps either implementation.
+
+class _HttpTransport:
+    """Client side of the HTTP/1.1 seam."""
+
+    kind = "http"
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url
+
+    def post(self, verb: str, body: bytes, *, timeout: float,
+             epoch: int | None = None, seq: int | None = None) -> bytes:
+        headers = {"Content-Type": "application/octet-stream"}
+        if epoch is not None:
+            headers["X-KTPU-Epoch"] = str(epoch)
+        if seq is not None:
+            headers["X-KTPU-Seq"] = str(seq)
+        req = urllib.request.Request(self.base_url + verb, data=body,
+                                     method="POST", headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                info = json.loads(raw)
+                cls, msg = info.get("class", ""), info.get("error", "")
+            except (ValueError, UnicodeDecodeError):
+                cls, msg = "", repr(raw[:200])
+            if e.code == 409 or cls == E_STATE_LOST:
+                raise WorkerStateLostError(verb, msg) from None
+            if 400 <= e.code < 500:
+                raise WorkerProtocolError(
+                    verb, f"HTTP {e.code} ({cls or 'error'}): {msg}"
+                ) from None
+            raise TransientSeamError(
+                verb, f"HTTP {e.code} ({cls or 'error'}): {msg}") from None
+        except OSError as e:
+            # URLError (connection refused/reset), socket timeouts — the
+            # network or the worker process, not the request
+            raise TransientSeamError(verb, repr(e)) from None
+
+    def close(self) -> None:
+        pass
+
 
 class _GrpcTransport:
     """Client side of the gRPC seam: verb path -> unary call with
-    identity (bytes) serializers."""
+    identity (bytes) serializers; epoch/seq ride call metadata."""
 
-    def __init__(self, target: str, timeout: float):
+    kind = "grpc"
+
+    def __init__(self, target: str):
         import grpc
 
-        self.timeout = timeout
+        self._grpc = grpc
         self._channel = grpc.insecure_channel(target,
                                               options=_GRPC_OPTIONS)
         self._calls = {
             path: self._channel.unary_unary(f"/{GRPC_SERVICE}/{name}")
             for name, path in _GRPC_VERBS.items()}
 
-    def post(self, verb: str, body: bytes) -> bytes:
-        return self._calls[verb](body, timeout=self.timeout)
+    def post(self, verb: str, body: bytes, *, timeout: float,
+             epoch: int | None = None, seq: int | None = None) -> bytes:
+        md = []
+        if epoch is not None:
+            md.append(("ktpu-epoch", str(epoch)))
+        if seq is not None:
+            md.append(("ktpu-seq", str(seq)))
+        try:
+            return self._calls[verb](body, timeout=timeout,
+                                     metadata=tuple(md) or None)
+        except self._grpc.RpcError as e:
+            sc = self._grpc.StatusCode
+            code = e.code()
+            details = e.details() or ""
+            if (code == sc.FAILED_PRECONDITION
+                    or details.startswith(E_STATE_LOST)):
+                raise WorkerStateLostError(verb, details) from None
+            if code in (sc.INVALID_ARGUMENT, sc.UNIMPLEMENTED,
+                        sc.UNAUTHENTICATED, sc.PERMISSION_DENIED):
+                raise WorkerProtocolError(
+                    verb, f"{code.name}: {details}") from None
+            # UNAVAILABLE / DEADLINE_EXCEEDED / INTERNAL / UNKNOWN / ...
+            raise TransientSeamError(
+                verb, f"{code.name}: {details}") from None
 
     def close(self) -> None:
         self._channel.close()
 
 
+def transport_for(worker_url: str):
+    url = worker_url.rstrip("/")
+    if url.startswith("grpc://"):
+        return _GrpcTransport(url[len("grpc://"):])
+    return _HttpTransport(url)
+
+
+# the /refresh (and checkpoint) body: exactly the mirror's keys
+_REFRESH_KEYS = ("used", "used_nz", "npods", "port_mask", "cd_sg", "cd_asg")
+
+
 class RemoteTPUBatchBackend(TPUBatchBackend):
     """TPUBatchBackend whose device half lives in a DeviceWorker.
 
-    Everything except the three overridden methods is inherited: the
-    tensors, encoder, mirror replay, patch diffing, chunking and the
+    Everything except the overridden device-seam methods is inherited:
+    the tensors, encoder, mirror replay, patch diffing, chunking and the
     FLUSH_FIRST protocol run scheduler-side, and the SAME packed bytes
     that would go to a local chip go over the wire.
+
+    Resilience (module docstring): per-verb deadlines, bounded jittered
+    retries, seq-deduped exactly-once posts, and a checkpoint+journal
+    that lets a worker restart be replayed transparently mid-stream:
+
+      * checkpoint — at the first dispatch after the pipeline drains,
+        snapshot the host mirror as a ready-to-post /refresh body (the
+        mirror IS the device state whenever nothing is unresolved) and
+        clear the journal; /static and /refresh posts checkpoint
+        themselves (their body is the state).
+      * journal — every /step body posted since the checkpoint, in
+        order.  On `state_lost`: re-/init, post the checkpointed static
+        + refresh, replay the journal, then re-post the failed step
+        under a fresh seq.  Deterministic kernels make the rebuilt
+        resident state bit-identical.
+      * degradation — if the journal overflowed (journal_cap) or no
+        checkpoint exists yet, raise WorkerUnavailableError instead:
+        the scheduler requeues the batch and the next dispatch rebuilds
+        the device state from the authoritative tensors.  Slower, never
+        wrong.
     """
 
     def __init__(self, worker_url: str, caps: Caps | None = None,
                  batch_size: int = 256,
                  weights: dict[str, float] | None = None,
                  k_cap: int = 1024, full_batch_cap: int | None = None,
-                 timeout: float = 120.0):
+                 timeout: float | None = None,
+                 policy: RemoteSeamPolicy | None = None,
+                 transport=None, rng_seed: int = 0):
         self.worker_url = worker_url.rstrip("/")
-        self.timeout = timeout
-        self._grpc = None
-        if self.worker_url.startswith("grpc://"):
-            self._grpc = _GrpcTransport(
-                self.worker_url[len("grpc://"):], timeout)
+        if policy is None:
+            policy = RemoteSeamPolicy()
+        if timeout is not None:
+            # legacy knob: one deadline for every verb
+            policy = replace(policy, init_timeout=timeout,
+                             static_timeout=timeout, refresh_timeout=timeout,
+                             step_timeout=timeout)
+        self.policy = policy
+        self.timeout = policy.step_timeout  # back-compat attribute
+        self._rng = random.Random(rng_seed)
+        self._transport = (transport if transport is not None
+                           else transport_for(self.worker_url))
+        self._seq = 0
+        self._epoch: int | None = None
+        self._needs_reinit = False
+        self._init_body: bytes | None = None
+        self._ckpt_static_body: bytes | None = None
+        self._ckpt_refresh_body: bytes | None = None
+        self._journal: list[tuple[str, bytes]] = []
+        self._journal_overflow = False
+        self.seam_stats = {"retries": 0, "resyncs": 0, "state_lost": 0,
+                           "corrupt_frames": 0, "giveups": 0}
         super().__init__(caps, batch_size=batch_size, weights=weights,
                          k_cap=k_cap, full_batch_cap=full_batch_cap)
-        got = self._post("/init", json.dumps({
+        self._init_body = json.dumps({
             "caps": vars(self.caps), "batch_size": batch_size,
             "weights": weights, "k_cap": k_cap,
             "full_batch_cap": self.full_cap,
@@ -303,16 +690,162 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
             # worker must build its main kernel with the same cap the
             # client's resolve() compensates for, or capped-kernel
             # leftovers decode as UNSCHEDULABLE with no retry
-            "full_main_waves": self.FULL_MAIN_WAVES}).encode())
-        self.full_cap = json.loads(got)["full_cap"]
+            "full_main_waves": self.FULL_MAIN_WAVES}).encode()
+        got = json.loads(self._post("/init", self._init_body))
+        self.full_cap = got["full_cap"]
+        self._epoch = got.get("epoch")
+
+    # -- resilient transport ---------------------------------------------
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _post_once(self, verb: str, body: bytes, seq: int | None) -> bytes:
+        out = self._transport.post(verb, body,
+                                   timeout=self.policy.timeout_for(verb),
+                                   epoch=self._epoch, seq=seq)
+        try:
+            return _unframe(out, verb)
+        except CorruptFrameError:
+            self.seam_stats["corrupt_frames"] += 1
+            raise
+
+    def _call(self, verb: str, body: bytes, seq: int | None,
+              allow_resync: bool = True) -> bytes:
+        """One logical post: bounded retries with exponential backoff +
+        seeded jitter for transient faults, transparent resync for a
+        state-lost worker, immediate raise for protocol errors."""
+        p = self.policy
+        attempt = 0
+        resyncs = 0
+        need_resync = False
+        while True:
+            try:
+                if need_resync:
+                    need_resync = False
+                    self._resync()
+                return self._post_once(verb, body, seq)
+            except WorkerStateLostError:
+                self.seam_stats["state_lost"] += 1
+                if not allow_resync or verb == "/init":
+                    raise
+                resyncs += 1
+                if resyncs > p.resync_attempts:
+                    self.seam_stats["giveups"] += 1
+                    raise
+                need_resync = True
+                # the failed post replays under a FRESH seq: the old
+                # seq's dedup slot died with the worker's state
+                seq = self._next_seq() if seq is not None else None
+            except WorkerUnavailableError:
+                raise  # a nested resync already gave up
+            except TransientSeamError as e:
+                attempt += 1
+                if attempt > p.max_retries:
+                    self.seam_stats["giveups"] += 1
+                    raise WorkerUnavailableError(
+                        verb, f"retries exhausted "
+                        f"({p.max_retries}): {e}") from e
+                self.seam_stats["retries"] += 1
+                time.sleep(p.backoff(attempt, self._rng))
 
     def _post(self, verb: str, body: bytes) -> bytes:
-        if self._grpc is not None:
-            return self._grpc.post(verb, body)
-        req = urllib.request.Request(self.worker_url + verb, data=body,
-                                     method="POST")
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return resp.read()
+        """A state-mutating post: one seq for its lifetime (retries dedup
+        worker-side); successful steps are journaled for resync replay."""
+        out = self._call(verb, body, self._next_seq())
+        if verb.startswith("/step"):
+            self._journal.append((verb, body))
+            if len(self._journal) > self.policy.journal_cap:
+                # transparent replay is no longer possible; the next
+                # quiescent dispatch re-checkpoints, and a restart before
+                # then degrades to a failed batch + full rebuild
+                self._journal_overflow = True
+                del self._journal[:]
+        return out
+
+    def _resync(self) -> None:
+        """The worker lost its resident state (restart): rebuild it to
+        exactly the post-last-successful-step state and carry on."""
+        self.seam_stats["resyncs"] += 1
+        if (self._init_body is None or self._ckpt_static_body is None
+                or self._ckpt_refresh_body is None or self._journal_overflow):
+            self._degrade()
+            raise WorkerUnavailableError(
+                "/resync", "worker restarted with no replayable checkpoint; "
+                "batch requeued, device state rebuilds next dispatch")
+        logger.warning(
+            "remote seam: worker state lost; resyncing (init + static + "
+            "refresh + %d journaled steps)", len(self._journal))
+        self._epoch = None  # accept whichever incarnation answers
+        got = json.loads(self._call("/init", self._init_body,
+                                    self._next_seq(), allow_resync=False))
+        if got["full_cap"] != self.full_cap:
+            raise WorkerProtocolError(
+                "/init", f"full_cap changed across restart "
+                f"({self.full_cap} -> {got['full_cap']})")
+        self._epoch = got.get("epoch")
+        self._call("/static", self._ckpt_static_body, self._next_seq(),
+                   allow_resync=False)
+        self._call("/refresh", self._ckpt_refresh_body, self._next_seq(),
+                   allow_resync=False)
+        for verb, body in self._journal:
+            self._call(verb, body, self._next_seq(), allow_resync=False)
+
+    def _degrade(self) -> None:
+        """No replayable checkpoint: forget the device state so the next
+        dispatch re-inits and uploads static + refresh from the
+        authoritative tensors (correct by construction — the failed
+        batch's pods were requeued, never bound)."""
+        self._needs_reinit = True
+        self._epoch = None
+        self._static_node = None
+        self._state = None
+        self._mirror = None
+        self._ckpt_refresh_body = None
+        del self._journal[:]
+        self._journal_overflow = False
+
+    # -- checkpointing hooks ---------------------------------------------
+
+    def dispatch(self, pod_infos, snapshot):
+        with self._lock:
+            self._seam_prepare()
+        return super().dispatch(pod_infos, snapshot)
+
+    def _seam_prepare(self) -> None:
+        if self._needs_reinit and self._init_body is not None:
+            got = json.loads(self._call("/init", self._init_body,
+                                        self._next_seq(),
+                                        allow_resync=False))
+            if got["full_cap"] != self.full_cap:
+                raise WorkerProtocolError(
+                    "/init", f"full_cap changed across restart "
+                    f"({self.full_cap} -> {got['full_cap']})")
+            self._epoch = got.get("epoch")
+            self._needs_reinit = False
+        if ((self._journal or self._journal_overflow)
+                and not self._unresolved and self._mirror is not None):
+            # quiescent: every dispatched step has been resolved AND
+            # replayed into the mirror, so the mirror == device state;
+            # snapshot it as a ready-to-post /refresh body
+            self._ckpt_refresh_body = _dump_arrays(
+                {k: self._mirror[k] for k in _REFRESH_KEYS})
+            del self._journal[:]
+            self._journal_overflow = False
+
+    def health(self, timeout: float | None = None) -> dict:
+        """One /health round trip (raises the SeamError ladder).  Used by
+        the failover breaker's half-open probe."""
+        out = self._transport.post(
+            "/health", b"",
+            timeout=timeout if timeout is not None
+            else self.policy.health_timeout,
+            epoch=None, seq=None)
+        return json.loads(_unframe(out, "/health"))
+
+    def close(self) -> None:
+        self._transport.close()
 
     # -- the device seam, remoted ---------------------------------------
 
@@ -344,11 +877,13 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
 
     def _upload_static(self) -> None:
         t = self.tensors
-        self._post("/static", _dump_arrays({
+        body = _dump_arrays({
             "alloc": t.alloc, "maxpods": t.maxpods, "valid": t.valid,
             "taint_mask": t.taint_mask, "label_mask": t.label_mask,
             "key_mask": t.key_mask, "dom_sg": t.dom_sg,
-            "dom_asg": t.dom_asg}))
+            "dom_asg": t.dom_asg})
+        self._post("/static", body)
+        self._ckpt_static_body = body  # the post IS the checkpoint
         self._static_node = True  # sentinel: worker holds the arrays
         t.static_dirty_rows = set()
         t.static_full = False
@@ -356,15 +891,23 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
 
     def _full_refresh(self, cd_sg: np.ndarray, cd_asg: np.ndarray) -> None:
         t = self.tensors
-        self._post("/refresh", _dump_arrays({
+        body = _dump_arrays({
             "used": t.used, "used_nz": t.used_nz, "npods": t.npods,
-            "port_mask": t.port_mask, "cd_sg": cd_sg, "cd_asg": cd_asg}))
+            "port_mask": t.port_mask, "cd_sg": cd_sg, "cd_asg": cd_asg})
+        self._post("/refresh", body)
+        # a refresh replaces the device state outright: it IS a checkpoint,
+        # and every journaled step before it is obsolete
+        self._ckpt_refresh_body = body
+        del self._journal[:]
+        self._journal_overflow = False
         self._state = True  # sentinel: worker holds the arrays
         self._mirror_from_tensors(cd_sg, cd_asg)
         self.stats["full_refresh"] += 1
 
     def warmup(self) -> None:
         with self._lock:
+            if self._needs_reinit:
+                self._seam_prepare()
             if self._static_node is None:
                 self._upload_static()
             if self._state is None:

@@ -28,6 +28,11 @@ list + the enabled/disabled merge rules).  Shape accepted (YAML or dict):
     - urlPrefix: http://127.0.0.1:9000
       filterVerb: filter
       weight: 2
+  remoteSeam:                # deadlines/retries for the TPU worker seam
+    stepTimeoutSeconds: 30   # (ops/remote.py; no upstream analogue)
+    maxRetries: 3
+    failureThreshold: 3
+    probeIntervalSeconds: 5
 
 Merge semantics (default_plugins.go mergePlugins):
   1. start from the default MultiPoint list;
@@ -67,6 +72,89 @@ class ProfileConfig:
 
 
 @dataclass
+class RemoteSeamPolicy:
+    """Deadline/retry/failover policy for the remote TPU worker seam
+    (ops/remote.py RemoteTPUBatchBackend, ops/failover.py ladder).
+
+    Configured via the `remoteSeam:` stanza (see load_config); defaults
+    reproduce the historical single 120s deadline but add bounded retries.
+    Deadlines are per verb: /init covers kernel compilation, /step covers
+    one device round trip, /health is a liveness probe and must stay
+    small so an open circuit breaker probes cheaply."""
+
+    init_timeout: float = 120.0     # includes worker-side XLA compile
+    static_timeout: float = 120.0
+    refresh_timeout: float = 120.0
+    step_timeout: float = 120.0
+    health_timeout: float = 5.0
+    max_retries: int = 3            # per logical post, transient errors only
+    retry_base: float = 0.05        # exponential backoff: base * 2^(n-1)
+    retry_max: float = 2.0
+    retry_jitter: float = 0.5       # +/- fraction of the backoff, seeded rng
+    resync_attempts: int = 3        # state-lost recoveries per logical post
+    failure_threshold: int = 3      # K consecutive failures open the breaker
+    probe_interval: float = 5.0     # seconds between half-open health probes
+    journal_cap: int = 512          # replayable steps between checkpoints
+
+    def timeout_for(self, verb: str) -> float:
+        if verb.startswith("/step"):
+            return self.step_timeout
+        return {"/init": self.init_timeout, "/static": self.static_timeout,
+                "/refresh": self.refresh_timeout,
+                "/health": self.health_timeout}.get(verb, self.step_timeout)
+
+    def backoff(self, attempt: int, rng) -> float:
+        """Delay before retry `attempt` (1-based): exponential, capped,
+        jittered from the caller's seeded rng (deterministic in tests,
+        decorrelated across clients in production)."""
+        d = min(self.retry_max, self.retry_base * (2 ** max(0, attempt - 1)))
+        if self.retry_jitter > 0.0:
+            d *= 1.0 - self.retry_jitter / 2.0 + self.retry_jitter * rng.random()
+        return d
+
+
+# remoteSeam YAML key -> RemoteSeamPolicy field
+_SEAM_FIELDS = {
+    "initTimeoutSeconds": "init_timeout",
+    "staticTimeoutSeconds": "static_timeout",
+    "refreshTimeoutSeconds": "refresh_timeout",
+    "stepTimeoutSeconds": "step_timeout",
+    "healthTimeoutSeconds": "health_timeout",
+    "maxRetries": "max_retries",
+    "retryBaseSeconds": "retry_base",
+    "retryMaxSeconds": "retry_max",
+    "retryJitter": "retry_jitter",
+    "resyncAttempts": "resync_attempts",
+    "failureThreshold": "failure_threshold",
+    "probeIntervalSeconds": "probe_interval",
+    "journalCap": "journal_cap",
+}
+
+
+def _parse_remote_seam(data: dict) -> RemoteSeamPolicy:
+    kwargs = {}
+    for key, value in (data or {}).items():
+        if key not in _SEAM_FIELDS:
+            raise ConfigError(f"unknown remoteSeam key {key!r}")
+        kwargs[_SEAM_FIELDS[key]] = value
+    policy = RemoteSeamPolicy(**kwargs)
+    for f in ("init_timeout", "static_timeout", "refresh_timeout",
+              "step_timeout", "health_timeout", "retry_base", "retry_max",
+              "probe_interval"):
+        if getattr(policy, f) <= 0:
+            raise ConfigError(f"remoteSeam {f} must be positive")
+    if policy.max_retries < 0 or policy.resync_attempts < 0:
+        raise ConfigError("remoteSeam retry counts must be >= 0")
+    if policy.failure_threshold < 1:
+        raise ConfigError("remoteSeam failureThreshold must be >= 1")
+    if not 0.0 <= policy.retry_jitter <= 1.0:
+        raise ConfigError("remoteSeam retryJitter must be in [0,1]")
+    if policy.journal_cap < 1:
+        raise ConfigError("remoteSeam journalCap must be >= 1")
+    return policy
+
+
+@dataclass
 class SchedulerConfig:
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 0
@@ -74,6 +162,7 @@ class SchedulerConfig:
     pod_max_backoff: float = 10.0
     profiles: list[ProfileConfig] = field(default_factory=list)
     extenders: list[dict] = field(default_factory=list)
+    remote_seam: RemoteSeamPolicy = field(default_factory=RemoteSeamPolicy)
 
 
 def load_config(source: str | dict) -> SchedulerConfig:
@@ -99,6 +188,7 @@ def load_config(source: str | dict) -> SchedulerConfig:
         pod_initial_backoff=data.get("podInitialBackoffSeconds", 1.0),
         pod_max_backoff=data.get("podMaxBackoffSeconds", 10.0),
         extenders=data.get("extenders") or [],
+        remote_seam=_parse_remote_seam(data.get("remoteSeam")),
     )
     if cfg.parallelism <= 0:
         raise ConfigError("parallelism must be positive")
@@ -227,4 +317,9 @@ def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
                       extenders=build_extenders(cfg.extenders))
     sched.queue._initial_backoff = cfg.pod_initial_backoff
     sched.queue._max_backoff = cfg.pod_max_backoff
+    # backends are constructed by the harness (bench/perf/tests), not
+    # here: hang the seam policy off the scheduler so whoever wires a
+    # RemoteTPUBatchBackend into a profile picks up the configured
+    # deadlines/retry budget instead of the hard-coded defaults
+    sched.remote_seam_policy = cfg.remote_seam
     return sched

@@ -91,6 +91,25 @@ class Metrics:
             "scheduler_tpu_device_duration_seconds",
             "Device time per TPU assignment batch.",
             buckets=_LATENCY_BUCKETS)
+        # remote-seam resilience (ops/remote.py + ops/failover.py): the
+        # scheduler loop pushes batch-failure events; the backend's own
+        # cumulative counters (retries/resyncs/failovers) are snapshotted
+        # into the _state gauge at expose time (Scheduler.expose_metrics)
+        self.tpu_seam_events = cbm.Counter(
+            "scheduler_tpu_seam_events_total",
+            "Remote TPU seam events observed by the scheduling loop "
+            "(batch_failures, requeued_pods).",
+            labels=("event",))
+        self.tpu_seam_state = cbm.Gauge(
+            "scheduler_tpu_seam_state",
+            "Cumulative remote-seam resilience counters (retries, resyncs, "
+            "state_lost, failovers, recloses...), snapshotted from the "
+            "batch backend at expose time.",
+            labels=("counter",))
+        self.tpu_seam_breaker = cbm.Gauge(
+            "scheduler_tpu_seam_breaker_open",
+            "Circuit-breaker state per backend rung (1 = open/failed over).",
+            labels=("rung",))
         r.must_register(
             self.schedule_attempts, self.scheduling_attempt_duration,
             self.scheduling_algorithm_duration, self.pod_scheduling_duration,
@@ -100,7 +119,9 @@ class Metrics:
             self.queue_incoming_pods, self.preemption_attempts,
             self.preemption_victims, self.cache_size,
             self.unschedulable_reasons, self.goroutines,
-            self.tpu_batch_size, self.tpu_device_duration)
+            self.tpu_batch_size, self.tpu_device_duration,
+            self.tpu_seam_events, self.tpu_seam_state,
+            self.tpu_seam_breaker)
 
     def expose(self) -> str:
         return self.registry.expose()

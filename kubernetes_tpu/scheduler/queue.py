@@ -344,6 +344,29 @@ class SchedulingQueue:
                     batch.append(qpi)
         return batch
 
+    def requeue_backoff(self, qpis: Iterable[QueuedPodInfo]) -> None:
+        """Return a popped batch whose BACKEND failed (remote seam down,
+        device lost — see scheduler.BackendUnavailableError) to the backoff
+        tier.
+
+        Unlike add_unschedulable_if_not_present this records no per-pod
+        failure: the pods were never scheduled against, so they keep their
+        unschedulable_plugins and are not parked.  attempts was already
+        incremented by pop/pop_batch, so the refreshed timestamp makes each
+        pod wait out its exponential backoff before the flush loop moves it
+        back to activeQ — a dead seam cannot spin the scheduling loop hot."""
+        with self._cond:
+            now = time.monotonic()
+            for qpi in qpis:
+                key = qpi.key
+                if (key in self._active or key in self._backoff
+                        or key in self._unschedulable):
+                    continue  # re-added by an event while the batch was out
+                qpi.timestamp = now
+                self._backoff.push(qpi)
+            # no notify: nothing landed in activeQ (the flush loop promotes
+            # pods as their backoff expires)
+
     def add_unschedulable_if_not_present(self, qpi: QueuedPodInfo,
                                          pod_scheduling_cycle: int) -> None:
         """Park a pod that failed scheduling (scheduling_queue.go:374).

@@ -140,6 +140,17 @@ class SchedulerMetrics:
         return self.prom.expose()
 
 
+class BackendUnavailableError(RuntimeError):
+    """A batch backend failed for reasons unrelated to the pods in the
+    batch — worker/transport failure, device loss, retries exhausted
+    (raised by the remote seam's error ladder, ops/remote.py).
+
+    This is NEVER a per-pod scheduling verdict: the scheduler returns the
+    whole batch to the queue's backoff tier (requeue_backoff) and keeps
+    running, instead of marking pods unschedulable or letting the loop
+    thread die with the exception."""
+
+
 class BatchBackend:
     """Contract for the TPU batch path (implemented by ops/backend.py and
     parallel/backend.py).
@@ -262,6 +273,23 @@ class Scheduler:
             self.metrics.prom.pending_pods.set(n, queue)
         for typ, n in self.cache.stats().items():
             self.metrics.prom.cache_size.set(n, typ)
+        # remote-seam resilience counters live on the backend (retries,
+        # resyncs, failovers, breaker state); snapshot them into gauges at
+        # pull time — the cheap direction for a hot dispatch path
+        for profile in self.profiles.values():
+            backend = profile.batch_backend
+            if backend is None:
+                continue
+            snap_fn = getattr(backend, "seam_snapshot", None)
+            stats = (snap_fn() if snap_fn is not None
+                     else getattr(backend, "seam_stats", None))
+            if stats:
+                for counter, v in stats.items():
+                    self.metrics.prom.tpu_seam_state.set(float(v), counter)
+            breaker_fn = getattr(backend, "breaker_state", None)
+            if breaker_fn is not None:
+                for rung, v in breaker_fn().items():
+                    self.metrics.prom.tpu_seam_breaker.set(float(v), rung)
         return self.metrics.expose()
 
     # -- event handlers (eventhandlers.go:249) ---------------------------
@@ -900,6 +928,20 @@ class Scheduler:
         for q in deferred:
             self.schedule_one(q)
 
+    def _requeue_batch(self, live: list[QueuedPodInfo],
+                       err: BackendUnavailableError) -> None:
+        """Backend (not pod) failure: the whole batch re-enters the queue's
+        backoff tier.  attempts was already incremented at pop, so a batch
+        that keeps hitting a dead seam backs off exponentially per pod;
+        nothing is dropped and no pod is marked unschedulable or status-
+        patched (the failure is not the pod's fault)."""
+        logger.warning("batch backend unavailable (%s); requeueing %d pods "
+                       "into backoff", err, len(live))
+        self.queue.requeue_backoff(live)
+        self.metrics.prom.tpu_seam_events.inc(1.0, "batch_failures")
+        self.metrics.prom.tpu_seam_events.inc(float(len(live)),
+                                              "requeued_pods")
+
     def _dispatch_batch(self, profile: Profile, batch: list[QueuedPodInfo]):
         """Pre-process a batch and dispatch it to the device (async).
 
@@ -954,15 +996,20 @@ class Scheduler:
         if stagelat.ENABLED:
             stagelat.record("queue_wait",
                             sum(start - q.timestamp for q in live) / len(live))
-        resolve = backend.dispatch([q.pod_info for q in live], view)
-        if resolve is FLUSH_FIRST:
-            # the batch needs device-state repair; drain the in-flight batch
-            # and its tail (so the authoritative state catches up), then
-            # re-dispatch clean
-            self._flush_pending()
+        try:
             resolve = backend.dispatch([q.pod_info for q in live], view)
-            if resolve is FLUSH_FIRST:  # pragma: no cover - nothing in flight
-                raise RuntimeError("backend demanded flush with empty pipeline")
+            if resolve is FLUSH_FIRST:
+                # the batch needs device-state repair; drain the in-flight
+                # batch and its tail (so the authoritative state catches
+                # up), then re-dispatch clean
+                self._flush_pending()
+                resolve = backend.dispatch([q.pod_info for q in live], view)
+                if resolve is FLUSH_FIRST:  # pragma: no cover - nothing in flight
+                    raise RuntimeError(
+                        "backend demanded flush with empty pipeline")
+        except BackendUnavailableError as e:
+            self._requeue_batch(live, e)
+            return None
         if stagelat.ENABLED:
             # covers the FLUSH_FIRST re-dispatch too (the flush drain time
             # lands here rather than in pipeline_wait)
@@ -983,7 +1030,11 @@ class Scheduler:
         guaranteed-update per pod."""
         fw = profile.framework
         t_enter = time.monotonic()
-        results = resolve()
+        try:
+            results = resolve()
+        except BackendUnavailableError as e:
+            self._requeue_batch(live, e)
+            return
         resolve_block = time.monotonic() - t_enter
         # Adapt the eager-retirement flight estimate HERE, whichever
         # path retired the batch (eager gate, depth overflow, queue-empty

@@ -17,3 +17,16 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # registered here because the repo carries no pytest.ini; without this,
+    # -m 'not slow' (the tier-1 selector) relies on unregistered markers
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running scheduling/e2e tests, excluded from tier-1 "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests for the remote TPU seam "
+        "(tests/test_chaos_seam.py; deterministic, seeded)")

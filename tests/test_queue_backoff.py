@@ -1,0 +1,104 @@
+"""Backoff-tier coverage for the scheduling queue's batch path
+(pkg/scheduler/backend/queue precedent; ISSUE satellite: failed batches
+re-enter backoff).
+
+requeue_backoff is the seam-failure path (scheduler catches
+BackendUnavailableError and returns the WHOLE popped batch): the pods
+must land in the backoff tier with their pop-incremented attempts, stay
+un-poppable until their exponential backoff expires, and then flow back
+through active without duplication.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.scheduler.queue import SchedulingQueue
+from kubernetes_tpu.testing import make_pod
+
+
+def new_queue(initial=0.1, maximum=0.4):
+    return SchedulingQueue(pod_initial_backoff=initial,
+                           pod_max_backoff=maximum)
+
+
+def add_pods(q, n, prefix="p"):
+    for i in range(n):
+        q.add(make_pod(f"{prefix}{i}").build())
+
+
+class TestRequeueBackoff:
+    def test_requeued_batch_lands_in_backoff_with_attempts(self):
+        q = new_queue()
+        add_pods(q, 4)
+        batch = q.pop_batch(4, timeout=1.0)
+        assert len(batch) == 4
+        assert all(b.attempts == 1 for b in batch)  # incremented at pop
+        q.requeue_backoff(batch)
+        assert q.stats() == {"active": 0, "backoff": 4, "unschedulable": 0}
+        # attempts are preserved (NOT bumped again — the backend failed,
+        # not the pods)
+        assert all(b.attempts == 1 for b in batch)
+
+    def test_not_re_popped_before_backoff_expires(self):
+        q = new_queue(initial=0.2)
+        add_pods(q, 3)
+        batch = q.pop_batch(3, timeout=1.0)
+        q.requeue_backoff(batch)
+        # backoff pods are not in active, and the flush loop isn't even
+        # running: an immediate pop must come up empty
+        assert q.pop_batch(3, timeout=0.05) == []
+
+    def test_flush_promotes_after_expiry(self):
+        q = new_queue(initial=0.1)
+        q.run()  # starts the backoff flush loop
+        try:
+            add_pods(q, 3)
+            batch = q.pop_batch(3, timeout=1.0)
+            q.requeue_backoff(batch)
+            deadline = time.time() + 5.0
+            again = []
+            while time.time() < deadline and len(again) < 3:
+                again.extend(q.pop_batch(3, timeout=0.1))
+            assert sorted(b.key for b in again) == sorted(
+                b.key for b in batch)
+            assert all(b.attempts == 2 for b in again)  # pop bumped again
+        finally:
+            q.close()
+
+    def test_backoff_duration_doubles_per_attempt(self):
+        q = new_queue(initial=0.1, maximum=10.0)
+        add_pods(q, 1)
+        [qpi] = q.pop_batch(1, timeout=1.0)
+        assert q._backoff_duration(qpi) == pytest.approx(0.1)
+        qpi.attempts = 3  # as if popped three times
+        assert q._backoff_duration(qpi) == pytest.approx(0.4)
+        qpi.attempts = 20
+        assert q._backoff_duration(qpi) == 10.0  # capped
+
+    def test_requeue_skips_pods_already_readmitted(self):
+        """An add event (pod update) racing the failed batch wins: the
+        requeue must not shadow the fresher copy with a stale one."""
+        q = new_queue()
+        add_pods(q, 2)
+        batch = q.pop_batch(2, timeout=1.0)
+        q.add(make_pod("p0").build())  # event re-adds one pod to active
+        q.requeue_backoff(batch)
+        st = q.stats()
+        assert st["active"] == 1   # the re-added copy
+        assert st["backoff"] == 1  # only the pod NOT re-added
+        # and p0 pops exactly once
+        popped = q.pop_batch(4, timeout=0.1)
+        assert [p.key for p in popped] == ["default/p0"]
+
+    def test_requeue_timestamp_refreshed(self):
+        """The backoff clock starts at requeue time, not at the original
+        enqueue — otherwise a long-running batch would requeue with its
+        backoff already expired."""
+        q = new_queue(initial=5.0)
+        add_pods(q, 1)
+        [qpi] = q.pop_batch(1, timeout=1.0)
+        before = qpi.timestamp
+        time.sleep(0.05)
+        q.requeue_backoff([qpi])
+        assert qpi.timestamp > before

@@ -92,6 +92,38 @@ def test_pause_is_an_independent_design():
         assert len(shared) <= 2, f"too much line overlap with reference: {shared}"
 
 
+def test_network_calls_carry_timeouts():
+    """Robustness invariant (ISSUE: fault-tolerant seam): every blocking
+    network call under kubernetes_tpu/ must carry an explicit timeout — a
+    bare urlopen/create_connection hangs a scheduler thread forever when
+    the peer stalls, which no retry/breaker layer can see, let alone fix.
+    (gRPC calls pass timeout= per call in ops/remote.py; this audits the
+    stdlib paths.)"""
+    import re
+
+    pat = re.compile(r"(?:urlopen|create_connection)\s*\(")
+    offenders = []
+    for path in sorted(ROOT.rglob("*.py")):
+        text = path.read_text()
+        for m in pat.finditer(text):
+            # walk the balanced parens to capture the full argument span
+            depth, i = 0, m.end() - 1
+            while i < len(text):
+                if text[i] == "(":
+                    depth += 1
+                elif text[i] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                i += 1
+            args = text[m.end():i]
+            if "timeout" not in args:
+                line = text.count("\n", 0, m.start()) + 1
+                offenders.append(f"{path.relative_to(ROOT.parent)}:{line}")
+    assert not offenders, (
+        f"network calls without an explicit timeout: {offenders}")
+
+
 def test_controller_registry_complete():
     """Every controller module's Controller subclass is constructible from
     the manager's registry (a new controller that isn't wired in is dead
