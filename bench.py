@@ -362,6 +362,75 @@ def run_seam_micro(kind: str = "grpc", faulty: bool = False) -> dict:
                                      2), **detail}
 
 
+def run_trace(out_path: str | None = None) -> dict:
+    """--trace mode: a 5k-pod SchedulingBasicLarge pass over the gRPC
+    DeviceWorker seam with full head-sampling, written as Chrome
+    trace-event JSON (chrome://tracing / Perfetto), then the identical
+    pass untraced to report the overhead honestly.
+
+    The export carries both sides of the seam: scheduler-process spans
+    (schedule_batch > queue.pop / snapshot.flatten / plugin.* / tpu.* /
+    bind) and worker-process spans (worker./step...) parented into the
+    same traces via the propagated traceparent."""
+    import copy
+
+    from kubernetes_tpu.component_base import tracing
+    from kubernetes_tpu.perf import (
+        caps_for_nodes, load_workloads, run_named_workload,
+    )
+    from kubernetes_tpu.perf.scheduler_perf import is_measured
+
+    nodes = int(os.environ.get("BENCH_TRACE_NODES", "1000"))
+    pods = int(os.environ.get("BENCH_TRACE_PODS", "5000"))
+    batch = int(os.environ.get("BENCH_TRACE_BATCH", "1024"))
+    out_path = out_path or os.environ.get(
+        "BENCH_TRACE_OUT", "trace_SchedulingBasicLarge.json")
+
+    def build_cfg() -> dict:
+        cfg = copy.deepcopy(load_workloads()["SchedulingBasicLarge"])
+        tpl = cfg["workloadTemplate"]
+        for op in tpl:
+            if op["opcode"] == "createNodes":
+                op["count"] = nodes
+            elif op["opcode"] == "createPods" and is_measured(op, tpl):
+                op["count"] = pods
+            elif op["opcode"] == "barrier":
+                op["timeout"] = 600.0
+        return cfg
+
+    caps = caps_for_nodes(nodes)
+    provider = tracing.TracerProvider(sampling_rate_per_million=1_000_000,
+                                      max_spans=65536, max_traces=8192)
+    summary_t, stats_t = run_named_workload(
+        build_cfg(), tpu=True, caps=caps, batch_size=batch,
+        pipeline_depth=2, remote_seam="grpc", tracing_provider=provider)
+    spans = provider.snapshot() + list(stats_t.get("worker_spans") or ())
+    with open(out_path, "w") as f:
+        json.dump(tracing.to_chrome_trace(spans), f)
+    summary_u, _ = run_named_workload(
+        build_cfg(), tpu=True, caps=caps, batch_size=batch,
+        pipeline_depth=2, remote_seam="grpc")
+    span_names: dict[str, int] = {}
+    for s in spans:
+        span_names[s.name] = span_names.get(s.name, 0) + 1
+    worker_parented = sum(1 for s in spans
+                          if s.name.startswith("worker.")
+                          and s.parent_span_id is not None)
+    traced = summary_t.average
+    untraced = summary_u.average
+    return {
+        "nodes": nodes, "pods": pods, "batch": batch,
+        "trace_file": os.path.abspath(out_path),
+        "events": len(spans),
+        "span_names": dict(sorted(span_names.items())),
+        "worker_spans_parented": worker_parented,
+        "traced_pods_per_s": round(traced, 1),
+        "untraced_pods_per_s": round(untraced, 1),
+        "overhead_ratio": round(untraced / max(traced, 1e-9), 3),
+        "barrier_ok": stats_t.get("barrier_ok", False),
+    }
+
+
 def run_once(workload: str, nodes: int | None, pods: int | None,
              batch: int, barrier_timeout: float = 900.0,
              rate: float | None = None, depth: int = 1,
@@ -527,6 +596,15 @@ def _config_env(c: dict) -> dict:
 def main() -> None:
     if os.environ.get("_BENCH_CHILD") == "1":
         child_main()
+        return
+    if "--trace" in sys.argv:
+        # in-process by design: the Chrome export needs the scheduler's
+        # and the in-process worker's span rings in one interpreter
+        idx = sys.argv.index("--trace")
+        out = (sys.argv[idx + 1] if len(sys.argv) > idx + 1
+               and not sys.argv[idx + 1].startswith("-") else None)
+        res = run_trace(out)
+        emit(res["traced_pods_per_s"], {"mode": "trace", **res})
         return
     if not _device_reachable():
         # The chip tunnel is down — but null-device configs measure the

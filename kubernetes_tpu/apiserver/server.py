@@ -627,6 +627,19 @@ class APIServer:
                 if path == "/configz":
                     self._send_json(200, configz.default_registry.snapshot())
                     return
+                if path == "/debug/traces":
+                    # recent batch traces from the process-wide flight
+                    # recorder (component_base/tracing.py); empty list
+                    # when tracing is off or nothing was sampled
+                    from ..component_base import tracing
+                    body = tracing.default_tracer_provider \
+                        .debug_traces_json().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path == "/metrics":
                     with server._metrics_lock:
                         lines = [f"apiserver_{k} {v}"

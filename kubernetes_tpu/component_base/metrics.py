@@ -34,8 +34,11 @@ def linear_buckets(start: float, width: float, count: int) -> List[float]:
 def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
     if not names:
         return ""
+    # exposition format escapes backslash, double-quote AND newline in
+    # label values (a raw newline would split the sample line in two)
     inner = ",".join(
-        '%s="%s"' % (n, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        '%s="%s"' % (n, str(v).replace("\\", "\\\\").replace('"', '\\"')
+                     .replace("\n", "\\n"))
         for n, v in zip(names, values))
     return "{" + inner + "}"
 

@@ -37,6 +37,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..component_base import tracing
 from ..models.assign import (
     ALL_FEATURES, PLAIN_FEATURES, STATE_KEYS, PackSpec,
     build_packed_assign_fn, pack_pod_batch,
@@ -45,8 +46,9 @@ from ..scheduler.cache import Snapshot
 from ..scheduler.scheduler import BatchBackend
 from ..scheduler.types import ERROR, SKIP, UNSCHEDULABLE, PodInfo, Status
 from .flatten import (
-    BatchEncoder, Caps, ClusterTensors, PodBatch, VocabFullError,
-    slice_pod_batch,
+    C_AFFINITY, C_ANTI_AFFINITY, C_PREF_AFFINITY, C_SPREAD_HARD,
+    C_SPREAD_SCORE, BatchEncoder, Caps, ClusterTensors, PodBatch,
+    VocabFullError, slice_pod_batch,
 )
 
 logger = logging.getLogger(__name__)
@@ -132,6 +134,18 @@ def _apply_sel_patch(sel, rows, label_v, key_v, dom_sg_v, dom_asg_v):
 # device-side accounting.  The caller must resolve the in-flight batch and
 # finish its tail (so the authoritative tensors catch up), then re-dispatch.
 FLUSH_FIRST = object()
+
+
+def _trace_parent():
+    """The scheduler-installed batch root span for THIS thread (see
+    component_base/tracing use_span), or None when the pipeline is
+    untraced or the root was not sampled — callers then skip every span
+    and attribute computation, so tracing off costs nothing on the
+    dispatch path."""
+    span = tracing.current_span()
+    if span is None or not span.sampled:
+        return None
+    return span
 
 
 def decode_results(assignments, n: int, batch_size: int, escapes: set,
@@ -380,6 +394,14 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         self._last_epoch: int | None = None
         self.stats = {"batches": 0, "full_refresh": 0, "patched_rows": 0,
                       "waves": 0, "flush_first": 0}
+        # batch-telemetry drains (scheduler._finish_batch): per-(plugin,
+        # reason) escape tallies applied as Counter DELTAS (inc-only), and
+        # per-batch telemetry dicts (mask densities, feasible nodes,
+        # waves) for the gauge/histogram metrics.  Own lock: dispatch and
+        # resolve both hold self._lock while tallying.
+        self._esc_lock = threading.Lock()
+        self._escape_pending: dict[tuple[str, str], int] = {}
+        self._telemetry_pending: list[dict] = []
 
     # -- device sync -----------------------------------------------------
 
@@ -562,6 +584,106 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         self._mirror_from_tensors(cd_sg, cd_asg)
         self.stats["full_refresh"] += 1
 
+    # -- batch telemetry (observability PR) ------------------------------
+
+    def _tally_escape_pairs(self, pairs: dict) -> None:
+        with self._esc_lock:
+            pend = self._escape_pending
+            for key, cnt in pairs.items():
+                pend[key] = pend.get(key, 0) + cnt
+
+    def _tally_batch_escapes(self, batch: PodBatch, n: int,
+                             assignments=None) -> None:
+        """Accumulate this batch's per-(plugin, reason) escape counts.
+        Encoder escapes carry their reason from flatten.escape_reasons;
+        collided-bucket no-fit re-proofs (decode_results nofit_escapes)
+        are attributed to the encoder's shared-bucket transport."""
+        pend: dict = {}
+        esc = set(batch.escape)
+        for i in esc:
+            if i < n:
+                key = (batch.escape_reasons.get(i)
+                       or ("BatchEncoder", "unencodable"))
+                pend[key] = pend.get(key, 0) + 1
+        for i in set(batch.nofit_oracle):
+            if (i < n and i not in esc and i < self.batch_size
+                    and (assignments is None or assignments[i] < 0)):
+                key = ("BatchEncoder", "bucket_collision")
+                pend[key] = pend.get(key, 0) + 1
+        if pend:
+            self._tally_escape_pairs(pend)
+
+    def drain_escape_reasons(self) -> dict:
+        """Pop the pending {(plugin, reason): count} escape tallies; the
+        scheduler incs scheduler_tpu_escape_total by these deltas."""
+        with self._esc_lock:
+            out, self._escape_pending = self._escape_pending, {}
+        return out
+
+    def drain_batch_telemetry(self) -> list[dict]:
+        """Pop the pending per-batch telemetry dicts ({feasible_nodes,
+        mask_density, waves, pods}) for the scheduler's gauge/histogram
+        updates."""
+        with self._esc_lock:
+            out, self._telemetry_pending = self._telemetry_pending, []
+        return out
+
+    def _mask_densities(self, batch: PodBatch, n: int) -> dict[str, float]:
+        """Per-plugin-family constraint-mask density: the fraction of the
+        batch's live slots carrying an active mask for that family.  The
+        device kernel fuses filter+score, so these host-side numbers are
+        what 'how selective was this batch' means per plugin."""
+        nl = max(1, min(n, self.batch_size))
+
+        def rows(a):
+            if a is None:
+                return None
+            return (a[:nl].reshape(nl, -1) != 0).any(axis=1)
+
+        def dens(*arrays):
+            acc = None
+            for a in arrays:
+                r = rows(a)
+                if r is not None:
+                    acc = r if acc is None else (acc | r)
+            return float(acc.sum()) / nl if acc is not None else 0.0
+
+        def kind_dens(*kinds):
+            if batch.c_kind is None:
+                return 0.0
+            ck = batch.c_kind[:nl]
+            acc = np.zeros(nl, bool)
+            for k in kinds:
+                acc |= (ck == k).any(axis=1)
+            return float(acc.sum()) / nl
+
+        out = {
+            "NodeAffinity": dens(batch.sel_any_active, batch.key_any_active,
+                                 batch.sel_forb, batch.key_forb),
+            "InterPodAffinity": kind_dens(C_AFFINITY, C_ANTI_AFFINITY,
+                                          C_PREF_AFFINITY),
+            "PodTopologySpread": kind_dens(C_SPREAD_HARD, C_SPREAD_SCORE),
+            "TaintToleration": dens(batch.untol_hard, batch.untol_prefer),
+            "NodePorts": dens(batch.ports),
+        }
+        if batch.node_row is not None:
+            out["NodeName"] = float(
+                (batch.node_row[:nl] >= 0).sum()) / nl
+        return out
+
+    def _score_densities(self, batch: PodBatch, n: int) -> dict[str, float]:
+        """Score-phase twin of _mask_densities: the soft (weight-carrying)
+        terms the kernel's score accumulation reads."""
+        nl = max(1, min(n, self.batch_size))
+        out = {"preferred_affinity": 0.0, "prefer_no_schedule": 0.0}
+        if batch.c_weight is not None:
+            out["preferred_affinity"] = float(
+                (batch.c_weight[:nl] != 0).any(axis=1).sum()) / nl
+        if batch.untol_prefer is not None:
+            out["prefer_no_schedule"] = float(
+                (batch.untol_prefer[:nl] != 0).any(axis=1).sum()) / nl
+        return out
+
     # -- BatchBackend ----------------------------------------------------
 
     def dispatch(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot):
@@ -580,6 +702,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         tensors catch up with the mirror), then call dispatch again — the
         dirty rows from this attempt are carried over so no external change
         is lost."""
+        parent = _trace_parent()
         with self._lock:
             # epoch fast path: if every cache change since the last sync
             # came from this backend's own batches (bulk assume + confirm),
@@ -592,6 +715,9 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             skip_sync = (epoch is not None and self._state is not None
                          and epoch == self._last_epoch
                          and not self._carry_dirty)
+            f_sp = (parent.tracer.start_span("snapshot.flatten",
+                                             parent=parent)
+                    if parent is not None else None)
             try:
                 if skip_sync:
                     dirty = set()
@@ -608,8 +734,21 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 # the next successful dispatch
                 self._state = None
                 self._carry_dirty = set()
+                reason = ("constraint_capacity" if "constraint" in str(e)
+                          else "vocab_full")
+                self._tally_escape_pairs(
+                    {("BatchEncoder", reason): len(pod_infos)})
+                if f_sp is not None:
+                    f_sp.add_event("vocab_overflow", error=str(e))
+                    f_sp.end()
                 results = [(None, Status(SKIP, str(e)))] * len(pod_infos)
                 return lambda: results
+            if f_sp is not None:
+                f_sp.set_attribute("pods", len(pod_infos))
+                f_sp.set_attribute("escaped", len(batch.escape))
+                f_sp.set_attribute("dirty_rows", len(dirty))
+                f_sp.set_attribute("sync_skipped", bool(skip_sync))
+                f_sp.end()
 
             n_live = len(pod_infos)
             if n_live and not batch.p_valid[:min(n_live,
@@ -625,6 +764,9 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 self._carry_dirty = dirty
                 self.stats["all_escape_skips"] = self.stats.get(
                     "all_escape_skips", 0) + 1
+                self._tally_batch_escapes(batch, n_live)
+                if parent is not None:
+                    parent.add_event("all_escape_skip", pods=n_live)
                 results = [
                     (None, Status(SKIP, "escape to per-pod path"))
                     ] * n_live
@@ -636,6 +778,35 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     return results
 
                 return resolve_escaped
+
+            # per-plugin batch telemetry: filter-mask and score-term
+            # densities + the device feasibility domain, recorded as span
+            # attributes here and queued (at resolve, with the wave count)
+            # for the scheduler's tpu_mask_density / tpu_feasible_nodes
+            # metrics.  The device kernel fuses filter/score/solve in one
+            # launch, so these two spans time the host-side telemetry
+            # pass over the per-phase inputs — the solve span below is
+            # the device-time phase.
+            fm_sp = (parent.tracer.start_span("plugin.filter_masks",
+                                              parent=parent)
+                     if parent is not None else None)
+            telem = {
+                "pods": n_live,
+                "feasible_nodes": int(self.tensors.valid.sum()),
+                "mask_density": self._mask_densities(batch, n_live),
+            }
+            if fm_sp is not None:
+                fm_sp.set_attribute("feasible_nodes",
+                                    telem["feasible_nodes"])
+                for plugin, d in telem["mask_density"].items():
+                    fm_sp.set_attribute(plugin, round(d, 4))
+                fm_sp.end()
+            sc_sp = (parent.tracer.start_span("plugin.score", parent=parent)
+                     if parent is not None else None)
+            if sc_sp is not None:
+                for term, d in self._score_densities(batch, n_live).items():
+                    sc_sp.set_attribute(term, round(d, 4))
+                sc_sp.end()
 
             inflight = bool(self._unresolved)
             static_changed = self._static_version != self.tensors.static_version
@@ -672,6 +843,14 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
 
             import jax.numpy as jnp
             n = len(pod_infos)
+            # plugin.assign_solve spans launch -> resolve (device time,
+            # ended by resolve() below); tpu.h2d covers pack + upload +
+            # kernel enqueue inside it
+            solve_sp = (parent.tracer.start_span("plugin.assign_solve",
+                                                 parent=parent)
+                        if parent is not None else None)
+            h2d_sp = (parent.tracer.start_span("tpu.h2d", parent=solve_sp)
+                      if solve_sp is not None else None)
             if self._needs_full(batch) and n > self.full_cap:
                 # oversized constraint batch: chunk through the capped
                 # full kernel; resident state chains chunk to chunk, so
@@ -706,6 +885,13 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                                      patches[1])
                 chunks = [(self._device_step("plain", buf), 0,
                            self.batch_size)]
+            if h2d_sp is not None:
+                h2d_sp.set_attribute("chunks", len(chunks))
+                h2d_sp.set_attribute(
+                    "variant", "full" if self._needs_full(batch)
+                    else "plain")
+                h2d_sp.set_attribute("patched_rows", int(len(patches[0])))
+                h2d_sp.end()
             self.stats["batches"] += 1
             holder = object()
             self._unresolved.append(holder)
@@ -717,12 +903,20 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         was_full = self._needs_full(batch)
 
         def resolve() -> list[tuple[str | None, Status | None]]:
+            batch_waves = 0
             with self._lock:
                 assignments = np.full(self.batch_size, -1, np.int64)
+                d2h_sp = (solve_sp.tracer.start_span("tpu.d2h",
+                                                     parent=solve_sp)
+                          if solve_sp is not None else None)
                 for rd, lo, hi in chunks:
                     result = np.asarray(rd)  # blocking device pull
                     assignments[lo:hi] = result[:-1][:hi - lo]
-                    self.stats["waves"] += int(result[-1])
+                    batch_waves += int(result[-1])
+                if d2h_sp is not None:
+                    d2h_sp.set_attribute("chunks", len(chunks))
+                    d2h_sp.end()
+                self.stats["waves"] += batch_waves
                 self._replay(batch, assignments)
                 if was_full and self.FULL_MAIN_WAVES:
                     self._retry_stragglers(batch, assignments, n)
@@ -730,10 +924,19 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     self._unresolved.remove(holder)
                 except ValueError:  # pragma: no cover - double resolve
                     pass
+            if solve_sp is not None:
+                solve_sp.set_attribute("waves", batch_waves)
+                solve_sp.set_attribute("pods", n)
+                solve_sp.end()
             out = decode_results(assignments, n, self.batch_size,
                                  set(batch.escape), row_infos,
                                  "no feasible node (TPU batch filter)",
                                  nofit_escapes=set(batch.nofit_oracle))
+            self._tally_batch_escapes(batch, n, assignments)
+            telem["waves"] = batch_waves
+            with self._esc_lock:
+                self._telemetry_pending.append(telem)
+                del self._telemetry_pending[:-64]  # bounded drain queue
             record_batch_stats(self.stats, self._lock, out, n)
             return out
 

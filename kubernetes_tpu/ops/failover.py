@@ -257,6 +257,25 @@ class FailoverBatchBackend(BatchBackend):
                     total[key] = total.get(key, 0) + val
         return total
 
+    def drain_escape_reasons(self) -> dict:
+        """Summed per-(plugin, reason) escape tallies across rungs (the
+        scheduler applies them as scheduler_tpu_escape_total deltas)."""
+        out: dict = {}
+        for rung in self._rungs:
+            fn = getattr(rung.backend, "drain_escape_reasons", None)
+            if fn is not None:
+                for key, cnt in fn().items():
+                    out[key] = out.get(key, 0) + cnt
+        return out
+
+    def drain_batch_telemetry(self) -> list:
+        out: list = []
+        for rung in self._rungs:
+            fn = getattr(rung.backend, "drain_batch_telemetry", None)
+            if fn is not None:
+                out.extend(fn())
+        return out
+
     def breaker_state(self) -> dict[str, float]:
         with self._lock:
             return {r.name: 1.0 if r.breaker.is_open else 0.0

@@ -112,7 +112,8 @@ class FaultyTransport:
         self._lock = threading.Lock()
 
     def post(self, verb: str, body: bytes, *, timeout: float,
-             epoch: int | None = None, seq: int | None = None) -> bytes:
+             epoch: int | None = None, seq: int | None = None,
+             traceparent: str | None = None) -> bytes:
         with self._lock:
             i = self.calls
             self.calls += 1
@@ -129,7 +130,7 @@ class FaultyTransport:
             self.injected[DELAY] += 1
             time.sleep(self.schedule.delay_s)
         out = self.inner.post(verb, body, timeout=timeout, epoch=epoch,
-                              seq=seq)
+                              seq=seq, traceparent=traceparent)
         if act == CORRUPT:
             self.injected[CORRUPT] += 1
             return _corrupt(out)

@@ -851,6 +851,10 @@ class PodBatch:
     sel_forb_ids: np.ndarray = None   # i32[P, 8]
     key_ids: np.ndarray = None        # i32[P, KG, 4]
     escape: list[int] = field(default_factory=list)  # batch positions for oracle path
+    # position -> (plugin, reason) for every escape above: WHICH
+    # constraint term forced the pod off the device path (feeds
+    # scheduler_tpu_escape_total and the batch span attributes)
+    escape_reasons: dict = field(default_factory=dict)
     # positions whose constraints touch a COLLIDED bucket (shared sg/asg
     # slot): a no-fit verdict for these is an upper-bound artifact, not
     # proof — the scheduler re-proves them on the per-pod oracle instead
@@ -920,7 +924,7 @@ def gather_pod_batch(batch: "PodBatch", idx, p_cap: int) -> "PodBatch":
     ix = None if contiguous else np.asarray(idx, np.int64)
     fields = {}
     for f in dataclasses.fields(PodBatch):
-        if f.name in ("p_cap", "escape", "nofit_oracle"):
+        if f.name in ("p_cap", "escape", "escape_reasons", "nofit_oracle"):
             continue
         arr = getattr(batch, f.name)
         if arr is None:
@@ -937,11 +941,17 @@ def gather_pod_batch(batch: "PodBatch", idx, p_cap: int) -> "PodBatch":
     if contiguous:
         lo, hi = idx.start, idx.stop
         fields["escape"] = [e - lo for e in batch.escape if lo <= e < hi]
+        fields["escape_reasons"] = {e - lo: r for e, r
+                                    in batch.escape_reasons.items()
+                                    if lo <= e < hi}
         fields["nofit_oracle"] = [e - lo for e in batch.nofit_oracle
                                   if lo <= e < hi]
     else:
         pos = {orig: j for j, orig in enumerate(idx)}
         fields["escape"] = [pos[e] for e in batch.escape if e in pos]
+        fields["escape_reasons"] = {pos[e]: r for e, r
+                                    in batch.escape_reasons.items()
+                                    if e in pos}
         fields["nofit_oracle"] = [pos[e] for e in batch.nofit_oracle
                                   if e in pos]
     return PodBatch(p_cap=p_cap, **fields)
@@ -953,6 +963,15 @@ class BatchEncoder:
     def __init__(self, tensors: ClusterTensors, p_cap: int):
         self.t = tensors
         self.p_cap = p_cap
+        # (plugin, reason) for the pod currently failing _encode_pod —
+        # read by encode() when it routes the pod to the escape list
+        self._escape_reason: tuple | None = None
+
+    def _esc(self, plugin: str, reason: str) -> bool:
+        """Record why the in-flight pod can't be tensor-encoded and
+        return False (the _encode_pod escape convention)."""
+        self._escape_reason = (plugin, reason)
+        return False
 
     def encode(self, pod_infos: list[PodInfo]) -> PodBatch:
         t, c = self.t, self.t.caps
@@ -1004,6 +1023,8 @@ class BatchEncoder:
                     guard_all
                     or any(kv in guard_kv for kv in pi.labels.items())):
                 b.escape.append(i)
+                b.escape_reasons[i] = ("InterPodAffinity",
+                                       "namespace_selector")
                 continue
             if is_plain(pi):
                 b.p_valid[i] = True
@@ -1019,13 +1040,20 @@ class BatchEncoder:
                 self._encode_taints(b, i, pi)
                 continue
             try:
+                self._escape_reason = None
                 ok = self._encode_pod(b, i, pi)
-            except VocabFullError:
+            except VocabFullError as e:
                 ok = False
+                self._escape_reason = (
+                    "BatchEncoder",
+                    "constraint_capacity" if "constraint" in str(e)
+                    else "vocab_full")
             if ok:
                 b.p_valid[i] = True
             else:
                 b.escape.append(i)
+                b.escape_reasons[i] = (self._escape_reason
+                                       or ("BatchEncoder", "unencodable"))
         if len(t.ns_anti_kv) + int(t.ns_anti_complex) != guard_n0:
             # the guard armed during THIS encode: retroactively escape
             # earlier pods in the batch that the live check missed
@@ -1037,6 +1065,8 @@ class BatchEncoder:
                         kv in t.ns_anti_kv for kv in pi.labels.items()):
                     b.p_valid[i] = False
                     b.escape.append(i)
+                    b.escape_reasons[i] = ("InterPodAffinity",
+                                           "namespace_selector")
         # cross-pod: inc/match rows vs the registered groups — via the
         # exact-kv index (O(pod labels)) + the short complex-selector
         # scan, so 2000 per-service groups don't cost 2000 matches/pod
@@ -1149,16 +1179,17 @@ class BatchEncoder:
             self._arm_ns_anti_guard(pi)
             # namespaceSelector terms need per-cycle namespace-label
             # resolution (a lister) the tensor encoding does not carry
-            return False
+            return self._esc("InterPodAffinity", "namespace_selector")
         if pi.nominated_node_name:
-            return False  # preemption nominations go through the per-pod path
+            # preemption nominations go through the per-pod path
+            return self._esc("DefaultPreemption", "nominated_node")
         for v in (pi.pod.get("spec") or {}).get("volumes") or ():
             if (v.get("persistentVolumeClaim") or v.get("gcePersistentDisk")
                     or v.get("awsElasticBlockStore") or v.get("azureDisk")
                     or v.get("iscsi") or v.get("csi")):
                 # volume binding/zones/limits are deeply stateful (PVC/PV/
                 # StorageClass lookups + API writes at PreBind): oracle path
-                return False
+                return self._esc("VolumeBinding", "stateful_volume")
         # (core request columns were filled column-wise in encode();
         # scalar resources are rare enough to stay per-pod — and their
         # VocabFullError must route this pod to the escape path)
@@ -1177,7 +1208,7 @@ class BatchEncoder:
         if want:
             row = t.row_of.get(want)
             if row is None:
-                return False
+                return self._esc("NodeName", "unknown_node")
             b.ensure(c, "node_row")[i] = row
 
         # node selector + required node affinity -> any-of groups / forbidden
@@ -1191,14 +1222,15 @@ class BatchEncoder:
             if not enc:
                 return False
         if len(groups) > c.g_cap or len(key_groups) > c.kg_cap:
-            return False
+            return self._esc("NodeAffinity", "group_overflow")
         if groups:
             sel_ids = b.ensure(c, "sel_ids")
             sel_any_active = b.ensure(c, "sel_any_active")
             sel_any = b.ensure(c, "sel_any")
             for g, ids in enumerate(groups):
                 if len(ids) > sel_ids.shape[2]:
-                    return False  # any-of group too wide for packed transport
+                    # any-of group too wide for packed transport
+                    return self._esc("NodeAffinity", "group_overflow")
                 sel_any_active[i, g] = 1.0
                 for v, lid in enumerate(ids):
                     sel_any[i, g, lid] = 1.0
@@ -1209,20 +1241,22 @@ class BatchEncoder:
             key_any = b.ensure(c, "key_any")
             for g, ids in enumerate(key_groups):
                 if len(ids) > key_ids.shape[2]:
-                    return False
+                    return self._esc("NodeAffinity", "group_overflow")
                 key_any_active[i, g] = 1.0
                 for v, kid in enumerate(ids):
                     key_any[i, g, kid] = 1.0
                     key_ids[i, g, v] = kid
         if pi.node_affinity_preferred:
-            return False  # node-affinity scoring: oracle path (rare)
+            # node-affinity scoring: oracle path (rare)
+            return self._esc("NodeAffinity", "preferred_terms")
 
         # host ports
         if pi.host_ports:
             ports = b.ensure(c, "ports")
             for proto, ip, port in pi.host_ports:
                 if ip not in ("0.0.0.0", "", None):
-                    return False  # per-IP port semantics: oracle path
+                    # per-IP port semantics: oracle path
+                    return self._esc("NodePorts", "host_port_ip")
                 ports[i, t.port_vocab.get((proto, port))] = 1.0
 
         # constraints
@@ -1264,7 +1298,7 @@ class BatchEncoder:
             add_constraint(C_ANTI_AFFINITY, t.register_sg(sg,
                                                           shareable=True))
             if t.register_asg(sg) is None:
-                return False
+                return self._esc("InterPodAffinity", "anti_group_overflow")
         for term in pi.preferred_affinity_terms:
             sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
             # scoring only: inflation distorts a score, never legality
@@ -1287,7 +1321,8 @@ class BatchEncoder:
         """
         t = self.t
         if any(fields.requirements for _, fields in terms):
-            return False  # matchFields (metadata.name): oracle path
+            # matchFields (metadata.name): oracle path
+            return self._esc("NodeAffinity", "match_fields")
         if len(terms) == 1:
             lab, fields = terms[0]
             for req in lab.requirements:
@@ -1302,19 +1337,20 @@ class BatchEncoder:
                         b.ensure(t.caps, "sel_forb")[i, lid] = 1.0
                         if not self._push_id(b.ensure(t.caps, "sel_forb_ids"),
                                              i, lid):
-                            return False
+                            return self._esc("NodeAffinity",
+                                             "not_in_overflow")
                 elif req.operator == DOES_NOT_EXIST:
                     # key_forb travels as a dense bitmask; no id list needed
                     b.ensure(t.caps, "key_forb")[
                         i, t.ensure_key_id(req.key)] = 1.0
                 else:  # Gt/Lt
-                    return False
+                    return self._esc("NodeAffinity", "gt_lt_operator")
             return True
         union: list[int] = []
         for lab, fields in terms:
             reqs = lab.requirements
             if len(reqs) != 1 or reqs[0].operator != IN:
-                return False
+                return self._esc("NodeAffinity", "multi_term")
             for v in reqs[0].values:
                 union.append(t.ensure_label_id((reqs[0].key, v)))
         groups.append(union)

@@ -84,6 +84,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..component_base import metrics as cbm
+from ..component_base import tracing
 from ..scheduler.config import RemoteSeamPolicy
 from ..scheduler.scheduler import BackendUnavailableError
 from .backend import TPUBatchBackend
@@ -210,7 +212,14 @@ class _WorkerCore:
     State beyond the backend itself: `_epoch` (incarnation token; a
     client pinning a stale epoch gets `state_lost`) and the one-deep
     dedup cache `(_last_seq, _last_resp)` — the client is a single
-    ordered writer, so one slot makes every retried post exactly-once."""
+    ordered writer, so one slot makes every retried post exactly-once.
+
+    Tracing: each verb served under a propagated W3C traceparent opens a
+    `worker.<verb>` span in the worker's OWN TracerProvider, parented by
+    ids into the client-side batch trace (the head-sampling decision
+    travels in the traceparent flags, so the worker never re-samples).
+    The worker's flight recorder is served at /debug/traces on the HTTP
+    transport."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -218,6 +227,8 @@ class _WorkerCore:
         self._epoch = _new_epoch()
         self._last_seq: int | None = None
         self._last_resp = None
+        self.tracer_provider = tracing.TracerProvider()
+        self._tracer = self.tracer_provider.tracer("tpu-worker")
 
     def reset(self) -> None:
         """Simulate a crash+restart in place: resident state, kernels and
@@ -232,9 +243,29 @@ class _WorkerCore:
             self._last_resp = None
 
     def handle(self, path: str, body: bytes, epoch: int | None = None,
-               seq: int | None = None):
+               seq: int | None = None, traceparent: str | None = None):
         """Returns (payload, worker_epoch); raises WorkerError with an
-        error class on any failure."""
+        error class on any failure.  A sampled `traceparent` wraps the
+        verb in a worker-side span (malformed headers are ignored, per
+        the W3C spec — never fail the request over telemetry)."""
+        ctx = tracing.parse_traceparent(traceparent)
+        if ctx is None or not ctx.sampled:
+            return self._handle(path, body, epoch, seq)
+        with self._tracer.start_span(
+                "worker." + path.lstrip("/").split("?", 1)[0],
+                context=ctx) as span:
+            span.set_attribute("process", "worker")
+            span.set_attribute("verb", path)
+            span.set_attribute("bytes", len(body))
+            try:
+                return self._handle(path, body, epoch, seq)
+            except WorkerError as e:
+                span.add_event("worker_error", error_class=e.error_class,
+                               error=str(e))
+                raise
+
+    def _handle(self, path: str, body: bytes, epoch: int | None = None,
+                seq: int | None = None):
         with self._lock:
             if path == "/health":
                 # liveness + incarnation, served before /init and without
@@ -366,6 +397,22 @@ class DeviceWorker:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_GET(self):
+                # observability twins of the scheduler apiserver's
+                # endpoints: the Prometheus page and the span flight
+                # recorder (component_base/tracing debug_traces_json)
+                if self.path == "/debug/traces":
+                    self._reply(200, server._core.tracer_provider
+                                .debug_traces_json().encode())
+                elif self.path == "/metrics":
+                    self._reply(200, cbm.default_registry.expose().encode(),
+                                "text/plain; version=0.0.4")
+                elif self.path in ("/healthz", "/health"):
+                    self._reply(200, json.dumps(
+                        {"ok": True}).encode())
+                else:
+                    self._reply(404, b'{"error": "not found"}')
+
             def do_POST(self):
                 try:
                     epoch = self.headers.get("X-KTPU-Epoch")
@@ -373,7 +420,8 @@ class DeviceWorker:
                     out, w_epoch = server._core.handle(
                         self.path, self._body(),
                         epoch=int(epoch) if epoch is not None else None,
-                        seq=int(seq) if seq is not None else None)
+                        seq=int(seq) if seq is not None else None,
+                        traceparent=self.headers.get("X-KTPU-Traceparent"))
                 except WorkerError as e:
                     code = {E_STATE_LOST: 409, E_INVALID: 400}.get(
                         e.error_class, 500)
@@ -402,6 +450,12 @@ class DeviceWorker:
     @property
     def url(self) -> str:
         return f"http://{self.httpd.server_address[0]}:{self.port}"
+
+    @property
+    def tracer_provider(self):
+        """The worker-side span flight recorder (served at /debug/traces;
+        bench --trace merges it into the Chrome export)."""
+        return self._core.tracer_provider
 
     def start(self) -> "DeviceWorker":
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -469,7 +523,8 @@ class GrpcDeviceWorker:
                     out, _w_epoch = core.handle(
                         verb_path, request,
                         epoch=int(epoch) if epoch is not None else None,
-                        seq=int(seq) if seq is not None else None)
+                        seq=int(seq) if seq is not None else None,
+                        traceparent=md.get("ktpu-traceparent"))
                 except WorkerError as e:
                     logger.warning("tpu-worker(grpc): %s -> %s: %s",
                                    verb_path, e.error_class, e)
@@ -504,6 +559,11 @@ class GrpcDeviceWorker:
     def url(self) -> str:
         return f"grpc://{self._host}:{self.port}"
 
+    @property
+    def tracer_provider(self):
+        """See DeviceWorker.tracer_provider."""
+        return self._core.tracer_provider
+
     def start(self) -> "GrpcDeviceWorker":
         self._server.start()
         return self
@@ -530,12 +590,15 @@ class _HttpTransport:
         self.base_url = base_url
 
     def post(self, verb: str, body: bytes, *, timeout: float,
-             epoch: int | None = None, seq: int | None = None) -> bytes:
+             epoch: int | None = None, seq: int | None = None,
+             traceparent: str | None = None) -> bytes:
         headers = {"Content-Type": "application/octet-stream"}
         if epoch is not None:
             headers["X-KTPU-Epoch"] = str(epoch)
         if seq is not None:
             headers["X-KTPU-Seq"] = str(seq)
+        if traceparent is not None:
+            headers["X-KTPU-Traceparent"] = traceparent
         req = urllib.request.Request(self.base_url + verb, data=body,
                                      method="POST", headers=headers)
         try:
@@ -582,12 +645,15 @@ class _GrpcTransport:
             for name, path in _GRPC_VERBS.items()}
 
     def post(self, verb: str, body: bytes, *, timeout: float,
-             epoch: int | None = None, seq: int | None = None) -> bytes:
+             epoch: int | None = None, seq: int | None = None,
+             traceparent: str | None = None) -> bytes:
         md = []
         if epoch is not None:
             md.append(("ktpu-epoch", str(epoch)))
         if seq is not None:
             md.append(("ktpu-seq", str(seq)))
+        if traceparent is not None:
+            md.append(("ktpu-traceparent", traceparent))
         try:
             return self._calls[verb](body, timeout=timeout,
                                      metadata=tuple(md) or None)
@@ -702,9 +768,18 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
         return self._seq
 
     def _post_once(self, verb: str, body: bytes, seq: int | None) -> bytes:
+        # propagate the batch trace across the seam: the scheduler's
+        # current (root) span rides the post as a W3C traceparent, so the
+        # worker's verb spans parent into the client trace by ids —
+        # including after retries/resync, because every re-post reads the
+        # same thread-local root (no orphan traces)
+        span = tracing.current_span()
+        tp = (span.traceparent()
+              if span is not None and span.sampled else None)
         out = self._transport.post(verb, body,
                                    timeout=self.policy.timeout_for(verb),
-                                   epoch=self._epoch, seq=seq)
+                                   epoch=self._epoch, seq=seq,
+                                   traceparent=tp)
         try:
             return _unframe(out, verb)
         except CorruptFrameError:
@@ -735,6 +810,7 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
                     self.seam_stats["giveups"] += 1
                     raise
                 need_resync = True
+                self._seam_event("seam_resync", verb=verb, resync=resyncs)
                 # the failed post replays under a FRESH seq: the old
                 # seq's dedup slot died with the worker's state
                 seq = self._next_seq() if seq is not None else None
@@ -748,7 +824,17 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
                         verb, f"retries exhausted "
                         f"({p.max_retries}): {e}") from e
                 self.seam_stats["retries"] += 1
+                # retries are EVENTS on the live batch span, never new
+                # traces: the re-post inherits the same trace context
+                self._seam_event("seam_retry", verb=verb, attempt=attempt,
+                                 error_class=e.error_class)
                 time.sleep(p.backoff(attempt, self._rng))
+
+    @staticmethod
+    def _seam_event(name: str, **attrs) -> None:
+        span = tracing.current_span()
+        if span is not None and span.sampled:
+            span.add_event(name, **attrs)
 
     def _post(self, verb: str, body: bytes) -> bytes:
         """A state-mutating post: one seq for its lifetime (retries dedup

@@ -198,11 +198,19 @@ class PerfCluster:
     factory: SharedInformerFactory  # needs .watch()/.list())
     scheduler: Scheduler
     server: object = None       # APIServer when via_http
+    worker: object = None       # in-process DeviceWorker when remote_seam
     _tmpdir: object = None      # WAL dir lifetime
     _proc: object = None        # subprocess.Popen when via_http="process"
 
     def shutdown(self) -> None:
         self.scheduler.stop()
+        if self.worker is not None:
+            # after scheduler.stop(): the final flush still needs the seam
+            for p in self.scheduler.profiles.values():
+                close = getattr(p.batch_backend, "close", None)
+                if close is not None:
+                    close()
+            self.worker.stop()
         self.factory.stop()
         self.client.close()  # event-broadcaster thread
         if self.server is not None:
@@ -224,12 +232,21 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                   admission_interval: float = 0.0,
                   via_http: bool = False,
                   null_device: bool = False,
-                  percentage_of_nodes_to_score: int = 0) -> PerfCluster:
+                  percentage_of_nodes_to_score: int = 0,
+                  remote_seam: str | None = None,
+                  tracing_provider=None) -> PerfCluster:
     """mustSetupScheduler (util.go:79): in-proc everything, no kubelet.
 
     pipeline_depth/admission_interval select latency mode (scheduler.py):
     depth ~4 + a few-ms admission interval turns the batch path into
     overlapped micro-batches for p99-targeted runs.
+
+    remote_seam ("grpc" or "http") routes the batch backend through an
+    in-process DeviceWorker (ops/remote.py) instead of the in-process
+    jax backend — the shape bench --trace uses so worker-side spans
+    exercise the real traceparent propagation.  tracing_provider attaches
+    a component_base.tracing.TracerProvider to the scheduler
+    (configure_tracing); None leaves the pipeline untraced.
 
     via_http runs the FRONT DOOR: a real apiserver with RBAC +
     admission + WAL durability, and the scheduler (informers, binds,
@@ -313,6 +330,7 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
         store = store or kv.MemoryStore(history=1_000_000)
         client = LocalClient(store)
     factory = SharedInformerFactory(client)
+    worker = None
     if tpu:
         from ..ops.flatten import Caps
         if null_device:
@@ -321,6 +339,14 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
             from ..ops.nullbackend import NullBatchBackend
             backend = NullBatchBackend(caps or Caps(),
                                        batch_size=batch_size)
+        elif remote_seam:
+            from ..ops.remote import (
+                DeviceWorker, GrpcDeviceWorker, RemoteTPUBatchBackend,
+            )
+            worker = (GrpcDeviceWorker() if remote_seam == "grpc"
+                      else DeviceWorker()).start()
+            backend = RemoteTPUBatchBackend(worker.url, caps or Caps(),
+                                            batch_size=batch_size)
         else:
             from ..ops.backend import TPUBatchBackend
             backend = TPUBatchBackend(caps or Caps(), batch_size=batch_size)
@@ -334,11 +360,13 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                           admission_interval=admission_interval)
     else:
         sched = new_scheduler(client, factory)
+    if tracing_provider is not None:
+        sched.configure_tracing(tracing_provider)
     factory.start()
     factory.wait_for_cache_sync()
     sched.run()
     return PerfCluster(store, client, factory, sched, server=server,
-                       _tmpdir=tmpdir, _proc=proc)
+                       worker=worker, _tmpdir=tmpdir, _proc=proc)
 
 
 # -- workload ops (scheduler_perf_test.go opcodes) -------------------------
@@ -693,7 +721,9 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                        admission_interval: float = 0.0,
                        via_http: bool = False,
                        null_device: bool = False,
-                       percentage_of_nodes_to_score: int = 0
+                       percentage_of_nodes_to_score: int = 0,
+                       remote_seam: str | None = None,
+                       tracing_provider=None
                        ) -> tuple[ThroughputSummary, dict]:
     """Run one workload config end to end; returns (throughput, stats)."""
     cluster = setup_cluster(
@@ -701,7 +731,8 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         pipeline_depth=pipeline_depth,
         admission_interval=admission_interval,
         via_http=via_http, null_device=null_device,
-        percentage_of_nodes_to_score=percentage_of_nodes_to_score)
+        percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+        remote_seam=remote_seam, tracing_provider=tracing_provider)
     collector = ThroughputCollector(cluster.store)
     try:
         ops = config["workloadTemplate"]
@@ -725,6 +756,13 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         if stagelat.ENABLED:
             stats["stage_latency"] = stagelat.summary()
             stagelat.reset()  # don't bleed into the next workload
+        if tracing_provider is not None and cluster.worker is not None:
+            # worker-side spans (parented into the client trace via the
+            # propagated traceparent); the caller merges them with the
+            # scheduler provider's for the Chrome export
+            wp = getattr(cluster.worker, "tracer_provider", None)
+            if wp is not None:
+                stats["worker_spans"] = wp.snapshot()
         for p in cluster.scheduler.profiles.values():
             if p.batch_backend is not None:
                 stats["backend_stats"] = dict(p.batch_backend.stats)

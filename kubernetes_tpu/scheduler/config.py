@@ -33,6 +33,10 @@ list + the enabled/disabled merge rules).  Shape accepted (YAML or dict):
     maxRetries: 3
     failureThreshold: 3
     probeIntervalSeconds: 5
+  tracing:                       # batch-pipeline span sampling
+    samplingRatePerMillion: 10000  # (component_base/tracing.py; mirrors
+    maxSpans: 4096                 #  apiserver TracingConfiguration's
+    maxTraces: 256                 #  samplingRatePerMillion field)
 
 Merge semantics (default_plugins.go mergePlugins):
   1. start from the default MultiPoint list;
@@ -155,6 +159,47 @@ def _parse_remote_seam(data: dict) -> RemoteSeamPolicy:
 
 
 @dataclass
+class TracingPolicy:
+    """Batch-pipeline trace sampling (component_base/tracing.py).
+
+    Configured via the `tracing:` stanza; the field name mirrors the
+    upstream apiserver TracingConfiguration (samplingRatePerMillion).
+    Rate 0 (the default) disables tracing entirely — the scheduler never
+    attaches a tracer, so the hot path pays nothing."""
+
+    sampling_rate_per_million: int = 0
+    max_spans: int = 4096       # flight-recorder span ring bound
+    max_traces: int = 256       # /debug/traces trace ring bound
+
+    @property
+    def enabled(self) -> bool:
+        return self.sampling_rate_per_million > 0
+
+
+# tracing YAML key -> TracingPolicy field
+_TRACING_FIELDS = {
+    "samplingRatePerMillion": "sampling_rate_per_million",
+    "maxSpans": "max_spans",
+    "maxTraces": "max_traces",
+}
+
+
+def _parse_tracing(data: dict) -> TracingPolicy:
+    kwargs = {}
+    for key, value in (data or {}).items():
+        if key not in _TRACING_FIELDS:
+            raise ConfigError(f"unknown tracing key {key!r}")
+        kwargs[_TRACING_FIELDS[key]] = value
+    policy = TracingPolicy(**kwargs)
+    if not 0 <= policy.sampling_rate_per_million <= 1_000_000:
+        raise ConfigError(
+            "tracing samplingRatePerMillion must be in [0, 1000000]")
+    if policy.max_spans < 1 or policy.max_traces < 1:
+        raise ConfigError("tracing ring bounds must be >= 1")
+    return policy
+
+
+@dataclass
 class SchedulerConfig:
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 0
@@ -163,6 +208,7 @@ class SchedulerConfig:
     profiles: list[ProfileConfig] = field(default_factory=list)
     extenders: list[dict] = field(default_factory=list)
     remote_seam: RemoteSeamPolicy = field(default_factory=RemoteSeamPolicy)
+    tracing: TracingPolicy = field(default_factory=TracingPolicy)
 
 
 def load_config(source: str | dict) -> SchedulerConfig:
@@ -189,6 +235,7 @@ def load_config(source: str | dict) -> SchedulerConfig:
         pod_max_backoff=data.get("podMaxBackoffSeconds", 10.0),
         extenders=data.get("extenders") or [],
         remote_seam=_parse_remote_seam(data.get("remoteSeam")),
+        tracing=_parse_tracing(data.get("tracing")),
     )
     if cfg.parallelism <= 0:
         raise ConfigError("parallelism must be positive")
@@ -322,4 +369,14 @@ def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
     # RemoteTPUBatchBackend into a profile picks up the configured
     # deadlines/retry budget instead of the hard-coded defaults
     sched.remote_seam_policy = cfg.remote_seam
+    if cfg.tracing.enabled:
+        # the process-wide provider backs /debug/traces on the apiserver's
+        # HTTP mux; tests that want isolation construct their own provider
+        # and call configure_tracing directly
+        from ..component_base import tracing
+        tracing.default_tracer_provider.configure(
+            sampling_rate_per_million=cfg.tracing.sampling_rate_per_million,
+            max_spans=cfg.tracing.max_spans,
+            max_traces=cfg.tracing.max_traces)
+        sched.configure_tracing(tracing.default_tracer_provider)
     return sched

@@ -110,6 +110,30 @@ class Metrics:
             "scheduler_tpu_seam_breaker_open",
             "Circuit-breaker state per backend rung (1 = open/failed over).",
             labels=("rung",))
+        # batch-telemetry additions (observability PR): WHY pods leave the
+        # device batch path, and how selective each batch was.  The escape
+        # counter is drained from the backend's per-batch reason tallies in
+        # _finish_batch (Counter is inc-only, so the scheduler applies
+        # deltas, never snapshots).
+        self.tpu_escape_total = cbm.Counter(
+            "scheduler_tpu_escape_total",
+            "Pods escaped from the TPU batch path to the per-pod oracle, "
+            "by owning plugin and escape reason (e.g. namespace_selector).",
+            labels=("plugin", "reason"))
+        self.tpu_mask_density = cbm.Gauge(
+            "scheduler_tpu_mask_density",
+            "Fraction of batch pods carrying an active constraint mask for "
+            "a plugin family, from the most recent dispatched batch.",
+            labels=("plugin",))
+        self.tpu_feasible_nodes = cbm.Histogram(
+            "scheduler_tpu_feasible_nodes",
+            "Schedulable node rows per dispatched batch (the device "
+            "feasibility domain before per-pod filter masks).",
+            buckets=cbm.exponential_buckets(1, 4, 10))
+        self.tpu_batch_waves = cbm.Histogram(
+            "scheduler_tpu_batch_waves",
+            "Device assignment-solver waves per batch.",
+            buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512])
         r.must_register(
             self.schedule_attempts, self.scheduling_attempt_duration,
             self.scheduling_algorithm_duration, self.pod_scheduling_duration,
@@ -121,7 +145,9 @@ class Metrics:
             self.unschedulable_reasons, self.goroutines,
             self.tpu_batch_size, self.tpu_device_duration,
             self.tpu_seam_events, self.tpu_seam_state,
-            self.tpu_seam_breaker)
+            self.tpu_seam_breaker, self.tpu_escape_total,
+            self.tpu_mask_density, self.tpu_feasible_nodes,
+            self.tpu_batch_waves)
 
     def expose(self) -> str:
         return self.registry.expose()

@@ -33,6 +33,7 @@ from ..api.meta import Obj
 from ..client.clientset import Client, NODES, PODS
 from ..client.informer import SharedInformerFactory
 from ..store import kv
+from ..component_base import tracing
 from ..utils import fasthost, stagelat
 from . import metrics as _metrics
 from .cache import Cache, Snapshot
@@ -262,9 +263,25 @@ class Scheduler:
         self._deferred: list[QueuedPodInfo] = []  # per-pod pods awaiting a quiescent cache
         self._binder_pool = ThreadPoolExecutor(max_workers=16,
                                                thread_name_prefix="bind")
+        # distributed tracing (component_base/tracing.py): None until
+        # configure_tracing attaches a provider; sampling is decided once
+        # per batch at the root span and inherited everywhere below
+        self.tracer_provider: tracing.TracerProvider | None = None
+        self._tracer: tracing.Tracer | None = None
         self._next_start_node_index = 0
         self._threads: list[threading.Thread] = []
         self._wire_event_handlers()
+
+    def configure_tracing(self, provider) -> None:
+        """Attach a component_base.tracing.TracerProvider: each sampled
+        batch gets one root span ("schedule_batch") with queue/flatten/
+        H2D/filter/score/solve/D2H/bind children — the device-side ones
+        come from ops/backend.py via the thread-local current span, and
+        remote worker spans parent in through the propagated traceparent
+        (ops/remote.py).  Pass None to detach."""
+        self.tracer_provider = provider
+        self._tracer = (provider.tracer("scheduler")
+                        if provider is not None else None)
 
     def expose_metrics(self) -> str:
         """Refresh pull-time gauges (pending_pods, cache_size) and return
@@ -473,7 +490,9 @@ class Scheduler:
                 t = self.admission_interval
             else:
                 t = 0.0
+            t_pop0 = time.monotonic()
             batch = self.queue.pop_batch(batch_profile.batch_size, t)
+            t_pop1 = time.monotonic()
             mine: list[QueuedPodInfo] = []
             perpod: list[QueuedPodInfo] = []
             if batch:
@@ -497,7 +516,8 @@ class Scheduler:
                 for q in deferred + perpod:
                     self.schedule_one(q)
             if mine:
-                pending = self._dispatch_batch(batch_profile, mine)
+                pending = self._dispatch_batch(batch_profile, mine,
+                                               pop_window=(t_pop0, t_pop1))
                 if pending is not None:
                     self._pending.append(pending)
                 while len(self._pending) > self.pipeline_depth:
@@ -942,11 +962,12 @@ class Scheduler:
         self.metrics.prom.tpu_seam_events.inc(float(len(live)),
                                               "requeued_pods")
 
-    def _dispatch_batch(self, profile: Profile, batch: list[QueuedPodInfo]):
+    def _dispatch_batch(self, profile: Profile, batch: list[QueuedPodInfo],
+                        pop_window: tuple[float, float] | None = None):
         """Pre-process a batch and dispatch it to the device (async).
 
-        Returns (profile, live, resolve, cycle, start) for _finish_batch, or
-        None if nothing went to the device."""
+        Returns (profile, live, resolve, cycle, start, span) for
+        _finish_batch, or None if nothing went to the device."""
         from ..ops.backend import FLUSH_FIRST
         backend = profile.batch_backend
         if not backend.supports_pipelining:
@@ -956,6 +977,24 @@ class Scheduler:
             self._flush_pending()
         cycle = self.queue.scheduling_cycle()
         start = time.monotonic()
+        root: tracing.Span | None = None
+        if self._tracer is not None:
+            root = self._tracer.start_span("schedule_batch", start=start)
+            if not root.sampled:
+                root.end(start)
+                root = None
+            else:
+                root.set_attribute("process", "scheduler")
+                root.set_attribute("cycle", cycle)
+                root.set_attribute("pods", len(batch))
+                if pop_window is not None:
+                    # the pop happened before the root existed; backdate a
+                    # child over the measured window so the trace shows
+                    # time spent waiting on the queue
+                    pop_sp = self._tracer.start_span(
+                        "queue.pop", parent=root, start=pop_window[0])
+                    pop_sp.set_attribute("pods", len(batch))
+                    pop_sp.end(pop_window[1])
         live = [q for q in batch if not self._skip_schedule(q.pod)]
         gates = profile.framework.batch_gates
         if gates and live:
@@ -988,6 +1027,9 @@ class Scheduler:
             live = [q for q in live if q not in ext_pods]
             self._deferred.extend(ext_pods)
         if not live:
+            if root is not None:
+                root.add_event("no_live_pods")
+                root.end()
             return None
         # zero-copy flatten: the backend re-encodes dirty node rows straight
         # from cache NodeInfos under the cache lock — no Snapshot clone on
@@ -997,27 +1039,63 @@ class Scheduler:
             stagelat.record("queue_wait",
                             sum(start - q.timestamp for q in live) / len(live))
         try:
-            resolve = backend.dispatch([q.pod_info for q in live], view)
-            if resolve is FLUSH_FIRST:
-                # the batch needs device-state repair; drain the in-flight
-                # batch and its tail (so the authoritative state catches
-                # up), then re-dispatch clean
-                self._flush_pending()
+            # the thread-local current span is how the backend (and, via
+            # the propagated traceparent, the remote worker) parents its
+            # flatten/H2D/solve spans into this batch's trace without
+            # widening the BatchBackend dispatch signature
+            with tracing.use_span(root):
                 resolve = backend.dispatch([q.pod_info for q in live], view)
-                if resolve is FLUSH_FIRST:  # pragma: no cover - nothing in flight
-                    raise RuntimeError(
-                        "backend demanded flush with empty pipeline")
+                if resolve is FLUSH_FIRST:
+                    # the batch needs device-state repair; drain the
+                    # in-flight batch and its tail (so the authoritative
+                    # state catches up), then re-dispatch clean
+                    if root is not None:
+                        root.add_event("flush_first_redispatch")
+                    self._flush_pending()
+                    resolve = backend.dispatch(
+                        [q.pod_info for q in live], view)
+                    if resolve is FLUSH_FIRST:  # pragma: no cover - nothing in flight
+                        raise RuntimeError(
+                            "backend demanded flush with empty pipeline")
         except BackendUnavailableError as e:
+            if root is not None:
+                root.add_event("backend_unavailable", error=str(e))
+                root.end()
             self._requeue_batch(live, e)
             return None
         if stagelat.ENABLED:
             # covers the FLUSH_FIRST re-dispatch too (the flush drain time
             # lands here rather than in pipeline_wait)
             stagelat.record("dispatch_host", time.monotonic() - start)
-        return profile, live, resolve, cycle, start
+        return profile, live, resolve, cycle, start, root
+
+    def _drain_backend_telemetry(self, backend) -> None:
+        """Apply the backend's per-batch escape/telemetry tallies as metric
+        deltas.  Counter is inc-only, so the backend accumulates per-batch
+        (plugin, reason) counts and the scheduler drains them here — the
+        only writer of scheduler_tpu_escape_total."""
+        drain = getattr(backend, "drain_escape_reasons", None)
+        if drain is not None:
+            for (plugin, reason), cnt in drain().items():
+                self.metrics.prom.tpu_escape_total.inc(
+                    float(cnt), plugin, reason)
+        drain_t = getattr(backend, "drain_batch_telemetry", None)
+        if drain_t is not None:
+            for telem in drain_t():
+                fn = telem.get("feasible_nodes")
+                if fn is not None:
+                    self.metrics.prom.tpu_feasible_nodes.observe(float(fn))
+                waves = telem.get("waves")
+                if waves:
+                    self.metrics.prom.tpu_batch_waves.observe(float(waves))
+                for plugin, dens in (telem.get("mask_density") or {}).items():
+                    if dens is not None:
+                        self.metrics.prom.tpu_mask_density.set(
+                            float(dens), plugin)
 
     def _finish_batch(self, profile: Profile, live: list[QueuedPodInfo],
-                      resolve, cycle: int, start: float) -> None:
+                      resolve, cycle: int, start: float,
+                      span: tracing.Span | None = None) -> None:
         """Resolve a dispatched batch and run the assume -> Reserve ->
         Permit -> bind tail.
 
@@ -1031,8 +1109,15 @@ class Scheduler:
         fw = profile.framework
         t_enter = time.monotonic()
         try:
-            results = resolve()
+            # resolve() may retry/resync through the remote seam: the
+            # current span makes those show up as events on this batch's
+            # trace rather than orphans (ops/remote.py _seam_event)
+            with tracing.use_span(span):
+                results = resolve()
         except BackendUnavailableError as e:
+            if span is not None:
+                span.add_event("backend_unavailable", error=str(e))
+                span.end()
             self._requeue_batch(live, e)
             return
         resolve_block = time.monotonic() - t_enter
@@ -1067,6 +1152,7 @@ class Scheduler:
         if stagelat.ENABLED:
             stagelat.record("pipeline_wait", t_enter - start)
             stagelat.record("resolve_block", resolve_block)
+        self._drain_backend_telemetry(profile.batch_backend)
         t_phase = time.monotonic()
         bulk: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         # phase 1: collect placements; failures/escapes handled per pod
@@ -1118,6 +1204,13 @@ class Scheduler:
                 ok.append((qpi, node_name, assumed))
         if fit_failures:
             self._batch_preempt(profile, fw, fit_failures, cycle, start)
+        if span is not None:
+            # the bind child outlives the root on purpose (the binding
+            # cycle runs on the binder pool; id-parenting keeps it in the
+            # trace) — end the root here so its duration means
+            # dispatch -> results applied
+            span.set_attribute("placed", len(ok))
+            span.end()
         if not ok:
             return
         # turbo tail: with an empty CycleState the hook loops are provably
@@ -1126,7 +1219,7 @@ class Scheduler:
         # Reserve/Permit/WaitOnPermit/PreBind calls entirely
         if fw.batch_tail_trivial() and self._bulk_bindable(fw):
             self._submit_binding(self._binding_cycle_turbo, fw, ok, cycle,
-                                 start)
+                                 start, span)
             return
         for qpi, node_name, assumed in ok:
             state = CycleState()
@@ -1151,11 +1244,12 @@ class Scheduler:
                                      node_name, cycle, start)
         if bulk:
             self._submit_binding(self._binding_cycle_bulk, fw, bulk,
-                                 cycle, start)
+                                 cycle, start, span)
 
     def _binding_cycle_turbo(self, fw: Framework,
                              items: list[tuple[QueuedPodInfo, str, Obj]],
-                             cycle: int, start: float) -> None:
+                             cycle: int, start: float,
+                             span: tracing.Span | None = None) -> None:
         """Bind tail for the provably-trivial case (batch_tail_trivial +
         DefaultBinder): no per-pod plugin hook calls at all — straight to
         the shared bulk commit.  The shared empty CycleState is sound
@@ -1163,7 +1257,7 @@ class Scheduler:
         state = CycleState()
         self._bulk_bind_commit(
             fw, [(state, qpi, node, assumed) for qpi, node, assumed in items],
-            cycle, start, run_post_bind=False)
+            cycle, start, run_post_bind=False, span=span)
 
     def _submit_binding(self, fn, *args) -> None:
         """Submit a binding cycle to the pool; if the pool was shut down
@@ -1185,7 +1279,8 @@ class Scheduler:
 
     def _binding_cycle_bulk(self, fw: Framework,
                             items: list[tuple[CycleState, QueuedPodInfo, str, Obj]],
-                            cycle: int, start: float) -> None:
+                            cycle: int, start: float,
+                            span: tracing.Span | None = None) -> None:
         """Binding cycle for a whole batch: per-pod WaitOnPermit (immediate
         for everything routed here) and PreBind, then ONE bulk bind write,
         then per-pod PostBind/metrics/events.  Failure handling per pod is
@@ -1210,16 +1305,24 @@ class Scheduler:
                                    Status(ERROR, str(e)), cycle)
         if not ready:
             return
-        self._bulk_bind_commit(fw, ready, cycle, start, run_post_bind=True)
+        self._bulk_bind_commit(fw, ready, cycle, start, run_post_bind=True,
+                               span=span)
 
     def _bulk_bind_commit(self, fw: Framework,
                           ready: list[tuple[CycleState, QueuedPodInfo, str, Obj]],
                           cycle: int, start: float,
-                          run_post_bind: bool) -> None:
+                          run_post_bind: bool,
+                          span: tracing.Span | None = None) -> None:
         """Shared bind/confirm/metrics tail for the bulk paths: ONE bulk
         bind write, bulk cache confirm, bulk metrics/events; per-pod
         failure handling identical to _binding_cycle (Forget + unreserve +
         requeue)."""
+        bind_sp: tracing.Span | None = None
+        if span is not None and span.sampled:
+            # parent has usually already ended (id-parenting stays valid);
+            # this span runs on the binder pool thread
+            bind_sp = span.tracer.start_span("bind", parent=span)
+            bind_sp.set_attribute("pods", len(ready))
         bindings = [(meta.namespace(q.pod), meta.name(q.pod), node)
                     for _, q, node, _ in ready]
         t_phase = time.monotonic()
@@ -1239,6 +1342,9 @@ class Scheduler:
                 continue
             bound.append((state, qpi, node_name, assumed))
         if not bound:
+            if bind_sp is not None:
+                bind_sp.add_event("all_bindings_rejected")
+                bind_sp.end()
             return
         # pods ARE bound in the store at this point: a failure in the
         # confirm/PostBind tail must not abort the rest of the batch or
@@ -1267,3 +1373,6 @@ class Scheduler:
              for _, qpi, node_name, _ in bound])
         self.metrics.observe_attempts("scheduled", [latency] * len(bound),
                                       fw.profile_name)
+        if bind_sp is not None:
+            bind_sp.set_attribute("bound", len(bound))
+            bind_sp.end()

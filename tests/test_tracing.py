@@ -1,0 +1,615 @@
+"""Batch-pipeline tracing tests: the component-base tracing layer (W3C
+trace context, proportional head sampling, flight recorder, Chrome trace
+export), metrics exposition details it leans on, span topology through
+the TPU batch backend, and traceparent propagation across the remote
+worker seam (ops/remote.py, both transports).
+
+Runs on CPU with 8 virtual devices (tests/conftest.py).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.component_base import metrics as cbm
+from kubernetes_tpu.component_base import tracing
+from kubernetes_tpu.ops.backend import TPUBatchBackend
+from kubernetes_tpu.ops.flatten import Caps
+from kubernetes_tpu.ops.remote import RemoteTPUBatchBackend, transport_for
+from kubernetes_tpu.scheduler import Profile, Scheduler, new_default_framework
+from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler.types import PodInfo
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def wait_for(pred, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+@pytest.fixture(scope="module", params=["http", "grpc"])
+def worker(request):
+    if request.param == "grpc":
+        from kubernetes_tpu.ops.remote import GrpcDeviceWorker
+        w = GrpcDeviceWorker().start()
+    else:
+        from kubernetes_tpu.ops.remote import DeviceWorker
+        w = DeviceWorker().start()
+    yield w
+    w.stop()
+
+
+def snapshot_from(nodes, bound_pods=()):
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in bound_pods:
+        cache.add_pod(p)
+    return cache.update_snapshot(Snapshot())
+
+
+def small_caps(**kw):
+    defaults = dict(n_cap=16, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                    s_cap=2, sg_cap=8, asg_cap=8)
+    defaults.update(kw)
+    return Caps(**defaults)
+
+
+# -- sampling (the satellite fix) ------------------------------------------
+
+class TestSampling:
+    @pytest.mark.parametrize("rate,n", [(250_000, 1000), (500_000, 10),
+                                        (100_000, 50), (600_000, 100),
+                                        (333_333, 300)])
+    def test_kept_count_is_proportional(self, rate, n):
+        """Counter-proportional sampling: over the first n roots, exactly
+        floor(n * rate / 1e6) are kept (the old modulo form kept every
+        root at rate 600_000)."""
+        provider = tracing.TracerProvider(sampling_rate_per_million=rate)
+        tracer = provider.tracer("t")
+        kept = 0
+        for _ in range(n):
+            sp = tracer.start_span("root")
+            kept += 1 if sp.sampled else 0
+            sp.end()
+        assert kept == (n * rate) // 1_000_000
+        assert len(provider.snapshot()) == kept
+
+    def test_edge_rates(self):
+        off = tracing.TracerProvider(sampling_rate_per_million=0)
+        sp = off.tracer("t").start_span("x")
+        assert sp.sampled is False
+        sp.end()
+        assert sp.duration >= 0.0          # still works as a timer
+        assert off.snapshot() == []        # but is never recorded
+        full = tracing.TracerProvider(sampling_rate_per_million=1_000_000)
+        spans = [full.tracer("t").start_span("x") for _ in range(7)]
+        for s in spans:
+            assert s.sampled
+            s.end()
+        assert len(full.snapshot()) == 7
+
+    def test_children_inherit_not_resample(self):
+        provider = tracing.TracerProvider(sampling_rate_per_million=0)
+        tracer = provider.tracer("t")
+        root = tracer.start_span("root")
+        child = tracer.start_span("child", parent=root)
+        assert child.sampled is False and child.trace_id == root.trace_id
+        child.end(), root.end()
+        assert provider.snapshot() == []
+
+
+# -- W3C trace context ------------------------------------------------------
+
+class TestTraceparent:
+    def test_round_trip(self):
+        provider = tracing.TracerProvider()
+        root = provider.tracer("t").start_span("root")
+        hdr = root.traceparent()
+        assert hdr == f"00-{root.trace_id}-{root.span_id}-01"
+        ctx = tracing.parse_traceparent(hdr)
+        assert (ctx.trace_id, ctx.span_id, ctx.sampled) == (
+            root.trace_id, root.span_id, True)
+        root.end()
+
+    def test_unsampled_flag_round_trip(self):
+        provider = tracing.TracerProvider(sampling_rate_per_million=0)
+        root = provider.tracer("t").start_span("root")
+        assert root.traceparent().endswith("-00")
+        assert tracing.parse_traceparent(root.traceparent()).sampled is False
+        root.end()
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-abc-def-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # all-zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+        "00-" + "g" * 32 + "-" + "1" * 16 + "-01",   # non-hex
+        "00-" + "1" * 31 + "-" + "1" * 16 + "-01",   # short trace id
+        "00-" + "1" * 32 + "-" + "1" * 16,           # missing flags
+    ])
+    def test_malformed_headers_are_none(self, bad):
+        assert tracing.parse_traceparent(bad) is None
+
+    def test_remote_child_parents_into_propagated_context(self):
+        client = tracing.TracerProvider()
+        root = client.tracer("sched").start_span("schedule_batch")
+        ctx = tracing.parse_traceparent(root.traceparent())
+        workerp = tracing.TracerProvider()
+        child = workerp.tracer("worker").start_span("worker.step",
+                                                    context=ctx)
+        assert child.trace_id == root.trace_id
+        assert child.parent_span_id == root.span_id
+        assert child.sampled is True
+        child.end(), root.end()
+        assert [s.name for s in workerp.snapshot()] == ["worker.step"]
+
+
+# -- flight recorder --------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_bounds(self):
+        provider = tracing.TracerProvider(max_spans=10, max_traces=3)
+        tracer = provider.tracer("t")
+        roots = []
+        for i in range(5):
+            root = tracer.start_span(f"batch{i}")
+            for j in range(3):
+                tracer.start_span(f"c{j}", parent=root).end()
+            root.end()
+            roots.append(root)
+        assert len(provider.snapshot()) == 10          # newest max_spans
+        recent = provider.recent_traces()
+        assert len(recent) == 3                        # newest max_traces
+        # newest-first, and the survivors are the LAST three created
+        assert [t["trace_id"] for t in recent] == [
+            r.trace_id for r in reversed(roots[-3:])]
+        assert len(provider.recent_traces(limit=1)) == 1
+
+    def test_debug_traces_json_shape(self):
+        provider = tracing.TracerProvider()
+        with provider.tracer("t").start_span("root") as root:
+            root.set_attribute("pods", 4)
+            root.add_event("flush_first_redispatch")
+        doc = json.loads(provider.debug_traces_json())
+        (trace,) = doc["traces"]
+        (span,) = trace["spans"]
+        assert span["name"] == "root"
+        assert span["attributes"] == {"pods": 4}
+        assert span["events"][0]["name"] == "flush_first_redispatch"
+        for key in ("trace_id", "span_id", "parent_span_id",
+                    "start_unix_s", "duration_s"):
+            assert key in span
+        provider.reset()
+        assert json.loads(provider.debug_traces_json()) == {"traces": []}
+
+
+# -- Chrome trace export ----------------------------------------------------
+
+class TestChromeExport:
+    def test_lanes_events_and_instants(self):
+        provider = tracing.TracerProvider()
+        tracer = provider.tracer("t")
+        root = tracer.start_span("schedule_batch")
+        root.set_attribute("process", "scheduler")
+        root.add_event("seam_retry", attempt=1)
+        w = tracer.start_span("worker.step", parent=root)
+        w.set_attribute("process", "worker")
+        w.end(), root.end()
+        doc = tracing.to_chrome_trace(provider.snapshot())
+        events = doc["traceEvents"]
+        metas = [e for e in events if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in metas} == {"scheduler", "worker"}
+        xs = {e["name"]: e for e in events if e["ph"] == "X"}
+        assert set(xs) == {"schedule_batch", "worker.step"}
+        # distinct pid lanes per process, one tid per trace
+        assert xs["schedule_batch"]["pid"] != xs["worker.step"]["pid"]
+        assert xs["schedule_batch"]["tid"] == xs["worker.step"]["tid"]
+        for e in xs.values():
+            assert e["ts"] > 0 and e["dur"] >= 0          # microseconds
+        (instant,) = [e for e in events if e["ph"] == "i"]
+        assert instant["name"] == "seam_retry"
+        assert instant["args"] == {"attempt": 1}
+        json.dumps(doc)  # must be serializable as written by bench --trace
+
+
+# -- metrics details the exposition relies on (satellite) -------------------
+
+class TestMetricsExposition:
+    def _registry_with_hist(self):
+        r = cbm.Registry()
+        h = cbm.Histogram("t_hist", "h", buckets=[0.1, 1.0, 10.0])
+        r.must_register(h)
+        return r, h
+
+    def test_observe_many_equals_repeated_observe(self):
+        vals = [0.05, 0.5, 0.5, 5.0, 50.0, 0.09, 10.0]
+        r1, h1 = self._registry_with_hist()
+        r2, h2 = self._registry_with_hist()
+        for v in vals:
+            h1.observe(v)
+        h2.observe_many(vals)
+        assert r1.expose() == r2.expose()     # bucket counts, sum, count
+        assert r1.gather() == r2.gather()
+        for q in (0.5, 0.9, 0.99):
+            assert h1.quantile(q) == h2.quantile(q)
+
+    def test_observe_many_with_labels_and_empty(self):
+        r1 = cbm.Registry()
+        h = cbm.Histogram("t_lab", "h", labels=("op",), buckets=[1.0])
+        r1.must_register(h)
+        h.observe_many([], "noop")            # no-op, no series created
+        assert 'op="noop"' not in r1.expose()
+        h.observe_many([0.5, 2.0], "step")
+        h.observe(0.5, "step")
+        assert 't_lab_count{op="step"} 3' in r1.expose()
+
+    def test_label_value_escaping(self):
+        r = cbm.Registry()
+        g = cbm.Gauge("t_gauge", "h", labels=("l",))
+        r.must_register(g)
+        g.set(1.0, 'a\\b"c\nd')
+        lines = [ln for ln in r.expose().splitlines()
+                 if ln.startswith("t_gauge{")]
+        assert len(lines) == 1                # newline must not split the line
+        assert '\\\\' in lines[0]             # backslash -> \\
+        assert '\\"' in lines[0]              # quote -> \"
+        assert '\\n' in lines[0]              # newline -> \n
+
+
+# -- escape-reason telemetry (satellite) ------------------------------------
+
+def _ns_selector_pod(name: str):
+    """Required pod-anti-affinity with a namespaceSelector — the one
+    InterPodAffinity shape the flattener can NOT encode; it must escape
+    with reason namespace_selector (testing.wrappers has no
+    namespaceSelector builder, so the spec is set by hand)."""
+    pod = make_pod(name).build()
+    pod["spec"]["affinity"] = {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [{
+            "topologyKey": "kubernetes.io/hostname",
+            "labelSelector": {"matchLabels": {"app": "x"}},
+            "namespaceSelector": {"matchLabels": {"team": "a"}}}]}}
+    return pod
+
+
+class TestEscapeTelemetry:
+    def test_backend_tallies_namespace_selector(self):
+        nodes = [make_node(f"n{i}").build() for i in range(2)]
+        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        infos = [PodInfo(_ns_selector_pod("nsp")),
+                 PodInfo(make_pod("plain").build())]
+        backend.assign(infos, snapshot_from(nodes))
+        drained = backend.drain_escape_reasons()
+        assert drained.get(("InterPodAffinity", "namespace_selector"), 0) >= 1
+        assert backend.drain_escape_reasons() == {}   # drain empties
+
+    def test_scheduler_drain_feeds_prom_registry(self):
+        """The scheduler-side drain turns backend tallies into
+        scheduler_tpu_escape_total{plugin,reason} samples visible in
+        Registry.gather() — using the REAL Scheduler method and the REAL
+        metric set, against the real backend above."""
+        from kubernetes_tpu.scheduler.scheduler import SchedulerMetrics
+
+        class _Host:
+            _drain_backend_telemetry = Scheduler._drain_backend_telemetry
+
+            def __init__(self):
+                self.metrics = SchedulerMetrics()
+
+        nodes = [make_node("n0").build()]
+        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        backend.assign([PodInfo(_ns_selector_pod("nsp")),
+                        PodInfo(make_pod("plain").build())],
+                       snapshot_from(nodes))
+        host = _Host()
+        host._drain_backend_telemetry(backend)
+        gathered = host.metrics.prom.registry.gather()
+        esc = gathered["scheduler_tpu_escape_total"]
+        assert esc.get(("InterPodAffinity", "namespace_selector"), 0) >= 1
+        text = host.metrics.prom.expose()
+        assert 'scheduler_tpu_escape_total{plugin="InterPodAffinity"' in text
+        assert 'reason="namespace_selector"' in text
+        # batch telemetry rides the same drain
+        count, _ = gathered["scheduler_tpu_feasible_nodes"][()]
+        assert count >= 1
+
+    def test_null_backend_is_harmless(self):
+        """Backends without drain hooks (per-pod fallback path) must not
+        break the drain helper."""
+        from kubernetes_tpu.scheduler.scheduler import SchedulerMetrics
+
+        class _Host:
+            _drain_backend_telemetry = Scheduler._drain_backend_telemetry
+
+            def __init__(self):
+                self.metrics = SchedulerMetrics()
+
+        _Host()._drain_backend_telemetry(object())
+
+
+# -- span topology through the batch pipeline -------------------------------
+
+PIPELINE_SPANS = {"schedule_batch", "queue.pop", "snapshot.flatten",
+                  "plugin.filter_masks", "plugin.score",
+                  "plugin.assign_solve", "tpu.h2d", "tpu.d2h", "bind"}
+
+
+class TestPipelineSpans:
+    def test_full_scheduler_emits_pipeline_spans(self):
+        provider = tracing.TracerProvider(sampling_rate_per_million=1_000_000)
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        factory = SharedInformerFactory(client)
+        fw = new_default_framework(client, factory)
+        backend = TPUBatchBackend(small_caps(), batch_size=8)
+        sched = Scheduler(client, factory, {"default-scheduler": Profile(
+            fw, batch_backend=backend, batch_size=8)})
+        sched.configure_tracing(provider)
+        factory.start()
+        factory.wait_for_cache_sync()
+        sched.run()
+        try:
+            for i in range(4):
+                client.create(NODES, make_node(f"tr-{i}")
+                              .capacity(cpu="8", mem="32Gi").build())
+            for i in range(12):
+                client.create(PODS,
+                              make_pod(f"tp{i}").req(cpu="250m").build())
+            assert wait_for(lambda: all(
+                meta.pod_node_name(p)
+                for p in client.list(PODS, "default")[0]))
+            # bind spans end on the binder-pool thread; wait for them too
+            assert wait_for(lambda: PIPELINE_SPANS <= {
+                s.name for s in provider.snapshot()})
+        finally:
+            sched.stop()
+            factory.stop()
+        spans = provider.snapshot()
+        roots = [s for s in spans if s.name == "schedule_batch"]
+        assert roots
+        # pick a batch that went all the way to bind; its trace must hold
+        # the COMPLETE pipeline, with every parent id resolving inside it
+        root, fam = next(
+            (r, f) for r in roots
+            for f in [[s for s in spans if s.trace_id == r.trace_id]]
+            if "bind" in {s.name for s in f})
+        assert {s.name for s in fam} >= PIPELINE_SPANS
+        ids = {s.span_id for s in fam}
+        for s in fam:
+            if s.parent_span_id is not None:
+                assert s.parent_span_id in ids
+        by_name = {s.name: s for s in fam}
+        # h2d/d2h are children of the solve span, bind a child of the root
+        assert by_name["tpu.h2d"].parent_span_id == \
+            by_name["plugin.assign_solve"].span_id
+        assert by_name["tpu.d2h"].parent_span_id == \
+            by_name["plugin.assign_solve"].span_id
+        assert by_name["bind"].parent_span_id == root.span_id
+        assert by_name["queue.pop"].parent_span_id == root.span_id
+        # per-plugin batch telemetry rode the spans into the registry
+        gathered = sched.metrics.prom.registry.gather()
+        count, _ = gathered["scheduler_tpu_feasible_nodes"][()]
+        assert count >= 1
+
+    def test_untraced_scheduler_emits_nothing(self):
+        """No configure_tracing call -> zero tracing work (the default)."""
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        factory = SharedInformerFactory(client)
+        fw = new_default_framework(client, factory)
+        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        sched = Scheduler(client, factory, {"default-scheduler": Profile(
+            fw, batch_backend=backend, batch_size=4)})
+        factory.start()
+        factory.wait_for_cache_sync()
+        sched.run()
+        try:
+            client.create(NODES, make_node("u0").build())
+            client.create(PODS, make_pod("up0").build())
+            assert wait_for(lambda: all(
+                meta.pod_node_name(p)
+                for p in client.list(PODS, "default")[0]))
+        finally:
+            sched.stop()
+            factory.stop()
+        assert sched.tracer_provider is None
+
+
+# -- traceparent across the remote seam (both transports) -------------------
+
+class TestRemoteSeamTracing:
+    def test_worker_spans_parent_into_client_trace(self, worker):
+        worker.tracer_provider.reset()
+        remote = RemoteTPUBatchBackend(worker.url, small_caps(), batch_size=4)
+        provider = tracing.TracerProvider()
+        root = provider.tracer("scheduler").start_span("schedule_batch")
+        try:
+            nodes = [make_node("n0").capacity(cpu="8").build()]
+            with tracing.use_span(root):
+                out = remote.assign([PodInfo(make_pod("p").build())],
+                                    snapshot_from(nodes))
+            assert out[0][0] == "n0"
+        finally:
+            root.end()
+            remote.close()
+        wspans = worker.tracer_provider.snapshot()
+        assert wspans, "worker recorded no spans despite sampled client root"
+        names = {s.name for s in wspans}
+        assert "worker.step" in names
+        for s in wspans:
+            assert s.name.startswith("worker.")
+            assert s.trace_id == root.trace_id
+            assert s.parent_span_id == root.span_id
+            assert s.attributes.get("process") == "worker"
+
+    def test_unsampled_root_propagates_nothing(self, worker):
+        worker.tracer_provider.reset()
+        remote = RemoteTPUBatchBackend(worker.url, small_caps(), batch_size=4)
+        provider = tracing.TracerProvider(sampling_rate_per_million=0)
+        root = provider.tracer("scheduler").start_span("schedule_batch")
+        try:
+            nodes = [make_node("n0").capacity(cpu="8").build()]
+            with tracing.use_span(root):
+                remote.assign([PodInfo(make_pod("p").build())],
+                              snapshot_from(nodes))
+        finally:
+            root.end()
+            remote.close()
+        assert worker.tracer_provider.snapshot() == []
+
+    def test_retry_is_a_span_event_not_an_orphan_trace(self, worker):
+        """PR-1 seam semantics under tracing: a dropped /step retries
+        within the SAME span (a `seam_retry` event), it does not start a
+        new trace."""
+        from kubernetes_tpu.ops.faults import (DROP, NONE, FaultSchedule,
+                                               FaultyTransport)
+        from kubernetes_tpu.scheduler.config import RemoteSeamPolicy
+
+        class OneStepDrop(FaultSchedule):
+            def __init__(self):
+                super().__init__(seed=1)
+                self.dropped = False
+
+            def action(self, call_index, verb):
+                if verb.startswith("/step") and not self.dropped:
+                    self.dropped = True
+                    return DROP
+                return NONE
+
+        worker.tracer_provider.reset()
+        faulty = FaultyTransport(transport_for(worker.url), OneStepDrop())
+        remote = RemoteTPUBatchBackend(
+            worker.url, small_caps(), batch_size=4,
+            policy=RemoteSeamPolicy(retry_base=0.01, retry_max=0.02),
+            transport=faulty)
+        provider = tracing.TracerProvider()
+        root = provider.tracer("scheduler").start_span("schedule_batch")
+        try:
+            nodes = [make_node("n0").capacity(cpu="8").build()]
+            with tracing.use_span(root):
+                out = remote.assign([PodInfo(make_pod("p").build())],
+                                    snapshot_from(nodes))
+            assert out[0][0] == "n0"
+        finally:
+            root.end()
+            remote.close()
+        assert faulty.injected[DROP] == 1
+        assert any(name == "seam_retry" for _, name, _ in root.events)
+        # the retried step landed in the ORIGINAL trace on the worker side
+        step_traces = {s.trace_id for s in worker.tracer_provider.snapshot()
+                       if s.name == "worker.step"}
+        assert step_traces == {root.trace_id}
+
+    def test_worker_http_debug_endpoints(self, worker):
+        if worker.url.startswith("grpc://"):
+            pytest.skip("debug HTTP endpoints are the http transport's")
+        worker.tracer_provider.reset()
+        remote = RemoteTPUBatchBackend(worker.url, small_caps(), batch_size=4)
+        provider = tracing.TracerProvider()
+        root = provider.tracer("scheduler").start_span("schedule_batch")
+        try:
+            nodes = [make_node("n0").capacity(cpu="8").build()]
+            with tracing.use_span(root):
+                remote.assign([PodInfo(make_pod("p").build())],
+                              snapshot_from(nodes))
+        finally:
+            root.end()
+            remote.close()
+        with urllib.request.urlopen(worker.url + "/debug/traces",
+                                    timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert any(t["trace_id"] == root.trace_id for t in doc["traces"])
+        with urllib.request.urlopen(worker.url + "/metrics",
+                                    timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+
+
+# -- /debug/traces on the apiserver -----------------------------------------
+
+class TestApiserverDebugTraces:
+    def test_debug_traces_served_next_to_metrics(self):
+        from kubernetes_tpu.apiserver import APIServer
+
+        dp = tracing.default_tracer_provider
+        dp.reset()
+        server = APIServer(kv.MemoryStore()).start()
+        try:
+            with dp.tracer("t").start_span("schedule_batch") as sp:
+                sp.set_attribute("pods", 1)
+            with urllib.request.urlopen(server.url + "/debug/traces",
+                                        timeout=10) as resp:
+                assert resp.headers["Content-Type"] == "application/json"
+                doc = json.loads(resp.read())
+            (trace,) = doc["traces"]
+            assert trace["spans"][0]["name"] == "schedule_batch"
+        finally:
+            server.stop()
+            dp.reset()
+
+
+# -- tracing: config stanza -------------------------------------------------
+
+class TestTracingConfig:
+    def test_defaults_disabled(self):
+        from kubernetes_tpu.scheduler.config import load_config
+
+        cfg = load_config({})
+        assert cfg.tracing.sampling_rate_per_million == 0
+        assert not cfg.tracing.enabled
+
+    def test_stanza_parses(self):
+        from kubernetes_tpu.scheduler.config import load_config
+
+        cfg = load_config({"tracing": {"samplingRatePerMillion": 500,
+                                       "maxSpans": 128, "maxTraces": 8}})
+        assert cfg.tracing.sampling_rate_per_million == 500
+        assert cfg.tracing.max_spans == 128
+        assert cfg.tracing.max_traces == 8
+        assert cfg.tracing.enabled
+
+    @pytest.mark.parametrize("stanza", [
+        {"samplingRatePerMillion": -1},
+        {"samplingRatePerMillion": 1_000_001},
+        {"maxSpans": 0},
+        {"maxTraces": 0},
+        {"samplingRate": 5},          # unknown key
+    ])
+    def test_invalid_stanzas_rejected(self, stanza):
+        from kubernetes_tpu.scheduler.config import ConfigError, load_config
+
+        with pytest.raises(ConfigError):
+            load_config({"tracing": stanza})
+
+    def test_scheduler_from_config_wires_the_default_provider(self):
+        from kubernetes_tpu.scheduler.config import (load_config,
+                                                     scheduler_from_config)
+
+        dp = tracing.default_tracer_provider
+        saved = (dp.sampling_rate_per_million, dp.max_spans, dp.max_traces)
+        client = LocalClient(kv.MemoryStore())
+        factory = SharedInformerFactory(client)
+        try:
+            cfg = load_config({"tracing": {"samplingRatePerMillion": 250_000,
+                                           "maxSpans": 64, "maxTraces": 4}})
+            sched = scheduler_from_config(client, factory, cfg)
+            assert sched.tracer_provider is dp
+            assert dp.sampling_rate_per_million == 250_000
+            assert dp.max_spans == 64 and dp.max_traces == 4
+            # disabled config leaves the scheduler untraced
+            sched2 = scheduler_from_config(client, factory, load_config({}))
+            assert sched2.tracer_provider is None
+        finally:
+            dp.configure(sampling_rate_per_million=saved[0],
+                         max_spans=saved[1], max_traces=saved[2])
+            dp.reset()

@@ -124,6 +124,50 @@ def test_network_calls_carry_timeouts():
         f"network calls without an explicit timeout: {offenders}")
 
 
+def test_spans_are_context_managed_or_ended():
+    """Observability invariant (ISSUE: batch-pipeline tracing): every
+    `start_span(` call site is either context-managed (`with ...
+    start_span(...)`) or its enclosing function's subtree also calls
+    `.end(` — the explicit-end form the pipeline uses where a span
+    outlives the function that opened it (dispatch -> resolve closures,
+    error paths).  A span that is never ended never reaches the flight
+    recorder AND silently drops its whole trace from /debug/traces."""
+    import ast
+
+    offenders = []
+    for path in sorted(ROOT.rglob("*.py")):
+        text = path.read_text()
+        if "start_span(" not in text:
+            continue
+        tree = ast.parse(text)
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            has_start = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "start_span"
+                for n in ast.walk(fn))
+            if not has_start:
+                continue
+            managed = any(
+                isinstance(n, ast.With)
+                and any("start_span" in ast.dump(item.context_expr)
+                        for item in n.items)
+                for n in ast.walk(fn))
+            ended = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "end"
+                for n in ast.walk(fn))
+            if not (managed or ended):
+                offenders.append(
+                    f"{path.relative_to(ROOT.parent)}:{fn.lineno} {fn.name}")
+    assert not offenders, (
+        "start_span call sites neither context-managed nor .end()ed: "
+        f"{offenders}")
+
+
 def test_controller_registry_complete():
     """Every controller module's Controller subclass is constructible from
     the manager's registry (a new controller that isn't wired in is dead
