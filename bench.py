@@ -168,19 +168,27 @@ EXTRA_CONFIGS = {
                                "timeout": 900.0},
     "SchedulingSecrets": {"workload": "SchedulingSecrets", "batch": 4096,
                           "depth": 2, "timeout": 900.0},
-    # namespaceSelector terms resolve through the per-pod oracle (the
-    # tensor path escapes them by design): the measured number is the
-    # oracle-regime feature throughput (reference :492-598)
-    # NOTE: no pct_nodes here — one-pod-per-host anti-affinity is
-    # feasibility-SEEKING at the contended tail, and a 2% sample often
-    # contains zero free hosts (measured: the run parked/retried its way
-    # past the timeout); the adaptive default finds them
+    # namespaceSelector terms are tensor-encoded: the flattener resolves
+    # each term against its informer-fed namespace-label cache into a
+    # concrete namespace set at encode time, so these run the device
+    # regime at escape_rate 0.0 (reference :492-598)
+    # NOTE: no pct_nodes on the required-anti row — one-pod-per-host
+    # anti-affinity is feasibility-SEEKING at the contended tail, and a
+    # 2% sample often contains zero free hosts (measured: the run
+    # parked/retried its way past the timeout); the adaptive default
+    # finds them
     "SchedulingRequiredPodAntiAffinityWithNSSelector": {
         "workload": "SchedulingRequiredPodAntiAffinityWithNSSelector",
         "batch": 4096, "depth": 2, "timeout": 1200.0},
     "SchedulingPreferredAffinityWithNSSelector": {
         "workload": "SchedulingPreferredAffinityWithNSSelector",
         "batch": 4096, "depth": 2, "timeout": 900.0, "pct_nodes": 2},
+    # the stress shape for namespace resolution: 201 namespaces in the
+    # vocab, every term fanning out across all of them, required-anti
+    # AND preferred-affinity on the same pods
+    "SchedulingNSSelectorDense": {
+        "workload": "SchedulingNSSelectorDense",
+        "batch": 4096, "depth": 2, "timeout": 1200.0},
     # blended tensor+oracle: 5% Gt node-affinity escapes; the config
     # whose escape_rate must be NON-zero (honest coverage)
     # pct_nodes=2: percentageOfNodesToScore for the ESCAPED pods'

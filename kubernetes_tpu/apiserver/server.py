@@ -1880,7 +1880,11 @@ class APIServer:
                 manager = self._field_manager(default="apply")
                 force = (r.query.get("force") or ["false"])[0] == "true"
                 applied.setdefault("metadata", {}).setdefault("name", r.name)
-                if r.ns:
+                if r.resource in CLUSTER_SCOPED:
+                    # stray namespace would fork the storage key away from
+                    # the cluster-scoped read path (same strip as POST)
+                    applied["metadata"].pop("namespace", None)
+                elif r.ns:
                     applied["metadata"].setdefault("namespace", r.ns)
                 try:
                     try:

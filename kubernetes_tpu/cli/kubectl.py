@@ -19,7 +19,7 @@ import yaml
 
 from .. import __version__
 from ..api import meta
-from ..client.clientset import NODES, PODS, Client
+from ..client.clientset import CLUSTER_SCOPED_RESOURCES, NODES, PODS, Client
 from ..client.http_client import HTTPClient
 from ..store import kv
 
@@ -440,7 +440,11 @@ class Kubectl:
             if not res:
                 self.out.write(f"error: unknown kind {obj.get('kind')}\n")
                 return 1
-            obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+            obj.setdefault("metadata", {})
+            if res not in CLUSTER_SCOPED_RESOURCES:
+                # real kubectl never stamps a namespace onto a
+                # cluster-scoped object (it would fork the storage key)
+                obj["metadata"].setdefault("namespace", namespace)
             try:
                 created = self.client.create(res, obj)
                 self.out.write(f"{res}/{meta.name(created)} created\n")
@@ -493,7 +497,11 @@ class Kubectl:
             if not res:
                 self.out.write(f"error: unknown kind {obj.get('kind')}\n")
                 return 1
-            obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+            obj.setdefault("metadata", {})
+            if res not in CLUSTER_SCOPED_RESOURCES:
+                # real kubectl never stamps a namespace onto a
+                # cluster-scoped object (it would fork the storage key)
+                obj["metadata"].setdefault("namespace", namespace)
             ns, nm = meta.namespace(obj), meta.name(obj)
             try:
                 self.client.get(res, ns, nm)
@@ -1652,7 +1660,11 @@ class Kubectl:
             if not res:
                 self.out.write(f"error: unknown kind {obj.get('kind')}\n")
                 return 2
-            obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+            obj.setdefault("metadata", {})
+            if res not in CLUSTER_SCOPED_RESOURCES:
+                # real kubectl never stamps a namespace onto a
+                # cluster-scoped object (it would fork the storage key)
+                obj["metadata"].setdefault("namespace", namespace)
             ns, nm = meta.namespace(obj), meta.name(obj)
             try:
                 live = self.client.get(res, ns, nm)

@@ -194,6 +194,31 @@ def _static_mask_and_score(node: dict, pod: dict, comm: _Comm, offset,
     return sel_mask, static_mask, static_score
 
 
+def _fold_ns_masks(node: dict, pod: dict) -> dict:
+    """Namespace gate for namespaceSelector terms: AND each pod's group
+    MEMBERSHIP vectors (inc_sg, match_asg) with the per-slot namespace
+    masks, selecting the column of the pod's namespace vocab id (last
+    column = outside-vocab).  The host encoder already folds namespace
+    membership into these bits from the SAME resolved sets, so on a
+    correct host this multiply is idempotent — it exists as structural
+    enforcement: a stale host fold can only over-block, never admit a
+    placement the resolution forbids.  inc_asg is deliberately NOT
+    gated: it marks the pod as a term CARRIER (its count must enter
+    cd_asg regardless of the pod's own namespace).  Plain-namespace
+    slots carry all-ones mask rows, so batches without namespaceSelector
+    terms pay two [P, cap]-scale multiplies and nothing else."""
+    sgm = node.get("sg_ns_mask")
+    col = pod.get("pod_ns")
+    if sgm is None or col is None:
+        return pod
+    pod = dict(pod)
+    pod["inc_sg"] = pod["inc_sg"] * sgm[:, col].T
+    asgm = node.get("asg_ns_mask")
+    if asgm is not None:
+        pod["match_asg"] = pod["match_asg"] * asgm[:, col].T
+    return pod
+
+
 def _fit_scores_vec(req_nz, alloc, used_nz):
     """LeastAllocated + BalancedAllocation over cpu/mem: [P,N] each.
     Written as 2-D ops (never materializes [P,N,R]) because the device
@@ -247,6 +272,7 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
 
     def assign(node: dict, pod: dict) -> dict[str, jnp.ndarray]:
         n_loc = node["alloc"].shape[0]
+        pod = _fold_ns_masks(node, pod)
         P = pod["req"].shape[0]
         offset = comm.my_offset(n_loc)
         sel_mask, static_mask, static_score = _static_mask_and_score(
@@ -658,6 +684,7 @@ def _make_scan_core(caps: Caps, w: dict, comm: _Comm):
 
     def assign(node: dict, pod: dict) -> dict[str, jnp.ndarray]:
         n_loc = node["alloc"].shape[0]
+        pod = _fold_ns_masks(node, pod)
         offset = comm.my_offset(n_loc)
         sel_mask, static_mask, static_score = _static_mask_and_score(
             node, pod, comm, offset)
@@ -819,7 +846,8 @@ class PackSpec:
             self.f_i = 2  # untol_hard bits | p_valid
         else:
             self.f_f = 2 * caps.r + 3 * C
-            self.f_i = 12 + 2 * C + G * SEL_V + FORB_V + KG * KEY_V
+            # 13 fixed int columns (12 legacy + pod_ns) then the blocks
+            self.f_i = 13 + 2 * C + G * SEL_V + FORB_V + KG * KEY_V
         self.f_patch = 2 * caps.r + 1 + caps.pt_cap
         self.a = p_cap * self.f_f
         self.b = p_cap * self.f_i
@@ -857,9 +885,9 @@ def pack_pod_batch(batch, spec: PackSpec,
     # full wire format: materialize any lazy (None == zeros) fields the
     # dense layout ships (see flatten.PodBatch laziness contract)
     for _nm in ("untol_prefer", "ports", "key_forb", "match_asg", "inc_asg",
-                "inc_sg", "sel_any_active", "key_any_active", "node_row",
-                "c_kind", "c_sg", "c_maxskew", "c_selfmatch", "c_weight",
-                "sel_ids", "sel_forb_ids", "key_ids"):
+                "inc_sg", "pod_ns", "sel_any_active", "key_any_active",
+                "node_row", "c_kind", "c_sg", "c_maxskew", "c_selfmatch",
+                "c_weight", "sel_ids", "sel_forb_ids", "key_ids"):
         batch.ensure(caps, _nm)
     pf = np.concatenate([batch.req, batch.req_nz, batch.c_maxskew,
                          batch.c_selfmatch, batch.c_weight],
@@ -878,7 +906,8 @@ def pack_pod_batch(batch, spec: PackSpec,
     pi[:, 9] = _bits(batch.key_any_active)
     pi[:, 10] = batch.p_valid.astype(np.int32)
     pi[:, 11] = batch.node_row
-    o = 12
+    pi[:, 12] = batch.pod_ns
+    o = 13
     pi[:, o:o + C] = batch.c_kind; o += C
     pi[:, o:o + C] = batch.c_sg; o += C
     pi[:, o:o + G * SEL_V] = batch.sel_ids.reshape(P, G * SEL_V); o += G * SEL_V
@@ -938,7 +967,7 @@ def _unpack(buf, spec: PackSpec, features: frozenset = ALL_FEATURES):
         }
         return pod, prow, pval
 
-    o = 12
+    o = 13
     c_kind = pi[:, o:o + C]; o += C
     c_sg = pi[:, o:o + C]; o += C
     sel_ids = pi[:, o:o + G * SEL_V].reshape(P, G, SEL_V); o += G * SEL_V
@@ -979,6 +1008,7 @@ def _unpack(buf, spec: PackSpec, features: frozenset = ALL_FEATURES):
         "key_any_active": unbits(pi[:, 9], caps.kg_cap),
         "p_valid": pi[:, 10] > 0,
         "node_row": pi[:, 11],
+        "pod_ns": pi[:, 12],
         "c_kind": c_kind, "c_sg": c_sg,
         "sel_any": sel_any, "sel_forb": sel_forb, "key_any": key_any,
     }

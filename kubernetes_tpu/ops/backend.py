@@ -64,7 +64,11 @@ _static_patch_jit = None
 # path never ships them (models/assign._static_mask_and_score reads them
 # behind the "selectors" feature gate)
 STATIC_CORE = ("alloc", "maxpods", "valid", "taint_mask")
-STATIC_SEL = ("label_mask", "key_mask", "dom_sg", "dom_asg")
+# sg_ns_mask/asg_ns_mask have NO node axis (per-slot namespace masks for
+# namespaceSelector terms): they are excluded from the row-patch path and
+# ride full uploads only — every mask mutation sets tensors.static_full
+STATIC_SEL = ("label_mask", "key_mask", "dom_sg", "dom_asg",
+              "sg_ns_mask", "asg_ns_mask")
 
 _core_patch_jit = None
 _sel_patch_jit = None
@@ -402,6 +406,16 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         self._esc_lock = threading.Lock()
         self._escape_pending: dict[tuple[str, str], int] = {}
         self._telemetry_pending: list[dict] = []
+
+    # -- namespace events ------------------------------------------------
+
+    def note_namespace_event(self, event_type: str, obj, old=None) -> None:
+        """Namespace informer feed: keep the flattener's namespace-label
+        cache (namespaceSelector resolution) in sync with the cluster.
+        Runs under the backend lock so a relabel is applied atomically
+        between batches — the next encode sees the new resolved sets."""
+        with self._lock:
+            self.tensors.note_namespace(obj, deleted=event_type == "DELETED")
 
     # -- device sync -----------------------------------------------------
 

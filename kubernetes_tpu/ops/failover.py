@@ -236,6 +236,15 @@ class FailoverBatchBackend(BatchBackend):
                     fn(snapshot)
                 return
 
+    def note_namespace_event(self, event_type: str, obj, old=None) -> None:
+        """Fan namespace-label events to EVERY rung (not just the active
+        one): a cold standby must resolve namespaceSelector terms from a
+        current cache the moment failover promotes it."""
+        for rung in self._rungs:
+            fn = getattr(rung.backend, "note_namespace_event", None)
+            if fn is not None:
+                fn(event_type, obj, old)
+
     def preempt_candidates(self, pod_infos, k: int = 16):
         for rung in self._rungs:
             if not rung.breaker.is_open:

@@ -94,11 +94,18 @@ class Vocab:
 
 @dataclass
 class SelectorGroup:
-    """(topology_key, selector, namespaces) — the unit of count bookkeeping."""
+    """(topology_key, selector, namespaces) — the unit of count bookkeeping.
+
+    namespaceSelector terms resolve to a CONCRETE namespace set at
+    registration (ClusterTensors.group_for_term); such groups keep the
+    selector and base namespaces around so a namespace relabel can
+    re-resolve the set in place (_refresh_ns_groups)."""
 
     topology_key: str
     selector: Selector
     namespaces: frozenset[str]
+    ns_selector: Selector | None = None      # resolved-from selector, if any
+    base_namespaces: frozenset[str] | None = None  # term's explicit namespaces
 
     def key(self):
         return (self.topology_key, self.selector, self.namespaces)
@@ -181,6 +188,7 @@ class Caps:
     g_cap: int = 4             # any-of label groups per pod (node selector)
     kg_cap: int = 2            # any-of key groups per pod (Exists)
     c_cap: int = 6             # constraints per pod
+    ns_cap: int = 256          # namespace vocab (namespaceSelector masks)
 
     @property
     def r(self) -> int:
@@ -230,13 +238,30 @@ class ClusterTensors:
         self._asg_kv_index: dict = {}
         self._asg_complex: list = []
 
-        # ns-anti guard (namespaceSelector anti-affinity): label pairs
-        # whose pods must ESCAPE to the oracle because a pod carrying a
-        # namespaceSelector anti term against them went through the
-        # escape hatch earlier in this process (the device can't check
-        # cross-namespace terms).  Conservative: armed at escape time,
-        # never disarmed.  Known residual: a restarted scheduler only
-        # re-arms when such a pod next passes through an encode.
+        # namespaceSelector resolution: Namespace-object labels cached
+        # from the informer feed (note_namespace/set_namespace_labels);
+        # terms resolve to concrete namespace sets against it at encode
+        # time, memoized until the cache changes (ns_version).  The
+        # namespace vocab + per-slot masks are the DEVICE side: column =
+        # namespace id, last column = namespaces outside the vocab.
+        # All-ones rows (the init state, kept for every plain-namespace
+        # group) make the kernel's namespace AND a no-op, so batches
+        # without namespaceSelector terms pay nothing.
+        self.ns_labels: dict[str, dict] = {}
+        self.ns_version = 0
+        self._ns_memo: dict = {}           # (base, ns_selector) -> frozenset
+        self.ns_vocab = Vocab(c.ns_cap)
+        self.sg_ns_mask = np.ones((c.sg_cap, c.ns_cap + 1), np.float32)
+        self.asg_ns_mask = np.ones((c.asg_cap, c.ns_cap + 1), np.float32)
+
+        # ns-anti guard: the conservative FALLBACK for namespaceSelector
+        # anti-affinity terms whose group could NOT be registered (asg
+        # bucket overflow) — any later pod whose labels could match one
+        # of the unregistered selectors escapes to the oracle, so a
+        # device placement can never violate them.  Armed at escape
+        # time, never disarmed; zero cost while unarmed.  (Terms whose
+        # group DID register need no guard: their counts/masks cover
+        # them on the device path.)
         self.ns_anti_kv: set[tuple[str, str]] = set()
         self.ns_anti_complex = False
 
@@ -294,6 +319,156 @@ class ClusterTensors:
         self.static_version += 1
         self.static_full = True  # column fill: every row changed
         return kid
+
+    # -- namespace resolution (namespaceSelector terms) ------------------
+
+    def resolve_namespaces(self, base: frozenset,
+                           ns_selector: Selector) -> frozenset:
+        """base ∪ {namespaces whose labels match ns_selector}, mirroring
+        the oracle's AffinityTerm.matches exactly: a namespace must have
+        a cached OBJECT to match (an empty match-all selector matches
+        only known namespaces), and empty labels {} DO match a match-all
+        selector.  Memoized until the namespace cache changes."""
+        memo_key = (base, ns_selector)
+        got = self._ns_memo.get(memo_key)
+        if got is None:
+            got = frozenset(base) | {
+                ns for ns, lbl in self.ns_labels.items()
+                if ns_selector.matches(lbl)}
+            self._ns_memo[memo_key] = got
+        return got
+
+    def term_group_key(self, term):
+        """The sg/asg id-map key for an affinity term: plain terms keep
+        the raw (topology_key, selector, namespaces) triple; terms with
+        a namespaceSelector key on the RESOLVED namespace set."""
+        if term.ns_selector is None:
+            return (term.topology_key, term.selector, term.namespaces)
+        return (term.topology_key, term.selector,
+                self.resolve_namespaces(term.namespaces, term.ns_selector))
+
+    def group_for_term(self, term) -> SelectorGroup:
+        """SelectorGroup for an affinity term, with any namespaceSelector
+        resolved against the namespace-label cache."""
+        if term.ns_selector is None:
+            return SelectorGroup(term.topology_key, term.selector,
+                                 term.namespaces)
+        return SelectorGroup(
+            term.topology_key, term.selector,
+            self.resolve_namespaces(term.namespaces, term.ns_selector),
+            ns_selector=term.ns_selector, base_namespaces=term.namespaces)
+
+    def intern_namespaces(self, namespaces) -> bool:
+        """Intern namespaces into the device vocab; False when the vocab
+        cannot hold them all (the registering pod then escapes with
+        reason namespace_vocab_overflow — the group itself still
+        registers with exact host-side counts and an all-ones mask)."""
+        ok = True
+        for ns in namespaces:
+            try:
+                self.ns_vocab.get(ns)
+            except VocabFullError:
+                ok = False
+        return ok
+
+    def set_namespace_labels(self, name: str, labels: dict | None) -> None:
+        """Update the namespace-label cache (labels=None: namespace
+        deleted) and re-resolve every registered namespaceSelector group
+        against it.  Deterministic invalidation: the NEXT batch encodes
+        against the new resolution — no TTL, no staleness window beyond
+        informer delivery."""
+        if labels is None:
+            if name not in self.ns_labels:
+                return
+            del self.ns_labels[name]
+        else:
+            labels = dict(labels)
+            if self.ns_labels.get(name) == labels:
+                return
+            self.ns_labels[name] = labels
+        self.ns_version += 1
+        self._ns_memo.clear()
+        self._refresh_ns_groups()
+
+    def note_namespace(self, obj: Obj, deleted: bool = False) -> None:
+        """Feed one Namespace informer event into the cache."""
+        self.set_namespace_labels(
+            meta.name(obj), None if deleted else meta.labels(obj))
+
+    def _refresh_ns_groups(self) -> None:
+        """Re-resolve registered namespaceSelector groups after a
+        namespace-label change: group membership sets, id-map keys,
+        per-node counts and the device namespace masks all follow the
+        new resolution in one pass."""
+        changed = False
+        for is_sg in (True, False):
+            buckets = self.sgs if is_sg else self.asgs
+            ids = self._sg_ids if is_sg else self._asg_ids
+            for idx, bucket in enumerate(buckets):
+                touched = False
+                for g in bucket.groups:
+                    if g.ns_selector is None:
+                        continue
+                    new = self.resolve_namespaces(g.base_namespaces,
+                                                  g.ns_selector)
+                    if new == g.namespaces:
+                        continue
+                    old_key = g.key()
+                    if ids.get(old_key) == idx:
+                        del ids[old_key]
+                    g.namespaces = new
+                    ids[g.key()] = idx
+                    touched = True
+                if not touched:
+                    continue
+                changed = True
+                for row, ni in enumerate(self.node_infos):
+                    if ni is None or not self.valid[row]:
+                        continue
+                    if is_sg:
+                        self._encode_sg_row(idx, row, ni)
+                    else:
+                        self._encode_asg_row(idx, row, ni)
+                self.intern_namespaces(
+                    ns for g in bucket.groups for ns in g.namespaces)
+                self._ns_mask_row_update(idx, bucket, is_sg)
+        if changed:
+            self.version += 1
+            self.static_version += 1
+            self.static_full = True
+
+    def _ns_mask_row_update(self, idx: int, bucket: GroupBucket,
+                            is_sg: bool) -> bool:
+        """Device namespace mask for one sg/asg slot (column = namespace
+        vocab id; last column = outside-vocab namespaces).  The host
+        fold is authoritative — it sets inc/match bits from the same
+        resolved sets — so the mask is enforcement, not semantics: a
+        stale or fallback row can only over-block, never admit a
+        placement the resolution forbids.  Plain members and
+        outside-vocab namespaces therefore fall back to all-ones (the
+        kernel AND becomes a no-op)."""
+        mask = self.sg_ns_mask if is_sg else self.asg_ns_mask
+        row = np.zeros(self.caps.ns_cap + 1, np.float32)
+        exact = True
+        for g in bucket.groups:
+            if g.ns_selector is None:
+                exact = False   # plain member: its namespaces aren't interned
+                break
+            for ns in g.namespaces:
+                nid = self.ns_vocab.lookup(ns)
+                if nid is None:
+                    exact = False
+                    break
+                row[nid] = 1.0
+            if not exact:
+                break
+        if not exact:
+            row[:] = 1.0
+        # report row-value changes: mask mutations are NOT row-patchable
+        # (no node axis), so callers must force a full static re-upload
+        changed = not np.array_equal(mask[idx], row)
+        mask[idx] = row
+        return changed
 
     def domain_id(self, topo_key: str, value: str) -> int:
         vocab = self.domain_vocabs.get(topo_key)
@@ -385,6 +560,11 @@ class ClusterTensors:
             is_new_bucket = False
         self._sg_ids[group.key()] = idx
         self._index_group(self._sg_kv_index, self._sg_complex, idx, group)
+        mask_changed = False
+        if group.ns_selector is not None or self.sgs[idx].collided:
+            # a namespaceSelector member (or a join that may widen a
+            # selective row) re-derives the slot's namespace mask
+            mask_changed = self._ns_mask_row_update(idx, self.sgs[idx], True)
         # Registration cost discipline (a 2000-service flood registers
         # its whole vocabulary inside ONE batch encode): a new bucket
         # copies/derives its dom row in one vectorized step; a JOIN can
@@ -397,7 +577,7 @@ class ClusterTensors:
         if is_new_bucket:
             self.dom_sg[idx] = self._dom_row_for_key(bucket.topology_key,
                                                      exclude=bucket)
-        changed = is_new_bucket
+        changed = is_new_bucket or mask_changed
         for row, ni in enumerate(self.node_infos):
             if ni is None or not self.valid[row] or not ni.pods:
                 continue
@@ -432,6 +612,10 @@ class ClusterTensors:
         self._asg_ids[group.key()] = idx
         self._index_group(self._asg_kv_index, self._asg_complex, idx,
                           group)
+        mask_changed = False
+        if group.ns_selector is not None or self.asgs[idx].collided:
+            mask_changed = self._ns_mask_row_update(idx, self.asgs[idx],
+                                                    False)
         # same registration cost discipline as register_sg: vectorized
         # dom row for new buckets, count deltas only on nodes that hold
         # anti-affinity pods, version bumps only when something changed
@@ -439,7 +623,8 @@ class ClusterTensors:
             self.dom_asg[idx] = self._dom_row_for_key(
                 group.topology_key, exclude=self.asgs[idx])
         ids = self._asg_ids
-        changed = is_new_bucket
+        term_key = self.term_group_key
+        changed = is_new_bucket or mask_changed
         for row, ni in enumerate(self.node_infos):
             if (ni is None or not self.valid[row]
                     or not ni.pods_with_required_anti_affinity):
@@ -447,8 +632,7 @@ class ClusterTensors:
             n = 0
             for pi in ni.pods_with_required_anti_affinity:
                 for term in pi.required_anti_affinity_terms:
-                    if ids.get((term.topology_key, term.selector,
-                                term.namespaces)) == idx:
+                    if ids.get(term_key(term)) == idx:
                         n += 1
             if n != self.cnt_asg[idx, row]:
                 self.cnt_asg[idx, row] = n
@@ -771,12 +955,13 @@ class ClusterTensors:
                                                      val)
                                       if val is not None else -1)
         # pods on this node carrying an anti-affinity term == any member
+        # (namespaceSelector terms compare by their RESOLVED group key)
         ids = self._asg_ids
+        term_key = self.term_group_key
         n = 0
         for pi in ni.pods_with_required_anti_affinity:
             for term in pi.required_anti_affinity_terms:
-                if ids.get((term.topology_key, term.selector,
-                            term.namespaces)) == asg_idx:
+                if ids.get(term_key(term)) == asg_idx:
                     n += 1
         self.cnt_asg[asg_idx, row] = n
 
@@ -845,6 +1030,7 @@ class PodBatch:
     inc_sg: np.ndarray = None         # f32[P, SG]  assigning pod bumps sg counts
     inc_asg: np.ndarray = None        # f32[P, ASG] pod carries this anti group
     match_asg: np.ndarray = None      # f32[P, ASG] pod labels match anti group
+    pod_ns: np.ndarray = None         # i32[P] namespace vocab id (ns_cap=unknown)
     # id-based duals of the dense selector arrays (for packed transport;
     # -1 padded; see models/assign.PackSpec)
     sel_ids: np.ndarray = None        # i32[P, G, 8]
@@ -883,6 +1069,7 @@ class PodBatch:
             "inc_sg": ((P, c.sg_cap), np.float32, 0.0),
             "inc_asg": ((P, c.asg_cap), np.float32, 0.0),
             "match_asg": ((P, c.asg_cap), np.float32, 0.0),
+            "pod_ns": ((P,), np.int32, c.ns_cap),
             "sel_ids": ((P, c.g_cap, 8), np.int32, -1),
             "sel_forb_ids": ((P, 8), np.int32, -1),
             "key_ids": ((P, c.kg_cap, 4), np.int32, -1),
@@ -1010,11 +1197,14 @@ class BatchEncoder:
             any_prefer = bool(base_prefer.any())
         is_plain = self._is_plain
         # ns-anti guard: once armed (a namespaceSelector anti-affinity
-        # pod escaped), any pod whose labels could match one of those
-        # selectors must take the oracle too — zero cost while unarmed.
-        # Arming can happen MID-batch (the arming pod's _encode_pod runs
-        # inside this loop): the post-loop re-scan below retroactively
-        # escapes earlier same-batch pods the live guard missed.
+        # term could not REGISTER — asg bucket overflow), any pod whose
+        # labels could match one of those selectors must take the
+        # oracle too — zero cost while unarmed, which is now the normal
+        # state (registered ns terms are covered by resolved groups +
+        # namespace masks, not the guard).  Arming can happen MID-batch
+        # (the arming pod's _encode_pod runs inside this loop): the
+        # post-loop re-scan below retroactively escapes earlier
+        # same-batch pods the live guard missed.
         guard_n0 = len(t.ns_anti_kv) + int(t.ns_anti_complex)
         guard_kv = t.ns_anti_kv if guard_n0 else None
         guard_all = t.ns_anti_complex
@@ -1024,7 +1214,7 @@ class BatchEncoder:
                     or any(kv in guard_kv for kv in pi.labels.items())):
                 b.escape.append(i)
                 b.escape_reasons[i] = ("InterPodAffinity",
-                                       "namespace_selector")
+                                       "ns_anti_guard")
                 continue
             if is_plain(pi):
                 b.p_valid[i] = True
@@ -1066,7 +1256,7 @@ class BatchEncoder:
                     b.p_valid[i] = False
                     b.escape.append(i)
                     b.escape_reasons[i] = ("InterPodAffinity",
-                                           "namespace_selector")
+                                           "ns_anti_guard")
         # cross-pod: inc/match rows vs the registered groups — via the
         # exact-kv index (O(pod labels)) + the short complex-selector
         # scan, so 2000 per-service groups don't cost 2000 matches/pod
@@ -1077,7 +1267,18 @@ class BatchEncoder:
             kvi_sg, cx_sg = t._sg_kv_index, t._sg_complex
             kvi_asg, cx_asg = t._asg_kv_index, t._asg_complex
             asg_ids = t._asg_ids
+            term_key = t.term_group_key
+            # per-pod namespace ids for the device masks — only when a
+            # namespaceSelector group has interned namespaces (plain
+            # workloads leave the vocab empty and pod_ns lazy, so
+            # batches without such terms pay nothing)
+            pod_ns = b.ensure(c, "pod_ns") if len(t.ns_vocab) else None
+            ns_lookup = t.ns_vocab.lookup
             for i, pi in enumerate(pods):
+                if pod_ns is not None:
+                    nid = ns_lookup(meta.namespace(pi.pod))
+                    if nid is not None:
+                        pod_ns[i] = nid
                 if not b.p_valid[i]:
                     continue
                 if inc_sg is not None:
@@ -1097,9 +1298,7 @@ class BatchEncoder:
                         if g.matches_pod(pi):
                             match_asg[i, idx] = 1.0
                     for term in pi.required_anti_affinity_terms:
-                        idx = asg_ids.get((term.topology_key,
-                                           term.selector,
-                                           term.namespaces))
+                        idx = asg_ids.get(term_key(term))
                         if idx is not None:
                             inc_asg[i, idx] += 1.0
         # collided-bucket post-pass (AFTER all registrations, so buckets
@@ -1157,29 +1356,44 @@ class BatchEncoder:
                 return True
         return False
 
-    def _arm_ns_anti_guard(self, pi: PodInfo) -> None:
-        """Record a pod's namespaceSelector ANTI terms in the guard —
-        called for EVERY non-plain pod before any escape path, so no
-        escape route (nominated, volumes, preferred terms, overflow)
-        can leave a later device placement unchecked against them."""
+    def _arm_ns_anti_guard(self, term) -> None:
+        """Record one namespaceSelector ANTI term in the conservative
+        guard — the fallback for terms whose group could NOT register
+        (asg bucket overflow): later pods whose labels could match the
+        selector escape to the oracle, so a device placement can never
+        violate the unregistered term."""
+        t = self.t
+        kv = _exact_kv(SelectorGroup("", term.selector, frozenset()))
+        if kv is not None:
+            t.ns_anti_kv.add(kv)
+        else:
+            t.ns_anti_complex = True
+
+    def _cover_ns_anti_terms(self, pi: PodInfo) -> None:
+        """Pre-register the resolved ANTI groups of a namespaceSelector
+        pod — called before any escape path, so even if the pod escapes
+        (nominated node, volumes, overflow), its anti constraint is
+        still enforced on the device path once the oracle binds it (the
+        bound pod's terms count into cnt_asg via the resolved term
+        key).  Only registration failure arms the conservative guard."""
         t = self.t
         for term in pi.required_anti_affinity_terms:
-            if term.ns_selector is not None:
-                kv = _exact_kv(SelectorGroup("", term.selector,
-                                             frozenset()))
-                if kv is not None:
-                    t.ns_anti_kv.add(kv)
-                else:
-                    t.ns_anti_complex = True
+            if term.ns_selector is None:
+                continue
+            sg = t.group_for_term(term)
+            t.intern_namespaces(sg.namespaces)  # mask falls back all-ones
+            if t.register_asg(sg) is None:
+                self._arm_ns_anti_guard(term)
 
     # returns False -> escape to oracle path
     def _encode_pod(self, b: PodBatch, i: int, pi: PodInfo) -> bool:
         t, c = self.t, self.t.caps
         if pi.has_ns_selector_terms:
-            self._arm_ns_anti_guard(pi)
-            # namespaceSelector terms need per-cycle namespace-label
-            # resolution (a lister) the tensor encoding does not carry
-            return self._esc("InterPodAffinity", "namespace_selector")
+            # namespaceSelector terms resolve to concrete namespace sets
+            # against the cached Namespace labels and encode like any
+            # other term; the pre-pass keeps anti terms enforced on every
+            # escape route out of this function
+            self._cover_ns_anti_terms(pi)
         if pi.nominated_node_name:
             # preemption nominations go through the per-pod path
             return self._esc("DefaultPreemption", "nominated_node")
@@ -1288,25 +1502,46 @@ class BatchEncoder:
                 maxskew=tsc.get("maxSkew", 1),
                 selfmatch=1.0 if sel.matches(pi.labels) else 0.0)
         for term in pi.required_affinity_terms:
-            sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
+            sg = t.group_for_term(term)
+            if (term.ns_selector is not None
+                    and not t.intern_namespaces(sg.namespaces)):
+                return self._esc("InterPodAffinity",
+                                 "namespace_vocab_overflow")
             # counts ENABLE here (gathered>0 satisfies): exclusive only
             add_constraint(C_AFFINITY, t.register_sg(sg),
                            selfmatch=1.0 if sg.matches_pod(pi) else 0.0)
         for term in pi.required_anti_affinity_terms:
-            sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
+            sg = t.group_for_term(term)
+            if (term.ns_selector is not None
+                    and not t.intern_namespaces(sg.namespaces)):
+                # the group itself registered in _cover_ns_anti_terms
+                # (exact host counts, all-ones mask); only the encoding
+                # pod takes the oracle
+                return self._esc("InterPodAffinity",
+                                 "namespace_vocab_overflow")
             # counts BLOCK here: sharing is sound (upper bounds)
             add_constraint(C_ANTI_AFFINITY, t.register_sg(sg,
                                                           shareable=True))
             if t.register_asg(sg) is None:
+                if term.ns_selector is not None:
+                    self._arm_ns_anti_guard(term)
                 return self._esc("InterPodAffinity", "anti_group_overflow")
         for term in pi.preferred_affinity_terms:
-            sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
+            sg = t.group_for_term(term)
+            if (term.ns_selector is not None
+                    and not t.intern_namespaces(sg.namespaces)):
+                return self._esc("InterPodAffinity",
+                                 "namespace_vocab_overflow")
             # scoring only: inflation distorts a score, never legality
             add_constraint(C_PREF_AFFINITY,
                            t.register_sg(sg, shareable=True),
                            weight=float(term.weight))
         for term in pi.preferred_anti_affinity_terms:
-            sg = SelectorGroup(term.topology_key, term.selector, term.namespaces)
+            sg = t.group_for_term(term)
+            if (term.ns_selector is not None
+                    and not t.intern_namespaces(sg.namespaces)):
+                return self._esc("InterPodAffinity",
+                                 "namespace_vocab_overflow")
             add_constraint(C_PREF_AFFINITY,
                            t.register_sg(sg, shareable=True),
                            weight=-float(term.weight))

@@ -967,7 +967,8 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
             "alloc": t.alloc, "maxpods": t.maxpods, "valid": t.valid,
             "taint_mask": t.taint_mask, "label_mask": t.label_mask,
             "key_mask": t.key_mask, "dom_sg": t.dom_sg,
-            "dom_asg": t.dom_asg})
+            "dom_asg": t.dom_asg, "sg_ns_mask": t.sg_ns_mask,
+            "asg_ns_mask": t.asg_ns_mask})
         self._post("/static", body)
         self._ckpt_static_body = body  # the post IS the checkpoint
         self._static_node = True  # sentinel: worker holds the arrays

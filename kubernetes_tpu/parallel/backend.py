@@ -90,6 +90,14 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                 {k: NamedSharding(self.mesh, v)
                  for k, v in pod_specs().items()})
 
+    # -- namespace events ------------------------------------------------
+
+    def note_namespace_event(self, event_type: str, obj, old=None) -> None:
+        """Namespace informer feed — see ops/backend.py; keeps the
+        namespaceSelector resolution cache coherent between batches."""
+        with self._lock:
+            self.tensors.note_namespace(obj, deleted=event_type == "DELETED")
+
     # -- device sync -----------------------------------------------------
 
     def warmup(self) -> None:
@@ -133,7 +141,8 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         raw = {"alloc": t.alloc, "maxpods": t.maxpods, "valid": t.valid,
                "taint_mask": t.taint_mask, "label_mask": t.label_mask,
                "key_mask": t.key_mask, "dom_sg": t.dom_sg,
-               "dom_asg": t.dom_asg}
+               "dom_asg": t.dom_asg, "sg_ns_mask": t.sg_ns_mask,
+               "asg_ns_mask": t.asg_ns_mask}
         shard = self._shardings[1]
         self._static_node = {k: jax.device_put(v, shard[k])
                              for k, v in raw.items()}

@@ -40,6 +40,8 @@ def node_specs(axis: str = NODE_AXIS) -> dict:
         "dom_sg": P(None, axis), "dom_asg": P(None, axis),
         # per-domain count tables are small and replicated
         "cd_sg": P(), "cd_asg": P(),
+        # per-group namespace membership masks have no node axis: replicated
+        "sg_ns_mask": P(), "asg_ns_mask": P(),
     }
 
 
@@ -49,13 +51,13 @@ def pod_specs() -> dict:
             "sel_any", "sel_any_active", "sel_forb", "key_any",
             "key_any_active", "key_forb", "ports", "node_row", "c_kind",
             "c_sg", "c_maxskew", "c_selfmatch", "c_weight", "inc_sg",
-            "inc_asg", "match_asg"]
+            "inc_asg", "match_asg", "pod_ns"]
     return {k: P() for k in keys}
 
 
 STATE_KEYS = ("used", "used_nz", "npods", "port_mask", "cd_sg", "cd_asg")
 STATIC_KEYS = ("alloc", "maxpods", "valid", "taint_mask", "label_mask",
-               "key_mask", "dom_sg", "dom_asg")
+               "key_mask", "dom_sg", "dom_asg", "sg_ns_mask", "asg_ns_mask")
 
 
 def state_specs(axis: str = NODE_AXIS) -> dict:

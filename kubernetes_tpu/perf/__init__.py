@@ -30,4 +30,4 @@ def caps_for_nodes(n_nodes: int):
     from ..ops.flatten import Caps
     n_cap = max(1024, -(-int(n_nodes * 1.1) // 256) * 256)
     return Caps(n_cap=n_cap, l_cap=256, kl_cap=62, t_cap=16, pt_cap=16,
-                s_cap=3, sg_cap=16, asg_cap=16, c_cap=2)
+                s_cap=3, sg_cap=16, asg_cap=16, c_cap=2, ns_cap=256)

@@ -30,7 +30,7 @@ from typing import Callable, Sequence
 
 from ..api import meta
 from ..api.meta import Obj
-from ..client.clientset import Client, NODES, PODS
+from ..client.clientset import Client, NAMESPACES, NODES, PODS
 from ..client.informer import SharedInformerFactory
 from ..store import kv
 from ..component_base import tracing
@@ -322,6 +322,18 @@ class Scheduler:
             nodes.add_bulk_event_handler(self._on_node_events)
         else:  # pragma: no cover - non-bulk informer stand-ins
             nodes.add_event_handler(self._on_node_event)
+        # namespace label events feed the batch backends' namespaceSelector
+        # resolution caches (ops/flatten.py); rare enough that the plain
+        # per-event handler suffices
+        namespaces = self.informer_factory.informer(NAMESPACES)
+        namespaces.add_event_handler(self._on_namespace_event)
+
+    def _on_namespace_event(self, type_: str, ns: Obj,
+                            old: Obj | None) -> None:
+        for profile in self.profiles.values():
+            fn = getattr(profile.batch_backend, "note_namespace_event", None)
+            if fn is not None:
+                fn(type_, ns, old)
 
     def _on_node_events(self, triples: list) -> None:
         """Bulk node-event handler: a registration flood (100k createNodes)

@@ -117,7 +117,9 @@ class AffinityTerm:
     per cycle via a namespace lister, plugins/interpodaffinity).  An
     EMPTY ns_selector matches every namespace.  Callers that cannot
     supply ns_labels treat ns_selector terms as namespace-list-only
-    (the TPU encoder escapes such pods instead, flatten._encode_pod)."""
+    (the TPU encoder instead resolves the term against its informer-fed
+    namespace-label cache into a concrete namespace set at flatten time,
+    flatten.ClusterTensors.resolve_namespaces)."""
 
     selector: Selector
     topology_key: str

@@ -224,6 +224,55 @@ class TestRestartResync:
         assert backend.seam_stats["state_lost"] >= 1
         assert [n for n, _ in got] == [n for n, _ in want]
 
+    def test_ns_selector_tensors_round_trip_with_kill(self, worker):
+        """Acceptance: the namespace tensors (per-pod namespace ids via
+        the packed step buffer, per-group namespace masks via /static)
+        round-trip the seam on BOTH transports and survive a mid-stream
+        kill+resync — assignments bit-identical to the in-process
+        backend fed the identical namespace events, with zero escapes."""
+        schedule = KillOnNthStep(2)
+        backend, transport = faulty_backend(worker, schedule, batch_size=8)
+        reference = TPUBatchBackend(small_caps(), batch_size=8)
+        namespaces = [
+            {"metadata": {"name": "ns-dev-a", "labels": {"team": "dev"}}},
+            {"metadata": {"name": "ns-dev-b", "labels": {"team": "dev"}}},
+            {"metadata": {"name": "ns-ops", "labels": {"team": "ops"}}},
+        ]
+        for b in (backend, reference):
+            for ns in namespaces:
+                b.note_namespace_event("ADDED", ns)
+        nodes = [make_node(f"h{i}")
+                 .labels(**{"kubernetes.io/hostname": f"h{i}"})
+                 .capacity(cpu="8", mem="32Gi").build() for i in range(6)]
+        snap = snapshot_from(nodes)
+
+        def anti_pod(name, ns):
+            p = make_pod(name, ns).labels(color="green").req(
+                cpu="100m").build()
+            p["spec"]["affinity"] = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"color": "green"}},
+                     "namespaceSelector": {"matchLabels": {"team": "dev"}}}]}}
+            return PodInfo(p)
+
+        ns_cycle = ["ns-dev-a", "ns-dev-b", "ns-ops", "default"]
+        first = [anti_pod(f"a{i}", ns_cycle[i % 4]) for i in range(4)]
+        # the second batch's /step is the 2nd overall -> lands on a
+        # restarted worker: the resync must replay the namespace masks
+        # (static) AND the first batch's committed claims
+        second = [anti_pod(f"b{i}", ns_cycle[i % 4]) for i in range(4)]
+        got = [backend.assign(list(batch), snap)
+               for batch in (first, second)]
+        want = [reference.assign(list(batch), snap)
+                for batch in (first, second)]
+        assert transport.injected[KILL] == 1
+        assert backend.seam_stats["resyncs"] >= 1
+        for g, w in zip(got, want):
+            assert [n for n, _ in g] == [n for n, _ in w]
+        assert backend.drain_escape_reasons() == {}
+        assert reference.drain_escape_reasons() == {}
+
     def test_kill_then_more_batches_keep_chaining(self, worker):
         """Resident-state chaining survives a restart: claims committed
         before AND replayed after the kill constrain later batches."""

@@ -40,6 +40,7 @@ CASES = [
     ("SchedulingWithMixedChurn", 100, 100),
     ("SchedulingRequiredPodAntiAffinityWithNSSelector", 100, 100),
     ("SchedulingPreferredAffinityWithNSSelector", 100, 100),
+    ("SchedulingNSSelectorDense", 100, 100),
 ]
 
 
@@ -112,6 +113,27 @@ def test_preemption_evicts_victims():
     finally:
         collector.stop()
         cluster.shutdown()
+
+
+@pytest.mark.parametrize("name", [
+    "SchedulingRequiredPodAntiAffinityWithNSSelector",
+    "SchedulingPreferredAffinityWithNSSelector",
+])
+def test_ns_selector_workloads_run_device_path(name):
+    """Regression guard: namespaceSelector terms are tensor-encoded
+    (resolved against the namespace-label cache), so the two NS-selector
+    workloads must report escape_rate == 0.0 on the in-process device
+    backend — the oracle fallback must not silently come back."""
+    from kubernetes_tpu.ops.flatten import Caps
+    from kubernetes_tpu.perf.scheduler_perf import run_named_workload
+    cfg = shrink(load_workloads()[name], 100, 100)
+    caps = Caps(n_cap=64, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                s_cap=2, sg_cap=8, asg_cap=8, c_cap=2, ns_cap=128)
+    summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
+                                        batch_size=64)
+    assert stats.get("barrier_ok"), stats
+    assert stats.get("backend_stats", {}).get("pods", 0) > 0, stats
+    assert stats.get("escape_rate", 1.0) == 0.0, stats
 
 
 def test_mixed_escapes_reports_nonzero_escape_rate():

@@ -357,13 +357,10 @@ class TestNamespaceSelector:
             factory.stop()
             client.close()
 
-    def test_encoder_escapes_and_arms_guard(self):
-        from kubernetes_tpu.ops.flatten import BatchEncoder, Caps, ClusterTensors
+    @staticmethod
+    def _seed_cache():
         from kubernetes_tpu.scheduler.cache import Cache
-        from kubernetes_tpu.scheduler.types import PodInfo
-        from kubernetes_tpu.testing import make_node, make_pod
-        caps = Caps(n_cap=16, l_cap=32, kl_cap=16, t_cap=4, pt_cap=4,
-                    s_cap=2, sg_cap=4, asg_cap=4, c_cap=2)
+        from kubernetes_tpu.testing import make_node
         cache = Cache()
         for i in range(4):
             n = make_node(f"n{i}").capacity(cpu="8", mem="32Gi",
@@ -371,9 +368,11 @@ class TestNamespaceSelector:
             n["metadata"].setdefault("labels", {})[
                 "kubernetes.io/hostname"] = f"n{i}"
             cache.add_node(n)
-        t = ClusterTensors(caps)
-        t.update_from_snapshot_tracked(cache.flatten_view())
-        enc = BatchEncoder(t, 8)
+        return cache
+
+    @staticmethod
+    def _ns_anti_pod():
+        from kubernetes_tpu.testing import make_pod
         anti_pod = make_pod("a").req(cpu="100m").build()
         anti_pod["metadata"]["labels"] = {"c": "g"}
         anti_pod["spec"]["affinity"] = {"podAntiAffinity": {
@@ -381,21 +380,80 @@ class TestNamespaceSelector:
                 {"topologyKey": "kubernetes.io/hostname",
                  "labelSelector": {"matchLabels": {"c": "g"}},
                  "namespaceSelector": {"matchLabels": {"team": "dev"}}}]}}
+        return anti_pod
+
+    def test_encoder_resolves_ns_selector_to_device_path(self):
+        """namespaceSelector terms resolve against the namespace-label
+        cache and ride the tensor path — no escape, no guard."""
+        from kubernetes_tpu.ops.flatten import BatchEncoder, Caps, ClusterTensors
+        from kubernetes_tpu.scheduler.types import PodInfo
+        from kubernetes_tpu.testing import make_pod
+        caps = Caps(n_cap=16, l_cap=32, kl_cap=16, t_cap=4, pt_cap=4,
+                    s_cap=2, sg_cap=4, asg_cap=4, c_cap=2)
+        cache = self._seed_cache()
+        t = ClusterTensors(caps)
+        t.update_from_snapshot_tracked(cache.flatten_view())
+        t.set_namespace_labels("default", {"team": "dev"})
+        t.set_namespace_labels("ops-ns", {"team": "ops"})
+        enc = BatchEncoder(t, 8)
         plain_matching = make_pod("m").req(cpu="100m").build()
         plain_matching["metadata"]["labels"] = {"c": "g"}
         plain_other = make_pod("o").req(cpu="100m").build()
-        # arming pod FIRST in the batch, then a matching plain pod, then
-        # an unrelated plain pod
-        b = enc.encode([PodInfo(anti_pod), PodInfo(plain_matching),
-                        PodInfo(plain_other)])
-        assert 0 in b.escape            # ns-selector term -> oracle
-        assert 1 in b.escape            # guard: labels match the anti kv
-        assert 2 not in b.escape        # unrelated pod rides the device
+        b = enc.encode([PodInfo(self._ns_anti_pod()),
+                        PodInfo(plain_matching), PodInfo(plain_other)])
+        assert b.escape == []
+        assert not t.ns_anti_kv and not t.ns_anti_complex
+        # the registered anti group carries the RESOLVED namespace set
+        # (only default matches team=dev), and its device mask is exact
+        groups = [g for bk in t.asgs for g in bk.groups]
+        assert len(groups) == 1
+        assert groups[0].namespaces == frozenset({"default"})
+        assert groups[0].ns_selector is not None
+        nid = t.ns_vocab.lookup("default")
+        row = t.asg_ns_mask[0]
+        assert row[nid] == 1.0 and row.sum() == 1.0
+        # matching pods in a dev-labeled namespace count into the group
+        assert b.match_asg[0, 0] == 1.0 and b.match_asg[1, 0] == 1.0
+        assert b.inc_asg[0, 0] == 1.0
+        assert b.pod_ns[0] == nid
+
+    def test_guard_arms_only_on_asg_overflow(self):
+        """When the resolved anti group cannot register (asg bucket
+        overflow), the conservative guard still protects label-matching
+        pods — including retroactively within the arming batch."""
+        from kubernetes_tpu.ops.flatten import BatchEncoder, Caps, ClusterTensors
+        from kubernetes_tpu.scheduler.types import PodInfo
+        from kubernetes_tpu.testing import make_pod
+        caps = Caps(n_cap=16, l_cap=32, kl_cap=16, t_cap=4, pt_cap=4,
+                    s_cap=2, sg_cap=8, asg_cap=2, c_cap=2)
+        cache = self._seed_cache()
+        t = ClusterTensors(caps)
+        t.update_from_snapshot_tracked(cache.flatten_view())
+        t.set_namespace_labels("default", {"team": "dev"})
+        enc = BatchEncoder(t, 8)
+        # fill every asg slot with zone-key buckets: the hostname-key ns
+        # term can then never probe into a compatible bucket
+        fillers = []
+        for i in range(caps.asg_cap):
+            f = make_pod(f"f{i}").req(cpu="100m").build()
+            f["metadata"]["labels"] = {"f": str(i)}
+            f["spec"]["affinity"] = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "zone",
+                     "labelSelector": {"matchLabels": {"f": str(i)}}}]}}
+            fillers.append(PodInfo(f))
+        plain_matching = make_pod("m").req(cpu="100m").build()
+        plain_matching["metadata"]["labels"] = {"c": "g"}
+        before = PodInfo(plain_matching)
+        after = PodInfo(plain_matching)
+        b = enc.encode(fillers + [before, PodInfo(self._ns_anti_pod()),
+                                  after])
+        k = caps.asg_cap
         assert ("c", "g") in t.ns_anti_kv
-        # mid-batch arming: matching pod BEFORE the arming pod must be
-        # retroactively escaped
-        t2 = ClusterTensors(caps)
-        t2.update_from_snapshot_tracked(cache.flatten_view())
-        enc2 = BatchEncoder(t2, 8)
-        b2 = enc2.encode([PodInfo(plain_matching), PodInfo(anti_pod)])
-        assert 0 in b2.escape and 1 in b2.escape
+        assert b.escape_reasons[k + 1] == ("InterPodAffinity",
+                                           "anti_group_overflow")
+        # retroactive (before) and live (after) guard escapes
+        assert b.escape_reasons[k] == ("InterPodAffinity", "ns_anti_guard")
+        assert b.escape_reasons[k + 2] == ("InterPodAffinity",
+                                           "ns_anti_guard")
+        assert all(i not in b.escape for i in range(k))

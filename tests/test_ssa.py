@@ -142,6 +142,25 @@ class TestApplyOverHTTP:
                           field_manager="hpa", force=True)
         assert forced["spec"]["replicas"] == 9
 
+    def test_cluster_scoped_apply_strips_stray_namespace(self, server):
+        """A Namespace (cluster-scoped) applied with a stray
+        metadata.namespace — what a naive client stamps on everything —
+        must store under the cluster-scoped key, or the object-GET path
+        (/api/v1/namespaces/{name}) can never find it again."""
+        c = HTTPClient.from_url(server.url)
+        applied = {"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": "team-a", "namespace": "default",
+                                "labels": {"team": "a"}}}
+        created = c.apply("namespaces", applied, field_manager="kubectl")
+        assert created["metadata"].get("namespace") in (None, "")
+        got = c.get("namespaces", None, "team-a")
+        assert got["metadata"]["labels"] == {"team": "a"}
+        # second apply merges with the live object instead of forking
+        applied["metadata"]["labels"] = {"team": "b"}
+        merged = c.apply("namespaces", applied, field_manager="kubectl")
+        assert merged["metadata"]["labels"] == {"team": "b"}
+        assert merged["metadata"].get("namespace") in (None, "")
+
     def test_put_records_update_manager(self, server):
         c = HTTPClient.from_url(server.url)
         c.create("configmaps", {"apiVersion": "v1", "kind": "ConfigMap",

@@ -245,6 +245,171 @@ class TestTopologyAndAffinity:
         assert out[0] == "n1"
 
 
+def make_ns(name, **labels):
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": name, "labels": dict(labels)}}
+
+
+def ns_anti_affinity(match, ns_match):
+    return {"podAntiAffinity": {
+        "requiredDuringSchedulingIgnoredDuringExecution": [
+            {"topologyKey": "kubernetes.io/hostname",
+             "labelSelector": {"matchLabels": dict(match)},
+             "namespaceSelector": {"matchLabels": dict(ns_match)}}]}}
+
+
+class TestNamespaceSelectorTensors:
+    """namespaceSelector terms resolve to concrete namespace sets at
+    flatten time and run the device path — no oracle escape."""
+
+    def _backend(self, namespaces, **kw):
+        backend = TPUBatchBackend(small_caps(), **kw)
+        for ns in namespaces:
+            backend.note_namespace_event("ADDED", ns)
+        return backend
+
+    def test_anti_affinity_ns_selector_vs_existing(self):
+        # a matching pod in a dev-labeled FOREIGN namespace blocks the
+        # incoming anti pod from its host
+        existing = make_pod("e", "team-a").labels(app="web").node("n1").build()
+        nodes = [make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build(),
+                 make_node("n2").labels(**{"kubernetes.io/hostname": "n2"}).build()]
+        snap = snapshot_from(nodes, [existing])
+        backend = self._backend(
+            [make_ns("team-a", team="dev"), make_ns("team-b", team="ops")],
+            batch_size=1)
+        pod = make_pod("p").labels(app="web").build()
+        pod["spec"]["affinity"] = ns_anti_affinity(
+            {"app": "web"}, {"team": "dev"})
+        out = run_assign(backend, [pod], snap)
+        assert out[0] == "n2"
+        assert backend.drain_escape_reasons() == {}
+
+    def test_ns_selector_ignores_unselected_namespace(self):
+        # same shape, but the existing pod's namespace does NOT carry the
+        # selected label: the anti term must not see it
+        existing = make_pod("e", "team-b").labels(app="web").node("n1").build()
+        nodes = [make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build()]
+        snap = snapshot_from(nodes, [existing])
+        backend = self._backend(
+            [make_ns("team-a", team="dev"), make_ns("team-b", team="ops")],
+            batch_size=1)
+        pod = make_pod("p").labels(app="web").build()
+        pod["spec"]["affinity"] = ns_anti_affinity(
+            {"app": "web"}, {"team": "dev"})
+        out = run_assign(backend, [pod], snap)
+        assert out[0] == "n1"
+        assert backend.drain_escape_reasons() == {}
+
+    def test_preferred_affinity_ns_selector_colocates(self):
+        existing = make_pod("e", "team-a").labels(app="cache").node("n1").build()
+        nodes = [make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build(),
+                 make_node("n2").labels(**{"kubernetes.io/hostname": "n2"}).build()]
+        snap = snapshot_from(nodes, [existing])
+        backend = self._backend([make_ns("team-a", team="dev")],
+                                batch_size=1, weights={"affinity": 1000.0})
+        pod = make_pod("p").build()
+        pod["spec"]["affinity"] = {"podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {"weight": 10, "podAffinityTerm": {
+                    "topologyKey": "kubernetes.io/hostname",
+                    "labelSelector": {"matchLabels": {"app": "cache"}},
+                    "namespaceSelector": {"matchLabels": {"team": "dev"}}}}]}}
+        out = run_assign(backend, [pod], snap)
+        assert out[0] == "n1"
+        assert backend.drain_escape_reasons() == {}
+
+    def test_relabeled_namespace_seen_by_next_batch(self):
+        """Satellite: a namespace label change re-resolves registered
+        groups — the NEXT batch encodes against the new resolution."""
+        existing = make_pod("e", "team-a").labels(app="db").node("n1").build()
+        nodes = [make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build(),
+                 make_node("n2").labels(**{"kubernetes.io/hostname": "n2"}).build()]
+        snap = snapshot_from(nodes, [existing])
+        backend = self._backend([make_ns("team-a", team="dev")], batch_size=1)
+
+        def affinity_pod(name):
+            p = make_pod(name).build()
+            p["spec"]["affinity"] = {"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"app": "db"}},
+                     "namespaceSelector": {"matchLabels": {"team": "dev"}}}]}}
+            return p
+
+        out = run_assign(backend, [affinity_pod("p1")], snap)
+        assert out[0] == "n1"  # colocate with the dev-namespace db pod
+        # relabel team-a out of the selected set: the SAME term now
+        # resolves to no namespace, so required affinity is unsatisfiable
+        backend.note_namespace_event(
+            "MODIFIED", make_ns("team-a", team="ops"))
+        infos = [PodInfo(affinity_pod("p2"))]
+        name, status = backend.assign(infos, snap)[0]
+        assert name is None and status is not None
+        assert backend.drain_escape_reasons() == {}
+
+    def test_deleted_namespace_seen_by_next_batch(self):
+        existing = make_pod("e", "team-a").labels(app="web").node("n1").build()
+        nodes = [make_node("n1").labels(**{"kubernetes.io/hostname": "n1"}).build()]
+        snap = snapshot_from(nodes, [existing])
+        backend = self._backend([make_ns("team-a", team="dev")], batch_size=1)
+
+        def anti_pod(name):
+            p = make_pod(name).labels(app="web").build()
+            p["spec"]["affinity"] = ns_anti_affinity(
+                {"app": "web"}, {"team": "dev"})
+            return p
+
+        name, status = backend.assign([PodInfo(anti_pod("p1"))], snap)[0]
+        assert name is None  # the single host is blocked
+        backend.note_namespace_event("DELETED", make_ns("team-a", team="dev"))
+        out = run_assign(backend, [anti_pod("p2")], snap)
+        assert out[0] == "n1"  # deleted namespace no longer resolves
+        assert backend.drain_escape_reasons() == {}
+
+    def test_randomized_ns_anti_parity_with_oracle(self):
+        """Placements must satisfy every required anti term of every pod
+        sharing a host, verified through AffinityTerm.matches — the
+        per-pod oracle's namespace resolution."""
+        rng = random.Random(7)
+        ns_labels = {"ns-a": {"team": "dev"}, "ns-b": {"team": "dev"},
+                     "ns-c": {"team": "ops"}, "default": {}}
+        namespaces = [make_ns(n, **l) for n, l in ns_labels.items()]
+        for trial in range(3):
+            nodes = [make_node(f"n{i}").labels(
+                **{"kubernetes.io/hostname": f"n{i}"}).build()
+                for i in range(6)]
+            snap = snapshot_from(nodes)
+            backend = self._backend(namespaces, batch_size=16)
+            pods = []
+            for i in range(12):
+                ns = rng.choice(list(ns_labels))
+                p = make_pod(f"t{trial}p{i}", ns).req(cpu="50m").build()
+                p["metadata"]["labels"] = {"app": rng.choice(["web", "db"])}
+                if rng.random() < 0.5:
+                    p["spec"]["affinity"] = ns_anti_affinity(
+                        {"app": p["metadata"]["labels"]["app"]},
+                        {"team": rng.choice(["dev", "ops"])})
+                pods.append(p)
+            infos = [PodInfo(p) for p in pods]
+            results = backend.assign(infos, snap)
+            assert backend.drain_escape_reasons() == {}
+            by_node: dict = {}
+            for pi, (name, _st) in zip(infos, results):
+                if name is not None:
+                    by_node.setdefault(name, []).append(pi)
+            for placed in by_node.values():
+                for a in placed:
+                    for b in placed:
+                        if a is b:
+                            continue
+                        for term in a.required_anti_affinity_terms:
+                            assert not term.matches(
+                                b.pod, b.labels, ns_labels), (
+                                f"{a.key} anti term matches co-located "
+                                f"{b.key}")
+
+
 class TestEscapeHatch:
     def test_gt_operator_escapes(self):
         nodes = [make_node("n1").build()]

@@ -269,10 +269,13 @@ class TestMetricsExposition:
 # -- escape-reason telemetry (satellite) ------------------------------------
 
 def _ns_selector_pod(name: str):
-    """Required pod-anti-affinity with a namespaceSelector — the one
-    InterPodAffinity shape the flattener can NOT encode; it must escape
-    with reason namespace_selector (testing.wrappers has no
-    namespaceSelector builder, so the spec is set by hand)."""
+    """Required pod-anti-affinity with a namespaceSelector.  These terms
+    resolve to concrete namespace sets and tensor-encode; to produce a
+    deterministic escape the tests below pair this pod with an ns_cap too
+    small for the resolved set (reason namespace_vocab_overflow), the one
+    genuinely unresolvable case that is cheap to construct
+    (testing.wrappers has no namespaceSelector builder, so the spec is
+    set by hand)."""
     pod = make_pod(name).build()
     pod["spec"]["affinity"] = {"podAntiAffinity": {
         "requiredDuringSchedulingIgnoredDuringExecution": [{
@@ -282,15 +285,26 @@ def _ns_selector_pod(name: str):
     return pod
 
 
+def _overflow_backend(**kw):
+    """Backend whose namespace vocab (ns_cap=1) cannot hold the two
+    team=a namespaces the _ns_selector_pod term resolves to."""
+    backend = TPUBatchBackend(small_caps(ns_cap=1), **kw)
+    for ns in ("ns-one", "ns-two"):
+        backend.note_namespace_event("ADDED", {
+            "metadata": {"name": ns, "labels": {"team": "a"}}})
+    return backend
+
+
 class TestEscapeTelemetry:
-    def test_backend_tallies_namespace_selector(self):
+    def test_backend_tallies_namespace_vocab_overflow(self):
         nodes = [make_node(f"n{i}").build() for i in range(2)]
-        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        backend = _overflow_backend(batch_size=4)
         infos = [PodInfo(_ns_selector_pod("nsp")),
                  PodInfo(make_pod("plain").build())]
         backend.assign(infos, snapshot_from(nodes))
         drained = backend.drain_escape_reasons()
-        assert drained.get(("InterPodAffinity", "namespace_selector"), 0) >= 1
+        assert drained.get(
+            ("InterPodAffinity", "namespace_vocab_overflow"), 0) >= 1
         assert backend.drain_escape_reasons() == {}   # drain empties
 
     def test_scheduler_drain_feeds_prom_registry(self):
@@ -307,7 +321,7 @@ class TestEscapeTelemetry:
                 self.metrics = SchedulerMetrics()
 
         nodes = [make_node("n0").build()]
-        backend = TPUBatchBackend(small_caps(), batch_size=4)
+        backend = _overflow_backend(batch_size=4)
         backend.assign([PodInfo(_ns_selector_pod("nsp")),
                         PodInfo(make_pod("plain").build())],
                        snapshot_from(nodes))
@@ -315,10 +329,11 @@ class TestEscapeTelemetry:
         host._drain_backend_telemetry(backend)
         gathered = host.metrics.prom.registry.gather()
         esc = gathered["scheduler_tpu_escape_total"]
-        assert esc.get(("InterPodAffinity", "namespace_selector"), 0) >= 1
+        assert esc.get(
+            ("InterPodAffinity", "namespace_vocab_overflow"), 0) >= 1
         text = host.metrics.prom.expose()
         assert 'scheduler_tpu_escape_total{plugin="InterPodAffinity"' in text
-        assert 'reason="namespace_selector"' in text
+        assert 'reason="namespace_vocab_overflow"' in text
         # batch telemetry rides the same drain
         count, _ = gathered["scheduler_tpu_feasible_nodes"][()]
         assert count >= 1

@@ -168,6 +168,39 @@ def test_spans_are_context_managed_or_ended():
         f"{offenders}")
 
 
+def test_escapes_always_record_a_reason():
+    """Telemetry invariant (ISSUE: namespaceSelector tensor-encode):
+    every `…escape.append(…)` site in ops/flatten.py must be paired with
+    an `escape_reasons` write in the same function — an escape with no
+    reason shows up in scheduler_tpu_escape_total as an unexplained
+    delta, which defeats the 'distinguish unsupported from capacity'
+    contract the escape metrics exist for."""
+    import ast
+
+    path = ROOT / "ops" / "flatten.py"
+    tree = ast.parse(path.read_text())
+    offenders = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        appends = [
+            n for n in ast.walk(fn)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "append"
+            and isinstance(n.func.value, ast.Attribute)
+            and n.func.value.attr == "escape"]
+        if not appends:
+            continue
+        records_reason = any(
+            isinstance(n, ast.Attribute) and n.attr == "escape_reasons"
+            for n in ast.walk(fn))
+        if not records_reason:
+            offenders.append(f"ops/flatten.py:{fn.lineno} {fn.name}")
+    assert not offenders, (
+        f"escape.append sites without an escape_reasons write: {offenders}")
+
+
 def test_controller_registry_complete():
     """Every controller module's Controller subclass is constructible from
     the manager's registry (a new controller that isn't wired in is dead
