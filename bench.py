@@ -140,6 +140,12 @@ EXTRA_CONFIGS = {
     "PreemptionBasic": {"two_pass": True,
                         "workload": "PreemptionBasic", "batch": 1024,
                         "depth": 1, "timeout": 900.0},
+    # victim-tensor stress: 8 residents/node, multi-victim evictions, 4
+    # preemptors contending per node (batched DryRunPreemption + bulk
+    # commit; the conflict-resolution waves are the measured path)
+    "PreemptionDense": {"two_pass": True,
+                        "workload": "PreemptionDense", "batch": 1024,
+                        "depth": 1, "timeout": 900.0},
     "Unschedulable": {"workload": "Unschedulable", "batch": 4096,
                       "depth": 2, "timeout": 900.0},
     "SchedulingWithMixedChurn": {"workload": "SchedulingWithMixedChurn",

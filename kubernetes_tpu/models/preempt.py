@@ -88,3 +88,133 @@ def preempt_candidates(alloc, used, npods, maxpods, valid, reclaim,
         jnp.asarray(active), k)
     import numpy as np
     return np.asarray(rows), np.asarray(count)
+
+
+# -- full DryRunPreemption (victim tensors) -------------------------------
+#
+# The kernel above LIMITS candidates and leaves victim selection to the
+# host Evaluator.  This one IS the dry run: per preemptor x every node,
+# remove all lower-priority victims, fit-check, greedy reprieve
+# (PDB-violating first, then highest priority first — preemption.go's
+# selectVictimsOnNode re-add order), emitting the per-(pod,node) planes
+# of pickOneNodeForPreemption's exact lexicographic key plus the full
+# victim masks.  All of it is masked prefix arithmetic under one jit —
+# zero host round trips per pod; the host takes the key argmin per pod
+# so a whole wave can conflict-resolve (exclude nodes claimed by
+# earlier winners, fold their nominations) without a device call per
+# preemptor.
+#
+# Exactness envelope (the caller gates everything outside it to the
+# Evaluator): plain preemptors, no inter-pod-affinity groups live, PDB
+# scope covered by the device bits, no victim-slot overflow on reachable
+# nodes.  Priorities stay int32 end-to-end (f32 loses exactness past
+# 2^24); the priority-SUM tie-break key is f32 and therefore approximate
+# only when victim priority sums exceed 2^24 — documented, and the two
+# earlier keys (violations, highest victim priority) dominate it.
+
+I32_MAX = 2**31 - 1
+
+
+@jax.jit
+def _preempt_dry_run(alloc, used, npods, maxpods, valid, taint_mask,
+                     vict_prio, vict_req, vict_pdb, vict_over,
+                     nom_used, nom_np, group_idx, req, prio, untol_hard,
+                     active):
+    """alloc/used f32[N,R]; npods/maxpods f32[N]; valid bool[N];
+    taint_mask f32[N,T]; vict_prio i32[N,V] (VICT_PAD-filled);
+    vict_req f32[N,V,R]; vict_pdb f32[N,V]; vict_over bool[N];
+    nom_used f32[G,N,R] / nom_np f32[G,N] capacity claimed by pods
+    nominated at >= the group's priority (RunFilterPluginsWithNominatedPods);
+    group_idx i32[P]; req f32[P,R]; prio i32[P]; untol_hard f32[P,T];
+    active bool[P].
+    returns the full per-(pod,node) dry-run planes — the host commit
+    loop (ops/backend.preempt_batch) runs pickOneNodeForPreemption's
+    lexicographic pick over them so it can exclude nodes claimed by
+    earlier winners of the SAME wave without another device call:
+      (cand bool[P,N], viol f32[P,N], highest i32[P,N], psum f32[P,N],
+       nvic f32[P,N], victims bool[P,N,V], overflow_hit bool[P])."""
+    P, R = req.shape
+    N, V = vict_prio.shape
+    eps = 1e-6
+
+    # a PAD slot's priority is I32_MAX, above any clamped real priority,
+    # so the single compare also masks empty slots
+    elig = vict_prio[None, :, :] < prio[:, None, None]          # [P,N,V]
+    eligf = elig.astype(jnp.float32)
+    freed = jnp.einsum("pnv,nvr->pnr", eligf, vict_req)         # [P,N,R]
+    freed_np = jnp.sum(eligf, axis=-1)                          # [P,N]
+
+    eff_used = used[None, :, :] + nom_used[group_idx]           # [P,N,R]
+    eff_np = npods[None, :] + nom_np[group_idx]                 # [P,N]
+    taint_ok = jnp.einsum("pt,nt->pn", untol_hard, taint_mask) == 0.0
+
+    free0 = alloc[None, :, :] - eff_used + freed
+    slack0 = maxpods[None, :] - (eff_np - freed_np)
+    fits0 = jnp.all(req[:, None, :] <= free0 + eps, axis=-1)
+    fits0 &= slack0 >= 1.0
+    fits0 &= valid[None, :] & taint_ok
+    fits0 &= freed_np > 0.0             # empty `potential` -> no candidate
+    fits0 &= active[:, None]
+
+    # reprieve order is per-NODE (preemptor-independent): violating
+    # first, then highest priority first, slot index (== stable
+    # ascending ni.pods order) last — jnp.lexsort's LAST key is primary
+    slot_iota = jnp.arange(V, dtype=jnp.int32)
+    ordv = jnp.lexsort((jnp.broadcast_to(slot_iota[None, :], (N, V)),
+                        -vict_prio, -vict_pdb), axis=-1)        # [N,V]
+
+    # greedy re-add: V static steps, each one "does the preemptor still
+    # fit with this victim back?" — reprieved victims return their
+    # resources and pod slot before the next step is judged
+    free = free0
+    slack = slack0
+    reprieved = jnp.zeros((P, N, V), bool)
+    for s in range(V):
+        j = ordv[:, s]                                          # [N]
+        onehot = (slot_iota[None, :] == j[:, None])             # [N,V]
+        onehotf = onehot.astype(jnp.float32)
+        vreq_j = jnp.einsum("nv,nvr->nr", onehotf, vict_req)    # [N,R]
+        elig_j = jnp.einsum("nv,pnv->pn", onehotf, eligf) > 0.0
+        free_try = free - vreq_j[None, :, :]
+        ok = elig_j & jnp.all(req[:, None, :] <= free_try + eps, axis=-1)
+        ok &= (slack - 1.0) >= 1.0
+        free = jnp.where(ok[:, :, None], free_try, free)
+        slack = jnp.where(ok, slack - 1.0, slack)
+        reprieved |= ok[:, :, None] & onehot[None, :, :]
+
+    victims = elig & ~reprieved                                  # [P,N,V]
+    victf = victims.astype(jnp.float32)
+    nvic = jnp.sum(victf, axis=-1)                               # [P,N]
+    viol = jnp.sum(victf * vict_pdb[None, :, :], axis=-1)        # [P,N]
+    highest = jnp.max(jnp.where(victims, vict_prio[None, :, :],
+                                jnp.int32(-I32_MAX)), axis=-1)
+    highest = jnp.where(nvic > 0.0, highest, 0)                  # [P,N]
+    psum = jnp.sum(victf * vict_prio[None, :, :].astype(jnp.float32),
+                   axis=-1)                                      # [P,N]
+
+    # a dry run whose reprieve pass spared everyone is NOT a candidate
+    # (selectVictimsOnNode: `if not victims: return None`); overflow rows
+    # carry a truncated victim set, so they never win on device — the
+    # caller escapes any preemptor that can reach one
+    cand = fits0 & (nvic > 0.0) & (~vict_over)[None, :]
+    overflow_hit = jnp.any(
+        vict_over[None, :] & valid[None, :] & taint_ok & active[:, None],
+        axis=1)
+    return (cand, viol, highest, psum, nvic, victims, overflow_hit)
+
+
+def preempt_dry_run(alloc, used, npods, maxpods, valid, taint_mask,
+                    vict_prio, vict_req, vict_pdb, vict_over,
+                    nom_used, nom_np, group_idx, req, prio, untol_hard,
+                    active):
+    """Host entry: numpy in, numpy out (one blocking round trip)."""
+    out = _preempt_dry_run(
+        jnp.asarray(alloc), jnp.asarray(used), jnp.asarray(npods),
+        jnp.asarray(maxpods), jnp.asarray(valid), jnp.asarray(taint_mask),
+        jnp.asarray(vict_prio), jnp.asarray(vict_req),
+        jnp.asarray(vict_pdb), jnp.asarray(vict_over),
+        jnp.asarray(nom_used), jnp.asarray(nom_np),
+        jnp.asarray(group_idx), jnp.asarray(req), jnp.asarray(prio),
+        jnp.asarray(untol_hard), jnp.asarray(active))
+    import numpy as np
+    return tuple(np.asarray(a) for a in out)

@@ -69,9 +69,17 @@ STATIC_CORE = ("alloc", "maxpods", "valid", "taint_mask")
 # ride full uploads only — every mask mutation sets tensors.static_full
 STATIC_SEL = ("label_mask", "key_mask", "dom_sg", "dom_asg",
               "sg_ns_mask", "asg_ns_mask")
+# victim tensors (batched preemption) are a THIRD upload channel, keyed
+# by tensors.vict_version: binds dirty victim rows every batch, but the
+# rebuild+upload happen only at preemption time — and must not
+# invalidate the static cache (a STATIC_CORE re-upload is multi-MB at
+# big N).  Over the remote seam they ride the /static verb (own body
+# section), so the checkpoint replay restores them on worker resync.
+STATIC_VICT = ("vict_prio", "vict_req", "vict_pdb", "vict_over")
 
 _core_patch_jit = None
 _sel_patch_jit = None
+_vict_patch_jit = None
 
 
 def _apply_static_patch(static, rows, alloc_v, maxpods_v, valid_v, taint_v):
@@ -131,6 +139,31 @@ def _apply_sel_patch(sel, rows, label_v, key_v, dom_sg_v, dom_asg_v):
 
         _sel_patch_jit = go
     return _sel_patch_jit(sel, rows, label_v, key_v, dom_sg_v, dom_asg_v)
+
+
+def _apply_vict_patch(vict, rows, prio_v, req_v, pdb_v, over_v):
+    """Row-wise scatter for the victim tensors (same padding contract as
+    _apply_static_patch: rows padded with -1 scatter out of bounds)."""
+    global _vict_patch_jit
+    if _vict_patch_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def go(vict, rows, prio_v, req_v, pdb_v, over_v):
+            n = vict["vict_prio"].shape[0]
+            li = jnp.where(rows >= 0, rows, n)
+            out = dict(vict)
+            out["vict_prio"] = vict["vict_prio"].at[li].set(
+                prio_v, mode="drop")
+            out["vict_req"] = vict["vict_req"].at[li].set(req_v, mode="drop")
+            out["vict_pdb"] = vict["vict_pdb"].at[li].set(pdb_v, mode="drop")
+            out["vict_over"] = vict["vict_over"].at[li].set(
+                over_v, mode="drop")
+            return out
+
+        _vict_patch_jit = go
+    return _vict_patch_jit(vict, rows, prio_v, req_v, pdb_v, over_v)
 
 
 # dispatch() sentinel: an earlier batch is still in flight and this batch
@@ -387,6 +420,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         self._state = None          # dict of device arrays (STATE_KEYS)
         self._static_node = None    # dict of device arrays (rarely changes)
         self._static_version = -1
+        self._static_vict = None    # device victim tensors (lazy; preempt)
+        self._vict_version = -1
         self._mirror: dict[str, np.ndarray] | None = None
         # dispatched-but-unresolved batches (pipeline bookkeeping) and node
         # rows whose dirtiness must survive an early-exit dispatch attempt
@@ -416,6 +451,13 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         between batches — the next encode sees the new resolved sets."""
         with self._lock:
             self.tensors.note_namespace(obj, deleted=event_type == "DELETED")
+
+    def note_pdb_event(self, event_type: str, obj, old=None) -> None:
+        """PodDisruptionBudget informer feed: keeps the flattener's PDB
+        cache in sync so the device victim PDB-coverage bits stay exact.
+        Coverage bits re-encode lazily at the next preemption wave."""
+        with self._lock:
+            self.tensors.note_pdb(obj, deleted=event_type == "DELETED")
 
     # -- device sync -----------------------------------------------------
 
@@ -450,6 +492,25 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             a = self._device_step("plain", pack_pod_batch(
                 batch, self._spec_plain, *empty))
             np.asarray(a)  # block until the device round trip completes
+            self._warm_preempt()
+
+    def _warm_preempt(self) -> None:
+        """Compile the preemption dry-run kernel (and make the victim
+        tensors resident) with an all-inactive pod chunk, specialized
+        to the common single-priority-group wave shape.  Like the
+        dispatch variants above, the cold compile otherwise lands
+        inside the first preemption wave and is charged to its pods."""
+        self._ensure_vict()
+        c = self.caps
+        P = self.PREEMPT_P_CAP
+        self._preempt_step({
+            "req": np.zeros((P, c.r), np.float32),
+            "prio": np.zeros(P, np.int32),
+            "untol_hard": np.zeros((P, c.t_cap), np.float32),
+            "group_idx": np.zeros(P, np.int32),
+            "nom_used": np.zeros((1, c.n_cap, c.r), np.float32),
+            "nom_np": np.zeros((1, c.n_cap), np.float32),
+            "active": np.zeros(P, bool)})
 
     def _device_step(self, variant: str, buf: np.ndarray):
         """Run one packed batch through the device and return the result
@@ -586,6 +647,41 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         t.static_full = False
         self._static_version = t.static_version
 
+    def _ensure_vict(self) -> None:
+        """Refresh + upload the victim tensors (STATIC_VICT channel).
+        Full upload when forced (first upload, PDB flip, many rows);
+        otherwise a row-wise scatter on the resident arrays — the same
+        economics as _upload_static, on the preemption-wave cadence."""
+        import jax.numpy as jnp
+        t = self.tensors
+        rows = t.refresh_victims()
+        if (self._static_vict is not None and not t.vict_full
+                and self._vict_version == t.vict_version):
+            return
+        full = (self._static_vict is None or t.vict_full or rows is None
+                or len(rows) > self.S_PATCH_MAX
+                or len(rows) * 8 > self.caps.n_cap)
+        if full:
+            self._static_vict = {k: jnp.asarray(getattr(t, k))
+                                 for k in STATIC_VICT}
+        else:
+            k = 256
+            while k < len(rows):
+                k *= 2
+            rows_a = np.full(k, -1, np.int32)
+            rows_a[:len(rows)] = rows
+            safe = np.where(rows_a >= 0, rows_a, 0)
+            self._static_vict = _apply_vict_patch(
+                self._static_vict, jnp.asarray(rows_a),
+                jnp.asarray(t.vict_prio[safe]),
+                jnp.asarray(t.vict_req[safe]),
+                jnp.asarray(t.vict_pdb[safe]),
+                jnp.asarray(t.vict_over[safe]))
+            self.stats["vict_patched_rows"] = self.stats.get(
+                "vict_patched_rows", 0) + len(rows)
+        t.vict_full = False
+        self._vict_version = t.vict_version
+
     def _full_refresh(self, cd_sg: np.ndarray, cd_asg: np.ndarray) -> None:
         import jax.numpy as jnp
         t = self.tensors
@@ -622,7 +718,11 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         for i in set(batch.nofit_oracle):
             if (i < n and i not in esc and i < self.batch_size
                     and (assignments is None or assignments[i] < 0)):
-                key = ("BatchEncoder", "bucket_collision")
+                # nominated-node re-proofs carry their own reason
+                # (flatten records it at encode); bare nofit_oracle
+                # entries are the collided-bucket transport
+                key = (batch.escape_reasons.get(i)
+                       or ("BatchEncoder", "bucket_collision"))
                 pend[key] = pend.get(key, 0) + 1
         if pend:
             self._tally_escape_pairs(pend)
@@ -1093,3 +1193,344 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                          and row_names[r] is not None]
                 out[i] = names
         return out
+
+    # -- full device DryRunPreemption (victim tensors) --------------------
+
+    def victim_occupancy(self) -> float:
+        """Fraction of victim slots in use across live rows (the
+        tpu_victim_occupancy gauge feed)."""
+        with self._lock:
+            return self.tensors.victim_occupancy()
+
+    def _preempt_step(self, body: dict):
+        """Run one padded preemptor chunk through the dry-run kernel
+        against the RESIDENT node state + victim tensors.  THE remote
+        seam for preemption: RemoteTPUBatchBackend overrides exactly
+        this method (ships `body`; the worker combines it with ITS
+        resident static/dynamic/victim arrays)."""
+        from ..models.preempt import preempt_dry_run
+        t = self.tensors
+        st = self._state
+        used = st["used"] if st is not None else t.used
+        npods = st["npods"] if st is not None else t.npods
+        s = self._static_node
+        v = self._static_vict
+        return preempt_dry_run(
+            s["alloc"], used, npods, s["maxpods"], s["valid"],
+            s["taint_mask"], v["vict_prio"], v["vict_req"], v["vict_pdb"],
+            v["vict_over"], body["nom_used"],
+            body["nom_np"], body["group_idx"], body["req"], body["prio"],
+            body["untol_hard"], body["active"])
+
+    def preempt_batch(self, pod_infos: Sequence[PodInfo],
+                      node_ord_of: dict, nominated=()):
+        """Full device-side DryRunPreemption for a wave of plain,
+        preemption-eligible failed pods: per pod, the reference-selected
+        candidate node + exact victim set + PDB violation count — one
+        device call per PREEMPT_P_CAP chunk instead of a host dry run per
+        (pod, node) pair.
+
+        The kernel returns the per-(pod,node) dry-run planes; selection
+        happens HERE, in caller order, so one wave conflict-resolves
+        without a device call per preemptor: unclaimed nodes keep their
+        kernel keys untouched (a nomination only changes its own node's
+        columns), and a node claimed by an earlier winner is either
+        proved closed by a host feasibility bound or re-proved exactly
+        by a host replay of the kernel's dry run with the claims folded
+        in — bit-identical to running the sequential Evaluator pod by
+        pod, nominating each winner before the next.
+
+        node_ord_of: {node_name: snapshot.list() position}, the
+        selection tie-break of last resort — it makes the pick
+        bit-identical to the host Evaluator's `min()` over
+        find_candidates order.  nominated: [(PodInfo, node_name)] pods
+        currently holding nominations, folded into per-priority-group
+        claimed capacity exactly as RunFilterPluginsWithNominatedPods
+        does (only >=-priority nominations claim).
+
+        Returns (results, escapes): results[i] = (node_name,
+        [victim pod keys], num_pdb_violations) when the device selected
+        a candidate, None when it proved there is none; escapes[i] = a
+        reason string when pod i must fall back to the per-pod Evaluator
+        (such i always have results[i] = None).  The exactness envelope
+        is gated HERE: anything the kernel does not model escapes with a
+        distinct reason instead of risking divergence."""
+        n = len(pod_infos)
+        out: list[tuple | None] = [None] * n
+        escapes: dict[int, str] = {}
+        # the serialization the wave's answers are exact against: live
+        # indices in finalization order (commit or proved-None), i.e.
+        # submission order minus escapes.  The parity suite replays the
+        # sequential Evaluator oracle along it, folding each winner's
+        # nomination before the next pod.
+        self.last_wave_order: list[int] = []
+        with self._lock:
+            t = self.tensors
+            live: list[int] = []
+            if t.asgs or t.ns_anti_kv or t.ns_anti_complex:
+                # resident anti-affinity groups can veto the preemptor in
+                # the Evaluator's full filter set, which the kernel does
+                # not model — the wave falls back wholesale
+                for i in range(n):
+                    escapes[i] = "constraint_groups"
+            else:
+                # PDB parity gate: the device coverage bit is computed
+                # against ALL blocking PDBs, the Evaluator lists only the
+                # preemptor's namespace — they agree exactly iff every
+                # blocking PDB lives in that namespace
+                bns = {ns for ns, _sel in t.pdb_blocking()}
+                for i, pi in enumerate(pod_infos):
+                    if bns and bns != {pi.key.split("/", 1)[0]}:
+                        escapes[i] = "pdb_scope"
+                    else:
+                        live.append(i)
+                prios = sorted({pod_infos[i].priority for i in live})
+                if len(prios) > self.PREEMPT_G_CAP:
+                    keep = set(prios[:self.PREEMPT_G_CAP])
+                    for i in list(live):
+                        if pod_infos[i].priority not in keep:
+                            escapes[i] = "priority_groups"
+                    live = [i for i in live if i not in escapes]
+                    prios = prios[:self.PREEMPT_G_CAP]
+            if live:
+                from .flatten import untolerated_hard
+                self._ensure_vict()
+                if (self._static_node is None
+                        or self._static_version != t.static_version):
+                    self._upload_static()
+                if self._state is None:
+                    # a preemption wave before any dispatch (or on the
+                    # remote seam, a worker holding no /refresh yet):
+                    # make the dynamic state resident so both halves run
+                    # the kernel against the same used/npods
+                    cd_sg, cd_asg = t.domain_base_counts()
+                    self._full_refresh(cd_sg, cd_asg)
+                G, N, R = len(prios), self.caps.n_cap, self.caps.r
+                gid_of = {p: g for g, p in enumerate(prios)}
+                node_ord = np.full(N, 2**31 - 1, np.int32)
+                for name, pos in node_ord_of.items():
+                    row = t.row_of.get(name)
+                    if row is not None and t.valid[row]:
+                        node_ord[row] = pos
+                row_names = [ni.name if ni is not None else None
+                             for ni in t.node_infos]
+                vict_keys = [list(ks) if ks else [] for ks in t.vict_keys]
+                # host copies for the post-claim feasibility bound; on
+                # the in-process backend these are the arrays the kernel
+                # reads, on the remote seam (_state is a sentinel, the
+                # worker holds the arrays) the snapshot mirror is a
+                # LOWER bound on device `used` — the bound then only
+                # over-defers (extra round), never wrongly excludes
+                st = self._state
+                alloc_h = np.asarray(t.alloc)
+                used_h = np.asarray(st["used"] if isinstance(st, dict)
+                                    else t.used)
+                npods_h = np.asarray(st["npods"] if isinstance(st, dict)
+                                     else t.npods)
+                maxpods_h = np.asarray(t.maxpods)
+                taint_h = np.asarray(t.taint_mask, np.float32)
+                vict_prio_h = np.asarray(t.vict_prio)
+                vict_req_h = np.asarray(t.vict_req, np.float32)
+                I32M = 2**31 - 1
+
+                def _pick(mask, kviol, khigh, kpsum, knvic):
+                    # pickOneNodeForPreemption: lexicographic min over
+                    # (violations, highest victim priority, priority sum,
+                    # victim count, snapshot order); node_ord is unique,
+                    # so exactly one row survives — bit-identical to the
+                    # host Evaluator's min() over find_candidates order
+                    m = mask.copy()
+                    for key, sent in ((kviol, np.inf), (khigh, I32M),
+                                      (kpsum, np.inf), (knvic, np.inf),
+                                      (node_ord, I32M)):
+                        kmin = np.min(np.where(m, key, sent))
+                        m &= key == kmin
+                    return int(np.argmax(m))
+
+                P = self.PREEMPT_P_CAP
+                nom_used = np.zeros((G, N, R), np.float32)
+                nom_np = np.zeros((G, N), np.float32)
+                for npi, nnode in nominated:
+                    row = t.row_of.get(nnode)
+                    if row is None or not t.valid[row]:
+                        continue
+                    rv = self._req_vec(npi.request)
+                    for g, p in enumerate(prios):
+                        if npi.priority >= p:
+                            nom_used[g, row] += rv
+                            nom_np[g, row] += 1.0
+                # THIS wave's winners: row -> [(claimant priority,
+                # request vector)].  Claims are NOT re-sent to the
+                # device — a nomination only changes its own node's
+                # columns, so every unclaimed node's plane stays exact
+                # and a claimed candidate is re-proved host-side by
+                # _host_dry_run below.
+                claimed_rows = np.zeros(N, bool)
+                claims_by_row: dict[int, list] = {}
+                vict_pdb_h = np.asarray(t.vict_pdb, np.float32)
+                V = vict_prio_h.shape[1]
+                # per-node reprieve order, identical to the kernel's
+                slot = np.broadcast_to(np.arange(V), vict_prio_h.shape)
+                ordv_h = np.lexsort(
+                    (slot, -vict_prio_h, -vict_pdb_h), axis=-1)
+                eps32 = np.float32(1e-6)
+
+                def _host_dry_run(rc, prio_j, req_j, g_j):
+                    """The kernel's dry run for ONE (pod, claimed node)
+                    pair with the wave's claims on that node folded in
+                    as >=-priority nominations — f32 end-to-end and the
+                    same reprieve order, so the key it returns is what
+                    the device WOULD have emitted had the claims been
+                    resident.  Returns (key, victim_mask, violations)
+                    or None when the node no longer yields a candidate."""
+                    elig = vict_prio_h[rc] < prio_j
+                    nelig = float(elig.sum())
+                    if nelig == 0.0:
+                        return None
+                    freed = (elig[:, None].astype(np.float32)
+                             * vict_req_h[rc]).sum(axis=0,
+                                                   dtype=np.float32)
+                    cl_used = np.zeros(R, np.float32)
+                    cl_np = np.float32(0.0)
+                    for cp, crv in claims_by_row[rc]:
+                        if cp >= prio_j:
+                            cl_used = cl_used + crv
+                            cl_np += np.float32(1.0)
+                    free = (alloc_h[rc] - (used_h[rc]
+                                           + nom_used[g_j, rc] + cl_used)
+                            + freed).astype(np.float32)
+                    slack = np.float32(
+                        maxpods_h[rc] - (npods_h[rc] + nom_np[g_j, rc]
+                                         + cl_np - nelig))
+                    if not (np.all(req_j <= free + eps32)
+                            and slack >= 1.0):
+                        return None
+                    reprieved = np.zeros(V, bool)
+                    for s in ordv_h[rc]:
+                        if not elig[s]:
+                            continue
+                        ftry = free - vict_req_h[rc, s]
+                        if (np.all(req_j <= ftry + eps32)
+                                and (slack - 1.0) >= 1.0):
+                            free = ftry
+                            slack = np.float32(slack - 1.0)
+                            reprieved[s] = True
+                    vict = elig & ~reprieved
+                    nv = float(vict.sum())
+                    if nv == 0.0:
+                        return None
+                    viol = float((vict_pdb_h[rc] * vict).sum(
+                        dtype=np.float32))
+                    high = int(vict_prio_h[rc][vict].max())
+                    ps = float((vict_prio_h[rc].astype(np.float32)
+                                * vict).sum(dtype=np.float32))
+                    return ((viol, high, ps, nv, int(node_ord[rc])),
+                            vict, int(viol))
+
+                for at in range(0, len(live), P):
+                    chunk = live[at:at + P]
+                    req = np.zeros((P, R), np.float32)
+                    prio = np.zeros(P, np.int32)
+                    untol = np.zeros((P, self.caps.t_cap), np.float32)
+                    gidx = np.zeros(P, np.int32)
+                    active = np.zeros(P, bool)
+                    for j, i in enumerate(chunk):
+                        pi = pod_infos[i]
+                        req[j] = self._req_vec(pi.request)
+                        prio[j] = min(max(pi.priority, -(2**31) + 2),
+                                      2**31 - 2)
+                        untol[j] = untolerated_hard(t, pi)
+                        gidx[j] = gid_of[pi.priority]
+                        active[j] = True
+                    (cand, kviol, khigh, kpsum, knvic, victs,
+                     overflow) = self._preempt_step({
+                        "req": req, "prio": prio, "untol_hard": untol,
+                        "group_idx": gidx, "nom_used": nom_used,
+                        "nom_np": nom_np, "active": active})
+                    for j, i in enumerate(chunk):
+                        if overflow[j]:
+                            # a reachable node carries a truncated
+                            # victim set — the device answer may
+                            # differ from the oracle's, so this pod
+                            # re-proves host-side
+                            escapes[i] = "victim_overflow"
+                            continue
+                        cj = np.asarray(cand[j])
+                        # best OPEN node straight from the kernel planes
+                        best = None
+                        open_m = cj & ~claimed_rows
+                        if open_m.any():
+                            r = _pick(open_m, kviol[j], khigh[j],
+                                      kpsum[j], knvic[j])
+                            best = ((float(kviol[j, r]),
+                                     int(khigh[j, r]),
+                                     float(kpsum[j, r]),
+                                     float(knvic[j, r]),
+                                     int(node_ord[r])),
+                                    r, None, int(kviol[j, r]))
+                        # A node claimed by an earlier winner may still
+                        # be this pod's true minimum (capacity sharing —
+                        # PreemptionDense stacks 4 preemptors per node):
+                        # re-prove it host-side with the claims folded.
+                        # The kernel's cand bit is claim-blind in BOTH
+                        # directions here — a claimed node the pod fit
+                        # WITHOUT victims (cand false, nvic 0) can need
+                        # victims once the claim is charged — so every
+                        # claimed row is re-gated from scratch: taints,
+                        # then a cheap closure bound (every eligible
+                        # victim evicted, claims charged; on saturating
+                        # workloads it prunes every claimed row and no
+                        # replay runs), then the exact replay.
+                        for rc in np.nonzero(claimed_rows)[0]:
+                            if float(untol[j] @ taint_h[rc]) != 0.0:
+                                continue
+                            elig = vict_prio_h[rc] < prio[j]
+                            freed = (vict_req_h[rc][elig].sum(axis=0)
+                                     if elig.any() else 0.0)
+                            free_ub = (alloc_h[rc] - used_h[rc]
+                                       - nom_used[gidx[j], rc] + freed)
+                            slack_ub = (maxpods_h[rc]
+                                        - (npods_h[rc]
+                                           + nom_np[gidx[j], rc]
+                                           - float(elig.sum())))
+                            for cp, crv in claims_by_row[rc]:
+                                if cp >= prio[j]:
+                                    free_ub = free_ub - crv
+                                    slack_ub -= 1.0
+                            if not (np.all(req[j] <= free_ub + 1e-6)
+                                    and slack_ub >= 1.0):
+                                continue  # provably closed post-claim
+                            res = _host_dry_run(rc, int(prio[j]),
+                                                req[j], int(gidx[j]))
+                            if res is None:
+                                continue
+                            ckey, cvict, cviol = res
+                            if best is None or ckey < best[0]:
+                                best = (ckey, int(rc), cvict, cviol)
+                        if best is None:
+                            # no open candidate and every claimed row
+                            # proved closed or victimless post-claim:
+                            # the sequential Evaluator would find no
+                            # candidate either
+                            self.last_wave_order.append(i)
+                            continue
+                        _key, r, cvict, viol_out = best
+                        keys = vict_keys[r]
+                        if cvict is None:
+                            vs = [keys[s] for s in range(len(keys))
+                                  if victs[j, r, s]]
+                        else:
+                            vs = [keys[s] for s in range(len(keys))
+                                  if cvict[s]]
+                        out[i] = (row_names[r], vs, viol_out)
+                        self.last_wave_order.append(i)
+                        claimed_rows[r] = True
+                        claims_by_row.setdefault(r, []).append(
+                            (int(prio[j]), req[j].copy()))
+        if escapes:
+            tl: dict = {}
+            for reason in escapes.values():
+                key = ("DefaultPreemption", reason)
+                tl[key] = tl.get(key, 0) + 1
+            self._tally_escape_pairs(tl)
+        return out, escapes

@@ -245,6 +245,14 @@ class FailoverBatchBackend(BatchBackend):
             if fn is not None:
                 fn(event_type, obj, old)
 
+    def note_pdb_event(self, event_type: str, obj, old=None) -> None:
+        """Fan PDB events to EVERY rung (same reason as namespace events:
+        a standby's victim-tensor PDB bits must be current at promotion)."""
+        for rung in self._rungs:
+            fn = getattr(rung.backend, "note_pdb_event", None)
+            if fn is not None:
+                fn(event_type, obj, old)
+
     def preempt_candidates(self, pod_infos, k: int = 16):
         for rung in self._rungs:
             if not rung.breaker.is_open:
@@ -253,7 +261,37 @@ class FailoverBatchBackend(BatchBackend):
                     return fn(pod_infos, k)
         return None
 
+    def preempt_batch(self, pod_infos, node_ord_of, nominated=()):
+        """Serve the batched dry run from the healthiest rung; a rung
+        failure opens its breaker and the NEXT rung answers — the last
+        resort escapes the whole wave to the per-pod Evaluator, one rung
+        at a time down the same ladder dispatch rides."""
+        for rung in self._rungs:
+            with self._lock:
+                open_ = rung.breaker.is_open
+            if open_:
+                continue
+            fn = getattr(rung.backend, "preempt_batch", None)
+            if fn is None:
+                continue
+            try:
+                return fn(pod_infos, node_ord_of, nominated)
+            except BackendUnavailableError as e:
+                with self._lock:
+                    self._on_failure(rung, e)
+        # no healthy rung implements it: the caller's legacy tier takes
+        # the wave (per-pod Evaluator / full host PostFilter)
+        return ([None] * len(pod_infos),
+                {i: "backend_unavailable" for i in range(len(pod_infos))})
+
     # -- observability ---------------------------------------------------
+
+    def victim_occupancy(self) -> float:
+        for rung in self._rungs:
+            fn = getattr(rung.backend, "victim_occupancy", None)
+            if fn is not None and not rung.breaker.is_open:
+                return fn()
+        return 0.0
 
     @property
     def stats(self) -> dict:

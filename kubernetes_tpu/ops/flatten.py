@@ -62,6 +62,11 @@ C_PREF_AFFINITY = 5    # preferred pod (anti-)affinity, weight signed
 
 UNSCHEDULABLE_TAINT = ("node.kubernetes.io/unschedulable", "", "NoSchedule")
 
+# victim-tensor priority padding: empty slots sort AFTER every real pod
+# (priorities are int32; kept as i32 on device — f32 loses exactness
+# above 2^24 and the reprieve/tie-break ordering must be bit-faithful)
+VICT_PAD = np.int32(2**31 - 1)
+
 
 class VocabFullError(Exception):
     pass
@@ -189,6 +194,7 @@ class Caps:
     kg_cap: int = 2            # any-of key groups per pod (Exists)
     c_cap: int = 6             # constraints per pod
     ns_cap: int = 256          # namespace vocab (namespaceSelector masks)
+    v_cap: int = 16            # victim slots per node (batched preemption)
 
     @property
     def r(self) -> int:
@@ -286,6 +292,33 @@ class ClusterTensors:
         # whole-array re-upload (column backfills touch every row)
         self.static_dirty_rows: set[int] = set()
         self.static_full = True
+
+        # victim tensors (batched preemption / DryRunPreemption): per-node
+        # resident pods sorted ascending by priority.  PAD slots carry
+        # VICT_PAD so `vict_prio < preemptor_prio` masks them out for any
+        # real priority.  Maintained LAZILY: hot paths (binds) only mark
+        # rows dirty here; refresh_victims() re-encodes at preempt time so
+        # the per-bind cost is one set.add.  vict_version is a SEPARATE
+        # upload channel from static_version — victim rebuilds must not
+        # invalidate the static cache (that would force a multi-MB label
+        # re-upload per preemption wave).
+        self.vict_prio = np.full((c.n_cap, c.v_cap), VICT_PAD, np.int32)
+        self.vict_req = np.zeros((c.n_cap, c.v_cap, c.r), np.float32)
+        self.vict_pdb = np.zeros((c.n_cap, c.v_cap), np.float32)
+        self.vict_over = np.zeros(c.n_cap, bool)
+        # host-side victim identities per row (slot-aligned with
+        # vict_prio); None for rows never victim-encoded
+        self.vict_keys: list[list | None] = [None] * c.n_cap
+        self.vict_version = 0
+        self.vict_dirty_rows: set[int] = set()
+        self.vict_full = True
+        # PDB cache from the informer: (namespace, name) -> (namespace,
+        # Selector, disruptionsAllowed).  The device bit marks victims
+        # covered by a BLOCKING pdb (allowed <= 0); pdb_version feeds the
+        # victim-refresh staleness check.
+        self.pdbs: dict[tuple, tuple] = {}
+        self.pdb_version = 0
+        self._vict_pdb_version = -1
 
     # -- vocab helpers ---------------------------------------------------
 
@@ -394,6 +427,33 @@ class ClusterTensors:
         """Feed one Namespace informer event into the cache."""
         self.set_namespace_labels(
             meta.name(obj), None if deleted else meta.labels(obj))
+
+    # -- PDB cache (batched preemption victim bits) -----------------------
+
+    def note_pdb(self, obj: Obj, deleted: bool = False) -> None:
+        """Feed one PodDisruptionBudget informer event into the cache.
+        Mirrors the Evaluator's _list_pdbs shape: (selector, allowed)
+        pairs, allowed defaulting to 0 when status is absent."""
+        key = (meta.namespace(obj), meta.name(obj))
+        if deleted:
+            if self.pdbs.pop(key, None) is None:
+                return
+        else:
+            spec = obj.get("spec") or {}
+            status = obj.get("status") or {}
+            entry = (key[0], selector_from_dict(spec.get("selector") or {}),
+                     int(status.get("disruptionsAllowed", 0)))
+            if self.pdbs.get(key) == entry:
+                return
+            self.pdbs[key] = entry
+        self.pdb_version += 1
+
+    def pdb_blocking(self) -> list[tuple]:
+        """(namespace, selector) pairs of BLOCKING pdbs (allowed <= 0) —
+        the only ones whose coverage counts as a violation in the
+        Evaluator's _violates_pdb."""
+        return [(ns, sel) for ns, sel, allowed in self.pdbs.values()
+                if allowed <= 0]
 
     def _refresh_ns_groups(self) -> None:
         """Re-resolve registered namespaceSelector groups after a
@@ -704,6 +764,10 @@ class ClusterTensors:
             self._encode_dynamic_bulk(bulk)
         if fresh_bulk:
             self._encode_fresh_bulk(fresh_bulk)
+        if dirty:
+            # resident-pod set may have changed on these rows; victim
+            # tensors re-encode lazily at preempt time
+            self.vict_dirty_rows.update(dirty)
         return dirty
 
     def _encode_fresh_bulk(self, pairs: list) -> None:
@@ -771,6 +835,7 @@ class ClusterTensors:
         self._free.append(row)
         self.static_version += 1
         self.static_dirty_rows.add(row)
+        self.vict_dirty_rows.add(row)
         return row
 
     def _update_from_dirty(self, pairs, removed_names) -> list[int]:
@@ -993,6 +1058,97 @@ class ClusterTensors:
     def node_name(self, row: int) -> str | None:
         ni = self.node_infos[row]
         return ni.name if ni is not None else None
+
+    # -- victim tensors (batched preemption) ------------------------------
+
+    def refresh_victims(self) -> list[int] | None:
+        """Re-encode victim rows marked dirty since the last refresh (plus
+        ALL rows when the PDB cache changed — coverage bits are global).
+        Called at preempt time, not on the bind hot path.  Returns the
+        re-encoded rows (patch candidates), None when nothing changed."""
+        if self._vict_pdb_version != self.pdb_version:
+            # PDB change flips coverage bits on any row; re-encode all
+            # live rows and force a full upload
+            self.vict_dirty_rows.update(
+                row for row, ni in enumerate(self.node_infos)
+                if ni is not None)
+            self._vict_pdb_version = self.pdb_version
+            self.vict_full = True
+        if not self.vict_dirty_rows:
+            return None
+        blocking = self.pdb_blocking()
+        rows = sorted(self.vict_dirty_rows)
+        for row in rows:
+            self._encode_vict_row(row, blocking)
+        self.vict_dirty_rows.clear()
+        self.vict_version += 1
+        return rows
+
+    def _encode_vict_row(self, row: int, blocking: list) -> None:
+        """Victim slots for one node row: ALL resident pods (terminating
+        included — the Evaluator's `potential` list keeps them; eligibility
+        gating happens host-side), stable-sorted ascending by priority to
+        mirror `sorted(ni.pods)` order under the reprieve lexsort.  Rows
+        holding more than v_cap lower-priority candidates overflow: the
+        device answer would be built from a truncated victim set, so the
+        row sets vict_over and any preemptor that can reach it escapes
+        with reason victim_overflow."""
+        c = self.caps
+        ni = self.node_infos[row]
+        self.vict_prio[row] = VICT_PAD
+        self.vict_req[row] = 0.0
+        self.vict_pdb[row] = 0.0
+        self.vict_over[row] = False
+        if ni is None or not self.valid[row]:
+            self.vict_keys[row] = None
+            return
+        pods = ni.pods
+        order = sorted(range(len(pods)), key=lambda j: pods[j].priority)
+        if len(order) > c.v_cap:
+            self.vict_over[row] = True
+            order = order[:c.v_cap]
+        keys = []
+        for slot, j in enumerate(order):
+            pi = pods[j]
+            # clamp keeps negation safe in the kernel's lexsort (int32
+            # -(-2^31) wraps) and PAD strictly above any real priority
+            self.vict_prio[row, slot] = np.int32(
+                min(max(pi.priority, -(2**31) + 2), 2**31 - 2))
+            try:
+                self._encode_resource(self.vict_req[row, slot], pi.request)
+            except VocabFullError:
+                # a victim whose scalars can't be represented would free
+                # resources the kernel can't see; conservative overflow
+                self.vict_over[row] = True
+            if blocking:
+                labels = meta.labels(pi.pod)
+                if any(sel.matches(labels) for _, sel in blocking):
+                    self.vict_pdb[row, slot] = 1.0
+            keys.append(pi.key)
+        self.vict_keys[row] = keys
+
+    def victim_occupancy(self) -> float:
+        """Fraction of victim slots in use across live rows (gauge feed)."""
+        live = self.valid
+        if not live.any():
+            return 0.0
+        used = (self.vict_prio[live] != VICT_PAD).sum()
+        return float(used) / float(live.sum() * self.caps.v_cap)
+
+
+def untolerated_hard(t: ClusterTensors, pi: PodInfo) -> np.ndarray:
+    """[t_cap] hard-untolerated taint vector for one pod — the standalone
+    twin of BatchEncoder._encode_taints' untol_hard section, for callers
+    without a PodBatch (the batched preemption path)."""
+    out = np.zeros(t.caps.t_cap, np.float32)
+    for tid, (key, value, effect) in enumerate(t.taint_vocab.items):
+        if effect not in ("NoSchedule", "NoExecute"):
+            continue
+        taint = {"key": key, "value": value, "effect": effect}
+        if not any(toleration_tolerates_taint(tol, taint)
+                   for tol in pi.tolerations):
+            out[tid] = 1.0
+    return out
 
 
 @dataclass
@@ -1395,8 +1551,21 @@ class BatchEncoder:
             # escape route out of this function
             self._cover_ns_anti_terms(pi)
         if pi.nominated_node_name:
-            # preemption nominations go through the per-pod path
-            return self._esc("DefaultPreemption", "nominated_node")
+            # nominated-first fast path (the reference tries the nominated
+            # node before the full list): pin the pod to its nominated row
+            # and let the device prove the fit.  No-fit is NOT proof of
+            # unschedulability — victims may still be terminating — so the
+            # position also rides nofit_oracle: a no-fit verdict yields
+            # SKIP and the per-pod oracle re-evaluates against the full
+            # node list, exactly today's semantics.  Only a nomination
+            # whose node left the cluster escapes outright (a genuine
+            # re-evaluation, distinct reason in scheduler_tpu_escape_total).
+            row = t.row_of.get(pi.nominated_node_name)
+            if row is None or not t.valid[row]:
+                return self._esc("DefaultPreemption", "nominated_node_stale")
+            b.ensure(c, "node_row")[i] = row
+            b.nofit_oracle.append(i)
+            b.escape_reasons[i] = ("DefaultPreemption", "nominated_node_stale")
         for v in (pi.pod.get("spec") or {}).get("volumes") or ():
             if (v.get("persistentVolumeClaim") or v.get("gcePersistentDisk")
                     or v.get("awsElasticBlockStore") or v.get("azureDisk")

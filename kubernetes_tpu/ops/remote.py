@@ -328,7 +328,7 @@ class _WorkerCore:
         if path == "/static":
             import jax.numpy as jnp
 
-            from .backend import STATIC_CORE, STATIC_SEL
+            from .backend import STATIC_CORE, STATIC_SEL, STATIC_VICT
             try:
                 arrays = _load_arrays(body)
                 static_node = {k: jnp.asarray(arrays[k])
@@ -341,6 +341,11 @@ class _WorkerCore:
             # so the base _ensure_sel must never try to rebuild from them)
             b._static_sel = static_sel
             b._sel_stale = False
+            if all(k in arrays for k in STATIC_VICT):
+                # victim tensors ride the same body once the client's
+                # preemption path engages (older clients omit them)
+                b._static_vict = {k: jnp.asarray(arrays[k])
+                                  for k in STATIC_VICT}
             return {"ok": True}
         if path == "/refresh":
             import jax.numpy as jnp
@@ -366,6 +371,37 @@ class _WorkerCore:
                 raise WorkerError(E_INVALID, f"malformed /step body: {e!r}")
             except Exception as e:  # noqa: BLE001 — classify, don't die
                 raise WorkerError(E_INTERNAL, f"/step failed: {e!r}")
+        if path == "/preempt":
+            # the dry-run kernel against the RESIDENT static + victim +
+            # dynamic arrays; read-only (never journaled client-side), so
+            # a retry or post-resync replay cannot double-apply anything
+            if b._static_vict is None:
+                raise WorkerError(E_STATE_LOST,
+                                  "no resident victim tensors (/static "
+                                  "with a victim section first)")
+            if b._state is None:
+                raise WorkerError(E_STATE_LOST,
+                                  "no resident dynamic state (/refresh "
+                                  "first)")
+            try:
+                arrays = _load_arrays(body)
+                out = b._preempt_step(
+                    {k: arrays[k]
+                     for k in ("req", "prio", "untol_hard", "group_idx",
+                               "nom_used", "nom_np", "active")})
+                cand, viol, highest, psum, nvic, victims, overflow = out
+                return _dump_arrays({
+                    "cand": np.asarray(cand), "viol": np.asarray(viol),
+                    "highest": np.asarray(highest),
+                    "psum": np.asarray(psum), "nvic": np.asarray(nvic),
+                    "victims": np.asarray(victims),
+                    "overflow": np.asarray(overflow)})
+            except WorkerError:
+                raise
+            except (ValueError, TypeError, KeyError, IndexError, OSError) as e:
+                raise WorkerError(E_INVALID, f"malformed /preempt body: {e!r}")
+            except Exception as e:  # noqa: BLE001 — classify, don't die
+                raise WorkerError(E_INTERNAL, f"/preempt failed: {e!r}")
         raise WorkerError(E_INVALID, f"unknown verb {path!r}")
 
 
@@ -485,6 +521,7 @@ _GRPC_VERBS = {
     "StepFull": "/step?variant=full",
     "StepFullSmall": "/step?variant=full_small",
     "StepPlain": "/step?variant=plain",
+    "Preempt": "/preempt",
     "Health": "/health",
 }
 _GRPC_MSG_CAP = 512 << 20
@@ -963,18 +1000,52 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
 
     def _upload_static(self) -> None:
         t = self.tensors
-        body = _dump_arrays({
+        arrays = {
             "alloc": t.alloc, "maxpods": t.maxpods, "valid": t.valid,
             "taint_mask": t.taint_mask, "label_mask": t.label_mask,
             "key_mask": t.key_mask, "dom_sg": t.dom_sg,
             "dom_asg": t.dom_asg, "sg_ns_mask": t.sg_ns_mask,
-            "asg_ns_mask": t.asg_ns_mask})
+            "asg_ns_mask": t.asg_ns_mask}
+        if self._static_vict is not None:
+            # once the preemption path has engaged, the victim section
+            # rides every static body — the body doubles as the resync
+            # checkpoint, so a restarted worker replays the victim
+            # tensors too and post-resync /preempt answers stay
+            # bit-identical
+            arrays.update({
+                "vict_prio": t.vict_prio, "vict_req": t.vict_req,
+                "vict_pdb": t.vict_pdb, "vict_over": t.vict_over})
+        body = _dump_arrays(arrays)
         self._post("/static", body)
         self._ckpt_static_body = body  # the post IS the checkpoint
         self._static_node = True  # sentinel: worker holds the arrays
         t.static_dirty_rows = set()
         t.static_full = False
         self._static_version = t.static_version
+
+    def _ensure_vict(self) -> None:
+        """Remote twin of TPUBatchBackend._ensure_vict: the victim
+        tensors travel inside a full /static body (no wire patch path —
+        preemption waves are rare and the body is the checkpoint)."""
+        t = self.tensors
+        t.refresh_victims()
+        if (self._static_vict is not None and not t.vict_full
+                and self._vict_version == t.vict_version):
+            return
+        self._static_vict = True  # sentinel: worker holds the arrays
+        self._upload_static()
+        t.vict_full = False
+        self._vict_version = t.vict_version
+
+    def _preempt_step(self, body: dict):
+        """Ship one padded preemptor chunk to the worker's /preempt verb;
+        the worker runs the dry-run kernel against ITS resident arrays.
+        Read-only — never journaled; a state-lost worker resyncs (which
+        replays the victim-carrying static checkpoint) and the re-post
+        returns the same answer."""
+        out = _load_arrays(self._post("/preempt", _dump_arrays(body)))
+        return (out["cand"], out["viol"], out["highest"], out["psum"],
+                out["nvic"], out["victims"], out["overflow"])
 
     def _full_refresh(self, cd_sg: np.ndarray, cd_asg: np.ndarray) -> None:
         t = self.tensors
@@ -1019,3 +1090,6 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
             self._ensure_plain()
             self._device_step("plain", pack_pod_batch(
                 batch, self._spec_plain, *empty))
+            # ship the dry-run warm chunk too: the worker compiles the
+            # preemption kernel before the first real wave pays for it
+            self._warm_preempt()

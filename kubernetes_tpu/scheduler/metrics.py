@@ -134,6 +134,10 @@ class Metrics:
             "scheduler_tpu_batch_waves",
             "Device assignment-solver waves per batch.",
             buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512])
+        self.tpu_victim_occupancy = cbm.Gauge(
+            "scheduler_tpu_victim_occupancy",
+            "Fraction of per-node victim tensor slots (v_cap) holding a "
+            "resident pod, from the most recent victim-tensor refresh.")
         r.must_register(
             self.schedule_attempts, self.scheduling_attempt_duration,
             self.scheduling_algorithm_duration, self.pod_scheduling_duration,
@@ -147,7 +151,7 @@ class Metrics:
             self.tpu_seam_events, self.tpu_seam_state,
             self.tpu_seam_breaker, self.tpu_escape_total,
             self.tpu_mask_density, self.tpu_feasible_nodes,
-            self.tpu_batch_waves)
+            self.tpu_batch_waves, self.tpu_victim_occupancy)
 
     def expose(self) -> str:
         return self.registry.expose()

@@ -40,6 +40,23 @@ class Candidate:
     num_pdb_violations: int = 0
 
 
+def evict_victims(client: Client, victims: list[PodInfo],
+                  preemptor_key: str, node_name: str) -> None:
+    """THE eviction site (prepareCandidate's delete+event loop).  Both
+    the per-pod Evaluator and the batched bulk-commit path route here —
+    a static check (tests/test_verify_static.py) pins that no other
+    scheduler code issues pod deletes, so preemption accounting
+    (events, metrics, victim dedup) can never fork."""
+    for v in victims:
+        try:
+            client.delete(PODS, meta.namespace(v.pod), meta.name(v.pod))
+            client.create_event(
+                v.pod, "Preempted",
+                f"Preempted by {preemptor_key} on node {node_name}")
+        except Exception as e:  # noqa: BLE001 - victim may be gone already
+            logger.info("preemption: victim %s delete failed: %s", v.key, e)
+
+
 class Evaluator:
     def __init__(self, framework: Framework, client: Client,
                  observer=None):
@@ -197,14 +214,7 @@ class Evaluator:
     # -- prepare (evict + nominate) --------------------------------------
 
     def _prepare_candidate(self, cand: Candidate, pod_info: PodInfo) -> Status:
-        for v in cand.victims:
-            try:
-                self.client.delete(PODS, meta.namespace(v.pod), meta.name(v.pod))
-                self.client.create_event(
-                    v.pod, "Preempted",
-                    f"Preempted by {pod_info.key} on node {cand.node_name}")
-            except Exception as e:  # noqa: BLE001 - victim may be gone already
-                logger.info("preemption: victim %s delete failed: %s", v.key, e)
+        evict_victims(self.client, cand.victims, pod_info.key, cand.node_name)
         return Status(SUCCESS)
 
     # -- PDBs ------------------------------------------------------------

@@ -210,6 +210,15 @@ class PodNominator:
         with self._lock:
             return list(self._node_to_pods.get(node_name, {}).values())
 
+    def all_nominations(self) -> list[tuple[PodInfo, str]]:
+        """(pod_info, node_name) for every live nomination — the batched
+        preemption path folds these into the device's per-priority-group
+        claimed capacity (RunFilterPluginsWithNominatedPods parity)."""
+        with self._lock:
+            return [(pi, node)
+                    for node, pods in self._node_to_pods.items()
+                    for pi in pods.values()]
+
 
 class SchedulingQueue:
     """The 3-tier priority queue."""

@@ -30,7 +30,7 @@ from typing import Callable, Sequence
 
 from ..api import meta
 from ..api.meta import Obj
-from ..client.clientset import Client, NAMESPACES, NODES, PODS
+from ..client.clientset import Client, NAMESPACES, NODES, PDBS, PODS
 from ..client.informer import SharedInformerFactory
 from ..store import kv
 from ..component_base import tracing
@@ -38,6 +38,7 @@ from ..utils import fasthost, stagelat
 from . import metrics as _metrics
 from .cache import Cache, Snapshot
 from .framework import CycleState, Framework, Handle
+from .preemption import evict_victims
 from .queue import SchedulingQueue
 from .types import (
     ERROR, SUCCESS, UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE, WAIT,
@@ -327,6 +328,10 @@ class Scheduler:
         # per-event handler suffices
         namespaces = self.informer_factory.informer(NAMESPACES)
         namespaces.add_event_handler(self._on_namespace_event)
+        # PDB events feed the backends' victim PDB-coverage bits (batched
+        # preemption); same rare-event shape as namespaces
+        pdbs = self.informer_factory.informer(PDBS)
+        pdbs.add_event_handler(self._on_pdb_event)
 
     def _on_namespace_event(self, type_: str, ns: Obj,
                             old: Obj | None) -> None:
@@ -334,6 +339,13 @@ class Scheduler:
             fn = getattr(profile.batch_backend, "note_namespace_event", None)
             if fn is not None:
                 fn(type_, ns, old)
+
+    def _on_pdb_event(self, type_: str, pdb: Obj,
+                      old: Obj | None) -> None:
+        for profile in self.profiles.values():
+            fn = getattr(profile.batch_backend, "note_pdb_event", None)
+            if fn is not None:
+                fn(type_, pdb, old)
 
     def _on_node_events(self, triples: list) -> None:
         """Bulk node-event handler: a registration flood (100k createNodes)
@@ -902,50 +914,154 @@ class Scheduler:
 
     def _batch_preempt(self, profile: Profile, fw: Framework,
                        failures: list[tuple[QueuedPodInfo, Status]],
-                       cycle: int, start: float) -> None:
-        """PostFilter for a batch's FitError pods: the device proposes
-        candidate nodes via a masked victim-removal refilter
-        (ops/backend.preempt_candidates -> models/preempt.py), and the
-        host evaluator runs the exact reprieve/PDB dry-run on just those
-        candidates (preemption.go:579 DryRunPreemption semantics with the
-        reference's own candidate-sampling precedent).  Pods the device
-        cannot group (priority overflow) take the full host scan, so
-        coverage matches the per-pod path."""
+                       cycle: int, start: float, span=None) -> None:
+        """PostFilter for a batch's FitError pods, two device tiers:
+
+        (1) preempt_batch — the FULL DryRunPreemption on device
+        (ops/backend.preempt_batch -> models/preempt._preempt_dry_run):
+        victim selection, reprieve pass, PDB violation counts and the
+        pickOneNodeForPreemption tie-break all run as one fused call per
+        chunk, and the host only resolves cross-pod conflicts and bulk-
+        commits evictions + nominations.  (2) pods outside the batched
+        kernel's exactness envelope (non-plain, nominated, kernel escape
+        reasons) take the legacy tier: device top-k candidates
+        (preempt_candidates) re-proved by the host Evaluator's exact
+        dry-run, or the full host PostFilter when the device cannot
+        group them — coverage always matches the per-pod path.
+
+        Conflict resolution: winners commit in queue order (higher
+        priority first — activeQ pop-order parity).  The wave itself
+        resolves claim conflicts in preempt_batch (a later pod either
+        proves an earlier winner's node closed and takes the next-best
+        open one, or re-proves the claimed node host-side with the
+        claims folded — bit-identical to the sequential Evaluator run
+        in the same order), so the results here are claim-consistent:
+        two winners naming the same node is a legal capacity share, and
+        overlapping victim sets just dedup the eviction (a victim is
+        deleted once).  Escaped pods take the legacy tier; everything
+        requeues through _handle_failure and re-evaluates next wave
+        against the persisted nominations."""
         plugin = next((p for p in fw.post_filter
                        if hasattr(p, "evaluator")
                        and hasattr(p, "persist_nomination")), None)
         backend = profile.batch_backend
-        if plugin is None or not hasattr(backend, "preempt_candidates"):
+        if plugin is None or not (hasattr(backend, "preempt_batch")
+                                  or hasattr(backend, "preempt_candidates")):
             for qpi, st in failures:
                 self._handle_failure(fw, qpi, st, cycle, set(), start)
             return
         snapshot = Snapshot() if not hasattr(self, "_snapshot") \
             else self._snapshot
         self._snapshot = snapshot = self.cache.update_snapshot(snapshot)
+        ev = plugin.evaluator()
         # higher-priority preemptors go first (activeQ pop-order parity)
         order = sorted(range(len(failures)),
                        key=lambda i: -failures[i][0].pod_info.priority)
-        cand_names = backend.preempt_candidates(
-            [failures[i][0].pod_info for i in order])
-        ev = plugin.evaluator()
-        for j, i in enumerate(order):
-            qpi, st = failures[i]
-            pod_info = qpi.pod_info
-            names = cand_names[j]
-            nominated = None
-            if names is None:
-                # device couldn't evaluate this pod: full host PostFilter
-                nominated, _ps = fw.run_post_filter_plugins(
-                    CycleState(), pod_info, {})
-            elif names:
-                infos = [ni for ni in (snapshot.get(nm) for nm in names)
-                         if ni is not None]
-                nominated, _ps = ev.preempt_among(
-                    CycleState(), pod_info, infos, snapshot)
+        dev: list[int] = []
+        fallback: list[int] = []
+        for i in order:
+            pi = failures[i][0].pod_info
+            if (hasattr(backend, "preempt_batch") and pi.plain
+                    and not pi.nominated_node_name
+                    and ev._pod_eligible(pi, snapshot)):
+                dev.append(i)
+            else:
+                fallback.append(i)
+
+        traced = span is not None and span.sampled
+        results = None
+        esc: dict[int, str] = {}
+        if dev:
+            dry_sp = (span.tracer.start_span("preempt.dry_run", parent=span)
+                      if traced else None)
+            node_ord_of = {ni.name: pos
+                           for pos, ni in enumerate(snapshot.list())}
+            results, esc = backend.preempt_batch(
+                [failures[i][0].pod_info for i in dev], node_ord_of,
+                self.queue.nominator.all_nominations())
+            if dry_sp is not None:
+                dry_sp.set_attribute("pods", len(dev))
+                dry_sp.set_attribute("escapes", len(esc))
+                dry_sp.set_attribute(
+                    "candidates",
+                    sum(1 for r in results if r is not None))
+                dry_sp.end()
+
+        # bulk commit under one child span: winners land in queue order;
+        # batched evictions (deduped — shared-node winners may name the
+        # same victim) + nominatedNodeName patches
+        commit_sp = (span.tracer.start_span("preempt.commit", parent=span)
+                     if traced else None)
+        claimed_nodes: set[str] = set()
+        claimed_victims: set[str] = set()
+        commits = conflicts = 0
+        if results is not None:
+            for j, i in enumerate(dev):
+                res = results[j]
+                if res is None:
+                    continue
+                node_name, vkeys, _viol = res
+                if node_name in claimed_nodes:
+                    # conflict resolved inside the wave: this winner
+                    # followed an earlier one onto the same node with
+                    # the claim folded into its dry run
+                    conflicts += 1
+                claimed_nodes.add(node_name)
+                pod_info = failures[i][0].pod_info
+                ni = snapshot.get(node_name)
+                vmap = {p.key: p for p in (ni.pods if ni is not None
+                                           else ())}
+                victims = [vmap[k] for k in vkeys
+                           if k in vmap and k not in claimed_victims]
+                claimed_victims.update(vkeys)
+                evict_victims(self.client, victims, pod_info.key, node_name)
+                plugin.persist_nomination(pod_info, node_name)
+                self.queue.nominator.add_nominated_pod(pod_info, node_name)
+                if ev.observer is not None:
+                    ev.observer(len(vkeys))
+                commits += 1
+        if commit_sp is not None:
+            commit_sp.set_attribute("commits", commits)
+            commit_sp.set_attribute("conflicts", conflicts)
+            commit_sp.set_attribute("victims", len(claimed_victims))
+            commit_sp.end()
+        occ_fn = getattr(backend, "victim_occupancy", None)
+        if occ_fn is not None:
+            try:
+                self.metrics.prom.tpu_victim_occupancy.set(occ_fn())
+            except Exception:  # noqa: BLE001 - gauge is best-effort
+                logger.debug("victim occupancy gauge update failed",
+                             exc_info=True)
+
+        # legacy tier: kernel escapes + pods outside the envelope
+        fallback += [dev[j] for j in sorted(esc)]
+        if fallback:
+            fallback.sort(key=lambda i: -failures[i][0].pod_info.priority)
+            if hasattr(backend, "preempt_candidates"):
+                cand_names = backend.preempt_candidates(
+                    [failures[i][0].pod_info for i in fallback])
+            else:  # pragma: no cover - ladder rung without the device op
+                cand_names = [None] * len(fallback)
+            for j, i in enumerate(fallback):
+                pod_info = failures[i][0].pod_info
+                names = cand_names[j]
+                nominated = None
+                if names is None:
+                    # device couldn't evaluate this pod: full host scan
+                    nominated, _ps = fw.run_post_filter_plugins(
+                        CycleState(), pod_info, {})
+                elif names:
+                    infos = [ni for ni in (snapshot.get(nm) for nm in names)
+                             if ni is not None]
+                    nominated, _ps = ev.preempt_among(
+                        CycleState(), pod_info, infos, snapshot)
+                    if nominated:
+                        plugin.persist_nomination(pod_info, nominated)
                 if nominated:
-                    plugin.persist_nomination(pod_info, nominated)
-            if nominated:
-                self.queue.nominator.add_nominated_pod(pod_info, nominated)
+                    self.queue.nominator.add_nominated_pod(pod_info,
+                                                           nominated)
+        for i in order:
+            qpi, st = failures[i]
             self._handle_failure(fw, qpi, st, cycle, set(), start)
 
     # -- batch pipeline (TPU path; no reference equivalent) --------------
@@ -1215,7 +1331,8 @@ class Scheduler:
             else:
                 ok.append((qpi, node_name, assumed))
         if fit_failures:
-            self._batch_preempt(profile, fw, fit_failures, cycle, start)
+            self._batch_preempt(profile, fw, fit_failures, cycle, start,
+                                span=span)
         if span is not None:
             # the bind child outlives the root on purpose (the binding
             # cycle runs on the binder pool; id-parenting keeps it in the

@@ -273,6 +273,46 @@ class TestRestartResync:
         assert backend.drain_escape_reasons() == {}
         assert reference.drain_escape_reasons() == {}
 
+    def test_kill_mid_preemption_wave_resyncs_bit_identical(self, worker):
+        """Batched-preemption chaos: the worker dies right before a
+        /preempt post.  The client detects the lost state, replays the
+        victim-carrying /static checkpoint + /refresh, and the re-posted
+        dry run returns decisions bit-identical to an in-process run —
+        no escape, no divergence."""
+
+        class KillOnFirstPreempt(FaultSchedule):
+            def action(self, call_index, verb):
+                self.rng.random()  # keep the one-draw-per-call invariant
+                if verb == "/preempt" and self.injectable:
+                    self.injectable = False
+                    return KILL
+                return NONE
+            injectable = True
+
+        schedule = KillOnFirstPreempt()
+        backend, transport = faulty_backend(worker, schedule)
+        nodes = [make_node(f"pn{i}").capacity(cpu="2", mem="8Gi").build()
+                 for i in range(4)]
+        cache = Cache()
+        for n in nodes:
+            cache.add_node(n)
+        for i in range(8):
+            cache.add_pod(make_pod(f"pv{i}").priority(1)
+                          .req(cpu="700m").node(f"pn{i % 4}").build())
+        snap = cache.update_snapshot(Snapshot())
+        backend.assign([], snap)
+        reference = TPUBatchBackend(small_caps(), batch_size=8)
+        reference.assign([], snap)
+        preemptors = [PodInfo(make_pod(f"pp{j}").priority(10)
+                              .req(cpu="1600m").build()) for j in range(3)]
+        node_ord_of = {ni.name: i for i, ni in enumerate(snap.list())}
+        got, esc = backend.preempt_batch(preemptors, node_ord_of)
+        want, esc_w = reference.preempt_batch(preemptors, node_ord_of)
+        assert transport.injected[KILL] == 1
+        assert backend.seam_stats["resyncs"] >= 1
+        assert esc == esc_w == {}
+        assert got == want
+
     def test_kill_then_more_batches_keep_chaining(self, worker):
         """Resident-state chaining survives a restart: claims committed
         before AND replayed after the kill constrain later batches."""

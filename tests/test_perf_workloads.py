@@ -36,6 +36,7 @@ CASES = [
     ("PreferredTopologySpreading", 100, 100),
     ("MixedSchedulingBasePod", 100, 100),
     ("PreemptionBasic", 25, 25),
+    ("PreemptionDense", 25, 25),
     ("Unschedulable", 100, 100),
     ("SchedulingWithMixedChurn", 100, 100),
     ("SchedulingRequiredPodAntiAffinityWithNSSelector", 100, 100),

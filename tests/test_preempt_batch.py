@@ -1,0 +1,364 @@
+"""Oracle parity for the batched device-side DryRunPreemption.
+
+The tentpole acceptance: for every pod the device keeps (no escape),
+ops/backend.preempt_batch must return BIT-IDENTICAL answers to the host
+Evaluator run SEQUENTIALLY over the wave — pod by pod along the wave's
+finalization order (backend.last_wave_order), folding each winner's
+nomination before the next pod, exactly as a one-pod-at-a-time
+scheduler would.  That covers the selected node (including
+pickOneNodeForPreemption tie-breaks), the exact victim set (reprieve
+semantics), the PDB violation count, AND the wave's conflict
+resolution (two winners may legally share one node's capacity).
+Randomized clusters drive the comparison; a seeded failure reproduces
+exactly.
+
+Also covers the grpc/http seam: RemoteTPUBatchBackend ships the victim
+tensors inside /static and the dry run via /preempt, so the remote
+answers must match the in-process backend bit-for-bit, including after
+a worker kill + resync.
+"""
+
+import random
+
+import pytest
+
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import PDBS
+from kubernetes_tpu.ops.backend import TPUBatchBackend
+from kubernetes_tpu.ops.flatten import Caps
+from kubernetes_tpu.scheduler import new_default_framework
+from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler.framework import CycleState
+from kubernetes_tpu.scheduler.preemption import Evaluator
+from kubernetes_tpu.scheduler.types import PodInfo
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+
+def small_caps():
+    return Caps(n_cap=16, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                s_cap=2, sg_cap=8, asg_cap=8, v_cap=8)
+
+
+def make_env():
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    fw = new_default_framework(client, factory)
+    return client, fw
+
+
+def snapshot_from(nodes, bound_pods=()):
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in bound_pods:
+        cache.add_pod(p)
+    return cache.update_snapshot(Snapshot())
+
+
+def oracle(fw, client, snapshot, pod_info):
+    """The per-pod reference answer: full host DryRunPreemption +
+    SelectCandidate, no eviction side effects."""
+    ev = Evaluator(fw, client)
+    cands = ev.find_candidates(CycleState(), pod_info, {}, snapshot)
+    if not cands:
+        return None
+    best = ev.select_candidate(cands)
+    return (best.node_name, sorted(v.key for v in best.victims),
+            best.num_pdb_violations)
+
+
+def sequential_oracle(fw, client, snapshot, pod_infos, order,
+                      nominated=()):
+    """The wave's reference answers: the per-pod Evaluator run pod by
+    pod along `order` (the wave's finalization order), each winner's
+    nomination folded before the next pod — what a sequential
+    scheduler would have decided."""
+    noms = list(nominated)
+
+    class _Nom:
+        def nominated_pods_for_node(self, name):
+            return [pi for pi, n in noms if n == name]
+
+    fw.handle.nominator = _Nom()
+    want: list = [None] * len(pod_infos)
+    for i in order:
+        r = oracle(fw, client, snapshot, pod_infos[i])
+        want[i] = r
+        if r is not None:
+            noms.append((pod_infos[i], r[0]))
+    return want
+
+
+def device(backend, snapshot, pod_infos, nominated=()):
+    node_ord_of = {ni.name: i for i, ni in enumerate(snapshot.list())}
+    res, esc = backend.preempt_batch(pod_infos, node_ord_of, nominated)
+    out = []
+    for r in res:
+        out.append(None if r is None
+                   else (r[0], sorted(r[1]), r[2]))
+    return out, esc
+
+
+def synced_backend(snapshot, caps=None):
+    backend = TPUBatchBackend(caps or small_caps(), batch_size=8)
+    backend.assign([], snapshot)
+    return backend
+
+
+class TestOracleParityRandomized:
+    """Seeded random clusters: device == Evaluator, bit for bit."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_victim_sets_and_tiebreaks_match(self, seed):
+        rng = random.Random(seed)
+        client, fw = make_env()
+        n_nodes = rng.randint(3, 10)
+        nodes = [make_node(f"n{i}")
+                 .capacity(cpu=str(rng.choice([1, 2, 4])), mem="32Gi")
+                 .build() for i in range(n_nodes)]
+        victims = []
+        for i in range(rng.randint(4, 24)):
+            victims.append(
+                make_pod(f"v{i}").priority(rng.randint(0, 4))
+                .req(cpu=f"{rng.choice([100, 200, 400, 800])}m")
+                .node(f"n{rng.randrange(n_nodes)}").build())
+        snap = snapshot_from(nodes, victims)
+        backend = synced_backend(snap)
+        preemptors = [
+            PodInfo(make_pod(f"p{j}").priority(rng.choice([5, 10, 20]))
+                    .req(cpu=f"{rng.choice([500, 1000, 2000, 3500])}m")
+                    .build())
+            for j in range(rng.randint(2, 8))]
+        got, esc = device(backend, snap, preemptors)
+        assert esc == {}
+        order = backend.last_wave_order
+        assert sorted(order) == list(range(len(preemptors)))
+        want = sequential_oracle(fw, client, snap, preemptors, order)
+        assert got == want
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_parity_with_nominated_claims(self, seed):
+        """Nominated >=-priority pods claim capacity on the device
+        exactly as RunFilterPluginsWithNominatedPods does on the host."""
+        rng = random.Random(100 + seed)
+        client, fw = make_env()
+        nodes = [make_node(f"n{i}").capacity(cpu="2", mem="8Gi").build()
+                 for i in range(4)]
+        victims = [make_pod(f"v{i}").priority(1)
+                   .req(cpu="600m").node(f"n{i % 4}").build()
+                   for i in range(8)]
+        snap = snapshot_from(nodes, victims)
+        backend = synced_backend(snap)
+        nom = PodInfo(make_pod("nom").priority(50).req(cpu="1500m").build())
+        nominated = [(nom, f"n{rng.randrange(4)}")]
+        preemptors = [PodInfo(make_pod(f"p{j}").priority(10)
+                              .req(cpu="1500m").build())
+                      for j in range(3)]
+        got, esc = device(backend, snap, preemptors, nominated)
+        assert esc == {}
+        want = sequential_oracle(fw, client, snap, preemptors,
+                                 backend.last_wave_order, nominated)
+        assert got == want
+
+
+class TestOracleParityTargeted:
+    def test_taints_gate_candidates_identically(self):
+        client, fw = make_env()
+        nodes = [
+            make_node("clean").capacity(cpu="1", mem="4Gi").build(),
+            make_node("tainted").capacity(cpu="1", mem="4Gi")
+            .taint("dedicated", "gpu", "NoSchedule").build()]
+        victims = [make_pod("vc").priority(1).req(cpu="800m")
+                   .node("clean").build(),
+                   make_pod("vt").priority(1).req(cpu="800m")
+                   .node("tainted").build()]
+        snap = snapshot_from(nodes, victims)
+        backend = synced_backend(snap)
+        intolerant = PodInfo(make_pod("p0").priority(10)
+                             .req(cpu="800m").build())
+        tolerant_pod = (make_pod("p1").priority(10).req(cpu="800m")
+                        .toleration("dedicated", "gpu", "NoSchedule")
+                        .build())
+        tolerant = PodInfo(tolerant_pod)
+        got, esc = device(backend, snap, [intolerant, tolerant])
+        assert esc == {}
+        want = sequential_oracle(fw, client, snap,
+                                 [intolerant, tolerant],
+                                 backend.last_wave_order)
+        assert got == want
+        assert got[0][0] == "clean"  # intolerant pod never picks tainted
+        # "clean" is claimed and provably closed (1-cpu node): the
+        # tolerant pod's wave answer lands on the tainted node
+        assert got[1][0] == "tainted"
+
+    def test_pdb_violations_counted_identically(self):
+        client, fw = make_env()
+        pdb = {"metadata": {"name": "db-pdb", "namespace": "default"},
+               "spec": {"selector": {"matchLabels": {"app": "db"}}},
+               "status": {"disruptionsAllowed": 0}}
+        client.create(PDBS, pdb)
+        nodes = [make_node("a").capacity(cpu="1", mem="4Gi").build(),
+                 make_node("b").capacity(cpu="1", mem="4Gi").build()]
+        victims = [
+            make_pod("covered").priority(1).labels(app="db")
+            .req(cpu="800m").node("a").build(),
+            make_pod("free").priority(1).labels(app="web")
+            .req(cpu="800m").node("b").build()]
+        snap = snapshot_from(nodes, victims)
+        backend = synced_backend(snap)
+        backend.note_pdb_event("ADDED", pdb)
+        pre = PodInfo(make_pod("p").priority(10).req(cpu="800m").build())
+        got, esc = device(backend, snap, [pre])
+        assert esc == {}
+        want = [oracle(fw, client, snap, pre)]
+        assert got == want
+        # fewest-PDB-violations dominates: node b (uncovered victim) wins
+        assert got[0][0] == "b"
+        assert got[0][2] == 0
+
+    def test_reprieve_spares_what_the_oracle_spares(self):
+        """Minimal victim prefix: removing both victims fits, but the
+        greedy re-add (highest priority first) must spare one — same one
+        the Evaluator spares."""
+        client, fw = make_env()
+        nodes = [make_node("n").capacity(cpu="2", mem="8Gi").build()]
+        victims = [make_pod("hi-v").priority(3).req(cpu="700m")
+                   .node("n").build(),
+                   make_pod("lo-v").priority(1).req(cpu="700m")
+                   .node("n").build()]
+        snap = snapshot_from(nodes, victims)
+        backend = synced_backend(snap)
+        pre = PodInfo(make_pod("p").priority(10).req(cpu="700m").build())
+        got, esc = device(backend, snap, [pre])
+        assert esc == {}
+        want = [oracle(fw, client, snap, pre)]
+        assert got == want
+        # one victim suffices; the higher-priority resident is reprieved
+        assert got[0][1] == ["default/lo-v"]
+
+    def test_zero_victim_nodes_are_not_candidates(self):
+        client, fw = make_env()
+        nodes = [make_node("empty").capacity(cpu="4", mem="8Gi").build()]
+        snap = snapshot_from(nodes)
+        backend = synced_backend(snap)
+        pre = PodInfo(make_pod("p").priority(10).req(cpu="1").build())
+        got, esc = device(backend, snap, [pre])
+        assert esc == {}
+        assert got == [None]  # fits without victims -> plain FitError
+        assert oracle(fw, client, snap, pre) is None
+
+
+class TestEscapeGates:
+    def test_victim_overflow_escapes_with_reason(self):
+        """More residents than v_cap on a reachable node: the device
+        refuses to answer from a truncated victim set."""
+        caps = Caps(n_cap=16, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                    s_cap=2, sg_cap=8, asg_cap=8, v_cap=2)
+        nodes = [make_node("full").capacity(cpu="2", mem="8Gi").build()]
+        victims = [make_pod(f"v{i}").priority(1).req(cpu="300m")
+                   .node("full").build() for i in range(4)]
+        snap = snapshot_from(nodes, victims)
+        backend = synced_backend(snap, caps)
+        pre = PodInfo(make_pod("p").priority(10).req(cpu="1500m").build())
+        got, esc = device(backend, snap, [pre])
+        assert esc == {0: "victim_overflow"}
+        assert got == [None]
+
+    def test_foreign_namespace_pdb_escapes(self):
+        """A blocking PDB outside the preemptor's namespace: the device
+        bit covers it, the Evaluator's namespace-scoped listing does not
+        — the pod must re-prove host-side instead of diverging."""
+        nodes = [make_node("a").capacity(cpu="1", mem="4Gi").build()]
+        victims = [make_pod("v").priority(1).req(cpu="800m")
+                   .node("a").build()]
+        snap = snapshot_from(nodes, victims)
+        backend = synced_backend(snap)
+        backend.note_pdb_event("ADDED", {
+            "metadata": {"name": "other", "namespace": "kube-system"},
+            "spec": {"selector": {"matchLabels": {"app": "x"}}},
+            "status": {"disruptionsAllowed": 0}})
+        pre = PodInfo(make_pod("p").priority(10).req(cpu="800m").build())
+        got, esc = device(backend, snap, [pre])
+        assert esc == {0: "pdb_scope"}
+
+    def test_pdb_with_budget_does_not_gate(self):
+        """disruptionsAllowed > 0 is not blocking: no escape, and the
+        victim counts zero violations on both halves."""
+        client, fw = make_env()
+        pdb = {"metadata": {"name": "roomy", "namespace": "default"},
+               "spec": {"selector": {"matchLabels": {"app": "db"}}},
+               "status": {"disruptionsAllowed": 2}}
+        client.create(PDBS, pdb)
+        nodes = [make_node("a").capacity(cpu="1", mem="4Gi").build()]
+        victims = [make_pod("v").priority(1).labels(app="db")
+                   .req(cpu="800m").node("a").build()]
+        snap = snapshot_from(nodes, victims)
+        backend = synced_backend(snap)
+        backend.note_pdb_event("ADDED", pdb)
+        pre = PodInfo(make_pod("p").priority(10).req(cpu="800m").build())
+        got, esc = device(backend, snap, [pre])
+        assert esc == {}
+        assert got == [oracle(fw, client, snap, pre)]
+        assert got[0][2] == 0
+
+
+@pytest.fixture(params=["http", "grpc"])
+def worker(request):
+    from kubernetes_tpu.ops.remote import DeviceWorker, GrpcDeviceWorker
+    w = (GrpcDeviceWorker() if request.param == "grpc"
+         else DeviceWorker()).start()
+    yield w
+    w.stop()
+
+
+class TestRemoteSeamParity:
+    """The dry run over the wire: victim tensors ride /static, the
+    kernel runs worker-side via /preempt, answers bit-identical."""
+
+    def _cluster(self):
+        nodes = [make_node(f"n{i}").capacity(cpu="2", mem="8Gi").build()
+                 for i in range(4)]
+        victims = [make_pod(f"v{i}").priority(1 + i % 3)
+                   .req(cpu=f"{400 + 200 * (i % 3)}m")
+                   .node(f"n{i % 4}").build() for i in range(10)]
+        return snapshot_from(nodes, victims)
+
+    def test_remote_matches_local_bit_identical(self, worker):
+        from kubernetes_tpu.ops.remote import RemoteTPUBatchBackend
+        snap = self._cluster()
+        local = synced_backend(snap)
+        remote = RemoteTPUBatchBackend(worker.url, small_caps(),
+                                       batch_size=8)
+        remote.assign([], snap)
+        preemptors = [PodInfo(make_pod(f"p{j}").priority(10)
+                              .req(cpu="1800m").build())
+                      for j in range(4)]
+        got_l, esc_l = device(local, snap, preemptors)
+        got_r, esc_r = device(remote, snap, preemptors)
+        assert esc_l == esc_r == {}
+        assert got_l == got_r
+
+    def test_kill_resync_replays_victim_tensors(self, worker):
+        """Chaos acceptance: a worker restart between preemption waves
+        loses the resident victim tensors; the client's resync replays
+        the victim-carrying /static checkpoint and the post-resync
+        answers stay bit-identical."""
+        from kubernetes_tpu.ops.remote import RemoteTPUBatchBackend
+        snap = self._cluster()
+        local = synced_backend(snap)
+        remote = RemoteTPUBatchBackend(worker.url, small_caps(),
+                                       batch_size=8)
+        remote.assign([], snap)
+        preemptors = [PodInfo(make_pod(f"p{j}").priority(10)
+                              .req(cpu="1800m").build())
+                      for j in range(4)]
+        first, _ = device(remote, snap, preemptors)
+        worker.simulate_restart()
+        second, esc = device(remote, snap, preemptors)
+        assert esc == {}
+        assert remote.seam_stats["resyncs"] >= 1
+        assert second == first
+        want, _ = device(local, snap, preemptors)
+        assert second == want

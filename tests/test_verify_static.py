@@ -201,6 +201,41 @@ def test_escapes_always_record_a_reason():
         f"escape.append sites without an escape_reasons write: {offenders}")
 
 
+def test_evictions_confined_to_bulk_commit_path():
+    """Preemption invariant (ISSUE: batched device-side preemption):
+    every pod DELETE issued by scheduler code must route through
+    preemption.evict_victims — THE single eviction site.  A second
+    delete site forks the preemption accounting (events, victim
+    metrics, conflict-resolution dedup) between the per-pod and the
+    bulk-commit paths; confining it statically keeps both paths honest
+    by construction."""
+    import ast
+
+    offenders = []
+    for path in sorted((ROOT / "scheduler").rglob("*.py")):
+        text = path.read_text()
+        if ".delete(" not in text:
+            continue
+        tree = ast.parse(text)
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for n in ast.walk(fn):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "delete"
+                        and n.args
+                        and isinstance(n.args[0], ast.Name)
+                        and n.args[0].id == "PODS"
+                        and not (path.name == "preemption.py"
+                                 and fn.name == "evict_victims")):
+                    offenders.append(
+                        f"scheduler/{path.name}:{n.lineno} in {fn.name}")
+    assert not offenders, (
+        "pod delete calls outside preemption.evict_victims: "
+        f"{offenders}")
+
+
 def test_controller_registry_complete():
     """Every controller module's Controller subclass is constructible from
     the manager's registry (a new controller that isn't wired in is dead
