@@ -204,7 +204,41 @@ EXTRA_CONFIGS = {
     "SchedulingMixedEscapes": {"workload": "SchedulingMixedEscapes",
                                "batch": 16384, "depth": 2,
                                "timeout": 900.0, "pct_nodes": 2},
+    # overload acceptance row: a 30k-pod flood with a periodic escape
+    # class, under a seeded ChaosBatchBackend storm schedule, with the
+    # full overload policy active (bounded admission + AIMD waves +
+    # escape breaker).  The detail carries shed/deferred/wave counters;
+    # bench.py --overload runs the same shape A/B with the policy off.
+    "SchedulingOverloadFlood": {"workload": "SchedulingOverloadFlood",
+                                "batch": 4096, "depth": 2,
+                                "timeout": 1200.0, "overload": True},
 }
+
+
+def _overload_shape(batch: int):
+    """The shared --overload/SchedulingOverloadFlood knobs: a policy
+    sized against the flood (cap = a few waves of backlog) and a seeded
+    chaos schedule (slow waves + adversarial all-escape waves).  One
+    place so the suite row and the A/B mode measure the same regime."""
+    from kubernetes_tpu.ops.faults import OverloadSchedule
+    from kubernetes_tpu.scheduler.config import OverloadPolicy
+
+    policy = OverloadPolicy(
+        queue_cap=int(os.environ.get("BENCH_OVERLOAD_CAP", str(4 * batch))),
+        shed_protect_priority=1000,   # the workload's hipri- pods
+        shed_protect_age=30.0,
+        slo_p99_ms=250.0,
+        wave_min=max(16, batch // 64),
+        wave_increase=max(32, batch // 32),
+        escape_rate_threshold=0.5,
+        escape_min_batch=64,
+        breaker_threshold=1,
+        breaker_probe_interval=0.5,
+        # generous: the watchdog is for WEDGED waves, not a loaded host
+        wave_deadline=120.0)
+    chaos = OverloadSchedule(seed=42, slow_rate=0.05, slow_s=0.05,
+                             all_escape_rate=0.1)
+    return policy, chaos
 
 
 def run_seam_micro(kind: str = "grpc", faulty: bool = False) -> dict:
@@ -445,11 +479,68 @@ def run_trace(out_path: str | None = None) -> dict:
     }
 
 
+def run_overload() -> dict:
+    """--overload mode: the SchedulingOverloadFlood workload under the
+    seeded chaos schedule, A/B WITH the overload policy (bounded
+    admission + AIMD waves + escape-storm breaker + watchdog) and
+    WITHOUT it.  The without side sends every injected escape storm to
+    the per-pod oracle and admits the whole flood unbounded — the gap
+    in pods/s, p99 and peak queue depth is what the protections buy.
+    Two passes in one process (same trade as --trace: a shared
+    interpreter beats doubling the device warmup)."""
+    import copy
+
+    from kubernetes_tpu.perf import (
+        caps_for_nodes, load_workloads, run_named_workload,
+    )
+    from kubernetes_tpu.perf.scheduler_perf import is_measured
+
+    nodes = int(os.environ.get("BENCH_OVERLOAD_NODES", "1000"))
+    pods = int(os.environ.get("BENCH_OVERLOAD_PODS", "10000"))
+    batch = int(os.environ.get("BENCH_OVERLOAD_BATCH", "2048"))
+
+    def build_cfg() -> dict:
+        cfg = copy.deepcopy(load_workloads()["SchedulingOverloadFlood"])
+        tpl = cfg["workloadTemplate"]
+        for op in tpl:
+            if op["opcode"] == "createNodes":
+                op["count"] = nodes
+            elif op["opcode"] == "createPods" and is_measured(op, tpl):
+                op["count"] = pods
+            elif op["opcode"] == "barrier":
+                op["timeout"] = 900.0
+        return cfg
+
+    caps = caps_for_nodes(nodes)
+    out: dict = {"nodes": nodes, "pods": pods, "batch": batch}
+    for tag, with_policy in (("with_policy", True), ("without_policy", False)):
+        policy, chaos = _overload_shape(batch)
+        summary, stats = run_named_workload(
+            build_cfg(), tpu=True, caps=caps, batch_size=batch,
+            pipeline_depth=2, overload=policy if with_policy else None,
+            chaos_schedule=chaos)
+        e2e = stats.get("e2e") or {}
+        side = {"pods_per_s": round(summary.average, 1),
+                "p99_ms": e2e.get("p99_ms"),
+                "barrier_ok": stats.get("barrier_ok", False),
+                "chaos_injected": stats.get("chaos_injected")}
+        if "escape_rate" in stats:
+            side["escape_rate"] = stats["escape_rate"]
+        if "overload" in stats:
+            side["overload"] = stats["overload"]
+        out[tag] = side
+    wp, np_ = out["with_policy"], out["without_policy"]
+    out["policy_speedup"] = round(
+        wp["pods_per_s"] / max(np_["pods_per_s"], 1e-9), 2)
+    return out
+
+
 def run_once(workload: str, nodes: int | None, pods: int | None,
              batch: int, barrier_timeout: float = 900.0,
              rate: float | None = None, depth: int = 1,
              admission_ms: float = 0.0, via_http: bool = False,
-             null_device: bool = False, pct_nodes: int = 0) -> dict:
+             null_device: bool = False, pct_nodes: int = 0,
+             overload: bool = False) -> dict:
     """One full workload pass in this process; returns the result dict."""
     import copy
 
@@ -477,6 +568,9 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
                    if op["opcode"] == "createNodes")
 
     caps = caps_for_nodes(n_nodes)  # THE shared cap policy (perf/__init__)
+    policy = chaos = None
+    if overload:
+        policy, chaos = _overload_shape(batch)
     t0 = time.monotonic()
     summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
                                         batch_size=batch,
@@ -484,7 +578,9 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
                                         admission_interval=admission_ms / 1e3,
                                         via_http=via_http,
                                         null_device=null_device,
-                                        percentage_of_nodes_to_score=pct_nodes)
+                                        percentage_of_nodes_to_score=pct_nodes,
+                                        overload=policy,
+                                        chaos_schedule=chaos)
     wall = time.monotonic() - t0
     if not stats.get("barrier_ok", False):
         return {"error": "pods left unscheduled", "value": 0.0,
@@ -499,6 +595,10 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
         detail["escape_rate"] = stats["escape_rate"]
     if "preemption_attempts" in stats:
         detail["preemption_attempts"] = stats["preemption_attempts"]
+    if "overload" in stats:
+        detail["overload"] = stats["overload"]
+    if "chaos_injected" in stats:
+        detail["chaos_injected"] = stats["chaos_injected"]
     return {"value": summary.average, "wall_s": round(wall, 1),
             "detail": detail}
 
@@ -561,7 +661,8 @@ def child_main() -> None:
                              if os.environ.get("_BENCH_W_HTTP") == "proc"
                              else os.environ.get("_BENCH_W_HTTP") == "1"),
                    null_device=os.environ.get("_BENCH_W_NULL") == "1",
-                   pct_nodes=int(os.environ.get("_BENCH_W_PCT", "0")))
+                   pct_nodes=int(os.environ.get("_BENCH_W_PCT", "0")),
+                   overload=os.environ.get("_BENCH_W_OVERLOAD") == "1")
     if "error" in res:
         emit(0.0, {"error": res["error"], **res["detail"]})
         sys.exit(1)
@@ -604,6 +705,8 @@ def _config_env(c: dict) -> dict:
         env["_BENCH_W_NULL"] = "1"
     if c.get("pct_nodes"):
         env["_BENCH_W_PCT"] = str(c["pct_nodes"])
+    if c.get("overload"):
+        env["_BENCH_W_OVERLOAD"] = "1"
     return env
 
 
@@ -619,6 +722,13 @@ def main() -> None:
                and not sys.argv[idx + 1].startswith("-") else None)
         res = run_trace(out)
         emit(res["traced_pods_per_s"], {"mode": "trace", **res})
+        return
+    if "--overload" in sys.argv:
+        # in-process A/B by design (same trade as --trace): both sides
+        # share one warmed interpreter + device so the policy gap isn't
+        # polluted by a second cold start
+        res = run_overload()
+        emit(res["with_policy"]["pods_per_s"], {"mode": "overload", **res})
         return
     if not _device_reachable():
         # The chip tunnel is down — but null-device configs measure the

@@ -126,7 +126,10 @@ class Client:
             try:
                 out.append((self.bind({"metadata": {"namespace": ns,
                                                     "name": nm}}, node), None))
-            except kv.StoreError as e:
+            except Exception as e:
+                # per-entry, and not just StoreError: one pod's transport
+                # blip must not abort the rest of the batch — the caller
+                # classifies each entry on its own
                 out.append((None, e))
         return out
 

@@ -19,6 +19,7 @@ Differences from the reference, on purpose:
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from typing import Callable
 
@@ -52,6 +53,12 @@ class Informer:
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # relist accounting ({reason: count}, drained into the
+        # informer_relist_total counter) + seeded per-informer jitter so
+        # every informer's retry clock is decorrelated deterministically
+        self._relist_pending: dict[str, int] = {}
+        self._retry_rng = random.Random(
+            hash(resource) & 0xFFFFFFFF)
 
     # -- lister ----------------------------------------------------------
 
@@ -129,15 +136,41 @@ class Informer:
     # -- reflector loop --------------------------------------------------
 
     def _run(self) -> None:
+        consecutive_failures = 0
         while not self._stop.is_set():
             try:
                 self._list_and_watch()
+                consecutive_failures = 0
             except kv.TooOldError:
+                # the relist itself recovers the window: no backoff
                 logger.info("informer %s: watch too old, relisting", self.resource)
+                self._tally_relist("too_old")
+                consecutive_failures = 0
                 continue
             except Exception:  # pragma: no cover - defensive, crash-only restart
-                logger.exception("informer %s: list/watch failed, retrying", self.resource)
-                self._stop.wait(1.0)
+                # jittered exponential backoff: a down store must not get a
+                # synchronized relist storm from every informer the moment
+                # it returns (they'd all retry in lockstep on a fixed sleep)
+                self._tally_relist("error")
+                consecutive_failures += 1
+                delay = min(30.0, 1.0 * 2 ** (consecutive_failures - 1))
+                delay *= 0.5 + self._retry_rng.random()  # +/-50%
+                logger.exception("informer %s: list/watch failed, retrying "
+                                 "in %.1fs", self.resource, delay)
+                self._stop.wait(delay)
+
+    def _tally_relist(self, reason: str) -> None:
+        with self._lock:
+            self._relist_pending[reason] = (
+                self._relist_pending.get(reason, 0) + 1)
+
+    def drain_relist_total(self) -> dict[str, int]:
+        """Pop the pending {reason: count} relist tallies (aggregated per
+        resource by SharedInformerFactory.drain_relist_total and drained
+        into informer_relist_total by Scheduler.expose_metrics)."""
+        with self._lock:
+            out, self._relist_pending = self._relist_pending, {}
+        return out
 
     def _list_and_watch(self) -> None:
         items, rv = self.client.list(self.resource)
@@ -259,3 +292,15 @@ class SharedInformerFactory:
             informers = list(self._informers.values())
         for inf in informers:
             inf.stop()
+
+    def drain_relist_total(self) -> dict[tuple[str, str], int]:
+        """Pop {(resource, reason): count} relist tallies across every
+        informer (feeds the informer_relist_total counter)."""
+        with self._lock:
+            informers = list(self._informers.items())
+        out: dict[tuple[str, str], int] = {}
+        for resource, inf in informers:
+            for reason, n in inf.drain_relist_total().items():
+                key = (resource, reason)
+                out[key] = out.get(key, 0) + n
+        return out

@@ -742,6 +742,33 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             out, self._telemetry_pending = self._telemetry_pending, []
         return out
 
+    # -- stuck-wave watchdog support -------------------------------------
+
+    def abandon_wave(self) -> None:
+        """Watchdog cancel (scheduler._resolve_with_deadline): a cancelled
+        wave's pods were requeued and never assumed, so its in-flight
+        device accounting must not chain into the next dispatch.  Drop
+        the pipeline bookkeeping and force a full tensor refresh from the
+        authoritative cache view on the next batch.
+
+        Lock acquisition is best-effort with a short timeout: the stuck
+        resolve may be blocked inside a device pull while HOLDING the
+        lock, and the watchdog must not hang the scheduling loop behind
+        it.  The unlocked fallback is safe for this state: replacing
+        _state/_last_epoch and clearing _unresolved only widens the next
+        dispatch's refresh; resolve() tolerates its holder vanishing
+        (the remove is try/except)."""
+        got = self._lock.acquire(timeout=0.1)
+        try:
+            self._unresolved.clear()
+            self._state = None
+            self._last_epoch = None
+            self.stats["abandoned_waves"] = (
+                self.stats.get("abandoned_waves", 0) + 1)
+        finally:
+            if got:
+                self._lock.release()
+
     def _mask_densities(self, batch: PodBatch, n: int) -> dict[str, float]:
         """Per-plugin-family constraint-mask density: the fraction of the
         batch's live slots carrying an active mask for that family.  The

@@ -138,3 +138,139 @@ class FaultyTransport:
 
     def close(self) -> None:
         self.inner.close()
+
+
+# -- overload chaos (overload-resilience PR) -----------------------------
+#
+# Same philosophy, one seam up: where FaultyTransport injects TRANSPORT
+# faults under the remote seam, ChaosBatchBackend injects LOAD faults at
+# the BatchBackend contract itself — slow waves (a device that still
+# answers, but late: the stuck-wave watchdog's prey) and adversarial
+# all-escape waves (every pod SKIPs toward the per-pod oracle: the
+# escape-storm breaker's prey).  Seeded + scriptable exactly like
+# FaultSchedule so tests/test_overload.py and bench.py --overload replay
+# identical storms.
+
+SLOW = "slow"
+ALL_ESCAPE = "all_escape"
+
+
+class OverloadSchedule:
+    """Seeded, reproducible per-WAVE overload decisions.
+
+    One rng draw per wave regardless of the script (same stream-stability
+    rule as FaultSchedule): scripted waves never shift the random stream
+    of the waves around them."""
+
+    def __init__(self, seed: int = 0, slow_rate: float = 0.0,
+                 slow_s: float = 0.25, all_escape_rate: float = 0.0,
+                 script: dict[int, str] | None = None):
+        self.rng = random.Random(seed)
+        self.slow_rate = slow_rate
+        self.slow_s = slow_s
+        self.all_escape_rate = all_escape_rate
+        self.script = dict(script or {})
+
+    def action(self, wave_index: int) -> str:
+        u = self.rng.random()
+        scripted = self.script.get(wave_index)
+        if scripted is not None:
+            return scripted
+        if u < self.slow_rate:
+            return SLOW
+        if u < self.slow_rate + self.all_escape_rate:
+            return ALL_ESCAPE
+        return NONE
+
+
+class ChaosBatchBackend:
+    """A BatchBackend wrapper that injects schedule-driven overload faults.
+
+    SLOW        -> forward the dispatch; the returned resolve() sleeps
+                   slow_s before yielding the real results (a live but
+                   late device — deadline/watchdog territory).
+    ALL_ESCAPE  -> do NOT touch the inner backend: every pod in the wave
+                   comes back (None, SKIP) as if its constraints were not
+                   tensor-encodable, and the wave tallies a
+                   ("chaos", "injected_all_escape") escape reason.  No
+                   device state is claimed, so abandoning or retrying the
+                   wave needs no repair.
+
+    `injected` counts fired faults; `waves` is the dispatch count."""
+
+    def __init__(self, inner, schedule: OverloadSchedule):
+        self.inner = inner
+        self.supports_pipelining = getattr(inner, "supports_pipelining", True)
+        self.schedule = schedule
+        self.waves = 0
+        self.injected = {SLOW: 0, ALL_ESCAPE: 0}
+        self._lock = threading.Lock()
+        self._esc_pending: dict[tuple[str, str], int] = {}
+
+    @property
+    def stats(self):
+        return getattr(self.inner, "stats", {})
+
+    def dispatch(self, pod_infos, snapshot):
+        from ..scheduler.types import SKIP, Status
+        with self._lock:
+            i = self.waves
+            self.waves += 1
+            act = self.schedule.action(i)
+        if act == ALL_ESCAPE:
+            self.injected[ALL_ESCAPE] += 1
+            n = len(pod_infos)
+            with self._lock:
+                key = ("chaos", "injected_all_escape")
+                self._esc_pending[key] = self._esc_pending.get(key, 0) + n
+            results = [(None, Status(SKIP, "injected escape storm"))
+                       for _ in range(n)]
+            return lambda: results
+        resolve = self.inner.dispatch(pod_infos, snapshot)
+        if not callable(resolve):
+            return resolve  # FLUSH_FIRST sentinel passes through
+        if act == SLOW:
+            self.injected[SLOW] += 1
+
+            def slow_resolve():
+                time.sleep(self.schedule.slow_s)
+                return resolve()
+            return slow_resolve
+        return resolve
+
+    def assign(self, pod_infos, snapshot):
+        return self.dispatch(pod_infos, snapshot)()
+
+    # -- forwarded backend surface (all optional on the contract) --------
+
+    def warmup(self) -> None:
+        fn = getattr(self.inner, "warmup", None)
+        if fn is not None:
+            fn()
+
+    def health(self):
+        fn = getattr(self.inner, "health", None)
+        return fn() if fn is not None else True
+
+    def prefetch(self, view) -> None:
+        fn = getattr(self.inner, "prefetch", None)
+        if fn is not None:
+            fn(view)
+
+    def abandon_wave(self) -> None:
+        fn = getattr(self.inner, "abandon_wave", None)
+        if fn is not None:
+            fn()
+
+    def drain_escape_reasons(self) -> dict:
+        with self._lock:
+            out, self._esc_pending = self._esc_pending, {}
+        fn = getattr(self.inner, "drain_escape_reasons", None)
+        if fn is not None:
+            for key, cnt in fn().items():
+                out[key] = out.get(key, 0) + cnt
+        return out
+
+    def drain_batch_telemetry(self) -> list:
+        fn = getattr(self.inner, "drain_batch_telemetry", None)
+        return fn() if fn is not None else []

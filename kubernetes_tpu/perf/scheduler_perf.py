@@ -234,7 +234,9 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                   null_device: bool = False,
                   percentage_of_nodes_to_score: int = 0,
                   remote_seam: str | None = None,
-                  tracing_provider=None) -> PerfCluster:
+                  tracing_provider=None,
+                  overload=None,
+                  chaos_schedule=None) -> PerfCluster:
     """mustSetupScheduler (util.go:79): in-proc everything, no kubelet.
 
     pipeline_depth/admission_interval select latency mode (scheduler.py):
@@ -257,7 +259,12 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
     SEPARATE OS PROCESS (`python -m kubernetes_tpu.cmd.apiserver`),
     the reference's actual deployment shape (separate binaries): the
     server's JSON/admission/WAL work then runs on its own interpreter
-    and cores instead of sharing the scheduler's GIL."""
+    and cores instead of sharing the scheduler's GIL.
+
+    overload takes a config.OverloadPolicy (configure_overload: bounded
+    admission + AIMD waves + escape breaker + watchdog); chaos_schedule
+    takes an ops.faults.OverloadSchedule and wraps the batch backend in
+    ChaosBatchBackend — together they are the bench --overload shape."""
     from ..utils.gctune import tune_for_throughput
     tune_for_throughput()  # CPython gen-2 pauses cost ~35% at bench scale
     server = tmpdir = proc = None
@@ -351,6 +358,9 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
             from ..ops.backend import TPUBatchBackend
             backend = TPUBatchBackend(caps or Caps(), batch_size=batch_size)
         backend.warmup()
+        if chaos_schedule is not None:
+            from ..ops.faults import ChaosBatchBackend
+            backend = ChaosBatchBackend(backend, chaos_schedule)
         fw = new_default_framework(client, factory)
         profiles = {"default-scheduler": Profile(
             fw, batch_backend=backend, batch_size=batch_size,
@@ -360,6 +370,8 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                           admission_interval=admission_interval)
     else:
         sched = new_scheduler(client, factory)
+    if overload is not None:
+        sched.configure_overload(overload)
     if tracing_provider is not None:
         sched.configure_tracing(tracing_provider)
     factory.start()
@@ -723,7 +735,9 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                        null_device: bool = False,
                        percentage_of_nodes_to_score: int = 0,
                        remote_seam: str | None = None,
-                       tracing_provider=None
+                       tracing_provider=None,
+                       overload=None,
+                       chaos_schedule=None
                        ) -> tuple[ThroughputSummary, dict]:
     """Run one workload config end to end; returns (throughput, stats)."""
     cluster = setup_cluster(
@@ -732,7 +746,8 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         admission_interval=admission_interval,
         via_http=via_http, null_device=null_device,
         percentage_of_nodes_to_score=percentage_of_nodes_to_score,
-        remote_seam=remote_seam, tracing_provider=tracing_provider)
+        remote_seam=remote_seam, tracing_provider=tracing_provider,
+        overload=overload, chaos_schedule=chaos_schedule)
     collector = ThroughputCollector(cluster.store)
     try:
         ops = config["workloadTemplate"]
@@ -770,7 +785,24 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                 esc = stats["backend_stats"].get("escaped", 0)
                 if pods:
                     stats["escape_rate"] = round(esc / pods, 4)
+                injected = getattr(p.batch_backend, "injected", None)
+                if injected is not None:  # ChaosBatchBackend wrapper
+                    stats["chaos_injected"] = dict(injected)
                 break
+        if overload is not None:
+            cluster.scheduler.expose_metrics()  # drain shed/defer tallies
+            prom = cluster.scheduler.metrics.prom
+            tuner = cluster.scheduler._wave_tuner
+            stats["overload"] = {
+                "shed": {f"{r}/{b}": v for (r, b), v
+                         in prom.queue_shed_total.values().items()},
+                "deferred": sum(
+                    prom.overload_deferred_total.values().values()),
+                "wave_cancels": sum(
+                    prom.overload_wave_cancel_total.values().values()),
+                "final_wave": (tuner.current() if tuner is not None
+                               else batch_size),
+            }
         return summary, stats
     finally:
         cluster.shutdown()

@@ -37,6 +37,11 @@ list + the enabled/disabled merge rules).  Shape accepted (YAML or dict):
     samplingRatePerMillion: 10000  # (component_base/tracing.py; mirrors
     maxSpans: 4096                 #  apiserver TracingConfiguration's
     maxTraces: 256                 #  samplingRatePerMillion field)
+  overload:                   # closed-loop overload protection (no upstream
+    queueCap: 16384           #  analogue; see OverloadPolicy below)
+    sloP99Ms: 250
+    escapeRateThreshold: 0.5
+    waveDeadlineSeconds: 30
 
 Merge semantics (default_plugins.go mergePlugins):
   1. start from the default MultiPoint list;
@@ -200,6 +205,102 @@ def _parse_tracing(data: dict) -> TracingPolicy:
 
 
 @dataclass
+class OverloadPolicy:
+    """Closed-loop overload protection for the batch pipeline.
+
+    Configured via the `overload:` stanza; every knob defaults OFF so an
+    unconfigured scheduler behaves exactly as before.  Four independent
+    layers (in the spirit of Borg's overload-tolerant admission and the
+    stability patterns in ops/failover.py):
+
+      queue_cap        bounded admission — activeQ depth cap; excess pods
+                       are shed lowest-priority-first (youngest first
+                       within a priority) into the backoff tier, never
+                       dropped.  Pods at/above shed_protect_priority and
+                       pods older than shed_protect_age are never shed,
+                       so the cap is soft with respect to protected pods
+                       and every pod is eventually admitted.
+      slo_p99_ms       adaptive wave sizing — AIMD control of the dispatch
+                       batch size against this per-wave latency SLO:
+                       multiplicative decrease on breach, additive
+                       increase while under it and backlogged.
+      escape_rate_threshold
+                       escape-storm breaker — when a batch's SKIP (escape)
+                       rate exceeds this fraction for breaker_threshold
+                       consecutive batches, escapes are deferred into the
+                       backoff tiers instead of flooding the per-pod
+                       oracle; a probe batch every breaker_probe_interval
+                       re-closes the breaker once escapes subside.
+      wave_deadline    stuck-wave watchdog — a wave whose results have not
+                       landed this many seconds after dispatch is
+                       cancelled: the backend abandons the wave and the
+                       pods requeue through the BackendUnavailableError
+                       path."""
+
+    queue_cap: int = 0                  # 0 = unbounded (admission off)
+    shed_protect_priority: int = 1000   # >= this priority: never shed
+    shed_protect_age: float = 30.0      # queued longer than this: never shed
+    slo_p99_ms: float = 0.0             # 0 = adaptive wave sizing off
+    wave_min: int = 16                  # AIMD floor for the wave size
+    wave_increase: int = 32             # additive increase per good wave
+    wave_decrease: float = 0.5          # multiplicative decrease on breach
+    escape_rate_threshold: float = 0.0  # 0 = escape-storm breaker off
+    escape_min_batch: int = 8           # smaller batches never count as storms
+    breaker_threshold: int = 3          # consecutive storm batches to open
+    breaker_probe_interval: float = 5.0  # seconds between probe batches
+    wave_deadline: float = 0.0          # 0 = stuck-wave watchdog off
+
+    @property
+    def enabled(self) -> bool:
+        return (self.queue_cap > 0 or self.slo_p99_ms > 0
+                or self.escape_rate_threshold > 0 or self.wave_deadline > 0)
+
+
+# overload YAML key -> OverloadPolicy field
+_OVERLOAD_FIELDS = {
+    "queueCap": "queue_cap",
+    "shedProtectPriority": "shed_protect_priority",
+    "shedProtectAgeSeconds": "shed_protect_age",
+    "sloP99Ms": "slo_p99_ms",
+    "waveMin": "wave_min",
+    "waveIncrease": "wave_increase",
+    "waveDecrease": "wave_decrease",
+    "escapeRateThreshold": "escape_rate_threshold",
+    "escapeMinBatch": "escape_min_batch",
+    "breakerThreshold": "breaker_threshold",
+    "breakerProbeIntervalSeconds": "breaker_probe_interval",
+    "waveDeadlineSeconds": "wave_deadline",
+}
+
+
+def _parse_overload(data: dict) -> OverloadPolicy:
+    kwargs = {}
+    for key, value in (data or {}).items():
+        if key not in _OVERLOAD_FIELDS:
+            raise ConfigError(f"unknown overload key {key!r}")
+        kwargs[_OVERLOAD_FIELDS[key]] = value
+    policy = OverloadPolicy(**kwargs)
+    for f in ("queue_cap", "slo_p99_ms", "wave_deadline"):
+        if getattr(policy, f) < 0:
+            raise ConfigError(f"overload {f} must be >= 0 (0 disables)")
+    if policy.shed_protect_age <= 0:
+        raise ConfigError("overload shedProtectAgeSeconds must be positive")
+    if policy.wave_min < 1 or policy.wave_increase < 1:
+        raise ConfigError("overload waveMin/waveIncrease must be >= 1")
+    if not 0.0 < policy.wave_decrease < 1.0:
+        raise ConfigError("overload waveDecrease must be in (0,1)")
+    if not 0.0 <= policy.escape_rate_threshold <= 1.0:
+        raise ConfigError("overload escapeRateThreshold must be in [0,1]")
+    if policy.escape_min_batch < 1:
+        raise ConfigError("overload escapeMinBatch must be >= 1")
+    if policy.breaker_threshold < 1:
+        raise ConfigError("overload breakerThreshold must be >= 1")
+    if policy.breaker_probe_interval <= 0:
+        raise ConfigError("overload breakerProbeIntervalSeconds must be positive")
+    return policy
+
+
+@dataclass
 class SchedulerConfig:
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 0
@@ -209,6 +310,7 @@ class SchedulerConfig:
     extenders: list[dict] = field(default_factory=list)
     remote_seam: RemoteSeamPolicy = field(default_factory=RemoteSeamPolicy)
     tracing: TracingPolicy = field(default_factory=TracingPolicy)
+    overload: OverloadPolicy = field(default_factory=OverloadPolicy)
 
 
 def load_config(source: str | dict) -> SchedulerConfig:
@@ -236,6 +338,7 @@ def load_config(source: str | dict) -> SchedulerConfig:
         extenders=data.get("extenders") or [],
         remote_seam=_parse_remote_seam(data.get("remoteSeam")),
         tracing=_parse_tracing(data.get("tracing")),
+        overload=_parse_overload(data.get("overload")),
     )
     if cfg.parallelism <= 0:
         raise ConfigError("parallelism must be positive")
@@ -369,6 +472,8 @@ def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
     # RemoteTPUBatchBackend into a profile picks up the configured
     # deadlines/retry budget instead of the hard-coded defaults
     sched.remote_seam_policy = cfg.remote_seam
+    if cfg.overload.enabled:
+        sched.configure_overload(cfg.overload)
     if cfg.tracing.enabled:
         # the process-wide provider backs /debug/traces on the apiserver's
         # HTTP mux; tests that want isolation construct their own provider

@@ -138,6 +138,37 @@ class Metrics:
             "scheduler_tpu_victim_occupancy",
             "Fraction of per-node victim tensor slots (v_cap) holding a "
             "resident pod, from the most recent victim-tensor refresh.")
+        # overload-protection additions (overload: stanza): bounded
+        # admission sheds, escape-storm deferrals, watchdog cancels, and
+        # the AIMD wave-size / breaker state gauges.  Shed tallies
+        # accumulate inside the queue and are drained at expose time
+        # (same drain discipline as the escape counter above).
+        self.queue_shed_total = cbm.Counter(
+            "scheduler_queue_shed_total",
+            "Pods shed from activeQ to the backoff tier by bounded "
+            "admission, by shed reason and pod priority band.",
+            labels=("reason", "priority_band"))
+        self.overload_deferred_total = cbm.Counter(
+            "scheduler_overload_deferred_total",
+            "Escaped pods deferred to the backoff tier by the open "
+            "escape-storm breaker instead of the per-pod oracle, by "
+            "dominant escape reason.",
+            labels=("reason",))
+        self.overload_wave_cancel_total = cbm.Counter(
+            "scheduler_overload_wave_cancel_total",
+            "Waves cancelled by the stuck-wave watchdog, by reason.",
+            labels=("reason",))
+        self.overload_wave_size = cbm.Gauge(
+            "scheduler_overload_wave_size",
+            "Current AIMD-controlled dispatch wave size.")
+        self.overload_breaker_open = cbm.Gauge(
+            "scheduler_overload_breaker_open",
+            "Escape-storm breaker state (1 = open: escapes deferred).")
+        self.informer_relist_total = cbm.Counter(
+            "informer_relist_total",
+            "Informer list/watch restarts, by resource and reason "
+            "(too_old = watch window expired, error = list/watch failed).",
+            labels=("resource", "reason"))
         r.must_register(
             self.schedule_attempts, self.scheduling_attempt_duration,
             self.scheduling_algorithm_duration, self.pod_scheduling_duration,
@@ -151,7 +182,10 @@ class Metrics:
             self.tpu_seam_events, self.tpu_seam_state,
             self.tpu_seam_breaker, self.tpu_escape_total,
             self.tpu_mask_density, self.tpu_feasible_nodes,
-            self.tpu_batch_waves, self.tpu_victim_occupancy)
+            self.tpu_batch_waves, self.tpu_victim_occupancy,
+            self.queue_shed_total, self.overload_deferred_total,
+            self.overload_wave_cancel_total, self.overload_wave_size,
+            self.overload_breaker_open, self.informer_relist_total)
 
     def expose(self) -> str:
         return self.registry.expose()

@@ -33,6 +33,10 @@ DEFAULT_POD_INITIAL_BACKOFF = 1.0     # scheduler.go:188
 DEFAULT_POD_MAX_BACKOFF = 10.0        # scheduler.go:193
 DEFAULT_UNSCHEDULABLE_TIMEOUT = 300.0  # flushUnschedulablePodsLeftover
 
+# system-cluster-critical / system-node-critical priority floor; pods at or
+# above this are in the "system" band and exempt from admission shedding
+SYSTEM_PRIORITY_BAND = 2_000_000_000
+
 
 def default_sort_key(qpi: QueuedPodInfo) -> tuple:
     """PrioritySort plugin order: higher .spec.priority first, then FIFO."""
@@ -141,6 +145,22 @@ class _BucketQueue:
     def remove(self, key: str) -> QueuedPodInfo | None:
         return self._entries.pop(key, None)
 
+    def pop_tail(self) -> QueuedPodInfo | None:
+        """Pop the LOWEST-priority, youngest pod — the shed victim order
+        for bounded admission.  Walks buckets from the largest -priority
+        key (lowest priority) and takes the deque tail (latest insertion).
+        Emptied buckets are left in place: their key is still in the
+        _prios heap and pop()/peek() retire the pair together."""
+        entries = self._entries
+        for p in sorted(self._buckets, reverse=True):
+            d = self._buckets[p]
+            while d:
+                qpi = d.pop()
+                if entries.get(qpi.key) is qpi:
+                    del entries[qpi.key]
+                    return qpi
+        return None
+
     def pop_n(self, max_n: int) -> list[QueuedPodInfo]:
         """Drain up to max_n pods in priority/FIFO order.  The full-drain
         case (the TPU batch path's dominant shape: the whole queue fits
@@ -231,6 +251,9 @@ class SchedulingQueue:
         unschedulable_timeout: float = DEFAULT_UNSCHEDULABLE_TIMEOUT,
         cluster_event_map: dict[str, list[ClusterEvent]] | None = None,
         priority_fifo: bool | None = None,
+        queue_cap: int = 0,
+        shed_protect_priority: int = 1000,
+        shed_protect_age: float = 30.0,
     ):
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -251,6 +274,11 @@ class SchedulingQueue:
         self._move_request_cycle = -1
         self._closed = False
         self._flush_thread: threading.Thread | None = None
+        # bounded admission (overload: stanza) — 0 = unbounded
+        self._queue_cap = queue_cap
+        self._shed_protect_priority = shed_protect_priority
+        self._shed_protect_age = shed_protect_age
+        self._shed_pending: dict[tuple[str, str], int] = {}
 
     # -- backoff ---------------------------------------------------------
 
@@ -268,6 +296,82 @@ class SchedulingQueue:
     def _is_backing_off(self, qpi: QueuedPodInfo) -> bool:
         return qpi.attempts > 0 and self._backoff_expiry(qpi) > time.monotonic()
 
+    # -- bounded admission (overload: stanza) ----------------------------
+
+    def set_overload_policy(self, queue_cap: int,
+                            shed_protect_priority: int = 1000,
+                            shed_protect_age: float = 30.0) -> None:
+        with self._lock:
+            self._queue_cap = queue_cap
+            self._shed_protect_priority = shed_protect_priority
+            self._shed_protect_age = shed_protect_age
+
+    def _priority_band(self, priority: int) -> str:
+        if priority >= SYSTEM_PRIORITY_BAND:
+            return "system"
+        if priority >= self._shed_protect_priority:
+            return "high"
+        if priority > 0:
+            return "normal"
+        return "best_effort"
+
+    def _shed_victim_locked(self) -> QueuedPodInfo | None:
+        """Lowest-priority-first, youngest-first-within-priority victim.
+        O(1)-ish on the bucket queue; generic heaps take an O(n) scan."""
+        pop_tail = getattr(self._active, "pop_tail", None)
+        if pop_tail is not None:
+            return pop_tail()
+        items = self._active.items()
+        if not items:
+            return None
+        victim = min(items, key=lambda q: (q.pod_info.priority, -q.timestamp))
+        return self._active.remove(victim.key)
+
+    def _shed_over_cap_locked(self, reason: str) -> None:
+        """Shed activeQ down to the cap: move excess pods to the backoff
+        tier, lowest priority first.  Shedding is never a drop — the pod
+        keeps its initial_attempt_timestamp and re-enters through the
+        backoff flush; attempts is bumped so repeat sheds wait out a
+        growing backoff instead of hot-looping shed→flush→shed.
+
+        Protection (pods put back untouched, making the cap soft):
+          - priority >= shed_protect_priority (system/high band), and
+          - pods queued longer than shed_protect_age — which bounds the
+            shed loop: every pod's age only grows, so eventual admission
+            is guaranteed."""
+        cap = self._queue_cap
+        if cap <= 0:
+            return
+        excess = len(self._active) - cap
+        if excess <= 0:
+            return
+        now = time.monotonic()
+        protected: list[QueuedPodInfo] = []
+        for _ in range(excess):
+            qpi = self._shed_victim_locked()
+            if qpi is None:
+                break
+            if (qpi.pod_info.priority >= self._shed_protect_priority
+                    or now - qpi.initial_attempt_timestamp
+                    >= self._shed_protect_age):
+                protected.append(qpi)
+                continue
+            qpi.attempts += 1
+            qpi.timestamp = now
+            self._backoff.push(qpi)
+            band = self._priority_band(qpi.pod_info.priority)
+            key = (reason, band)
+            self._shed_pending[key] = self._shed_pending.get(key, 0) + 1
+        for qpi in protected:
+            self._active.push(qpi)
+
+    def drain_shed_total(self) -> dict[tuple[str, str], int]:
+        """Drained by Scheduler.expose_metrics into
+        scheduler_queue_shed_total{reason,priority_band}."""
+        with self._lock:
+            out, self._shed_pending = self._shed_pending, {}
+        return out
+
     # -- add/pop ---------------------------------------------------------
 
     def add(self, pod: Obj) -> None:
@@ -277,6 +381,7 @@ class SchedulingQueue:
             self._unschedulable.pop(qpi.key, None)
             self._active.push(qpi)
             self.nominator.add_nominated_pod(qpi.pod_info)
+            self._shed_over_cap_locked("admission")
             self._cond.notify()
 
     def add_many(self, pods: list[Obj]) -> None:
@@ -289,6 +394,7 @@ class SchedulingQueue:
                 self._unschedulable.pop(qpi.key, None)
                 self._active.push(qpi)
                 self.nominator.add_nominated_pod(qpi.pod_info)
+            self._shed_over_cap_locked("admission")
             self._cond.notify()
 
     def delete_many(self, pods: list[Obj]) -> None:
@@ -456,6 +562,7 @@ class SchedulingQueue:
                 del self._unschedulable[key]
             self._move_request_cycle = self._scheduling_cycle
             if moved:
+                self._shed_over_cap_locked("event_move")
                 self._cond.notify_all()
 
     def assigned_pod_added(self, pod: Obj) -> None:
@@ -496,6 +603,7 @@ class SchedulingQueue:
                                 self._active.push(qpi)
                                 notified = True
                 if notified:
+                    self._shed_over_cap_locked("backoff_promotion")
                     self._cond.notify_all()
 
     def close(self) -> None:
@@ -513,8 +621,3 @@ class SchedulingQueue:
         summary = (f"activeQ:{len(active)} backoffQ:{len(backoff)} "
                    f"unschedulable:{len(unsched)}")
         return active + backoff + unsched, summary
-
-    def stats(self) -> dict[str, int]:
-        with self._lock:
-            return {"active": len(self._active), "backoff": len(self._backoff),
-                    "unschedulable": len(self._unschedulable)}

@@ -188,6 +188,85 @@ class BatchBackend:
         return lambda: results
 
 
+class _WaveTuner:
+    """AIMD controller for the dispatch wave size (overload: sloP99Ms).
+
+    One observation per retired wave: dispatch -> results-applied latency
+    against the SLO.  Breach -> multiplicative decrease (halve by
+    default), under -> additive increase, faster while the queue is
+    backlogged beyond the current wave.  Classic AIMD converges to the
+    largest wave the device sustains within the latency target instead
+    of letting a slow device turn a static batch size into unbounded
+    per-wave latency ("The Tail at Scale" engineering: degrade
+    throughput, not tail latency)."""
+
+    def __init__(self, wave_cap: int, slo_s: float, wave_min: int,
+                 increase: int, decrease: float):
+        self._cap = max(1, wave_cap)
+        self._slo = slo_s
+        self._min = max(1, min(wave_min, self._cap))
+        self._increase = max(1, increase)
+        self._decrease = decrease
+        self._wave = self._cap
+
+    def current(self) -> int:
+        return self._wave
+
+    def observe(self, wave_latency_s: float, queue_depth: int) -> None:
+        if wave_latency_s > self._slo:
+            self._wave = max(self._min, int(self._wave * self._decrease))
+        elif queue_depth > self._wave:
+            self._wave = min(self._cap, self._wave + self._increase)
+        else:
+            # no backlog pressure: creep back up slowly so a burst after a
+            # quiet period doesn't land on a wave still sized for the storm
+            self._wave = min(self._cap,
+                             self._wave + max(1, self._increase // 4))
+
+
+class _OverloadBreaker:
+    """Escape-storm circuit breaker: consecutive-failure open, probe-based
+    re-close.  Same shape as ops/failover._Breaker (duplicated because
+    ops.failover imports this module); now_fn is injectable so tests
+    drive the probe clock deterministically."""
+
+    def __init__(self, threshold: int, probe_interval: float,
+                 now_fn=time.monotonic):
+        self.threshold = max(1, threshold)
+        self.probe_interval = probe_interval
+        self._now = now_fn
+        self.consecutive = 0
+        self.opened_at: float | None = None
+
+    @property
+    def is_open(self) -> bool:
+        return self.opened_at is not None
+
+    def record_storm(self) -> bool:
+        """Returns True when this storm OPENS the breaker (edge).  A storm
+        while open re-arms the probe window."""
+        self.consecutive += 1
+        if self.opened_at is not None:
+            self.opened_at = self._now()
+            return False
+        if self.consecutive >= self.threshold:
+            self.opened_at = self._now()
+            return True
+        return False
+
+    def record_calm(self) -> bool:
+        """Returns True when a calm batch RE-CLOSES an open breaker."""
+        self.consecutive = 0
+        if self.opened_at is not None:
+            self.opened_at = None
+            return True
+        return False
+
+    def probe_due(self) -> bool:
+        return (self.opened_at is not None
+                and self._now() - self.opened_at >= self.probe_interval)
+
+
 class Profile:
     __slots__ = ("framework", "percentage_of_nodes_to_score", "batch_backend",
                  "batch_size")
@@ -269,6 +348,11 @@ class Scheduler:
         # per batch at the root span and inherited everywhere below
         self.tracer_provider: tracing.TracerProvider | None = None
         self._tracer: tracing.Tracer | None = None
+        # overload protection (config.py OverloadPolicy): None until
+        # configure_overload attaches a policy; every layer defaults off
+        self.overload_policy = None
+        self._wave_tuner: _WaveTuner | None = None
+        self._escape_breaker: _OverloadBreaker | None = None
         self._next_start_node_index = 0
         self._threads: list[threading.Thread] = []
         self._wire_event_handlers()
@@ -283,6 +367,32 @@ class Scheduler:
         self.tracer_provider = provider
         self._tracer = (provider.tracer("scheduler")
                         if provider is not None else None)
+
+    def configure_overload(self, policy) -> None:
+        """Attach a config.OverloadPolicy: bounded admission on the queue,
+        AIMD wave sizing, the escape-storm breaker and the stuck-wave
+        watchdog (each layer only active when its knob is non-zero).
+        Pass None to detach everything."""
+        self.overload_policy = policy
+        if policy is None or not policy.enabled:
+            self.queue.set_overload_policy(0)
+            self._wave_tuner = None
+            self._escape_breaker = None
+            return
+        self.queue.set_overload_policy(policy.queue_cap,
+                                       policy.shed_protect_priority,
+                                       policy.shed_protect_age)
+        batch_profile = next((p for p in self.profiles.values()
+                              if p.batch_backend is not None), None)
+        wave_cap = batch_profile.batch_size if batch_profile else 256
+        self._wave_tuner = (
+            _WaveTuner(wave_cap, policy.slo_p99_ms / 1e3, policy.wave_min,
+                       policy.wave_increase, policy.wave_decrease)
+            if policy.slo_p99_ms > 0 else None)
+        self._escape_breaker = (
+            _OverloadBreaker(policy.breaker_threshold,
+                             policy.breaker_probe_interval)
+            if policy.escape_rate_threshold > 0 else None)
 
     def expose_metrics(self) -> str:
         """Refresh pull-time gauges (pending_pods, cache_size) and return
@@ -308,6 +418,23 @@ class Scheduler:
             if breaker_fn is not None:
                 for rung, v in breaker_fn().items():
                     self.metrics.prom.tpu_seam_breaker.set(float(v), rung)
+        # overload-protection tallies: the queue accumulates sheds under
+        # its own lock; the informers count relists — both drained here
+        # (Counter is inc-only, the scheduler is the only writer)
+        for (reason, band), n in self.queue.drain_shed_total().items():
+            self.metrics.prom.queue_shed_total.inc(float(n), reason, band)
+        drain_relists = getattr(self.informer_factory,
+                                "drain_relist_total", None)
+        if drain_relists is not None:
+            for (resource, reason), n in drain_relists().items():
+                self.metrics.prom.informer_relist_total.inc(
+                    float(n), resource, reason)
+        if self._wave_tuner is not None:
+            self.metrics.prom.overload_wave_size.set(
+                float(self._wave_tuner.current()))
+        if self._escape_breaker is not None:
+            self.metrics.prom.overload_breaker_open.set(
+                1.0 if self._escape_breaker.is_open else 0.0)
         return self.metrics.expose()
 
     # -- event handlers (eventhandlers.go:249) ---------------------------
@@ -514,8 +641,13 @@ class Scheduler:
                 t = self.admission_interval
             else:
                 t = 0.0
+            # AIMD wave sizing (overload: sloP99Ms): the tuner shrinks the
+            # wave when the last waves blew the latency SLO and grows it
+            # back while under — static batch_size otherwise
+            wave = (self._wave_tuner.current() if self._wave_tuner is not None
+                    else batch_profile.batch_size)
             t_pop0 = time.monotonic()
-            batch = self.queue.pop_batch(batch_profile.batch_size, t)
+            batch = self.queue.pop_batch(wave, t)
             t_pop1 = time.monotonic()
             mine: list[QueuedPodInfo] = []
             perpod: list[QueuedPodInfo] = []
@@ -1163,6 +1295,7 @@ class Scheduler:
         # from cache NodeInfos under the cache lock — no Snapshot clone on
         # the batch path (the per-pod oracle keeps its immutable Snapshot)
         view = self.cache.flatten_view()
+        self.metrics.prom.tpu_batch_size.observe(float(len(live)))
         if stagelat.ENABLED:
             stagelat.record("queue_wait",
                             sum(start - q.timestamp for q in live) / len(live))
@@ -1197,14 +1330,18 @@ class Scheduler:
             stagelat.record("dispatch_host", time.monotonic() - start)
         return profile, live, resolve, cycle, start, root
 
-    def _drain_backend_telemetry(self, backend) -> None:
+    def _drain_backend_telemetry(self, backend) -> dict:
         """Apply the backend's per-batch escape/telemetry tallies as metric
         deltas.  Counter is inc-only, so the backend accumulates per-batch
         (plugin, reason) counts and the scheduler drains them here — the
-        only writer of scheduler_tpu_escape_total."""
+        only writer of scheduler_tpu_escape_total.  Returns the drained
+        escape tallies so the escape-storm breaker can label its deferral
+        metric with the dominant reason."""
+        escapes: dict = {}
         drain = getattr(backend, "drain_escape_reasons", None)
         if drain is not None:
-            for (plugin, reason), cnt in drain().items():
+            escapes = drain()
+            for (plugin, reason), cnt in escapes.items():
                 self.metrics.prom.tpu_escape_total.inc(
                     float(cnt), plugin, reason)
         drain_t = getattr(backend, "drain_batch_telemetry", None)
@@ -1220,6 +1357,58 @@ class Scheduler:
                     if dens is not None:
                         self.metrics.prom.tpu_mask_density.set(
                             float(dens), plugin)
+        return escapes
+
+    def _resolve_with_deadline(self, profile: Profile,
+                               live: list[QueuedPodInfo], resolve,
+                               start: float, deadline: float,
+                               span: tracing.Span | None):
+        """Stuck-wave watchdog (overload: waveDeadlineSeconds): resolve()
+        with a hard wall measured from DISPATCH.  A wave whose results
+        have not landed by the deadline is cancelled — the backend
+        abandons its in-flight bookkeeping (abandon_wave) and the pods
+        requeue through the BackendUnavailableError path, exactly as if
+        the seam had failed.  Returns the results, or None after a
+        cancel.
+
+        The overrunning resolve keeps running on an orphan daemon thread
+        (there is no portable way to interrupt a device pull); its late
+        mutations are harmless because abandon_wave dropped the pipeline
+        chain and forced a full state refresh for the next dispatch."""
+        remaining = deadline - (time.monotonic() - start)
+        if remaining > 0.0:
+            out: list = []
+            done = threading.Event()
+
+            def _run() -> None:
+                try:
+                    out.append(("ok", resolve()))
+                except BaseException as e:
+                    out.append(("err", e))
+                finally:
+                    done.set()
+
+            threading.Thread(target=_run, name="wave-resolve",
+                             daemon=True).start()
+            if done.wait(remaining) and out:
+                kind, val = out[0]
+                if kind == "ok":
+                    return val
+                raise val
+        logger.warning("wave of %d pods exceeded watchdog deadline (%.1fs); "
+                       "cancelling", len(live), deadline)
+        if span is not None:
+            span.add_event("watchdog_cancel", deadline_s=deadline,
+                           pods=len(live))
+        self.metrics.prom.overload_wave_cancel_total.inc(1.0, "deadline")
+        abandon = getattr(profile.batch_backend, "abandon_wave", None)
+        if abandon is not None:
+            abandon()
+        if span is not None:
+            span.end()
+        self._requeue_batch(live, BackendUnavailableError(
+            f"wave exceeded watchdog deadline ({deadline:.1f}s)"))
+        return None
 
     def _finish_batch(self, profile: Profile, live: list[QueuedPodInfo],
                       resolve, cycle: int, start: float,
@@ -1235,13 +1424,21 @@ class Scheduler:
         written back through one bulk store transaction instead of one
         guaranteed-update per pod."""
         fw = profile.framework
+        pol = self.overload_policy
+        deadline = pol.wave_deadline if pol is not None else 0.0
         t_enter = time.monotonic()
         try:
             # resolve() may retry/resync through the remote seam: the
             # current span makes those show up as events on this batch's
             # trace rather than orphans (ops/remote.py _seam_event)
             with tracing.use_span(span):
-                results = resolve()
+                if deadline > 0.0:
+                    results = self._resolve_with_deadline(
+                        profile, live, resolve, start, deadline, span)
+                    if results is None:
+                        return  # wave cancelled; pods already requeued
+                else:
+                    results = resolve()
         except BackendUnavailableError as e:
             if span is not None:
                 span.add_event("backend_unavailable", error=str(e))
@@ -1280,20 +1477,58 @@ class Scheduler:
         if stagelat.ENABLED:
             stagelat.record("pipeline_wait", t_enter - start)
             stagelat.record("resolve_block", resolve_block)
-        self._drain_backend_telemetry(profile.batch_backend)
+        escapes = self._drain_backend_telemetry(profile.batch_backend)
+        if self._wave_tuner is not None:
+            # wave latency = dispatch -> results in hand; queue depth tells
+            # the tuner whether growing the wave is worth anything
+            self._wave_tuner.observe(time.monotonic() - start,
+                                     self.queue.stats()["active"])
+        # escape-storm breaker (overload: escapeRateThreshold): decide where
+        # this batch's SKIPs go BEFORE the routing loop below.  Open +
+        # probe-not-due -> backoff tier (don't flood the per-pod oracle);
+        # any other state routes to the oracle as usual and the batch's
+        # storm/calm verdict drives open/re-close.
+        defer_escapes = False
+        br = self._escape_breaker
+        if (br is not None and pol is not None
+                and len(live) >= pol.escape_min_batch):
+            n_skip = sum(1 for node_name, s in results
+                         if node_name is None and s is not None
+                         and s.is_skip())
+            storm = n_skip / len(live) > pol.escape_rate_threshold
+            if br.is_open and not br.probe_due():
+                defer_escapes = True
+            elif storm:
+                # closed: may open at the consecutive threshold (only the
+                # OPENING batch defers).  Open probe: the probe failed —
+                # re-arm the window, but let this one batch's skips flow to
+                # the oracle so a persistent organic escape class still
+                # drains at probe pace instead of starving forever.
+                defer_escapes = br.record_storm()
+                if defer_escapes and span is not None:
+                    span.add_event("escape_storm_open", skips=n_skip)
+            else:
+                if br.record_calm() and span is not None:
+                    span.add_event("escape_storm_reclose")
         t_phase = time.monotonic()
         bulk: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         # phase 1: collect placements; failures/escapes handled per pod
         placed_q: list[QueuedPodInfo] = []
         placed_names: list[str] = []
         fit_failures: list[tuple[QueuedPodInfo, Status]] = []
+        storm_deferred: list[QueuedPodInfo] = []
         for qpi, (node_name, s) in zip(live, results):
             if node_name is None:
                 if s is not None and s.is_skip():
                     # constraint not tensor-encodable: per-pod oracle path,
                     # deferred until nothing is in flight (a pipelined next
-                    # batch may already be claiming capacity on device)
-                    self._deferred.append(qpi)
+                    # batch may already be claiming capacity on device) —
+                    # unless the escape-storm breaker is open, in which
+                    # case the escape class waits out a backoff instead
+                    if defer_escapes:
+                        storm_deferred.append(qpi)
+                    else:
+                        self._deferred.append(qpi)
                     continue
                 st = s or Status(UNSCHEDULABLE, "no feasible node (batch)")
                 if st.code == UNSCHEDULABLE and fw.post_filter:
@@ -1306,6 +1541,17 @@ class Scheduler:
                 continue
             placed_q.append(qpi)
             placed_names.append(node_name)
+        if storm_deferred:
+            # never scheduled against, so requeue_backoff applies: attempts
+            # (bumped at pop) buys each deferral a growing backoff
+            self.queue.requeue_backoff(storm_deferred)
+            reason = (max(escapes, key=escapes.get)[1] if escapes
+                      else "escape_storm")
+            self.metrics.prom.overload_deferred_total.inc(
+                float(len(storm_deferred)), reason)
+            if span is not None:
+                span.add_event("escape_storm_deferred",
+                               pods=len(storm_deferred), reason=reason)
         # 2-level shallow copies in ONE native pass (utils/fasthost): only
         # spec is replaced; nested values are never mutated in place on
         # this path (store reads hand out copies), so the deep copy the
@@ -1457,14 +1703,28 @@ class Scheduler:
         t_phase = time.monotonic()
         try:
             results = self.client.bind_many(bindings)
-        except Exception as e:  # pragma: no cover
-            logger.exception("bulk bind failed")
-            results = [(None, e)] * len(ready)
+        except Exception:
+            # whole-call failure (transport, mid-call store error): the old
+            # behavior blamed every pod with the same opaque error.  Retry
+            # each binding individually instead, so only genuinely failed
+            # pods take the Forget+requeue path and each failure event
+            # carries its own cause
+            logger.exception("bulk bind failed; classifying per binding")
+            results = self._classify_bindings(bindings)
         if stagelat.ENABLED:
             stagelat.record("bind_store_write", time.monotonic() - t_phase)
         bound: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         for (state, qpi, node_name, assumed), (obj, err) in zip(ready, results):
             if err is not None:
+                if isinstance(err, kv.NotFoundError):
+                    # pod deleted mid-wave: there is nothing to requeue or
+                    # status-patch — just release the assumed capacity
+                    fw.run_unreserve_plugins(state, qpi.pod_info, node_name)
+                    try:
+                        self.cache.forget_pod(assumed)
+                    except ValueError:  # pragma: no cover - already expired
+                        pass
+                    continue
                 self._bind_failure(fw, state, qpi, assumed, node_name,
                                    Status(ERROR, f"binding rejected: {err}"),
                                    cycle)
@@ -1505,3 +1765,23 @@ class Scheduler:
         if bind_sp is not None:
             bind_sp.set_attribute("bound", len(bound))
             bind_sp.end()
+
+    def _classify_bindings(self, bindings: list[tuple[str, str, str]]
+                           ) -> list[tuple[Obj | None, Exception | None]]:
+        """Per-binding fallback after a whole-call bind_many failure:
+        retry each binding on its own so the store classifies it —
+        NotFoundError (pod deleted mid-wave), ConflictError (already
+        bound, possibly by the half-applied bulk call), or the real
+        transport error.  Bind is idempotent per pod at the store level:
+        a binding the failed bulk call DID apply comes back as a
+        ConflictError naming the same node, which _handle_failure then
+        resolves by observing the bound pod."""
+        out: list[tuple[Obj | None, Exception | None]] = []
+        for ns, nm, node in bindings:
+            try:
+                obj = self.client.bind(
+                    {"metadata": {"namespace": ns, "name": nm}}, node)
+                out.append((obj, None))
+            except Exception as e:
+                out.append((None, e))
+        return out

@@ -1,0 +1,492 @@
+"""Overload-resilience suite for the batch pipeline (ISSUE 5 tentpole).
+
+Four closed-loop protections, each proven against a seeded, reproducible
+overload schedule (ops/faults.OverloadSchedule — same determinism rules
+as the transport-level FaultSchedule):
+
+  * bounded admission   — the active queue is capped; over the cap the
+                          LOWEST-priority, youngest pods shed into the
+                          backoff tier (never dropped); system/high
+                          priority and aged pods are shed-exempt.
+  * AIMD wave sizing    — _WaveTuner shrinks the dispatch wave
+                          multiplicatively on SLO breach, grows it
+                          additively while under.
+  * escape-storm breaker— a batch whose SKIP rate crosses the threshold
+                          trips _OverloadBreaker: the escape class waits
+                          out a backoff instead of flooding the per-pod
+                          oracle; a calm probe batch re-closes it.
+  * stuck-wave watchdog — a wave whose resolve outlives waveDeadline is
+                          cancelled and the pods re-enter backoff via the
+                          BackendUnavailableError requeue path.
+
+Plus the satellite seams: per-binding failure classification under a bulk
+bind error, and the overload: config stanza.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.ops.faults import (
+    ALL_ESCAPE, SLOW, ChaosBatchBackend, OverloadSchedule)
+from kubernetes_tpu.scheduler import Profile, Scheduler, new_default_framework
+from kubernetes_tpu.scheduler.config import (
+    ConfigError, OverloadPolicy, load_config)
+from kubernetes_tpu.scheduler.queue import (
+    SYSTEM_PRIORITY_BAND, SchedulingQueue)
+from kubernetes_tpu.scheduler.scheduler import (
+    BackendUnavailableError, BatchBackend, _OverloadBreaker, _WaveTuner)
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+pytestmark = pytest.mark.chaos
+
+
+def wait_for(pred, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def new_queue(cap=0, protect_prio=1000, protect_age=30.0,
+              initial=0.05, maximum=0.2):
+    return SchedulingQueue(pod_initial_backoff=initial,
+                           pod_max_backoff=maximum,
+                           queue_cap=cap,
+                           shed_protect_priority=protect_prio,
+                           shed_protect_age=protect_age)
+
+
+def prio_pod(name, priority):
+    return make_pod(name).priority(priority).req(cpu="100m").build()
+
+
+# -- tentpole (1): bounded priority-aware admission ----------------------
+
+
+class TestBoundedAdmission:
+    def test_flood_capped_and_shed_to_backoff(self):
+        """20 pods into a cap-8 queue: active holds exactly the cap, the
+        12 shed pods land in BACKOFF (not dropped), and the shed tally
+        carries the reason + priority band."""
+        q = new_queue(cap=8)
+        for i in range(20):
+            q.add(make_pod(f"p{i}").build())  # default priority 0
+        st = q.stats()
+        assert st["active"] == 8
+        assert st["backoff"] == 12
+        assert q.drain_shed_total() == {("admission", "best_effort"): 12}
+        assert q.drain_shed_total() == {}  # drain is destructive
+
+    def test_lowest_priority_shed_first(self):
+        """Whichever arrival order, the pods that survive at the cap are
+        the higher-priority ones."""
+        for first, second in [(500, 10), (10, 500)]:
+            q = new_queue(cap=4)
+            for i in range(4):
+                q.add(prio_pod(f"a{i}", first))
+            for i in range(4):
+                q.add(prio_pod(f"b{i}", second))
+            survivors = q.pop_batch(8, timeout=0.1)
+            assert len(survivors) == 4
+            assert all(s.pod_info.priority == 500 for s in survivors), \
+                f"arrival order {first},{second}"
+
+    def test_system_and_high_priority_never_shed(self):
+        """Shed-exempt pods may take active past the cap — bounded
+        admission must NEVER cost a system or high-priority pod."""
+        q = new_queue(cap=2, protect_prio=1000)
+        for i in range(3):
+            q.add(prio_pod(f"be{i}", 0))
+        for i in range(3):
+            q.add(prio_pod(f"sys{i}", SYSTEM_PRIORITY_BAND + i))
+        for i in range(3):
+            q.add(prio_pod(f"hi{i}", 1500))
+        sheds = q.drain_shed_total()
+        assert all(band == "best_effort" for _, band in sheds)
+        popped = q.pop_batch(16, timeout=0.1)
+        names = {p.pod_info.pod["metadata"]["name"] for p in popped}
+        assert {f"sys{i}" for i in range(3)} <= names
+        assert {f"hi{i}" for i in range(3)} <= names
+
+    def test_aged_pod_is_shed_exempt(self):
+        """A pod past shedProtectAgeSeconds is exempt even when it is the
+        lowest-priority victim candidate — starvation protection: a pod
+        cannot be shed over and over forever."""
+        q = new_queue(cap=1, protect_age=0.05)
+        q.add(prio_pod("old", -1))  # lowest priority: first victim pick
+        time.sleep(0.1)            # ...but now aged past the threshold
+        q.add(prio_pod("fresh", 0))
+        st = q.stats()
+        assert st["active"] == 2   # both kept: the only victim was exempt
+        assert q.drain_shed_total() == {}
+
+    def test_shed_keeps_initial_attempt_timestamp_and_reenters(self):
+        """Shed = move to backoff with attempts+1: the original
+        initial_attempt_timestamp survives (so age-based protections keep
+        working) and the pod re-enters active after its backoff."""
+        q = new_queue(cap=1, initial=0.05, maximum=0.2)
+        q.run()
+        try:
+            q.add(make_pod("p0").build())
+            q.add(make_pod("p1").build())  # over cap: p1 (youngest) shed
+            [first] = q.pop_batch(2, timeout=0.5)
+            assert first.key == "default/p0"
+            again = []
+            deadline = time.time() + 5.0
+            while time.time() < deadline and not again:
+                again.extend(q.pop_batch(2, timeout=0.1))
+            [p1] = again
+            assert p1.key == "default/p1"
+            assert p1.attempts == 2  # 1 shed + 1 pop
+            # backoff clock restarted at shed; admission clock did not
+            assert p1.initial_attempt_timestamp < p1.timestamp
+        finally:
+            q.close()
+
+    def test_no_infinite_shed_loop(self):
+        """shed -> backoff -> promote -> shed must converge: with age
+        protection every pod is eventually admitted and popped."""
+        q = new_queue(cap=2, protect_age=0.1, initial=0.02, maximum=0.05)
+        q.run()
+        try:
+            for i in range(6):
+                q.add(make_pod(f"p{i}").build())
+            seen = set()
+            deadline = time.time() + 5.0
+            while time.time() < deadline and len(seen) < 6:
+                for p in q.pop_batch(2, timeout=0.1):
+                    seen.add(p.key)
+            assert len(seen) == 6
+        finally:
+            q.close()
+
+
+# -- tentpole (2): AIMD wave sizing --------------------------------------
+
+
+class TestWaveTuner:
+    def test_breach_shrinks_multiplicatively_to_floor(self):
+        t = _WaveTuner(256, 0.2, 16, 32, 0.5)
+        assert t.current() == 256
+        t.observe(0.5, 1000)
+        assert t.current() == 128
+        for _ in range(10):
+            t.observe(0.9, 1000)
+        assert t.current() == 16  # never below wave_min
+
+    def test_under_slo_grows_additively(self):
+        t = _WaveTuner(256, 0.2, 16, 32, 0.5)
+        for _ in range(10):
+            t.observe(0.9, 0)
+        assert t.current() == 16
+        t.observe(0.05, 1000)       # under SLO with a backlog
+        assert t.current() == 48    # +increase
+        t.observe(0.05, 0)          # under SLO, queue idle
+        assert t.current() == 56    # +increase//4 (cautious growth)
+
+    def test_never_exceeds_cap(self):
+        t = _WaveTuner(64, 0.2, 16, 32, 0.5)
+        for _ in range(50):
+            t.observe(0.01, 10_000)
+        assert t.current() == 64
+
+
+# -- tentpole (3): escape-storm breaker ----------------------------------
+
+
+class TestOverloadBreaker:
+    def test_opens_on_threshold_probes_and_recloses(self):
+        clock = [0.0]
+        br = _OverloadBreaker(threshold=2, probe_interval=5.0,
+                              now_fn=lambda: clock[0])
+        assert br.record_storm() is False  # 1 of 2
+        assert br.record_storm() is True   # opens (edge)
+        assert br.is_open and not br.probe_due()
+        clock[0] = 5.0
+        assert br.probe_due()
+        assert br.record_storm() is False  # failed probe: re-arm
+        assert not br.probe_due()          # window restarted
+        clock[0] = 10.0
+        assert br.probe_due()
+        assert br.record_calm() is True    # calm probe re-closes (edge)
+        assert not br.is_open
+        assert br.record_calm() is False   # already closed: no edge
+
+    def test_calm_resets_consecutive_count(self):
+        br = _OverloadBreaker(threshold=2, probe_interval=5.0,
+                              now_fn=lambda: 0.0)
+        assert br.record_storm() is False
+        br.record_calm()
+        assert br.record_storm() is False  # count restarted, not 2 of 2
+        assert not br.is_open
+
+
+# -- e2e harness ---------------------------------------------------------
+
+
+class _StubRung(BatchBackend):
+    """Assigns every pod to a fixed node (test_chaos_seam idiom)."""
+
+    def __init__(self, node="ov-0"):
+        self.node = node
+        self.stats = {"batches": 0}
+
+    def dispatch(self, pod_infos, snapshot):
+        results = [(self.node, None) for _ in pod_infos]
+        self.stats["batches"] += 1
+        return lambda: results
+
+
+def build_harness(backend, policy=None, batch_size=8):
+    store = kv.MemoryStore()
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    fw = new_default_framework(client, factory)
+    sched = Scheduler(client, factory, {"default-scheduler": Profile(
+        fw, batch_backend=backend, batch_size=batch_size)})
+    sched.queue._initial_backoff = 0.05
+    sched.queue._max_backoff = 0.2
+    if policy is not None:
+        sched.configure_overload(policy)
+    factory.start()
+    factory.wait_for_cache_sync()
+    return client, factory, sched
+
+
+def all_bound(client):
+    pods, _ = client.list(PODS, "default")
+    return pods and all(meta.pod_node_name(p) for p in pods)
+
+
+class TestEscapeStormBreakerE2E:
+    def test_storm_defers_to_backoff_then_recloses_and_binds(self):
+        """Wave 0 is an injected all-escape storm: the breaker opens and
+        the whole wave waits out a backoff instead of hitting the per-pod
+        oracle.  The chaos schedule then goes calm, so the probe re-closes
+        the breaker and every pod binds."""
+        chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
+            script={0: ALL_ESCAPE}))
+        policy = OverloadPolicy(escape_rate_threshold=0.5,
+                                escape_min_batch=1,
+                                breaker_threshold=1,
+                                breaker_probe_interval=0.05)
+        client, factory, sched = build_harness(chaos, policy)
+        try:
+            client.create(NODES, make_node("ov-0")
+                          .capacity(cpu="8", mem="32Gi").build())
+            for i in range(6):
+                client.create(PODS, make_pod(f"esc{i}")
+                              .req(cpu="100m").build())
+            # pods reach the queue via the (already wired) informer before
+            # the run loop starts: wave 0 carries all six
+            assert wait_for(lambda: sched.queue.stats()["active"] == 6,
+                            timeout=10)
+            sched.run()
+            assert wait_for(lambda: all_bound(client), timeout=30)
+            sched.expose_metrics()
+            prom = sched.metrics.prom
+            assert prom.overload_deferred_total.value(
+                "injected_all_escape") == 6.0
+            assert chaos.injected[ALL_ESCAPE] == 1
+            assert prom.overload_breaker_open.value() == 0.0  # re-closed
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+class TestStuckWaveWatchdogE2E:
+    def test_slow_wave_cancelled_and_pods_rebound(self):
+        """Wave 0 resolves 1.0s late against a 0.15s deadline: the
+        watchdog cancels it, the pods re-enter backoff via the
+        BackendUnavailableError path, and the (calm) next wave binds
+        them — well before the slow resolve would have returned."""
+        chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
+            script={0: SLOW}, slow_s=1.0))
+        policy = OverloadPolicy(wave_deadline=0.15)
+        client, factory, sched = build_harness(chaos, policy)
+        try:
+            client.create(NODES, make_node("ov-0")
+                          .capacity(cpu="8", mem="32Gi").build())
+            for i in range(4):
+                client.create(PODS, make_pod(f"slow{i}")
+                              .req(cpu="100m").build())
+            assert wait_for(lambda: sched.queue.stats()["active"] == 4,
+                            timeout=10)
+            t0 = time.time()
+            sched.run()
+            assert wait_for(lambda: all_bound(client), timeout=30)
+            prom = sched.metrics.prom
+            assert prom.overload_wave_cancel_total.value("deadline") == 1.0
+            assert prom.tpu_seam_events.value("requeued_pods") >= 4
+            # rebound happened on the cancel path, not by waiting out the
+            # 1.0s slow resolve plus a backoff
+            assert time.time() - t0 < 1.0
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+class TestSeededOverloadChaos:
+    def test_flooded_pipeline_stays_live_and_protects_priority(self):
+        """The acceptance scenario: a pod flood against a cap-32 queue
+        with seeded slow-wave and escape-storm injection.  The pipeline
+        must keep scheduling (every pod binds), keep the active queue
+        bounded, and never shed a system/high-priority pod."""
+        chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
+            seed=7, slow_rate=0.1, slow_s=0.03, all_escape_rate=0.2))
+        policy = OverloadPolicy(queue_cap=32,
+                                shed_protect_priority=1000,
+                                shed_protect_age=30.0,
+                                slo_p99_ms=200.0,
+                                escape_rate_threshold=0.5,
+                                escape_min_batch=4,
+                                breaker_threshold=1,
+                                breaker_probe_interval=0.05,
+                                wave_deadline=5.0)
+        client, factory, sched = build_harness(chaos, policy,
+                                               batch_size=16)
+        try:
+            for i in range(2):
+                client.create(NODES, make_node(f"ov-{i}")
+                              .capacity(cpu="8", mem="32Gi").build())
+            for i in range(120):
+                client.create(PODS, prio_pod(f"be{i}", 0))
+            for i in range(5):
+                client.create(PODS, prio_pod(f"hi{i}", 1500))
+            for i in range(5):
+                client.create(PODS,
+                              prio_pod(f"sys{i}", SYSTEM_PRIORITY_BAND))
+            sched.run()
+            max_active = 0
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                max_active = max(max_active,
+                                 sched.queue.stats()["active"])
+                if all_bound(client):
+                    break
+                time.sleep(0.02)
+            assert all_bound(client), "pipeline lost liveness under flood"
+            # bounded memory: active never exceeds cap + the shed-exempt
+            # pods (10 protected-priority pods in the flood)
+            assert max_active <= 32 + 10
+            sched.expose_metrics()
+            sheds = sched.metrics.prom.queue_shed_total.values()
+            assert sum(sheds.values()) > 0  # the flood did overflow
+            for (reason, band), n in sheds.items():
+                assert band not in ("system", "high"), \
+                    f"shed {n} {band} pods (reason={reason})"
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+# -- satellite: per-binding failure classification -----------------------
+
+
+class TestBulkBindClassification:
+    def test_bulk_failure_classified_per_binding(self):
+        """A whole-call bulk bind failure where ONE pod was deleted
+        mid-flight: the classification pass re-drives each binding, the
+        deleted pod is dropped quietly (NotFound), and every other pod in
+        the batch still binds — no all-or-nothing requeue."""
+        client, factory, sched = build_harness(_StubRung())
+        real_bind_many = client.bind_many
+        fired = []
+
+        def sabotaged_bind_many(bindings):
+            if not fired:
+                fired.append(True)
+                client.delete(PODS, "default", "bind1")
+                raise RuntimeError("injected bulk transport failure")
+            return real_bind_many(bindings)
+
+        client.bind_many = sabotaged_bind_many
+        try:
+            client.create(NODES, make_node("ov-0")
+                          .capacity(cpu="8", mem="32Gi").build())
+            for i in range(4):
+                client.create(PODS, make_pod(f"bind{i}")
+                              .req(cpu="100m").build())
+            assert wait_for(lambda: sched.queue.stats()["active"] == 4,
+                            timeout=10)
+            sched.run()
+            assert wait_for(lambda: all_bound(client), timeout=30)
+            pods, _ = client.list(PODS, "default")
+            names = {p["metadata"]["name"] for p in pods}
+            assert names == {"bind0", "bind2", "bind3"}
+            assert fired  # the sabotage actually ran
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+# -- satellite: overload config stanza -----------------------------------
+
+
+class TestOverloadConfig:
+    def test_stanza_parses(self):
+        cfg = load_config({
+            "apiVersion": "kubescheduler.config.k8s.io/v1",
+            "kind": "KubeSchedulerConfiguration",
+            "overload": {
+                "queueCap": 16384,
+                "shedProtectPriority": 2000,
+                "shedProtectAgeSeconds": 60,
+                "sloP99Ms": 250,
+                "waveMin": 8,
+                "waveIncrease": 16,
+                "waveDecrease": 0.25,
+                "escapeRateThreshold": 0.5,
+                "escapeMinBatch": 4,
+                "breakerThreshold": 2,
+                "breakerProbeIntervalSeconds": 1.5,
+                "waveDeadlineSeconds": 30,
+            },
+        })
+        ov = cfg.overload
+        assert ov.enabled
+        assert ov.queue_cap == 16384
+        assert ov.shed_protect_priority == 2000
+        assert ov.shed_protect_age == 60.0
+        assert ov.slo_p99_ms == 250.0
+        assert ov.wave_min == 8
+        assert ov.wave_increase == 16
+        assert ov.wave_decrease == 0.25
+        assert ov.escape_rate_threshold == 0.5
+        assert ov.escape_min_batch == 4
+        assert ov.breaker_threshold == 2
+        assert ov.breaker_probe_interval == 1.5
+        assert ov.wave_deadline == 30.0
+
+    def test_absent_stanza_disables_everything(self):
+        cfg = load_config({
+            "apiVersion": "kubescheduler.config.k8s.io/v1",
+            "kind": "KubeSchedulerConfiguration",
+        })
+        assert not cfg.overload.enabled
+
+    @pytest.mark.parametrize("stanza", [
+        {"queueCap": -1},
+        {"sloP99Ms": -5},
+        {"waveDecrease": 1.5},
+        {"waveDecrease": 0},
+        {"escapeRateThreshold": 2},
+        {"waveMin": 0},
+        {"breakerThreshold": 0},
+        {"shedProtectAgeSeconds": 0},
+        {"nope": 1},
+    ])
+    def test_bad_stanza_rejected(self, stanza):
+        with pytest.raises(ConfigError):
+            load_config({
+                "apiVersion": "kubescheduler.config.k8s.io/v1",
+                "kind": "KubeSchedulerConfiguration",
+                "overload": stanza,
+            })

@@ -137,6 +137,32 @@ def test_ns_selector_workloads_run_device_path(name):
     assert stats.get("escape_rate", 1.0) == 0.0, stats
 
 
+def test_overload_flood_runs_with_policy_and_chaos():
+    """SchedulingOverloadFlood shrunk through the bench --overload
+    plumbing: seeded escape-storm chaos + the full overload policy.
+    Liveness (barrier_ok) must hold and the protected high-priority
+    class (the workload's hipri- pods) must never be shed."""
+    from kubernetes_tpu.ops.faults import OverloadSchedule
+    from kubernetes_tpu.perf import caps_for_nodes
+    from kubernetes_tpu.perf.scheduler_perf import run_named_workload
+    from kubernetes_tpu.scheduler.config import OverloadPolicy
+    cfg = shrink(load_workloads()["SchedulingOverloadFlood"], 100, 100)
+    policy = OverloadPolicy(queue_cap=64, shed_protect_priority=1000,
+                            slo_p99_ms=250.0, escape_rate_threshold=0.5,
+                            escape_min_batch=8, breaker_threshold=1,
+                            breaker_probe_interval=0.05,
+                            wave_deadline=60.0)
+    chaos = OverloadSchedule(seed=3, all_escape_rate=0.2)
+    summary, stats = run_named_workload(
+        cfg, tpu=True, caps=caps_for_nodes(20), batch_size=64,
+        null_device=True, overload=policy, chaos_schedule=chaos)
+    assert stats.get("barrier_ok"), stats
+    ov = stats.get("overload")
+    assert ov is not None, stats
+    assert not any(k.endswith(("/system", "/high")) for k in ov["shed"]), ov
+    assert stats.get("chaos_injected", {}).get("all_escape", 0) >= 0
+
+
 def test_mixed_escapes_reports_nonzero_escape_rate():
     """SchedulingMixedEscapes: the Gt node-affinity pods must escape to
     the per-pod oracle (non-zero escape_rate) AND still schedule onto

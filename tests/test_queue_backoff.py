@@ -102,3 +102,56 @@ class TestRequeueBackoff:
         time.sleep(0.05)
         q.requeue_backoff([qpi])
         assert qpi.timestamp > before
+
+
+class TestShedBackoffInteraction:
+    """Bounded admission (overload: queueCap) reuses the backoff tier as
+    its shed destination, so the two paths must compose: sheds triggered
+    by backoff promotion carry their own reason label, and a shed pod is
+    indistinguishable from a requeued one once it re-enters active."""
+
+    def test_backoff_promotion_over_cap_sheds_with_own_reason(self):
+        q = SchedulingQueue(pod_initial_backoff=0.05,
+                            pod_max_backoff=0.2, queue_cap=2)
+        q.run()
+        try:
+            add_pods(q, 2)
+            batch = q.pop_batch(2, timeout=1.0)
+            q.requeue_backoff(batch)      # 2 pods parked in backoff
+            add_pods(q, 2, prefix="new")  # active back AT the cap
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                sheds = q.drain_shed_total()
+                if sheds:
+                    assert set(sheds) == {
+                        ("backoff_promotion", "best_effort")}
+                    assert sheds[("backoff_promotion", "best_effort")] == 2
+                    break
+                time.sleep(0.02)
+            else:
+                pytest.fail("promotion over the cap never shed")
+        finally:
+            q.close()
+
+    def test_shed_then_requeue_never_duplicates(self):
+        """shed -> pop -> backend-failure requeue -> promote: one copy of
+        the pod flows through, whatever mix of paths it took."""
+        q = SchedulingQueue(pod_initial_backoff=0.02,
+                            pod_max_backoff=0.05, queue_cap=1)
+        q.run()
+        try:
+            add_pods(q, 2)  # p1 shed at admission
+            seen = []
+            failed_once = False
+            deadline = time.time() + 5.0
+            while time.time() < deadline and len(seen) < 2:
+                batch = q.pop_batch(2, timeout=0.1)
+                if batch and not failed_once:
+                    failed_once = True
+                    q.requeue_backoff(batch)  # first pop: backend "fails"
+                    continue
+                seen.extend(batch)
+            assert sorted(p.key for p in seen) == [
+                "default/p0", "default/p1"]
+        finally:
+            q.close()

@@ -236,6 +236,91 @@ def test_evictions_confined_to_bulk_commit_path():
         f"{offenders}")
 
 
+def test_overload_actions_record_labelled_metrics():
+    """Overload invariant (ISSUE: overload-resilient pipeline): every
+    degraded-mode action must be observable with a REASON — an operator
+    staring at a pod that won't schedule needs the metrics to say which
+    protection fired and why.  Statically: (a) every shed trigger in
+    queue.py passes a string-literal reason into _shed_over_cap_locked;
+    (b) every overload_deferred_total / overload_wave_cancel_total
+    increment in scheduler.py carries a reason label argument."""
+    import ast
+
+    offenders = []
+    qtree = ast.parse((ROOT / "scheduler" / "queue.py").read_text())
+    for n in ast.walk(qtree):
+        if (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "_shed_over_cap_locked"):
+            if not (n.args and isinstance(n.args[0], ast.Constant)
+                    and isinstance(n.args[0].value, str)):
+                offenders.append(
+                    f"scheduler/queue.py:{n.lineno} shed without a "
+                    "string-literal reason")
+    stree = ast.parse((ROOT / "scheduler" / "scheduler.py").read_text())
+    for n in ast.walk(stree):
+        if (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "inc"
+                and isinstance(n.func.value, ast.Attribute)
+                and n.func.value.attr in ("overload_deferred_total",
+                                          "overload_wave_cancel_total")):
+            if len(n.args) < 2:  # (amount, reason)
+                offenders.append(
+                    f"scheduler/scheduler.py:{n.lineno} "
+                    f"{n.func.value.attr}.inc without a reason label")
+    assert not offenders, (
+        f"overload actions without a reason-labelled metric: {offenders}")
+
+
+def test_retry_loops_back_off():
+    """Liveness invariant (ISSUE satellite: informer relist backoff): a
+    retry loop that catches ANY exception and goes around again must
+    back off inside the handler — a tight except-Exception-continue loop
+    turns one persistent failure into a busy-spin (and, fleet-wide, into
+    a synchronized retry storm).  Audits the long-running loop modules;
+    handlers that re-raise, break, or return are exempt (not retries)."""
+    import ast
+
+    def is_generic(handler):
+        if handler.type is None:
+            return True
+        t = handler.type
+        return (isinstance(t, ast.Name) and t.id == "Exception") or (
+            isinstance(t, ast.Attribute) and t.attr == "Exception")
+
+    def escapes(handler):
+        return any(isinstance(n, (ast.Raise, ast.Return, ast.Break))
+                   for n in ast.walk(handler))
+
+    def backs_off(handler):
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Call):
+                name = (n.func.attr if isinstance(n.func, ast.Attribute)
+                        else getattr(n.func, "id", ""))
+                if name in ("wait", "sleep") or "backoff" in name:
+                    return True
+        return False
+
+    offenders = []
+    for rel in ("client/informer.py", "client/http_client.py",
+                "scheduler/queue.py", "scheduler/scheduler.py",
+                "ops/remote.py", "ops/failover.py"):
+        path = ROOT / rel
+        tree = ast.parse(path.read_text())
+        for loop in ast.walk(tree):
+            if not isinstance(loop, ast.While):
+                continue
+            for n in ast.walk(loop):
+                if not isinstance(n, ast.ExceptHandler):
+                    continue
+                if is_generic(n) and not escapes(n) and not backs_off(n):
+                    offenders.append(f"{rel}:{n.lineno}")
+    assert not offenders, (
+        "generic-except retry loops without a backoff/sleep in the "
+        f"handler: {offenders}")
+
+
 def test_controller_registry_complete():
     """Every controller module's Controller subclass is constructible from
     the manager's registry (a new controller that isn't wired in is dead
