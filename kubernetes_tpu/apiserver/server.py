@@ -2019,6 +2019,14 @@ class APIServer:
                     # GC won would otherwise loop on 403 forever).
                     # Namespaces stay admitted even when implicit: the
                     # immortal-namespace guard is name-based.
+                    #
+                    # INVARIANT (delete admission): attrs.obj is None on
+                    # DELETE — only name/namespace/old_obj carry state.
+                    # A plugin that denies deletes MUST therefore key on
+                    # the NAME (like NamespaceLifecycle's immortal set)
+                    # or on old_obj, never on attrs.obj: a deny derived
+                    # from attrs.obj can't fire here, silently admitting
+                    # exactly the deletes it was written to block.
                     ident = self._identity() or ("", ())
                     attrs = adm.Attributes(adm.DELETE, r.resource, None,
                                            cur_obj,

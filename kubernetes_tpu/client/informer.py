@@ -47,16 +47,16 @@ class Informer:
         # _dispatch_lock -> _lock, never the reverse.
         self._lock = threading.RLock()
         self._dispatch_lock = threading.RLock()
-        self._indexer: dict[str, Obj] = {}
-        self._handlers: list[EventHandler] = []
-        self._bulk_handlers: list[Callable[[list], None]] = []
+        self._indexer: dict[str, Obj] = {}  # guarded-by: _lock
+        self._handlers: list[EventHandler] = []  # guarded-by: _dispatch_lock
+        self._bulk_handlers: list[Callable[[list], None]] = []  # guarded-by: _dispatch_lock
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # relist accounting ({reason: count}, drained into the
         # informer_relist_total counter) + seeded per-informer jitter so
         # every informer's retry clock is decorrelated deterministically
-        self._relist_pending: dict[str, int] = {}
+        self._relist_pending: dict[str, int] = {}  # guarded-by: _lock
         self._retry_rng = random.Random(
             hash(resource) & 0xFFFFFFFF)
 

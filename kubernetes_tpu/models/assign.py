@@ -601,13 +601,11 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
 
                 if os.environ.get("KTPU_WAVE_DEBUG") and not isinstance(
                         claims, jax.core.Tracer):  # pragma: no cover - debug
-                    _WAVE_DEBUG.append({
-                        "claims": np.asarray(claims), "has": np.asarray(has),
-                        "res_ok": np.asarray(res_ok),
-                        "conf": np.asarray(conf),
-                        "over": np.asarray(spread_over_any),
-                        "accept": np.asarray(accept),
-                        "active": np.asarray(active)})
+                    # sync-point: env-gated debug dump (off in production)
+                    _WAVE_DEBUG.append(jax.device_get({
+                        "claims": claims, "has": has, "res_ok": res_ok,
+                        "conf": conf, "over": spread_over_any,
+                        "accept": accept, "active": active}))
                 assigned = jnp.where(accept, claims, assigned)
                 progress = jnp.any(accept)
                 active = active & ~accept & progress  # no progress -> give up
@@ -805,6 +803,8 @@ def _make_scan_core(caps: Caps, w: dict, comm: _Comm):
 def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None,
                     mode: str = "wave"):
     """Single-device jitted assignment: fn(node, pod) -> dict."""
+    # compile-cached: built once per Caps at backend setup; the returned
+    # callable (and its jit cache) is held by the caller for all waves
     return jax.jit(make_assign_core(caps, weights, axis_name=None, mode=mode))
 
 
@@ -1068,6 +1068,8 @@ def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
                                   **(weights or {})}, _Comm(None), max_waves,
                            features)
 
+    # compile-cached: built once per Caps at backend setup; one resident
+    # jit cache serves every wave against the packed transport
     @functools.partial(jax.jit, donate_argnums=0)
     def fn(state, static_node, buf):
         pod, prow, pval = _unpack(buf, spec, features)

@@ -64,7 +64,13 @@ def _preempt_candidates(alloc, used, npods, maxpods, valid,
     # decorrelates the same way with a RANDOM candidate-sampling offset
     # (GetOffsetAndNumCandidates).  The 1e-9*headroom term is a
     # deterministic last-resort tiebreak under identical noise only.
-    headroom = jnp.sum(jnp.maximum(free, 0.0), axis=-1)
+    # Headroom is per-resource NORMALIZED (free fraction of allocatable,
+    # summed): raw unit sums let the largest-magnitude resource dominate
+    # — a node with 256Gi of free memory outranks one with 64 free CPUs
+    # on absolute numbers alone, so heterogeneous-memory fleets ranked
+    # on memory bytes, not balance.
+    headroom = jnp.sum(jnp.maximum(free, 0.0)
+                       / jnp.maximum(alloc, 1e-9)[None, :, :], axis=-1)
     P, N = fits.shape
     tie = (((jnp.arange(P, dtype=jnp.uint32)[:, None]
              * jnp.uint32(2654435761))
@@ -86,8 +92,8 @@ def preempt_candidates(alloc, used, npods, maxpods, valid, reclaim,
         jnp.asarray(maxpods), jnp.asarray(valid), jnp.asarray(reclaim),
         jnp.asarray(reclaim_np), jnp.asarray(group_idx), jnp.asarray(req),
         jnp.asarray(active), k)
-    import numpy as np
-    return np.asarray(rows), np.asarray(count)
+    # sync-point: preemption host entry — the one explicit blocking pull
+    return jax.device_get((rows, count))
 
 
 # -- full DryRunPreemption (victim tensors) -------------------------------
@@ -216,5 +222,5 @@ def preempt_dry_run(alloc, used, npods, maxpods, valid, taint_mask,
         jnp.asarray(nom_used), jnp.asarray(nom_np),
         jnp.asarray(group_idx), jnp.asarray(req), jnp.asarray(prio),
         jnp.asarray(untol_hard), jnp.asarray(active))
-    import numpy as np
-    return tuple(np.asarray(a) for a in out)
+    # sync-point: dry-run host entry — the one explicit blocking pull
+    return jax.device_get(out)

@@ -92,6 +92,8 @@ def _apply_static_patch(static, rows, alloc_v, maxpods_v, valid_v, taint_v):
         import jax
         import jax.numpy as jnp
 
+        # compile-cached: lazy module-level singleton (the `global`
+        # guard above); one cache serves every patch upload
         @jax.jit
         def go(static, rows, alloc_v, maxpods_v, valid_v, taint_v):
             n = static["alloc"].shape[0]
@@ -124,6 +126,8 @@ def _apply_sel_patch(sel, rows, label_v, key_v, dom_sg_v, dom_asg_v):
         import jax
         import jax.numpy as jnp
 
+        # compile-cached: lazy module-level singleton (the `global`
+        # guard above); one cache serves every patch upload
         @jax.jit
         def go(sel, rows, label_v, key_v, dom_sg_v, dom_asg_v):
             n = sel["label_mask"].shape[0]
@@ -149,6 +153,8 @@ def _apply_vict_patch(vict, rows, prio_v, req_v, pdb_v, over_v):
         import jax
         import jax.numpy as jnp
 
+        # compile-cached: lazy module-level singleton (the `global`
+        # guard above); one cache serves every patch upload
         @jax.jit
         def go(vict, rows, prio_v, req_v, pdb_v, over_v):
             n = vict["vict_prio"].shape[0]
@@ -199,8 +205,8 @@ def decode_results(assignments, n: int, batch_size: int, escapes: set,
     artifact, so they go to the per-pod oracle instead of
     UNSCHEDULABLE.  A placement is always sound; only no-fit needs the
     re-proof (flatten.GroupBucket)."""
-    rows = np.asarray(assignments).tolist()  # ONE bulk convert, not
-    # int(arr[i]) per pod (np scalar indexing costs ~0.5µs each)
+    rows = np.asarray(assignments, np.int64).tolist()  # ONE bulk convert,
+    # not int(arr[i]) per pod (np scalar indexing costs ~0.5µs each)
     results: list[tuple[str | None, Status | None]] = []
     for i in range(n):
         if i >= batch_size or (escapes and i in escapes):
@@ -467,6 +473,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         tunneled chip) and jit compile otherwise land inside the first
         scheduling cycle, which both hurts first-pod latency and pollutes
         throughput measurement windows."""
+        import jax
         import jax.numpy as jnp
         with self._lock:
             if self._static_node is None:
@@ -491,7 +498,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             self._ensure_plain()
             a = self._device_step("plain", pack_pod_batch(
                 batch, self._spec_plain, *empty))
-            np.asarray(a)  # block until the device round trip completes
+            # sync-point: warmup barrier — block until the round trip lands
+            jax.device_get(a)
             self._warm_preempt()
 
     def _warm_preempt(self) -> None:
@@ -1044,6 +1052,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         was_full = self._needs_full(batch)
 
         def resolve() -> list[tuple[str | None, Status | None]]:
+            import jax
             batch_waves = 0
             with self._lock:
                 assignments = np.full(self.batch_size, -1, np.int64)
@@ -1051,7 +1060,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                                                      parent=solve_sp)
                           if solve_sp is not None else None)
                 for rd, lo, hi in chunks:
-                    result = np.asarray(rd)  # blocking device pull
+                    # sync-point: wave resolve — THE pipeline's d2h pull
+                    result = jax.device_get(rd)
                     assignments[lo:hi] = result[:-1][:hi - lo]
                     batch_waves += int(result[-1])
                 if d2h_sp is not None:
@@ -1099,6 +1109,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         chain the same resident device state as ordinary batches, and
         the mirror replay is purely additive, so commit order between an
         already-inflight next batch and these retries cannot diverge."""
+        import jax
+
         from ..ops.flatten import gather_pod_batch
         self._ensure_full_small()  # spec needed below before the step
         skip = set(batch.escape)
@@ -1116,7 +1128,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 idx = left[lo:lo + cap]
                 rb = gather_pod_batch(batch, idx, cap)
                 buf = pack_pod_batch(rb, self._spec_full_small, *empty)
-                res = np.asarray(self._device_step("full_small", buf))
+                # sync-point: straggler retry resolves synchronously
+                res = jax.device_get(self._device_step("full_small", buf))
                 self.stats["waves"] += int(res[-1])
                 sub = res[:-1]
                 self._replay(rb, sub)
@@ -1349,14 +1362,16 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 # LOWER bound on device `used` — the bound then only
                 # over-defers (extra round), never wrongly excludes
                 st = self._state
-                alloc_h = np.asarray(t.alloc)
-                used_h = np.asarray(st["used"] if isinstance(st, dict)
-                                    else t.used)
-                npods_h = np.asarray(st["npods"] if isinstance(st, dict)
-                                     else t.npods)
-                maxpods_h = np.asarray(t.maxpods)
+                import jax
+                alloc_h = np.asarray(t.alloc, np.float32)
+                # sync-point: preempt planning pulls the resident device
+                # aggregates (host mirror stands in on the remote seam)
+                used_h, npods_h = jax.device_get(
+                    (st["used"], st["npods"]) if isinstance(st, dict)
+                    else (t.used, t.npods))
+                maxpods_h = np.asarray(t.maxpods, np.float32)
                 taint_h = np.asarray(t.taint_mask, np.float32)
-                vict_prio_h = np.asarray(t.vict_prio)
+                vict_prio_h = np.asarray(t.vict_prio, np.int32)
                 vict_req_h = np.asarray(t.vict_req, np.float32)
                 I32M = 2**31 - 1
 
@@ -1482,7 +1497,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                             # re-proves host-side
                             escapes[i] = "victim_overflow"
                             continue
-                        cj = np.asarray(cand[j])
+                        cj = np.asarray(cand[j], bool)
                         # best OPEN node straight from the kernel planes
                         best = None
                         open_m = cj & ~claimed_rows

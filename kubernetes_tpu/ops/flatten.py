@@ -914,6 +914,22 @@ class ClusterTensors:
         for asg_idx in range(len(self.asgs)):
             self._encode_asg_row(asg_idx, row, ni)
 
+        # restart window: a RESIDENT pod can carry a namespaceSelector
+        # anti term whose group was never registered in this process —
+        # registration happens on the ENCODE path of incoming pods, and
+        # after a scheduler restart a bound pod never re-encodes.  Arm
+        # the conservative guard for any such term during the first
+        # snapshot sync so an incoming pod that could match it defers to
+        # the oracle instead of silently violating the unencoded
+        # constraint.  (Groups that DO register later keep exact device
+        # counts; the guard stays armed — conservative, never wrong.)
+        ids, term_key = self._asg_ids, self.term_group_key
+        for pi in ni.pods_with_required_anti_affinity:
+            for term in pi.required_anti_affinity_terms:
+                if term.ns_selector is not None \
+                        and term_key(term) not in ids:
+                    self.arm_ns_anti_guard(term)
+
         # ---- static fields (labels/taints/alloc) ----
         # Binds dirty only dynamic fields; NodeInfo.node_generation advances
         # only when the node OBJECT changed, so rows dirtied by pod traffic
@@ -1029,6 +1045,17 @@ class ClusterTensors:
                 if ids.get(term_key(term)) == asg_idx:
                     n += 1
         self.cnt_asg[asg_idx, row] = n
+
+    def arm_ns_anti_guard(self, term) -> None:
+        """Record one namespaceSelector ANTI term in the conservative
+        guard (ns_anti_kv/ns_anti_complex, see __init__): later pods
+        whose labels could match the selector escape to the oracle, so
+        a device placement can never violate the unencoded term."""
+        kv = _exact_kv(SelectorGroup("", term.selector, frozenset()))
+        if kv is not None:
+            self.ns_anti_kv.add(kv)
+        else:
+            self.ns_anti_complex = True
 
     # -- per-batch domain base counts ------------------------------------
 
@@ -1515,15 +1542,9 @@ class BatchEncoder:
     def _arm_ns_anti_guard(self, term) -> None:
         """Record one namespaceSelector ANTI term in the conservative
         guard — the fallback for terms whose group could NOT register
-        (asg bucket overflow): later pods whose labels could match the
-        selector escape to the oracle, so a device placement can never
-        violate the unregistered term."""
-        t = self.t
-        kv = _exact_kv(SelectorGroup("", term.selector, frozenset()))
-        if kv is not None:
-            t.ns_anti_kv.add(kv)
-        else:
-            t.ns_anti_complex = True
+        (asg bucket overflow).  Delegates to the tensors' own arming
+        path (also used by the restart-window resident scan)."""
+        self.t.arm_ns_anti_guard(term)
 
     def _cover_ns_anti_terms(self, pi: PodInfo) -> None:
         """Pre-register the resolved ANTI groups of a namespaceSelector

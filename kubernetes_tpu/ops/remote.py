@@ -360,9 +360,11 @@ class _WorkerCore:
             if variant not in ("full", "full_small", "plain"):
                 raise WorkerError(E_INVALID, f"unknown variant {variant!r}")
             try:
+                import jax
                 buf = np.frombuffer(body, np.float32)
                 rd = b._device_step(variant, buf)
-                return np.asarray(rd).astype(np.int32).tobytes()
+                # sync-point: worker serializes the step result for the wire
+                return jax.device_get(rd).astype(np.int32).tobytes()
             except WorkerError:
                 raise
             except (ValueError, TypeError, KeyError, IndexError) as e:
@@ -389,13 +391,14 @@ class _WorkerCore:
                     {k: arrays[k]
                      for k in ("req", "prio", "untol_hard", "group_idx",
                                "nom_used", "nom_np", "active")})
-                cand, viol, highest, psum, nvic, victims, overflow = out
+                import jax
+                # sync-point: worker serializes the dry-run planes
+                cand, viol, highest, psum, nvic, victims, overflow = \
+                    jax.device_get(out)
                 return _dump_arrays({
-                    "cand": np.asarray(cand), "viol": np.asarray(viol),
-                    "highest": np.asarray(highest),
-                    "psum": np.asarray(psum), "nvic": np.asarray(nvic),
-                    "victims": np.asarray(victims),
-                    "overflow": np.asarray(overflow)})
+                    "cand": cand, "viol": viol, "highest": highest,
+                    "psum": psum, "nvic": nvic, "victims": victims,
+                    "overflow": overflow})
             except WorkerError:
                 raise
             except (ValueError, TypeError, KeyError, IndexError, OSError) as e:

@@ -120,7 +120,9 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                 self._state, self._static_node, pod_arrays, prows, pvals)
             self._state, a, _w = self._ensure_plain()(
                 self._state, self._static_node, pod_arrays, prows, pvals)
-            np.asarray(a)  # block until the device round trips complete
+            import jax
+            # sync-point: warmup barrier — block until the round trips land
+            jax.device_get(a)
 
     def _empty_patches(self):
         return (np.full(self._k_cap, -1, np.int32),
@@ -263,9 +265,12 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         n = len(pod_infos)
 
         def resolve():
+            import jax
             with self._lock:
-                assignments = np.asarray(assignments_dev)
-                self.stats["waves"] += int(np.asarray(waves_dev))
+                # sync-point: sharded wave resolve — the pipeline's d2h pull
+                assignments, waves = jax.device_get(
+                    (assignments_dev, waves_dev))
+                self.stats["waves"] += int(waves)
                 self._replay(batch, assignments)
                 try:
                     self._unresolved.remove(holder)

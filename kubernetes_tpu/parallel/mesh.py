@@ -128,6 +128,8 @@ def build_sharded_step_fn(caps: Caps, mesh: Mesh,
         out_specs=(ss, P(), P()),
         check_vma=False,
     )
+    # compile-cached: built once per mesh at backend setup; the caller
+    # holds the returned callable (and its jit cache) for every wave
     return jax.jit(fn, donate_argnums=(0,))
 
 
@@ -149,4 +151,6 @@ def build_sharded_assign_fn(caps: Caps, mesh: Mesh,
                    "cd_sg": P(), "cd_asg": P()},
         check_vma=False,
     )
+    # compile-cached: built once per mesh at backend setup; the caller
+    # holds the returned callable (and its jit cache) for every wave
     return jax.jit(fn)

@@ -261,9 +261,12 @@ class SchedulingQueue:
         # O(1) bucket queue; a custom QueueSort keeps the generic heap
         if priority_fifo is None:
             priority_fifo = sort_key is default_sort_key
-        self._active = _BucketQueue() if priority_fifo else _Heap(sort_key)
-        self._backoff = _Heap(lambda q: (self._backoff_expiry(q),))
-        self._unschedulable: dict[str, QueuedPodInfo] = {}
+        # the three tiers move pods between them under one lock; _cond
+        # shares it (Condition(self._lock)), so either name proves a
+        # mutation site to the lock-discipline rule
+        self._active = _BucketQueue() if priority_fifo else _Heap(sort_key)  # guarded-by: _lock|_cond
+        self._backoff = _Heap(lambda q: (self._backoff_expiry(q),))  # guarded-by: _lock|_cond
+        self._unschedulable: dict[str, QueuedPodInfo] = {}  # guarded-by: _lock|_cond
         self._initial_backoff = pod_initial_backoff
         self._max_backoff = pod_max_backoff
         self._unschedulable_timeout = unschedulable_timeout
@@ -278,7 +281,7 @@ class SchedulingQueue:
         self._queue_cap = queue_cap
         self._shed_protect_priority = shed_protect_priority
         self._shed_protect_age = shed_protect_age
-        self._shed_pending: dict[tuple[str, str], int] = {}
+        self._shed_pending: dict[tuple[str, str], int] = {}  # guarded-by: _lock|_cond
 
     # -- backoff ---------------------------------------------------------
 

@@ -65,14 +65,14 @@ class SchedulerMetrics:
     def __init__(self) -> None:
         self.lock = threading.Lock()
         self.prom = _metrics.Metrics()
-        self.schedule_attempts = {"scheduled": 0, "unschedulable": 0, "error": 0}
-        self.scheduling_latency_sum = 0.0
-        self.scheduling_latencies: list[float] = []
+        self.schedule_attempts = {"scheduled": 0, "unschedulable": 0, "error": 0}  # guarded-by: lock
+        self.scheduling_latency_sum = 0.0  # guarded-by: lock
+        self.scheduling_latencies: list[float] = []  # guarded-by: lock
         # submit->bind per pod: queue admission (QueuedPodInfo creation)
         # to bind write confirmed.  The OTHER half of the north-star metric
         # (p99 <10ms); reference: pod_scheduling_duration_seconds
         # (pkg/scheduler/metrics/metrics.go:55-75)
-        self.pod_e2e_latencies: list[float] = []
+        self.pod_e2e_latencies: list[float] = []  # guarded-by: lock
         self.preemption_attempts = 0
 
     def observe_attempt(self, result: str, latency: float,

@@ -337,7 +337,13 @@ fasthost_pod_scan_into(PyObject *self, PyObject *args)
                      || (PyList_CheckExact(tsc) && PyList_GET_SIZE(tsc) == 0))
                  && !has_ports && !special_vol && !truthy_nominated
                  && (node_name == NULL || node_name == Py_None
-                     || !PyObject_IsTrue(node_name));
+                     || !PyObject_IsTrue(node_name))
+                 /* explicit JSON null (Py_None) for these keys is NOT the
+                    same as the key being absent: the Python path's
+                    spec.get("schedulerName", default) returns None, not
+                    the default.  Punt nulls to Python instead of
+                    guessing a coalescence it doesn't perform. */
+                 && sched != Py_None && uid != Py_None && labels != Py_None;
     if (PyErr_Occurred())
         return NULL;
     if (!simple)

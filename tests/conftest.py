@@ -15,8 +15,31 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture
+def no_implicit_transfers():
+    """Scope under jax's device->host transfer guard: any implicit pull
+    raises; explicit jax.device_get (the `# sync-point:` idiom the
+    device-sync lint rule enforces) stays allowed.  On CPU the arrays
+    are host-resident so the guard can't trip, but the wiring is what
+    TPU CI inherits — see tools/ktpulint/sanitizers.py."""
+    from tools.ktpulint.sanitizers import transfer_guard
+
+    with transfer_guard():
+        yield
+
+
+@pytest.fixture
+def compile_counter():
+    """Factory for CompileCounter contexts (zero-per-wave-recompile
+    assertions in device-path suites)."""
+    from tools.ktpulint.sanitizers import CompileCounter
+
+    return CompileCounter
 
 
 def pytest_configure(config):

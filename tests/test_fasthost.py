@@ -86,6 +86,23 @@ def pods_corpus() -> list[dict]:
         "whenUnsatisfiable": "DoNotSchedule",
         "labelSelector": {"matchLabels": {"app": "x"}}}]
     out.append(p)
+    # explicit JSON null is NOT the same as the key being absent — the
+    # Python path's spec.get("schedulerName", default) returns None, so
+    # the native path must punt these to Python, not coalesce them
+    p = copy.deepcopy(base)  # explicit-null schedulerName
+    p["spec"]["schedulerName"] = None
+    out.append(p)
+    p = copy.deepcopy(base)  # explicit-null uid
+    p["metadata"]["uid"] = None
+    out.append(p)
+    p = copy.deepcopy(base)  # explicit-null labels
+    p["metadata"]["labels"] = None
+    out.append(p)
+    p = copy.deepcopy(base)  # all three nulled at once
+    p["spec"]["schedulerName"] = None
+    p["metadata"]["uid"] = None
+    p["metadata"]["labels"] = None
+    out.append(p)
     return out
 
 

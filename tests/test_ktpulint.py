@@ -1,0 +1,581 @@
+"""Self-tests for the ktpu-lint engine (tools/ktpulint).
+
+Every rule gets a seeded-violation fixture that must FIRE and a clean
+fixture that must stay silent — the lint gate is only trustworthy if the
+rules themselves are pinned.  Engine mechanics (suppression comments,
+baselines, annotation-block scanning) are covered at the bottom.
+
+Fixture trees are built per-test under tmp_path; project-scope rules
+that import fixture packages use unique package names so sys.modules
+never aliases two tests together.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from tools.ktpulint.engine import (
+    Finding, LintContext, all_rules, load_baseline, run_lint, write_baseline,
+)
+
+PKG = "fixpkg"
+
+
+def make_ctx(tmp_path, files: dict[str, str], package_name: str = PKG,
+             **kw) -> LintContext:
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        if rel.endswith(".py"):
+            paths.append(p)
+    return LintContext(tmp_path, targets=paths, package_name=package_name,
+                       **kw)
+
+
+def run_rule(ctx: LintContext, name: str) -> list[Finding]:
+    return run_lint(ctx, rule_names=[name])
+
+
+def test_registry_has_the_full_catalog():
+    rules = all_rules()
+    assert len(rules) >= 14
+    for name, rule in rules.items():
+        assert name == rule.name
+        assert rule.doc, f"rule {name} has no doc line"
+        assert rule.scope in ("file", "project")
+
+
+# -- wiring rules ----------------------------------------------------------
+
+def test_module_imports_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {
+        "fixpkg_mi_bad/__init__.py": "",
+        "fixpkg_mi_bad/boom.py": 'raise RuntimeError("import-time kaboom")\n',
+    }, package_name="fixpkg_mi_bad")
+    found = run_rule(ctx, "module-imports")
+    assert any("boom" in f.message for f in found)
+
+    ctx = make_ctx(tmp_path / "ok", {
+        "fixpkg_mi_ok/__init__.py": "",
+        "fixpkg_mi_ok/fine.py": "X = 1\n",
+    }, package_name="fixpkg_mi_ok")
+    assert run_rule(ctx, "module-imports") == []
+
+
+def test_reference_citation_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {
+        f"{PKG}/__init__.py": "",
+        f"{PKG}/uncited.py": '"""A module with no reference."""\n',
+    })
+    found = run_rule(ctx, "reference-citation")
+    assert [f.path for f in found] == [f"{PKG}/uncited.py"]
+
+    ctx = make_ctx(tmp_path / "ok", {
+        f"{PKG}/__init__.py": "",
+        f"{PKG}/cited.py":
+            '"""Mirrors pkg/scheduler/framework/plugins/noderesources."""\n',
+    })
+    assert run_rule(ctx, "reference-citation") == []
+
+
+_CSS_COMMON = {
+    "client/__init__.py": "",
+    "client/clientset.py":
+        'CLUSTER_SCOPED_RESOURCES = frozenset({"nodes", "namespaces"})\n',
+    "client/http_client.py": """\
+        from . import clientset
+
+        class HTTPClient:
+            def __init__(self,
+                         cluster_scoped=clientset.CLUSTER_SCOPED_RESOURCES):
+                self.cluster_scoped = cluster_scoped
+        """,
+    "apiserver/__init__.py": "",
+}
+
+
+def test_cluster_scoped_share_fires_and_clean(tmp_path):
+    bad = {f"fixpkg_css_bad/{k}": v for k, v in _CSS_COMMON.items()}
+    bad["fixpkg_css_bad/__init__.py"] = ""
+    bad["fixpkg_css_bad/apiserver/server.py"] = \
+        'CLUSTER_SCOPED = frozenset({"nodes", "namespaces"})  # a FORK\n'
+    ctx = make_ctx(tmp_path, bad, package_name="fixpkg_css_bad")
+    found = run_rule(ctx, "cluster-scoped-share")
+    assert any("fork" in f.message for f in found)
+
+    ok = {f"fixpkg_css_ok/{k}": v for k, v in _CSS_COMMON.items()}
+    ok["fixpkg_css_ok/__init__.py"] = ""
+    ok["fixpkg_css_ok/apiserver/server.py"] = """\
+        from ..client.clientset import CLUSTER_SCOPED_RESOURCES
+
+        CLUSTER_SCOPED = CLUSTER_SCOPED_RESOURCES
+        """
+    ctx = make_ctx(tmp_path / "ok", ok, package_name="fixpkg_css_ok")
+    assert run_rule(ctx, "cluster-scoped-share") == []
+
+
+def test_pause_independence_fires_and_clean(tmp_path):
+    native = tmp_path / "native"
+    (native / "pause").mkdir(parents=True)
+    (native / "pause" / "pause.c").write_text(
+        "static void sigdown(int s) {}\n"
+        "int main(void) { struct sigaction sa = {.sa_handler = sigdown}; }\n")
+    ctx = make_ctx(tmp_path, {f"{PKG}/__init__.py": ""}, native_dir=native)
+    found = run_rule(ctx, "pause-independence")
+    assert any("sigwaitinfo" in f.message for f in found)
+    assert any("sigaction" in f.message for f in found)
+
+    (native / "pause" / "pause.c").write_text(
+        "int main(void) { siginfo_t si; sigwaitinfo(&set, &si); }\n")
+    ctx = make_ctx(tmp_path, {f"{PKG}/__init__.py": ""}, native_dir=native)
+    assert run_rule(ctx, "pause-independence") == []
+
+
+_CR_COMMON = {
+    "__init__.py": "",
+    "controllers/__init__.py": "",
+    "controllers/base.py": """\
+        class Controller:
+            name = "controller"
+        """,
+    "controllers/endpoints.py": """\
+        from .base import Controller
+
+        class EndpointsController(Controller):
+            name = "endpoints"
+        """,
+    "controllers/cloud.py": """\
+        from .base import Controller
+
+        class CloudServiceController(Controller):
+            name = "cloud-service"
+
+        class CloudRouteController(Controller):
+            name = "cloud-route"
+
+        class CloudNodeController(Controller):
+            name = "cloud-node"
+        """,
+    "controllers/orphan.py": """\
+        from .base import Controller
+
+        class OrphanController(Controller):
+            name = "orphan"
+        """,
+}
+
+
+def test_controller_registry_fires_and_clean(tmp_path):
+    bad = {f"fixpkg_cr_bad/{k}": v for k, v in _CR_COMMON.items()}
+    bad["fixpkg_cr_bad/controllers/manager.py"] = """\
+        class ControllerManager:
+            CTORS = {}
+        """
+    ctx = make_ctx(tmp_path, bad, package_name="fixpkg_cr_bad")
+    found = run_rule(ctx, "controller-registry")
+    assert any("OrphanController" in f.message for f in found)
+
+    ok = {f"fixpkg_cr_ok/{k}": v for k, v in _CR_COMMON.items()}
+    ok["fixpkg_cr_ok/controllers/manager.py"] = """\
+        from .orphan import OrphanController
+
+        class ControllerManager:
+            CTORS = {"orphan": OrphanController}
+        """
+    ctx = make_ctx(tmp_path / "ok", ok, package_name="fixpkg_cr_ok")
+    assert run_rule(ctx, "controller-registry") == []
+
+
+# -- lifecycle rules -------------------------------------------------------
+
+def test_net_timeout_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {"a.py": """\
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url).read()
+        """})
+    found = run_rule(ctx, "net-timeout")
+    assert len(found) == 1 and found[0].line == 4
+
+    ctx = make_ctx(tmp_path / "ok", {"a.py": """\
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url, timeout=5.0).read()
+        """})
+    assert run_rule(ctx, "net-timeout") == []
+
+
+def test_span_lifecycle_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {"a.py": """\
+        def leaky(tracer):
+            span = tracer.start_span("wave")
+            return span
+        """})
+    found = run_rule(ctx, "span-lifecycle")
+    assert len(found) == 1 and "leaky" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {"a.py": """\
+        def managed(tracer):
+            with tracer.start_span("wave"):
+                pass
+
+        def explicit(tracer):
+            span = tracer.start_span("wave")
+            span.end()
+        """})
+    assert run_rule(ctx, "span-lifecycle") == []
+
+
+def test_retry_backoff_fires_and_clean(tmp_path):
+    bad = """\
+        def run(self):
+            while True:
+                try:
+                    self.poll()
+                except Exception:
+                    continue
+        """
+    ctx = make_ctx(tmp_path, {"client/informer.py": bad})
+    found = run_rule(ctx, "retry-backoff")
+    assert len(found) == 1
+
+    # same loop outside the audited module set: silent by design
+    ctx = make_ctx(tmp_path / "other", {"client/widget.py": bad})
+    assert run_rule(ctx, "retry-backoff") == []
+
+    ctx = make_ctx(tmp_path / "ok", {"client/informer.py": """\
+        import time
+
+        def run(self):
+            while True:
+                try:
+                    self.poll()
+                except Exception:
+                    time.sleep(self.backoff())
+        """})
+    assert run_rule(ctx, "retry-backoff") == []
+
+
+# -- pipeline rules --------------------------------------------------------
+
+def test_escape_reason_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {"ops/flatten.py": """\
+        class Enc:
+            def encode(self, i):
+                self.escape.append(i)
+        """})
+    found = run_rule(ctx, "escape-reason")
+    assert len(found) == 1 and "encode" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {"ops/flatten.py": """\
+        class Enc:
+            def encode(self, i):
+                self.escape.append(i)
+                self.escape_reasons[i] = ("Plugin", "why")
+        """})
+    assert run_rule(ctx, "escape-reason") == []
+
+
+def test_eviction_confinement_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/scheduler/rogue.py": """\
+        def drop(self, name):
+            self.client.delete(PODS, name)
+        """})
+    found = run_rule(ctx, "eviction-confinement")
+    assert len(found) == 1 and "drop" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {f"{PKG}/scheduler/preemption.py": """\
+        def evict_victims(self, names):
+            for n in names:
+                self.client.delete(PODS, n)
+        """})
+    assert run_rule(ctx, "eviction-confinement") == []
+
+
+def test_overload_metric_reason_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {
+        "scheduler/queue.py": """\
+            def on_cap(self, why):
+                self._shed_over_cap_locked(why)
+            """,
+        "scheduler/scheduler.py": """\
+            def defer(self):
+                self.metrics.overload_deferred_total.inc(1)
+            """})
+    found = run_rule(ctx, "overload-metric-reason")
+    assert len(found) == 2
+    assert any("string-literal" in f.message for f in found)
+    assert any("reason label" in f.message for f in found)
+
+    ctx = make_ctx(tmp_path / "ok", {
+        "scheduler/queue.py": """\
+            def on_cap(self):
+                self._shed_over_cap_locked("backoff_cap")
+            """,
+        "scheduler/scheduler.py": """\
+            def defer(self):
+                self.metrics.overload_deferred_total.inc(1, "admission_gate")
+            """})
+    assert run_rule(ctx, "overload-metric-reason") == []
+
+
+_TAXO_README_OK = """\
+    # fixture
+
+    ### Escape hatch
+
+    | Plugin/reason | Why |
+    |---|---|
+    | `NodePorts/port_clash` | host port collision |
+
+    ### Overload protections
+
+    Sheds with reason `backoff_cap`.
+    """
+
+
+def test_taxonomy_sync_code_to_readme(tmp_path):
+    ctx = make_ctx(tmp_path, {
+        f"{PKG}/ops/flatten.py": """\
+            class Enc:
+                def f(self, i):
+                    self._esc("Ghost", "mystery_reason")
+            """,
+        "README.md": _TAXO_README_OK,
+    }, readme=tmp_path / "README.md")
+    found = run_rule(ctx, "taxonomy-sync")
+    msgs = " ".join(f.message for f in found)
+    assert "'Ghost'" in msgs and "'mystery_reason'" in msgs
+    # ... and the README's own row now lacks an emit site too
+    assert "'NodePorts'" in msgs
+
+
+def test_taxonomy_sync_readme_to_code_and_clean(tmp_path):
+    code = {f"{PKG}/ops/flatten.py": """\
+        class Enc:
+            def f(self, i):
+                self._esc("NodePorts", "port_clash")
+        """,
+        f"{PKG}/scheduler/queue.py": """\
+        class Q:
+            def g(self):
+                self._shed_over_cap_locked("backoff_cap")
+        """}
+    stale = dict(code)
+    # NB: _TAXO_README_OK ends with the closing-quote indent, so the
+    # appended row must carry none of its own
+    stale["README.md"] = _TAXO_README_OK + \
+        "| `Stale/old_reason` | gone |\n"
+    ctx = make_ctx(tmp_path, stale, readme=tmp_path / "README.md")
+    found = run_rule(ctx, "taxonomy-sync")
+    msgs = " ".join(f.message for f in found)
+    assert "'Stale'" in msgs and "'old_reason'" in msgs
+
+    clean = dict(code)
+    clean["README.md"] = _TAXO_README_OK
+    ctx = make_ctx(tmp_path / "ok", clean,
+                   readme=tmp_path / "ok" / "README.md")
+    assert run_rule(ctx, "taxonomy-sync") == []
+
+
+# -- device rules ----------------------------------------------------------
+
+def test_device_sync_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/ops/hot.py": """\
+        import numpy as np
+
+        def pull(scores_dev):
+            n = scores_dev.item()
+            f = float(scores_dev)
+            a = np.asarray(scores_dev)
+            return n, f, a
+        """})
+    found = run_rule(ctx, "device-sync")
+    assert len(found) == 3
+
+    ctx = make_ctx(tmp_path / "ok", {f"{PKG}/ops/hot.py": """\
+        import jax
+        import numpy as np
+
+        def pull(scores_dev, host_rows):
+            # sync-point: wave resolve pulls the winner row
+            n = jax.device_get(scores_dev).item()
+            a = np.asarray(host_rows, np.float32)
+            return n, a
+        """})
+    assert run_rule(ctx, "device-sync") == []
+
+
+def test_device_sync_ignores_cold_path(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/client/cold.py": """\
+        def pull(x_dev):
+            return x_dev.item()
+        """})
+    assert run_rule(ctx, "device-sync") == []
+
+
+def test_recompile_hazard_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/models/hot.py": """\
+        import jax
+
+        def build(core):
+            return jax.jit(core)
+
+        @jax.jit
+        def kernel(x):
+            if x.shape[0] > 4:
+                return x * 2
+            return x
+        """})
+    found = run_rule(ctx, "recompile-hazard")
+    msgs = " ".join(f.message for f in found)
+    assert "fresh compile cache" in msgs
+    assert "forks the trace" in msgs
+
+    ctx = make_ctx(tmp_path / "ok", {f"{PKG}/models/hot.py": """\
+        import jax
+
+        def build(core):
+            # compile-cached: built once at setup; caller holds the jit
+            return jax.jit(core)
+        """})
+    assert run_rule(ctx, "recompile-hazard") == []
+
+
+def test_recompile_hazard_unhashable_static_arg(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/models/hot.py": """\
+        import jax
+
+        step = jax.jit(_core, static_argnames=("dims",))
+
+        def drive(x):
+            return step(x, dims=[1, 2, 3])
+        """})
+    found = run_rule(ctx, "recompile-hazard")
+    assert len(found) == 1 and "unhashable" in found[0].message
+
+
+# -- thread rules ----------------------------------------------------------
+
+def test_lock_discipline_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {"q.py": """\
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded-by: _lock
+
+            def racy_push(self, x):
+                self._items.append(x)
+        """})
+    found = run_rule(ctx, "lock-discipline")
+    assert len(found) == 1 and "racy_push" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {"q.py": """\
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._items = []  # guarded-by: _lock|_cond
+
+            def push(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def notify_push(self, x):
+                with self._cond:
+                    self._items.append(x)
+
+            def _push_locked(self, x):
+                self._items.append(x)
+        """})
+    assert run_rule(ctx, "lock-discipline") == []
+
+
+# -- engine mechanics ------------------------------------------------------
+
+def test_line_suppression_and_file_suppression(tmp_path):
+    ctx = make_ctx(tmp_path, {"a.py": """\
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url)  # ktpulint: disable=net-timeout
+        """})
+    assert run_rule(ctx, "net-timeout") == []
+
+    ctx = make_ctx(tmp_path / "above", {"a.py": """\
+        from urllib.request import urlopen
+
+        def fetch(url):
+            # ktpulint: disable=net-timeout
+            return urlopen(url)
+        """})
+    assert run_rule(ctx, "net-timeout") == []
+
+    ctx = make_ctx(tmp_path / "file", {"a.py": """\
+        # ktpulint: disable-file=net-timeout
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url)
+
+        def fetch2(url):
+            return urlopen(url)
+        """})
+    assert run_rule(ctx, "net-timeout") == []
+
+
+def test_baseline_round_trip(tmp_path):
+    files = {"a.py": """\
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url)
+        """}
+    ctx = make_ctx(tmp_path, files)
+    found = run_rule(ctx, "net-timeout")
+    assert found
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(bl_path, found)
+    data = json.loads(bl_path.read_text())
+    assert data["findings"][0]["rule"] == "net-timeout"
+
+    ctx = make_ctx(tmp_path, files)
+    assert run_lint(ctx, rule_names=["net-timeout"],
+                    baseline=load_baseline(bl_path)) == []
+
+
+def test_fingerprint_excludes_line():
+    a = Finding("r", "p.py", 10, "msg")
+    b = Finding("r", "p.py", 99, "msg")
+    c = Finding("r", "p.py", 10, "other msg")
+    assert a.fingerprint() == b.fingerprint()
+    assert a.fingerprint() != c.fingerprint()
+
+
+def test_annotation_scans_wrapped_comment_block(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/ops/hot.py": """\
+        import jax
+
+        def build(core):
+            # compile-cached: lazy module-level singleton; one cache
+            # serves every call — second line of a wrapped comment
+            return jax.jit(core)
+        """})
+    assert run_rule(ctx, "recompile-hazard") == []
+
+
+def test_unknown_rule_name_raises(tmp_path):
+    ctx = make_ctx(tmp_path, {"a.py": "X = 1\n"})
+    with pytest.raises(KeyError):
+        run_lint(ctx, rule_names=["no-such-rule"])

@@ -362,3 +362,33 @@ class TestRemoteSeamParity:
         assert second == first
         want, _ = device(local, snap, preemptors)
         assert second == want
+
+
+class TestCandidateRanking:
+    def test_headroom_normalized_per_resource(self):
+        """Heterogeneous-memory fleets: the headroom tiebreak must be the
+        per-resource free FRACTION, not raw units.  Node A frees 256Gi of
+        memory but needs TWO victims; node B frees 1Gi with ONE victim.
+        Fewest-victims must win — under raw-unit headroom, 1e-9 * free
+        memory BYTES (~274 for 256Gi) dwarfed both the victim-count term
+        and the decorrelation noise, so big-memory nodes always won."""
+        import numpy as np
+
+        from kubernetes_tpu.models.preempt import preempt_candidates
+
+        GI = float(1 << 30)
+        alloc = np.array([[64.0, 512 * GI],    # node A
+                          [64.0, 64 * GI]],    # node B
+                         np.float32)
+        used = alloc.copy()                    # both full pre-reclaim
+        reclaim = np.array([[[2.0, 256 * GI],  # A: two victims, huge mem
+                             [2.0, 1 * GI]]],  # B: one victim, small mem
+                           np.float32)         # [G=1, N=2, R=2]
+        reclaim_np = np.array([[2.0, 1.0]], np.float32)
+        rows, count = preempt_candidates(
+            alloc, used, np.array([5.0, 5.0], np.float32),
+            np.array([10.0, 10.0], np.float32), np.array([True, True]),
+            reclaim, reclaim_np, np.array([0], np.int32),
+            np.array([[1.0, GI / 2]], np.float32), np.array([True]), k=2)
+        assert count[0] == 2                   # both nodes feasible
+        assert rows[0, 0] == 1                 # fewest victims first: B

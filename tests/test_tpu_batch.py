@@ -665,3 +665,41 @@ class TestTailCompaction:
         assignments = r[:-1]
         assert (assignments >= 0).all(), assignments
         assert len(set(assignments.tolist())) == P  # one per node
+
+
+class TestNsAntiGuardRestartWindow:
+    """Scheduler-restart window: a RESIDENT pod can carry a
+    namespaceSelector anti term whose group was never registered in THIS
+    process — registration happens on the encode path of incoming pods,
+    and a bound pod never re-encodes after a restart.  The first
+    snapshot sync must arm the conservative ns-anti guard for such
+    terms, so a matching incoming pod escapes to the oracle instead of
+    taking a device placement that could violate the unencoded term."""
+
+    def test_resident_ns_anti_term_arms_guard_after_restart(self):
+        resident = make_pod("old", "team-a").labels(app="web") \
+            .node("n1").build()
+        resident["spec"]["affinity"] = ns_anti_affinity(
+            {"app": "web"}, {"team": "dev"})
+        nodes = [make_node("n1")
+                 .labels(**{"kubernetes.io/hostname": "n1"}).build()]
+        snap = snapshot_from(nodes, [resident])
+        # fresh backend = restarted scheduler: no prior encode registered
+        # the resident term's group
+        backend = TPUBatchBackend(small_caps(), batch_size=1)
+        backend.note_namespace_event("ADDED", make_ns("team-a", team="dev"))
+        incoming = make_pod("p").labels(app="web").build()
+        name, status = backend.assign([PodInfo(incoming)], snap)[0]
+        assert name is None and status.is_skip()
+        reasons = backend.drain_escape_reasons()
+        assert reasons.get(("InterPodAffinity", "ns_anti_guard")) == 1
+
+    def test_plain_resident_pod_does_not_arm_guard(self):
+        resident = make_pod("old").labels(app="web").node("n1").build()
+        nodes = [make_node("n1").build()]
+        snap = snapshot_from(nodes, [resident])
+        backend = TPUBatchBackend(small_caps(), batch_size=1)
+        out = run_assign(backend,
+                         [make_pod("p").labels(app="web").build()], snap)
+        assert out[0] == "n1"  # device path, no guard, no escape
+        assert backend.drain_escape_reasons() == {}

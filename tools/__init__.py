@@ -1,0 +1,2 @@
+"""Project tooling (hack/ in the reference tree): the ktpu-lint static
+analysis engine plus standalone profiling/census scripts."""

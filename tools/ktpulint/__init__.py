@@ -1,0 +1,6 @@
+"""ktpu-lint: project-native static analysis for the TPU scheduler
+(the hack/verify-* battery of the reference tree, grown rules for this
+codebase's hazard classes).  `python -m tools.ktpulint --help`."""
+
+from .engine import (Finding, FileView, LintContext, Rule, all_rules,  # noqa: F401
+                     load_baseline, run_lint, write_baseline)

@@ -1,0 +1,93 @@
+"""ktpu-lint CLI: `python -m tools.ktpulint [paths...]`.
+
+Exit status: 0 clean, 1 findings, 2 usage error — the shape of the
+reference's hack/verify-*.sh gates so CI can wire it as a single step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from .engine import LintContext, all_rules, load_baseline, run_lint, \
+    write_baseline
+
+DEFAULT_TARGETS = ("kubernetes_tpu", "tools", "bench.py")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.ktpulint",
+        description="Project-native static analysis for the TPU scheduler.")
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_TARGETS),
+                   help="files/directories to lint (default: %(default)s)")
+    p.add_argument("--repo-root", default=".",
+                   help="repository root (default: cwd)")
+    p.add_argument("--rule", action="append", dest="rules", metavar="NAME",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--baseline", metavar="FILE",
+                   help="JSON baseline of accepted findings to skip")
+    p.add_argument("--write-baseline", metavar="FILE",
+                   help="write current findings as the new baseline, exit 0")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    rules = all_rules()
+
+    if args.list_rules:
+        width = max(len(n) for n in rules)
+        for name in sorted(rules):
+            r = rules[name]
+            print(f"{name:<{width}}  [{r.scope:7}]  {r.doc}")
+        return 0
+
+    if args.rules:
+        unknown = [n for n in args.rules if n not in rules]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    repo_root = pathlib.Path(args.repo_root).resolve()
+    targets = []
+    for raw in args.paths:
+        p = pathlib.Path(raw)
+        if not p.is_absolute():
+            p = repo_root / p
+        if not p.exists():
+            print(f"no such path: {raw}", file=sys.stderr)
+            return 2
+        targets.append(p)
+
+    baseline = None
+    if args.baseline:
+        bp = pathlib.Path(args.baseline)
+        if bp.is_file():
+            baseline = load_baseline(bp)
+
+    ctx = LintContext(repo_root, targets=targets)
+    findings = run_lint(ctx, rule_names=args.rules, baseline=baseline)
+
+    if args.write_baseline:
+        write_baseline(pathlib.Path(args.write_baseline), findings)
+        print(f"wrote {len(findings)} finding(s) to {args.write_baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({"findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "message": f.message, "fingerprint": f.fingerprint()}
+            for f in findings]}, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"\n{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0

@@ -1,0 +1,298 @@
+"""ktpu-lint core: rule registry, file views, suppression, baselines.
+
+The project-native analogue of the reference's hack/verify-*.sh battery
+(golint/verify-gofmt/typecheck gates), reshaped for THIS codebase's
+hazard classes: every invariant the batch pipeline grew across PRs 1-5
+(escape reasons, eviction confinement, span lifecycles, retry backoff,
+reason-labelled overload metrics) plus the accelerator-native ones
+(silent host<->device syncs, per-wave recompiles, GIL-thread lock
+discipline) lives here as a Rule class.  tests/test_verify_static.py is
+a thin pytest runner over this engine; `python -m tools.ktpulint` is the
+CLI entry.
+
+Annotation conventions (documented in README "Static analysis"):
+
+  # ktpulint: disable=<rule>[,<rule>...]     suppress findings on this
+      line (or the line directly below the comment)
+  # ktpulint: disable-file=<rule>[,...]      suppress for the whole file
+  # sync-point: <why>                        authorize a host<->device
+      sync on this line / this def (device-sync rule)
+  # compile-cached: <why>                    authorize a nested jit def
+      (recompile-hazard rule)
+  # guarded-by: <lock>[|<alt-lock>...]       declare the lock guarding a
+      shared attribute (lock-discipline rule)
+
+Findings are deterministic and ordered; a baseline file (JSON list of
+fingerprints) lets pre-existing accepted findings ride without blocking
+the gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import pathlib
+import re
+from typing import Callable, Iterable, Iterator
+
+_DISABLE_RE = re.compile(r"#\s*ktpulint:\s*disable=([\w,\- ]+)")
+_DISABLE_FILE_RE = re.compile(r"#\s*ktpulint:\s*disable-file=([\w,\- ]+)")
+_ANNOTATION_RE = re.compile(r"#\s*(sync-point|compile-cached|guarded-by)\b")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str       # repo-relative posix path ("" for project-level)
+    line: int       # 1-based; 0 for project-level findings
+    message: str
+
+    def fingerprint(self) -> str:
+        """Stable identity for baselines: deliberately excludes the line
+        number so unrelated edits above a finding don't churn it."""
+        h = hashlib.sha256(
+            f"{self.rule}|{self.path}|{self.message}".encode()).hexdigest()
+        return h[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.path else "<project>"
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+class FileView:
+    """One parsed source file: text, lines, lazy AST, suppressions."""
+
+    def __init__(self, path: pathlib.Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self._tree: ast.Module | None = None
+        self._parse_error: SyntaxError | None = None
+        # line -> set of rule names disabled on that line
+        self.line_disables: dict[int, set[str]] = {}
+        self.file_disables: set[str] = set()
+        for i, ln in enumerate(self.lines, start=1):
+            m = _DISABLE_FILE_RE.search(ln)
+            if m:
+                self.file_disables.update(
+                    r.strip() for r in m.group(1).split(",") if r.strip())
+                continue
+            m = _DISABLE_RE.search(ln)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                self.line_disables.setdefault(i, set()).update(rules)
+                # a comment-only line shields the line below it too
+                if ln.lstrip().startswith("#"):
+                    self.line_disables.setdefault(i + 1, set()).update(rules)
+
+    @property
+    def tree(self) -> ast.Module | None:
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as e:  # surfaced by the module-imports rule
+                self._parse_error = e
+        return self._tree
+
+    def line_has_annotation(self, line: int, kind: str) -> bool:
+        """True when `# <kind>:` appears on `line` or in the contiguous
+        comment block directly above it (annotations often wrap)."""
+        if 1 <= line <= len(self.lines):
+            m = _ANNOTATION_RE.search(self.lines[line - 1])
+            if m and m.group(1) == kind:
+                return True
+        ln = line - 1
+        while 1 <= ln <= len(self.lines) \
+                and self.lines[ln - 1].lstrip().startswith(("#", "@")):
+            m = _ANNOTATION_RE.search(self.lines[ln - 1])
+            if m and m.group(1) == kind:
+                return True
+            ln -= 1
+        return False
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return (rule in self.file_disables
+                or rule in self.line_disables.get(line, ()))
+
+
+class LintContext:
+    """Everything a rule may consult: the target file set plus the
+    project fixtures (package root, README, native sources).  Tests point
+    these at seeded fixture trees to prove each rule fires."""
+
+    def __init__(self, repo_root: pathlib.Path,
+                 targets: Iterable[pathlib.Path] | None = None,
+                 package_name: str = "kubernetes_tpu",
+                 readme: pathlib.Path | None = None,
+                 native_dir: pathlib.Path | None = None):
+        self.repo_root = pathlib.Path(repo_root).resolve()
+        self.package_name = package_name
+        self.readme = readme or (self.repo_root / "README.md")
+        self.native_dir = native_dir or (self.repo_root / "native")
+        self._views: dict[str, FileView] = {}
+        self._targets: list[str] = []
+        for p in (targets if targets is not None
+                  else [self.repo_root / package_name]):
+            p = pathlib.Path(p)
+            if not p.is_absolute():
+                p = self.repo_root / p
+            if p.is_dir():
+                files = sorted(p.rglob("*.py"))
+            else:
+                files = [p]
+            for f in files:
+                if "__pycache__" in f.parts:
+                    continue
+                rel = f.resolve().relative_to(self.repo_root).as_posix()
+                if rel not in self._views:
+                    self._views[rel] = FileView(f, rel)
+                    self._targets.append(rel)
+
+    @property
+    def package_root(self) -> pathlib.Path:
+        return self.repo_root / self.package_name
+
+    def files(self, prefix: str | tuple[str, ...] = "") -> Iterator[FileView]:
+        for rel in self._targets:
+            if not prefix or rel.startswith(prefix):
+                yield self._views[rel]
+
+    def view(self, rel: str) -> FileView | None:
+        """Fetch a view by repo-relative path, loading it on demand even
+        when outside the CLI target set (project rules pin fixed files)."""
+        if rel in self._views:
+            return self._views[rel]
+        p = self.repo_root / rel
+        if not p.is_file():
+            return None
+        v = FileView(p, rel)
+        self._views[rel] = v
+        return v
+
+
+class Rule:
+    """Base rule.  Subclasses set `name` (kebab-case, the suppression
+    token), `scope` ("file" runs per FileView, "project" runs once), and
+    implement check_file(view, ctx) or check_project(ctx)."""
+
+    name = "rule"
+    scope = "file"
+    doc = ""
+
+    def check_file(self, view: FileView,
+                   ctx: LintContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, ctx: LintContext) -> Iterable[Finding]:
+        return ()
+
+    # helper shared by AST rules
+    def finding(self, view: FileView | None, line: int,
+                message: str) -> Finding:
+        return Finding(self.name, view.rel if view else "", line, message)
+
+
+REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    inst = cls()
+    if inst.name in REGISTRY:
+        raise ValueError(f"duplicate rule name: {inst.name}")
+    REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    from . import rules  # noqa: F401  (import populates REGISTRY)
+    return dict(REGISTRY)
+
+
+def run_lint(ctx: LintContext,
+             rule_names: Iterable[str] | None = None,
+             baseline: set[str] | None = None) -> list[Finding]:
+    """Run the selected rules (default: all) over the context; returns
+    findings not suppressed in-source and not in the baseline."""
+    rules = all_rules()
+    selected = ([rules[n] for n in rule_names] if rule_names is not None
+                else list(rules.values()))
+    out: list[Finding] = []
+    for rule in selected:
+        if rule.scope == "project":
+            found = list(rule.check_project(ctx))
+        else:
+            found = []
+            for view in ctx.files():
+                found.extend(rule.check_file(view, ctx))
+        for f in found:
+            view = ctx._views.get(f.path)
+            if view is not None and view.suppressed(f.rule, f.line):
+                continue
+            if baseline and f.fingerprint() in baseline:
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out
+
+
+def load_baseline(path: pathlib.Path) -> set[str]:
+    data = json.loads(path.read_text())
+    return {entry["fingerprint"] for entry in data["findings"]}
+
+
+def write_baseline(path: pathlib.Path, findings: list[Finding]) -> None:
+    data = {"findings": [
+        {"fingerprint": f.fingerprint(), "rule": f.rule, "path": f.path,
+         "message": f.message} for f in findings]}
+    path.write_text(json.dumps(data, indent=2, sort_keys=True) + "\n")
+
+
+# -- shared AST helpers (used by several rule modules) ---------------------
+
+def walk_functions(tree: ast.AST) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n
+
+
+def call_name(node: ast.Call) -> str:
+    """Trailing name of the called thing: f() -> "f", a.b.c() -> "c"."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted-name rendering: jax.jit -> "jax.jit"."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def enclosing_withs(fn: ast.AST, target: ast.AST) -> list[ast.With]:
+    """All With statements on the path from `fn` down to `target`."""
+    out: list[ast.With] = []
+
+    def descend(node: ast.AST) -> bool:
+        if node is target:
+            return True
+        for child in ast.iter_child_nodes(node):
+            if descend(child):
+                if isinstance(node, ast.With):
+                    out.append(node)
+                return True
+        return False
+
+    descend(fn)
+    return out

@@ -1,0 +1,3 @@
+"""Rule modules; importing this package populates engine.REGISTRY."""
+
+from . import device, lifecycle, pipeline, threads, wiring  # noqa: F401

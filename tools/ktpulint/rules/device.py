@@ -1,0 +1,202 @@
+"""Accelerator-native rules (new in this PR): silent host<->device
+syncs and recompile hazards in the batch hot path.
+
+On a real TPU every unannounced `.item()` / `float(dev_val)` /
+`np.asarray(dev_val)` is a blocking device->host transfer that stalls
+the wave pipeline; every per-wave retrace burns seconds of XLA compile
+time.  On the CPU test platform both are free, which is exactly why they
+creep in — these rules are the static teeth, and tools.ktpulint.sanitizers
+wires the matching runtime guards (jax.transfer_guard + compile counter).
+
+Reference: JAX transfer-guard / jit-caching docs; the hot-path module
+set mirrors this repo's ops/ + models/ + parallel/ device pipeline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileView, LintContext, Rule, dotted, register, \
+    walk_functions
+
+_JIT_NAMES = ("jax.jit", "jit", "jax.pjit", "pjit")
+
+
+def hot_path(view: FileView, ctx: LintContext) -> bool:
+    pkg = ctx.package_name
+    return view.rel.startswith((f"{pkg}/ops/", f"{pkg}/models/",
+                                f"{pkg}/parallel/"))
+
+
+def _mentions_device_value(node: ast.AST) -> bool:
+    """Heuristic: the expression touches a jnp.* value or a name that the
+    codebase's convention marks device-resident (*_dev / *_device)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and (
+                n.id == "jnp" or n.id.endswith(("_dev", "_device"))):
+            return True
+        if isinstance(n, ast.Attribute) and dotted(n).startswith("jnp."):
+            return True
+    return False
+
+
+@register
+class DeviceSyncRule(Rule):
+    """Hot-path modules (ops/, models/, parallel/) may only sync
+    device->host at sites annotated `# sync-point: <why>` — and those
+    sites should use jax.device_get, the one transfer idiom the runtime
+    transfer guard (sanitizers.py) lets through.  Flags `.item()`,
+    `float()/int()` on device values, and dtype-less np.asarray (the
+    implicit-transfer spelling of device_get)."""
+
+    name = "device-sync"
+    doc = "hot-path host syncs only at annotated # sync-point sites"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if not hot_path(view, ctx) or view.tree is None:
+            return
+        for n in ast.walk(view.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            if view.line_has_annotation(n.lineno, "sync-point"):
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not n.args:
+                yield self.finding(
+                    view, n.lineno,
+                    ".item() forces a blocking device->host sync; use "
+                    "jax.device_get at a # sync-point")
+            elif (isinstance(f, ast.Name) and f.id in ("float", "int")
+                    and len(n.args) == 1
+                    and _mentions_device_value(n.args[0])):
+                yield self.finding(
+                    view, n.lineno,
+                    f"{f.id}() on a device value is a hidden sync; use "
+                    "jax.device_get at a # sync-point")
+            elif (dotted(f) in ("np.asarray", "numpy.asarray")
+                    and len(n.args) < 2  # positional dtype
+                    and not any(kw.arg == "dtype" for kw in n.keywords)):
+                yield self.finding(
+                    view, n.lineno,
+                    "np.asarray without dtype is an implicit device->host "
+                    "transfer; use jax.device_get at a # sync-point (or "
+                    "pass dtype= for host-side conversion)")
+
+
+def _jit_static_names(call: ast.Call) -> set[str] | None:
+    """If `call` is jax.jit(...)/pjit(...) (directly or via partial),
+    return its static_argnames literals (empty set when none)."""
+    target = dotted(call.func)
+    if target in ("partial", "functools.partial") and call.args:
+        inner = dotted(call.args[0])
+        if inner not in _JIT_NAMES:
+            return None
+    elif target not in _JIT_NAMES:
+        return None
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for c in ast.walk(kw.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    names.add(c.value)
+    return names
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if dotted(dec) in _JIT_NAMES:
+        return True
+    return isinstance(dec, ast.Call) and _jit_static_names(dec) is not None
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+@register
+class RecompileHazardRule(Rule):
+    """Per-wave recompiles are the silent latency killer: (a) a jit
+    wrapper created inside another function gets a FRESH compile cache
+    per call — annotate `# compile-cached: <why>` where an outer cache
+    genuinely holds it; (b) an unhashable literal passed for a
+    static_argnames parameter retraces on every call; (c) Python `if`
+    on `.shape` inside a jitted function forks the trace per shape —
+    exactly what wave-varying batches produce."""
+
+    name = "recompile-hazard"
+    doc = "no per-wave retrace hazards in jitted code"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if not hot_path(view, ctx) or view.tree is None:
+            return
+        # static_argnames registry for call-site checking: name -> argnames
+        static_fns: dict[str, set[str]] = {}
+        for n in ast.walk(view.tree):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                names = _jit_static_names(n.value)
+                if names:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            static_fns[t.id] = names
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in n.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        names = _jit_static_names(dec)
+                        if names:
+                            static_fns[n.name] = names
+
+        for fn in walk_functions(view.tree):
+            # (a) nested jit definitions / wrappings
+            for n in ast.walk(fn):
+                if n is fn:
+                    continue
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in n.decorator_list:
+                        if _is_jit_decorator(dec) and not (
+                                view.line_has_annotation(n.lineno,
+                                                         "compile-cached")
+                                or view.line_has_annotation(
+                                    dec.lineno, "compile-cached")):
+                            yield self.finding(
+                                view, n.lineno,
+                                f"jit-decorated {n.name} defined inside "
+                                f"{fn.name} gets a fresh compile cache per "
+                                "call; hoist it or annotate "
+                                "# compile-cached: <why>")
+                elif (isinstance(n, ast.Call)
+                        and dotted(n.func) in _JIT_NAMES
+                        and not view.line_has_annotation(n.lineno,
+                                                         "compile-cached")):
+                    yield self.finding(
+                        view, n.lineno,
+                        f"jax.jit(...) called inside {fn.name} builds a "
+                        "fresh compile cache per call; hoist it or annotate "
+                        "# compile-cached: <why>")
+            # (c) shape-dependent Python branching inside jitted defs
+            if any(_is_jit_decorator(d) for d in fn.decorator_list):
+                for n in ast.walk(fn):
+                    if (isinstance(n, ast.If)
+                            and any(isinstance(s, ast.Attribute)
+                                    and s.attr == "shape"
+                                    for s in ast.walk(n.test))
+                            and not view.line_has_annotation(
+                                n.lineno, "compile-cached")):
+                        yield self.finding(
+                            view, n.lineno,
+                            f"Python branch on .shape inside jitted "
+                            f"{fn.name} forks the trace per shape")
+
+        # (b) unhashable literals at static_argnames call sites
+        for n in ast.walk(view.tree):
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in static_fns):
+                continue
+            for kw in n.keywords:
+                if kw.arg in static_fns[n.func.id] \
+                        and isinstance(kw.value, _UNHASHABLE) \
+                        and not view.line_has_annotation(n.lineno,
+                                                         "compile-cached"):
+                    yield self.finding(
+                        view, n.lineno,
+                        f"unhashable literal for static arg {kw.arg!r} of "
+                        f"{n.func.id} retraces on every call")

@@ -1,0 +1,143 @@
+"""Liveness/lifecycle rules migrated from tests/test_verify_static.py:
+network-call timeouts, span lifecycles, retry-loop backoff.
+
+Reference: hack/verify-* gates; the invariants themselves come from this
+repo's PR history (fault-tolerant seam, batch-pipeline tracing, informer
+relist backoff).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import FileView, LintContext, Rule, register, walk_functions
+
+_NET_CALL_RE = re.compile(r"(?:urlopen|create_connection)\s*\(")
+
+
+@register
+class NetTimeoutRule(Rule):
+    """Every blocking network call must carry an explicit timeout — a
+    bare urlopen/create_connection hangs a scheduler thread forever when
+    the peer stalls, which no retry/breaker layer can see, let alone fix.
+    (gRPC calls pass timeout= per call in ops/remote.py; this audits the
+    stdlib paths.)"""
+
+    name = "net-timeout"
+    doc = "urlopen/create_connection calls carry an explicit timeout"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        text = view.text
+        for m in _NET_CALL_RE.finditer(text):
+            # walk the balanced parens to capture the full argument span
+            depth, i = 0, m.end() - 1
+            while i < len(text):
+                if text[i] == "(":
+                    depth += 1
+                elif text[i] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                i += 1
+            if "timeout" not in text[m.end():i]:
+                line = text.count("\n", 0, m.start()) + 1
+                yield self.finding(view, line,
+                                   "network call without an explicit timeout")
+
+
+@register
+class SpanLifecycleRule(Rule):
+    """Every `start_span(` call site is either context-managed (`with
+    ... start_span(...)`) or its enclosing function's subtree also calls
+    `.end(` — the explicit-end form the pipeline uses where a span
+    outlives the function that opened it (dispatch -> resolve closures,
+    error paths).  A span that is never ended never reaches the flight
+    recorder AND silently drops its whole trace from /debug/traces."""
+
+    name = "span-lifecycle"
+    doc = "start_span sites are context-managed or .end()ed"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if "start_span(" not in view.text or view.tree is None:
+            return
+        for fn in walk_functions(view.tree):
+            has_start = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "start_span"
+                for n in ast.walk(fn))
+            if not has_start:
+                continue
+            managed = any(
+                isinstance(n, ast.With)
+                and any("start_span" in ast.dump(item.context_expr)
+                        for item in n.items)
+                for n in ast.walk(fn))
+            ended = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "end"
+                for n in ast.walk(fn))
+            if not (managed or ended):
+                yield self.finding(
+                    view, fn.lineno,
+                    f"{fn.name} opens a span but neither context-manages "
+                    "nor .end()s it")
+
+
+RETRY_AUDITED = ("client/informer.py", "client/http_client.py",
+                 "scheduler/queue.py", "scheduler/scheduler.py",
+                 "ops/remote.py", "ops/failover.py")
+
+
+@register
+class RetryBackoffRule(Rule):
+    """A retry loop that catches ANY exception and goes around again
+    must back off inside the handler — a tight except-Exception-continue
+    loop turns one persistent failure into a busy-spin (and, fleet-wide,
+    into a synchronized retry storm).  Audits the long-running loop
+    modules; handlers that re-raise, break, or return are exempt (not
+    retries)."""
+
+    name = "retry-backoff"
+    doc = "generic-except retry loops back off in the handler"
+
+    @staticmethod
+    def _is_generic(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        t = handler.type
+        return (isinstance(t, ast.Name) and t.id == "Exception") or (
+            isinstance(t, ast.Attribute) and t.attr == "Exception")
+
+    @staticmethod
+    def _escapes(handler: ast.ExceptHandler) -> bool:
+        return any(isinstance(n, (ast.Raise, ast.Return, ast.Break))
+                   for n in ast.walk(handler))
+
+    @staticmethod
+    def _backs_off(handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Call):
+                name = (n.func.attr if isinstance(n.func, ast.Attribute)
+                        else getattr(n.func, "id", ""))
+                if name in ("wait", "sleep") or "backoff" in name:
+                    return True
+        return False
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if not view.rel.endswith(RETRY_AUDITED) or view.tree is None:
+            return
+        for loop in ast.walk(view.tree):
+            if not isinstance(loop, ast.While):
+                continue
+            for n in ast.walk(loop):
+                if not isinstance(n, ast.ExceptHandler):
+                    continue
+                if (self._is_generic(n) and not self._escapes(n)
+                        and not self._backs_off(n)):
+                    yield self.finding(
+                        view, n.lineno,
+                        "generic-except retry loop without a backoff/sleep "
+                        "in the handler")

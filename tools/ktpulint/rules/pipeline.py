@@ -1,0 +1,271 @@
+"""Batch-pipeline invariants: escape-reason pairing, eviction
+confinement, reason-labelled overload metrics (migrated from
+tests/test_verify_static.py) and the taxonomy-sync rule (new): every
+escape/shed/defer/cancel reason string emitted in code appears in the
+README taxonomy tables and vice versa.
+
+Reference: pkg/scheduler metrics discipline + this repo's PR 3-5
+invariants (scheduler_tpu_escape_total / scheduler_queue_shed_total /
+scheduler_overload_*_total reason labels).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import FileView, Finding, LintContext, Rule, register, \
+    walk_functions
+
+
+@register
+class EscapeReasonRule(Rule):
+    """Every `…escape.append(…)` site in ops/flatten.py must be paired
+    with an `escape_reasons` write in the same function — an escape with
+    no reason shows up in scheduler_tpu_escape_total as an unexplained
+    delta, which defeats the 'distinguish unsupported from capacity'
+    contract the escape metrics exist for."""
+
+    name = "escape-reason"
+    doc = "flatten.py escape.append sites record an escape reason"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if not view.rel.endswith("ops/flatten.py") or view.tree is None:
+            return
+        for fn in walk_functions(view.tree):
+            appends = [
+                n for n in ast.walk(fn)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "append"
+                and isinstance(n.func.value, ast.Attribute)
+                and n.func.value.attr == "escape"]
+            if not appends:
+                continue
+            records_reason = any(
+                isinstance(n, ast.Attribute) and n.attr == "escape_reasons"
+                for n in ast.walk(fn))
+            if not records_reason:
+                yield self.finding(
+                    view, fn.lineno,
+                    f"{fn.name} appends to .escape without an "
+                    "escape_reasons write")
+
+
+@register
+class EvictionConfinementRule(Rule):
+    """Every pod DELETE issued by scheduler code must route through
+    preemption.evict_victims — THE single eviction site.  A second
+    delete site forks the preemption accounting (events, victim metrics,
+    conflict-resolution dedup) between the per-pod and the bulk-commit
+    paths; confining it statically keeps both paths honest by
+    construction."""
+
+    name = "eviction-confinement"
+    doc = "pod deletes confined to preemption.evict_victims"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if (f"{ctx.package_name}/scheduler/" not in f"/{view.rel}"
+                and not view.rel.startswith(f"{ctx.package_name}/scheduler/")):
+            return
+        if ".delete(" not in view.text or view.tree is None:
+            return
+        for fn in walk_functions(view.tree):
+            for n in ast.walk(fn):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "delete"
+                        and n.args
+                        and isinstance(n.args[0], ast.Name)
+                        and n.args[0].id == "PODS"
+                        and not (view.rel.endswith("preemption.py")
+                                 and fn.name == "evict_victims")):
+                    yield self.finding(
+                        view, n.lineno,
+                        f"pod delete outside preemption.evict_victims "
+                        f"(in {fn.name})")
+
+
+@register
+class OverloadMetricReasonRule(Rule):
+    """Every degraded-mode action must be observable with a REASON — an
+    operator staring at a pod that won't schedule needs the metrics to
+    say which protection fired and why.  Statically: (a) every shed
+    trigger in queue.py passes a string-literal reason into
+    _shed_over_cap_locked; (b) every overload_deferred_total /
+    overload_wave_cancel_total increment in scheduler.py carries a
+    reason label argument."""
+
+    name = "overload-metric-reason"
+    doc = "shed/defer/cancel actions carry reason-labelled metrics"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if view.tree is None:
+            return
+        if view.rel.endswith("scheduler/queue.py"):
+            for n in ast.walk(view.tree):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "_shed_over_cap_locked"):
+                    if not (n.args and isinstance(n.args[0], ast.Constant)
+                            and isinstance(n.args[0].value, str)):
+                        yield self.finding(
+                            view, n.lineno,
+                            "shed without a string-literal reason")
+        elif view.rel.endswith("scheduler/scheduler.py"):
+            for n in ast.walk(view.tree):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "inc"
+                        and isinstance(n.func.value, ast.Attribute)
+                        and n.func.value.attr in ("overload_deferred_total",
+                                                  "overload_wave_cancel_total")):
+                    if len(n.args) < 2:  # (amount, reason)
+                        yield self.finding(
+                            view, n.lineno,
+                            f"{n.func.value.attr}.inc without a reason label")
+
+
+# -- taxonomy-sync ---------------------------------------------------------
+
+_IDENT_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
+_ROW_RE = re.compile(r"^\|\s*`([A-Za-z]+)/([a-z0-9_]+)`")
+
+
+@register
+class TaxonomySyncRule(Rule):
+    """Every escape/shed/defer/cancel reason string emitted in code
+    appears in the README taxonomy tables and vice versa — the taxonomy
+    is the operator's decoder ring for scheduler_tpu_escape_total and
+    the overload metrics; a reason missing from either side is an
+    unexplained delta or stale documentation."""
+
+    name = "taxonomy-sync"
+    scope = "project"
+    doc = "code reason strings and README taxonomy tables agree"
+
+    # emit-site modules, relative to the package root
+    SCAN_FILES = ("ops/flatten.py", "ops/backend.py", "ops/failover.py",
+                  "ops/faults.py", "scheduler/queue.py",
+                  "scheduler/scheduler.py")
+    SECTIONS = ("### Escape hatch", "### Overload protections")
+
+    def _collect_code(self, ctx: LintContext):
+        """(string -> (rel, line)) for every reason-ish literal at a
+        known emit shape; plugin names ride along (README rows name
+        `plugin/reason` pairs)."""
+        found: dict[str, tuple[str, int]] = {}
+
+        def note(s: str, rel: str, line: int) -> None:
+            if s and s not in found:
+                found[s] = (rel, line)
+
+        def strings_in(node: ast.AST):
+            # structured descent, NOT ast.walk: an IfExp's *test* operand
+            # (`"constraint" in msg`) is not an emitted reason
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                yield node
+            elif isinstance(node, ast.IfExp):
+                yield from strings_in(node.body)
+                yield from strings_in(node.orelse)
+            elif isinstance(node, (ast.Tuple, ast.List)):
+                for e in node.elts:
+                    yield from strings_in(e)
+            elif isinstance(node, ast.BoolOp):
+                for e in node.values:
+                    yield from strings_in(e)
+
+        for suffix in self.SCAN_FILES:
+            view = ctx.view(f"{ctx.package_name}/{suffix}")
+            if view is None or view.tree is None:
+                continue
+            for n in ast.walk(view.tree):
+                # _esc("Plugin", "reason")
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "_esc"):
+                    for a in n.args[:2]:
+                        for c in strings_in(a):
+                            note(c.value, view.rel, c.lineno)
+                # _shed_over_cap_locked("reason")
+                elif (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "_shed_over_cap_locked"
+                        and n.args):
+                    for c in strings_in(n.args[0]):
+                        note(c.value, view.rel, c.lineno)
+                # overload_*_total.inc(amount, "reason")
+                elif (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "inc"
+                        and isinstance(n.func.value, ast.Attribute)
+                        and "overload" in n.func.value.attr
+                        and len(n.args) >= 2):
+                    for c in strings_in(n.args[1]):
+                        note(c.value, view.rel, c.lineno)
+                elif isinstance(n, ast.Assign):
+                    tgt_names = {t.value.attr if isinstance(t, ast.Subscript)
+                                 and isinstance(t.value, ast.Attribute)
+                                 else t.value.id if isinstance(t, ast.Subscript)
+                                 and isinstance(t.value, ast.Name)
+                                 else t.id if isinstance(t, ast.Name) else ""
+                                 for t in n.targets}
+                    # escape_reasons[...] = ("Plugin", "reason"),
+                    # escapes[...] = "reason", reason = "..." / IfExp
+                    if tgt_names & {"escape_reasons", "escapes", "reason"}:
+                        for c in strings_in(n.value):
+                            note(c.value, view.rel, c.lineno)
+                # {i: "reason" ...} dict-comps (failover bulk escapes)
+                elif isinstance(n, ast.DictComp):
+                    for c in strings_in(n.value):
+                        note(c.value, view.rel, c.lineno)
+        return found
+
+    def _readme_taxonomy(self, ctx: LintContext):
+        """(tokens, rows): all backticked identifier tokens inside the
+        taxonomy sections, plus the escape-table `Plugin/reason` rows."""
+        if not ctx.readme.is_file():
+            return None
+        text = ctx.readme.read_text()
+        tokens: set[str] = set()
+        rows: list[tuple[str, str, int]] = []
+        in_section = False
+        for i, ln in enumerate(text.splitlines(), start=1):
+            if ln.startswith(("#", "##")) and ln.lstrip("#").strip():
+                in_section = ln.strip() in self.SECTIONS
+                continue
+            if not in_section:
+                continue
+            m = _ROW_RE.match(ln)
+            if m:
+                rows.append((m.group(1), m.group(2), i))
+                tokens.update(m.groups())
+            for tok in _IDENT_RE.findall(ln):
+                tokens.add(tok)
+        return tokens, rows
+
+    def check_project(self, ctx: LintContext):
+        taxonomy = self._readme_taxonomy(ctx)
+        if taxonomy is None:
+            return
+        tokens, rows = taxonomy
+        code = self._collect_code(ctx)
+        rel_readme = ctx.readme.name if ctx.readme.parent == ctx.repo_root \
+            else str(ctx.readme)
+        # code -> README: every emitted reason/plugin literal documented
+        for s, (rel, line) in sorted(code.items()):
+            if s not in tokens:
+                yield Finding(self.name, rel, line,
+                              f"reason {s!r} emitted here is missing from "
+                              "the README taxonomy tables")
+        # README -> code: every escape-table row's plugin and reason
+        # still exist at an emit site
+        for plugin, reason, line in rows:
+            if plugin not in code:
+                yield Finding(self.name, rel_readme, line,
+                              f"README names plugin {plugin!r} with no "
+                              "matching emit site in code")
+            if reason not in code:
+                yield Finding(self.name, rel_readme, line,
+                              f"README names reason {reason!r} with no "
+                              "matching emit site in code")

@@ -1,0 +1,139 @@
+"""Lock-discipline rule (new in this PR): `# guarded-by:` annotations
+make the lock protocol of the GIL-threaded control plane checkable.
+
+Declaring `self._active = {}  # guarded-by: _lock|_cond` in __init__
+obliges every OTHER mutation site of self._active in the class to be
+(a) inside `with self.<lock>:` for one of the named locks, or (b) in a
+method whose name ends `_locked` (the codebase's called-with-lock-held
+convention).  tools.ktpulint.sanitizers adds the matching runtime check
+(lock-order graph) for threaded suites.
+
+Reference: Go's -race + staticcheck lock annotations; the protocol
+itself comes from this repo's queue.py/_cond and informer.py
+`_dispatch_lock -> _lock` ordering docs.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import FileView, LintContext, Rule, enclosing_withs, register
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w|]+)")
+
+# method calls that mutate the receiver in place
+_MUTATORS = {"append", "appendleft", "add", "remove", "discard", "pop",
+             "popleft", "popitem", "clear", "update", "extend", "insert",
+             "setdefault", "sort", "reverse"}
+
+
+def _guard_decls(view: FileView, cls: ast.ClassDef) -> dict[str, set[str]]:
+    """attr -> lock names, from `self.X = ...  # guarded-by: L[|L2]`
+    annotations (same line or the line above) anywhere in the class."""
+    decls: dict[str, set[str]] = {}
+    for n in ast.walk(cls):
+        if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+        for t in targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                for ln in (n.lineno, n.lineno - 1):
+                    if not (1 <= ln <= len(view.lines)):
+                        continue
+                    m = _GUARDED_RE.search(view.lines[ln - 1])
+                    if m:
+                        decls.setdefault(t.attr, set()).update(
+                            m.group(1).split("|"))
+                        break
+    return decls
+
+
+def _mutated_attr(node: ast.AST) -> tuple[str, int] | None:
+    """(attr, line) when `node` mutates some self.<attr> in place."""
+
+    def self_attr(e: ast.AST) -> str | None:
+        if (isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name)
+                and e.value.id == "self"):
+            return e.attr
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            attr = self_attr(base)
+            if attr:
+                return attr, node.lineno
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            attr = self_attr(base)
+            if attr:
+                return attr, node.lineno
+    elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS):
+        attr = self_attr(node.func.value)
+        if attr:
+            return attr, node.lineno
+    return None
+
+
+def _held_locks(fn: ast.AST, site: ast.AST) -> set[str]:
+    """Lock names held at `site` via enclosing `with self.<lock>:`."""
+    held: set[str] = set()
+    for w in enclosing_withs(fn, site):
+        for item in w.items:
+            e = item.context_expr
+            # with self._lock:  /  with self._cond:
+            if (isinstance(e, ast.Attribute)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self"):
+                held.add(e.attr)
+    return held
+
+
+@register
+class LockDisciplineRule(Rule):
+    """Every mutation of a `# guarded-by:`-declared attribute happens
+    under one of its named locks — a mutation outside the lock is a data
+    race the GIL merely makes rare, not impossible (informer dispatch,
+    queue shed, and metrics threads all interleave at bytecode
+    boundaries)."""
+
+    name = "lock-discipline"
+    doc = "guarded-by-declared attributes only mutate under their lock"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if "guarded-by" not in view.text or view.tree is None:
+            return
+        for cls in ast.walk(view.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            decls = _guard_decls(view, cls)
+            if not decls:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if fn.name == "__init__" or fn.name.endswith("_locked"):
+                    # construction precedes sharing; *_locked methods are
+                    # called with the lock already held by convention
+                    continue
+                for n in ast.walk(fn):
+                    hit = _mutated_attr(n)
+                    if hit is None or hit[0] not in decls:
+                        continue
+                    attr, line = hit
+                    if view.line_has_annotation(line, "guarded-by"):
+                        continue  # explicit per-site waiver/re-declaration
+                    if _held_locks(fn, n) & decls[attr]:
+                        continue
+                    locks = "|".join(sorted(decls[attr]))
+                    yield self.finding(
+                        view, line,
+                        f"{cls.name}.{fn.name} mutates self.{attr} outside "
+                        f"its declared lock ({locks})")

@@ -1,0 +1,212 @@
+"""Project-wiring rules migrated from tests/test_verify_static.py: the
+importability / citation / registry-consistency battery (the reference's
+hack/verify-* + test/typecheck gates).
+
+Reference: hack/verify-golint.sh, hack/verify-typecheck.sh — build-time
+gates that fail the tree, not a test suite.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import pathlib
+import pkgutil
+import sys
+
+from ..engine import Finding, LintContext, Rule, register
+
+CITATION_TOKENS = ("pkg/", "staging/", "cmd/", "test/", "build/", "hack/",
+                   "component-base", "k8s.io/", "scheduler-plugins",
+                   "BASELINE", "SURVEY")
+
+
+def _walk_modules(ctx: LintContext, include_packages: bool = True):
+    root = str(ctx.package_root)
+    if str(ctx.repo_root) not in sys.path:
+        sys.path.insert(0, str(ctx.repo_root))
+    for mod in pkgutil.walk_packages([root], prefix=ctx.package_name + "."):
+        if mod.ispkg and not include_packages:
+            continue
+        yield mod.name
+
+
+@register
+class ModuleImportsRule(Rule):
+    """Every module under the package imports cleanly — a module that
+    raises at import time is dead weight the test collector may or may
+    not trip over depending on ordering."""
+
+    name = "module-imports"
+    scope = "project"
+    doc = "every package module imports without raising"
+
+    def check_project(self, ctx: LintContext):
+        for name in _walk_modules(ctx):
+            try:
+                importlib.import_module(name)
+            except Exception as e:  # noqa: BLE001 — any failure is the finding
+                yield Finding(self.name, "", 0,
+                              f"module {name} failed to import: {e!r}")
+
+
+@register
+class ReferenceCitationRule(Rule):
+    """Parity auditability: each subsystem module names the reference
+    file it mirrors (pkg/..., staging/..., cmd/...) in its docstring."""
+
+    name = "reference-citation"
+    scope = "project"
+    doc = "package modules cite the reference file they mirror"
+
+    def check_project(self, ctx: LintContext):
+        for path in sorted(ctx.package_root.rglob("*.py")):
+            rel = path.relative_to(ctx.repo_root).as_posix()
+            if "__pycache__" in path.parts or "/testing/" in rel:
+                continue
+            if path.name == "__init__.py":
+                continue
+            try:
+                doc = ast.get_docstring(ast.parse(path.read_text())) or ""
+            except SyntaxError:
+                continue  # module-imports owns unparsable files
+            if not any(tok in doc for tok in CITATION_TOKENS):
+                yield Finding(self.name, rel, 1,
+                              "module docstring cites no reference file "
+                              "(pkg/..., staging/..., cmd/...)")
+
+
+@register
+class ClusterScopedShareRule(Rule):
+    """The apiserver routing and HTTP client must key off the SAME
+    cluster-scoped set (or writes route to the wrong key) — both derive
+    from clientset.CLUSTER_SCOPED_RESOURCES; a fork sneaking back in is
+    the failure this rule pins."""
+
+    name = "cluster-scoped-share"
+    scope = "project"
+    doc = "apiserver/client share one CLUSTER_SCOPED set object"
+
+    def check_project(self, ctx: LintContext):
+        import inspect
+
+        if str(ctx.repo_root) not in sys.path:
+            sys.path.insert(0, str(ctx.repo_root))
+        try:
+            server = importlib.import_module(
+                f"{ctx.package_name}.apiserver.server")
+            clientset = importlib.import_module(
+                f"{ctx.package_name}.client.clientset")
+            http_client = importlib.import_module(
+                f"{ctx.package_name}.client.http_client")
+        except ImportError:
+            return  # module-imports owns missing modules
+        shared = clientset.CLUSTER_SCOPED_RESOURCES
+        if server.CLUSTER_SCOPED is not shared:
+            yield Finding(self.name, "", 0,
+                          "apiserver.server.CLUSTER_SCOPED is a fork, not "
+                          "an alias of clientset.CLUSTER_SCOPED_RESOURCES")
+        default = inspect.signature(
+            http_client.HTTPClient.__init__).parameters[
+                "cluster_scoped"].default
+        if default is not shared:
+            yield Finding(self.name, "", 0,
+                          "HTTPClient cluster_scoped default is not the "
+                          "shared CLUSTER_SCOPED_RESOURCES object")
+
+
+@register
+class PauseIndependenceRule(Rule):
+    """Copy-guard for the one file COPYCHECK flagged in round 1: our
+    pause init (native/pause/pause.c) must stay an independent design
+    (synchronous signal draining), not a lightly-disguised copy of the
+    reference's handler-based build/pause/linux/pause.c."""
+
+    name = "pause-independence"
+    scope = "project"
+    doc = "native/pause stays an independent design, not a copy"
+
+    REF_IDIOMS = ("shutting down, got signal",
+                  "pause should be the first process",
+                  "infinite loop terminated",
+                  "return 42")
+
+    def check_project(self, ctx: LintContext):
+        path = ctx.native_dir / "pause" / "pause.c"
+        if not path.is_file():
+            return
+        src = path.read_text()
+        rel = path.relative_to(ctx.repo_root).as_posix() \
+            if ctx.repo_root in path.parents else str(path)
+        if "sigwaitinfo" not in src:
+            yield Finding(self.name, rel, 1,
+                          "pause.c lost its synchronous sigwaitinfo design")
+        for tok in ("sa_handler", "sigaction"):
+            if tok in src:
+                yield Finding(self.name, rel, 1,
+                              f"pause.c uses the reference's async-handler "
+                              f"idiom ({tok})")
+        for idiom in self.REF_IDIOMS:
+            if idiom.lower() in src.lower():
+                yield Finding(self.name, rel, 1,
+                              f"pause.c contains reference idiom {idiom!r}")
+        ref_path = pathlib.Path("/root/reference/build/pause/linux/pause.c")
+        if ref_path.exists():
+            norm = lambda text: {  # noqa: E731
+                ln.strip() for ln in text.splitlines()
+                if len(ln.strip()) > 10
+                and not ln.strip().startswith(("#", "/*", "*"))}
+            shared = norm(src) & norm(ref_path.read_text())
+            if len(shared) > 2:
+                yield Finding(self.name, rel, 1,
+                              f"too much line overlap with the reference "
+                              f"pause.c: {sorted(shared)[:4]}")
+
+
+@register
+class ControllerRegistryRule(Rule):
+    """Every controller module's Controller subclass is constructible
+    from the manager's registry — a new controller that isn't wired in
+    is dead code."""
+
+    name = "controller-registry"
+    scope = "project"
+    doc = "every Controller subclass is wired into a manager registry"
+
+    def check_project(self, ctx: LintContext):
+        import inspect
+
+        if str(ctx.repo_root) not in sys.path:
+            sys.path.insert(0, str(ctx.repo_root))
+        try:
+            base = importlib.import_module(
+                f"{ctx.package_name}.controllers.base")
+            manager = importlib.import_module(
+                f"{ctx.package_name}.controllers.manager")
+        except ImportError:
+            return
+        Controller = base.Controller
+        wired = set(manager.ControllerManager.CTORS.values())
+        # EndpointsController predates the manager and is wired directly
+        # by cmd/cluster + cmd/controller_manager
+        endpoints = importlib.import_module(
+            f"{ctx.package_name}.controllers.endpoints")
+        wired.add(endpoints.EndpointsController)
+        # cloud controllers run under their OWN manager (a separate
+        # binary in the reference: cmd/cloud-controller-manager)
+        cloud = importlib.import_module(f"{ctx.package_name}.controllers.cloud")
+        wired.update({cloud.CloudServiceController,
+                      cloud.CloudRouteController,
+                      cloud.CloudNodeController})
+        for name in _walk_modules(ctx):
+            if not name.startswith(f"{ctx.package_name}.controllers."):
+                continue
+            mod = importlib.import_module(name)
+            for _, cls in inspect.getmembers(mod, inspect.isclass):
+                if (issubclass(cls, Controller) and cls is not Controller
+                        and cls.__module__ == name
+                        and cls.name != "controller"
+                        and cls not in wired):
+                    yield Finding(self.name, "", 0,
+                                  f"controller {name}.{cls.__name__} is not "
+                                  "registered in any manager")

@@ -1,0 +1,166 @@
+"""Runtime sanitizers: the dynamic half of ktpu-lint.
+
+Three guards, mirroring the static rules in rules/device.py and
+rules/threads.py:
+
+* transfer_guard(): jax.transfer_guard_device_to_host("disallow") for
+  the scope — any implicit device->host pull raises.  Explicit
+  jax.device_get (the idiom the device-sync rule pushes annotated
+  sync-points toward) stays allowed.  NOTE: on the CPU test platform
+  device arrays are host-resident and zero-copy, so the guard engages
+  but implicit pulls cannot trip it; on a real TPU the same wiring is
+  load-bearing.  Tests therefore assert the guard ENGAGES and the
+  device path runs clean under it, which is exactly the property that
+  transfers teeth to TPU CI.
+
+* CompileCounter: counts XLA compiles via jax's own compile logging —
+  the per-wave-recompile detector (recompile-hazard's runtime twin).
+  Warmup waves compile; steady-state waves must not.
+
+* LockOrderChecker / OrderedLock: wrap threading locks to record the
+  acquisition-order graph per thread; a cycle (A->B and B->A) is a
+  latent deadlock even if the schedule never interleaved it in this
+  run.  Verifies informer's documented `_dispatch_lock -> _lock, never
+  the reverse` contract.
+
+Reference: JAX transfer-guard docs + jax_log_compiles; Go's -race
+acquisition-order heuristic for the lock checker.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+
+# loggers that emit "Compiling <fn> ..." when jax_log_compiles is on
+_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+
+
+class CompileCounter(logging.Handler):
+    """Counts XLA compilations inside the context.
+
+        with CompileCounter() as cc:
+            run_wave(...)
+        assert cc.count == 0, cc.messages
+    """
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.count = 0
+        self.messages: list[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = record.getMessage()
+        if msg.startswith("Compiling "):
+            self.count += 1
+            self.messages.append(msg.split("\n", 1)[0])
+
+    def __enter__(self) -> "CompileCounter":
+        import jax
+
+        self._prev = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+        self._loggers = []
+        for name in _COMPILE_LOGGERS:
+            lg = logging.getLogger(name)
+            self._loggers.append((lg, lg.level))
+            if lg.level > logging.DEBUG or lg.level == logging.NOTSET:
+                lg.setLevel(logging.DEBUG)
+            lg.addHandler(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import jax
+
+        for lg, level in self._loggers:
+            lg.removeHandler(self)
+            lg.setLevel(level)
+        jax.config.update("jax_log_compiles", self._prev)
+
+
+@contextlib.contextmanager
+def transfer_guard(level: str = "disallow"):
+    """Disallow implicit device->host transfers for the scope."""
+    import jax
+
+    with jax.transfer_guard_device_to_host(level):
+        yield
+
+
+class OrderedLock:
+    """Proxy around a Lock/RLock that reports acquisitions to a
+    LockOrderChecker.  Context-manager and acquire/release compatible,
+    so it can be swapped into an object's lock attributes."""
+
+    def __init__(self, name: str, inner, checker: "LockOrderChecker"):
+        self.name = name
+        self._inner = inner
+        self._checker = checker
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._checker._note_acquire(self.name)
+        return got
+
+    def release(self) -> None:
+        self._checker._note_release(self.name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class LockOrderChecker:
+    """Builds the held->acquired edge graph across all threads.
+
+        checker = LockOrderChecker()
+        obj._lock = checker.wrap("_lock", obj._lock)
+        obj._dispatch_lock = checker.wrap("_dispatch_lock", obj._dispatch_lock)
+        ... run threaded workload ...
+        assert not checker.violations()
+    """
+
+    def __init__(self) -> None:
+        self._tls = threading.local()
+        self._graph_lock = threading.Lock()
+        # (outer, inner) -> first observed, with edge de-dup
+        self.edges: set[tuple[str, str]] = set()
+
+    def wrap(self, name: str, lock) -> OrderedLock:
+        return OrderedLock(name, lock, self)
+
+    def _stack(self) -> list[str]:
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        return self._tls.stack
+
+    def _note_acquire(self, name: str) -> None:
+        stack = self._stack()
+        new_edges = {(held, name) for held in stack
+                     if held != name}  # re-entrant self-acquire is not an edge
+        if new_edges - self.edges:
+            with self._graph_lock:
+                self.edges |= new_edges
+        stack.append(name)
+
+    def _note_release(self, name: str) -> None:
+        stack = self._stack()
+        if name in stack:
+            # remove the innermost occurrence (re-entrant locks release LIFO)
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i] == name:
+                    del stack[i]
+                    break
+
+    def violations(self) -> list[tuple[str, str]]:
+        """Edge pairs observed in BOTH directions — each is a latent
+        ABBA deadlock regardless of whether this run interleaved it."""
+        with self._graph_lock:
+            return sorted({(a, b) for (a, b) in self.edges
+                           if (b, a) in self.edges and a < b})
