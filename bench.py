@@ -535,6 +535,108 @@ def run_overload() -> dict:
     return out
 
 
+def run_scaleout(max_instances: int) -> dict:
+    """--instances N: horizontal scale-out A/B.  1, 2, ... N cooperating
+    scheduler instances (each with its own informers, cache, queue and
+    device backend) share ONE MemoryStore — the Omega shared-state shape
+    — and drain the Scheduling100k-scale flood together.  Instances >1
+    partition nodes AND pods over the scaleOut node-pool ring, so the
+    steady-state conflict rate should be ~0; every optimistic-bind loss
+    that does happen is counted via scheduler_bind_conflict_total and
+    reported as conflict_rate (conflicted pod-events / pods).
+
+    In-process by design (same trade as --trace/--overload): N
+    interpreters would each pay the device warmup, and the instances
+    must share a store object.  Shrink with BENCH_SCALEOUT_NODES/PODS
+    for smoke runs."""
+    from kubernetes_tpu.client.clientset import NODES, PODS, LocalClient
+    from kubernetes_tpu.perf import caps_for_nodes
+    from kubernetes_tpu.perf.scheduler_perf import (
+        ThroughputCollector, setup_cluster,
+    )
+    from kubernetes_tpu.scheduler.config import ScaleOutPolicy
+    from kubernetes_tpu.store import kv
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    nodes = int(os.environ.get("BENCH_SCALEOUT_NODES", "100000"))
+    pods = int(os.environ.get("BENCH_SCALEOUT_PODS", "200000"))
+    batch = int(os.environ.get("BENCH_SCALEOUT_BATCH", "16384"))
+    timeout = float(os.environ.get("BENCH_SCALEOUT_TIMEOUT", "1200"))
+
+    def one_pass(n: int) -> dict:
+        store = kv.MemoryStore(history=2_000_000)
+        admin = LocalClient(store)
+        # each instance tracks only ~1/n of the ring, so its backend's
+        # node capacity shrinks with n (1.6/n covers crc32 slice skew)
+        caps = caps_for_nodes(
+            nodes if n == 1 else min(nodes, int(nodes * 1.6 / n) + 256))
+        clusters = []
+        for i in range(n):
+            cl = setup_cluster(tpu=True, caps=caps, batch_size=batch,
+                               store=store, pipeline_depth=2)
+            if n > 1:
+                cl.scheduler.configure_scaleout(ScaleOutPolicy(
+                    instance_count=n, instance_index=i,
+                    ring_slices=max(64, 16 * n)))
+            clusters.append(cl)
+        try:
+            CHUNK = 10_000
+            for lo in range(0, nodes, CHUNK):
+                admin.create_bulk(NODES, [
+                    make_node(f"sn-{i}")
+                    .capacity(cpu="64", mem="256Gi", pods=1000).build()
+                    for i in range(lo, min(lo + CHUNK, nodes))])
+            # let every instance fold its node partition into its host
+            # tensors before the flood (same reason as the idle prefetch)
+            time.sleep(1.0 + nodes / 50_000)
+            collector = ThroughputCollector(store)
+            collector.start()
+            t0 = time.monotonic()
+            for lo in range(0, pods, CHUNK):
+                admin.create_bulk(PODS, [
+                    make_pod(f"sp-{i}").req(cpu="10m", mem="16Mi").build()
+                    for i in range(lo, min(lo + CHUNK, pods))])
+            ok = False
+            while time.monotonic() - t0 < timeout:
+                if collector.bound_total() >= pods:
+                    ok = True
+                    break
+                time.sleep(0.25)
+            elapsed = time.monotonic() - t0
+            collector.stop()
+            conflicts: dict[str, float] = {}
+            for cl in clusters:
+                vals = cl.scheduler.metrics.prom.bind_conflict_total.values()
+                for labels, v in vals.items():
+                    conflicts[labels[0]] = conflicts.get(labels[0], 0.0) + v
+            row = {"pods_per_s": round(pods / elapsed, 1) if ok else 0.0,
+                   "wall_s": round(elapsed, 1),
+                   "bound": collector.bound_total(),
+                   "conflicts": {k: int(v) for k, v in
+                                 sorted(conflicts.items())},
+                   "conflict_rate": round(
+                       sum(conflicts.values()) / max(pods, 1), 6)}
+            if not ok:
+                row["error"] = "pods left unscheduled"
+            return row
+        finally:
+            for cl in clusters:
+                cl.shutdown()
+
+    counts = [c for c in (1, 2, 4) if c <= max_instances]
+    if max_instances not in counts:
+        counts.append(max_instances)
+    instances: dict[str, dict] = {}
+    for n in counts:
+        instances[str(n)] = one_pass(n)
+    base = instances.get("1", {}).get("pods_per_s") or 0.0
+    for row in instances.values():
+        if base and row.get("pods_per_s"):
+            row["speedup_vs_1"] = round(row["pods_per_s"] / base, 2)
+    return {"nodes": nodes, "pods": pods, "batch": batch,
+            "BENCH_SCALEOUT": instances}
+
+
 def run_once(workload: str, nodes: int | None, pods: int | None,
              batch: int, barrier_timeout: float = 900.0,
              rate: float | None = None, depth: int = 1,
@@ -729,6 +831,15 @@ def main() -> None:
         # polluted by a second cold start
         res = run_overload()
         emit(res["with_policy"]["pods_per_s"], {"mode": "overload", **res})
+        return
+    if "--instances" in sys.argv:
+        idx = sys.argv.index("--instances")
+        n = (int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1
+             and sys.argv[idx + 1].isdigit() else 2)
+        res = run_scaleout(n)
+        best = max((row.get("pods_per_s") or 0.0)
+                   for row in res["BENCH_SCALEOUT"].values())
+        emit(best, {"mode": "scaleout", **res})
         return
     if not _device_reachable():
         # The chip tunnel is down — but null-device configs measure the

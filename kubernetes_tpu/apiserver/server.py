@@ -106,6 +106,18 @@ def status_error(code: int, reason: str, message: str) -> dict:
             "reason": reason, "message": message, "code": code}
 
 
+def bind_conflict_status(err) -> dict:
+    """409 Status for kv.BindConflict with the structured fields in
+    `details`, so an HTTP scheduler rehydrates the same typed error a
+    LocalClient one sees (the already_bound_same_node classification
+    needs current_node, not message parsing)."""
+    status = status_error(409, "BindConflict", str(err))
+    status["details"] = {"name": err.key,
+                         "currentNode": err.current_node,
+                         "wantedNode": err.wanted_node}
+    return status
+
+
 class _QuietTLSServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that doesn't spray tracebacks when a TLS
     handshake fails (wrong client CA, plain-HTTP probe, port scan) —
@@ -1414,13 +1426,22 @@ class APIServer:
                             "each binding needs metadata.name and "
                             "target.name"))
                         return
-                    triples.append((md.get("namespace") or r.ns
-                                    or "default", md["name"], node))
+                    entry = (md.get("namespace") or r.ns
+                             or "default", md["name"], node)
+                    if md.get("resourceVersion") is not None:
+                        # compare-and-bind precondition (scale-out
+                        # schedulers): bind only if the pod hasn't moved
+                        entry += (md["resourceVersion"],)
+                    triples.append(entry)
                 results = server.store.bind_many("pods", triples)
                 out = []
                 for _obj, err in results:
                     if err is None:
                         out.append({"kind": "Status", "status": "Success"})
+                    elif isinstance(err, kv.BindConflict):
+                        # distinct reason so HTTP schedulers can classify
+                        # lost-the-optimistic-race without string parsing
+                        out.append(bind_conflict_status(err))
                     elif isinstance(err, kv.ConflictError):
                         out.append(status_error(409, "Conflict", str(err)))
                     elif isinstance(err, kv.NotFoundError):
@@ -1529,6 +1550,8 @@ class APIServer:
                 BindingREST): writes spec.nodeName once."""
                 node = ((binding.get("target") or {}).get("name")
                         or binding.get("nodeName"))
+                expect_rv = (binding.get("metadata")
+                             or {}).get("resourceVersion")
                 if not node:
                     self._send_json(400, status_error(
                         400, "BadRequest", "binding needs target.name"))
@@ -1536,9 +1559,20 @@ class APIServer:
                 try:
                     def bind(pod):
                         if meta.pod_node_name(pod):
-                            raise kv.ConflictError(
+                            cur_node = meta.pod_node_name(pod)
+                            raise kv.BindConflict(
                                 "pod %s is already assigned to node %s"
-                                % (r.name, meta.pod_node_name(pod)))
+                                % (r.name, cur_node),
+                                key=r.name, current_node=cur_node,
+                                wanted_node=node)
+                        if expect_rv is not None and \
+                                (pod.get("metadata") or {}).get(
+                                    "resourceVersion") != expect_rv:
+                            raise kv.BindConflict(
+                                "pod %s moved past resourceVersion %r"
+                                % (r.name, expect_rv),
+                                key=r.name, current_node=None,
+                                wanted_node=node)
                         pod.setdefault("spec", {})["nodeName"] = node
                         return pod
                     server.store.guaranteed_update(
@@ -1547,6 +1581,8 @@ class APIServer:
                     self._send_json(201, {"kind": "Status", "status": "Success"})
                 except kv.NotFoundError as e:
                     self._send_json(404, status_error(404, "NotFound", str(e)))
+                except kv.BindConflict as e:
+                    self._send_json(409, bind_conflict_status(e))
                 except kv.ConflictError as e:
                     self._send_json(409, status_error(409, "Conflict", str(e)))
 

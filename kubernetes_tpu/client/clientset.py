@@ -96,19 +96,33 @@ class Client:
 
     # -- conveniences used across the tree --------------------------------
 
-    def bind(self, pod: Obj, node_name: str) -> Obj:
+    def bind(self, pod: Obj, node_name: str,
+             expect_rv: int | None = None) -> Obj:
         """POST pods/{name}/binding equivalent: set spec.nodeName atomically.
 
         Reference: pkg/registry/core/pod/storage BindingREST — fails if the
         pod is already bound (the scheduler relies on this for correctness
-        under races).
+        under races).  With N scheduler instances committing optimistically
+        against one store, losing that race raises a structured BindConflict
+        (kv.py) naming the current owner; the optional expect_rv tightens
+        the precondition to compare-and-bind on the pod's resourceVersion.
         """
         ns, nm = meta.namespace(pod), meta.name(pod)
 
         def apply(cur: Obj) -> Obj:
             if cur["spec"].get("nodeName"):
-                raise kv.ConflictError(
-                    f"pod {ns}/{nm} is already bound to {cur['spec']['nodeName']!r}")
+                bound_to = cur["spec"]["nodeName"]
+                raise kv.BindConflict(
+                    f"pod {ns}/{nm} is already bound to {bound_to!r}",
+                    key=f"{ns}/{nm}" if ns else nm,
+                    current_node=bound_to, wanted_node=node_name)
+            if expect_rv is not None and \
+                    cur["metadata"].get("resourceVersion") != expect_rv:
+                raise kv.BindConflict(
+                    f"pod {ns}/{nm} moved past resourceVersion "
+                    f"{expect_rv!r}",
+                    key=f"{ns}/{nm}" if ns else nm,
+                    current_node=None, wanted_node=node_name)
             cur["spec"]["nodeName"] = node_name
             conds = cur.setdefault("status", {}).setdefault("conditions", [])
             conds.append({"type": "PodScheduled", "status": "True"})
@@ -116,16 +130,20 @@ class Client:
 
         return self.guaranteed_update(PODS, ns, nm, apply)
 
-    def bind_many(self, bindings: list[tuple[str, str, str]]
+    def bind_many(self, bindings: list[tuple]
                   ) -> list[tuple[Obj | None, Exception | None]]:
-        """Bulk bind: (namespace, name, node_name) triples, per-entry
-        results.  Generic clients fall back to per-pod bind(); LocalClient
-        uses the store's transactional multi-bind."""
+        """Bulk bind: (namespace, name, node_name[, expect_rv]) entries,
+        per-entry results.  Generic clients fall back to per-pod bind();
+        LocalClient uses the store's transactional multi-bind.  Entries that
+        lose the optimistic bind race come back as kv.BindConflict."""
         out: list[tuple[Obj | None, Exception | None]] = []
-        for ns, nm, node in bindings:
+        for entry in bindings:
+            ns, nm, node = entry[0], entry[1], entry[2]
+            expect_rv = entry[3] if len(entry) > 3 else None
             try:
                 out.append((self.bind({"metadata": {"namespace": ns,
-                                                    "name": nm}}, node), None))
+                                                    "name": nm}}, node,
+                                      expect_rv=expect_rv), None))
             except Exception as e:
                 # per-entry, and not just StoreError: one pod's transport
                 # blip must not abort the rest of the batch — the caller

@@ -26,10 +26,24 @@ class HTTPError(kv.StoreError):
         super().__init__(f"HTTP {code}: {message}")
 
 
+def _bind_conflict_from(body: dict) -> kv.BindConflict:
+    """Rehydrate the typed conflict from a 409 Status: the structured
+    fields ride the `details` block (apiserver bind_conflict_status) so
+    an HTTP scheduler classifies already_bound_same_node/lost_to_peer
+    exactly like a LocalClient one."""
+    d = body.get("details") or {}
+    return kv.BindConflict(body.get("message", ""),
+                           key=d.get("name") or "",
+                           current_node=d.get("currentNode"),
+                           wanted_node=d.get("wantedNode"))
+
+
 def _raise_for(code: int, body: dict) -> None:
     msg = body.get("message", "")
     if body.get("reason") == "AlreadyExists":
         raise kv.AlreadyExistsError(msg)
+    if body.get("reason") == "BindConflict":
+        raise _bind_conflict_from(body)
     err = _ERRORS.get(code)
     if err is not None:
         raise err(msg)
@@ -455,27 +469,39 @@ class HTTPClient(Client):
         return self._request("PATCH", path, obj,
                              content_type="application/apply-patch+yaml")
 
-    def bind(self, pod: Obj, node_name: str) -> Obj:
-        """POST pods/{name}/binding (DefaultBinder's write)."""
+    def bind(self, pod: Obj, node_name: str,
+             expect_rv: int | None = None) -> Obj:
+        """POST pods/{name}/binding (DefaultBinder's write).  expect_rv
+        rides metadata.resourceVersion as the compare-and-bind
+        precondition (scale-out schedulers)."""
         path = self._path("pods", meta.namespace(pod), meta.name(pod)) + "/binding"
+        md: Obj = {"name": meta.name(pod)}
+        if expect_rv is not None:
+            md["resourceVersion"] = expect_rv
         return self._request("POST", path, {
             "kind": "Binding", "apiVersion": "v1",
-            "metadata": {"name": meta.name(pod)},
+            "metadata": md,
             "target": {"kind": "Node", "name": node_name}})
 
-    _BULK_ERRORS = {"Conflict": kv.ConflictError,
+    _BULK_ERRORS = {"BindConflict": kv.BindConflict,
+                    "Conflict": kv.ConflictError,
                     "NotFound": kv.NotFoundError,
                     "AlreadyExists": kv.AlreadyExistsError}
 
-    def bind_many(self, bindings: list[tuple[str, str, str]]
+    def bind_many(self, bindings: list[tuple]
                   ) -> list[tuple[Obj | None, Exception | None]]:
         """Bulk bind through ONE request: POST a BindingList to the
         bindings collection (server: _post_bindings -> kv.bind_many).
         Per-pod fallback when the server predates the bulk verb."""
-        body = {"kind": "BindingList", "apiVersion": "v1", "items": [
-            {"metadata": {"namespace": ns, "name": nm},
-             "target": {"kind": "Node", "name": node}}
-            for ns, nm, node in bindings]}
+        items = []
+        for entry in bindings:
+            ns, nm, node = entry[0], entry[1], entry[2]
+            md = {"namespace": ns, "name": nm}
+            if len(entry) > 3 and entry[3] is not None:
+                md["resourceVersion"] = entry[3]
+            items.append({"metadata": md,
+                          "target": {"kind": "Node", "name": node}})
+        body = {"kind": "BindingList", "apiVersion": "v1", "items": items}
         try:
             resp = self._request("POST", "/api/v1/bindings", body)
         except kv.NotFoundError:
@@ -486,6 +512,8 @@ class HTTPClient(Client):
         for item in resp.get("items") or ():
             if item.get("status") == "Success":
                 out.append(({}, None))
+            elif item.get("reason") == "BindConflict":
+                out.append((None, _bind_conflict_from(item)))
             else:
                 err = self._BULK_ERRORS.get(item.get("reason"), HTTPError)
                 msg = item.get("message", "")

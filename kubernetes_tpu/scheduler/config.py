@@ -42,6 +42,11 @@ list + the enabled/disabled merge rules).  Shape accepted (YAML or dict):
     sloP99Ms: 250
     escapeRateThreshold: 0.5
     waveDeadlineSeconds: 30
+  scaleOut:                   # N cooperating instances over one store
+    instanceCount: 4          #  (Omega-style optimistic binding; see
+    instanceIndex: 1          #  ScaleOutPolicy / scheduler/scaleout.py)
+    partitionBy: nodePoolRing # or namespaceHash
+    leaseDurationSeconds: 2
 
 Merge semantics (default_plugins.go mergePlugins):
   1. start from the default MultiPoint list;
@@ -301,6 +306,71 @@ def _parse_overload(data: dict) -> OverloadPolicy:
 
 
 @dataclass
+class ScaleOutPolicy:
+    """Horizontal scale-out: this process is instance `instance_index` of
+    `instance_count` cooperating schedulers sharing one store.
+
+    Configured via the `scaleOut:` stanza; instance_count=1 (the default)
+    disables the whole layer.  The cluster is partitioned with a
+    node-pool ring (scheduler/scaleout.py): node and pod keys hash onto
+    `ring_slices` virtual slices, and live instances own slices
+    round-robin — when an instance's lease lapses, survivors recompute
+    the same map and absorb its slices with no coordination.
+    partition_by="namespaceHash" is the fallback for clusters whose node
+    names hash unevenly: pods partition by namespace and every instance
+    sees all nodes.  Binding stays optimistic either way: ownership only
+    reduces contention, the compare-and-bind precondition (kv.bind_many)
+    is what prevents double-binds during churn windows."""
+
+    instance_count: int = 1             # 1 = scale-out layer off
+    instance_index: int = 0             # this process's identity
+    partition_by: str = "nodePoolRing"  # or "namespaceHash"
+    ring_slices: int = 64               # virtual slices on the ring
+    lease_duration: float = 2.0         # unrenewed this long = dead
+    renew_interval: float = 0.5         # lease heartbeat period
+
+    @property
+    def enabled(self) -> bool:
+        return self.instance_count > 1
+
+
+# scaleOut YAML key -> ScaleOutPolicy field
+_SCALEOUT_FIELDS = {
+    "instanceCount": "instance_count",
+    "instanceIndex": "instance_index",
+    "partitionBy": "partition_by",
+    "ringSlices": "ring_slices",
+    "leaseDurationSeconds": "lease_duration",
+    "renewIntervalSeconds": "renew_interval",
+}
+
+
+def _parse_scaleout(data: dict) -> ScaleOutPolicy:
+    kwargs = {}
+    for key, value in (data or {}).items():
+        if key not in _SCALEOUT_FIELDS:
+            raise ConfigError(f"unknown scaleOut key {key!r}")
+        kwargs[_SCALEOUT_FIELDS[key]] = value
+    policy = ScaleOutPolicy(**kwargs)
+    if policy.instance_count < 1:
+        raise ConfigError("scaleOut instanceCount must be >= 1")
+    if not 0 <= policy.instance_index < policy.instance_count:
+        raise ConfigError(
+            "scaleOut instanceIndex must be in [0, instanceCount)")
+    if policy.partition_by not in ("nodePoolRing", "namespaceHash"):
+        raise ConfigError(
+            "scaleOut partitionBy must be nodePoolRing or namespaceHash")
+    if policy.ring_slices < policy.instance_count:
+        raise ConfigError("scaleOut ringSlices must be >= instanceCount")
+    if policy.lease_duration <= 0:
+        raise ConfigError("scaleOut leaseDurationSeconds must be positive")
+    if not 0 < policy.renew_interval < policy.lease_duration:
+        raise ConfigError("scaleOut renewIntervalSeconds must be in "
+                          "(0, leaseDurationSeconds)")
+    return policy
+
+
+@dataclass
 class SchedulerConfig:
     parallelism: int = 16
     percentage_of_nodes_to_score: int = 0
@@ -311,6 +381,7 @@ class SchedulerConfig:
     remote_seam: RemoteSeamPolicy = field(default_factory=RemoteSeamPolicy)
     tracing: TracingPolicy = field(default_factory=TracingPolicy)
     overload: OverloadPolicy = field(default_factory=OverloadPolicy)
+    scale_out: ScaleOutPolicy = field(default_factory=ScaleOutPolicy)
 
 
 def load_config(source: str | dict) -> SchedulerConfig:
@@ -339,6 +410,7 @@ def load_config(source: str | dict) -> SchedulerConfig:
         remote_seam=_parse_remote_seam(data.get("remoteSeam")),
         tracing=_parse_tracing(data.get("tracing")),
         overload=_parse_overload(data.get("overload")),
+        scale_out=_parse_scaleout(data.get("scaleOut")),
     )
     if cfg.parallelism <= 0:
         raise ConfigError("parallelism must be positive")
@@ -474,6 +546,8 @@ def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
     sched.remote_seam_policy = cfg.remote_seam
     if cfg.overload.enabled:
         sched.configure_overload(cfg.overload)
+    if cfg.scale_out.enabled:
+        sched.configure_scaleout(cfg.scale_out)
     if cfg.tracing.enabled:
         # the process-wide provider backs /debug/traces on the apiserver's
         # HTTP mux; tests that want isolation construct their own provider

@@ -164,6 +164,17 @@ class Metrics:
         self.overload_breaker_open = cbm.Gauge(
             "scheduler_overload_breaker_open",
             "Escape-storm breaker state (1 = open: escapes deferred).")
+        # scale-out additions (scaleOut: stanza): optimistic-bind races
+        # between cooperating scheduler instances, resolved at commit time
+        # (Omega shared-state model).  The loser classifies each conflicted
+        # pod into an outcome: requeued / lost_to_peer /
+        # already_bound_same_node / fenced.
+        self.bind_conflict_total = cbm.Counter(
+            "scheduler_bind_conflict_total",
+            "Pods whose bind was rejected because a peer scheduler "
+            "instance claimed them first (or this instance lost its "
+            "lease), by conflict outcome.",
+            labels=("outcome",))
         self.informer_relist_total = cbm.Counter(
             "informer_relist_total",
             "Informer list/watch restarts, by resource and reason "
@@ -185,7 +196,8 @@ class Metrics:
             self.tpu_batch_waves, self.tpu_victim_occupancy,
             self.queue_shed_total, self.overload_deferred_total,
             self.overload_wave_cancel_total, self.overload_wave_size,
-            self.overload_breaker_open, self.informer_relist_total)
+            self.overload_breaker_open, self.bind_conflict_total,
+            self.informer_relist_total)
 
     def expose(self) -> str:
         return self.registry.expose()

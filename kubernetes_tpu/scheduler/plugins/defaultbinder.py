@@ -23,6 +23,11 @@ class DefaultBinder(BindPlugin):
              node_name: str) -> Status | None:
         try:
             self.client.bind(pod_info.pod, node_name)
+        except kv.BindConflict:
+            # lost the optimistic race to a peer scheduler instance: the
+            # typed error must reach the binding cycle intact so the pod
+            # is Forgotten + reclassified, not blamed as a plain error
+            raise
         except kv.StoreError as e:
             return Status(ERROR, f"binding rejected: {e}")
         return None

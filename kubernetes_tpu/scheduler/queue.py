@@ -421,6 +421,16 @@ class SchedulingQueue:
                     "backoff": len(self._backoff),
                     "unschedulable": len(self._unschedulable)}
 
+    def has(self, pod: Obj) -> bool:
+        """Whether the pod sits in ANY tier — the scale-out partition
+        resync uses this to avoid re-admitting pods it already holds
+        (a duplicate active entry would schedule the pod twice and
+        manufacture a self-conflict at bind time)."""
+        key = meta.namespaced_name(pod)
+        with self._lock:
+            return (key in self._active or key in self._backoff
+                    or key in self._unschedulable)
+
     def pop(self, timeout: float | None = None) -> QueuedPodInfo | None:
         with self._cond:
             deadline = None if timeout is None else time.monotonic() + timeout

@@ -353,6 +353,10 @@ class Scheduler:
         self.overload_policy = None
         self._wave_tuner: _WaveTuner | None = None
         self._escape_breaker: _OverloadBreaker | None = None
+        # horizontal scale-out (config.py ScaleOutPolicy): None until
+        # configure_scaleout attaches a coordinator; single-instance
+        # schedulers skip every ownership check
+        self.scaleout = None
         self._next_start_node_index = 0
         self._threads: list[threading.Thread] = []
         self._wire_event_handlers()
@@ -393,6 +397,19 @@ class Scheduler:
             _OverloadBreaker(policy.breaker_threshold,
                              policy.breaker_probe_interval)
             if policy.escape_rate_threshold > 0 else None)
+
+    def configure_scaleout(self, policy_or_coordinator) -> None:
+        """Attach the horizontal scale-out layer (scaleout.py): ownership
+        filters on the informer hot path, the lease tick in the
+        scheduling loop, and the bind-side write fence.  Accepts a
+        config.ScaleOutPolicy or a prebuilt ScaleOutCoordinator (tests
+        and the bench harness inject one with a controlled clock).
+        Pass None to detach."""
+        from .scaleout import ScaleOutCoordinator
+        so = policy_or_coordinator
+        if so is not None and not isinstance(so, ScaleOutCoordinator):
+            so = ScaleOutCoordinator(so) if so.enabled else None
+        self.scaleout = so
 
     def expose_metrics(self) -> str:
         """Refresh pull-time gauges (pending_pods, cache_size) and return
@@ -488,7 +505,10 @@ class Scheduler:
                 adds.clear()
 
         ADDED = kv.ADDED
+        so = self.scaleout
         for t, node, old in triples:
+            if so is not None and not so.owns_node(meta.name(node)):
+                continue  # a peer instance's node-pool ring slice
             if t == ADDED:
                 adds.append(node)
             else:
@@ -505,6 +525,7 @@ class Scheduler:
         event order is preserved exactly."""
         queue_adds: list[Obj] = []
         confirms: list[Obj] = []
+        peer_bound: list[Obj] = []  # bound on a node a peer instance owns
 
         def flush() -> None:
             if queue_adds:
@@ -518,14 +539,27 @@ class Scheduler:
                 self.queue.move_all_to_active_or_backoff(
                     ClusterEvent("AssignedPod", "Add"))
                 confirms.clear()
+            if peer_bound:
+                # a peer committed these pods; they are not our cache's
+                # business, but drop any copy still queued here (a lost
+                # optimistic-bind race leaves the pod in our backoff tier
+                # until its peer bind confirmation streams in)
+                self.queue.delete_many(peer_bound)
+                peer_bound.clear()
 
         ADDED, MODIFIED = kv.ADDED, kv.MODIFIED
         profiles = self.profiles
+        so = self.scaleout
         for t, pod, old in triples:
             spec = pod.get("spec") or {}
             bound = bool(spec.get("nodeName"))
             if t == ADDED and not bound:
                 if spec.get("schedulerName", "default-scheduler") in profiles:
+                    if so is not None:
+                        md = pod.get("metadata") or {}
+                        if not so.owns_pod(md.get("namespace", ""),
+                                           md.get("name", "")):
+                            continue  # a peer instance's partition
                     queue_adds.append(pod)
             elif (t == MODIFIED and bound
                     and old is not None
@@ -533,7 +567,10 @@ class Scheduler:
                     and pod["metadata"].get("deletionTimestamp") is None
                     and (pod.get("status") or {}).get("phase")
                     not in ("Succeeded", "Failed")):
-                confirms.append(pod)
+                if so is not None and not so.owns_node(spec["nodeName"]):
+                    peer_bound.append(pod)
+                else:
+                    confirms.append(pod)
             else:
                 flush()
                 self._on_pod_event(t, pod, old)
@@ -543,17 +580,40 @@ class Scheduler:
         name = (pod.get("spec") or {}).get("schedulerName", "default-scheduler")
         return name in self.profiles
 
+    def _scaleout_owns(self, pod: Obj) -> bool:
+        """Ownership of an UNBOUND pod under the scale-out partition
+        (always true single-instance)."""
+        so = self.scaleout
+        if so is None:
+            return True
+        md = pod.get("metadata") or {}
+        return so.owns_pod(md.get("namespace", ""), md.get("name", ""))
+
+    def _scaleout_tracks(self, node_name: str | None) -> bool:
+        """Whether this instance's cache tracks the given node (bound-pod
+        events on a peer's node slice are not our accounting)."""
+        so = self.scaleout
+        return so is None or not node_name or so.owns_node(node_name)
+
     def _on_pod_event(self, type_: str, pod: Obj, old: Obj | None) -> None:
         bound = bool(meta.pod_node_name(pod))
+        tracked = self._scaleout_tracks(
+            meta.pod_node_name(pod) or (old and meta.pod_node_name(old)))
         if type_ == kv.ADDED:
             if bound:
-                self.cache.add_pod(pod)
-                self.queue.assigned_pod_added(pod)
-            elif self._responsible_for(pod):
+                if tracked:
+                    self.cache.add_pod(pod)
+                    self.queue.assigned_pod_added(pod)
+            elif self._responsible_for(pod) and self._scaleout_owns(pod):
                 self.queue.add(pod)
         elif type_ == kv.MODIFIED:
             was_bound = bool(old and meta.pod_node_name(old))
             if bound or was_bound:
+                if not tracked:
+                    # a peer's partition: just make sure no stale copy of
+                    # the pod is still queued here (lost bind race)
+                    self.queue.delete(pod)
+                    return
                 if was_bound:
                     self.cache.update_pod(old, pod)
                 else:
@@ -565,20 +625,24 @@ class Scheduler:
                     self.cache.remove_pod(pod)
                     self.queue.move_all_to_active_or_backoff(
                         ClusterEvent("AssignedPod", "Delete"))
-            elif self._responsible_for(pod):
+            elif self._responsible_for(pod) and self._scaleout_owns(pod):
                 if old is not None:
                     self.queue.update(old, pod)
                 else:
                     self.queue.add(pod)
         elif type_ == kv.DELETED:
             if bound:
-                self.cache.remove_pod(pod)
-                self.queue.move_all_to_active_or_backoff(
-                    ClusterEvent("AssignedPod", "Delete"))
+                if tracked:
+                    self.cache.remove_pod(pod)
+                    self.queue.move_all_to_active_or_backoff(
+                        ClusterEvent("AssignedPod", "Delete"))
             else:
                 self.queue.delete(pod)
 
     def _on_node_event(self, type_: str, node: Obj, old: Obj | None) -> None:
+        if self.scaleout is not None \
+                and not self.scaleout.owns_node(meta.name(node)):
+            return  # a peer instance's node-pool ring slice
         if type_ == kv.ADDED:
             self.cache.add_node(node)
             self.queue.move_all_to_active_or_backoff(ClusterEvent("Node", "Add"))
@@ -630,6 +694,10 @@ class Scheduler:
         already claimed.  While a batch is in flight the queue pop is
         non-blocking so an emptying queue flushes the pipeline immediately
         instead of parking the last batch behind the pop timeout."""
+        if self.scaleout is not None and self.scaleout.tick(self.client):
+            # membership changed (an instance died or rejoined): recompute
+            # this instance's partition before scheduling anything more
+            self._scaleout_resync()
         batch_profile = next((p for p in self.profiles.values()
                               if p.batch_backend is not None), None)
         if batch_profile is not None:
@@ -713,6 +781,48 @@ class Scheduler:
         and run their tails."""
         while self._pending:
             self._finish_batch(*self._pending.pop(0))
+
+    def _scaleout_resync(self) -> None:
+        """Recompute this instance's partition after a membership change:
+        absorb newly-owned nodes — and the bound pods on them, whose
+        resources must be accounted before anything else is placed
+        there — admit newly-owned pending pods, and shed what a live
+        peer owns again.  Everything derives from the shared store and
+        the shared lease table, so every survivor converges on the same
+        ownership map with no coordination round."""
+        so = self.scaleout
+        nodes, _ = self.client.list(NODES)
+        have, _pods, _assumed = self.cache.comparison_snapshot()
+        owned = {meta.name(n) for n in nodes if so.owns_node(meta.name(n))}
+        absorbed = [n for n in nodes if meta.name(n) in owned
+                    and meta.name(n) not in have]
+        if absorbed:
+            self.cache.add_nodes(absorbed)
+        for n in nodes:
+            nm = meta.name(n)
+            if nm in have and nm not in owned:
+                self.cache.remove_node(n)
+        pods, _ = self.client.list(PODS)
+        confirm: list[Obj] = []
+        for p in pods:
+            node = meta.pod_node_name(p)
+            if node:
+                if node in owned and not meta.pod_is_terminal(p):
+                    confirm.append(p)  # idempotent (confirm_or_add_pods)
+                continue
+            if not self._responsible_for(p):
+                continue
+            md = p.get("metadata") or {}
+            if so.owns_pod(md.get("namespace", ""), md.get("name", "")):
+                if not self.queue.has(p):
+                    self.queue.add(p)  # a dead peer's pending pod: ours now
+            else:
+                self.queue.delete(p)
+        if confirm:
+            self.cache.confirm_or_add_pods(confirm)
+        self.queue.move_all_to_active_or_backoff(ClusterEvent("Node", "Add"))
+        logger.info("scale-out resync: instance %d live=%s owns %d/%d nodes",
+                    so.index, so.live, len(owned), len(nodes))
 
     def _profile_for(self, pod: Obj) -> Profile | None:
         name = (pod.get("spec") or {}).get("schedulerName", "default-scheduler")
@@ -961,6 +1071,12 @@ class Scheduler:
         pod_info = qpi.pod_info
         assumed = meta.deep_copy(pod_info.pod)
         assumed["spec"]["nodeName"] = node_name
+        if self.scaleout is not None and not self.scaleout.self_live:
+            # write fence (lease lapsed or instance retired): committing
+            # could double-bind against whichever peer absorbed our slice
+            self._conflict_requeue(fw, [(state, qpi, node_name, assumed)],
+                                   None, forced="fenced")
+            return
         try:
             s = fw.wait_on_permit(pod_info)
             if not is_success(s):
@@ -994,6 +1110,15 @@ class Scheduler:
                 [(now - qpi.initial_attempt_timestamp, qpi.attempts)])
             self.client.create_event(pod_info.pod, "Scheduled",
                                      f"Successfully assigned {qpi.key} to {node_name}")
+        except kv.BindConflict:
+            # a peer scheduler instance claimed the pod (or the node)
+            # first: Forget + reclassify via the conflict taxonomy
+            # instead of blaming the pod as a generic bind error
+            self._conflict_requeue(fw, [(state, qpi, node_name, assumed)],
+                                   None)
+        except kv.FencedError:
+            self._conflict_requeue(fw, [(state, qpi, node_name, assumed)],
+                                   None, forced="fenced")
         except Exception as e:  # pragma: no cover
             logger.exception("binding cycle error for %s", qpi.key)
             self._bind_failure(fw, state, qpi, assumed, node_name,
@@ -1701,8 +1826,26 @@ class Scheduler:
         bindings = [(meta.namespace(q.pod), meta.name(q.pod), node)
                     for _, q, node, _ in ready]
         t_phase = time.monotonic()
+        if self.scaleout is not None and not self.scaleout.self_live:
+            # write fence (scale-out lease lapsed or instance retired):
+            # committing now could double-bind against whichever survivor
+            # absorbed our partition.  Nothing reached the store — the
+            # whole in-flight wave lands in the backoff tier, where the
+            # survivors' resync picks the pods up from the shared store.
+            self._conflict_requeue(fw, ready, bind_sp, forced="fenced")
+            if bind_sp is not None:
+                bind_sp.end()
+            return
         try:
             results = self.client.bind_many(bindings)
+        except kv.FencedError as e:
+            # the STORE fenced (replication failover deposed this
+            # primary): same contract as the lease fence above
+            logger.warning("bind wave fenced by the store: %s", e)
+            self._conflict_requeue(fw, ready, bind_sp, forced="fenced")
+            if bind_sp is not None:
+                bind_sp.end()
+            return
         except Exception:
             # whole-call failure (transport, mid-call store error): the old
             # behavior blamed every pod with the same opaque error.  Retry
@@ -1714,6 +1857,7 @@ class Scheduler:
         if stagelat.ENABLED:
             stagelat.record("bind_store_write", time.monotonic() - t_phase)
         bound: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
+        conflicted: list[tuple[CycleState, QueuedPodInfo, str, Obj]] = []
         for (state, qpi, node_name, assumed), (obj, err) in zip(ready, results):
             if err is not None:
                 if isinstance(err, kv.NotFoundError):
@@ -1725,11 +1869,25 @@ class Scheduler:
                     except ValueError:  # pragma: no cover - already expired
                         pass
                     continue
+                if isinstance(err, kv.ConflictError):
+                    if getattr(err, "current_node", None) == node_name:
+                        # our own write landed (a half-applied bulk call
+                        # retried per binding): the pod IS bound where we
+                        # assumed it — take the success tail
+                        self.metrics.prom.bind_conflict_total.inc(
+                            1.0, "already_bound_same_node")
+                        bound.append((state, qpi, node_name, assumed))
+                        continue
+                    # lost the optimistic race to a peer instance
+                    conflicted.append((state, qpi, node_name, assumed))
+                    continue
                 self._bind_failure(fw, state, qpi, assumed, node_name,
                                    Status(ERROR, f"binding rejected: {err}"),
                                    cycle)
                 continue
             bound.append((state, qpi, node_name, assumed))
+        if conflicted:
+            self._conflict_requeue(fw, conflicted, bind_sp)
         if not bound:
             if bind_sp is not None:
                 bind_sp.add_event("all_bindings_rejected")
@@ -1779,9 +1937,62 @@ class Scheduler:
         out: list[tuple[Obj | None, Exception | None]] = []
         for ns, nm, node in bindings:
             try:
+                # conflicts ship to _bulk_bind_commit by value in `out`,
+                # where the taxonomy resolves them
+                # ktpulint: disable=bind-conflict-handled
                 obj = self.client.bind(
                     {"metadata": {"namespace": ns, "name": nm}}, node)
                 out.append((obj, None))
             except Exception as e:
                 out.append((None, e))
         return out
+
+    def _conflict_requeue(self, fw: Framework,
+                          entries: list[tuple[CycleState, QueuedPodInfo,
+                                              str, Obj]],
+                          bind_sp, forced: str | None = None) -> None:
+        """Resolve pods that lost the optimistic bind race to a peer
+        scheduler instance — or a whole in-flight wave caught behind a
+        write fence (forced="fenced").  Every entry Forgets its assumed
+        capacity first; then the store decides the outcome:
+
+          lost_to_peer   re-fetch shows the pod bound (or gone): a peer
+                         owns it now, nothing to requeue
+          requeued       pod still unbound (peer's claim evaporated, or
+                         the store is unreadable): back through the
+                         backoff tiers — compare-and-bind keeps a
+                         spurious retry safe
+          fenced         forced: nothing reached the store, the whole
+                         wave requeues without a re-fetch
+        """
+        outcomes: dict[str, int] = {}
+        requeue: list[QueuedPodInfo] = []
+        for state, qpi, node_name, assumed in entries:
+            fw.run_unreserve_plugins(state, qpi.pod_info, node_name)
+            try:
+                self.cache.forget_pod(assumed)
+            except ValueError:  # pragma: no cover - already expired
+                pass
+            if forced is not None:
+                outcome = forced
+            else:
+                outcome = "requeued"
+                try:
+                    current = self.client.get(PODS, meta.namespace(qpi.pod),
+                                              meta.name(qpi.pod))
+                    if (current.get("spec") or {}).get("nodeName"):
+                        outcome = "lost_to_peer"
+                except kv.NotFoundError:
+                    outcome = "lost_to_peer"  # bound by a peer, then deleted
+                except kv.StoreError:
+                    pass  # cannot tell: requeue is the safe side
+            outcomes[outcome] = outcomes.get(outcome, 0) + 1
+            if outcome != "lost_to_peer":
+                requeue.append(qpi)
+        if requeue:
+            self.queue.requeue_backoff(requeue)
+        for outcome, n in sorted(outcomes.items()):
+            self.metrics.prom.bind_conflict_total.inc(float(n), outcome)
+        if bind_sp is not None:
+            bind_sp.add_event("bind_conflict", pods=len(entries), **outcomes)
+        logger.info("bind conflicts resolved: %s", outcomes)

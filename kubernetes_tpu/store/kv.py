@@ -78,6 +78,27 @@ class ConflictError(StoreError):
     """resourceVersion mismatch — caller should re-get and retry."""
 
 
+class BindConflict(ConflictError):
+    """A bind lost the optimistic race: the pod was claimed by a peer
+    scheduler instance (Omega-style shared-state scheduling resolves
+    multi-scheduler contention at commit time, not at dispatch time).
+
+    Subclasses ConflictError so every existing 409/retry path keeps
+    working; carries structured fields so the losing scheduler can
+    classify the outcome without parsing the message — ``current_node``
+    is who actually owns the pod now (None when only the resourceVersion
+    precondition failed), ``wanted_node`` is where the caller tried to
+    put it."""
+
+    def __init__(self, message: str, *, key: str = "",
+                 current_node: str | None = None,
+                 wanted_node: str | None = None):
+        super().__init__(message)
+        self.key = key
+        self.current_node = current_node
+        self.wanted_node = wanted_node
+
+
 class TooOldError(StoreError):
     """Requested watch revision has been compacted — caller must re-list."""
 
@@ -525,16 +546,23 @@ class MemoryStore:
             return tomb
 
     def bind_many(self, resource: str,
-                  bindings: list[tuple[str, str, str]]
+                  bindings: list[tuple]
                   ) -> list[tuple[Obj | None, StoreError | None]]:
         """Bulk Binding write: one lock round trip for a whole TPU batch.
 
-        Each (namespace, name, node_name) entry follows BindingREST semantics
-        (pkg/registry/core/pod/storage — fail if the pod is already bound);
-        results are per-entry so one conflict doesn't poison the batch.  The
-        reference has no bulk verb (scheduler binds one pod per goroutine);
-        batched assignment makes the 1-write-per-pod pattern the bottleneck,
-        so the store grows a transactional multi-bind instead.
+        Each (namespace, name, node_name[, expect_rv]) entry follows
+        BindingREST semantics (pkg/registry/core/pod/storage — fail if the
+        pod is already bound); results are per-entry so one conflict doesn't
+        poison the batch.  The reference has no bulk verb (scheduler binds
+        one pod per goroutine); batched assignment makes the 1-write-per-pod
+        pattern the bottleneck, so the store grows a transactional
+        multi-bind instead.
+
+        Compare-and-bind: an entry whose pod already carries spec.nodeName —
+        or, when the optional 4th element expect_rv is given, whose stored
+        resourceVersion moved past it — returns a structured BindConflict
+        instead of silently double-binding, so N scheduler instances can
+        commit optimistically against one shared store and losers detect it.
         """
         self._check_fence()
         out: list[tuple[Obj | None, StoreError | None]] = []
@@ -545,7 +573,9 @@ class MemoryStore:
             logging_on = self._logging  # invariant while the lock is held
             table = self._table(resource)
             rev = self._rev
-            for ns, nm, node in bindings:
+            for entry in bindings:
+                ns, nm, node = entry[0], entry[1], entry[2]
+                expect_rv = entry[3] if len(entry) > 3 else None
                 key = f"{ns}/{nm}" if ns else nm
                 cur = table.get(key)
                 if cur is None:
@@ -555,9 +585,18 @@ class MemoryStore:
                 if transform is not None:
                     cur = transform.decrypt_obj(cur)
                 if (cur.get("spec") or {}).get("nodeName"):
-                    out.append((None, ConflictError(
-                        f"pod {key!r} is already bound to "
-                        f"{cur['spec']['nodeName']!r}")))
+                    bound_to = cur["spec"]["nodeName"]
+                    out.append((None, BindConflict(
+                        f"pod {key!r} is already bound to {bound_to!r}",
+                        key=key, current_node=bound_to, wanted_node=node)))
+                    continue
+                if expect_rv is not None and \
+                        cur["metadata"].get("resourceVersion") != expect_rv:
+                    out.append((None, BindConflict(
+                        f"pod {key!r} moved past resourceVersion "
+                        f"{expect_rv!r} (now "
+                        f"{cur['metadata'].get('resourceVersion')!r})",
+                        key=key, current_node=None, wanted_node=node)))
                     continue
                 # 2-level copy, not deep: only metadata/spec/status own
                 # mutated slots; nested values are shared with the prior

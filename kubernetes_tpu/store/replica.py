@@ -444,11 +444,63 @@ class FollowerStore(kv.MemoryStore):
                 f"our epoch {max(self._seen_epoch, self.epoch)}")
         if snap.get("type") != "snapshot":
             raise kv.StoreError("replication bootstrap failed")
+        new_data = {res: dict(tbl)
+                    for res, tbl in (snap.get("data") or {}).items()}
+        snap_rev = int(snap.get("rev", 0))
         with self._lock:
-            self._data = {res: dict(tbl)
-                          for res, tbl in (snap.get("data") or {}).items()}
-            self._rev = int(snap.get("rev", 0))
-            self._floor = self._rev  # pre-snapshot revisions unobservable
+            old_data = self._data
+            if self._rev > 0 or any(old_data.values()):
+                # Rejoin (this store served — or followed — a previous
+                # term): attached watchers hold a view that may contain a
+                # dirty never-acked tail, and the new primary's revision
+                # sequence can numerically overlap it.  Installing the
+                # snapshot wholesale would leave those watchers with
+                # stale keys forever and let later emissions run
+                # backwards.  Instead: restart the watch ring (resumes
+                # from old-term revisions must relist — TooOldError), and
+                # converge attached watchers onto the snapshot with
+                # synthesized diff events stamped ABOVE everything they
+                # have seen, so the stream stays strictly monotonic.
+                base = max(self._rev, snap_rev)
+                self._history.clear()
+                self._floor = base
+                for res in sorted(set(old_data) | set(new_data)):
+                    old_tbl = old_data.get(res) or {}
+                    new_tbl = new_data.get(res) or {}
+                    evs: list[kv.WatchEvent] = []
+                    for key in sorted(old_tbl):
+                        if key in new_tbl:
+                            continue
+                        base += 1
+                        opened = self._open(res, old_tbl[key])
+                        tomb = dict(opened)
+                        tomb["metadata"] = dict(
+                            opened.get("metadata") or {})
+                        tomb["metadata"]["resourceVersion"] = base
+                        evs.append(kv.WatchEvent(kv.DELETED, tomb, base))
+                    for key in sorted(new_tbl):
+                        stored = new_tbl[key]
+                        stale = old_tbl.get(key)
+                        opened = self._open(res, stored)
+                        if stale is not None:
+                            stale_rv = (self._open(res, stale).get(
+                                "metadata") or {}).get("resourceVersion")
+                            if stale_rv == (opened.get("metadata")
+                                            or {}).get("resourceVersion"):
+                                continue  # watcher view already current
+                        base += 1
+                        evs.append(kv.WatchEvent(
+                            kv.MODIFIED if stale is not None else kv.ADDED,
+                            opened, base))
+                    self._emit_many(res, evs)
+                self._data = new_data
+                self._rev = base
+            else:
+                # first bootstrap of a fresh follower: nobody watched the
+                # empty store, plain install
+                self._data = new_data
+                self._rev = snap_rev
+                self._floor = snap_rev  # pre-snapshot revs unobservable
             self._seen_epoch = max(self._seen_epoch,
                                    int(snap.get("epoch", 0)))
         self._last_frame = time.monotonic()
@@ -524,7 +576,15 @@ class FollowerStore(kv.MemoryStore):
                 op, rev, resource, key = rec[0], int(rec[1]), rec[2], rec[3]
                 obj = rec[4] if len(rec) > 4 else None
                 table = self._table(resource)
-                self._rev = max(self._rev, rev)
+                if rev > self._rev:
+                    self._rev = rev
+                else:
+                    # post-rejoin plateau: the new primary's sequence is
+                    # still below what attached watchers observed (old
+                    # term's dirty tail or the synthesized rejoin diff) —
+                    # step past it so the emitted stream stays strictly
+                    # monotonic until the primary's numbering catches up
+                    self._rev += 1
                 if op == wal_mod.PUT:
                     existed = key in table
                     table[key] = obj
@@ -536,7 +596,7 @@ class FollowerStore(kv.MemoryStore):
                     tomb = obj or {"metadata": {
                         "name": key.rpartition("/")[2],
                         "namespace": key.rpartition("/")[0],
-                        "resourceVersion": rev}}
+                        "resourceVersion": self._rev}}
                     self._emit(resource, kv.DELETED, tomb)
             if self._logging:
                 self._commit([tuple(r) for r in recs])
@@ -570,8 +630,11 @@ class FollowerStore(kv.MemoryStore):
         """Re-enter the cluster as a follower of the (new) primary: a
         deposed/fenced primary calls this after a partition heals.  Any
         dirty never-acked tail in the table is discarded by the
-        bootstrap snapshot; the write fence flips back on (this store is
-        a replica again)."""
+        bootstrap snapshot — follow() converges attached watchers onto
+        it with synthesized DELETED/ADDED/MODIFIED diff events and
+        restarts the watch ring, so a watcher spanning fence→rejoin
+        sees vanished keys deleted and strictly monotonic revisions.
+        The write fence flips back on (this store is a replica again)."""
         self._promoted = False
         self._fenced = False
         self._fence_reason = ""

@@ -53,3 +53,8 @@ def pytest_configure(config):
         "markers",
         "chaos: fault-injection tests for the remote TPU seam "
         "(tests/test_chaos_seam.py; deterministic, seeded)")
+    config.addinivalue_line(
+        "markers",
+        "scaleout: multi-instance scheduler tests (tests/test_scaleout.py); "
+        "tier-1 runs the shrunk 2-instance chaos case, the full "
+        "churn matrix is additionally marked slow")

@@ -67,6 +67,32 @@ class TestBulkBind:
         assert store.get(PODS, "default", "bf-bound")["spec"][
             "nodeName"] == "n0"
 
+    def test_bind_conflict_fields_survive_the_wire(self, server):
+        """The structured BindConflict fields (key/current_node/
+        wanted_node) ride the 409 Status `details` block both ways, so
+        an HTTP scheduler classifies already_bound_same_node vs
+        lost_to_peer exactly like a LocalClient one — no message
+        parsing."""
+        http, store = server
+        http.create(PODS, mkpod("bc-pod"))
+        http.bind_many([("default", "bc-pod", "n0")])
+        # bulk path
+        [(_, err)] = http.bind_many([("default", "bc-pod", "n1")])
+        assert isinstance(err, kv.BindConflict)
+        assert err.current_node == "n0" and err.wanted_node == "n1"
+        # single-binding subresource path
+        with pytest.raises(kv.BindConflict) as ei:
+            http.bind({"metadata": {"namespace": "default",
+                                    "name": "bc-pod"}}, "n2")
+        assert ei.value.current_node == "n0"
+        assert ei.value.wanted_node == "n2"
+        assert ei.value.key  # names the pod
+        # a conflict naming OUR node is the already-bound-same-node
+        # success tail, distinguishable without parsing
+        [(_, err)] = http.bind_many([("default", "bc-pod", "n0")])
+        assert isinstance(err, kv.BindConflict)
+        assert err.current_node == "n0" == err.wanted_node
+
     def test_single_binding_collection_post(self, server):
         """Upstream shape: POST one Binding to the collection."""
         http, store = server

@@ -244,6 +244,34 @@ profiles:
             load_config({"profiles": [{"plugins": {
                 "filter": {"enabled": [{"name": "Bogus"}]}}}]})
 
+    def test_scaleout_stanza_parses(self):
+        cfg = load_config({"scaleOut": {
+            "instanceCount": 4, "instanceIndex": 2,
+            "partitionBy": "namespaceHash", "ringSlices": 128,
+            "leaseDurationSeconds": 15, "renewIntervalSeconds": 3}})
+        so = cfg.scale_out
+        assert so.enabled
+        assert (so.instance_count, so.instance_index) == (4, 2)
+        assert so.partition_by == "namespaceHash"
+        assert so.ring_slices == 128
+        assert (so.lease_duration, so.renew_interval) == (15, 3)
+        # default: single instance, layer off
+        assert not load_config({}).scale_out.enabled
+
+    def test_scaleout_validation_errors(self):
+        for bad in (
+                {"noSuchKey": 1},
+                {"instanceCount": 0},
+                {"instanceCount": 2, "instanceIndex": 2},
+                {"instanceCount": 2, "instanceIndex": -1},
+                {"partitionBy": "consistentHashing"},
+                {"instanceCount": 8, "ringSlices": 4},
+                {"leaseDurationSeconds": 0},
+                {"renewIntervalSeconds": 0},
+                {"leaseDurationSeconds": 1, "renewIntervalSeconds": 2}):
+            with pytest.raises(ConfigError):
+                load_config({"scaleOut": bad})
+
     def test_point_scoped_disable(self):
         cfg = load_config({"profiles": [{"plugins": {
             "score": {"disabled": [{"name": "NodeResourcesFit"}]}}}]})

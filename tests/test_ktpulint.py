@@ -43,7 +43,7 @@ def run_rule(ctx: LintContext, name: str) -> list[Finding]:
 
 def test_registry_has_the_full_catalog():
     rules = all_rules()
-    assert len(rules) >= 14
+    assert len(rules) >= 16
     for name, rule in rules.items():
         assert name == rule.name
         assert rule.doc, f"rule {name} has no doc line"
@@ -326,6 +326,43 @@ def test_overload_metric_reason_fires_and_clean(tmp_path):
     assert run_rule(ctx, "overload-metric-reason") == []
 
 
+def test_bind_conflict_handled_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/scheduler/rogue.py": """\
+        def commit(self, bindings):
+            return self.client.bind_many(bindings)
+        """})
+    found = run_rule(ctx, "bind-conflict-handled")
+    assert len(found) == 1
+    assert "bind_many" in found[0].message and "commit" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {f"{PKG}/scheduler/good.py": """\
+        def commit(self, bindings):
+            try:
+                return self.client.bind_many(bindings)
+            except kv.BindConflict:
+                raise
+
+        def serve(self, listener):
+            listener.bind(("127.0.0.1", 0))
+        """})
+    assert run_rule(ctx, "bind-conflict-handled") == []
+
+
+def test_bind_conflict_handled_exempts_bind_layers(tmp_path):
+    # the clientset / transport / store layers ARE the bind
+    # implementation; the rule only audits callers above them
+    ctx = make_ctx(tmp_path, {
+        f"{PKG}/client/clientset.py": """\
+            def bind(self, pod, node):
+                return self.client.bind(pod, node)
+            """,
+        f"{PKG}/store/replica.py": """\
+            def bind_many(self, *a):
+                return self.client.bind_many(*a)
+            """})
+    assert run_rule(ctx, "bind-conflict-handled") == []
+
+
 _TAXO_README_OK = """\
     # fixture
 
@@ -383,6 +420,64 @@ def test_taxonomy_sync_readme_to_code_and_clean(tmp_path):
     ctx = make_ctx(tmp_path / "ok", clean,
                    readme=tmp_path / "ok" / "README.md")
     assert run_rule(ctx, "taxonomy-sync") == []
+
+
+_TAXO_SCALEOUT_README = _TAXO_README_OK + """\
+
+    ### Horizontal scale-out
+
+    | outcome | meaning |
+    |---|---|
+    | `requeued` | still unbound, back through backoff |
+    | `lost_to_peer` | a peer owns the pod now |
+    | `already_bound_same_node` | our own write landed |
+    | `fenced` | write fence, wave requeues whole |
+    """
+
+
+def test_taxonomy_sync_covers_bind_conflict_outcomes(tmp_path):
+    # the three scale-out emit shapes: outcome = "..." assignments,
+    # _conflict_requeue(forced=...), bind_conflict_total.inc literals
+    code = {
+        f"{PKG}/ops/flatten.py": """\
+            class Enc:
+                def f(self, i):
+                    self._esc("NodePorts", "port_clash")
+            """,
+        f"{PKG}/scheduler/queue.py": """\
+            class Q:
+                def g(self):
+                    self._shed_over_cap_locked("backoff_cap")
+            """,
+        f"{PKG}/scheduler/scheduler.py": """\
+            class S:
+                def resolve(self, fw, entries, bound_elsewhere, fenced):
+                    if fenced:
+                        self._conflict_requeue(fw, entries, None,
+                                               forced="fenced")
+                        return
+                    outcome = "requeued"
+                    if bound_elsewhere:
+                        outcome = "lost_to_peer"
+                    self.metrics.prom.bind_conflict_total.inc(
+                        1.0, "already_bound_same_node")
+                    return outcome
+            """}
+    clean = dict(code)
+    clean["README.md"] = _TAXO_SCALEOUT_README
+    ctx = make_ctx(tmp_path, clean, readme=tmp_path / "README.md")
+    assert run_rule(ctx, "taxonomy-sync") == []
+
+    # drop one outcome row from the README: its emit site is now
+    # undocumented and the rule must name it
+    stale = dict(code)
+    stale["README.md"] = _TAXO_SCALEOUT_README.replace(
+        "| `fenced` | write fence, wave requeues whole |\n", "")
+    ctx = make_ctx(tmp_path / "stale", stale,
+                   readme=tmp_path / "stale" / "README.md")
+    found = run_rule(ctx, "taxonomy-sync")
+    msgs = " ".join(f.message for f in found)
+    assert "'fenced'" in msgs and "'requeued'" not in msgs
 
 
 # -- device rules ----------------------------------------------------------

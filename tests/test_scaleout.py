@@ -1,0 +1,458 @@
+"""Horizontal scale-out: N cooperating scheduler instances over one
+shared store (Omega-style shared-state scheduling).
+
+Three layers, cheapest first:
+
+  * ScaleOutCoordinator unit tests — the partition map is disjoint,
+    complete, minimal-motion under failover, and lease-driven.
+  * Conflict-taxonomy tests — the commit path classifies optimistic-bind
+    losses deterministically (lost_to_peer / requeued / fenced /
+    already_bound_same_node) with the scheduler_bind_conflict_total
+    metric accounting for every conflicted pod.
+  * Chaos integration — 2 instances share a MemoryStore; a seeded
+    churn schedule (ops/faults.ScaleOutSchedule) kills an instance
+    mid-wave and the suite proves ZERO double-binds (no pod's nodeName
+    ever moves node->node in the store's event history) and ZERO lost
+    pods (every pod ends bound exactly once).  The full churn matrix
+    (3-4 instances, kill+revive) is marked slow; tier-1 runs the shrunk
+    2-instance case.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.api import meta
+from kubernetes_tpu.client import LocalClient, SharedInformerFactory
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.ops.faults import (
+    KILL_INSTANCE, REVIVE_INSTANCE, InstanceChurner, ScaleOutSchedule)
+from kubernetes_tpu.scheduler import Profile, Scheduler, new_default_framework
+from kubernetes_tpu.scheduler.config import ScaleOutPolicy
+from kubernetes_tpu.scheduler.scaleout import ScaleOutCoordinator
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node, make_pod
+
+pytestmark = pytest.mark.scaleout
+
+
+def wait_for(pred, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def scheduled(client):
+    return [p for p in client.list(PODS, "default")[0]
+            if meta.pod_node_name(p)]
+
+
+def fast_policy(index: int, count: int, **kw) -> ScaleOutPolicy:
+    """Sub-second leases so failover detection fits a unit-test budget."""
+    kw.setdefault("lease_duration", 0.4)
+    kw.setdefault("renew_interval", 0.1)
+    return ScaleOutPolicy(instance_count=count, instance_index=index, **kw)
+
+
+def chaos_policy(index: int, count: int) -> ScaleOutPolicy:
+    """Lease windows for the churn tests, which renew from a scheduler
+    loop doing real binding work: wide enough that a loaded single-core
+    box can't starve a live instance past its own lease and fence it
+    spuriously, still fast enough that scripted kills are detected well
+    inside the wait_for budget."""
+    return fast_policy(index, count,
+                       lease_duration=1.5, renew_interval=0.25)
+
+
+def new_instance(store, index: int, count: int, policy=None):
+    client = LocalClient(store)
+    factory = SharedInformerFactory(client)
+    fw = new_default_framework(client, factory)
+    sched = Scheduler(client, factory, {"default-scheduler": Profile(fw)})
+    sched.configure_scaleout(policy or fast_policy(index, count))
+    factory.start()
+    factory.wait_for_cache_sync()
+    sched.run()
+    return sched, factory, client
+
+
+class BindLedger:
+    """Tails the store's pod event history and records every nodeName a
+    pod key has EVER carried — the double-bind detector.  A pod that is
+    bound exactly once has one node in its set; a pod two instances both
+    committed would show two."""
+
+    def __init__(self, store):
+        self.nodes_seen: dict[str, set[str]] = {}
+        self._watch = store.watch(PODS, since_rv=0)
+
+    def drain(self):
+        for ev in self._watch.next_batch(timeout=0.0):
+            md = ev.object.get("metadata") or {}
+            key = f"{md.get('namespace')}/{md.get('name')}"
+            node = (ev.object.get("spec") or {}).get("nodeName")
+            if node:
+                self.nodes_seen.setdefault(key, set()).add(node)
+        return self.nodes_seen
+
+    def assert_no_double_binds(self):
+        self.drain()
+        moved = {k: v for k, v in self.nodes_seen.items() if len(v) > 1}
+        assert not moved, f"pods bound to more than one node: {moved}"
+
+    def stop(self):
+        self._watch.stop()
+
+
+# -- coordinator unit tests ----------------------------------------------
+
+
+class TestPartitionMap:
+    @pytest.mark.parametrize("count", [2, 3, 4])
+    def test_partition_disjoint_and_complete(self, count):
+        cos = [ScaleOutCoordinator(fast_policy(i, count))
+               for i in range(count)]
+        pods = [("default", f"p-{i}") for i in range(200)]
+        nodes = [f"node-{i}" for i in range(50)]
+        for ns, nm in pods:
+            owners = [c.index for c in cos if c.owns_pod(ns, nm)]
+            assert len(owners) == 1, (ns, nm, owners)
+        for n in nodes:
+            owners = [c.index for c in cos if c.owns_node(n)]
+            assert len(owners) == 1, (n, owners)
+
+    def test_failover_is_minimal_motion(self):
+        cos = [ScaleOutCoordinator(fast_policy(i, 3)) for i in range(3)]
+        nodes = [f"node-{i}" for i in range(60)]
+        before = {n: next(c.index for c in cos if c.owns_node(n))
+                  for n in nodes}
+        for c in cos:
+            c.set_live([0, 2])  # instance 1 died
+        after = {n: next(c.index for c in cos if c.owns_node(n))
+                 for n in nodes}
+        for n in nodes:
+            if before[n] != 1:
+                # a live instance's slices never move
+                assert after[n] == before[n]
+            else:
+                # a dead instance's slices land on SOME survivor
+                assert after[n] in (0, 2)
+        # and the dead instance's share is actually spread, not dumped
+        absorbed = {after[n] for n in nodes if before[n] == 1}
+        assert absorbed == {0, 2}
+
+    def test_namespace_hash_mode_shares_nodes(self):
+        cos = [ScaleOutCoordinator(
+            fast_policy(i, 2, partition_by="namespaceHash"))
+            for i in range(2)]
+        assert all(c.owns_node("any-node") for c in cos)
+        # pods in one namespace all land on the same instance
+        owner = {ns: [c.index for c in cos
+                      if c.owns_pod(ns, "x")][0]
+                 for ns in ("default", "team-a", "team-b", "team-c")}
+        for ns, idx in owner.items():
+            for i in range(20):
+                assert (cos[idx].owns_pod(ns, f"p{i}")), (ns, i)
+
+    def test_empty_namespace_normalizes_to_default(self):
+        co = ScaleOutCoordinator(fast_policy(0, 2))
+        assert co.owns_pod("", "x") == co.owns_pod("default", "x")
+
+    def test_lease_lifecycle_and_self_fence(self):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        a = ScaleOutCoordinator(fast_policy(0, 2))
+        b = ScaleOutCoordinator(fast_policy(1, 2))
+        a.tick(client)
+        b.tick(client)
+        assert a.live == (0, 1) and b.live == (0, 1)
+        assert a.self_live and b.self_live
+        a.retire()
+        assert not a.self_live  # immediate bind fence, before any sweep
+        assert b.tick(client, time.time() + 10.0)  # lease lapsed -> change
+        assert b.live == (1,)
+        assert all(b.owns_node(f"n{i}") for i in range(20))
+        a.revive()
+        a.tick(client, time.time() + 11.0)
+        assert b.tick(client, time.time() + 11.0)
+        assert b.live == (0, 1)
+
+
+class TestScaleOutSchedule:
+    def test_scripted_entries_win_and_do_not_shift_stream(self):
+        plain = ScaleOutSchedule(seed=7, instance_count=3, kill_rate=0.2)
+        scripted = ScaleOutSchedule(seed=7, instance_count=3, kill_rate=0.2,
+                                    script={3: (KILL_INSTANCE, 1)})
+        a = [plain.action(i) for i in range(10)]
+        b = [scripted.action(i) for i in range(10)]
+        assert b[3] == (KILL_INSTANCE, 1)
+        assert a[:3] == b[:3] and a[4:] == b[4:]
+
+    def test_churner_enforces_min_live(self):
+        cos = [ScaleOutCoordinator(fast_policy(i, 2)) for i in range(2)]
+        sched = ScaleOutSchedule(instance_count=2, script={
+            0: (KILL_INSTANCE, 0), 1: (KILL_INSTANCE, 1),
+            2: (REVIVE_INSTANCE, 0)})
+        churn = InstanceChurner(cos, sched, min_live=1)
+        assert churn.step() == (KILL_INSTANCE, 0)
+        assert churn.step() is None  # would leave zero live instances
+        assert cos[1].self_live
+        assert churn.step() == (REVIVE_INSTANCE, 0)
+        assert churn.injected[KILL_INSTANCE] == 1
+        assert churn.injected[REVIVE_INSTANCE] == 1
+
+
+# -- conflict taxonomy (deterministic, single process) --------------------
+
+
+class TestBindConflictTaxonomy:
+    def _cluster(self, n_nodes=3):
+        store = kv.MemoryStore(history=100_000)
+        client = LocalClient(store)
+        for i in range(n_nodes):
+            client.create(NODES, make_node(f"cx-{i}").build())
+        return store, client
+
+    def test_lost_to_peer_forgotten_not_requeued(self):
+        store, client = self._cluster()
+        rogue = LocalClient(store)
+        sched, factory, _ = new_instance(store, 0, 1)
+        real_bind = sched.client.bind
+        raced = []
+
+        def racing_bind(pod, node_name, expect_rv=None):
+            # a peer instance wins the optimistic race for this pod,
+            # right before our commit lands
+            if not raced:
+                other = next(n for n in (f"cx-{i}" for i in range(3))
+                             if n != node_name)
+                rogue.bind(pod, other)
+                raced.append(other)
+            return real_bind(pod, node_name, expect_rv)
+
+        sched.client.bind = racing_bind
+        try:
+            client.create(PODS, make_pod("race-0").req(cpu="100m").build())
+            assert wait_for(lambda: len(scheduled(client)) == 1)
+            pod = client.get(PODS, "default", "race-0")
+            # the peer's placement stands; we never overwrote it
+            assert meta.pod_node_name(pod) == raced[0]
+            prom = sched.metrics.prom
+            assert prom.bind_conflict_total.value("lost_to_peer") == 1.0
+            assert prom.bind_conflict_total.value("requeued") == 0.0
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_spurious_conflict_requeues_and_lands(self):
+        store, client = self._cluster()
+        sched, factory, _ = new_instance(store, 0, 1)
+        real_bind = sched.client.bind
+        fired = []
+
+        def flaky_bind(pod, node_name, expect_rv=None):
+            if not fired:
+                fired.append(True)
+                # conflict with NO visible winner (e.g. compare-and-bind
+                # rv precondition lost to a status-patch): pod re-fetches
+                # as unbound and must requeue, not vanish
+                md = pod.get("metadata") or {}
+                raise kv.BindConflict(
+                    "injected",
+                    key=f"{md.get('namespace')}/{md.get('name')}",
+                    current_node=None, wanted_node=node_name)
+            return real_bind(pod, node_name, expect_rv)
+
+        sched.client.bind = flaky_bind
+        try:
+            client.create(PODS, make_pod("flaky-0").req(cpu="100m").build())
+            assert wait_for(lambda: len(scheduled(client)) == 1)
+            prom = sched.metrics.prom
+            assert prom.bind_conflict_total.value("requeued") == 1.0
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_fenced_instance_parks_wave_in_backoff_then_drains(self):
+        store, client = self._cluster()
+        sched, factory, _ = new_instance(store, 0, 2)
+        co = sched.scaleout
+        co.retire()  # fence BEFORE any pod arrives: first wave must park
+        try:
+            for i in range(4):
+                client.create(PODS,
+                              make_pod(f"fence-{i}").req(cpu="100m").build())
+            prom = sched.metrics.prom
+            assert wait_for(
+                lambda: prom.bind_conflict_total.value("fenced") >= 4)
+            # nothing bound, nothing lost: every pod is parked in a queue
+            assert len(scheduled(client)) == 0
+            stats = sched.queue.stats()
+            parked = sum(stats.get(q, 0) for q in
+                         ("active", "backoff", "unschedulable"))
+            assert parked == 4, stats
+            co.revive()
+            assert wait_for(lambda: len(scheduled(client)) == 4)
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+# -- chaos integration: shared store, instance churn ----------------------
+
+
+def run_churn(n_instances: int, n_nodes: int, n_pods: int,
+              script: dict, waves: int, seed: int = 0,
+              pods_per_wave: int | None = None):
+    """Drive n_instances over one store while a seeded churner kills and
+    revives instances between pod waves.  Returns everything the caller
+    asserts on; always proves no-double-bind + no-lost-pod before
+    returning."""
+    store = kv.MemoryStore(history=1_000_000)
+    admin = LocalClient(store)
+    ledger = BindLedger(store)
+    for i in range(n_nodes):
+        admin.create(NODES, make_node(f"ch-{i}").build())
+    instances = [new_instance(store, i, n_instances,
+                              policy=chaos_policy(i, n_instances))
+                 for i in range(n_instances)]
+    churner = InstanceChurner(
+        [s.scaleout for s, _, _ in instances],
+        ScaleOutSchedule(seed=seed, instance_count=n_instances,
+                         script=script),
+        min_live=1)
+    per_wave = pods_per_wave or max(1, n_pods // waves)
+    created = 0
+    try:
+        for w in range(waves):
+            for _ in range(per_wave):
+                if created >= n_pods:
+                    break
+                admin.create(
+                    PODS,
+                    make_pod(f"cp-{created}").req(cpu="50m").build())
+                created += 1
+            act = churner.step()
+            if act and act[0] == KILL_INSTANCE:
+                # deterministic failover: don't race the wave loop against
+                # lease expiry — hold the next wave until every live
+                # survivor has swept the victim out of its membership
+                victim = act[1]
+                survivors = [s.scaleout for s, _, _ in instances
+                             if s.scaleout.index != victim
+                             and s.scaleout.self_live]
+                assert wait_for(lambda: all(
+                    victim not in so.live for so in survivors)), (
+                    f"survivors never observed the death of {victim}")
+            ledger.drain()
+            time.sleep(0.05)
+        while created < n_pods:
+            admin.create(PODS,
+                         make_pod(f"cp-{created}").req(cpu="50m").build())
+            created += 1
+        # revive everyone so the backlog cannot be stranded on a pod
+        # whose owner is dead and whose lease has not lapsed yet
+        for s, _, _ in instances:
+            s.scaleout.revive()
+        assert wait_for(lambda: len(scheduled(admin)) == n_pods,
+                        timeout=60.0), (
+            f"{len(scheduled(admin))}/{n_pods} bound; "
+            f"churn log {churner.log}")
+        ledger.assert_no_double_binds()
+        assert len(ledger.nodes_seen) == n_pods  # zero lost pods
+        return instances, churner, ledger, admin
+    finally:
+        for s, f, _ in instances:
+            s.stop()
+            f.stop()
+        ledger.stop()
+
+
+class TestScaleOutChaos:
+    def test_two_instances_steady_state(self):
+        """No churn: disjoint partitions schedule side by side with zero
+        conflicts and zero double-binds."""
+        instances, churner, ledger, admin = run_churn(
+            n_instances=2, n_nodes=8, n_pods=40, script={}, waves=4)
+        total_conflicts = sum(
+            v for s, _, _ in instances
+            for v in s.metrics.prom.bind_conflict_total.values().values())
+        assert total_conflicts == 0.0
+
+    def test_two_instance_failover_mid_wave(self):
+        """Tier-1 shrunk chaos: instance 0 dies after the first wave; the
+        survivor absorbs its ring slice and every pod still lands exactly
+        once.  Satellite contract: the dead instance's in-flight work is
+        requeued (fenced outcome) or absorbed — never lost."""
+        instances, churner, ledger, admin = run_churn(
+            n_instances=2, n_nodes=8, n_pods=60,
+            script={1: (KILL_INSTANCE, 0)}, waves=6)
+        assert churner.injected[KILL_INSTANCE] == 1
+        surv = instances[1][0]
+        # the survivor saw the membership change and took over slices it
+        # did not originally own: its cache must now track ALL nodes
+        have_nodes, _, _ = surv.cache.comparison_snapshot()
+        assert len(have_nodes) == 8
+        # metric accounting: every pod is bound; any fenced/conflicted
+        # classification on the dead instance matches pods that were
+        # subsequently rescued by the survivor, not dropped
+        dead = instances[0][0]
+        fenced = dead.metrics.prom.bind_conflict_total.value("fenced")
+        assert fenced >= 0.0  # present (possibly zero if no wave in flight)
+
+    def test_kill_then_revive_rebalances(self):
+        instances, churner, ledger, admin = run_churn(
+            n_instances=2, n_nodes=8, n_pods=60,
+            script={1: (KILL_INSTANCE, 0), 3: (REVIVE_INSTANCE, 0)},
+            waves=6)
+        assert churner.injected[KILL_INSTANCE] == 1
+        assert churner.injected[REVIVE_INSTANCE] == 1
+
+
+@pytest.mark.slow
+class TestScaleOutChurnMatrix:
+    """Full churn matrix: more instances, seeded random kills layered
+    over scripted ones, repeated revives.  Excluded from tier-1."""
+
+    @pytest.mark.parametrize("n_instances,seed", [(3, 1), (4, 2)])
+    def test_random_churn_never_double_binds(self, n_instances, seed):
+        run_churn(
+            n_instances=n_instances, n_nodes=12, n_pods=90,
+            script={1: (KILL_INSTANCE, 0),
+                    3: (REVIVE_INSTANCE, 0),
+                    4: (KILL_INSTANCE, n_instances - 1)},
+            waves=9, seed=seed)
+
+    def test_namespace_hash_partitioning_under_churn(self):
+        store = kv.MemoryStore(history=1_000_000)
+        admin = LocalClient(store)
+        ledger = BindLedger(store)
+        for i in range(8):
+            admin.create(NODES, make_node(f"nh-{i}").build())
+        pols = [fast_policy(i, 2, partition_by="namespaceHash")
+                for i in range(2)]
+        instances = [new_instance(store, i, 2, policy=pols[i])
+                     for i in range(2)]
+        try:
+            for ns in ("default", "team-a", "team-b"):
+                for i in range(10):
+                    admin.create(PODS, make_pod(f"np-{i}", ns)
+                                 .req(cpu="50m").build())
+            instances[0][0].scaleout.retire()
+
+            def all_bound():
+                return sum(
+                    1 for ns in ("default", "team-a", "team-b")
+                    for p in admin.list(PODS, ns)[0]
+                    if meta.pod_node_name(p)) == 30
+            assert wait_for(all_bound, timeout=60.0)
+            ledger.assert_no_double_binds()
+        finally:
+            for s, f, _ in instances:
+                s.stop()
+                f.stop()
+            ledger.stop()

@@ -390,3 +390,105 @@ class TestAutomatedFailover:
         with pytest.raises(kv.FencedError):
             primary.create("pods", make_pod("lonely").build())
         hub.stop()
+
+
+class TestRejoinWatchConsistency:
+    """rejoin() correctness: a watcher opened on a deposed primary
+    BEFORE it rejoins must observe (a) DELETED events for every key its
+    dirty never-acked tail held that the new primary's snapshot lacks,
+    (b) the new primary's additions, and (c) a strictly monotonic
+    revision stream across the install — never a silent disappearance
+    and never a revision that steps backwards."""
+
+    def test_watcher_spanning_rejoin_sees_deletes_and_monotonic_rvs(self):
+        # A is primary; B follows and syncs the shared prefix
+        a = FollowerStore(history=10_000).promote()
+        hub_a = ReplicationHub(a, sync=True, sync_timeout=30.0).start()
+        b = FollowerStore(history=10_000)
+        b.follow(*hub_a.address)
+        for i in range(5):
+            a.create("pods", make_pod(f"keep-{i}").build())
+        assert wait_for(lambda: len(b.list("pods", "default")[0]) == 5)
+
+        # A is partitioned away (hub torn down); it keeps committing a
+        # dirty tail nobody will ever ack
+        hub_a.stop()
+        for i in range(3):
+            a.create("pods", make_pod(f"dirty-{i}").build())
+
+        # B is promoted and the cluster moves on without A
+        b.promote()
+        b.create("pods", make_pod("new-0").build())
+        b.delete("pods", "default", "keep-0")
+
+        # the cross-rejoin watcher: opened on A before it rejoins
+        w = a.watch("pods")
+        rev_before = a._rev
+        hub_b = ReplicationHub(b, sync=True, sync_timeout=30.0,
+                               heartbeat_interval=0.1).start()
+        a.rejoin(*hub_b.address)
+
+        # post-rejoin liveness: a write on the new primary still streams
+        # through to the same watcher (the ring was restarted, not torn)
+        b.create("pods", make_pod("new-1").build())
+        assert wait_for(lambda: any(
+            o["metadata"]["name"] == "new-1"
+            for o in a.list("pods", "default")[0]), timeout=10.0)
+
+        events = []
+        while True:
+            batch = w.next_batch(timeout=1.0)
+            if not batch:
+                break
+            events.extend(batch)
+        w.stop()
+        hub_b.stop()
+
+        deleted = {ev.object["metadata"]["name"] for ev in events
+                   if ev.type == kv.DELETED}
+        added = {ev.object["metadata"]["name"] for ev in events
+                 if ev.type == kv.ADDED}
+        # (a) every vanished key surfaces as DELETED: the dirty tail the
+        # snapshot discarded AND the key the new primary deleted
+        assert {"dirty-0", "dirty-1", "dirty-2", "keep-0"} <= deleted, \
+            f"missing tombstones; saw {deleted}"
+        # (b) the new primary's additions arrive
+        assert {"new-0", "new-1"} <= added
+        # (c) strictly monotonic revisions, all past the pre-rejoin rev
+        revs = [ev.revision for ev in events]
+        assert all(b_ > a_ for a_, b_ in zip(revs, revs[1:])), \
+            f"non-monotonic watch revisions: {revs}"
+        assert revs and revs[0] > rev_before
+        # the object revisions the tombstones carry match the stream
+        for ev in events:
+            if ev.type == kv.DELETED:
+                assert ev.object["metadata"]["resourceVersion"] == \
+                    ev.revision
+        # final store states agree
+        a_names = {o["metadata"]["name"]
+                   for o in a.list("pods", "default")[0]}
+        b_names = {o["metadata"]["name"]
+                   for o in b.list("pods", "default")[0]}
+        assert a_names == b_names
+        assert not any(n.startswith("dirty-") for n in a_names)
+
+    def test_resume_watch_from_old_term_rev_gets_too_old(self):
+        """A client that saved a pre-rejoin resourceVersion cannot
+        silently resume into the new term's numbering: the restarted
+        ring must force a relist (TooOldError), reflector semantics."""
+        a = FollowerStore(history=10_000).promote()
+        hub_a = ReplicationHub(a, sync=True, sync_timeout=30.0).start()
+        b = FollowerStore(history=10_000)
+        b.follow(*hub_a.address)
+        for i in range(4):
+            a.create("pods", make_pod(f"t-{i}").build())
+        assert wait_for(lambda: len(b.list("pods", "default")[0]) == 4)
+        old_rv = a._rev - 2  # a rev squarely inside the old term's ring
+        hub_a.stop()
+        b.promote()
+        b.create("pods", make_pod("term2").build())
+        hub_b = ReplicationHub(b, sync=True, sync_timeout=30.0).start()
+        a.rejoin(*hub_b.address)
+        with pytest.raises(kv.TooOldError):
+            a.watch("pods", since_rv=old_rv)
+        hub_b.stop()

@@ -14,7 +14,7 @@ from __future__ import annotations
 import ast
 import re
 
-from ..engine import FileView, Finding, LintContext, Rule, register, \
+from ..engine import FileView, Finding, LintContext, Rule, dotted, register, \
     walk_functions
 
 
@@ -126,6 +126,54 @@ class OverloadMetricReasonRule(Rule):
                             f"{n.func.value.attr}.inc without a reason label")
 
 
+@register
+class BindConflictHandledRule(Rule):
+    """Every `client.bind` / `client.bind_many` call site outside the
+    clientset/transport/store layers must handle the `BindConflict`
+    path — requeue, reclassify, or re-raise.  With N scheduler
+    instances racing over one store, a bind call that treats the typed
+    conflict as a generic error blames the pod (failure event, status
+    patch, error-tier requeue) for losing a race that is part of normal
+    operation, and skips the Forget-assumed-capacity step the conflict
+    taxonomy depends on."""
+
+    name = "bind-conflict-handled"
+    doc = "bind/bind_many call sites outside the clientset handle BindConflict"
+
+    # layers that implement or transport bind itself
+    EXEMPT_PARTS = ("/client/", "/store/", "/apiserver/")
+    HANDLER_NAMES = ("BindConflict", "ConflictError")
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        rel = f"/{view.rel}"
+        if any(part in rel for part in self.EXEMPT_PARTS):
+            return
+        if ".bind" not in view.text or view.tree is None:
+            return
+        for fn in walk_functions(view.tree):
+            calls = [
+                n for n in ast.walk(fn)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("bind", "bind_many")
+                # target the API client, not sockets / plugin dispatch
+                and "client" in dotted(n.func.value)]
+            if not calls:
+                continue
+            handles = any(
+                (isinstance(n, ast.Attribute)
+                 and n.attr in self.HANDLER_NAMES)
+                or (isinstance(n, ast.Name) and n.id in self.HANDLER_NAMES)
+                for n in ast.walk(fn))
+            if handles:
+                continue
+            for c in calls:
+                yield self.finding(
+                    view, c.lineno,
+                    f"{fn.name} calls {c.func.attr} without handling "
+                    "BindConflict (requeue or re-raise)")
+
+
 # -- taxonomy-sync ---------------------------------------------------------
 
 _IDENT_RE = re.compile(r"`([a-z][a-z0-9_]*)`")
@@ -148,7 +196,8 @@ class TaxonomySyncRule(Rule):
     SCAN_FILES = ("ops/flatten.py", "ops/backend.py", "ops/failover.py",
                   "ops/faults.py", "scheduler/queue.py",
                   "scheduler/scheduler.py")
-    SECTIONS = ("### Escape hatch", "### Overload protections")
+    SECTIONS = ("### Escape hatch", "### Overload protections",
+                "### Horizontal scale-out")
 
     def _collect_code(self, ctx: LintContext):
         """(string -> (rel, line)) for every reason-ish literal at a
@@ -194,15 +243,25 @@ class TaxonomySyncRule(Rule):
                         and n.args):
                     for c in strings_in(n.args[0]):
                         note(c.value, view.rel, c.lineno)
-                # overload_*_total.inc(amount, "reason")
+                # overload_*_total.inc(amount, "reason") and
+                # bind_conflict_total.inc(amount, "outcome")
                 elif (isinstance(n, ast.Call)
                         and isinstance(n.func, ast.Attribute)
                         and n.func.attr == "inc"
                         and isinstance(n.func.value, ast.Attribute)
-                        and "overload" in n.func.value.attr
+                        and ("overload" in n.func.value.attr
+                             or n.func.value.attr == "bind_conflict_total")
                         and len(n.args) >= 2):
                     for c in strings_in(n.args[1]):
                         note(c.value, view.rel, c.lineno)
+                # _conflict_requeue(..., forced="outcome")
+                elif (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "_conflict_requeue"):
+                    for kw in n.keywords:
+                        if kw.arg == "forced":
+                            for c in strings_in(kw.value):
+                                note(c.value, view.rel, c.lineno)
                 elif isinstance(n, ast.Assign):
                     tgt_names = {t.value.attr if isinstance(t, ast.Subscript)
                                  and isinstance(t.value, ast.Attribute)
@@ -211,8 +270,10 @@ class TaxonomySyncRule(Rule):
                                  else t.id if isinstance(t, ast.Name) else ""
                                  for t in n.targets}
                     # escape_reasons[...] = ("Plugin", "reason"),
-                    # escapes[...] = "reason", reason = "..." / IfExp
-                    if tgt_names & {"escape_reasons", "escapes", "reason"}:
+                    # escapes[...] = "reason", reason = "..." / IfExp,
+                    # outcome = "..." (bind-conflict taxonomy)
+                    if tgt_names & {"escape_reasons", "escapes", "reason",
+                                    "outcome"}:
                         for c in strings_in(n.value):
                             note(c.value, view.rel, c.lineno)
                 # {i: "reason" ...} dict-comps (failover bulk escapes)
