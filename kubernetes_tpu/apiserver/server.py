@@ -639,6 +639,19 @@ class APIServer:
                 if path == "/configz":
                     self._send_json(200, configz.default_registry.snapshot())
                     return
+                if path == "/debug/profile":
+                    # collapsed stacks (flamegraph.pl format) from the
+                    # process-wide sampling profiler; empty body when
+                    # the profiling: stanza never started it
+                    from ..component_base import profiling
+                    body = profiling.default_host_profiler \
+                        .collapsed().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path == "/debug/traces":
                     # recent batch traces from the process-wide flight
                     # recorder (component_base/tracing.py); empty list

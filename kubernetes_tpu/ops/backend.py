@@ -364,6 +364,8 @@ class ResidentHostMirror:
 
 
 class TPUBatchBackend(ResidentHostMirror, BatchBackend):
+    census_kind = "tpu"
+
     def __init__(self, caps: Caps | None = None, batch_size: int = 256,
                  weights: dict[str, float] | None = None, k_cap: int = 1024,
                  full_batch_cap: int | None = None):
@@ -501,6 +503,44 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             # sync-point: warmup barrier — block until the round trip lands
             jax.device_get(a)
             self._warm_preempt()
+
+    def device_census(self, variants: tuple = ("full", "plain")) -> dict:
+        """Static cost census of the compiled step variants: lower each
+        one with the backend's own host tensors (shape-exact; nothing
+        executes on the device) and walk the optimized HLO
+        (component_base/profiling).  Works identically for the remote
+        backend — the step fns are built client-side and the worker
+        compiles the same program.  Costs a fresh AOT compile per
+        variant, so callers reach this only through the profiling:
+        stanza (Scheduler.run_device_census)."""
+        from ..component_base import profiling
+        with self._lock:
+            t = self.tensors
+            cd_sg, cd_asg = t.domain_base_counts()
+            state = {"used": t.used, "used_nz": t.used_nz,
+                     "npods": t.npods, "port_mask": t.port_mask,
+                     "cd_sg": cd_sg, "cd_asg": cd_asg}
+            static_core = {k: getattr(t, k) for k in STATIC_CORE}
+            batch = self.encoder.encode([])
+            empty = (np.empty(0, np.int32),
+                     np.empty((0, self._f_patch), np.float32))
+            plans = []
+            if "full" in variants:
+                self._ensure_full()
+                sel = {k: getattr(t, k) for k in STATIC_SEL}
+                buf = pack_pod_batch(
+                    slice_pod_batch(batch, 0, 0, self.full_cap),
+                    self._spec_full, *empty)
+                plans.append(("full", self._fn_full,
+                              {**static_core, **sel}, buf))
+            if "plain" in variants:
+                fn = self._ensure_plain()
+                buf = pack_pod_batch(batch, self._spec_plain, *empty)
+                plans.append(("plain", fn, static_core, buf))
+        # the AOT lowering/compile runs OUTSIDE the backend lock: a
+        # multi-second census must not stall a concurrent dispatch
+        return {name: profiling.census_lowered(fn.lower(state, static, buf))
+                for name, fn, static, buf in plans}
 
     def _warm_preempt(self) -> None:
         """Compile the preemption dry-run kernel (and make the victim

@@ -215,6 +215,27 @@ class FailoverBatchBackend(BatchBackend):
 
     # -- delegation ------------------------------------------------------
 
+    def device_census(self, *args, **kwargs) -> dict:
+        """Census the currently-active rung (the program waves actually
+        run through); rungs without a device path contribute nothing."""
+        with self._lock:
+            rung = next((r for r in self._rungs if not r.breaker.is_open),
+                        None)
+        if rung is None:
+            return {}
+        fn = getattr(rung.backend, "device_census", None)
+        return fn(*args, **kwargs) if fn is not None else {}
+
+    @property
+    def census_kind(self) -> str:
+        with self._lock:
+            rung = next((r for r in self._rungs if not r.breaker.is_open),
+                        None)
+        if rung is None:
+            return "failover"
+        inner = getattr(rung.backend, "census_kind", rung.name)
+        return f"failover-{inner}"
+
     def warmup(self) -> None:
         """Warm EVERY rung: a failover target that still has kernels to
         compile would turn the first degraded batch into a compile storm."""

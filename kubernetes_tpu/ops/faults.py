@@ -275,6 +275,14 @@ class ChaosBatchBackend:
         fn = getattr(self.inner, "drain_batch_telemetry", None)
         return fn() if fn is not None else []
 
+    def device_census(self, *args, **kwargs) -> dict:
+        fn = getattr(self.inner, "device_census", None)
+        return fn(*args, **kwargs) if fn is not None else {}
+
+    @property
+    def census_kind(self) -> str:
+        return getattr(self.inner, "census_kind", "chaos")
+
 
 # -- scale-out chaos (horizontal scale-out PR) ---------------------------
 #

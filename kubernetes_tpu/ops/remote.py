@@ -85,6 +85,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from ..component_base import metrics as cbm
+from ..component_base import profiling
 from ..component_base import tracing
 from ..scheduler.config import RemoteSeamPolicy
 from ..scheduler.scheduler import BackendUnavailableError
@@ -443,6 +444,9 @@ class DeviceWorker:
                 if self.path == "/debug/traces":
                     self._reply(200, server._core.tracer_provider
                                 .debug_traces_json().encode())
+                elif self.path == "/debug/profile":
+                    self._reply(200, profiling.default_host_profiler
+                                .collapsed().encode(), "text/plain")
                 elif self.path == "/metrics":
                     self._reply(200, cbm.default_registry.expose().encode(),
                                 "text/plain; version=0.0.4")
@@ -755,6 +759,11 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
         the device state from the authoritative tensors.  Slower, never
         wrong.
     """
+
+    # device_census is inherited: the step fns are built client-side and
+    # the worker compiles the same bytes, so the client-side lowering IS
+    # the worker's program
+    census_kind = "remote"
 
     def __init__(self, worker_url: str, caps: Caps | None = None,
                  batch_size: int = 256,

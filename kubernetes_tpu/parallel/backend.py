@@ -49,6 +49,7 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
     # may dispatch while k is in flight, as long as no patch/refresh is
     # needed — the same contract as the single-chip backend
     supports_pipelining = True
+    census_kind = "sharded"
 
     def __init__(self, caps: Caps | None = None, batch_size: int = 256,
                  weights: dict[str, float] | None = None, mesh=None,
@@ -170,6 +171,22 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                 self.caps, self.mesh, self._weights, k_cap=self._k_cap,
                 features=PLAIN_FEATURES)
         return self._fn_plain
+
+    def device_census(self, batch_size: int | None = None,
+                      variants: Sequence[str] = ("full", "plain")) -> dict:
+        """Static cost census of the compiled sharded step: lower each
+        variant at the census shapes (parallel/census.py — the SAME
+        shapes tools/collective_census.py pins, so the exported gauges
+        match the offline tool bit-for-bit) and walk its optimized HLO.
+        Costs a fresh AOT compile per variant — callers reach this only
+        through the profiling: stanza (Scheduler.run_device_census)."""
+        from .census import census_step_fn
+        b = batch_size or self.batch_size
+        out = {}
+        for v in variants:
+            fn = self._fn if v == "full" else self._ensure_plain()
+            out[v] = census_step_fn(fn, self.caps, b, self._k_cap)
+        return out
 
     def _dispatch_locked(self, batch, prows, pvals):
         """Async sharded step: donates the current state and immediately

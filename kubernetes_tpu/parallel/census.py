@@ -1,0 +1,109 @@
+"""Runtime device cost census for the sharded scheduling step.
+
+One shared lowering path serves three callers — the offline CLI
+(tools/collective_census.py), ShardedTPUBatchBackend.device_census(),
+and the parity test (tests/test_profiling.py) — so the committed
+`tpu_wave_collective_bytes` gauges and the tool output agree bit-for-bit
+by construction: same fn builder, same abstract input shapes, same HLO
+walk (component_base/profiling.census_from_hlo).
+
+Nothing here executes on a device; lowering is shape-exact, so the
+counts/bytes are the ones a real v5e-8 would run.
+
+Reference: no upstream analogue (the reference scheduler has no device
+kernel to census); the gauges it feeds follow the
+staging/src/k8s.io/component-base/metrics export contract.
+"""
+
+from __future__ import annotations
+
+from ..component_base import profiling
+from ..ops.flatten import Caps
+
+
+def round_caps_to_mesh(caps: Caps, n_dev: int) -> Caps:
+    """Round n_cap up to a mesh multiple (shard_map needs an even node
+    split); mutates and returns caps, mirroring the backend's own
+    divisibility requirement."""
+    if caps.n_cap % n_dev:
+        caps.n_cap += n_dev - caps.n_cap % n_dev
+    return caps
+
+
+def abstract_step_inputs(caps: Caps, batch: int, k_cap: int = 1024):
+    """Shape-only abstract inputs (state, static, pods, prows, pvals)
+    for build_sharded_step_fn at a given pod-batch size — the single
+    definition of the lowering shapes the census is pinned at."""
+    import jax
+    import jax.numpy as jnp
+
+    c = caps
+    P_, R, PT = batch, c.r, c.pt_cap
+
+    def zeros(shape, dtype=jnp.float32):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    state = {"used": zeros((c.n_cap, R)), "used_nz": zeros((c.n_cap, R)),
+             "npods": zeros((c.n_cap,)), "port_mask": zeros((c.n_cap, PT)),
+             "cd_sg": zeros((c.sg_cap, c.n_cap)),
+             "cd_asg": zeros((c.asg_cap, c.n_cap))}
+    static = {"alloc": zeros((c.n_cap, R)), "maxpods": zeros((c.n_cap,)),
+              "valid": zeros((c.n_cap,), jnp.bool_),
+              "taint_mask": zeros((c.n_cap, c.t_cap)),
+              "label_mask": zeros((c.n_cap, c.l_cap)),
+              "key_mask": zeros((c.n_cap, c.kl_cap)),
+              "dom_sg": zeros((c.sg_cap, c.n_cap), jnp.int32),
+              "dom_asg": zeros((c.asg_cap, c.n_cap), jnp.int32)}
+    pods = {"req": zeros((P_, R)), "req_nz": zeros((P_, R)),
+            "p_valid": zeros((P_,), jnp.bool_),
+            "untol_hard": zeros((P_, c.t_cap)),
+            "untol_prefer": zeros((P_, c.t_cap)),
+            "sel_any": zeros((P_, c.g_cap, c.l_cap)),
+            "sel_any_active": zeros((P_, c.g_cap)),
+            "sel_forb": zeros((P_, c.l_cap)),
+            "key_any": zeros((P_, c.kg_cap, c.kl_cap)),
+            "key_any_active": zeros((P_, c.kg_cap)),
+            "key_forb": zeros((P_, c.kl_cap)),
+            "ports": zeros((P_, PT)),
+            "node_row": zeros((P_,), jnp.int32),
+            "c_kind": zeros((P_, c.c_cap), jnp.int32),
+            "c_sg": zeros((P_, c.c_cap), jnp.int32),
+            "c_maxskew": zeros((P_, c.c_cap)),
+            "c_selfmatch": zeros((P_, c.c_cap)),
+            "c_weight": zeros((P_, c.c_cap)),
+            "inc_sg": zeros((P_, c.sg_cap)),
+            "inc_asg": zeros((P_, c.asg_cap)),
+            "match_asg": zeros((P_, c.asg_cap))}
+    prows = zeros((k_cap,), jnp.int32)
+    pvals = zeros((k_cap, 2 * R + 1 + PT))
+    return state, static, pods, prows, pvals
+
+
+def census_step_fn(fn, caps: Caps, batch: int, k_cap: int = 1024) -> dict:
+    """Lower + compile one sharded step fn at the census shapes and walk
+    its optimized HLO (profiling.census_lowered)."""
+    return profiling.census_lowered(
+        fn.lower(*abstract_step_inputs(caps, batch, k_cap)))
+
+
+def sharded_census(nodes: int, batch: int, variant: str,
+                   weights: dict[str, float] | None = None,
+                   k_cap: int = 1024) -> dict:
+    """The offline-tool entry point: build the sharded step at bench
+    shapes (perf.caps_for_nodes, mesh-rounded) and census it.  Assumes
+    jax is already bootstrapped onto the virtual mesh
+    (profiling.ensure_virtual_mesh)."""
+    import jax
+
+    from ..models.assign import ALL_FEATURES, PLAIN_FEATURES
+    from ..perf import caps_for_nodes
+    from .mesh import build_sharded_step_fn, make_mesh
+
+    caps = round_caps_to_mesh(caps_for_nodes(nodes), len(jax.devices()))
+    mesh = make_mesh()
+    features = PLAIN_FEATURES if variant == "plain" else ALL_FEATURES
+    fn = build_sharded_step_fn(caps, mesh, weights, k_cap=k_cap,
+                               features=features)
+    rec = census_step_fn(fn, caps, batch, k_cap)
+    return {"nodes": nodes, "batch": batch, "variant": variant,
+            "mesh_devices": len(jax.devices()), "n_cap": caps.n_cap, **rec}

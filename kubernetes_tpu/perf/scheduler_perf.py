@@ -236,7 +236,8 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                   remote_seam: str | None = None,
                   tracing_provider=None,
                   overload=None,
-                  chaos_schedule=None) -> PerfCluster:
+                  chaos_schedule=None,
+                  profiling_policy=None) -> PerfCluster:
     """mustSetupScheduler (util.go:79): in-proc everything, no kubelet.
 
     pipeline_depth/admission_interval select latency mode (scheduler.py):
@@ -374,6 +375,25 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
         sched.configure_overload(overload)
     if tracing_provider is not None:
         sched.configure_tracing(tracing_provider)
+    if profiling_policy is not None and (profiling_policy.enabled
+                                         or profiling_policy.census):
+        # same wiring scheduler_from_config applies for the profiling:
+        # stanza — bench --profile reuses the ProfilingPolicy dataclass
+        from ..component_base import profiling as cbp
+        profiler = None
+        if profiling_policy.enabled:
+            profiler = cbp.default_host_profiler
+            profiler.reset()
+            profiler.interval = profiling_policy.sample_interval_ms / 1000.0
+            profiler.max_stacks = profiling_policy.max_stacks
+            profiler.start()
+        slo = cbp.SLOTracker(
+            target_ms=profiling_policy.slo_target_ms,
+            objective=profiling_policy.slo_objective,
+            windows=profiling_policy.burn_windows_s)
+        sched.configure_profiling(profiler, slo,
+                                  census=profiling_policy.census)
+        sched.run_device_census()
     factory.start()
     factory.wait_for_cache_sync()
     sched.run()
@@ -737,7 +757,8 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                        remote_seam: str | None = None,
                        tracing_provider=None,
                        overload=None,
-                       chaos_schedule=None
+                       chaos_schedule=None,
+                       profiling_policy=None
                        ) -> tuple[ThroughputSummary, dict]:
     """Run one workload config end to end; returns (throughput, stats)."""
     cluster = setup_cluster(
@@ -747,7 +768,8 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         via_http=via_http, null_device=null_device,
         percentage_of_nodes_to_score=percentage_of_nodes_to_score,
         remote_seam=remote_seam, tracing_provider=tracing_provider,
-        overload=overload, chaos_schedule=chaos_schedule)
+        overload=overload, chaos_schedule=chaos_schedule,
+        profiling_policy=profiling_policy)
     collector = ThroughputCollector(cluster.store)
     try:
         ops = config["workloadTemplate"]
@@ -789,6 +811,24 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                 if injected is not None:  # ChaosBatchBackend wrapper
                     stats["chaos_injected"] = dict(injected)
                 break
+        if profiling_policy is not None and (profiling_policy.enabled
+                                             or profiling_policy.census):
+            # the performance-observatory read-out bench --profile emits
+            # as the PROFILE artifact: per-stage host attribution, the
+            # device census, and the SLO window view
+            sched = cluster.scheduler
+            if sched._profiler is not None:
+                sched._profiler.stop()
+                stats["host_stages"] = sched._profiler.stage_seconds()
+                stats["profile_samples"] = sched._profiler.samples_total()
+                stats["hot_stacks"] = sched._profiler.top_stacks(10)
+            if sched._census:
+                stats["device_census"] = sched._census
+            if sched._slo is not None:
+                stats["slo"] = {
+                    **sched._slo.quantiles(),
+                    "burn_rates": sched._slo.burn_rates(),
+                }
         if overload is not None:
             cluster.scheduler.expose_metrics()  # drain shed/defer tallies
             prom = cluster.scheduler.metrics.prom

@@ -210,6 +210,75 @@ def _parse_tracing(data: dict) -> TracingPolicy:
 
 
 @dataclass
+class ProfilingPolicy:
+    """Continuous performance observatory (component_base/profiling.py).
+
+    Configured via the `profiling:` stanza; everything defaults OFF so
+    an unconfigured scheduler attaches no sampler thread, runs no
+    census compile, and pays nothing on the hot path.
+
+      enabled          master switch: starts the process-wide sampling
+                       host profiler (sys._current_frames() at
+                       1000/sampleIntervalMs Hz) behind /debug/profile
+                       and feeds scheduler_host_stage_seconds{stage}.
+      census           device cost census: at warmup the backend lowers
+                       its compiled step variants and exports
+                       tpu_wave_collective_bytes / tpu_wave_flops /
+                       tpu_step_hbm_bytes gauges (costs one extra AOT
+                       compile per variant, off the hot path).
+      sloTargetMs      rolling-window scheduling-latency SLO target fed
+                       by submit->bind latencies; p50/p95/p99 and
+                       multi-window burn rates export as
+                       scheduler_slo_latency_ms / scheduler_slo_burn_rate
+                       (the arm/disarm signal for adaptive overload
+                       engagement)."""
+
+    enabled: bool = False
+    census: bool = False
+    sample_interval_ms: float = 5.0
+    max_stacks: int = 512
+    slo_target_ms: float = 10.0
+    slo_objective: float = 0.99
+    burn_windows_s: tuple = (60.0, 300.0, 3600.0)
+
+
+# profiling YAML key -> ProfilingPolicy field
+_PROFILING_FIELDS = {
+    "enabled": "enabled",
+    "census": "census",
+    "sampleIntervalMs": "sample_interval_ms",
+    "maxStacks": "max_stacks",
+    "sloTargetMs": "slo_target_ms",
+    "sloObjective": "slo_objective",
+    "burnWindowsSeconds": "burn_windows_s",
+}
+
+
+def _parse_profiling(data: dict) -> ProfilingPolicy:
+    kwargs = {}
+    for key, value in (data or {}).items():
+        if key not in _PROFILING_FIELDS:
+            raise ConfigError(f"unknown profiling key {key!r}")
+        kwargs[_PROFILING_FIELDS[key]] = value
+    if "burn_windows_s" in kwargs:
+        kwargs["burn_windows_s"] = tuple(
+            float(w) for w in kwargs["burn_windows_s"])
+    policy = ProfilingPolicy(**kwargs)
+    if policy.sample_interval_ms <= 0:
+        raise ConfigError("profiling sampleIntervalMs must be positive")
+    if policy.max_stacks < 1:
+        raise ConfigError("profiling maxStacks must be >= 1")
+    if policy.slo_target_ms <= 0:
+        raise ConfigError("profiling sloTargetMs must be positive")
+    if not 0.0 < policy.slo_objective < 1.0:
+        raise ConfigError("profiling sloObjective must be in (0,1)")
+    if not policy.burn_windows_s or any(w <= 0
+                                        for w in policy.burn_windows_s):
+        raise ConfigError("profiling burnWindowsSeconds must be positive")
+    return policy
+
+
+@dataclass
 class OverloadPolicy:
     """Closed-loop overload protection for the batch pipeline.
 
@@ -382,6 +451,7 @@ class SchedulerConfig:
     tracing: TracingPolicy = field(default_factory=TracingPolicy)
     overload: OverloadPolicy = field(default_factory=OverloadPolicy)
     scale_out: ScaleOutPolicy = field(default_factory=ScaleOutPolicy)
+    profiling: ProfilingPolicy = field(default_factory=ProfilingPolicy)
 
 
 def load_config(source: str | dict) -> SchedulerConfig:
@@ -411,6 +481,7 @@ def load_config(source: str | dict) -> SchedulerConfig:
         tracing=_parse_tracing(data.get("tracing")),
         overload=_parse_overload(data.get("overload")),
         scale_out=_parse_scaleout(data.get("scaleOut")),
+        profiling=_parse_profiling(data.get("profiling")),
     )
     if cfg.parallelism <= 0:
         raise ConfigError("parallelism must be positive")
@@ -558,4 +629,22 @@ def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
             max_spans=cfg.tracing.max_spans,
             max_traces=cfg.tracing.max_traces)
         sched.configure_tracing(tracing.default_tracer_provider)
+    if cfg.profiling.enabled or cfg.profiling.census:
+        # the process-wide profiler backs /debug/profile on the apiserver
+        # and device-worker muxes (tracing's default-provider pattern);
+        # tests wanting isolation construct their own HostProfiler and
+        # call configure_profiling directly.  Default-off: this branch is
+        # the ONLY place the sampler starts or the census arms.
+        from ..component_base import profiling
+        profiler = None
+        if cfg.profiling.enabled:
+            profiler = profiling.default_host_profiler
+            profiler.interval = cfg.profiling.sample_interval_ms / 1000.0
+            profiler.max_stacks = cfg.profiling.max_stacks
+            profiler.start()
+        slo = profiling.SLOTracker(
+            target_ms=cfg.profiling.slo_target_ms,
+            objective=cfg.profiling.slo_objective,
+            windows=cfg.profiling.burn_windows_s)
+        sched.configure_profiling(profiler, slo, census=cfg.profiling.census)
     return sched

@@ -130,7 +130,8 @@ class SchedulerMetrics:
         def pct(p: float) -> float:
             return round(1e3 * xs[min(int(len(xs) * p), len(xs) - 1)], 2)
         return {"count": len(xs), "p50_ms": pct(0.50), "p90_ms": pct(0.90),
-                "p99_ms": pct(0.99), "max_ms": round(1e3 * xs[-1], 2)}
+                "p95_ms": pct(0.95), "p99_ms": pct(0.99),
+                "max_ms": round(1e3 * xs[-1], 2)}
 
     def observe_preemption(self, victims: int) -> None:
         with self.lock:
@@ -357,6 +358,14 @@ class Scheduler:
         # configure_scaleout attaches a coordinator; single-instance
         # schedulers skip every ownership check
         self.scaleout = None
+        # performance observatory (config.py ProfilingPolicy /
+        # component_base/profiling.py): None until configure_profiling
+        # attaches the host profiler + SLO tracker; everything off by
+        # default so the hot path pays nothing unconfigured
+        self._profiler = None
+        self._slo = None
+        self._census_wanted = False
+        self._census: dict = {}
         self._next_start_node_index = 0
         self._threads: list[threading.Thread] = []
         self._wire_event_handlers()
@@ -411,6 +420,58 @@ class Scheduler:
             so = ScaleOutCoordinator(so) if so.enabled else None
         self.scaleout = so
 
+    def configure_profiling(self, profiler, slo=None,
+                            census: bool = False) -> None:
+        """Attach the performance observatory (component_base/profiling):
+        `profiler` is a HostProfiler (started by the caller — usually
+        scheduler_from_config off the profiling: stanza) whose per-stage
+        host seconds drain into scheduler_host_stage_seconds at expose
+        time; `slo` is an SLOTracker fed submit->bind latencies at the
+        bind-commit tail, publishing rolling p50/p95/p99 + burn-rate
+        gauges; `census=True` arms run_device_census() so the harness
+        runs it once after backend warmup.  Pass (None, None) to
+        detach."""
+        self._profiler = profiler
+        self._slo = slo
+        self._census_wanted = bool(census)
+
+    def run_device_census(self) -> dict:
+        """In-band device cost census: ask the batch backend to lower
+        its compiled step variants and commit the collective/flops/HBM
+        numbers as gauges (the ROADMAP \"collective bytes/wave\" criterion
+        as a metric, not a script run).  Gated: only called when the
+        profiling: stanza set census=true, and costs an AOT compile per
+        variant, so the harness runs it right after backend warmup."""
+        if not self._census_wanted:
+            return {}
+        from ..component_base.profiling import collective_bytes_by_op
+        m = self.metrics.prom
+        census_all: dict = {}
+        for profile in self.profiles.values():
+            backend = profile.batch_backend
+            census_fn = getattr(backend, "device_census", None)
+            if backend is None or census_fn is None:
+                continue
+            kind = getattr(backend, "census_kind",
+                           type(backend).__name__)
+            census = census_fn()
+            census_all[kind] = census
+            for variant, rec in census.items():
+                label = f"{kind}-{variant}"
+                per_wave, per_call = collective_bytes_by_op(rec)
+                for op, v in per_wave.items():
+                    m.tpu_wave_collective_bytes.set(float(v), op, label)
+                for op, v in per_call.items():
+                    m.tpu_step_collective_bytes.set(float(v), op, label)
+                cost = rec.get("cost") or {}
+                if cost:
+                    m.tpu_wave_flops.set(cost.get("flops", 0.0),
+                                         kind, variant)
+                    m.tpu_step_hbm_bytes.set(cost.get("bytes_accessed", 0.0),
+                                             kind, variant)
+        self._census = census_all
+        return census_all
+
     def expose_metrics(self) -> str:
         """Refresh pull-time gauges (pending_pods, cache_size) and return
         the Prometheus exposition text for this scheduler's registry."""
@@ -452,6 +513,18 @@ class Scheduler:
         if self._escape_breaker is not None:
             self.metrics.prom.overload_breaker_open.set(
                 1.0 if self._escape_breaker.is_open else 0.0)
+        # performance observatory: drain per-stage host seconds from the
+        # sampling profiler (inc-only deltas) and refresh the SLO
+        # rolling-window quantile + burn-rate gauges
+        if self._profiler is not None:
+            for stage, secs in self._profiler.drain_stage_seconds().items():
+                self.metrics.prom.host_stage_seconds.inc(secs, stage)
+        if self._slo is not None:
+            q = self._slo.quantiles()
+            for quant in ("p50", "p95", "p99"):
+                self.metrics.prom.slo_latency_ms.set(q[f"{quant}_ms"], quant)
+            for window, burn in self._slo.burn_rates().items():
+                self.metrics.prom.slo_burn_rate.set(burn, window)
         return self.metrics.expose()
 
     # -- event handlers (eventhandlers.go:249) ---------------------------
@@ -1904,9 +1977,28 @@ class Scheduler:
         if stagelat.ENABLED:
             stagelat.record("bind_confirm", now - t_phase)
             stagelat.record("disp_to_bound", latency)
+        e2e_lats = [now - q.initial_attempt_timestamp for _, q, _, _ in bound]
         self.metrics.observe_e2e(
-            [(now - q.initial_attempt_timestamp, q.attempts)
-             for _, q, _, _ in bound])
+            [(lat, q.attempts)
+             for lat, (_, q, _, _) in zip(e2e_lats, bound)])
+        if self._slo is not None:
+            # SLO tracker tap: the submit->bind latencies of this wave
+            # feed the rolling windows; a wave that lands past the
+            # target while the budget is burning gets a profile slice
+            # attached to its bind span (what WAS the host doing?)
+            self._slo.observe(e2e_lats, now=now)
+            if (bind_sp is not None
+                    and max(e2e_lats, default=0.0) > self._slo.target_s
+                    and self._slo.breached(now=now)):
+                attrs = {"slo_target_ms": self._slo.target_s * 1e3,
+                         "wave_p_max_ms": round(max(e2e_lats) * 1e3, 2)}
+                if self._profiler is not None:
+                    for i, (stack, n) in enumerate(
+                            self._profiler.top_stacks(5)):
+                        attrs[f"stack_{i}"] = f"{n} {stack}"
+                    attrs["stage_seconds"] = str(
+                        self._profiler.stage_seconds())
+                bind_sp.add_event("slo_breach_profile", **attrs)
         if run_post_bind:
             for state, qpi, node_name, assumed in bound:
                 try:

@@ -7,17 +7,14 @@ sitecustomize (JAX_PLATFORMS=axon), so env vars alone don't stick — we
 override through jax.config before any backend initializes.
 """
 
-import os
+import pytest
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+# the shared bootstrap (also used by tools/collective_census.py and the
+# runtime census): sets XLA_FLAGS/JAX_PLATFORMS before the jax import
+# AND forces the platform through jax.config
+from kubernetes_tpu.component_base.profiling import ensure_virtual_mesh
 
-import jax  # noqa: E402
-import pytest  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+ensure_virtual_mesh(8)
 
 
 @pytest.fixture

@@ -43,7 +43,7 @@ def run_rule(ctx: LintContext, name: str) -> list[Finding]:
 
 def test_registry_has_the_full_catalog():
     rules = all_rules()
-    assert len(rules) >= 16
+    assert len(rules) >= 18
     for name, rule in rules.items():
         assert name == rule.name
         assert rule.doc, f"rule {name} has no doc line"
@@ -478,6 +478,98 @@ def test_taxonomy_sync_covers_bind_conflict_outcomes(tmp_path):
     found = run_rule(ctx, "taxonomy-sync")
     msgs = " ".join(f.message for f in found)
     assert "'fenced'" in msgs and "'requeued'" not in msgs
+
+
+# -- observability rules ---------------------------------------------------
+
+_METRICS_README = """\
+    # Fix
+
+    ### Metrics
+
+    | metric | kind |
+    |---|---|
+    | `fix_binds_total` | counter |
+    """
+
+
+def test_metric_documented_fires_both_directions(tmp_path):
+    stale = _METRICS_README + "| `fix_stale_total` | counter |\n"
+    ctx = make_ctx(tmp_path, {
+        f"{PKG}/scheduler/metrics.py": """\
+            from ..component_base import metrics as cbm
+
+            BINDS = cbm.Counter("fix_binds_total", "Binds.")
+            GHOST = cbm.Gauge("fix_ghost_gauge", "Never documented.")
+            """,
+        "README.md": stale,
+    }, readme=tmp_path / "README.md")
+    found = run_rule(ctx, "metric-documented")
+    msgs = " ".join(f.message for f in found)
+    assert "'fix_ghost_gauge'" in msgs      # constructed, undocumented
+    assert "'fix_stale_total'" in msgs      # documented, never constructed
+    assert "'fix_binds_total'" not in msgs
+
+
+def test_metric_documented_clean_and_counter_discriminator(tmp_path):
+    ctx = make_ctx(tmp_path, {
+        f"{PKG}/scheduler/metrics.py": """\
+            from collections import Counter
+
+            from ..component_base import metrics as cbm
+
+            BINDS = cbm.Counter("fix_binds_total", "Binds.",
+                                labels=("result",))
+            tallies = Counter()              # NOT a metric: no name+help
+            hist = Counter(["a", "b"])
+            """,
+        "README.md": _METRICS_README,
+    }, readme=tmp_path / "README.md")
+    assert run_rule(ctx, "metric-documented") == []
+
+
+def test_profiling_gated_fires_on_defaults_and_bare_hooks(tmp_path):
+    ctx = make_ctx(tmp_path, {
+        f"{PKG}/scheduler/config.py": """\
+            class ProfilingPolicy:
+                enabled: bool = True
+                census: bool = False
+            """,
+        f"{PKG}/perf/harness.py": """\
+            from ..component_base import profiling
+
+            def setup(sched, profiler):
+                sched.configure_profiling(profiler)
+                sched.run_device_census()
+                profiling.default_host_profiler.start()
+            """,
+    })
+    found = run_rule(ctx, "profiling-gated")
+    msgs = " ".join(f.message for f in found)
+    assert "ProfilingPolicy.enabled" in msgs
+    assert "configure_profiling" in msgs
+    assert "run_device_census" in msgs
+    assert "default_host_profiler.start" in msgs
+
+
+def test_profiling_gated_clean_when_stanza_guarded(tmp_path):
+    ctx = make_ctx(tmp_path, {
+        f"{PKG}/scheduler/config.py": """\
+            class ProfilingPolicy:
+                enabled: bool = False
+                census: bool = False
+            """,
+        f"{PKG}/perf/harness.py": """\
+            from ..component_base import profiling
+
+            def setup(cfg, sched, profiler):
+                if cfg.profiling.enabled or cfg.profiling.census:
+                    profiling.default_host_profiler.start()
+                    sched.configure_profiling(profiler)
+                    sched.run_device_census()
+            """,
+    })
+    assert run_rule(ctx, "profiling-gated") == []
 
 
 # -- device rules ----------------------------------------------------------

@@ -1,0 +1,521 @@
+"""Continuous performance observatory (component_base/profiling.py and
+its wiring): HLO collective census, runtime-vs-tool parity, host
+profiler lifecycle + pinned overhead bound, /debug/profile endpoints,
+SLO burn-rate tracker, cross-process metrics federation under seeded
+instance churn, and the 0.010 SLO-boundary latency bucket."""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.component_base import metrics as cbm
+from kubernetes_tpu.component_base import profiling
+from kubernetes_tpu.component_base.profiling import (
+    HostProfiler,
+    SLOTracker,
+    census_from_hlo,
+    classify_stage,
+    collective_bytes_by_op,
+    federate,
+    federate_texts,
+    parse_prometheus_text,
+    shape_bytes,
+)
+from kubernetes_tpu.ops import faults
+from kubernetes_tpu.ops.flatten import Caps
+from kubernetes_tpu.perf import run_named_workload
+from kubernetes_tpu.scheduler.config import (
+    ConfigError,
+    ProfilingPolicy,
+    _parse_profiling,
+)
+
+# Small caps: fast compiles / cheap host tensors (test_scheduler_perf).
+CAPS = Caps(n_cap=64, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8, s_cap=2,
+            sg_cap=8, asg_cap=8)
+
+
+# -- HLO collective census core ---------------------------------------------
+
+# Hand-built optimized-HLO module: an all-reduce and an async
+# reduce-scatter pair inside the while body (per-wave), an all-gather in
+# ENTRY (per-call).  The -done op must NOT be counted (its -start is).
+SYNTH_HLO = """\
+HloModule synthetic
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+%wave_body (p: (s32[], f32[8,4])) -> (s32[], f32[8,4]) {
+  %p = (s32[], f32[8,4]) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[8,4]) %p), index=0
+  %x = f32[8,4] get-tuple-element((s32[], f32[8,4]) %p), index=1
+  %ar = f32[8,4] all-reduce(f32[8,4] %x), to_apply=%add
+  %rs = (f32[16,4], f32[2,4]) reduce-scatter-start(f32[16,4] %y), dimensions={0}, to_apply=%add
+  %rsd = f32[2,4] reduce-scatter-done((f32[16,4], f32[2,4]) %rs)
+  ROOT %t = (s32[], f32[8,4]) tuple(s32[] %i, f32[8,4] %ar)
+}
+
+%wave_cond (p: (s32[], f32[8,4])) -> pred[] {
+  %p = (s32[], f32[8,4]) parameter(0)
+  ROOT %lt = pred[] compare(s32[] %i, s32[] %n), direction=LT
+}
+
+ENTRY %main (z: f32[8,4]) -> f32[32,4] {
+  %z = f32[8,4] parameter(0)
+  %w = (s32[], f32[8,4]) while((s32[], f32[8,4]) %init), condition=%wave_cond, body=%wave_body
+  ROOT %ag = f32[32,4] all-gather(f32[8,4] %z), dimensions={0}
+}
+"""
+
+
+class TestCensusFromHLO:
+    def test_shape_bytes(self):
+        assert shape_bytes("f32[8,4]") == 128
+        assert shape_bytes("bf16[16]") == 32
+        assert shape_bytes("pred[]") == 1          # scalar: 1 elem x 1 byte
+        assert shape_bytes("(f32[2,2], s32[3])") == 16 + 12
+
+    def test_while_body_and_async_start(self):
+        rec = census_from_hlo(SYNTH_HLO)
+        cols = rec["collectives"]
+        ar = cols["all-reduce f32[8,4]"]
+        assert (ar["count"], ar["bytes"], ar["per_wave"]) == (1, 128, True)
+        # async start: bytes are the RESULT element (last tuple shape)
+        rs = cols["reduce-scatter (f32[16,4], f32[2,4])"]
+        assert (rs["count"], rs["bytes"], rs["per_wave"]) == (1, 32, True)
+        ag = cols["all-gather f32[32,4]"]
+        assert (ag["count"], ag["bytes"], ag["per_wave"]) == (1, 512, False)
+        # the reduce-scatter-done op did not produce a fourth entry
+        assert len(cols) == 3
+        assert rec["per_wave_bytes"] == 128 + 32
+        assert rec["per_call_bytes"] == 512
+
+    def test_collective_bytes_by_op(self):
+        per_wave, per_call = collective_bytes_by_op(
+            census_from_hlo(SYNTH_HLO))
+        assert per_wave == {"all-reduce": 128, "reduce-scatter": 32}
+        assert per_call == {"all-gather": 512}
+
+
+# -- runtime census vs offline tool (bit-for-bit) ----------------------------
+
+class TestCensusParity:
+    def test_single_chip_census_deterministic(self):
+        """TPUBatchBackend.device_census: structure + determinism (two
+        lowerings of the same step yield the identical record)."""
+        from kubernetes_tpu.ops.backend import TPUBatchBackend
+
+        backend = TPUBatchBackend(CAPS, batch_size=16)
+        a = backend.device_census(variants=("plain",))
+        b = backend.device_census(variants=("plain",))
+        assert a == b
+        rec = a["plain"]
+        assert set(rec) >= {"collectives", "per_call_bytes",
+                            "per_wave_bytes", "cost"}
+        # single chip: no ICI collectives, but real XLA cost numbers
+        assert rec["per_wave_bytes"] == 0 and rec["per_call_bytes"] == 0
+        assert rec["cost"].get("flops", 0) > 0
+
+    def test_sharded_census_matches_tool(self):
+        """The acceptance pin: the RUNNING backend's census equals
+        tools/collective_census.py bit-for-bit at the same shapes on the
+        8-way virtual mesh (same fn builder, same abstract inputs, same
+        HLO walk)."""
+        import jax
+
+        if not hasattr(jax, "shard_map"):
+            pytest.skip("jax.shard_map unavailable on this toolchain "
+                        "(device image only)")
+        from kubernetes_tpu.parallel.backend import ShardedTPUBatchBackend
+        from kubernetes_tpu.parallel.census import (
+            round_caps_to_mesh,
+            sharded_census,
+        )
+        from kubernetes_tpu.perf import caps_for_nodes
+
+        nodes, batch = 256, 32
+        tool = sharded_census(nodes, batch, "full")
+        caps = round_caps_to_mesh(caps_for_nodes(nodes), len(jax.devices()))
+        backend = ShardedTPUBatchBackend(caps, batch_size=batch)
+        runtime = backend.device_census(variants=("full",))["full"]
+        for key in ("collectives", "per_wave_bytes", "per_call_bytes",
+                    "cost"):
+            assert runtime[key] == tool[key], key
+        # and the gauges derived from both agree
+        assert collective_bytes_by_op(runtime) == \
+            collective_bytes_by_op(tool)
+
+
+# -- host sampling profiler --------------------------------------------------
+
+def _sampler_threads():
+    return [t for t in threading.enumerate()
+            if t.name == HostProfiler.THREAD_NAME]
+
+
+class TestHostProfiler:
+    def test_start_stop_leaves_no_sampler_thread(self):
+        prof = HostProfiler(interval=0.001)
+        prof.start()
+        prof.start()                      # idempotent
+        assert prof.running
+        assert len(_sampler_threads()) == 1
+        time.sleep(0.05)
+        assert prof.stop()
+        assert prof.stop()                # idempotent
+        assert not prof.running
+        assert not _sampler_threads()
+        assert prof.samples_total() > 0
+
+    def test_collapsed_output_parses(self):
+        prof = HostProfiler(interval=0.001)
+        prof.start()
+        time.sleep(0.05)
+        prof.stop()
+        text = prof.collapsed()
+        lines = [ln for ln in text.splitlines() if ln]
+        assert lines
+        for ln in lines:
+            stack, count = ln.rsplit(" ", 1)
+            assert int(count) > 0
+            assert stack                     # root-first frames, ; joined
+
+    def test_bounded_stacks_overflow_to_other(self):
+        prof = HostProfiler(interval=0.001, max_stacks=1)
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(range(100))
+
+        t = threading.Thread(target=spin, name="spin-worker", daemon=True)
+        t.start()
+        prof.start()
+        time.sleep(0.08)
+        prof.stop()
+        stop.set()
+        t.join()
+        with prof._lock:
+            keys = list(prof._stacks)
+        distinct = [k for k in keys if not k.endswith("<other>")]
+        assert len(distinct) <= 1            # bound held; rest folded
+
+    def test_drain_stage_seconds_is_delta(self):
+        prof = HostProfiler(interval=0.001)
+        prof.start()
+        time.sleep(0.05)
+        prof.stop()
+        first = prof.drain_stage_seconds()
+        assert first and all(v > 0 for v in first.values())
+        assert prof.drain_stage_seconds() == {}   # nothing new since
+        total = prof.stage_seconds()
+        assert sum(total.values()) == pytest.approx(sum(first.values()))
+
+    def test_classify_stage(self):
+        assert classify_stage("bind-3", []) == "binder"
+        assert classify_stage("sched-loop", []) == "submitter"
+        assert classify_stage("informer-pods", []) == "informer"
+        assert classify_stage("MainThread", []) == "main"
+        assert classify_stage("mystery", []) == "other"
+        # binder frame carve-out wins over the thread-name mapping
+        assert classify_stage("sched-loop",
+                              ["poll", "_bulk_bind_commit"]) == "binder"
+
+
+# -- SLO tracker -------------------------------------------------------------
+
+class TestSLOTracker:
+    def make(self, **kw):
+        self.now = [0.0]
+        kw.setdefault("target_ms", 10.0)
+        kw.setdefault("objective", 0.99)
+        kw.setdefault("windows", (60.0, 300.0, 3600.0))
+        return SLOTracker(time_fn=lambda: self.now[0], **kw)
+
+    def test_quantiles(self):
+        slo = self.make()
+        slo.observe([i / 1000.0 for i in range(1, 101)])   # 1..100 ms
+        q = slo.quantiles()
+        assert q["count"] == 100
+        assert q["p50_ms"] == pytest.approx(51.0)
+        assert q["p95_ms"] == pytest.approx(96.0)
+        assert q["p99_ms"] == pytest.approx(100.0)
+        assert slo.target_s == pytest.approx(0.010)
+
+    def test_burn_rate_boundary_not_breached(self):
+        """Burn of exactly 1.0 consumes budget at the sustainable rate:
+        NOT an arm signal (breached requires strictly > 1.0)."""
+        slo = self.make()
+        slo.observe([0.001] * 99 + [0.02])     # 1/100 over, budget 0.01
+        rates = slo.burn_rates()
+        assert rates["60s"] == pytest.approx(1.0)
+        assert not slo.breached()
+        slo.observe([0.02, 0.02])              # 3/102 over -> ~2.9x
+        assert slo.burn_rates()["60s"] > 1.0
+        assert slo.breached()
+
+    def test_multi_window_confirmation(self):
+        """Old breaches age out of the short window: the fast window
+        must CONFIRM the burn or the tracker disarms."""
+        slo = self.make()
+        slo.observe([0.05] * 10, now=0.0)      # all over target
+        assert slo.breached(now=1.0)
+        # 70s later: outside the 60s window, still inside 300s
+        rates = slo.burn_rates(now=70.0)
+        assert rates["60s"] == 0.0 and rates["300s"] > 1.0
+        assert not slo.breached(now=70.0)
+
+    def test_objective_validated(self):
+        with pytest.raises(ValueError):
+            SLOTracker(objective=1.0)
+
+
+# -- cross-process metrics federation ----------------------------------------
+
+class TestFederation:
+    def test_parse_prometheus_text(self):
+        text = ("# HELP a_total [ALPHA] help\n"
+                "# TYPE a_total counter\n"
+                'a_total{x="1"} 3\n'
+                'a_total{x="2"} 4.5\n'
+                "b_gauge 7\n"
+                'h_bucket{le="0.01"} 2\n'
+                "garbage line ===\n")
+        out = parse_prometheus_text(text)
+        assert out["a_total"] == {("1",): 3.0, ("2",): 4.5}
+        assert out["b_gauge"] == {(): 7.0}
+        assert out["h_bucket"] == {("0.01",): 2.0}
+
+    def test_federate_sums_floats_and_tuples(self):
+        a = {"c_total": {("x",): 2.0}, "h": {(): (3, 0.5)}}
+        b = {"c_total": {("x",): 3.0, ("y",): 1.0}, "h": {(): (1, 0.25)}}
+        out = federate([a, b])
+        assert out["c_total"] == {("x",): 5.0, ("y",): 1.0}
+        assert out["h"] == {(): (4, 0.75)}
+
+    def test_federation_under_seeded_instance_churn(self):
+        """Fleet totals survive kills and revives: an instance killed
+        mid-run contributes its last /metrics snapshot; a revived slot
+        restarts from a fresh registry.  Ground truth is tracked in
+        plain dicts alongside, and the federated view must equal it."""
+
+        def fresh_instance():
+            reg = cbm.Registry()
+            c = cbm.Counter("fleet_binds_total", "Binds per instance.",
+                            labels=("result",))
+            g = cbm.Gauge("fleet_capacity", "Slots per instance.")
+            reg.must_register(c, g)
+            return reg, c, g
+
+        n = 4
+        instances = [fresh_instance() for _ in range(n)]
+        sched = faults.ScaleOutSchedule(seed=7, instance_count=n,
+                                        kill_rate=0.25, revive_rate=0.25)
+        truth_binds = 0.0
+        dead_snapshots = []
+        kills = revives = 0
+        for wave in range(60):
+            for slot in instances:
+                if slot is None:
+                    continue
+                _, c, g = slot
+                inc = float(wave % 5 + 1)
+                c.inc(inc, "bound")
+                g.set(2.0)
+                truth_binds += inc
+            act, victim = sched.action(wave)
+            if act == faults.KILL_INSTANCE and instances[victim] is not None:
+                dead_snapshots.append(instances[victim][0].expose())
+                instances[victim] = None
+                kills += 1
+            elif act == faults.REVIVE_INSTANCE and instances[victim] is None:
+                instances[victim] = fresh_instance()
+                revives += 1
+        assert kills > 0 and revives > 0     # seed actually churned
+        live = [slot for slot in instances if slot is not None]
+        fleet = federate_texts(
+            dead_snapshots + [reg.expose() for reg, _, _ in live])
+        assert fleet["fleet_binds_total"][("bound",)] == \
+            pytest.approx(truth_binds)
+        # gauges sum across LIVE instances only (dead snapshots carry
+        # the victim's last value; here each live instance reports 2)
+        assert fleet["fleet_capacity"][()] >= 2.0 * len(live)
+
+
+# -- SLO-boundary latency bucket ---------------------------------------------
+
+class TestLatencyBuckets:
+    def test_explicit_10ms_boundary(self):
+        from kubernetes_tpu.scheduler.metrics import _LATENCY_BUCKETS
+
+        assert 0.010 in _LATENCY_BUCKETS
+        # strictly increasing: no duplicate boundaries after the insert
+        assert all(a < b for a, b in
+                   zip(_LATENCY_BUCKETS, _LATENCY_BUCKETS[1:]))
+
+    def test_cumulative_counts_monotone(self):
+        from kubernetes_tpu.scheduler.metrics import _LATENCY_BUCKETS
+
+        h = cbm.Histogram("t_seconds", "h", buckets=_LATENCY_BUCKETS)
+        for v in (0.008, 0.0095, 0.010, 0.0101, 0.016, 0.2):
+            h.observe(v)
+        series = {}
+        for line in h.collect():
+            if "_bucket" not in line:
+                continue
+            le = line.split('le="', 1)[1].split('"', 1)[0]
+            series[le] = int(line.rsplit(" ", 1)[1])
+        counts = list(series.values())   # exposition order: ascending le
+        assert counts == sorted(counts)
+        assert series["+Inf"] == 6
+        # a 10ms observation counts as within the <=10ms SLO boundary
+        assert series["0.01"] - series["0.008"] == 2   # 0.0095 and 0.010
+
+
+# -- /debug/profile endpoints ------------------------------------------------
+
+def _assert_collapsed(body: str):
+    lines = [ln for ln in body.splitlines() if ln]
+    assert lines
+    for ln in lines:
+        stack, count = ln.rsplit(" ", 1)
+        assert int(count) > 0 and stack
+
+
+class TestDebugProfileEndpoints:
+    def test_apiserver_serves_collapsed_stacks(self):
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.store import kv
+
+        prof = profiling.default_host_profiler
+        prof.reset()
+        prof.start()
+        server = APIServer(kv.MemoryStore()).start()
+        try:
+            time.sleep(0.05)
+            with urllib.request.urlopen(server.url + "/debug/profile",
+                                        timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                _assert_collapsed(resp.read().decode())
+        finally:
+            server.stop()
+            prof.stop()
+            prof.reset()
+
+    def test_device_worker_serves_collapsed_stacks(self):
+        from kubernetes_tpu.ops.remote import DeviceWorker
+
+        prof = profiling.default_host_profiler
+        prof.reset()
+        prof.start()
+        worker = DeviceWorker().start()
+        try:
+            time.sleep(0.05)
+            with urllib.request.urlopen(worker.url + "/debug/profile",
+                                        timeout=10) as resp:
+                assert resp.status == 200
+                _assert_collapsed(resp.read().decode())
+        finally:
+            worker.stop()
+            prof.stop()
+            prof.reset()
+
+
+# -- profiling: config stanza ------------------------------------------------
+
+class TestProfilingConfig:
+    def test_defaults_off(self):
+        p = ProfilingPolicy()
+        assert not p.enabled and not p.census
+
+    def test_parse_stanza(self):
+        p = _parse_profiling({"enabled": True, "census": True,
+                              "sampleIntervalMs": 2,
+                              "sloTargetMs": 5,
+                              "burnWindowsSeconds": [30, 120]})
+        assert p.enabled and p.census
+        assert p.sample_interval_ms == 2
+        assert p.slo_target_ms == 5
+        assert p.burn_windows_s == (30.0, 120.0)
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ConfigError):
+            _parse_profiling({"enabld": True})
+
+
+# -- end-to-end: profiled null-device workload + overhead bound --------------
+
+def _small_cfg(pods=600):
+    # 40 nodes fits CAPS.n_cap=64: pods stay on the batch path (the
+    # per-pod oracle fallback has no SLO tap to exercise)
+    return {"workloadTemplate": [
+        {"opcode": "createNodes", "count": 40},
+        {"opcode": "createPods", "count": pods},
+        {"opcode": "barrier", "timeout": 120.0},
+    ]}
+
+
+class TestProfiledWorkload:
+    def test_observatory_readout_and_overhead(self):
+        """Profiler-on run populates host stages / samples / SLO stats,
+        leaves no sampler thread behind, and stays within a pinned 2x
+        throughput bound of the profiler-off run on the null-device
+        host bench."""
+        summary_off, stats_off = run_named_workload(
+            _small_cfg(), tpu=True, caps=CAPS, batch_size=128,
+            null_device=True)
+        assert stats_off["barrier_ok"]
+        assert "host_stages" not in stats_off      # off: no readout keys
+
+        policy = ProfilingPolicy(enabled=True, sample_interval_ms=2.0,
+                                 slo_target_ms=10.0)
+        summary_on, stats_on = run_named_workload(
+            _small_cfg(), tpu=True, caps=CAPS, batch_size=128,
+            null_device=True, profiling_policy=policy)
+        assert stats_on["barrier_ok"]
+        assert not _sampler_threads()              # harness stopped it
+        assert stats_on["profile_samples"] > 0
+        stages = stats_on["host_stages"]
+        assert stages and sum(stages.values()) > 0
+        slo = stats_on["slo"]
+        assert slo["count"] == 600                 # every bound pod fed
+        assert set(slo["burn_rates"]) == {"60s", "300s", "3600s"}
+        # pinned overhead bound: sampling must not halve throughput
+        assert summary_on.average >= summary_off.average / 2.0
+
+    def test_slo_gauges_in_exposition(self):
+        """The scheduler's /metrics page carries the SLO quantile and
+        burn-rate series after a profiled run."""
+        from kubernetes_tpu.perf.scheduler_perf import setup_cluster
+
+        policy = ProfilingPolicy(enabled=True, slo_target_ms=10.0)
+        cluster = setup_cluster(tpu=True, caps=CAPS, batch_size=128,
+                                null_device=True, profiling_policy=policy)
+        try:
+            cluster.scheduler._slo.observe([0.002, 0.004, 0.02])
+            time.sleep(0.05)               # let the sampler take a few
+            text = cluster.scheduler.expose_metrics()
+            parsed = parse_prometheus_text(text)
+            assert ("p99",) in parsed["scheduler_slo_latency_ms"]
+            assert ("60s",) in parsed["scheduler_slo_burn_rate"]
+            assert "scheduler_host_stage_seconds" in text
+        finally:
+            cluster.shutdown()
+            profiling.default_host_profiler.stop()
+            profiling.default_host_profiler.reset()
+
+    def test_e2e_summary_includes_p95(self):
+        from kubernetes_tpu.scheduler.scheduler import SchedulerMetrics
+
+        m = SchedulerMetrics()
+        m.observe_e2e([(i / 1000.0, 1) for i in range(1, 41)])
+        s = m.e2e_summary()
+        assert s["p50_ms"] <= s["p95_ms"] <= s["p99_ms"] <= s["max_ms"]
+        assert "p95_ms" in s

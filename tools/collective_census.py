@@ -9,163 +9,30 @@ device; lowering is shape-exact, so the counts/bytes are the ones a real
 v5e-8 would run, and wave multiplicity (which collectives sit inside the
 wave loop) is reported from the HLO's while-body nesting.
 
-Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-          python tools/collective_census.py [nodes] [batch] [plain|full]
+This is now a thin CLI: the lowering path and HLO walk live in
+kubernetes_tpu/parallel/census.py and component_base/profiling.py, the
+SAME code the running scheduler's `device_census()` executes — so this
+tool's output and the tpu_wave_collective_bytes gauges agree bit-for-bit
+by construction (pinned by tests/test_profiling.py).
+
+Run:  python tools/collective_census.py [nodes] [batch] [plain|full]
 """
 
 import json
 import os
-import re
 import sys
 
-# the image's sitecustomize pins JAX_PLATFORMS=axon (the chip tunnel);
-# env vars alone don't stick — override through jax.config before the
-# backend initializes, exactly like tests/conftest.py
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-DTYPE_BYTES = {"f32": 4, "s32": 4, "u32": 4, "bf16": 2, "f16": 2,
-               "pred": 1, "s8": 1, "u8": 1, "f64": 8, "s64": 8, "u64": 8}
+from kubernetes_tpu.component_base.profiling import ensure_virtual_mesh  # noqa: E402
 
-COLLECTIVE_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
-    r"(.*?)\s*"
-    r"(all-gather|all-reduce|reduce-scatter|collective-permute|"
-    r"all-to-all)\(", re.M)
-SHAPE_RE = re.compile(r"(f32|s32|u32|bf16|f16|pred|s8|u8|f64|s64|u64)"
-                      r"\[([\d,]*)\]")
-
-
-def shape_bytes(type_str: str) -> int:
-    total = 0
-    for dt, dims in SHAPE_RE.findall(type_str):
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * DTYPE_BYTES[dt]
-    return total
+ensure_virtual_mesh(8)
 
 
 def census(nodes: int, batch: int, variant: str) -> dict:
-    import jax
-    import numpy as np
+    from kubernetes_tpu.parallel.census import sharded_census
 
-    from kubernetes_tpu.models.assign import ALL_FEATURES, PLAIN_FEATURES
-    from kubernetes_tpu.parallel.mesh import (
-        build_sharded_step_fn, make_mesh, state_specs, static_specs,
-    )
-    from kubernetes_tpu.perf import caps_for_nodes
-
-    caps = caps_for_nodes(nodes)
-    # round n_cap to a mesh multiple
-    n_dev = len(jax.devices())
-    if caps.n_cap % n_dev:
-        caps.n_cap += n_dev - caps.n_cap % n_dev
-    mesh = make_mesh()
-    features = PLAIN_FEATURES if variant == "plain" else ALL_FEATURES
-    fn = build_sharded_step_fn(caps, mesh, features=features)
-
-    # shape-only abstract inputs
-    import jax.numpy as jnp
-    c = caps
-    P_, R, PT = batch, c.r, c.pt_cap
-
-    def zeros(shape, dtype=jnp.float32):
-        return jax.ShapeDtypeStruct(shape, dtype)
-
-    state = {"used": zeros((c.n_cap, R)), "used_nz": zeros((c.n_cap, R)),
-             "npods": zeros((c.n_cap,)), "port_mask": zeros((c.n_cap, PT)),
-             "cd_sg": zeros((c.sg_cap, c.n_cap)),
-             "cd_asg": zeros((c.asg_cap, c.n_cap))}
-    static = {"alloc": zeros((c.n_cap, R)), "maxpods": zeros((c.n_cap,)),
-              "valid": zeros((c.n_cap,), jnp.bool_),
-              "taint_mask": zeros((c.n_cap, c.t_cap)),
-              "label_mask": zeros((c.n_cap, c.l_cap)),
-              "key_mask": zeros((c.n_cap, c.kl_cap)),
-              "dom_sg": zeros((c.sg_cap, c.n_cap), jnp.int32),
-              "dom_asg": zeros((c.asg_cap, c.n_cap), jnp.int32)}
-    pods = {"req": zeros((P_, R)), "req_nz": zeros((P_, R)),
-            "p_valid": zeros((P_,), jnp.bool_),
-            "untol_hard": zeros((P_, c.t_cap)),
-            "untol_prefer": zeros((P_, c.t_cap)),
-            "sel_any": zeros((P_, c.g_cap, c.l_cap)),
-            "sel_any_active": zeros((P_, c.g_cap)),
-            "sel_forb": zeros((P_, c.l_cap)),
-            "key_any": zeros((P_, c.kg_cap, c.kl_cap)),
-            "key_any_active": zeros((P_, c.kg_cap)),
-            "key_forb": zeros((P_, c.kl_cap)),
-            "ports": zeros((P_, PT)),
-            "node_row": zeros((P_,), jnp.int32),
-            "c_kind": zeros((P_, c.c_cap), jnp.int32),
-            "c_sg": zeros((P_, c.c_cap), jnp.int32),
-            "c_maxskew": zeros((P_, c.c_cap)),
-            "c_selfmatch": zeros((P_, c.c_cap)),
-            "c_weight": zeros((P_, c.c_cap)),
-            "inc_sg": zeros((P_, c.sg_cap)),
-            "inc_asg": zeros((P_, c.asg_cap)),
-            "match_asg": zeros((P_, c.asg_cap))}
-    k_cap = 1024
-    prows = zeros((k_cap,), jnp.int32)
-    pvals = zeros((k_cap, 2 * R + 1 + PT))
-
-    lowered = fn.lower(state, static, pods, prows, pvals)
-    hlo = lowered.compile().as_text()
-
-    # split module into computations; while-loop bodies are separate
-    # computations whose callers are while ops — collectives there run
-    # once PER WAVE
-    comps: dict[str, str] = {}
-    cur = None
-    for line in hlo.splitlines():
-        # computation headers: "%name (params...) -> type {" — params may
-        # contain nested parens (tuple types), so match only the prefix
-        m = re.match(r"^(?:ENTRY\s+)?%([\w.\-]+)\s*\(", line)
-        if m and line.rstrip().endswith("{"):
-            cur = m.group(1)
-            comps[cur] = ""
-        elif cur is not None:
-            comps[cur] += line + "\n"
-    while_bodies = set(re.findall(r"body=%?([\w.\-]+)", hlo))
-    # transitively include computations called from while bodies
-    call_re = re.compile(r"(?:calls|to_apply|body|condition)=%?([\w.\-]+)")
-    frontier = set(while_bodies)
-    in_loop = set()
-    while frontier:
-        nxt = set()
-        for name in frontier:
-            if name in in_loop:
-                continue
-            in_loop.add(name)
-            nxt |= set(call_re.findall(comps.get(name, "")))
-        frontier = nxt - in_loop
-
-    out: dict[str, dict] = {}
-    for comp, body in comps.items():
-        for m in COLLECTIVE_RE.finditer(body):
-            out_type, op = m.group(1), m.group(2)
-            b = shape_bytes(out_type)
-            key = f"{op} {out_type.strip()}"
-            rec = out.setdefault(key, {"op": op, "count": 0, "bytes": b,
-                                       "per_wave": False})
-            rec["count"] += 1
-            if comp in in_loop:
-                rec["per_wave"] = True
-    return {"nodes": nodes, "batch": batch, "variant": variant,
-            "mesh_devices": n_dev, "n_cap": caps.n_cap,
-            "collectives": out,
-            "per_call_bytes": sum(r["bytes"] * r["count"]
-                                  for r in out.values()
-                                  if not r["per_wave"]),
-            "per_wave_bytes": sum(r["bytes"] * r["count"]
-                                  for r in out.values() if r["per_wave"])}
+    return sharded_census(nodes, batch, variant)
 
 
 if __name__ == "__main__":

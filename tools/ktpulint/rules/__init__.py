@@ -1,3 +1,5 @@
 """Rule modules; importing this package populates engine.REGISTRY."""
 
-from . import device, lifecycle, pipeline, threads, wiring  # noqa: F401
+from . import (  # noqa: F401
+    device, lifecycle, observability, pipeline, threads, wiring,
+)

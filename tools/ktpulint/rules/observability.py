@@ -1,0 +1,201 @@
+"""Observability rules: the metrics-documentation gate and the
+profiling-stanza gating check (PR: continuous performance observatory).
+
+Reference: hack/verify-generated-docs.sh + the reference's metrics
+stability framework (k8s.io/component-base/metrics stability levels,
+which fail CI when a metric changes without a docs update) — reshaped
+for THIS repo: the README "### Metrics" table is the operator contract,
+and the always-on profiler/census must stay opt-in.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..engine import Finding, LintContext, Rule, register
+
+_METRIC_KINDS = ("Counter", "Gauge", "Histogram")
+_TABLE_NAME_RE = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`")
+_TICK_RE = re.compile(r"`([a-z_][a-z0-9_]*)`")
+
+
+def _metric_calls(tree: ast.AST) -> Iterator[tuple[str, int]]:
+    """(metric_name, line) for every cbm.Counter/Gauge/Histogram
+    construction.  Discriminator from collections.Counter & co: the
+    first TWO positional args are string literals (name + help) — no
+    non-metric Counter takes that shape."""
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        tail = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if tail not in _METRIC_KINDS or len(n.args) < 2:
+            continue
+        name_a, help_a = n.args[0], n.args[1]
+        if (isinstance(name_a, ast.Constant) and isinstance(name_a.value, str)
+                and isinstance(help_a, ast.Constant)
+                and isinstance(help_a.value, str)):
+            yield name_a.value, n.lineno
+
+
+@register
+class MetricDocumentedRule(Rule):
+    """Every metric name constructed in non-test package code appears in
+    the README "### Metrics" table and vice versa — an undocumented
+    series is a dashboard nobody can read, and a documented series
+    nobody emits is a stale operator contract (the metrics twin of
+    taxonomy-sync)."""
+
+    name = "metric-documented"
+    scope = "project"
+    doc = "constructed metric names and the README metrics table agree"
+
+    SECTION = "### Metrics"
+
+    def _readme_table(self, ctx: LintContext):
+        """(tokens, rows): all backticked lowercase tokens inside the
+        metrics section, plus the first-column metric names per row."""
+        if not ctx.readme.is_file():
+            return None
+        tokens: set[str] = set()
+        rows: list[tuple[str, int]] = []
+        in_section = False
+        for i, ln in enumerate(ctx.readme.read_text().splitlines(), start=1):
+            if ln.startswith("#") and ln.lstrip("#").strip():
+                in_section = ln.strip() == self.SECTION
+                continue
+            if not in_section:
+                continue
+            m = _TABLE_NAME_RE.match(ln)
+            if m:
+                rows.append((m.group(1), i))
+            tokens.update(_TICK_RE.findall(ln))
+        return tokens, rows
+
+    def check_project(self, ctx: LintContext):
+        table = self._readme_table(ctx)
+        if table is None:
+            return
+        tokens, rows = table
+        code: dict[str, tuple[str, int]] = {}
+        for path in sorted(ctx.package_root.rglob("*.py")):
+            rel = path.relative_to(ctx.repo_root).as_posix()
+            if "__pycache__" in path.parts or "/testing/" in rel:
+                continue
+            view = ctx.view(rel)
+            if view is None or view.tree is None:
+                continue
+            for mname, line in _metric_calls(view.tree):
+                code.setdefault(mname, (rel, line))
+        rel_readme = ctx.readme.name if ctx.readme.parent == ctx.repo_root \
+            else str(ctx.readme)
+        for mname, (rel, line) in sorted(code.items()):
+            if mname not in tokens:
+                yield Finding(self.name, rel, line,
+                              f"metric {mname!r} constructed here is missing "
+                              "from the README metrics table")
+        for mname, line in rows:
+            if mname not in code:
+                yield Finding(self.name, rel_readme, line,
+                              f"README documents metric {mname!r} with no "
+                              "construction site in package code")
+
+
+@register
+class ProfilingGatedRule(Rule):
+    """The performance observatory stays opt-in: ProfilingPolicy's
+    `enabled`/`census` fields default to False, and every hook that arms
+    it (configure_profiling, run_device_census, the sampler's start())
+    sits under an `if` that consults the profiling stanza — an
+    unconditional hook would make every deployment pay the sampler."""
+
+    name = "profiling-gated"
+    scope = "project"
+    doc = "profiler/census hooks are gated behind the profiling: stanza"
+
+    HOOKS = ("configure_profiling", "run_device_census")
+    _GUARD_RE = re.compile(r"profiling|census|profiler")
+
+    def _policy_defaults(self, ctx: LintContext):
+        view = ctx.view(f"{ctx.package_name}/scheduler/config.py")
+        if view is None or view.tree is None:
+            return
+        for n in ast.walk(view.tree):
+            if not (isinstance(n, ast.ClassDef)
+                    and n.name == "ProfilingPolicy"):
+                continue
+            for stmt in n.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)
+                        and stmt.target.id in ("enabled", "census")
+                        and not (isinstance(stmt.value, ast.Constant)
+                                 and stmt.value.value is False)):
+                    yield Finding(
+                        self.name, view.rel, stmt.lineno,
+                        f"ProfilingPolicy.{stmt.target.id} must default to "
+                        "False (the observatory is opt-in)")
+
+    @staticmethod
+    def _enclosing_ifs(fn: ast.AST, target: ast.AST) -> list[ast.If]:
+        out: list[ast.If] = []
+
+        def descend(node: ast.AST) -> bool:
+            if node is target:
+                return True
+            for child in ast.iter_child_nodes(node):
+                if descend(child):
+                    if isinstance(node, ast.If):
+                        out.append(node)
+                    return True
+            return False
+
+        descend(fn)
+        return out
+
+    def _is_hook(self, call: ast.Call) -> str:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in self.HOOKS:
+                return f.attr
+            if f.attr == "start" and isinstance(f.value, ast.Attribute) \
+                    and f.value.attr == "default_host_profiler":
+                return "default_host_profiler.start"
+            if f.attr == "start" and isinstance(f.value, ast.Name) \
+                    and f.value.id == "default_host_profiler":
+                return "default_host_profiler.start"
+        return ""
+
+    def check_project(self, ctx: LintContext):
+        yield from self._policy_defaults(ctx)
+        for path in sorted(ctx.package_root.rglob("*.py")):
+            rel = path.relative_to(ctx.repo_root).as_posix()
+            if "__pycache__" in path.parts or "/testing/" in rel:
+                continue
+            # the module defining the hooks is not a call site of them
+            if rel.endswith("component_base/profiling.py"):
+                continue
+            view = ctx.view(rel)
+            if view is None or view.tree is None:
+                continue
+            for fn in ast.walk(view.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                for n in ast.walk(fn):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    hook = self._is_hook(n)
+                    if not hook:
+                        continue
+                    guards = self._enclosing_ifs(fn, n)
+                    if not any(self._GUARD_RE.search(ast.unparse(g.test))
+                               for g in guards):
+                        yield Finding(
+                            self.name, rel, n.lineno,
+                            f"{hook}() called without an enclosing "
+                            "profiling-stanza guard (if ...profiling/"
+                            "census... :) — the observatory must stay "
+                            "default-off")

@@ -2,70 +2,30 @@
 """Sample-profile the null-device host pipeline across ALL threads.
 
 Python 3.12's cProfile holds the single global sys.monitoring slot, so
-per-thread deterministic profiling is impossible; this uses a sampling
-thread (sys._current_frames() at ~200 Hz) instead — low overhead, all
-threads, like py-spy.  Run:
+per-thread deterministic profiling is impossible; this samples
+sys._current_frames() instead — low overhead, all threads, like py-spy.
+
+This is now a thin CLI over component_base/profiling.HostProfiler (the
+same sampler the `profiling:` config stanza runs always-on inside the
+scheduler and serves at /debug/profile).  Run:
 
     python tools/profile_host.py [nodes] [pods] [batch]
 
 Output: per-thread CPU seconds from /proc/self/task (stage-level view),
-then leaf-frame sample counts per thread (function-level view), then
-whole-stack hot paths.  Confirm wins unprofiled via bench's
+per-pipeline-stage host-second attribution, then whole-stack hot paths
+(collapsed-stacks keys).  Confirm wins unprofiled via bench's
 SchedulingHostNull config.
 """
 
 import os
 import sys
-import threading
 import time
-from collections import Counter
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SAMPLES: dict[str, Counter] = {}   # thread name -> leaf (func:file:line) count
-STACKS: dict[str, Counter] = {}    # thread name -> abbreviated stack count
-_stop = threading.Event()
-
-
-def _sampler(interval: float = 0.005):
-    names = {}
-    while not _stop.is_set():
-        for t in threading.enumerate():
-            names[t.ident] = t.name
-        for ident, frame in sys._current_frames().items():
-            name = names.get(ident, str(ident))
-            if name == "prof-sampler":
-                continue
-            leaf = f"{frame.f_code.co_name} {frame.f_code.co_filename.rsplit('/', 1)[-1]}:{frame.f_lineno}"
-            SAMPLES.setdefault(name, Counter())[leaf] += 1
-            # abbreviated stack: innermost 6 frames, repo files only
-            parts = []
-            f = frame
-            while f is not None and len(parts) < 6:
-                fn = f.f_code.co_filename
-                if "kubernetes_tpu" in fn or fn.endswith("bench.py"):
-                    parts.append(f"{f.f_code.co_name}@{fn.rsplit('/', 1)[-1]}")
-                f = f.f_back
-            if parts:
-                STACKS.setdefault(name, Counter())[" < ".join(parts)] += 1
-        time.sleep(interval)
-
-
-def thread_cpu() -> dict:
-    out = {}
-    base = "/proc/self/task"
-    for tid in os.listdir(base):
-        try:
-            with open(f"{base}/{tid}/stat") as f:
-                parts = f.read().rsplit(")", 1)[1].split()
-            with open(f"{base}/{tid}/comm") as f:
-                comm = f.read().strip()
-            tick = os.sysconf("SC_CLK_TCK")
-            out[f"{comm}-{tid}"] = round(
-                (int(parts[11]) + int(parts[12])) / tick, 2)
-        except (OSError, IndexError, ValueError):
-            pass
-    return out
+from kubernetes_tpu.component_base.profiling import (  # noqa: E402
+    HostProfiler, thread_cpu_seconds,
+)
 
 
 def main():
@@ -89,37 +49,29 @@ def main():
             op["timeout"] = 600.0
     caps = caps_for_nodes(nodes)  # the bench's cap policy, shared
 
-    st = threading.Thread(target=_sampler, name="prof-sampler", daemon=True)
-    st.start()
+    prof = HostProfiler(interval=0.005, max_stacks=4096, max_depth=6)
+    prof.start()
     t0 = time.monotonic()
     summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
                                         batch_size=batch,
                                         null_device=True)
     wall = time.monotonic() - t0
-    _stop.set()
-    st.join(1.0)
+    prof.stop()
 
     print(f"== {nodes} nodes / {pods} pods / batch {batch}: "
           f"{summary.average:.0f} pods/s wall={wall:.1f}s "
           f"barrier_ok={stats.get('barrier_ok')}")
     print("== per-thread CPU seconds:")
-    for k, v in sorted(thread_cpu().items(), key=lambda kv: -kv[1]):
+    for k, v in sorted(thread_cpu_seconds().items(), key=lambda kv: -kv[1]):
         if v >= 0.05:
             print(f"   {k:28s} {v}")
-    for name, ctr in sorted(SAMPLES.items(),
-                            key=lambda kv: -sum(kv[1].values())):
-        total = sum(ctr.values())
-        if total < 20:
-            continue
-        print(f"== {name}: {total} samples, top leaves:")
-        for leaf, n in ctr.most_common(12):
-            print(f"   {n:6d} ({100*n/total:4.1f}%) {leaf}")
-    print("== hot stacks (all threads):")
-    allst = Counter()
-    for ctr in STACKS.values():
-        allst.update(ctr)
-    for stk, n in allst.most_common(20):
-        print(f"   {n:6d} {stk}")
+    print("== per-stage host seconds (sampled):")
+    for stage, s in sorted(prof.stage_seconds().items(),
+                           key=lambda kv: -kv[1]):
+        print(f"   {stage:16s} {s:8.2f}")
+    print(f"== hot stacks ({prof.samples_total()} samples, collapsed):")
+    for stack, n in prof.top_stacks(20):
+        print(f"   {n:6d} {stack}")
 
 
 if __name__ == "__main__":
