@@ -7,14 +7,21 @@ pipeline — store -> watch -> informers -> queue -> TPU batch
 Filter/Score/Assign -> assume -> bind — and reports end-to-end
 scheduling throughput.
 
-Headline metric: SchedulingBasic at BENCH_NODES (default 5000) nodes,
-median of BENCH_RUNS fresh-subprocess passes (one interpreter + jax
-client + device state per pass — runs in one process interfere through
-allocator/device-buffer state).
+Headline metric: Scheduling100k (BENCH_HEAD_NODES=100000 nodes /
+BENCH_HEAD_PODS=200000 pods) through the SHARDED backend (`--backend
+sharded`, the default; parallel/backend.py — node tensors partitioned
+across the mesh, conflict matrices resolved per pod slab via
+reduce-scatter), one fresh-subprocess pass with the device cost census
+armed so `tpu_wave_collective_bytes` rides in the row.  `--backend
+tpu`/BENCH_BACKEND override the backend kind.
 
 Tracked configs (BASELINE.md): unless BENCH_SUITE=basic, one pass each
 of the hard workloads also runs and lands in detail.configs —
-  Scheduling100k          100k nodes / 200k pods (BASELINE config #5 tier)
+  SchedulingBasicSingleChip  the BENCH_r01-r05 trajectory row: 5k nodes,
+                          single-chip, median of BENCH_RUNS
+                          fresh-subprocess passes
+  Scheduling100k          100k nodes / 200k pods SINGLE-CHIP (the
+                          headline's direct A/B)
   SchedulingPodAntiAffinity  5k nodes / 5k anti-affinity pods
   TopologySpreading       1k nodes / 3 zones / 5k DoNotSchedule pods
   CoschedulingGang        5k nodes / 10k pods in 1k PodGroups
@@ -72,10 +79,12 @@ EXTRA_CONFIGS = {
                                "depth": 12, "admission_ms": 1.0},
     # single pass despite the tier's 10-17k weather band: a second
     # 100k pass costs up to ~25 min in bad weather and the driver's
-    # bench budget is finite — the band is documented in README/LATENCY
+    # bench budget is finite — the band is documented in README/LATENCY.
+    # Explicitly single-chip: the direct A/B against the sharded
+    # Scheduling100k HEADLINE row (main() head_cfg)
     "Scheduling100k": {"workload": "SchedulingBasicLarge",
                        "nodes": 100_000, "pods": 200_000, "batch": 16384,
-                       "depth": 2, "timeout": 1200.0},
+                       "depth": 2, "timeout": 1200.0, "backend": "tpu"},
     # constraint workloads: batch 8192 (full_cap chunks pipeline inside
     # ONE dispatch -> fewer fixed per-call tunnel round trips) + a 50ms
     # admission window so an arrival flood coalesces into ~2 dispatches
@@ -736,7 +745,8 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
              rate: float | None = None, depth: int = 1,
              admission_ms: float = 0.0, via_http: bool = False,
              null_device: bool = False, pct_nodes: int = 0,
-             overload: bool = False) -> dict:
+             overload: bool = False, backend_kind: str = "tpu",
+             census: bool = False) -> dict:
     """One full workload pass in this process; returns the result dict."""
     import copy
 
@@ -767,6 +777,13 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
     policy = chaos = None
     if overload:
         policy, chaos = _overload_shape(batch)
+    profiling_policy = None
+    if census:
+        # census=True arms run_device_census() after warmup so the row
+        # carries tpu_wave_collective_bytes — the in-band pin of the
+        # collective-byte budget (bit-for-bit vs tools/collective_census.py)
+        from kubernetes_tpu.scheduler.config import ProfilingPolicy
+        profiling_policy = ProfilingPolicy(census=True)
     t0 = time.monotonic()
     summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
                                         batch_size=batch,
@@ -775,8 +792,10 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
                                         via_http=via_http,
                                         null_device=null_device,
                                         percentage_of_nodes_to_score=pct_nodes,
+                                        backend_kind=backend_kind,
                                         overload=policy,
-                                        chaos_schedule=chaos)
+                                        chaos_schedule=chaos,
+                                        profiling_policy=profiling_policy)
     wall = time.monotonic() - t0
     if not stats.get("barrier_ok", False):
         return {"error": "pods left unscheduled", "value": 0.0,
@@ -798,6 +817,22 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
         detail["overload"] = stats["overload"]
     if "chaos_injected" in stats:
         detail["chaos_injected"] = stats["chaos_injected"]
+    if backend_kind != "tpu":
+        detail["backend"] = backend_kind
+    if census and stats.get("device_census"):
+        from kubernetes_tpu.component_base.profiling import (
+            collective_bytes_by_op,
+        )
+        gauges: dict[str, dict] = {}
+        for kind, recs in stats["device_census"].items():
+            for variant, rec in recs.items():
+                per_wave, per_call = collective_bytes_by_op(rec)
+                gauges[f"{kind}-{variant}"] = {
+                    "per_wave_bytes": rec.get("per_wave_bytes", 0),
+                    "tpu_wave_collective_bytes": per_wave,
+                    "tpu_step_collective_bytes": per_call,
+                }
+        detail["tpu_wave_collective_bytes"] = gauges
     return {"value": summary.average, "wall_s": round(wall, 1),
             "detail": detail}
 
@@ -861,7 +896,9 @@ def child_main() -> None:
                              else os.environ.get("_BENCH_W_HTTP") == "1"),
                    null_device=os.environ.get("_BENCH_W_NULL") == "1",
                    pct_nodes=int(os.environ.get("_BENCH_W_PCT", "0")),
-                   overload=os.environ.get("_BENCH_W_OVERLOAD") == "1")
+                   overload=os.environ.get("_BENCH_W_OVERLOAD") == "1",
+                   backend_kind=os.environ.get("_BENCH_W_BACKEND", "tpu"),
+                   census=os.environ.get("_BENCH_W_CENSUS") == "1")
     if "error" in res:
         emit(0.0, {"error": res["error"], **res["detail"]})
         sys.exit(1)
@@ -906,6 +943,10 @@ def _config_env(c: dict) -> dict:
         env["_BENCH_W_PCT"] = str(c["pct_nodes"])
     if c.get("overload"):
         env["_BENCH_W_OVERLOAD"] = "1"
+    if c.get("backend"):
+        env["_BENCH_W_BACKEND"] = c["backend"]
+    if c.get("census"):
+        env["_BENCH_W_CENSUS"] = "1"
     return env
 
 
@@ -968,26 +1009,54 @@ def main() -> None:
                    "configs": configs})
         sys.exit(1)
     n_runs = max(1, int(os.environ.get("BENCH_RUNS", "3")))
+    backend_kind = os.environ.get("BENCH_BACKEND", "sharded")
+    if "--backend" in sys.argv:
+        idx = sys.argv.index("--backend")
+        if len(sys.argv) > idx + 1 and not sys.argv[idx + 1].startswith("-"):
+            backend_kind = sys.argv[idx + 1]
+    head_nodes = int(os.environ.get("BENCH_HEAD_NODES", "100000"))
+    head_pods = int(os.environ.get("BENCH_HEAD_PODS", "200000"))
     if n_runs == 1:
-        res = run_once("SchedulingBasicLarge", N_NODES, N_PODS, BATCH,
-                       depth=DEPTH)
+        res = run_once("SchedulingBasicLarge", head_nodes, head_pods, BATCH,
+                       barrier_timeout=1800.0, depth=DEPTH,
+                       backend_kind=backend_kind, census=True)
         if "error" in res:
-            emit(0.0, {"error": res["error"], **res["detail"]})
+            emit(0.0, {"error": res["error"], "nodes": head_nodes,
+                       "pods": head_pods, **res["detail"]})
             sys.exit(1)
-        emit(res["value"], {"wall_s": res["wall_s"], **res["detail"]})
+        emit(res["value"], {"wall_s": res["wall_s"], "nodes": head_nodes,
+                            "pods": head_pods, **res["detail"]})
         return
 
     t0 = time.monotonic()
+    # HEADLINE: Scheduling100k through the sharded backend (node tensors
+    # partitioned per NODE_PARTITION_RULES, conflict matrices resolved by
+    # reduce-scatter) with the census gauges carried in-row.  ONE pass —
+    # the 100k tier's budget note on EXTRA_CONFIGS applies doubly here.
+    head_cfg = {"workload": "SchedulingBasicLarge", "nodes": head_nodes,
+                "pods": head_pods, "batch": BATCH, "depth": DEPTH,
+                "timeout": 1800.0, "backend": backend_kind, "census": True}
+    head = _spawn_child(_config_env(head_cfg), timeout=2100.0)
+    if head is None:
+        emit(0.0, {"error": "bench headline child failed twice"})
+        sys.exit(1)
+    if head.get("value", 0.0) == 0.0:
+        emit(0.0, head.get("detail", {"error": "headline child failed"}))
+        sys.exit(1)
+
+    # trajectory row: the BENCH_r01-r05 headline shape (5k-node
+    # SchedulingBasic, single-chip, median of n_runs) so the series
+    # stays comparable across the backend switch
     results: list[dict] = []
-    head_env = {"_BENCH_WORKLOAD": "SchedulingBasicLarge",
-                "_BENCH_W_NODES": str(N_NODES),
-                "_BENCH_W_PODS": str(N_PODS),
-                "_BENCH_W_BATCH": str(BATCH),
-                "_BENCH_W_DEPTH": str(DEPTH)}
+    basic_env = {"_BENCH_WORKLOAD": "SchedulingBasicLarge",
+                 "_BENCH_W_NODES": str(N_NODES),
+                 "_BENCH_W_PODS": str(N_PODS),
+                 "_BENCH_W_BATCH": str(BATCH),
+                 "_BENCH_W_DEPTH": str(DEPTH)}
     for _ in range(n_runs):
         # margin over the child's 900s barrier so a stuck child still
         # gets to emit its own error JSON before the parent gives up
-        got = _spawn_child(head_env, timeout=1200.0)
+        got = _spawn_child(basic_env, timeout=1200.0)
         if got is None:
             emit(0.0, {"error": "bench child failed twice"})
             sys.exit(1)
@@ -1062,12 +1131,17 @@ def main() -> None:
     wall = time.monotonic() - t0
     results.sort(key=lambda r: r["value"])
     med = results[len(results) // 2]
-    emit(med["value"], {"wall_s": round(wall, 1), "runs": n_runs,
-                        "averages": [r["value"] for r in results],
-                        "configs": configs,
-                        **{k: v for k, v in med["detail"].items()
-                           if k not in ("nodes", "pods", "batch",
-                                        "wall_s")}})
+    configs["SchedulingBasicSingleChip"] = {
+        "pods_per_s": med["value"], "runs": n_runs,
+        "averages": [r["value"] for r in results],
+        "p50_ms": med["detail"].get("pod_e2e_p50_ms"),
+        "p99_ms": med["detail"].get("pod_e2e_p99_ms"),
+        "total_pods": med["detail"].get("TotalPods")}
+    emit(head["value"], {"wall_s": round(wall, 1),
+                         "nodes": head_nodes, "pods": head_pods,
+                         "configs": configs,
+                         **{k: v for k, v in head["detail"].items()
+                            if k not in ("nodes", "pods", "wall_s")}})
 
 
 if __name__ == "__main__":

@@ -41,8 +41,13 @@ Conservative wave-conflict rules (reject -> retry, never accept wrongly):
 Multi-chip: the node axis shards across a jax Mesh (parallel/mesh.py wraps
 this in shard_map); cross-node reductions go through _Comm (pmax/pmin/psum
 over ICI), per-pod argmax is per-shard top-1 + all_gather + pick, and
-gathers by global node index are psum-of-owner.  All collectives are XLA
-ICI collectives — no NCCL on TPU (SURVEY.md §2.6).
+gathers by global node index are psum-of-owner.  The [P,P] conflict
+matrices are slab-partitioned: gather_cols_rs reduce-scatters so each
+shard resolves a contiguous P/n_shards pod slab (the same addends and
+per-row reduction order as the replicated all-reduce form, so results are
+bit-identical), and the [P]-bool verdicts merge with a small tiled
+all-gather.  All collectives are XLA ICI collectives — no NCCL on TPU
+(SURVEY.md §2.6).
 
 All shapes are static (derived from flatten.Caps); one compile serves every
 batch.
@@ -86,10 +91,14 @@ PLAIN_FEATURES = frozenset()
 
 class _Comm:
     """Reduction layer: local ops when axis_name is None, ICI collectives
-    inside shard_map otherwise."""
+    inside shard_map otherwise.  `n_shards` (the mesh size) lets the wave
+    solver slab-partition its [P,P] conflict matrices: gather_cols_rs
+    returns only this shard's contiguous row slab via reduce-scatter
+    instead of materializing the replicated all-reduce result."""
 
-    def __init__(self, axis_name: str | None):
+    def __init__(self, axis_name: str | None, n_shards: int = 1):
         self.axis = axis_name
+        self.n_shards = n_shards if axis_name else 1
 
     def psum(self, x):
         return lax.psum(x, self.axis) if self.axis else x
@@ -138,6 +147,27 @@ class _Comm:
         if fill != 0.0:
             seen = inrange if not self.axis else (
                 lax.psum(inrange.astype(jnp.int32), self.axis) > 0)
+            vals = jnp.where(seen, vals, fill)
+        return vals
+
+    def gather_cols_rs(self, arr, gidx, offset, n_loc: int, fill=0.0):
+        """gather_cols, slab form: same psum-of-owner addends, but each
+        shard keeps only its contiguous rows/n_shards slab of the leading
+        axis via reduce-scatter — for a [P,P] conflict matrix this ships
+        1/S of the all-reduce bytes and every (row, col) cell is still
+        exact (exactly one shard owns col's claimed node; the reduction
+        sums one non-zero contribution).  Leading axis must divide by
+        n_shards; callers fall back to gather_cols when it doesn't."""
+        local = gidx - offset
+        inrange = (local >= 0) & (local < n_loc) & (gidx >= 0)
+        vals = jnp.take(arr, jnp.clip(local, 0, n_loc - 1), axis=-1)
+        vals = jnp.where(inrange, vals, 0)
+        vals = lax.psum_scatter(vals, self.axis, scatter_dimension=0,
+                                tiled=True)
+        if fill != 0.0:
+            # seen is per-COLUMN (the gathered q axis), so the full [P]
+            # mask broadcasts over the slab rows unchanged
+            seen = lax.psum(inrange.astype(jnp.int32), self.axis) > 0
             vals = jnp.where(seen, vals, fill)
         return vals
 
@@ -241,10 +271,11 @@ HARD_KINDS_SERIAL = (C_SPREAD_HARD, C_ANTI_AFFINITY)
 def make_assign_core(caps: Caps, weights: dict[str, float] | None = None,
                      axis_name: str | None = None, mode: str = "wave",
                      max_waves: int = 128,
-                     features: frozenset = ALL_FEATURES):
+                     features: frozenset = ALL_FEATURES,
+                     n_shards: int = 1):
     w = {"fit": 1.0, "balanced": 1.0, "spread": 2.0, "affinity": 1.0,
          "taint": 1.0, **(weights or {})}
-    comm = _Comm(axis_name)
+    comm = _Comm(axis_name, n_shards)
     if mode == "scan":
         return _make_scan_core(caps, w, comm)
     return _make_wave_core(caps, w, comm, max_waves, features)
@@ -280,8 +311,8 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
         # deterministic tie-break noise keyed on (pod, GLOBAL node) so the
         # result is identical regardless of how the node axis is sharded
         # (reference: selectHost reservoir sample breaks ties randomly)
-        gn = (offset + jnp.arange(n_loc)).astype(jnp.float32)
-        pp = jnp.arange(P, dtype=jnp.float32)
+        gn = (offset + jnp.arange(n_loc)).astype(jnp.uint32)
+        pp = jnp.arange(P, dtype=jnp.uint32)
         # pseudo-random tie-break keyed on (pod, GLOBAL node): uniform per
         # cell, so claims stay spread under ANY occupancy pattern (a
         # structured cyclic gradient was tried — 1 wave on an empty
@@ -289,9 +320,21 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
         # first-feasible collapsed to the same few nodes and
         # anti-affinity serialized to ~1 pod/wave).  Deterministic and
         # shard-invariant, same contract as the reference's selectHost
-        # random tie-break (schedule_one.go:777).
-        h = jnp.sin(pp[:, None] * 12.9898 + gn[None, :] * 78.233) * 43758.5453
-        noise = (h - jnp.floor(h)) * TIE_NOISE
+        # random tie-break (schedule_one.go:777).  Integer mix (murmur3
+        # finalizer), NOT a sin() hash: f32 sin of large arguments is not
+        # correctly rounded, so XLA's constant folder (offset=0 path) and
+        # the runtime vectorized libm disagree in the low bits — which
+        # breaks bit-identical single-vs-sharded parity.  Modular uint32
+        # arithmetic and the exact 2^-24 scale are reproducible under any
+        # fusion/folding.
+        hx = (pp[:, None] * jnp.uint32(0x9E3779B1)
+              ^ gn[None, :] * jnp.uint32(0x85EBCA77))
+        hx ^= hx >> 16
+        hx *= jnp.uint32(0x85EBCA6B)
+        hx ^= hx >> 13
+        hx *= jnp.uint32(0xC2B2AE35)
+        hx ^= hx >> 16
+        noise = (hx >> 8).astype(jnp.float32) * (TIE_NOISE / (1 << 24))
         alloc = node["alloc"]
         # absent in the plain variant's static pytree (only f_cons/f_asg
         # blocks read them; those elide when the features are off)
@@ -327,6 +370,25 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
             req, req_nz = podv["req"], podv["req_nz"]
             earlier = jnp.tril(jnp.ones((Pv, Pv), jnp.float32), k=-1)  # q<p
             p_iota = jnp.arange(Pv)
+            # REDUCE-SCATTER slab mode (multi-chip): instead of every
+            # shard materializing the replicated [P,P] conflict matrices
+            # through all-reduce (the SCALING.md multi-chip cost center:
+            # s32[P,P] per constraint slot per wave), each shard resolves
+            # a contiguous P/S pod slab — gather_cols_rs keeps only the
+            # slab rows, the per-row reductions over the full q axis run
+            # unchanged (same addends, same order -> bit-identical), and
+            # the [P]-bool verdicts merge with a small tiled all-gather.
+            # Applies to the compacted tail sub-batch too (TAIL_P divides
+            # by any power-of-two mesh), so the round-5 tail-compaction
+            # trick runs per shard.  Falls back to the all-reduce path
+            # when the pod axis doesn't divide by the mesh.
+            # KTPU_RS_DISABLE forces that fallback (read at trace time) —
+            # the LATENCY.md/SCALING.md A/B baseline, not a runtime knob.
+            rs = bool(comm.axis) and comm.n_shards > 1 \
+                and Pv % comm.n_shards == 0 \
+                and not os.environ.get("KTPU_RS_DISABLE")
+            P_S = Pv // comm.n_shards if rs else Pv
+            s_iota = jnp.arange(P_S)
             pod, sel_mask, static_mask, static_score, noise = (
                 podv, sel_maskv, static_maskv, static_scorev, noisev)
             P = Pv
@@ -464,10 +526,45 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                 conf = jnp.zeros(P, bool)
                 spread_over_any = jnp.zeros(P, bool)   # failed the static quota
                 both = (has[:, None] & has[None, :]).astype(jnp.float32) * earlier
+                if rs:
+                    slab_lo = lax.axis_index(comm.axis) * P_S
+                    sl = functools.partial(
+                        lax.dynamic_slice_in_dim, start_index=slab_lo,
+                        slice_size=P_S, axis=0)
+
+                    def unsl(x):  # slab verdicts -> replicated full [P]
+                        return lax.all_gather(x, comm.axis, tiled=True)
+
+                    both_s = sl(both)                             # [P_S,P]
+                    conf_s = jnp.zeros(P_S, bool)
+                    spread_s = jnp.zeros(P_S, bool)
+                Dpqs = []   # rs: per-slot [P_S,P] slabs, reused by cohort
                 for c in range(caps.c_cap if f_cons else 0):
                     kind = pod["c_kind"][:, c]
                     sg = jnp.clip(pod["c_sg"][:, c], 0)
                     dom_rows = dom_sg[sg]                         # [P,N] local
+                    if rs:
+                        # slab of the [P,P] matrix: dom of q's claim under
+                        # p's sg, for this shard's P/S rows only
+                        Dpq = comm.gather_cols_rs(dom_rows, claims, offset,
+                                                  n_loc, fill=-1.0)  # [P_S,P]
+                        Dpqs.append(Dpq)
+                        kind_s, sg_s = sl(kind), sl(sg)
+                        own = Dpq[s_iota, slab_lo + s_iota]       # [P_S]
+                        same_dom = (Dpq == own[:, None]) & (own[:, None] >= 0)
+                        q_incs = pod["inc_sg"].T[sg_s]            # [P_S,P]
+                        k_same = jnp.sum(both_s * same_dom * q_incs, axis=1)
+                        conf_s |= (kind_s == C_ANTI_AFFINITY) & (k_same > 0)
+                        cnt_own = cd_sg[sg_s, jnp.clip(own, 0)
+                                        .astype(jnp.int32)]       # [P_S]
+                        over = (cnt_own + sl(pod["c_selfmatch"][:, c])
+                                + k_same - sl(minmatches[c][:, 0])) \
+                            > sl(pod["c_maxskew"][:, c])
+                        is_spread = (kind_s == C_SPREAD_HARD) & (own >= 0)
+                        spread_s |= is_spread & over
+                        conf_s |= sl(boot_flags[c]) & (
+                            jnp.sum(both_s * q_incs, axis=1) > 0)
+                        continue
                     Dpq = comm.gather_cols(dom_rows, claims, offset, n_loc,
                                            fill=-1.0)             # [P,P]: dom of q's claim under p's sg
                     own = Dpq[p_iota, p_iota][:, None]            # [P,1] p's own domain
@@ -493,6 +590,9 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                     spread_over_any |= is_spread & over
                     # affinity bootstrap: serialize against any incrementing q
                     conf |= boot_flags[c] & (jnp.sum(both * q_incs, axis=1) > 0)
+                if rs and f_cons:
+                    conf |= unsl(conf_s)
+                    spread_over_any |= unsl(spread_s)
                 for a in range(caps.asg_cap if f_asg else 0):
                     dom_a = comm.gather_cols(dom_asg[a], claims, offset, n_loc,
                                              fill=-1.0)           # [P]
@@ -539,6 +639,8 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                         comm_f = committed.astype(jnp.float32)
                         new_f = new_ok.astype(jnp.float32)
                         ok_all = new_ok
+                        if rs:
+                            ok_all_s = sl(new_ok)                 # [P_S]
                         for c in range(caps.c_cap):
                             kind = pod["c_kind"][:, c]
                             sg = jnp.clip(pod["c_sg"][:, c], 0)
@@ -557,6 +659,31 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                                 jnp.take_along_axis(fill, jnp.clip(dom_sg, 0),
                                                     axis=1),
                                 jnp.inf)                          # [SG,N]
+                            elig_c = sel_mask & (dom_rows >= 0)
+                            # [P,1] pmin: cheap; stays full-width in rs mode
+                            floor = comm.rowmin(gath[sg], elig_c, jnp.inf)[:, 0]
+                            floor = jnp.where(jnp.isfinite(floor), floor, 0.0)
+                            level = floor + pod["c_maxskew"][:, c]
+                            if rs:
+                                # reuse the conflict pass's slab — one
+                                # reduce-scatter per slot per wave total
+                                Dpq = Dpqs[c]
+                                sg_s, kind_s = sl(sg), sl(kind)
+                                own = Dpq[s_iota, slab_lo + s_iota]
+                                same_dom = (Dpq == own[:, None]) \
+                                    & (own[:, None] >= 0)
+                                q_incs = pod["inc_sg"].T[sg_s]
+                                rprime = jnp.sum(both_s * same_dom * q_incs
+                                                 * new_f[None, :], axis=1)
+                                own_ix = jnp.clip(own, 0).astype(jnp.int32)
+                                cond = (cd_sg[sg_s, own_ix]
+                                        + m_sg[sg_s, own_ix] + rprime
+                                        + sl(pod["c_selfmatch"][:, c])) \
+                                    <= sl(level)
+                                is_spread = (kind_s == C_SPREAD_HARD) \
+                                    & (own >= 0)
+                                ok_all_s &= (~is_spread) | cond
+                                continue
                             Dpq = comm.gather_cols(dom_rows, claims, offset,
                                                    n_loc, fill=-1.0)
                             own = Dpq[p_iota, p_iota]
@@ -566,15 +693,13 @@ def _make_wave_core(caps: Caps, w: dict, comm: _Comm, max_waves: int,
                                              * new_f[None, :], axis=1)
                             own_ix = jnp.clip(own, 0).astype(jnp.int32)
                             m_own = m_sg[sg, own_ix]
-                            elig_c = sel_mask & (dom_rows >= 0)
-                            floor = comm.rowmin(gath[sg], elig_c, jnp.inf)[:, 0]
-                            floor = jnp.where(jnp.isfinite(floor), floor, 0.0)
-                            level = floor + pod["c_maxskew"][:, c]
                             cnt_own = cd_sg[sg, own_ix]
                             cond = (cnt_own + m_own + rprime
                                     + pod["c_selfmatch"][:, c]) <= level
                             is_spread = (kind == C_SPREAD_HARD) & (own >= 0)
                             ok_all &= (~is_spread) | cond
+                        if rs:
+                            ok_all = new_ok & unsl(ok_all_s)
                         committed = committed | (new_ok & ok_all)
                     accept = committed
 

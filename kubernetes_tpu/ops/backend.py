@@ -1616,3 +1616,39 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 tl[key] = tl.get(key, 0) + 1
             self._tally_escape_pairs(tl)
         return out, escapes
+
+
+def make_batch_backend(kind: str = "tpu", caps: Caps | None = None,
+                       batch_size: int = 256,
+                       weights: dict[str, float] | None = None,
+                       k_cap: int = 1024, **kw):
+    """Construct a BatchBackend by kind — the one seam the `backend:`
+    config stanza (scheduler/config.BackendPolicy) and `bench.py
+    --backend` both resolve through, so the selectable kinds stay in one
+    place:
+
+      tpu      single-chip resident kernel (TPUBatchBackend)
+      sharded  mesh-partitioned shard_map path (parallel/backend.py);
+               node tensors live sharded per NODE_PARTITION_RULES and
+               the wave solver's conflict matrices resolve per pod slab
+               via reduce-scatter
+      null     host pipeline with the device step nulled (host-tail
+               measurement)
+
+    Remote seams (ops/remote.py) stay separate: they need a worker URL
+    and a transport policy, not just a kind string.  The worker itself
+    rejects kind != "tpu" — sharded is mesh-local by design (the device
+    mesh lives in THIS process; tunneling per-shard buffers through the
+    row-patch wire protocol would re-replicate them)."""
+    if kind == "tpu":
+        return TPUBatchBackend(caps, batch_size=batch_size,
+                               weights=weights, k_cap=k_cap, **kw)
+    if kind == "sharded":
+        from ..parallel.backend import ShardedTPUBatchBackend
+        return ShardedTPUBatchBackend(caps, batch_size=batch_size,
+                                      weights=weights, k_cap=k_cap, **kw)
+    if kind == "null":
+        from .nullbackend import NullBatchBackend
+        return NullBatchBackend(caps or Caps(), batch_size=batch_size)
+    raise ValueError(f"unknown batch backend kind {kind!r} "
+                     "(expected tpu, sharded or null)")

@@ -303,6 +303,15 @@ class _WorkerCore:
             caps = Caps(**cfg["caps"])
         except (ValueError, TypeError, KeyError) as e:
             raise WorkerError(E_INVALID, f"bad /init body: {e!r}")
+        kind = cfg.get("backend_kind", "tpu")
+        if kind != "tpu":
+            # the sharded backend is mesh-local by design: its node
+            # tensors live partitioned across THIS process's device mesh
+            # and the row-patch wire protocol would re-replicate them —
+            # run `backend: sharded` in the scheduler process instead
+            raise WorkerError(
+                E_INVALID, f"worker backend kind {kind!r} unsupported "
+                "(only 'tpu'; sharded is mesh-local)")
         try:
             # a plain TPUBatchBackend, used ONLY for its device half —
             # the remote client owns all host bookkeeping
@@ -800,6 +809,10 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
         self._init_body = json.dumps({
             "caps": vars(self.caps), "batch_size": batch_size,
             "weights": weights, "k_cap": k_cap,
+            # explicit so a future mixed fleet fails loudly: today's
+            # workers only build the single-chip kernel (sharded is
+            # mesh-local; see DeviceWorker._init)
+            "backend_kind": "tpu",
             "full_batch_cap": self.full_cap,
             # the CLIENT's wave-cap/retry setting governs both halves: the
             # worker must build its main kernel with the same cap the

@@ -58,8 +58,16 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         self.caps = caps or Caps()
         n_dev = self.mesh.devices.size
         if self.caps.n_cap % n_dev != 0:
-            raise ValueError(
-                f"n_cap {self.caps.n_cap} must divide by {n_dev} devices")
+            # shard_map needs an even node split; round up instead of
+            # making operators do mesh math (100k nodes on 8 devices
+            # just works, at the cost of a few padding rows)
+            from .census import round_caps_to_mesh
+            n_was = self.caps.n_cap
+            round_caps_to_mesh(self.caps, n_dev)
+            logger.warning(
+                "n_cap %d not divisible by %d devices; rounded up to %d "
+                "(%.2f%% padding overhead)", n_was, n_dev, self.caps.n_cap,
+                100.0 * (self.caps.n_cap - n_was) / n_was)
         self.batch_size = batch_size
         self.tensors = ClusterTensors(self.caps)
         self.encoder = BatchEncoder(self.tensors, batch_size)

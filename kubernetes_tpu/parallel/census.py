@@ -53,7 +53,9 @@ def abstract_step_inputs(caps: Caps, batch: int, k_cap: int = 1024):
               "label_mask": zeros((c.n_cap, c.l_cap)),
               "key_mask": zeros((c.n_cap, c.kl_cap)),
               "dom_sg": zeros((c.sg_cap, c.n_cap), jnp.int32),
-              "dom_asg": zeros((c.asg_cap, c.n_cap), jnp.int32)}
+              "dom_asg": zeros((c.asg_cap, c.n_cap), jnp.int32),
+              "sg_ns_mask": zeros((c.sg_cap, c.ns_cap + 1)),
+              "asg_ns_mask": zeros((c.asg_cap, c.ns_cap + 1))}
     pods = {"req": zeros((P_, R)), "req_nz": zeros((P_, R)),
             "p_valid": zeros((P_,), jnp.bool_),
             "untol_hard": zeros((P_, c.t_cap)),
@@ -73,7 +75,8 @@ def abstract_step_inputs(caps: Caps, batch: int, k_cap: int = 1024):
             "c_weight": zeros((P_, c.c_cap)),
             "inc_sg": zeros((P_, c.sg_cap)),
             "inc_asg": zeros((P_, c.asg_cap)),
-            "match_asg": zeros((P_, c.asg_cap))}
+            "match_asg": zeros((P_, c.asg_cap)),
+            "pod_ns": zeros((P_,), jnp.int32)}
     prows = zeros((k_cap,), jnp.int32)
     pvals = zeros((k_cap, 2 * R + 1 + PT))
     return state, static, pods, prows, pvals

@@ -6,14 +6,28 @@ each core owns a contiguous slab of node rows, computes local feasibility +
 scores, and placement is a per-core top-1 + all_gather + global pick.  The
 running-sum state (used/npods/ports) lives sharded; the small domain-count
 tables (cd_sg/cd_asg) are replicated and kept coherent with a psum of the
-winning shard's domain ids.  All collectives are XLA ICI collectives — no
-NCCL on TPU (reference's comm backbone analysis: SURVEY.md §2.6).
+winning shard's domain ids.  The [P,P] conflict matrices of the wave
+solver are slab-partitioned: each shard resolves a contiguous pod slab via
+reduce-scatter and winners merge with a small all-gather
+(models/assign.py gather_cols_rs).  All collectives are XLA ICI
+collectives — no NCCL on TPU (reference's comm backbone analysis:
+SURVEY.md §2.6).
+
+Shardings are DECLARATIVE here: NODE_PARTITION_RULES maps every node-side
+array name to an explicit PartitionSpec (match_partition_rules, the
+exemplar shape of SNIPPETS.md [2]), and compile_sharded is the
+pjit-preferred compile helper (SNIPPETS.md [3]) shared by
+parallel/backend.py and parallel/census.py: jit==pjit drives placement +
+donation over a shard_map manual region, falling back to a plain jit wrap
+where the pjit sharding kwargs are unavailable.
 
 Multi-host: jax.distributed.initialize() + the same Mesh spanning all
 processes gives DCN+ICI automatically; nothing here changes.
 """
 
 from __future__ import annotations
+
+import re
 
 import jax
 import numpy as np
@@ -24,25 +38,65 @@ from ..ops.flatten import Caps
 
 NODE_AXIS = "nodes"
 
+# Sentinel used in NODE_PARTITION_RULES entries; substituted with the
+# mesh axis name by match_partition_rules.
+_AXIS = "@nodes"
+
+# Rule table: (name regex, PartitionSpec dims) — every node-side array
+# entering the sharded step MUST match exactly one rule, so a new array
+# cannot silently default to replicated (match_partition_rules raises on
+# a miss; the replicated-large-tensor lint rule convicts capacity-scaled
+# arrays that pair with P() without an annotation).
+NODE_PARTITION_RULES = (
+    # [n_cap, *] row-major node tensors: shard the node axis
+    (r"^(alloc|used|used_nz|taint_mask|label_mask|key_mask|port_mask)$",
+     (_AXIS, None)),
+    # [n_cap] per-node vectors
+    (r"^(npods|maxpods|valid)$", (_AXIS,)),
+    # [cap, n_cap] domain-id tables: node axis is the LAST dim
+    (r"^dom_(sg|asg)$", (None, _AXIS)),
+    # [cap, dom] per-domain count tables stay replicated: the kernel
+    # gathers per-node domain ids into them from every shard
+    # (take_along_axis + psum commits) and reads total = sum(cnt_rows)
+    # locally; a sharded copy would add a collective per constraint slot
+    (r"^cd_(sg|asg)$", ()),  # replicated-ok: kernel-coherent count table
+    # [cap, ns_vocab] namespace masks have no node axis and fold into
+    # pod bits once per batch (_fold_ns_masks)
+    (r"^(sg|asg)_ns_mask$", ()),  # replicated-ok: no node axis
+)
+
+
+def match_partition_rules(rules, names, axis: str = NODE_AXIS) -> dict:
+    """Resolve array names against a (regex, spec-dims) rule table into
+    {name: PartitionSpec}.  First match wins; an unmatched name raises so
+    sharding stays exhaustive by construction (SNIPPETS.md [2])."""
+    specs = {}
+    for name in names:
+        for pattern, dims in rules:
+            if re.search(pattern, name):
+                specs[name] = P(*(axis if d == _AXIS else d for d in dims))
+                break
+        else:
+            raise ValueError(
+                f"no partition rule matches node array {name!r} — add it "
+                f"to NODE_PARTITION_RULES")
+    return specs
+
 
 def make_mesh(devices=None, axis: str = NODE_AXIS) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.array(devices), (axis,))
 
 
+NODE_KEYS = ("alloc", "used", "used_nz", "npods", "maxpods", "valid",
+             "taint_mask", "label_mask", "key_mask", "port_mask",
+             "dom_sg", "dom_asg", "cd_sg", "cd_asg",
+             "sg_ns_mask", "asg_ns_mask")
+
+
 def node_specs(axis: str = NODE_AXIS) -> dict:
-    """PartitionSpec per node-side array (the real tp-style shardings)."""
-    return {
-        "alloc": P(axis, None), "used": P(axis, None), "used_nz": P(axis, None),
-        "npods": P(axis), "maxpods": P(axis), "valid": P(axis),
-        "taint_mask": P(axis, None), "label_mask": P(axis, None),
-        "key_mask": P(axis, None), "port_mask": P(axis, None),
-        "dom_sg": P(None, axis), "dom_asg": P(None, axis),
-        # per-domain count tables are small and replicated
-        "cd_sg": P(), "cd_asg": P(),
-        # per-group namespace membership masks have no node axis: replicated
-        "sg_ns_mask": P(), "asg_ns_mask": P(),
-    }
+    """PartitionSpec per node-side array, resolved from the rule table."""
+    return match_partition_rules(NODE_PARTITION_RULES, NODE_KEYS, axis)
 
 
 def pod_specs() -> dict:
@@ -70,6 +124,49 @@ def static_specs(axis: str = NODE_AXIS) -> dict:
     return {k: ns[k] for k in STATIC_KEYS}
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """jax.shard_map across the API straddle: prefer the stable entry
+    (check_vma, jax>=0.4.35-ish), fall back to jax.experimental.shard_map
+    (check_rep) on runtimes that predate the promotion.  Replication
+    checking is off either way: the wave solver's manual collectives
+    (psum-of-owner gathers, reduce-scatter slabs) are replicated by
+    construction, not by inference."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
+def _specs_to_shardings(mesh: Mesh, tree):
+    from jax.sharding import NamedSharding
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def compile_sharded(fn, mesh: Mesh, in_specs, out_specs,
+                    donate_argnums: tuple = ()):
+    """pjit-preferred compile of a sharded step (SNIPPETS.md [3] shape):
+    the body runs as a shard_map manual region (per-shard collectives need
+    axis names), and jit==pjit around it carries explicit NamedSharding
+    in/out shardings so XLA places/donates buffers without inferring
+    layouts from the first call.  Where this jax predates the sharding
+    kwargs, fall back to the bare shard_map wrap — same program, placement
+    then comes from the device_put'd operands."""
+    mapped = shard_map_compat(fn, mesh, in_specs, out_specs)
+    try:
+        # compile-cached: this IS the compile helper — callers build once
+        # at backend setup and hold the returned jitted fn
+        return jax.jit(mapped,
+                       in_shardings=_specs_to_shardings(mesh, in_specs),
+                       out_shardings=_specs_to_shardings(mesh, out_specs),
+                       donate_argnums=donate_argnums)
+    except TypeError:  # pragma: no cover - older jit signature
+        # compile-cached: same — fallback arm of the one-shot compile
+        return jax.jit(mapped, donate_argnums=donate_argnums)
+
+
 def build_sharded_step_fn(caps: Caps, mesh: Mesh,
                           weights: dict[str, float] | None = None,
                           axis: str = NODE_AXIS, k_cap: int = 1024,
@@ -95,7 +192,7 @@ def build_sharded_step_fn(caps: Caps, mesh: Mesh,
     R, PT = caps.r, caps.pt_cap
     from ..models.assign import ALL_FEATURES
     core = make_assign_core(
-        caps, weights, axis_name=axis,
+        caps, weights, axis_name=axis, n_shards=n_shards,
         features=ALL_FEATURES if features is None else features)
 
     def stepped(state, static, pods, prows, pvals):
@@ -122,15 +219,12 @@ def build_sharded_step_fn(caps: Caps, mesh: Mesh,
         return new_state, out["assignments"], out["waves"]
 
     ss, st = state_specs(axis), static_specs(axis)
-    fn = jax.shard_map(
-        stepped, mesh=mesh,
-        in_specs=(ss, st, pod_specs(), P(), P()),
-        out_specs=(ss, P(), P()),
-        check_vma=False,
-    )
     # compile-cached: built once per mesh at backend setup; the caller
     # holds the returned callable (and its jit cache) for every wave
-    return jax.jit(fn, donate_argnums=(0,))
+    return compile_sharded(stepped, mesh,
+                           in_specs=(ss, st, pod_specs(), P(), P()),
+                           out_specs=(ss, P(), P()),
+                           donate_argnums=(0,))
 
 
 def build_sharded_assign_fn(caps: Caps, mesh: Mesh,
@@ -141,16 +235,13 @@ def build_sharded_assign_fn(caps: Caps, mesh: Mesh,
     n_shards = mesh.devices.size
     if caps.n_cap % n_shards != 0:
         raise ValueError(f"n_cap {caps.n_cap} not divisible by {n_shards} devices")
-    core = make_assign_core(caps, weights, axis_name=axis)
-    fn = jax.shard_map(
-        core, mesh=mesh,
+    core = make_assign_core(caps, weights, axis_name=axis, n_shards=n_shards)
+    # compile-cached: built once per mesh at backend setup; the caller
+    # holds the returned callable (and its jit cache) for every wave
+    return compile_sharded(
+        core, mesh,
         in_specs=(node_specs(axis), pod_specs()),
         out_specs={"assignments": P(), "waves": P(),
                    "used": P(axis, None), "used_nz": P(axis, None),
                    "npods": P(axis), "port_mask": P(axis, None),
-                   "cd_sg": P(), "cd_asg": P()},
-        check_vma=False,
-    )
-    # compile-cached: built once per mesh at backend setup; the caller
-    # holds the returned callable (and its jit cache) for every wave
-    return jax.jit(fn)
+                   "cd_sg": P(), "cd_asg": P()})

@@ -234,6 +234,7 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                   null_device: bool = False,
                   percentage_of_nodes_to_score: int = 0,
                   remote_seam: str | None = None,
+                  backend_kind: str = "tpu",
                   tracing_provider=None,
                   overload=None,
                   chaos_schedule=None,
@@ -265,7 +266,15 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
     overload takes a config.OverloadPolicy (configure_overload: bounded
     admission + AIMD waves + escape breaker + watchdog); chaos_schedule
     takes an ops.faults.OverloadSchedule and wraps the batch backend in
-    ChaosBatchBackend — together they are the bench --overload shape."""
+    ChaosBatchBackend — together they are the bench --overload shape.
+
+    backend_kind selects the in-process device backend via
+    ops/backend.make_batch_backend ("tpu" single-chip resident kernel,
+    "sharded" the mesh-partitioned shard_map path, "null" device step
+    nulled) — the same vocabulary as the scheduler config's `backend:`
+    stanza and `bench.py --backend`.  null_device/remote_seam take
+    precedence (they predate the stanza and the remote seam needs a
+    worker, not a kind)."""
     from ..utils.gctune import tune_for_throughput
     tune_for_throughput()  # CPython gen-2 pauses cost ~35% at bench scale
     server = tmpdir = proc = None
@@ -356,8 +365,9 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
             backend = RemoteTPUBatchBackend(worker.url, caps or Caps(),
                                             batch_size=batch_size)
         else:
-            from ..ops.backend import TPUBatchBackend
-            backend = TPUBatchBackend(caps or Caps(), batch_size=batch_size)
+            from ..ops.backend import make_batch_backend
+            backend = make_batch_backend(backend_kind, caps or Caps(),
+                                         batch_size=batch_size)
         backend.warmup()
         if chaos_schedule is not None:
             from ..ops.faults import ChaosBatchBackend
@@ -755,6 +765,7 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                        null_device: bool = False,
                        percentage_of_nodes_to_score: int = 0,
                        remote_seam: str | None = None,
+                       backend_kind: str = "tpu",
                        tracing_provider=None,
                        overload=None,
                        chaos_schedule=None,
@@ -767,7 +778,8 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         admission_interval=admission_interval,
         via_http=via_http, null_device=null_device,
         percentage_of_nodes_to_score=percentage_of_nodes_to_score,
-        remote_seam=remote_seam, tracing_provider=tracing_provider,
+        remote_seam=remote_seam, backend_kind=backend_kind,
+        tracing_provider=tracing_provider,
         overload=overload, chaos_schedule=chaos_schedule,
         profiling_policy=profiling_policy)
     collector = ThroughputCollector(cluster.store)

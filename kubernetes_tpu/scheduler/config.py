@@ -169,6 +169,53 @@ def _parse_remote_seam(data: dict) -> RemoteSeamPolicy:
 
 
 @dataclass
+class BackendPolicy:
+    """Device batch-backend selection (`backend:` stanza).
+
+    kind picks the BatchBackend implementation the harness constructs
+    (ops/backend.make_batch_backend): "tpu" is the single-chip resident
+    kernel, "sharded" the mesh-partitioned shard_map path
+    (parallel/backend.py — node tensors live sharded, conflict matrices
+    resolve via reduce-scatter), "null" the host-only pipeline with the
+    device step nulled.  batchSize/kCap 0 mean "harness default" so the
+    stanza can pin just the kind."""
+
+    kind: str = "tpu"
+    batch_size: int = 0
+    k_cap: int = 0
+
+    @property
+    def selected(self) -> bool:
+        return self.kind != "tpu" or bool(self.batch_size or self.k_cap)
+
+
+# backend YAML key -> BackendPolicy field
+_BACKEND_FIELDS = {
+    "kind": "kind",
+    "batchSize": "batch_size",
+    "kCap": "k_cap",
+}
+
+BACKEND_KINDS = ("tpu", "sharded", "null")
+
+
+def _parse_backend(data: dict) -> BackendPolicy:
+    kwargs = {}
+    for key, value in (data or {}).items():
+        if key not in _BACKEND_FIELDS:
+            raise ConfigError(f"unknown backend key {key!r}")
+        kwargs[_BACKEND_FIELDS[key]] = value
+    policy = BackendPolicy(**kwargs)
+    if policy.kind not in BACKEND_KINDS:
+        raise ConfigError(
+            f"backend kind must be one of {', '.join(BACKEND_KINDS)}; "
+            f"got {policy.kind!r}")
+    if policy.batch_size < 0 or policy.k_cap < 0:
+        raise ConfigError("backend batchSize/kCap must be >= 0")
+    return policy
+
+
+@dataclass
 class TracingPolicy:
     """Batch-pipeline trace sampling (component_base/tracing.py).
 
@@ -448,6 +495,7 @@ class SchedulerConfig:
     profiles: list[ProfileConfig] = field(default_factory=list)
     extenders: list[dict] = field(default_factory=list)
     remote_seam: RemoteSeamPolicy = field(default_factory=RemoteSeamPolicy)
+    backend: BackendPolicy = field(default_factory=BackendPolicy)
     tracing: TracingPolicy = field(default_factory=TracingPolicy)
     overload: OverloadPolicy = field(default_factory=OverloadPolicy)
     scale_out: ScaleOutPolicy = field(default_factory=ScaleOutPolicy)
@@ -478,6 +526,7 @@ def load_config(source: str | dict) -> SchedulerConfig:
         pod_max_backoff=data.get("podMaxBackoffSeconds", 10.0),
         extenders=data.get("extenders") or [],
         remote_seam=_parse_remote_seam(data.get("remoteSeam")),
+        backend=_parse_backend(data.get("backend")),
         tracing=_parse_tracing(data.get("tracing")),
         overload=_parse_overload(data.get("overload")),
         scale_out=_parse_scaleout(data.get("scaleOut")),
@@ -615,6 +664,10 @@ def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
     # RemoteTPUBatchBackend into a profile picks up the configured
     # deadlines/retry budget instead of the hard-coded defaults
     sched.remote_seam_policy = cfg.remote_seam
+    # same contract for the device backend: the stanza records WHICH
+    # backend the harness should build (ops/backend.make_batch_backend),
+    # construction stays with bench/perf/tests
+    sched.backend_policy = cfg.backend
     if cfg.overload.enabled:
         sched.configure_overload(cfg.overload)
     if cfg.scale_out.enabled:

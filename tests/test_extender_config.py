@@ -272,6 +272,30 @@ profiles:
             with pytest.raises(ConfigError):
                 load_config({"scaleOut": bad})
 
+    def test_backend_stanza_parses(self):
+        cfg = load_config({"backend": {
+            "kind": "sharded", "batchSize": 512, "kCap": 2048}})
+        be = cfg.backend
+        assert be.selected
+        assert (be.kind, be.batch_size, be.k_cap) == ("sharded", 512, 2048)
+        # default: tpu single-chip, nothing selected explicitly
+        d = load_config({}).backend
+        assert d.kind == "tpu" and not d.selected
+
+    def test_backend_validation_errors(self):
+        for bad in ({"noSuchKey": 1},
+                    {"kind": "gpu"},
+                    {"batchSize": -1},
+                    {"kCap": -8}):
+            with pytest.raises(ConfigError):
+                load_config({"backend": bad})
+
+    def test_backend_policy_reaches_scheduler(self, cluster):
+        store, client, factory = cluster
+        cfg = load_config({"backend": {"kind": "sharded"}})
+        sched = scheduler_from_config(client, factory, cfg)
+        assert sched.backend_policy.kind == "sharded"
+
     def test_point_scoped_disable(self):
         cfg = load_config({"profiles": [{"plugins": {
             "score": {"disabled": [{"name": "NodeResourcesFit"}]}}}]})

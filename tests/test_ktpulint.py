@@ -43,7 +43,7 @@ def run_rule(ctx: LintContext, name: str) -> list[Finding]:
 
 def test_registry_has_the_full_catalog():
     rules = all_rules()
-    assert len(rules) >= 18
+    assert len(rules) >= 19
     for name, rule in rules.items():
         assert name == rule.name
         assert rule.doc, f"rule {name} has no doc line"
@@ -647,6 +647,32 @@ def test_recompile_hazard_unhashable_static_arg(tmp_path):
         """})
     found = run_rule(ctx, "recompile-hazard")
     assert len(found) == 1 and "unhashable" in found[0].message
+
+
+def test_replicated_large_tensor_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/parallel/mesh.py": """\
+        NODE_PARTITION_RULES = (
+            (r"^(alloc|used)$", ("@nodes", None)),
+            (r"^big_table$", ()),
+        )
+        """})
+    found = run_rule(ctx, "replicated-large-tensor")
+    assert len(found) == 1 and "big_table" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {f"{PKG}/parallel/mesh.py": """\
+        NODE_PARTITION_RULES = (
+            (r"^(alloc|used)$", ("@nodes", None)),
+            (r"^cd_counts$", ()),  # replicated-ok: kernel keeps it coherent
+        )
+        """})
+    assert run_rule(ctx, "replicated-large-tensor") == []
+
+
+def test_replicated_large_tensor_ignores_other_dirs(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/ops/tables.py": """\
+        MY_PARTITION_RULES = ((r".*", ()),)
+        """})
+    assert run_rule(ctx, "replicated-large-tensor") == []
 
 
 # -- thread rules ----------------------------------------------------------

@@ -42,6 +42,8 @@ def build_inputs(caps, nodes, pods, batch_size):
         "dom_sg": jnp.asarray(tensors.dom_sg),
         "dom_asg": jnp.asarray(tensors.dom_asg),
         "cd_sg": jnp.asarray(cd_sg), "cd_asg": jnp.asarray(cd_asg),
+        "sg_ns_mask": jnp.asarray(tensors.sg_ns_mask),
+        "asg_ns_mask": jnp.asarray(tensors.asg_ns_mask),
     }
     from kubernetes_tpu.parallel.mesh import pod_specs
     pod_arrays = {k: jnp.asarray(v) for k, v in
@@ -102,3 +104,109 @@ class TestShardedParity:
         # spread pods (0..5) split 3/3 across zones
         zones = ["a" if n.startswith("a") else "b" for n in names[:6]]
         assert zones.count("a") == 3 and zones.count("b") == 3
+
+
+def random_workload(seed: int, n_nodes: int = 16, n_pods: int = 32):
+    """Seeded random cluster + constraint-mixed pod batch.
+
+    Node capacities, zones and pod requests/constraints all derive from
+    the seed, so each case exercises a different contention pattern
+    (which waves conflict, which cohorts water-fill, who ends in the
+    compacted tail) without the test hard-coding any placement."""
+    import random as _random
+    rng = _random.Random(seed)
+    zones = ["a", "b", "c"][:rng.randint(2, 3)]
+    nodes = []
+    for i in range(n_nodes):
+        z = zones[i % len(zones)]
+        nodes.append(
+            make_node(f"{z}{i}").zone(z)
+            .labels(**{"kubernetes.io/hostname": f"{z}{i}"})
+            .capacity(cpu=str(rng.choice([1, 2, 4])),
+                      mem=f"{rng.choice([2, 4, 8])}Gi").build())
+    pods = []
+    for i in range(n_pods):
+        kind = rng.choice(["spread", "anti", "affinity", "plain", "plain"])
+        cpu = f"{rng.choice([100, 250, 500])}m"
+        mem = f"{rng.choice([64, 128, 256])}Mi"
+        if kind == "spread":
+            pods.append(
+                make_pod(f"sp{i}").labels(app=f"web{i % 3}")
+                .req(cpu=cpu, mem=mem)
+                .topology_spread("topology.kubernetes.io/zone",
+                                 max_skew=rng.randint(1, 2),
+                                 match_labels={"app": f"web{i % 3}"})
+                .build())
+        elif kind == "anti":
+            pods.append(
+                make_pod(f"an{i}").labels(app=f"solo{i % 2}")
+                .req(cpu=cpu)
+                .pod_affinity("kubernetes.io/hostname",
+                              {"app": f"solo{i % 2}"}, anti=True).build())
+        elif kind == "affinity":
+            pods.append(
+                make_pod(f"af{i}").labels(app="pair")
+                .req(cpu=cpu, mem=mem)
+                .pod_affinity("topology.kubernetes.io/zone", {"app": "pair"})
+                .build())
+        else:
+            pods.append(make_pod(f"pl{i}").req(cpu=cpu, mem=mem).build())
+    rng.shuffle(pods)
+    return nodes, pods
+
+
+class TestRandomizedParity:
+    """Satellite: sharded (reduce-scatter slab) assignments bit-identical
+    to the single-chip path over seeded clusters with mixed constraints.
+
+    The fns compile once per (caps, batch) shape — the seeds vary only
+    the data, so the whole sweep costs two compiles."""
+
+    @pytest.fixture(scope="class")
+    def fns(self, caps):
+        return (build_assign_fn(caps),
+                build_sharded_assign_fn(caps, make_mesh()))
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_seeded_parity(self, caps, fns, seed):
+        nodes, pods = random_workload(seed)
+        _, node_arrays, pod_arrays = build_inputs(caps, nodes, pods, 32)
+        single, sharded = fns
+        out1 = np.asarray(single(node_arrays, pod_arrays)["assignments"])
+        out8 = np.asarray(sharded(node_arrays, pod_arrays)["assignments"])
+        assert np.array_equal(out1, out8), \
+            f"seed={seed} single={out1} sharded={out8}"
+
+    def test_tail_compaction_parity(self, caps, monkeypatch):
+        """Force the compacted-tail waves (TAIL_P < P) so the per-shard
+        tail path — the rs slab math re-applied on the gathered
+        straggler sub-batch — is covered bit-for-bit too."""
+        from kubernetes_tpu.models import assign as assign_mod
+        # 16 divides the 8-device mesh: each shard owns a 2-row tail slab
+        monkeypatch.setattr(assign_mod, "TAIL_P", 16)
+        single = build_assign_fn(caps)
+        sharded = build_sharded_assign_fn(caps, make_mesh())
+        for seed in range(3):
+            nodes, pods = random_workload(seed, n_nodes=8, n_pods=32)
+            _, node_arrays, pod_arrays = build_inputs(
+                caps, nodes, pods, 32)
+            out1 = np.asarray(single(node_arrays, pod_arrays)["assignments"])
+            out8 = np.asarray(sharded(node_arrays, pod_arrays)["assignments"])
+            assert np.array_equal(out1, out8), \
+                f"seed={seed} single={out1} sharded={out8}"
+
+    @pytest.mark.slow
+    def test_large_tier_parity(self):
+        """The 100k-shape tier (n_cap rounded to the mesh, big batch) —
+        slow: two fresh compiles at larger shapes."""
+        caps = Caps(n_cap=256, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                    s_cap=2, sg_cap=8, asg_cap=8)
+        single = build_assign_fn(caps)
+        sharded = build_sharded_assign_fn(caps, make_mesh())
+        for seed in range(2):
+            nodes, pods = random_workload(seed, n_nodes=200, n_pods=64)
+            _, node_arrays, pod_arrays = build_inputs(
+                caps, nodes, pods, 64)
+            out1 = np.asarray(single(node_arrays, pod_arrays)["assignments"])
+            out8 = np.asarray(sharded(node_arrays, pod_arrays)["assignments"])
+            assert np.array_equal(out1, out8), f"seed={seed}"

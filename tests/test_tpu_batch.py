@@ -144,8 +144,10 @@ class TestSelectorsAndTaints:
         backend = TPUBatchBackend(small_caps(), batch_size=3)
         pods = [make_pod(f"p{i}").host_port(8080).build() for i in range(3)]
         out = run_assign(backend, pods, snap)
-        assert {out[0], out[1]} == {"n1", "n2"}
-        assert out[2] not in ("n1", "n2")  # both ports taken within the batch
+        # claims are simultaneous within a batch (tie-break noise picks the
+        # two winners): exactly one pod per node, the third blocked
+        placed = [o for o in out if o in ("n1", "n2")]
+        assert sorted(placed) == ["n1", "n2"]  # both ports taken in-batch
 
 
 class TestTopologyAndAffinity:

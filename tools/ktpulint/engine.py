@@ -21,6 +21,8 @@ Annotation conventions (documented in README "Static analysis"):
       (recompile-hazard rule)
   # guarded-by: <lock>[|<alt-lock>...]       declare the lock guarding a
       shared attribute (lock-discipline rule)
+  # replicated-ok: <why>                     authorize a replicated
+      partition-rule entry (replicated-large-tensor rule)
 
 Findings are deterministic and ordered; a baseline file (JSON list of
 fingerprints) lets pre-existing accepted findings ride without blocking
@@ -39,7 +41,8 @@ from typing import Callable, Iterable, Iterator
 
 _DISABLE_RE = re.compile(r"#\s*ktpulint:\s*disable=([\w,\- ]+)")
 _DISABLE_FILE_RE = re.compile(r"#\s*ktpulint:\s*disable-file=([\w,\- ]+)")
-_ANNOTATION_RE = re.compile(r"#\s*(sync-point|compile-cached|guarded-by)\b")
+_ANNOTATION_RE = re.compile(
+    r"#\s*(sync-point|compile-cached|guarded-by|replicated-ok)\b")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -84,6 +84,49 @@ class DeviceSyncRule(Rule):
                     "pass dtype= for host-side conversion)")
 
 
+@register
+class ReplicatedLargeTensorRule(Rule):
+    """Partition rule tables (`*_PARTITION_RULES` in parallel/) map
+    node-side, capacity-scaled arrays to PartitionSpecs.  An entry with
+    empty dims `()` replicates that array on EVERY shard — at the 100k
+    tier a single [P,P] matrix left replicated costs ~134MB per device
+    and an all-reduce per wave, the exact regression the reduce-scatter
+    path removed.  Replication is sometimes right (count tables the
+    kernel keeps coherent itself, arrays with no node axis) but must be
+    argued for: annotate `# replicated-ok: <why>` on the entry."""
+
+    name = "replicated-large-tensor"
+    doc = "replicated rule-table entries need # replicated-ok: <why>"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        pkg = ctx.package_name
+        if not view.rel.startswith(f"{pkg}/parallel/") or view.tree is None:
+            return
+        for n in ast.walk(view.tree):
+            if not (isinstance(n, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id.endswith("_PARTITION_RULES")
+                            for t in n.targets)
+                    and isinstance(n.value, (ast.Tuple, ast.List))):
+                continue
+            for entry in n.value.elts:
+                if not (isinstance(entry, ast.Tuple)
+                        and len(entry.elts) == 2):
+                    continue
+                pattern, dims = entry.elts
+                if not (isinstance(dims, ast.Tuple) and not dims.elts):
+                    continue  # sharded along some axis — fine
+                if view.line_has_annotation(dims.lineno, "replicated-ok"):
+                    continue
+                pat = pattern.value if isinstance(pattern, ast.Constant) \
+                    else "<entry>"
+                yield self.finding(
+                    view, dims.lineno,
+                    f"rule-table entry {pat!r} replicates its arrays on "
+                    "every shard; shard the node axis or annotate "
+                    "# replicated-ok: <why>")
+
+
 def _jit_static_names(call: ast.Call) -> set[str] | None:
     """If `call` is jax.jit(...)/pjit(...) (directly or via partial),
     return its static_argnames literals (empty set when none)."""
