@@ -740,6 +740,139 @@ def run_scaleout(max_instances: int) -> dict:
             "BENCH_SCALEOUT": instances}
 
 
+def run_scaleout_proc(max_procs: int) -> dict:
+    """--processes N: PROCESS-TRUE scale-out A/B.  Unlike --instances
+    (N schedulers sharing one interpreter and one MemoryStore object),
+    this spawns one real apiserver process plus 1, 2, ... N scheduler
+    OS processes via the procrun supervisor — every list/watch/bind
+    crosses an actual process boundary over HTTP, so the numbers carry
+    serialization, socket and GIL-free costs the in-process row hides.
+
+    Null-device on purpose: N child interpreters would each pay the
+    device warmup, and the question this row answers is whether the
+    CONTROL PLANE scales across processes, not whether N chips do.
+    Exactly-once is proved per pass by a store-watch WireBindLedger
+    (zero double-binds, zero lost pods), and every multi-process count
+    re-validates it under a seeded crash->failover churn sub-pass.
+    Shrink with BENCH_SCALEOUT_NODES/PODS for smoke runs."""
+    from kubernetes_tpu.client.clientset import NODES, PODS
+    from kubernetes_tpu.component_base.profiling import federate_texts
+    from kubernetes_tpu.ops.faults import (
+        KILL_INSTANCE, ProcessChurner, ScaleOutSchedule,
+    )
+    from kubernetes_tpu.scheduler.procrun import ProcCluster, WireBindLedger
+    from kubernetes_tpu.testing import make_node, make_pod
+
+    nodes = int(os.environ.get("BENCH_SCALEOUT_NODES", "20000"))
+    pods = int(os.environ.get("BENCH_SCALEOUT_PODS", "60000"))
+    batch = int(os.environ.get("BENCH_SCALEOUT_BATCH", "4096"))
+    timeout = float(os.environ.get("BENCH_SCALEOUT_TIMEOUT", "1200"))
+    CHUNK = 10_000
+
+    def one_pass(n: int) -> dict:
+        cluster = ProcCluster(n, backend="null", batch_size=batch,
+                              nodes=nodes)
+        try:
+            cluster.start()
+            admin = cluster.admin_client()
+            for lo in range(0, nodes, CHUNK):
+                admin.create_bulk(NODES, [
+                    make_node(f"sn-{i}")
+                    .capacity(cpu="64", mem="256Gi", pods=1000).build()
+                    for i in range(lo, min(lo + CHUNK, nodes))])
+            # let every child replicate its node partition over the wire
+            # before the flood (the in-process pass sleeps for the same
+            # reason; here the watch stream adds HTTP latency on top)
+            time.sleep(2.0 + nodes / 20_000)
+            ledger = WireBindLedger(admin)
+            t0 = time.monotonic()
+            for lo in range(0, pods, CHUNK):
+                admin.create_bulk(PODS, [
+                    make_pod(f"sp-{i}").req(cpu="10m", mem="16Mi").build()
+                    for i in range(lo, min(lo + CHUNK, pods))])
+            ok = False
+            while time.monotonic() - t0 < timeout:
+                if ledger.bound_total() >= pods:
+                    ok = True
+                    break
+                time.sleep(0.25)
+            elapsed = time.monotonic() - t0
+            try:
+                ledger.assert_no_double_binds()
+                double_binds: int | str = 0
+            except AssertionError as e:  # record, don't abort the sweep
+                double_binds = str(e)[:500]
+            fleet = federate_texts(cluster.metrics_texts())
+            conflicts = {
+                labels[0]: v for labels, v in
+                fleet.get("scheduler_bind_conflict_total", {}).items()}
+            row = {"pods_per_s": round(pods / elapsed, 1) if ok else 0.0,
+                   "wall_s": round(elapsed, 1),
+                   "bound": ledger.bound_total(),
+                   "double_binds": double_binds,
+                   "conflicts": {k: int(v) for k, v in
+                                 sorted(conflicts.items())},
+                   "conflict_rate": round(
+                       sum(conflicts.values()) / max(pods, 1), 6)}
+            if not ok:
+                row["error"] = "pods left unscheduled"
+            # seeded crash->failover validation: SIGKILL one child, then
+            # prove the survivors drain a fresh flood with the same
+            # exactly-once guarantees (ledger stays live: it has seen
+            # every bind since rv=0, so double-binds across the crash
+            # boundary are visible too)
+            if n > 1 and ok:
+                churner = ProcessChurner(
+                    cluster,
+                    ScaleOutSchedule(seed=13, instance_count=n,
+                                     script={0: (KILL_INSTANCE, 0)}),
+                    min_live=1)
+                applied = churner.step()
+                extra = max(1000, pods // 20)
+                for lo in range(pods, pods + extra, CHUNK):
+                    admin.create_bulk(PODS, [
+                        make_pod(f"sp-{i}")
+                        .req(cpu="10m", mem="16Mi").build()
+                        for i in range(lo, min(lo + CHUNK, pods + extra))])
+                c0 = time.monotonic()
+                c_ok = False
+                while time.monotonic() - c0 < timeout:
+                    if ledger.bound_total() >= pods + extra:
+                        c_ok = True
+                        break
+                    time.sleep(0.25)
+                try:
+                    ledger.assert_no_double_binds()
+                    c_doubles: int | str = 0
+                except AssertionError as e:
+                    c_doubles = str(e)[:500]
+                row["churn"] = {
+                    "applied": list(applied) if applied else None,
+                    "extra_pods": extra,
+                    "bound_after": ledger.bound_total(),
+                    "zero_lost": c_ok,
+                    "double_binds": c_doubles,
+                    "wall_s": round(time.monotonic() - c0, 1)}
+            ledger.stop()
+            return row
+        finally:
+            cluster.shutdown()
+
+    counts = [c for c in (1, 2, 4) if c <= max_procs]
+    if max_procs not in counts:
+        counts.append(max_procs)
+    procs: dict[str, dict] = {}
+    for n in counts:
+        procs[str(n)] = one_pass(n)
+    base = procs.get("1", {}).get("pods_per_s") or 0.0
+    for row in procs.values():
+        if base and row.get("pods_per_s"):
+            row["speedup_vs_1"] = round(row["pods_per_s"] / base, 2)
+    return {"nodes": nodes, "pods": pods, "batch": batch,
+            "host_cores": os.cpu_count(),
+            "BENCH_SCALEOUT_PROC": procs}
+
+
 def run_once(workload: str, nodes: int | None, pods: int | None,
              batch: int, barrier_timeout: float = 900.0,
              rate: float | None = None, depth: int = 1,
@@ -988,6 +1121,18 @@ def main() -> None:
         best = max((row.get("pods_per_s") or 0.0)
                    for row in res["BENCH_SCALEOUT"].values())
         emit(best, {"mode": "scaleout", **res})
+        return
+    if "--processes" in sys.argv:
+        # before the device check on purpose: the process-true row is
+        # null-device (control-plane scaling, not chip scaling) and must
+        # keep reporting when the chip tunnel is down
+        idx = sys.argv.index("--processes")
+        n = (int(sys.argv[idx + 1]) if len(sys.argv) > idx + 1
+             and sys.argv[idx + 1].isdigit() else 2)
+        res = run_scaleout_proc(n)
+        best = max((row.get("pods_per_s") or 0.0)
+                   for row in res["BENCH_SCALEOUT_PROC"].values())
+        emit(best, {"mode": "scaleout-proc", **res})
         return
     if not _device_reachable():
         # The chip tunnel is down — but null-device configs measure the

@@ -410,6 +410,8 @@ def _method(name, recv_node, arg_nodes, env):
     raise CELError(f"unknown method {name}()")
 
 
+# process-local: compiled-expression memo keyed by rule text; each
+# apiserver/child process rebuilds its own copy on first evaluate()
 _CACHE: dict[str, tuple] = {}
 
 

@@ -26,6 +26,7 @@ from typing import Callable
 from ..api import meta
 from ..api.meta import Obj
 from ..store import kv
+from ..utils import fasthost
 from .clientset import Client
 
 logger = logging.getLogger(__name__)
@@ -59,6 +60,11 @@ class Informer:
         self._relist_pending: dict[str, int] = {}  # guarded-by: _lock
         self._retry_rng = random.Random(
             hash(resource) & 0xFFFFFFFF)
+        # deterministic per-INSTANCE relist offset (scale-out): N
+        # processes restarting after an apiserver blip would otherwise
+        # thundering-herd it with simultaneous LISTs; the factory sets
+        # this to a fixed offset derived from the instance index
+        self.relist_stagger = 0.0
 
     # -- lister ----------------------------------------------------------
 
@@ -142,10 +148,16 @@ class Informer:
                 self._list_and_watch()
                 consecutive_failures = 0
             except kv.TooOldError:
-                # the relist itself recovers the window: no backoff
+                # the relist itself recovers the window: no backoff, but
+                # the instance stagger still applies — every instance
+                # overruns its watch window at the same moment when the
+                # store compacts, and N synchronized LISTs is exactly the
+                # herd the offset exists to spread
                 logger.info("informer %s: watch too old, relisting", self.resource)
                 self._tally_relist("too_old")
                 consecutive_failures = 0
+                if self.relist_stagger:
+                    self._stop.wait(self.relist_stagger)
                 continue
             except Exception:  # pragma: no cover - defensive, crash-only restart
                 # jittered exponential backoff: a down store must not get a
@@ -155,6 +167,7 @@ class Informer:
                 consecutive_failures += 1
                 delay = min(30.0, 1.0 * 2 ** (consecutive_failures - 1))
                 delay *= 0.5 + self._retry_rng.random()  # +/-50%
+                delay += self.relist_stagger  # deterministic instance offset
                 logger.exception("informer %s: list/watch failed, retrying "
                                  "in %.1fs", self.resource, delay)
                 self._stop.wait(delay)
@@ -209,21 +222,12 @@ class Informer:
                     continue
                 # apply the whole burst to the indexer under ONE lock
                 # acquisition, then dispatch; per-resource ordering is
-                # preserved (single informer thread, in-order drain)
-                triples = []
+                # preserved (single informer thread, in-order drain).
+                # The apply itself is one fasthost C pass when built
+                # (pure-Python fallback is the identical loop).
                 with self._dispatch_lock:
                     with self._lock:
-                        for ev in evs:
-                            key = meta.namespaced_name(ev.object)
-                            if ev.type == kv.DELETED:
-                                prev = self._indexer.pop(key, None)
-                                triples.append((kv.DELETED, ev.object, prev))
-                            else:
-                                prev = self._indexer.get(key)
-                                self._indexer[key] = ev.object
-                                triples.append(
-                                    (kv.MODIFIED if prev is not None
-                                     else kv.ADDED, ev.object, prev))
+                        triples = fasthost.watch_apply(evs, self._indexer)
                     self._dispatch_all(triples)
         finally:
             w.stop()
@@ -263,12 +267,25 @@ class SharedInformerFactory:
         self._lock = threading.Lock()
         self._informers: dict[str, Informer] = {}
         self._started = False
+        self._relist_stagger = 0.0
+
+    def set_relist_stagger(self, offset: float) -> None:
+        """Set the deterministic relist offset (seconds) on every
+        informer, existing and future — wired from the scaleOut: stanza
+        as a fixed function of the instance index so N processes never
+        relist in lockstep."""
+        with self._lock:
+            self._relist_stagger = max(0.0, offset)
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.relist_stagger = self._relist_stagger
 
     def informer(self, resource: str) -> Informer:
         with self._lock:
             inf = self._informers.get(resource)
             if inf is None:
                 inf = self._informers[resource] = Informer(self.client, resource)
+                inf.relist_stagger = self._relist_stagger
                 if self._started:
                     # factory already running: late informers start eagerly
                     # (client-go restarts the factory; we just start the one)

@@ -70,6 +70,7 @@ from ..ops.flatten import (
 )
 
 NEG = -1e9
+# process-local: per-process debug scratch; never read cross-process
 _WAVE_DEBUG: list = []  # populated only under KTPU_WAVE_DEBUG + eager mode
 TIE_NOISE = 0.05  # breaks exact score ties only (real score deltas >> this).
 # Must stay ABOVE f32 resolution at score scale (~200 * 1.2e-7 * n_cap per

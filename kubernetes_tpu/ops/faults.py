@@ -377,3 +377,43 @@ class InstanceChurner:
             self.injected[act] += 1
             self.log.append((i, act, idx))
             return (act, idx)
+
+
+class ProcessChurner:
+    """InstanceChurner's process-true sibling: applies the SAME seeded
+    ScaleOutSchedule to a procrun.ProcCluster, so the chaos an instance
+    sees is identical whether it lives in this interpreter or in its own
+    OS process.  KILL_INSTANCE becomes SIGKILL (no drain — the victim's
+    lease lapses and survivors absorb its ring slices); REVIVE_INSTANCE
+    becomes a respawn with the old instance identity.  Same min_live
+    floor and `injected` accounting as InstanceChurner."""
+
+    def __init__(self, cluster, schedule: ScaleOutSchedule,
+                 min_live: int = 1):
+        self.cluster = cluster
+        self.schedule = schedule
+        self.min_live = min_live
+        self.waves = 0
+        self.injected = {KILL_INSTANCE: 0, REVIVE_INSTANCE: 0}
+        self.log: list[tuple[int, str, int]] = []
+        self._lock = threading.Lock()
+
+    def step(self) -> tuple[str, int] | None:
+        with self._lock:
+            i = self.waves
+            self.waves += 1
+            act, idx = self.schedule.action(i)
+            if act == NONE or not (0 <= idx < self.cluster.n):
+                return None
+            if act == KILL_INSTANCE:
+                if not self.cluster.alive(idx) \
+                        or len(self.cluster.live_indices()) <= self.min_live:
+                    return None
+                self.cluster.kill(idx)
+            else:
+                if self.cluster.alive(idx):
+                    return None
+                self.cluster.respawn(idx)
+            self.injected[act] += 1
+            self.log.append((i, act, idx))
+            return (act, idx)

@@ -635,12 +635,63 @@ class GrpcDeviceWorker:
 # wraps either implementation.
 
 class _HttpTransport:
-    """Client side of the HTTP/1.1 seam."""
+    """Client side of the HTTP/1.1 seam: ONE pooled keep-alive
+    connection per (host, port) per thread.
+
+    The old per-request urlopen paid TCP handshake + header re-parse on
+    every dispatch/wait — invisible against a 70ms tunnel round trip,
+    but multi-process mode hammers this seam from N schedulers on one
+    loopback.  Connections are thread-local (http.client connections are
+    not thread-safe) and the pool retries ONCE on a stale keep-alive
+    socket; the retry is safe even for mid-flight failures because the
+    server dedups by (epoch, seq) — a replayed post is answered from the
+    dedup cache, never re-executed.  The SeamError ladder is unchanged;
+    unlike urlopen, http.client does not raise on 4xx/5xx, so status
+    classification happens on resp.status."""
 
     kind = "http"
 
     def __init__(self, base_url: str):
+        import http.client as _hc
+        import urllib.parse as _up
+
         self.base_url = base_url
+        parts = _up.urlsplit(base_url)
+        self._hc = _hc
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port or 80
+        self._prefix = parts.path.rstrip("/")
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._conns: list = []  # every conn ever pooled, for close()
+        self._closed = False
+
+    def _conn(self, timeout: float):
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._hc.HTTPConnection(self._host, self._port,
+                                           timeout=timeout)
+            self._local.conn = conn
+            with self._lock:
+                self._conns.append(conn)
+        # refresh the deadline for THIS request: set on the object for
+        # the next connect and directly on a live socket
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        return conn
+
+    def _drop_conn(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        self._local.conn = None
+        if conn is not None:
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def post(self, verb: str, body: bytes, *, timeout: float,
              epoch: int | None = None, seq: int | None = None,
@@ -652,33 +703,49 @@ class _HttpTransport:
             headers["X-KTPU-Seq"] = str(seq)
         if traceparent is not None:
             headers["X-KTPU-Traceparent"] = traceparent
-        req = urllib.request.Request(self.base_url + verb, data=body,
-                                     method="POST", headers=headers)
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            raw = e.read()
+        last: Exception | None = None
+        for attempt in range(2):  # second pass only for a stale socket
+            conn = self._conn(timeout)
+            try:
+                conn.request("POST", self._prefix + verb, body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                status = resp.status
+            except (self._hc.HTTPException, OSError) as e:
+                # stale keep-alive (server idle-closed between requests)
+                # or a real network fault: drop the conn; retry once
+                self._drop_conn()
+                last = e
+                continue
+            if status < 400:
+                return raw
             try:
                 info = json.loads(raw)
                 cls, msg = info.get("class", ""), info.get("error", "")
             except (ValueError, UnicodeDecodeError):
                 cls, msg = "", repr(raw[:200])
-            if e.code == 409 or cls == E_STATE_LOST:
+            if status == 409 or cls == E_STATE_LOST:
                 raise WorkerStateLostError(verb, msg) from None
-            if 400 <= e.code < 500:
+            if 400 <= status < 500:
                 raise WorkerProtocolError(
-                    verb, f"HTTP {e.code} ({cls or 'error'}): {msg}"
+                    verb, f"HTTP {status} ({cls or 'error'}): {msg}"
                 ) from None
             raise TransientSeamError(
-                verb, f"HTTP {e.code} ({cls or 'error'}): {msg}") from None
-        except OSError as e:
-            # URLError (connection refused/reset), socket timeouts — the
-            # network or the worker process, not the request
-            raise TransientSeamError(verb, repr(e)) from None
+                verb, f"HTTP {status} ({cls or 'error'}): {msg}") from None
+        # both attempts died on the wire: the network or the worker
+        # process, not the request
+        raise TransientSeamError(verb, repr(last)) from None
 
     def close(self) -> None:
-        pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+            self._closed = True
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class _GrpcTransport:

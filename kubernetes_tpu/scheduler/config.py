@@ -672,6 +672,12 @@ def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
         sched.configure_overload(cfg.overload)
     if cfg.scale_out.enabled:
         sched.configure_scaleout(cfg.scale_out)
+        # deterministic per-instance relist offset: when every instance
+        # restarts its watch at once (store compaction, apiserver blip),
+        # the LISTs arrive index-staggered instead of as one herd
+        if hasattr(informer_factory, "set_relist_stagger"):
+            informer_factory.set_relist_stagger(
+                0.1 * cfg.scale_out.instance_index)
     if cfg.tracing.enabled:
         # the process-wide provider backs /debug/traces on the apiserver's
         # HTTP mux; tests that want isolation construct their own provider

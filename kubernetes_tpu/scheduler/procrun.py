@@ -1,0 +1,509 @@
+"""Process-true scale-out: supervisor for N scheduler OS processes.
+
+Reference analog: cmd/kube-scheduler as a separate binary per replica with
+--leader-elect=false (the Omega-style multi-scheduler deployment), plus
+test/integration/util's StartApiserver — separate processes wired only
+through the apiserver, never through shared memory.
+
+PR 7 built the scale-out layer (scheduler/scaleout.py: node-pool-ring
+partition, store leases, optimistic compare-and-bind) and PR 9 benched it
+— but with every instance in ONE interpreter, so the GIL serialized the
+host work and 4 instances bought 1.32x.  This module makes the topology
+process-true:
+
+  ProcCluster   spawns `python -m kubernetes_tpu.cmd.apiserver` plus N
+                scheduler children (`python -m
+                kubernetes_tpu.scheduler.procrun --child`), each a FULL
+                scheduler: its own informers over HTTP, its own backend,
+                its own Lease — configured purely through the existing
+                `scaleOut:` stanza.  Readiness is a stdout handshake
+                (KTPU_SCHED_READY line) + a per-child /healthz; liveness
+                is the child's lease (self_live) behind /healthz.
+  child_main    the child entrypoint: SIGTERM triggers a graceful drain
+                (retire the lease -> fence binds -> flush/requeue ->
+                exit 0); SIGKILL is the crash path the churn chaos uses
+                (ops/faults.ProcessChurner).
+  WireBindLedger  the cross-process double-bind detector: tails the
+                apiserver's pod watch from rv=0 and records every
+                nodeName a pod key has EVER carried.
+
+bench.py --processes N drives ProcCluster and federates the children's
+/metrics text (component_base/profiling.federate_texts) into one
+BENCH_SCALEOUT_PROC row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+READY_PREFIX = "KTPU_SCHED_READY"
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- child ----------------------------------------------------------------
+
+
+class _ChildHTTP(http.server.BaseHTTPRequestHandler):
+    """Per-child observability endpoint: /metrics (Prometheus text the
+    supervisor federates) and /healthz (liveness = the scale-out lease;
+    a fenced/retired child answers 503 so a probe restarts it)."""
+
+    sched = None  # class attribute, set per server instance below
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        sched = self.server.sched  # type: ignore[attr-defined]
+        if self.path == "/metrics":
+            body = sched.expose_metrics().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+        elif self.path == "/healthz":
+            so = sched.scaleout
+            ok = so is None or so.self_live
+            body = b"ok" if ok else b"fenced"
+            self.send_response(200 if ok else 503)
+            self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):  # pragma: no cover - silence per-request spam
+        pass
+
+
+def _install_race_probes(client) -> None:
+    """Test-only bind shims, armed by env (see tests/test_scaleout.py
+    cross-process conflict taxonomy):
+
+      KTPU_PROC_BIND_HOLD=<seconds>  delay this child's FIRST bind write,
+          opening a compute-before-peer-commit / commit-after window.
+      KTPU_PROC_BIND_DIVERT=<node>   rewrite this child's FIRST bind to
+          <node> — the peer acting on a divergent partition view.
+
+    Both wrap the live HTTP client, so the raced commit still travels the
+    real wire path: bulk 409 rehydration, conflict re-fetch, taxonomy."""
+    hold = float(os.environ.get("KTPU_PROC_BIND_HOLD", "0") or 0)
+    divert = os.environ.get("KTPU_PROC_BIND_DIVERT", "")
+    if not hold and not divert:
+        return
+    fired: list[bool] = []
+    real_bind, real_bind_many = client.bind, client.bind_many
+
+    def bind(pod, node_name, expect_rv=None):
+        if not fired:
+            fired.append(True)
+            if hold:
+                time.sleep(hold)
+            if divert:
+                node_name = divert
+        return real_bind(pod, node_name, expect_rv)
+
+    def bind_many(bindings):
+        if not fired:
+            fired.append(True)
+            if hold:
+                time.sleep(hold)
+            if divert:
+                bindings = [(b[0], b[1], divert, *b[3:]) for b in bindings]
+        return real_bind_many(bindings)
+
+    client.bind, client.bind_many = bind, bind_many
+
+
+def child_main(args) -> int:
+    """One scheduler instance as an OS process.  Everything it knows
+    about the topology comes from the scaleOut: stanza; everything it
+    knows about the cluster comes over the wire."""
+    from ..client.http_client import HTTPClient
+    from ..client.informer import SharedInformerFactory
+    from .config import load_config, scheduler_from_config
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"sched[{args.instance_index}] %(levelname)s %(message)s")
+    client = HTTPClient.from_url(args.server, token=args.token or None)
+    _install_race_probes(client)
+    factory = SharedInformerFactory(client)
+    stanza: dict = {"kind": "KubeSchedulerConfiguration",
+                    "backend": {"kind": args.backend
+                                if args.backend != "none" else "null",
+                                "batchSize": args.batch_size}}
+    if args.instance_count > 1:
+        stanza["scaleOut"] = {
+            "instanceCount": args.instance_count,
+            "instanceIndex": args.instance_index,
+            "ringSlices": max(64, 16 * args.instance_count),
+            "leaseDurationSeconds": args.lease_duration,
+            "renewIntervalSeconds": args.renew_interval,
+        }
+    sched = scheduler_from_config(client, factory, load_config(stanza))
+    if args.backend != "none":
+        # the harness half of the backend: stanza contract — construct
+        # the device backend the config named and hang it on the profile
+        from ..ops.backend import make_batch_backend
+        from ..perf import caps_for_nodes
+        backend = make_batch_backend(sched.backend_policy.kind,
+                                     caps_for_nodes(max(args.nodes, 256)),
+                                     batch_size=args.batch_size)
+        backend.warmup()
+        profile = next(iter(sched.profiles.values()))
+        profile.batch_backend = backend
+        profile.batch_size = args.batch_size
+        sched.pipeline_depth = 2
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _ChildHTTP)
+    server.sched = sched  # type: ignore[attr-defined]
+    threading.Thread(target=server.serve_forever,
+                     name="child-metrics", daemon=True).start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    factory.start()
+    if not factory.wait_for_cache_sync(60.0):
+        logger.error("cache sync timed out; exiting")
+        return 1
+    sched.run()
+    # readiness handshake: the supervisor tails our stdout for this line
+    print(f"{READY_PREFIX} index={args.instance_index} pid={os.getpid()} "
+          f"metrics_port={server.server_address[1]}", flush=True)
+
+    stop.wait()
+    # graceful drain (SIGTERM): retire the lease FIRST so the bind fence
+    # rejects any wave still in flight (nothing new reaches the store),
+    # then stop the loop — its shutdown path flushes/requeues in-flight
+    # work so peers absorbing our partition find every pod in the store.
+    if sched.scaleout is not None:
+        sched.scaleout.retire()
+    sched.stop()
+    factory.stop()
+    server.shutdown()
+    return 0
+
+
+# -- supervisor -----------------------------------------------------------
+
+
+class _Child:
+    """One scheduler child: Popen + stdout tail + readiness state."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.proc: subprocess.Popen | None = None
+        self.metrics_port: int | None = None
+        self.ready = threading.Event()
+        self.lines: list[str] = []  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def tail(self, n: int = 20) -> list[str]:
+        with self._lock:
+            return self.lines[-n:]
+
+    def _reader(self, proc: subprocess.Popen) -> None:
+        for raw in proc.stdout:  # type: ignore[union-attr]
+            line = raw.rstrip("\n")
+            with self._lock:
+                self.lines.append(line)
+                del self.lines[:-200]
+            if line.startswith(READY_PREFIX):
+                for tok in line.split():
+                    if tok.startswith("metrics_port="):
+                        self.metrics_port = int(tok.split("=", 1)[1])
+                self.ready.set()
+
+
+class ProcCluster:
+    """Supervisor: one apiserver process + N scheduler processes.
+
+    Lifecycle: start() spawns everything and blocks on the readiness
+    handshake; kill(i) is the crash path (SIGKILL, no drain — the
+    victim's lease lapses and survivors absorb its ring slices);
+    drain(i) is the graceful path (SIGTERM -> lease retire -> flush ->
+    exit 0); respawn(i) brings an instance back with its old identity.
+    shutdown() drains every child then the apiserver.  Context-manager
+    friendly so a failing test can never leak processes (tests add the
+    conftest proc_reaper belt on top)."""
+
+    def __init__(self, n_instances: int, *, backend: str = "none",
+                 batch_size: int = 1024, nodes: int = 256,
+                 lease_duration: float = 1.5, renew_interval: float = 0.25,
+                 solo_ownership: bool = False,
+                 child_env: dict[int, dict[str, str]] | None = None,
+                 ready_timeout: float = 120.0):
+        self.n = n_instances
+        self.backend = backend
+        self.batch_size = batch_size
+        self.nodes = nodes
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        # solo_ownership: every child runs instanceCount=1 (scale-out
+        # layer off) so ALL children own ALL pods — the deliberate-race
+        # topology the cross-process conflict tests use
+        self.solo = solo_ownership
+        self.child_env = child_env or {}
+        self.ready_timeout = ready_timeout
+        self.url: str | None = None
+        self.token: str | None = None
+        self._api: subprocess.Popen | None = None
+        self._children: dict[int, _Child] = {}
+        self._clients: list = []  # admin HTTPClients handed out
+
+    # -- apiserver --------------------------------------------------------
+
+    def _start_apiserver(self) -> None:
+        import secrets
+
+        from ..client.http_client import HTTPClient
+        port = _free_port()
+        self.token = secrets.token_urlsafe(16)
+        self.url = f"http://127.0.0.1:{port}"
+        # AlwaysAllow + no admission: this supervisor exists to measure
+        # the SCHEDULER topology; perf/scheduler_perf.py via_http keeps
+        # the RBAC+admission front-door configuration
+        self._api = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.cmd.apiserver",
+             "--secure-port", str(port), "--token", self.token],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=_REPO_ROOT)
+        client = HTTPClient.from_url(self.url, token=self.token)
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                client._request("GET", "/healthz")
+                return
+            except Exception:  # noqa: BLE001 - still starting
+                if self._api.poll() is not None \
+                        or time.monotonic() > deadline:
+                    self.shutdown()
+                    raise RuntimeError(
+                        "apiserver process failed to start") from None
+                time.sleep(0.1)
+
+    def admin_client(self):
+        from ..client.http_client import HTTPClient
+        cl = HTTPClient.from_url(self.url, token=self.token)
+        self._clients.append(cl)
+        return cl
+
+    # -- children ---------------------------------------------------------
+
+    def _spawn(self, index: int) -> _Child:
+        child = _Child(index)
+        env = dict(os.environ)
+        if self.backend in ("none", "null"):
+            # host-only children must never touch (or wait on) a device
+            env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONUNBUFFERED"] = "1"
+        env.update(self.child_env.get(index, {}))
+        count = 1 if self.solo else self.n
+        argv = [sys.executable, "-m", "kubernetes_tpu.scheduler.procrun",
+                "--child", "--server", self.url, "--token", self.token,
+                "--instance-index", str(0 if self.solo else index),
+                "--instance-count", str(count),
+                "--backend", self.backend,
+                "--batch-size", str(self.batch_size),
+                "--nodes", str(self.nodes),
+                "--lease-duration", str(self.lease_duration),
+                "--renew-interval", str(self.renew_interval)]
+        child.proc = subprocess.Popen(
+            argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=_REPO_ROOT, env=env)
+        threading.Thread(target=child._reader, args=(child.proc,),
+                         name=f"child-tail-{index}", daemon=True).start()
+        self._children[index] = child
+        return child
+
+    def start(self) -> "ProcCluster":
+        self._start_apiserver()
+        for i in range(self.n):
+            self._spawn(i)
+        self.wait_ready(range(self.n))
+        return self
+
+    def wait_ready(self, indices) -> None:
+        deadline = time.monotonic() + self.ready_timeout
+        for i in indices:
+            child = self._children[i]
+            while not child.ready.wait(
+                    min(1.0, max(0.0, deadline - time.monotonic()))):
+                if child.proc is not None and child.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"scheduler child {i} exited rc="
+                        f"{child.proc.returncode} before READY; tail: "
+                        f"{child.tail()}")
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"scheduler child {i} not READY after "
+                        f"{self.ready_timeout}s; tail: {child.tail()}")
+
+    def alive(self, index: int) -> bool:
+        c = self._children.get(index)
+        return (c is not None and c.proc is not None
+                and c.proc.poll() is None)
+
+    def live_indices(self) -> list[int]:
+        return [i for i in self._children if self.alive(i)]
+
+    def kill(self, index: int) -> None:
+        """Crash path: SIGKILL, no drain — the chaos ladder's
+        KILL_INSTANCE made process-true."""
+        c = self._children.get(index)
+        if c is None or c.proc is None:
+            return
+        try:
+            c.proc.kill()
+        except OSError:
+            pass
+        c.proc.wait()
+        c.ready.clear()
+
+    def drain(self, index: int, timeout: float = 20.0) -> int | None:
+        """Graceful path: SIGTERM -> the child retires its lease, flushes
+        in-flight work and exits 0.  Escalates to SIGKILL on a hang so a
+        stuck child can never wedge the caller."""
+        c = self._children.get(index)
+        if c is None or c.proc is None:
+            return None
+        if c.proc.poll() is None:
+            try:
+                c.proc.terminate()
+            except OSError:
+                pass
+            try:
+                c.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                c.proc.kill()
+                c.proc.wait()
+        c.ready.clear()
+        return c.proc.returncode
+
+    def respawn(self, index: int, wait_ready: bool = True) -> None:
+        if self.alive(index):
+            return
+        self._spawn(index)
+        if wait_ready:
+            self.wait_ready([index])
+
+    def metrics_texts(self) -> list[str]:
+        """One /metrics pull per live child — the raw exposition bodies
+        component_base/profiling.federate_texts merges (the true
+        cross-process federation path PR 8 built the parser for)."""
+        import urllib.request
+        out = []
+        for i in sorted(self._children):
+            c = self._children[i]
+            if not self.alive(i) or c.metrics_port is None:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{c.metrics_port}/metrics",
+                        timeout=10.0) as resp:
+                    out.append(resp.read().decode())
+            except OSError:  # child died mid-pull: skip, don't fail
+                continue
+        return out
+
+    def shutdown(self) -> None:
+        for i in list(self._children):
+            try:
+                self.drain(i, timeout=10.0)
+            except Exception:  # noqa: BLE001 - teardown must not raise
+                pass
+        if self._api is not None:
+            self._api.terminate()
+            try:
+                self._api.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                self._api.kill()
+                self._api.wait()
+            self._api = None
+
+    def __enter__(self) -> "ProcCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+class WireBindLedger:
+    """Cross-process double-bind detector: tails the apiserver's pod
+    watch from rv=0 (the store's full event history) and records every
+    nodeName a pod key has EVER carried.  A pod bound exactly once has
+    one node in its set; a pod two PROCESSES both committed would show
+    two — the assertion no amount of in-process mocking can fake."""
+
+    def __init__(self, client):
+        self.nodes_seen: dict[str, set[str]] = {}
+        from ..client.clientset import PODS
+        self._watch = client.watch(PODS, since_rv=0)
+
+    def drain(self, timeout: float = 0.05):
+        for ev in self._watch.next_batch(timeout=timeout):
+            md = ev.object.get("metadata") or {}
+            key = f"{md.get('namespace')}/{md.get('name')}"
+            node = (ev.object.get("spec") or {}).get("nodeName")
+            if node:
+                self.nodes_seen.setdefault(key, set()).add(node)
+        return self.nodes_seen
+
+    def bound_total(self) -> int:
+        self.drain()
+        return len(self.nodes_seen)
+
+    def assert_no_double_binds(self) -> None:
+        self.drain()
+        moved = {k: v for k, v in self.nodes_seen.items() if len(v) > 1}
+        assert not moved, f"pods bound to more than one node: {moved}"
+
+    def stop(self) -> None:
+        self._watch.stop()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="ktpu-procrun")
+    ap.add_argument("--child", action="store_true",
+                    help="run as one scheduler instance (supervisor use)")
+    ap.add_argument("--server", default="http://127.0.0.1:8080")
+    ap.add_argument("--token", default="")
+    ap.add_argument("--instance-index", type=int, default=0)
+    ap.add_argument("--instance-count", type=int, default=1)
+    ap.add_argument("--backend", default="none",
+                    choices=["none", "null", "tpu", "sharded"],
+                    help="batch backend kind; none = per-pod host path")
+    ap.add_argument("--batch-size", type=int, default=1024)
+    ap.add_argument("--nodes", type=int, default=256,
+                    help="expected node count (backend capacity sizing)")
+    ap.add_argument("--lease-duration", type=float, default=1.5)
+    ap.add_argument("--renew-interval", type=float, default=0.25)
+    args = ap.parse_args(argv)
+    if not args.child:
+        ap.error("supervisor mode is library-only: use ProcCluster; "
+                 "--child is the process entrypoint")
+    sys.exit(child_main(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,7 @@ handling):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -282,6 +283,93 @@ class Profile:
         self.batch_size = batch_size
 
 
+class _BinderWorker:
+    """Dedicated binder: one pinned worker + a bounded queue, mirroring
+    the remote-worker seam's single-consumer discipline.
+
+    LATENCY.md rounds 4-5 traced the ~8k/s host-only p99 knee to the
+    binder: bulk commits ran on a 16-thread pool whose GIL wake-ups
+    landed inside the NEXT wave's snapshot/assume window.  Routing the
+    bulk/turbo commits through ONE thread (optionally CPU-pinned via
+    KTPU_BINDER_PIN) moves the bind write off the wave critical path and
+    stops the pool's thundering wake-ups.  The queue is BOUNDED: when
+    binds fall behind, put() blocking the dispatch loop IS the
+    backpressure (same contract as the bounded relay in ops/remote).
+
+    Only the non-blocking commits route here — the per-pod cycle can
+    park in WaitOnPermit (Coscheduling gangs) and would deadlock a
+    single consumer, so it stays on the pool."""
+
+    def __init__(self, maxsize: int = 16):
+        import queue as _qmod
+        self._q: "_qmod.Queue" = _qmod.Queue(maxsize=maxsize)
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._stopped = False
+
+    def _ensure_started(self) -> None:
+        if self._thread is None:
+            with self._lock:
+                if self._thread is None and not self._stopped:
+                    t = threading.Thread(target=self._run, name="binder0",
+                                         daemon=True)
+                    t.start()
+                    self._thread = t
+
+    def _run(self) -> None:
+        pin = os.environ.get("KTPU_BINDER_PIN", "")
+        if pin and hasattr(os, "sched_setaffinity"):
+            try:
+                os.sched_setaffinity(0, {int(pin)})
+            except (OSError, ValueError):
+                pass  # advisory: pinning is a perf hint, never a failure
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            # not a retry loop: a failed commit is logged and dropped (its
+            # pods requeue via the cycle's own conflict/error tails), the
+            # loop moves on to the NEXT queued commit
+            # ktpulint: disable=retry-backoff
+            except Exception:  # pragma: no cover - commit tails self-handle
+                logger.exception("binder worker cycle error")
+
+    def submit(self, fn, *args) -> bool:
+        """Enqueue a commit; blocks when the queue is full (backpressure).
+        False once stopped — the caller falls back to inline/pool."""
+        if self._stopped:
+            return False
+        self._ensure_started()
+        self._q.put((fn, args))
+        return True
+
+    def stop(self) -> None:
+        """Stop the worker and run any still-queued commits inline so no
+        assumed pod is stranded unbound and unrequeued."""
+        self._stopped = True
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join(timeout=5.0)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except Exception:  # queue.Empty
+                return
+            if item is None:
+                continue
+            fn, args = item
+            try:
+                fn(*args)
+            # drain, not retry: each queued commit runs once; a failure is
+            # logged and the loop advances to the next leftover item
+            # ktpulint: disable=retry-backoff
+            except Exception:  # pragma: no cover
+                logger.exception("binder drain cycle error")
+
+
 class Scheduler:
     """The scheduler (scheduler.go:62)."""
 
@@ -344,6 +432,10 @@ class Scheduler:
         self._deferred: list[QueuedPodInfo] = []  # per-pod pods awaiting a quiescent cache
         self._binder_pool = ThreadPoolExecutor(max_workers=16,
                                                thread_name_prefix="bind")
+        # bulk/turbo commits route through ONE dedicated worker off the
+        # wave critical path; the per-pod cycle (blocking WaitOnPermit)
+        # stays on the pool above (see _BinderWorker)
+        self._binder_worker = _BinderWorker()
         # distributed tracing (component_base/tracing.py): None until
         # configure_tracing attaches a provider; sampling is decided once
         # per batch at the root span and inherited everywhere below
@@ -742,6 +834,7 @@ class Scheduler:
             t.join(timeout=2.0)
         if not any(t.is_alive() for t in self._threads):
             self._flush_pending()  # loop thread gone: safe to drain here
+        self._binder_worker.stop()  # runs queued commits inline
         self._binder_pool.shutdown(wait=False)
 
     def _loop(self) -> None:
@@ -1833,9 +1926,18 @@ class Scheduler:
             cycle, start, run_post_bind=False, span=span)
 
     def _submit_binding(self, fn, *args) -> None:
-        """Submit a binding cycle to the pool; if the pool was shut down
-        (stop() racing a final flush), run it inline so no assumed pod is
-        stranded unbound and unrequeued."""
+        """Route a binding cycle off the wave critical path.
+
+        Non-blocking commits (bulk/turbo) go to the dedicated binder
+        worker — single consumer, bounded queue, optional CPU pin.  The
+        per-pod cycle can park in WaitOnPermit (Coscheduling gangs), so
+        it keeps the multi-thread pool; a stopped worker or shut-down
+        pool degrades to inline so no assumed pod is stranded unbound
+        and unrequeued."""
+        wired = (Scheduler._binding_cycle_turbo, Scheduler._binding_cycle_bulk)
+        if getattr(fn, "__func__", None) in wired \
+                and self._binder_worker.submit(fn, *args):
+            return
         try:
             self._binder_pool.submit(fn, *args)
         except RuntimeError:
@@ -1896,8 +1998,7 @@ class Scheduler:
             # this span runs on the binder pool thread
             bind_sp = span.tracer.start_span("bind", parent=span)
             bind_sp.set_attribute("pods", len(ready))
-        bindings = [(meta.namespace(q.pod), meta.name(q.pod), node)
-                    for _, q, node, _ in ready]
+        bindings = fasthost.binding_rows(ready)
         t_phase = time.monotonic()
         if self.scaleout is not None and not self.scaleout.self_live:
             # write fence (scale-out lease lapsed or instance retired):

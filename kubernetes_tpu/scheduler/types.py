@@ -346,11 +346,13 @@ def node_selector_terms_match(terms: list[tuple[Selector, Selector]], node: Obj)
     return False
 
 
+# process-local: read-only empties (contract below) — a per-process
+# copy is exactly as good as a shared one
 _EMPTY_PORTS: list[tuple[str, str, int]] = []
 # shared empties for the no-affinity fast path; treated as immutable
-_EMPTY_TERMS: list = []
-_EMPTY_DICT: dict = {}
-_EMPTY_LIST: list = []
+_EMPTY_TERMS: list = []  # process-local: same read-only contract
+_EMPTY_DICT: dict = {}  # process-local: same read-only contract
+_EMPTY_LIST: list = []  # process-local: same read-only contract
 # singletons handed to the C fast path (fasthost.pod_scan_into): shared
 # across every simple PodInfo, read-only by the same contract as
 # _EMPTY_TERMS (consumers only iterate/read these fields)

@@ -11,6 +11,8 @@ the scheduler's per-pod host loops (see fasthost.c header for the
 inventory and the reference's goroutine/parallel-for analog).  Consumers:
 
   scheduler/scheduler.py  _finish_batch  -> build_assumed, clone_podinfos
+  scheduler/scheduler.py  _bulk_bind_commit -> binding_rows
+  client/informer.py      _list_and_watch -> watch_apply
   ops/flatten.py          encode         -> req_columns
   scheduler/types.py      PodInfo.update -> pod_scan_into
 
@@ -105,3 +107,43 @@ def clone_podinfos(infos: list, pods: list) -> list:
     if _native is not None:
         return _native.clone_podinfos(infos, pods)
     return [pi.clone_with_pod(pod) for pi, pod in zip(infos, pods)]
+
+
+# The two round-12 helpers use getattr guards, not bare _native checks: a
+# stale .so built before this round imports fine but lacks the symbols.
+
+
+def watch_apply(events: list, indexer: dict) -> list:
+    """Informer watch-burst apply: update the indexer from a batch of
+    watch events and return the (type, obj, prev) dispatch triples.
+    Caller holds the informer locks (single C pass replaces the
+    per-event bytecode loop in Informer._list_and_watch)."""
+    from ..api import meta
+    from ..store import kv
+    fn = getattr(_native, "watch_apply", None)
+    if fn is not None:
+        return fn(events, indexer, kv.DELETED, kv.ADDED, kv.MODIFIED)
+    triples = []
+    for ev in events:
+        key = meta.namespaced_name(ev.object)
+        if ev.type == kv.DELETED:
+            prev = indexer.pop(key, None)
+            triples.append((kv.DELETED, ev.object, prev))
+        else:
+            prev = indexer.get(key)
+            indexer[key] = ev.object
+            triples.append((kv.MODIFIED if prev is not None
+                            else kv.ADDED, ev.object, prev))
+    return triples
+
+
+def binding_rows(ready: list) -> list:
+    """(namespace, name, node) wire rows from the bulk-bind ready
+    tuples (state, qpi, node, assumed) — the binder-worker half of the
+    bind critical path in one C pass."""
+    from ..api import meta
+    fn = getattr(_native, "binding_rows", None)
+    if fn is not None:
+        return fn(ready)
+    return [(meta.namespace(q.pod), meta.name(q.pod), node)
+            for _, q, node, _ in ready]

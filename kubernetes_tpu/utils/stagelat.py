@@ -31,6 +31,8 @@ ENABLED = os.environ.get("KTPU_STAGE_DEBUG", "0") not in ("", "0")
 
 _CAP = 4096  # per-stage reservoir bound (newest kept, oldest dropped)
 _lock = threading.Lock()
+# process-local: latency reservoir; each scheduler process reports
+# its own stages, federation happens at the /metrics text layer
 _stages: dict[str, list[float]] = {}
 
 

@@ -55,3 +55,51 @@ def pytest_configure(config):
         "scaleout: multi-instance scheduler tests (tests/test_scaleout.py); "
         "tier-1 runs the shrunk 2-instance chaos case, the full "
         "churn matrix is additionally marked slow")
+    config.addinivalue_line(
+        "markers",
+        "proc: process-true topology tests that spawn real apiserver + "
+        "scheduler OS processes (scheduler/procrun.py); every such test "
+        "takes the proc_reaper fixture so a hung child can never wedge "
+        "tier-1")
+
+
+@pytest.fixture
+def proc_reaper():
+    """Hard-timeout + orphan-reaping belt for process-topology tests.
+
+    Yields a `register(cluster_or_popen)` function.  On teardown — pass
+    OR fail — everything registered is force-reaped (ProcCluster via
+    shutdown(), bare Popens via kill), and a watchdog thread SIGKILLs
+    the registered children if the test body itself outlives the hard
+    deadline, so a wedged child can't hold the suite past its timeout.
+    """
+    import subprocess
+    import threading
+
+    registered: list = []
+    reaped = threading.Event()
+
+    def _reap():
+        for item in registered:
+            try:
+                if isinstance(item, subprocess.Popen):
+                    if item.poll() is None:
+                        item.kill()
+                        item.wait(timeout=10.0)
+                else:
+                    item.shutdown()
+            except Exception:  # noqa: BLE001 - reaping is best-effort
+                pass
+
+    def _watchdog():
+        # hard ceiling per proc test; generous next to the per-call
+        # readiness timeouts, tiny next to the tier-1 driver timeout
+        if not reaped.wait(240.0):
+            _reap()
+
+    threading.Thread(target=_watchdog, name="proc-reaper", daemon=True).start()
+    try:
+        yield registered.append
+    finally:
+        _reap()
+        reaped.set()

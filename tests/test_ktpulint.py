@@ -43,7 +43,7 @@ def run_rule(ctx: LintContext, name: str) -> list[Finding]:
 
 def test_registry_has_the_full_catalog():
     rules = all_rules()
-    assert len(rules) >= 19
+    assert len(rules) >= 20
     for name, rule in rules.items():
         assert name == rule.name
         assert rule.doc, f"rule {name} has no doc line"
@@ -713,6 +713,66 @@ def test_lock_discipline_fires_and_clean(tmp_path):
                 self._items.append(x)
         """})
     assert run_rule(ctx, "lock-discipline") == []
+
+
+# -- process rules ---------------------------------------------------------
+
+def test_process_safe_state_fires_and_clean(tmp_path):
+    # seeded violation: a registry two hops from the child entrypoint,
+    # reached through a RELATIVE import (the resolver's hard case)
+    ctx = make_ctx(tmp_path, {
+        f"{PKG}/__init__.py": "",
+        f"{PKG}/scheduler/__init__.py": "",
+        f"{PKG}/scheduler/procrun.py": f"""\
+            from .config import load
+            from {PKG}.client import informer
+            """,
+        f"{PKG}/scheduler/config.py": """\
+            _REGISTRY = {}
+
+            def load():
+                return _REGISTRY
+            """,
+        f"{PKG}/client/__init__.py": "",
+        f"{PKG}/client/informer.py": """\
+            import collections
+
+            _CACHES = collections.defaultdict(list)
+            LOOKUP = {"a": 1}
+            _TUPLE = ()
+            """,
+    })
+    found = run_rule(ctx, "process-safe-state")
+    assert sorted(f.path for f in found) == [
+        f"{PKG}/client/informer.py", f"{PKG}/scheduler/config.py"]
+    assert all("process-local" in f.message for f in found)
+    # populated literals and immutables are out of scope by design
+    assert not any("LOOKUP" in f.message or "_TUPLE" in f.message
+                   for f in found)
+
+    # clean: same tree with the annotation claims in place; a module NOT
+    # in the entrypoint closure stays invisible however mutable it is
+    ctx = make_ctx(tmp_path / "ok", {
+        f"{PKG}/__init__.py": "",
+        f"{PKG}/scheduler/__init__.py": "",
+        f"{PKG}/scheduler/procrun.py": "from .config import load\n",
+        f"{PKG}/scheduler/config.py": """\
+            # process-local: plugin registry, rebuilt per child on import
+            _REGISTRY = {}
+
+            def load():
+                return _REGISTRY
+            """,
+        f"{PKG}/unreached.py": "_GLOBAL_STATE = {}\n",
+    })
+    assert run_rule(ctx, "process-safe-state") == []
+
+
+def test_process_safe_state_real_tree_is_annotated():
+    """The actual child-process import closure carries its claims."""
+    import pathlib
+    ctx = LintContext(pathlib.Path(__file__).resolve().parents[1])
+    assert run_rule(ctx, "process-safe-state") == []
 
 
 # -- engine mechanics ------------------------------------------------------

@@ -1,0 +1,119 @@
+"""Process-true scale-out: the procrun supervisor drives a real
+apiserver process plus N scheduler processes wired only over HTTP.
+
+These are the cross-PROCESS versions of test_scaleout.py's chaos layer:
+the shared interpreter is gone, so every property must hold across
+actual OS process boundaries — exactly-once binding proved by a
+store-watch ledger over the wire, crash->failover driven by SIGKILL (not
+coordinator.retire()), and graceful drain as a SIGTERM/exit-code
+contract.  Reference analog: test/integration/scheduler runs the real
+binaries against a live apiserver for the same reason.
+
+Every test takes proc_reaper (conftest): registered clusters are
+force-reaped on teardown and a watchdog SIGKILLs the children if the
+test wedges, so a hung child can never hold tier-1 hostage.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.component_base.profiling import federate_texts
+from kubernetes_tpu.ops.faults import (
+    KILL_INSTANCE, ProcessChurner, ScaleOutSchedule)
+from kubernetes_tpu.scheduler.procrun import ProcCluster, WireBindLedger
+from kubernetes_tpu.testing import make_node, make_pod
+
+pytestmark = pytest.mark.proc
+
+
+def wait_for(pred, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def fill_cluster(admin, nodes: int):
+    for i in range(nodes):
+        admin.create(NODES, make_node(f"n{i}")
+                     .capacity(cpu="16", mem="64Gi", pods=110).build())
+
+
+def submit_pods(admin, count: int, offset: int = 0):
+    for i in range(offset, offset + count):
+        admin.create(PODS, make_pod(f"p{i}")
+                     .req(cpu="100m", mem="128Mi").build())
+
+
+class TestProcessTopology:
+    def test_two_process_exactly_once_and_drain(self, proc_reaper):
+        """The tier-1 keeper: 2 scheduler processes over one wire
+        apiserver bind every pod exactly once (cross-process BindLedger:
+        zero double-binds, zero lost pods), per-instance /metrics
+        federate, and SIGTERM drains both children to exit code 0."""
+        cluster = ProcCluster(2, nodes=16)
+        proc_reaper(cluster)
+        cluster.start()
+        assert cluster.live_indices() == [0, 1]
+        admin = cluster.admin_client()
+        fill_cluster(admin, 16)
+        ledger = WireBindLedger(admin)
+        submit_pods(admin, 80)
+
+        assert wait_for(lambda: ledger.bound_total() >= 80), \
+            f"only {ledger.bound_total()}/80 pods bound; " \
+            f"live={cluster.live_indices()}"
+        ledger.assert_no_double_binds()
+        assert ledger.bound_total() == 80  # zero lost pods
+        ledger.stop()
+
+        # PR-8 federation over the true cross-process path: one /metrics
+        # pull per child, merged into a single view
+        texts = cluster.metrics_texts()
+        assert len(texts) == 2
+        fed = federate_texts(texts)
+        assert any(name.startswith("scheduler_") for name in fed), \
+            f"no scheduler metrics federated: {sorted(fed)[:5]}"
+
+        # graceful drain contract: SIGTERM -> retire lease -> flush ->
+        # exit 0 (a non-zero code means the drain path raised)
+        assert cluster.drain(0) == 0
+        assert cluster.drain(1) == 0
+
+    def test_crash_failover_under_seeded_churn(self, proc_reaper):
+        """SIGKILL one instance mid-stream via the seeded churn schedule
+        (the process-true KILL_INSTANCE): the victim's lease lapses, the
+        survivor absorbs its ring slices, and every pod still lands
+        exactly once."""
+        cluster = ProcCluster(2, nodes=8,
+                              lease_duration=1.0, renew_interval=0.2)
+        proc_reaper(cluster)
+        cluster.start()
+        admin = cluster.admin_client()
+        fill_cluster(admin, 8)
+        ledger = WireBindLedger(admin)
+
+        submit_pods(admin, 20)
+        assert wait_for(lambda: ledger.bound_total() >= 10)
+
+        churner = ProcessChurner(
+            cluster,
+            ScaleOutSchedule(seed=7, instance_count=2,
+                             script={0: (KILL_INSTANCE, 0)}),
+            min_live=1)
+        assert churner.step() == (KILL_INSTANCE, 0)
+        assert not cluster.alive(0) and cluster.alive(1)
+        assert churner.injected[KILL_INSTANCE] == 1
+
+        # pods submitted AFTER the crash prove the survivor absorbed the
+        # dead instance's partition, not just finished its own backlog
+        submit_pods(admin, 20, offset=20)
+        assert wait_for(lambda: ledger.bound_total() >= 40), \
+            f"only {ledger.bound_total()}/40 bound after crash"
+        ledger.assert_no_double_binds()
+        assert ledger.bound_total() == 40
+        ledger.stop()

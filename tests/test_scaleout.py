@@ -456,3 +456,64 @@ class TestScaleOutChurnMatrix:
                 s.stop()
                 f.stop()
             ledger.stop()
+
+
+@pytest.mark.proc
+class TestCrossProcessConflictTaxonomy:
+    """The taxonomy proven across REAL process boundaries: two live
+    scheduler processes race a bind on the same pod key through the wire
+    apiserver; exactly one classifies `lost_to_peer` and the peer's
+    placement stands.  The in-process taxonomy tests above monkeypatch
+    client.bind — here the 409 travels the full HTTP rehydration path
+    (apiserver bind_conflict_status -> _bind_conflict_from).
+
+    Determinism construction (no sleep-and-hope): both children run
+    solo-ownership (instanceCount=1) so both schedule every pod, and the
+    cluster has ONE feasible node (n0; n1 is too small to fit the pod),
+    so both deterministically pick n0.  The race-probe env knobs then
+    pin the interleaving: the peer (child 1) holds its first bind 0.5s
+    and commits it DIVERTED to n1 — a peer acting on a divergent
+    partition view — while child 0 holds 2.5s, guaranteeing its commit
+    lands strictly after the peer's."""
+
+    def test_exactly_one_lost_to_peer(self, proc_reaper):
+        from kubernetes_tpu.component_base.profiling import (
+            parse_prometheus_text)
+        from kubernetes_tpu.scheduler.procrun import ProcCluster
+
+        cluster = ProcCluster(
+            2, solo_ownership=True, nodes=2,
+            child_env={0: {"KTPU_PROC_BIND_HOLD": "2.5"},
+                       1: {"KTPU_PROC_BIND_HOLD": "0.5",
+                           "KTPU_PROC_BIND_DIVERT": "n1"}})
+        proc_reaper(cluster)
+        cluster.start()
+        admin = cluster.admin_client()
+        admin.create(NODES, make_node("n0")
+                     .capacity(cpu="16", mem="64Gi", pods=110).build())
+        admin.create(NODES, make_node("n1")
+                     .capacity(cpu="100m", mem="64Mi", pods=110).build())
+        admin.create(PODS, make_pod("racer").req(cpu="4", mem="1Gi").build())
+
+        def lost_to_peer_counts():
+            out = []
+            for text in cluster.metrics_texts():
+                series = parse_prometheus_text(text).get(
+                    "scheduler_bind_conflict_total", {})
+                out.append(sum(v for labels, v in series.items()
+                               if "lost_to_peer" in labels))
+            return out
+
+        assert wait_for(lambda: sum(lost_to_peer_counts()) >= 1,
+                        timeout=60.0), \
+            f"no lost_to_peer surfaced: {lost_to_peer_counts()}"
+        # exactly one loser, and it is the held child (index 0)
+        assert lost_to_peer_counts() == [1.0, 0.0]
+        # the peer's placement stands: the diverted commit to n1 won
+        pod = admin.get(PODS, "default", "racer")
+        assert (pod.get("spec") or {}).get("nodeName") == "n1"
+        # and it STAYS won — the loser must not requeue/rebind it
+        time.sleep(1.0)
+        assert lost_to_peer_counts() == [1.0, 0.0]
+        assert admin.get(PODS, "default",
+                         "racer")["spec"]["nodeName"] == "n1"

@@ -23,6 +23,9 @@ Annotation conventions (documented in README "Static analysis"):
       shared attribute (lock-discipline rule)
   # replicated-ok: <why>                     authorize a replicated
       partition-rule entry (replicated-large-tensor rule)
+  # process-local: <why>                     declare a module-level
+      mutable singleton safe across fork/spawn boundaries — each OS
+      process gets (and wants) its own copy (process-safe-state rule)
 
 Findings are deterministic and ordered; a baseline file (JSON list of
 fingerprints) lets pre-existing accepted findings ride without blocking
@@ -42,7 +45,8 @@ from typing import Callable, Iterable, Iterator
 _DISABLE_RE = re.compile(r"#\s*ktpulint:\s*disable=([\w,\- ]+)")
 _DISABLE_FILE_RE = re.compile(r"#\s*ktpulint:\s*disable-file=([\w,\- ]+)")
 _ANNOTATION_RE = re.compile(
-    r"#\s*(sync-point|compile-cached|guarded-by|replicated-ok)\b")
+    r"#\s*(sync-point|compile-cached|guarded-by|replicated-ok|"
+    r"process-local)\b")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,5 +1,5 @@
 """Rule modules; importing this package populates engine.REGISTRY."""
 
 from . import (  # noqa: F401
-    device, lifecycle, observability, pipeline, threads, wiring,
+    device, lifecycle, observability, pipeline, process, threads, wiring,
 )

@@ -1,0 +1,147 @@
+"""Process-topology rule: mutable module state reachable from child
+processes must be declared process-local.
+
+Reference analog: the reference never shares interpreter state between
+scheduler replicas — each is its own binary (cmd/kube-scheduler), and
+anything cross-replica goes through the apiserver.  Our procrun
+supervisor re-creates that shape, which silently CHANGES the meaning of
+every module-level registry and cache in the child's import closure:
+what used to be one shared singleton per test process becomes one copy
+PER OS PROCESS.  That's usually exactly right (metrics accumulators,
+interned caches) — but only the author knows, so the rule forces the
+claim into the source as `# process-local: <why>`.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from ..engine import Finding, LintContext, Rule, register
+
+# accumulator-shaped constructors: a module-level call to one of these
+# is a registry/cache in the making
+_MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque", "Counter",
+                  "OrderedDict", "WeakValueDictionary", "WeakKeyDictionary"}
+
+
+def _ctor_name(call: ast.Call) -> str | None:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _is_mutable_singleton(value: ast.expr) -> bool:
+    """True for accumulator-shaped initializers: EMPTY mutable literals
+    and mutable-container constructor calls.  Populated literals (lookup
+    tables) are deliberately out of scope — they're read-only by idiom
+    and flagging them would bury the real registries in noise."""
+    if isinstance(value, ast.Dict):
+        return not value.keys
+    if isinstance(value, (ast.List, ast.Set)):
+        return not value.elts
+    if isinstance(value, ast.Call):
+        return _ctor_name(value) in _MUTABLE_CTORS
+    return False
+
+
+@register
+class ProcessSafeStateRule(Rule):
+    """Walks the import closure of the supervisor's child-process
+    entrypoints (AST-only — nothing is imported) and flags module-level
+    mutable singletons lacking a `# process-local: <why>` annotation."""
+
+    name = "process-safe-state"
+    scope = "project"
+    doc = "child-reachable module-level mutable singletons are annotated"
+
+    ENTRYPOINTS = ("scheduler/procrun.py", "cmd/apiserver.py")
+
+    # -- import-closure walk (no importing: spawn targets may have
+    # import-time side effects the linter must not trigger) -------------
+
+    def _module_file(self, ctx: LintContext, dotted: str) -> str | None:
+        """kubernetes_tpu.client.informer -> repo-relative file, or None
+        when the module isn't an in-package source file."""
+        if not dotted.startswith(ctx.package_name):
+            return None
+        rel = dotted.replace(".", "/")
+        for cand in (f"{rel}.py", f"{rel}/__init__.py"):
+            if (ctx.repo_root / cand).is_file():
+                return cand
+        return None
+
+    def _imports_of(self, ctx: LintContext, rel: str) -> set[str]:
+        view = ctx.view(rel)
+        if view is None or view.tree is None:
+            return set()
+        # the importing module's package, dotted (for relative imports)
+        pkg_parts = pathlib.PurePosixPath(rel).parts[:-1]
+        out: set[str] = set()
+        for node in ast.walk(view.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    f = self._module_file(ctx, alias.name)
+                    if f:
+                        out.add(f)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    dotted = ".".join(base)
+                    if node.module:
+                        dotted = f"{dotted}.{node.module}"
+                else:
+                    dotted = node.module or ""
+                f = self._module_file(ctx, dotted)
+                if f:
+                    out.add(f)
+                # `from pkg.sub import mod` — each alias may itself be a
+                # module, not a name inside one
+                for alias in node.names:
+                    f = self._module_file(ctx, f"{dotted}.{alias.name}")
+                    if f:
+                        out.add(f)
+        return out
+
+    def _closure(self, ctx: LintContext) -> list[str]:
+        seen: set[str] = set()
+        frontier = [f"{ctx.package_name}/{e}" for e in self.ENTRYPOINTS
+                    if (ctx.repo_root / ctx.package_name / e).is_file()]
+        while frontier:
+            rel = frontier.pop()
+            if rel in seen:
+                continue
+            seen.add(rel)
+            frontier.extend(self._imports_of(ctx, rel) - seen)
+        return sorted(seen)
+
+    # -- the check -------------------------------------------------------
+
+    def check_project(self, ctx: LintContext):
+        for rel in self._closure(ctx):
+            view = ctx.view(rel)
+            if view is None or view.tree is None:
+                continue
+            for node in view.tree.body:
+                if isinstance(node, ast.Assign):
+                    value, targets = node.value, node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    value, targets = node.value, [node.target]
+                else:
+                    continue
+                if not _is_mutable_singleton(value):
+                    continue
+                names = [t.id for t in targets if isinstance(t, ast.Name)]
+                if not names or all(n.startswith("__") for n in names):
+                    continue  # dunders (__all__ etc.) aren't registries
+                if view.line_has_annotation(node.lineno, "process-local") \
+                        or view.suppressed(self.name, node.lineno):
+                    continue
+                yield Finding(
+                    self.name, rel, node.lineno,
+                    f"module-level mutable singleton {'/'.join(names)!r} is "
+                    f"reachable from a child-process entrypoint; annotate "
+                    f"with `# process-local: <why>` (or refactor)")
