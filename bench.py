@@ -946,6 +946,23 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
         detail["escape_rate"] = stats["escape_rate"]
     if "preemption_attempts" in stats:
         detail["preemption_attempts"] = stats["preemption_attempts"]
+    maint = stats.get("tensor_maintenance")
+    if maint:
+        # incremental flatten: how the resident device tensors were kept
+        # current — patched-in-place vs full re-flatten wave counts, and
+        # the two maintenance stages' share of the run's wall time
+        patch_s = float(maint.get("patch_seconds", 0.0))
+        flat_s = float(maint.get("flatten_seconds", 0.0))
+        detail["tensor_maintenance"] = {
+            "waves_patched": maint.get("waves_patched", 0),
+            "waves_reflattened": maint.get("waves_reflattened", 0),
+            "event_patches": maint.get("event_patches", 0),
+            "compactions": maint.get("compactions", 0),
+            "gen_stale_waves": maint.get("gen_stale_waves", 0),
+            "snapshot_patch_s": round(patch_s, 3),
+            "snapshot_flatten_s": round(flat_s, 3),
+            "host_share": round((patch_s + flat_s) / wall, 4) if wall else 0.0,
+        }
     if "overload" in stats:
         detail["overload"] = stats["overload"]
     if "chaos_injected" in stats:

@@ -231,14 +231,31 @@ _STAGE_PATTERNS: tuple[tuple[str, str], ...] = (
 )
 _BINDER_FRAMES = frozenset({"_bulk_bind_commit", "_store_bind",
                             "bind_many", "_finish_batch"})
+# incremental flatten: the two halves of host-side tensor maintenance,
+# carved out by frame like binder work.  Patch frames are checked FIRST —
+# patch_node calls _encode_node, and an event patch should attribute to
+# snapshot.patch even when the sample lands inside the shared encoder.
+_PATCH_FRAMES = frozenset({"note_node_event", "patch_node", "patch_remove",
+                           "compact", "_maybe_compact", "run_locked_node"})
+_FLATTEN_FRAMES = frozenset({"update_from_snapshot_tracked",
+                             "_update_from_dirty", "_update_from_nodes_tracked",
+                             "_sync_rows", "_encode_node",
+                             "_encode_dynamic_bulk", "_encode_fresh_bulk"})
 
 
 def classify_stage(thread_name: str, co_names: Iterable[str]) -> str:
     """Map one sample (thread name + frame co_names, leaf first) onto a
     pipeline stage for scheduler_host_stage_seconds{stage}."""
-    for co in co_names:
+    names = tuple(co_names)
+    for co in names:
         if co in _BINDER_FRAMES:
             return "binder"
+    for co in names:
+        if co in _PATCH_FRAMES:
+            return "snapshot.patch"
+    for co in names:
+        if co in _FLATTEN_FRAMES:
+            return "snapshot.flatten"
     for prefix, stage in _STAGE_PATTERNS:
         if thread_name.startswith(prefix):
             return stage

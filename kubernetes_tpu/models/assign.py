@@ -945,7 +945,13 @@ def build_assign_fn(caps: Caps, weights: dict[str, float] | None = None,
 # over a gRPC shim" (BASELINE.json): the shim ships deltas, never the world.
 # ---------------------------------------------------------------------------
 
-STATE_KEYS = ("used", "used_nz", "npods", "port_mask", "cd_sg", "cd_asg")
+# device-resident wave state: the aggregate arrays the wave core consumes
+# and re-emits, plus a scalar generation counter ("gen") the core never
+# sees — the step fn increments it every wave and echoes it in the result
+# tail, so the host can fence a resolve against state that was rebuilt
+# (or a patch that was lost) while the wave was in flight.
+AGGREGATE_KEYS = ("used", "used_nz", "npods", "port_mask", "cd_sg", "cd_asg")
+STATE_KEYS = AGGREGATE_KEYS + ("gen",)
 SEL_V = 8       # max ids per any-of label group (more -> escape hatch)
 FORB_V = 8      # max forbidden label ids per pod
 KEY_V = 4       # max ids per Exists key group
@@ -1169,10 +1175,11 @@ def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
                            max_waves: int | None = None):
     """fn(state, static_node, buf) -> (new_state, result).
     `state` is device-resident and donated; `buf` is the single per-batch
-    upload produced by pack_pod_batch.  `result` is int32[p_cap+1]:
-    assignments for each pod slot followed by the wave count in the last
-    element — one array so the host pulls the whole answer in ONE device
-    transfer (a second scalar pull costs a full tunnel round trip).
+    upload produced by pack_pod_batch.  `result` is int32[p_cap+2]:
+    assignments for each pod slot, then the wave count, then the state
+    generation after this step — one array so the host pulls the whole
+    answer (and the generation fence) in ONE device transfer (a second
+    scalar pull costs a full tunnel round trip).
     `features` selects a specialized kernel variant (the backend keeps one
     per feature set and picks per batch based on what the batch actually
     uses).  `max_waves` overrides the wave ceiling: the backend caps the
@@ -1198,13 +1205,17 @@ def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
     # jit cache serves every wave against the packed transport
     @functools.partial(jax.jit, donate_argnums=0)
     def fn(state, static_node, buf):
+        gen = state["gen"] + 1
+        dyn = {k: state[k] for k in AGGREGATE_KEYS}
         pod, prow, pval = _unpack(buf, spec, features)
-        state = _apply_patches(state, prow, pval, caps)
-        out = core({**static_node, **state}, pod)
-        new_state = {k: out[k] for k in STATE_KEYS}
+        dyn = _apply_patches(dyn, prow, pval, caps)
+        out = core({**static_node, **dyn}, pod)
+        new_state = {k: out[k] for k in AGGREGATE_KEYS}
+        new_state["gen"] = gen
         result = jnp.concatenate([
             out["assignments"].astype(jnp.int32),
-            out["waves"].reshape(1).astype(jnp.int32)])
+            out["waves"].reshape(1).astype(jnp.int32),
+            gen.reshape(1).astype(jnp.int32)])
         return new_state, result
 
     return fn, spec

@@ -33,6 +33,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Sequence
 
 import numpy as np
@@ -267,14 +268,19 @@ class ResidentHostMirror:
             epoch = epoch_fn() if epoch_fn is not None else None
             if epoch is not None and epoch == self._last_epoch:
                 return  # nothing external changed: the scan is a no-op
+            t_sync = time.monotonic()
             try:
                 dirty = set(self.tensors.update_from_snapshot_tracked(
                     snapshot))
             except VocabFullError:
                 self._state = None  # force a refresh on next dispatch
                 return
+            finally:
+                self.stats["flatten_seconds"] = self.stats.get(
+                    "flatten_seconds", 0.0) + (time.monotonic() - t_sync)
             self._carry_dirty |= dirty
             self._last_epoch = epoch
+            self._maybe_compact()
 
     def _needs_full(self, batch: PodBatch) -> bool:
         """Batches using selectors/constraints/ports/pins need the
@@ -362,6 +368,98 @@ class ResidentHostMirror:
                     d = t.dom_asg[a, rows[inc]]
                     np.add.at(m["cd_asg"][a], d[d >= 0], 1.0)
 
+    # -- event-driven tensor maintenance (incremental flatten) -----------
+
+    # compact when tombstoned slots exceed n_cap / COMPACT_TOMBSTONE_DIV
+    # (and never while a wave is in flight — it references rows by index)
+    COMPACT_TOMBSTONE_DIV = 16
+
+    def note_node_event(self, event_type: str, name: str, view) -> None:
+        """Node informer feed: apply one add/update/delete event as a
+        targeted row patch on the resident host tensors, so the wave-time
+        drain finds the row already generation-current and the device
+        upload shrinks to the genuinely-changed rows.  `view` is the
+        cache's CacheFlattenView; the NodeInfo is read under the cache
+        lock (backend lock -> cache lock, the order dispatch takes).  Any
+        patch-path error leaves the event pending for the wave-time drain
+        — the full re-flatten is the recovery path, never lost state."""
+        run_node = getattr(view, "run_locked_node", None)
+        if run_node is None:
+            return
+        t0 = time.monotonic()
+        with self._lock:
+            t = self.tensors
+            try:
+                row = run_node(name, lambda ni: (
+                    t.patch_remove(name) if ni is None
+                    else t.patch_node(name, ni)))
+            except VocabFullError:
+                self._state = None  # force a refresh on next dispatch
+                return
+            except Exception:
+                logger.exception(
+                    "node event patch failed; deferring to wave drain")
+                return
+            finally:
+                self.stats["patch_seconds"] = self.stats.get(
+                    "patch_seconds", 0.0) + (time.monotonic() - t0)
+            if row is not None:
+                self._carry_dirty.add(row)
+                self.stats["event_patches"] = self.stats.get(
+                    "event_patches", 0) + 1
+            self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        """Reclaim tombstoned row slots between waves (caller holds the
+        backend lock).  Skipped while any wave is in flight: an in-flight
+        batch resolves against rows captured by index at dispatch."""
+        t = self.tensors
+        if self._unresolved:
+            return
+        if (t.tombstone_count() * self.COMPACT_TOMBSTONE_DIV
+                >= self.caps.n_cap):
+            if t.compact():
+                self.stats["compactions"] = self.stats.get(
+                    "compactions", 0) + 1
+
+    def maintenance_snapshot(self) -> dict:
+        """Tensor-maintenance readout for the observatory: occupancy /
+        tombstone gauges plus the patched-vs-reflattened wave counters
+        (scheduler.expose_metrics incs the counter metrics by deltas)."""
+        with self._lock:
+            t = self.tensors
+            s = self.stats
+            return {
+                "row_occupancy": t.row_occupancy(),
+                "tombstone_rows": t.tombstone_count(),
+                "waves_patched": s.get("waves_patched", 0),
+                "waves_reflattened": s.get("waves_reflattened", 0),
+                "event_patches": s.get("event_patches", 0),
+                "compactions": s.get("compactions", 0),
+                "gen_stale_waves": s.get("gen_stale_waves", 0),
+                "patch_seconds": s.get("patch_seconds", 0.0),
+                "flatten_seconds": s.get("flatten_seconds", 0.0),
+            }
+
+    def _restore_state_from_mirror(self) -> None:
+        """Generation-fence recovery: rebuild the device wave state from
+        the host replay mirror — which already includes every replay
+        committed so far, so re-running a fenced wave's retained chunk
+        buffers against this state reproduces exactly what a healthy
+        wave would have produced.  Bumping the host generation FIRST
+        also fences any pipelined successor dispatched off the stale
+        lineage: it self-heals at its own resolve."""
+        import jax.numpy as jnp
+        m = self._mirror
+        self._gen += 1
+        state = {k: jnp.asarray(m[k]) for k in
+                 ("used", "used_nz", "npods", "port_mask",
+                  "cd_sg", "cd_asg")}
+        state["gen"] = jnp.asarray(self._gen, jnp.int32)
+        self._state = state
+        self.stats["gen_recoveries"] = self.stats.get(
+            "gen_recoveries", 0) + 1
+
 
 class TPUBatchBackend(ResidentHostMirror, BatchBackend):
     census_kind = "tpu"
@@ -411,6 +509,11 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         # budget above), not at import.
         self.FULL_MAIN_WAVES = int(
             os.environ.get("KTPU_FULL_MAIN_WAVES", "0"))
+        # A/B baseline knob: disable the epoch fast path so every wave
+        # pays the snapshot re-encode (flatten honors the same env by
+        # forcing the O(nodes) full scan) — the pre-incremental world,
+        # used by bench to pin the maintenance win in-band
+        self.FORCE_REFLATTEN = bool(os.environ.get("KTPU_FORCE_REFLATTEN"))
         self._fn_full = None   # built lazily / in warmup
         self._spec_full = None
         self._fn_full_small = None   # straggler retry kernel (lazy)
@@ -439,8 +542,15 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         # reports the same epoch, every change since was our own replayed
         # binds and the whole re-encode + mirror diff is skipped
         self._last_epoch: int | None = None
+        # host-side expectation of the device state-generation counter:
+        # _device_step bumps it 1:1 with the kernel's own gen+1, so a
+        # resolve whose result tail disagrees proves the wave chained on
+        # state the host never committed (lost patch / restored worker)
+        self._gen = 0
         self.stats = {"batches": 0, "full_refresh": 0, "patched_rows": 0,
-                      "waves": 0, "flush_first": 0}
+                      "waves": 0, "flush_first": 0, "waves_patched": 0,
+                      "waves_reflattened": 0, "event_patches": 0,
+                      "patch_seconds": 0.0, "flatten_seconds": 0.0}
         # batch-telemetry drains (scheduler._finish_batch): per-(plugin,
         # reason) escape tallies applied as Counter DELTAS (inc-only), and
         # per-batch telemetry dicts (mask densities, feasible nodes,
@@ -519,7 +629,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             cd_sg, cd_asg = t.domain_base_counts()
             state = {"used": t.used, "used_nz": t.used_nz,
                      "npods": t.npods, "port_mask": t.port_mask,
-                     "cd_sg": cd_sg, "cd_asg": cd_asg}
+                     "cd_sg": cd_sg, "cd_asg": cd_asg,
+                     "gen": np.int32(0)}
             static_core = {k: getattr(t, k) for k in STATIC_CORE}
             batch = self.encoder.encode([])
             empty = (np.empty(0, np.int32),
@@ -581,6 +692,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             fn = self._fn_plain
             static = self._static_node
         self._state, rd = fn(self._state, static, jnp.asarray(buf))
+        self._gen += 1  # the kernel computes the identical state.gen + 1
         # start the result's D2H transfer NOW: on a tunneled chip a
         # blocking pull costs ~90ms of fixed round-trip latency per call
         # (measured: the assignments vector is ~1KB — it is all latency),
@@ -738,6 +850,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             "npods": jnp.asarray(t.npods),
             "port_mask": jnp.asarray(t.port_mask),
             "cd_sg": jnp.asarray(cd_sg), "cd_asg": jnp.asarray(cd_asg),
+            "gen": jnp.asarray(self._gen, jnp.int32),
         }
         self._mirror_from_tensors(cd_sg, cd_asg)
         self.stats["full_refresh"] += 1
@@ -903,7 +1016,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             epoch = epoch_fn() if epoch_fn is not None else None
             skip_sync = (epoch is not None and self._state is not None
                          and epoch == self._last_epoch
-                         and not self._carry_dirty)
+                         and not self._carry_dirty
+                         and not self.FORCE_REFLATTEN)
             f_sp = (parent.tracer.start_span("snapshot.flatten",
                                              parent=parent)
                     if parent is not None else None)
@@ -911,10 +1025,13 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 if skip_sync:
                     dirty = set()
                 else:
+                    t_sync = time.monotonic()
                     dirty = set(self.tensors.update_from_snapshot_tracked(
                         snapshot))
                     dirty |= self._carry_dirty
                     self._last_epoch = epoch
+                    self.stats["flatten_seconds"] += (
+                        time.monotonic() - t_sync)
                 batch = self.encoder.encode(list(pod_infos))
             except VocabFullError as e:
                 logger.warning("tensorization overflow (%s); batch -> oracle path", e)
@@ -1025,6 +1142,11 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                            np.empty((0, self._spec.f_patch), np.float32))
             elif needs_patch:
                 self._sync_mirror_rows(patches[0])
+            # patched-vs-reflattened wave accounting: a wave that kept the
+            # resident state (row patches or nothing) vs one that had to
+            # rebuild it (the recovery path, not steady state)
+            self.stats["waves_reflattened" if needs_refresh
+                       else "waves_patched"] += 1
             self._carry_dirty = set()
             self.stats["patched_rows"] += len(patches[0])
             self.stats["epoch_skips"] = self.stats.get("epoch_skips", 0) + (
@@ -1046,6 +1168,9 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 # intra-batch accounting stays exact.  Patches ride the
                 # first chunk only.
                 self._ensure_full()
+                # chunk tuples retain the packed buffer + variant + the
+                # expected device generation, so a fenced resolve can
+                # re-run the identical chunks from restored state
                 chunks = []
                 p = patches
                 for lo in range(0, n, self.full_cap):
@@ -1056,7 +1181,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     p = (np.empty(0, np.int32),
                          np.empty((0, self._f_patch), np.float32))
                     chunks.append((self._device_step("full", cbuf),
-                                   lo, hi))
+                                   lo, hi, "full", cbuf, self._gen))
             elif self._needs_full(batch):
                 self._ensure_full()
                 if self.full_cap == self.batch_size:
@@ -1065,7 +1190,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     cb, hi = slice_pod_batch(batch, 0, n, self.full_cap), n
                 cbuf = pack_pod_batch(cb, self._spec_full, patches[0],
                                       patches[1])
-                chunks = [(self._device_step("full", cbuf), 0, hi)]
+                chunks = [(self._device_step("full", cbuf), 0, hi,
+                           "full", cbuf, self._gen)]
             else:
                 self.stats["plain"] = self.stats.get("plain", 0) + 1
                 self._ensure_plain()
@@ -1073,7 +1199,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 buf = pack_pod_batch(batch, self._spec_plain, patches[0],
                                      patches[1])
                 chunks = [(self._device_step("plain", buf), 0,
-                           self.batch_size)]
+                           self.batch_size, "plain", buf, self._gen)]
             if h2d_sp is not None:
                 h2d_sp.set_attribute("chunks", len(chunks))
                 h2d_sp.set_attribute(
@@ -1099,11 +1225,37 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 d2h_sp = (solve_sp.tracer.start_span("tpu.d2h",
                                                      parent=solve_sp)
                           if solve_sp is not None else None)
-                for rd, lo, hi in chunks:
+                raw = []
+                stale = False
+                for rd, _lo, _hi, _variant, _cbuf, expect in chunks:
                     # sync-point: wave resolve — THE pipeline's d2h pull
                     result = jax.device_get(rd)
-                    assignments[lo:hi] = result[:-1][:hi - lo]
-                    batch_waves += int(result[-1])
+                    stale = stale or int(result[-1]) != expect
+                    raw.append(result)
+                if stale:
+                    # generation fence tripped: the device state this
+                    # wave chained on is not the lineage the host
+                    # committed (lost patch / restored worker / chaos).
+                    # Recovery: rebuild the state from the replay mirror
+                    # and re-run the retained chunk buffers in order —
+                    # identical inputs against the authoritative state,
+                    # so the accepted assignments are exactly what a
+                    # healthy wave would have produced.
+                    logger.warning(
+                        "generation-stale wave (device gen mismatch); "
+                        "re-running %d chunk(s) from restored state",
+                        len(chunks))
+                    self.stats["gen_stale_waves"] = self.stats.get(
+                        "gen_stale_waves", 0) + 1
+                    self._restore_state_from_mirror()
+                    raw = []
+                    for _rd, _lo, _hi, variant, cbuf, _expect in chunks:
+                        # sync-point: recovery re-run resolves in line
+                        raw.append(jax.device_get(
+                            self._device_step(variant, cbuf)))
+                for result, (_rd, lo, hi, *_rest) in zip(raw, chunks):
+                    assignments[lo:hi] = result[:-2][:hi - lo]
+                    batch_waves += int(result[-2])
                 if d2h_sp is not None:
                     d2h_sp.set_attribute("chunks", len(chunks))
                     d2h_sp.end()
@@ -1170,8 +1322,18 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 buf = pack_pod_batch(rb, self._spec_full_small, *empty)
                 # sync-point: straggler retry resolves synchronously
                 res = jax.device_get(self._device_step("full_small", buf))
-                self.stats["waves"] += int(res[-1])
-                sub = res[:-1]
+                if int(res[-1]) != self._gen:
+                    # generation fence: restore from the mirror (which
+                    # already includes this batch's replays) and re-post
+                    # the identical retry buffer
+                    self.stats["gen_stale_waves"] = self.stats.get(
+                        "gen_stale_waves", 0) + 1
+                    self._restore_state_from_mirror()
+                    # sync-point: recovery re-run resolves in line
+                    res = jax.device_get(
+                        self._device_step("full_small", buf))
+                self.stats["waves"] += int(res[-2])
+                sub = res[:-2]
                 self._replay(rb, sub)
                 for j, orig in enumerate(idx):
                     if sub[j] >= 0:

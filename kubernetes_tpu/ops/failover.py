@@ -274,6 +274,15 @@ class FailoverBatchBackend(BatchBackend):
             if fn is not None:
                 fn(event_type, obj, old)
 
+    def note_node_event(self, event_type: str, name: str, view) -> None:
+        """Fan node events to EVERY rung (incremental flatten): each rung
+        keeps its own resident ClusterTensors, and a cold standby's rows
+        must be generation-current the moment failover promotes it."""
+        for rung in self._rungs:
+            fn = getattr(rung.backend, "note_node_event", None)
+            if fn is not None:
+                fn(event_type, name, view)
+
     def preempt_candidates(self, pod_infos, k: int = 16):
         for rung in self._rungs:
             if not rung.breaker.is_open:
@@ -313,6 +322,16 @@ class FailoverBatchBackend(BatchBackend):
             if fn is not None and not rung.breaker.is_open:
                 return fn()
         return 0.0
+
+    def maintenance_snapshot(self) -> dict:
+        """The ACTIVE rung's tensor-maintenance readout (occupancy and
+        tombstones are per-tensor-copy state, not summable; the wave
+        counters follow the rung that actually dispatched)."""
+        for rung in self._rungs:
+            fn = getattr(rung.backend, "maintenance_snapshot", None)
+            if fn is not None and not rung.breaker.is_open:
+                return fn()
+        return {}
 
     @property
     def stats(self) -> dict:

@@ -257,6 +257,15 @@ class ChaosBatchBackend:
         if fn is not None:
             fn(view)
 
+    def note_node_event(self, event_type: str, name: str, view) -> None:
+        fn = getattr(self.inner, "note_node_event", None)
+        if fn is not None:
+            fn(event_type, name, view)
+
+    def maintenance_snapshot(self) -> dict:
+        fn = getattr(self.inner, "maintenance_snapshot", None)
+        return fn() if fn is not None else {}
+
     def abandon_wave(self) -> None:
         fn = getattr(self.inner, "abandon_wave", None)
         if fn is not None:

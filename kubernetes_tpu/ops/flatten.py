@@ -33,6 +33,7 @@ ops/backend.py from these buffers.
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -276,6 +277,21 @@ class ClusterTensors:
         self.gen = np.zeros(c.n_cap, np.int64)
         self.node_gen = np.full(c.n_cap, -1, np.int64)  # last static encode
         self._free = list(range(c.n_cap - 1, -1, -1))
+        # released rows park here instead of going straight back to _free:
+        # a row freed mid-wave must not be re-assigned to a new node while
+        # an in-flight wave still references it by index.  compact()
+        # (called by the backend between waves, or forcibly by _sync_rows
+        # when _free empties) scrubs the group columns and recycles them.
+        self._tombstones: set[int] = set()
+        # patch_gen counts patch/compaction API applications; every
+        # mutation through patch_node/patch_remove/compact bumps it (the
+        # tensor-patch-discipline lint keys off this counter)
+        self.patch_gen = 0
+        # per-row dynamic-aggregate digest for the bulk re-encode skip:
+        # bind-shaped churn (assume→confirm cycles) advances NodeInfo
+        # generations without changing the encoded aggregates; a matching
+        # digest means the row's dynamic columns are already current
+        self._dyn_digest: list = [None] * c.n_cap
         # rows that have EVER held data: a pristine row's arrays are still
         # their init zeros, so the fresh-flood encode can skip the ~360
         # floats/row of zero-fills (at 100k nodes those writes alone cost
@@ -718,9 +734,13 @@ class ClusterTensors:
         from a NodeInfo mid-mutation, and skip the per-dirty-node clone
         the Snapshot path pays.  Views that can feed the changed-node
         delta (run_locked_dirty) skip the O(nodes) membership scan too."""
-        run_dirty = getattr(snapshot, "run_locked_dirty", None)
-        if run_dirty is not None:
-            return run_dirty(self._update_from_dirty)
+        if not os.environ.get("KTPU_FORCE_REFLATTEN"):
+            # A/B baseline knob: when set, skip the changed-node delta so
+            # every sync pays the O(nodes) full scan (the pre-incremental
+            # world bench measures the maintenance win against)
+            run_dirty = getattr(snapshot, "run_locked_dirty", None)
+            if run_dirty is not None:
+                return run_dirty(self._update_from_dirty)
         run_locked = getattr(snapshot, "run_locked", None)
         if run_locked is not None:
             return run_locked(self._update_from_nodes_tracked)
@@ -737,9 +757,12 @@ class ClusterTensors:
         fresh_bulk: list = []  # brand-new podless rows (creation floods)
         bulk_ok = not self.sgs and not self.asgs
         row_of, gen, valid = self.row_of, self.gen, self.valid
+        digests = self._dyn_digest
         for name, ni in named_infos:
             row = row_of.get(name)
             if row is None:
+                if not self._free and self._tombstones:
+                    self.compact()
                 if not self._free:
                     raise VocabFullError(
                         f"node capacity {self.caps.n_cap} exceeded")
@@ -751,6 +774,19 @@ class ClusterTensors:
                         and self.node_gen[row] == ni.node_generation
                         and not ni.used_ports
                         and not ni.requested.scalar):
+                    req, nz = ni.requested, ni.non_zero_requested
+                    dg = (req.milli_cpu, req.memory, req.ephemeral_storage,
+                          nz.milli_cpu, nz.memory, nz.ephemeral_storage,
+                          len(ni.pods))
+                    if digests[row] == dg:
+                        # identical aggregates (snapshot paths clone
+                        # NodeInfos per update): record the generation and
+                        # NodeInfo identity, skip the rewrite + upload
+                        self.node_infos[row] = ni
+                        gen[row] = ni.generation
+                        self.vict_dirty_rows.add(row)
+                        continue
+                    digests[row] = dg
                     bulk.append((row, ni))
                 elif (bulk_ok and not valid[row] and ni.node is not None
                         and not ni.pods and not ni.used_ports
@@ -832,10 +868,81 @@ class ClusterTensors:
         self.valid[row] = False
         self.node_infos[row] = None
         self.node_gen[row] = -1
-        self._free.append(row)
+        self._dyn_digest[row] = None
+        self._tombstones.add(row)
         self.static_version += 1
         self.static_dirty_rows.add(row)
         self.vict_dirty_rows.add(row)
+        return row
+
+    def compact(self) -> int:
+        """Reclaim tombstoned row slots: scrub the selector-group columns
+        a dead row may still carry (valid=False masks it on device, but a
+        recycled slot must start clean) and return the slots to the free
+        list.  Called by the backend between waves (never while a wave is
+        in flight — an in-flight wave references rows by index) and
+        forcibly by _sync_rows when the free list empties.  Selector-group
+        SLOTS are buckets and stay permanent; only node rows recycle."""
+        if not self._tombstones:
+            return 0
+        rows = sorted(self._tombstones, reverse=True)
+        arr = np.asarray(rows, np.int64)
+        self.cnt_sg[:, arr] = 0.0
+        self.dom_sg[:, arr] = -1
+        self.cnt_asg[:, arr] = 0.0
+        self.dom_asg[:, arr] = -1
+        self._tombstones.clear()
+        self._free.extend(rows)
+        self.static_dirty_rows.update(rows)
+        self.version += 1
+        self.static_version += 1
+        self.patch_gen += 1
+        return len(rows)
+
+    def tombstone_count(self) -> int:
+        return len(self._tombstones)
+
+    def row_occupancy(self) -> float:
+        """Fraction of node-row capacity holding a live node."""
+        return len(self.row_of) / self.caps.n_cap
+
+    # -- event-driven patch API (incremental flatten) --------------------
+    # Informer deltas land here between waves so the resident tensors stay
+    # current without a per-wave snapshot re-encode; the wave-time drain
+    # (update_from_snapshot_tracked) remains the authoritative backstop —
+    # a row patched here is generation-current and skips re-encode there.
+
+    def patch_node(self, name: str, ni: NodeInfo) -> int | None:
+        """Apply one node add/update event as a targeted row patch.
+        Returns the touched row (for the backend's dirty-row upload), or
+        None when the row is already generation-current.  Raises
+        VocabFullError only if compaction cannot free a slot."""
+        row = self.row_of.get(name)
+        if row is None:
+            if not self._free and self._tombstones:
+                self.compact()
+            if not self._free:
+                raise VocabFullError(
+                    f"node capacity {self.caps.n_cap} exceeded")
+            row = self._free.pop()
+            self.row_of[name] = row
+            self.gen[row] = -1
+        elif self.gen[row] == ni.generation:
+            return None
+        self._encode_node(row, ni)
+        self.gen[row] = ni.generation
+        self.vict_dirty_rows.add(row)
+        self.version += 1
+        self.patch_gen += 1
+        return row
+
+    def patch_remove(self, name: str) -> int | None:
+        """Apply one node delete event: tombstone the row (reclaimed by a
+        later compact()).  Returns the released row or None."""
+        row = self._release_row(name)
+        if row is not None:
+            self.version += 1
+            self.patch_gen += 1
         return row
 
     def _update_from_dirty(self, pairs, removed_names) -> list[int]:
@@ -901,6 +1008,7 @@ class ClusterTensors:
         node = ni.node
         self.node_infos[row] = ni
         self._ever_used[row] = True
+        self._dyn_digest[row] = None  # full encode: bulk digest is stale
 
         # ---- dynamic fields (change on every bind; cheap to upload) ----
         self._encode_resource(self.used[row], ni.requested)

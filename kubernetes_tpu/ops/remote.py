@@ -1043,9 +1043,12 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
                 and not self._unresolved and self._mirror is not None):
             # quiescent: every dispatched step has been resolved AND
             # replayed into the mirror, so the mirror == device state;
-            # snapshot it as a ready-to-post /refresh body
+            # snapshot it as a ready-to-post /refresh body (gen rides
+            # along so a resync replay lands on the same generation
+            # lineage: G_ckpt + len(journal) == the client's counter)
             self._ckpt_refresh_body = _dump_arrays(
-                {k: self._mirror[k] for k in _REFRESH_KEYS})
+                {**{k: self._mirror[k] for k in _REFRESH_KEYS},
+                 "gen": np.asarray(self._gen, np.int32)})
             del self._journal[:]
             self._journal_overflow = False
 
@@ -1088,6 +1091,7 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
     def _device_step(self, variant: str, buf: np.ndarray) -> np.ndarray:
         out = self._post(f"/step?variant={variant}",
                          np.ascontiguousarray(buf, np.float32).tobytes())
+        self._gen += 1  # the worker's kernel computed state.gen + 1
         return np.frombuffer(out, np.int32)
 
     def _upload_static(self) -> None:
@@ -1143,7 +1147,8 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
         t = self.tensors
         body = _dump_arrays({
             "used": t.used, "used_nz": t.used_nz, "npods": t.npods,
-            "port_mask": t.port_mask, "cd_sg": cd_sg, "cd_asg": cd_asg})
+            "port_mask": t.port_mask, "cd_sg": cd_sg, "cd_asg": cd_asg,
+            "gen": np.asarray(self._gen, np.int32)})
         self._post("/refresh", body)
         # a refresh replaces the device state outright: it IS a checkpoint,
         # and every journaled step before it is obsolete
@@ -1153,6 +1158,22 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
         self._state = True  # sentinel: worker holds the arrays
         self._mirror_from_tensors(cd_sg, cd_asg)
         self.stats["full_refresh"] += 1
+
+    def _restore_state_from_mirror(self) -> None:
+        """Gen-stale recovery over the wire: post the host mirror as a
+        fresh /refresh body on a bumped generation lineage.  The body
+        doubles as a checkpoint (the mirror IS the intended device state),
+        so the journaled steps behind it are obsolete."""
+        self._gen += 1
+        body = _dump_arrays({
+            **{k: self._mirror[k] for k in _REFRESH_KEYS},
+            "gen": np.asarray(self._gen, np.int32)})
+        self._post("/refresh", body)
+        self._ckpt_refresh_body = body
+        del self._journal[:]
+        self._journal_overflow = False
+        self._state = True  # sentinel: worker holds the arrays
+        self.stats["gen_recoveries"] = self.stats.get("gen_recoveries", 0) + 1
 
     def warmup(self) -> None:
         with self._lock:

@@ -22,7 +22,9 @@ protocol as ops/backend.py — steady state moves ZERO node-side bytes.
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 from typing import Sequence
 
 import numpy as np
@@ -86,8 +88,16 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         self._unresolved: list[object] = []
         self._carry_dirty: set[int] = set()
         self._last_epoch: int | None = None  # see ops/backend.py epoch skip
+        # host expectation of the device state-generation counter (the
+        # resolve fence — see ops/backend.py)
+        self._gen = 0
+        # A/B baseline knob — see ops/backend.py
+        self.FORCE_REFLATTEN = bool(os.environ.get("KTPU_FORCE_REFLATTEN"))
         self.stats = {"batches": 0, "waves": 0, "full_refresh": 0,
-                      "patched_rows": 0, "flush_first": 0}
+                      "patched_rows": 0, "flush_first": 0,
+                      "waves_patched": 0, "waves_reflattened": 0,
+                      "event_patches": 0, "patch_seconds": 0.0,
+                      "flatten_seconds": 0.0}
 
     def _make_shardings(self):
         from jax.sharding import NamedSharding
@@ -125,10 +135,12 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
             # inside the first constraint-carrying scheduling cycle
             pod_arrays = self._pod_arrays(batch)
             prows, pvals = self._empty_patches()
-            self._state, a, _w = self._fn(
+            self._state, a, _w, _g = self._fn(
                 self._state, self._static_node, pod_arrays, prows, pvals)
-            self._state, a, _w = self._ensure_plain()(
+            self._gen += 1
+            self._state, a, _w, _g = self._ensure_plain()(
                 self._state, self._static_node, pod_arrays, prows, pvals)
+            self._gen += 1
             import jax
             # sync-point: warmup barrier — block until the round trips land
             jax.device_get(a)
@@ -165,12 +177,27 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         import jax
         t = self.tensors
         raw = {"used": t.used, "used_nz": t.used_nz, "npods": t.npods,
-               "port_mask": t.port_mask, "cd_sg": cd_sg, "cd_asg": cd_asg}
+               "port_mask": t.port_mask, "cd_sg": cd_sg, "cd_asg": cd_asg,
+               "gen": np.int32(self._gen)}
         shard = self._shardings[0]
         self._state = {k: jax.device_put(v, shard[k])
                        for k, v in raw.items()}
         self._mirror_from_tensors(cd_sg, cd_asg)
         self.stats["full_refresh"] += 1
+
+    def _restore_state_from_mirror(self) -> None:
+        """Gen-stale recovery (see ops/backend.py): re-seed the sharded
+        device state from the host mirror on a fresh generation lineage."""
+        import jax
+        self._gen += 1
+        m = self._mirror
+        shard = self._shardings[0]
+        state = {k: jax.device_put(m[k], shard[k])
+                 for k in ("used", "used_nz", "npods", "port_mask",
+                           "cd_sg", "cd_asg")}
+        state["gen"] = jax.device_put(np.int32(self._gen), shard["gen"])
+        self._state = state
+        self.stats["gen_recoveries"] = self.stats.get("gen_recoveries", 0) + 1
 
     def _ensure_plain(self):
         if self._fn_plain is None:
@@ -205,13 +232,14 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         backend's _needs_full."""
         pod_arrays = self._pod_arrays(batch)
         fn = self._fn if self._needs_full(batch) else self._ensure_plain()
-        self._state, assignments, waves = fn(
+        self._state, assignments, waves, gen_dev = fn(
             self._state, self._static_node, pod_arrays, prows, pvals)
-        for h in (assignments, waves):
+        self._gen += 1  # the kernel computes the identical state.gen + 1
+        for h in (assignments, waves, gen_dev):
             copy_async = getattr(h, "copy_to_host_async", None)
             if copy_async is not None:  # see ops/backend.py _device_step
                 copy_async()
-        return assignments, waves
+        return assignments, waves, gen_dev
 
     # -- BatchBackend ----------------------------------------------------
 
@@ -224,13 +252,20 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
             epoch = epoch_fn() if epoch_fn is not None else None
             skip_sync = (epoch is not None and self._state is not None
                          and epoch == self._last_epoch
-                         and not self._carry_dirty)
+                         and not self._carry_dirty
+                         and not self.FORCE_REFLATTEN)
             try:
                 if skip_sync:
                     dirty = set()
                 else:
-                    dirty = set(self.tensors.update_from_snapshot_tracked(
-                        snapshot))
+                    t_sync = time.monotonic()
+                    try:
+                        dirty = set(
+                            self.tensors.update_from_snapshot_tracked(
+                                snapshot))
+                    finally:
+                        self.stats["flatten_seconds"] += (
+                            time.monotonic() - t_sync)
                     dirty |= self._carry_dirty
                     self._last_epoch = epoch
                 batch = self.encoder.encode(list(pod_infos))
@@ -278,10 +313,15 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                 self.stats["patched_rows"] += k
             else:
                 prows, pvals = self._empty_patches()
+            # tentpole accounting: did this wave ride the patch path or
+            # pay a full re-flatten/refresh of the device tensors?
+            self.stats["waves_reflattened" if needs_refresh
+                       else "waves_patched"] += 1
             self._carry_dirty = set()
 
-            assignments_dev, waves_dev = self._dispatch_locked(
+            assignments_dev, waves_dev, gen_dev = self._dispatch_locked(
                 batch, prows, pvals)
+            expect_gen = self._gen
             self.stats["batches"] += 1
             holder = object()
             self._unresolved.append(holder)
@@ -293,8 +333,24 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
             import jax
             with self._lock:
                 # sync-point: sharded wave resolve — the pipeline's d2h pull
-                assignments, waves = jax.device_get(
-                    (assignments_dev, waves_dev))
+                assignments, waves, gen = jax.device_get(
+                    (assignments_dev, waves_dev, gen_dev))
+                if int(gen) != expect_gen:
+                    # generation fence tripped: the resident lineage this
+                    # wave chained off is not the one the host mirrored.
+                    # Re-seed device state from the mirror and replay the
+                    # batch synchronously on the fresh lineage.
+                    logger.warning(
+                        "sharded state generation mismatch (device %d, "
+                        "expected %d); re-seeding from host mirror",
+                        int(gen), expect_gen)
+                    self.stats["gen_stale_waves"] = (
+                        self.stats.get("gen_stale_waves", 0) + 1)
+                    self._restore_state_from_mirror()
+                    a_dev, w_dev, _g = self._dispatch_locked(
+                        batch, prows, pvals)
+                    # sync-point: gen-stale recovery replay
+                    assignments, waves = jax.device_get((a_dev, w_dev))
                 self.stats["waves"] += int(waves)
                 self._replay(batch, assignments)
                 try:

@@ -46,7 +46,8 @@ def abstract_step_inputs(caps: Caps, batch: int, k_cap: int = 1024):
     state = {"used": zeros((c.n_cap, R)), "used_nz": zeros((c.n_cap, R)),
              "npods": zeros((c.n_cap,)), "port_mask": zeros((c.n_cap, PT)),
              "cd_sg": zeros((c.sg_cap, c.n_cap)),
-             "cd_asg": zeros((c.asg_cap, c.n_cap))}
+             "cd_asg": zeros((c.asg_cap, c.n_cap)),
+             "gen": zeros((), jnp.int32)}
     static = {"alloc": zeros((c.n_cap, R)), "maxpods": zeros((c.n_cap,)),
               "valid": zeros((c.n_cap,), jnp.bool_),
               "taint_mask": zeros((c.n_cap, c.t_cap)),

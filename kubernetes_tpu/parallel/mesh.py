@@ -63,6 +63,9 @@ NODE_PARTITION_RULES = (
     # [cap, ns_vocab] namespace masks have no node axis and fold into
     # pod bits once per batch (_fold_ns_masks)
     (r"^(sg|asg)_ns_mask$", ()),  # replicated-ok: no node axis
+    # scalar state-generation counter (the resolve fence; every shard
+    # computes the identical gen+1 so it stays coherent without psum)
+    (r"^gen$", ()),  # replicated-ok: scalar counter
 )
 
 
@@ -109,14 +112,16 @@ def pod_specs() -> dict:
     return {k: P() for k in keys}
 
 
-STATE_KEYS = ("used", "used_nz", "npods", "port_mask", "cd_sg", "cd_asg")
+AGGREGATE_KEYS = ("used", "used_nz", "npods", "port_mask", "cd_sg", "cd_asg")
+STATE_KEYS = AGGREGATE_KEYS + ("gen",)
 STATIC_KEYS = ("alloc", "maxpods", "valid", "taint_mask", "label_mask",
                "key_mask", "dom_sg", "dom_asg", "sg_ns_mask", "asg_ns_mask")
 
 
 def state_specs(axis: str = NODE_AXIS) -> dict:
-    ns = node_specs(axis)
-    return {k: ns[k] for k in STATE_KEYS}
+    # resolved straight from the rule table (gen has no NODE_KEYS entry:
+    # it is wave state only, never an input to the snapshot assign fn)
+    return match_partition_rules(NODE_PARTITION_RULES, STATE_KEYS, axis)
 
 
 def static_specs(axis: str = NODE_AXIS) -> dict:
@@ -196,6 +201,7 @@ def build_sharded_step_fn(caps: Caps, mesh: Mesh,
         features=ALL_FEATURES if features is None else features)
 
     def stepped(state, static, pods, prows, pvals):
+        gen = state["gen"] + 1
         local = prows - jax.lax.axis_index(axis) * shard_n
         in_shard = (prows >= 0) & (local >= 0) & (local < shard_n)
         # out-of-shard/padding entries scatter to an out-of-bounds
@@ -215,15 +221,16 @@ def build_sharded_step_fn(caps: Caps, mesh: Mesh,
         node["cd_sg"] = state["cd_sg"]
         node["cd_asg"] = state["cd_asg"]
         out = core(node, pods)
-        new_state = {k: out[k] for k in STATE_KEYS}
-        return new_state, out["assignments"], out["waves"]
+        new_state = {k: out[k] for k in AGGREGATE_KEYS}
+        new_state["gen"] = gen
+        return new_state, out["assignments"], out["waves"], gen
 
     ss, st = state_specs(axis), static_specs(axis)
     # compile-cached: built once per mesh at backend setup; the caller
     # holds the returned callable (and its jit cache) for every wave
     return compile_sharded(stepped, mesh,
                            in_specs=(ss, st, pod_specs(), P(), P()),
-                           out_specs=(ss, P(), P()),
+                           out_specs=(ss, P(), P(), P()),
                            donate_argnums=(0,))
 
 

@@ -822,6 +822,13 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                 injected = getattr(p.batch_backend, "injected", None)
                 if injected is not None:  # ChaosBatchBackend wrapper
                     stats["chaos_injected"] = dict(injected)
+                maint_fn = getattr(p.batch_backend,
+                                   "maintenance_snapshot", None)
+                if maint_fn is not None:
+                    # incremental-flatten readout: patched-vs-reflattened
+                    # wave counts + the snapshot.patch / snapshot.flatten
+                    # host seconds every BENCH row reports
+                    stats["tensor_maintenance"] = maint_fn()
                 break
         if profiling_policy is not None and (profiling_policy.enabled
                                              or profiling_policy.census):

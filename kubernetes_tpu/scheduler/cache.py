@@ -453,3 +453,24 @@ class CacheFlattenView:
                 c._dirty_nodes |= dirty
                 c._removed_nodes |= removed
                 raise
+
+    def run_locked_node(self, name: str, fn):
+        """Event-patch feed: fn(NodeInfo | None) for ONE node under the
+        cache lock — NodeInfo when the node is live, None when it has left
+        the schedulable set.  On success the node's pending dirty/removed
+        delta entry is discarded (the patch consumed it); a later mutation
+        re-adds it, and the wave-time run_locked_dirty drain remains the
+        authoritative backstop.  Before the first full drain the delta is
+        left untouched: a consumer that has never seen the whole cluster
+        must still take the full scan."""
+        c = self._cache
+        with c._lock:
+            ni = c._nodes.get(name)
+            if ni is not None and ni.node is None:
+                ni = None
+            out = fn(ni)  # raises -> delta stays pending for the drain
+            if c._flatten_synced:
+                c._dirty_nodes.discard(name)
+                if ni is None:
+                    c._removed_nodes.discard(name)
+            return out

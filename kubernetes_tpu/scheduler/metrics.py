@@ -231,6 +231,26 @@ class Metrics:
             "budget consumed exactly at the sustainable rate; the "
             "multi-window AND arms overload engagement).",
             labels=("window",))
+        # incremental-flatten additions (tensor-maintenance PR): how each
+        # dispatched wave synced the resident device tensors (patched in
+        # place vs full re-flatten/refresh — the perf headline), plus the
+        # row-slot allocator's occupancy/tombstone pressure, snapshotted
+        # from the backend's maintenance counters at expose time (waves
+        # are inc-only deltas, occupancy is a point-in-time gauge).
+        self.tpu_tensor_waves = cbm.Counter(
+            "scheduler_tpu_tensor_waves_total",
+            "Dispatched device waves by tensor-maintenance mode: patched "
+            "(targeted row patches / event patches / no-op) vs "
+            "reflattened (full snapshot re-encode + state refresh).",
+            labels=("mode",))
+        self.tpu_tensor_occupancy = cbm.Gauge(
+            "scheduler_tpu_tensor_occupancy",
+            "Fraction of node-tensor row slots (n_cap) bound to a live "
+            "node in the resident ClusterTensors row allocator.")
+        self.tpu_tensor_tombstones = cbm.Gauge(
+            "scheduler_tpu_tensor_tombstones",
+            "Node-tensor row slots released by node deletion but not yet "
+            "reclaimed by compaction (tombstoned rows).")
         r.must_register(
             self.schedule_attempts, self.scheduling_attempt_duration,
             self.scheduling_algorithm_duration, self.pod_scheduling_duration,
@@ -251,7 +271,9 @@ class Metrics:
             self.informer_relist_total, self.tpu_wave_collective_bytes,
             self.tpu_step_collective_bytes, self.tpu_wave_flops,
             self.tpu_step_hbm_bytes, self.host_stage_seconds,
-            self.slo_latency_ms, self.slo_burn_rate)
+            self.slo_latency_ms, self.slo_burn_rate,
+            self.tpu_tensor_waves, self.tpu_tensor_occupancy,
+            self.tpu_tensor_tombstones)
 
     def expose(self) -> str:
         return self.registry.expose()

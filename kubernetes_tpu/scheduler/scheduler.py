@@ -458,6 +458,10 @@ class Scheduler:
         self._slo = None
         self._census_wanted = False
         self._census: dict = {}
+        # last-seen tensor-maintenance wave counts per profile: the
+        # backend keeps cumulative tallies, the Prometheus counter is
+        # inc-only, so expose time applies deltas
+        self._maint_seen: dict = {}
         self._next_start_node_index = 0
         self._threads: list[threading.Thread] = []
         self._wire_event_handlers()
@@ -574,7 +578,7 @@ class Scheduler:
         # remote-seam resilience counters live on the backend (retries,
         # resyncs, failovers, breaker state); snapshot them into gauges at
         # pull time — the cheap direction for a hot dispatch path
-        for profile in self.profiles.values():
+        for profile_name, profile in self.profiles.items():
             backend = profile.batch_backend
             if backend is None:
                 continue
@@ -588,6 +592,24 @@ class Scheduler:
             if breaker_fn is not None:
                 for rung, v in breaker_fn().items():
                     self.metrics.prom.tpu_seam_breaker.set(float(v), rung)
+            # incremental-flatten maintenance: per-wave patched-vs-
+            # reflattened deltas into the counter, allocator pressure
+            # into the gauges
+            maint_fn = getattr(backend, "maintenance_snapshot", None)
+            if maint_fn is not None:
+                maint = maint_fn()
+                seen = self._maint_seen.setdefault(profile_name, {})
+                for mode, key in (("patched", "waves_patched"),
+                                  ("reflattened", "waves_reflattened")):
+                    now = float(maint.get(key, 0))
+                    delta = now - seen.get(key, 0.0)
+                    if delta > 0:
+                        self.metrics.prom.tpu_tensor_waves.inc(delta, mode)
+                    seen[key] = now
+                self.metrics.prom.tpu_tensor_occupancy.set(
+                    float(maint.get("row_occupancy", 0.0)))
+                self.metrics.prom.tpu_tensor_tombstones.set(
+                    float(maint.get("tombstone_rows", 0)))
         # overload-protection tallies: the queue accumulates sheds under
         # its own lock; the informers count relists — both drained here
         # (Counter is inc-only, the scheduler is the only writer)
@@ -817,6 +839,18 @@ class Scheduler:
         elif type_ == kv.DELETED:
             self.cache.remove_node(node)
             self.queue.move_all_to_active_or_backoff(ClusterEvent("Node", "Delete"))
+        else:
+            return
+        # incremental flatten: patch the event's row into the resident
+        # device tensors NOW, off the dispatch path, instead of leaving it
+        # for the next wave's snapshot drain (bulk ADDED floods stay on
+        # the drain path — _encode_fresh_bulk absorbs those cheaper)
+        name = meta.name(node)
+        view = self.cache.flatten_view()
+        for profile in self.profiles.values():
+            fn = getattr(profile.batch_backend, "note_node_event", None)
+            if fn is not None:
+                fn(type_, name, view)
 
     # -- run loops (scheduler.go:341) ------------------------------------
 

@@ -57,6 +57,11 @@ def pytest_configure(config):
         "churn matrix is additionally marked slow")
     config.addinivalue_line(
         "markers",
+        "churn: randomized incremental-flatten parity tests "
+        "(tests/test_churn_parity.py; seeded event streams pinned "
+        "against from-scratch re-flatten — large tier is also slow)")
+    config.addinivalue_line(
+        "markers",
         "proc: process-true topology tests that spawn real apiserver + "
         "scheduler OS processes (scheduler/procrun.py); every such test "
         "takes the proc_reaper fixture so a hung child can never wedge "

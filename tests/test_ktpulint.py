@@ -43,7 +43,7 @@ def run_rule(ctx: LintContext, name: str) -> list[Finding]:
 
 def test_registry_has_the_full_catalog():
     rules = all_rules()
-    assert len(rules) >= 20
+    assert len(rules) >= 21
     for name, rule in rules.items():
         assert name == rule.name
         assert rule.doc, f"rule {name} has no doc line"
@@ -673,6 +673,67 @@ def test_replicated_large_tensor_ignores_other_dirs(tmp_path):
         MY_PARTITION_RULES = ((r".*", ()),)
         """})
     assert run_rule(ctx, "replicated-large-tensor") == []
+
+
+def test_tensor_patch_discipline_outside_write_fires(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/ops/backend.py": """\
+        def clobber(t, rows):
+            t.used[rows] = 0.0
+        """})
+    found = run_rule(ctx, "tensor-patch-discipline")
+    assert len(found) == 1 and "ClusterTensors.used" in found[0].message
+
+
+def test_tensor_patch_discipline_attr_chain_and_augassign(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/scheduler/hot.py": """\
+        def drift(backend, row):
+            backend.tensors.npods[row] += 1.0
+        """})
+    found = run_rule(ctx, "tensor-patch-discipline")
+    assert len(found) == 1 and "npods" in found[0].message
+
+
+def test_tensor_patch_discipline_annotation_and_dict_mirror_quiet(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/ops/backend.py": """\
+        def rebuild(t, m, rows):
+            # patch-ok: full re-flatten rebuilds every row from scratch
+            t.used[rows] = 0.0
+            m["used"][rows] = 0.0  # host mirror dict, not ClusterTensors
+        """})
+    assert run_rule(ctx, "tensor-patch-discipline") == []
+
+
+def test_tensor_patch_discipline_api_must_bump_gen(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/ops/flatten.py": """\
+        class ClusterTensors:
+            def patch_node(self, name, ni):
+                self.used[0] = 1.0
+                return 0
+
+            def patch_remove(self, name):
+                row = self._release_row(name)
+                self.version += 1
+                self.patch_gen += 1
+                return row
+        """})
+    found = run_rule(ctx, "tensor-patch-discipline")
+    assert len(found) == 1 and "patch_node" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {f"{PKG}/ops/flatten.py": """\
+        class ClusterTensors:
+            def patch_node(self, name, ni):
+                self.used[0] = 1.0
+                self.patch_gen += 1
+                return 0
+        """})
+    assert run_rule(ctx, "tensor-patch-discipline") == []
+
+
+def test_tensor_patch_discipline_real_tree_is_clean():
+    import pathlib
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    ctx = LintContext(repo)
+    assert run_rule(ctx, "tensor-patch-discipline") == []
 
 
 # -- thread rules ----------------------------------------------------------

@@ -588,7 +588,8 @@ class TestTailCompaction:
                  "npods": jnp.asarray(t.npods),
                  "port_mask": jnp.asarray(t.port_mask),
                  "cd_sg": jnp.asarray(cd_sg),
-                 "cd_asg": jnp.asarray(cd_asg)}
+                 "cd_asg": jnp.asarray(cd_asg),
+                 "gen": jnp.asarray(0, jnp.int32)}
         static = {k: jnp.asarray(getattr(t, k))
                   for k in ("alloc", "maxpods", "valid", "taint_mask",
                             "label_mask", "key_mask", "dom_sg", "dom_asg")}
@@ -597,7 +598,7 @@ class TestTailCompaction:
         buf = pack_pod_batch(batch, spec, *empty)
         _state, rd = fn(state, static, jnp.asarray(buf))
         r = np.asarray(rd)
-        assignments = r[:-1]
+        assignments = r[:-2]  # result tail: | waves | gen
         assert (assignments >= 0).all(), assignments
         # maxSkew=1 over 3 zones with 12 pods: 4 per zone exactly
         zones = [int(t.dom_sg[0, row]) for row in assignments]
@@ -655,7 +656,8 @@ class TestTailCompaction:
                  "npods": jnp.asarray(t.npods),
                  "port_mask": jnp.asarray(t.port_mask),
                  "cd_sg": jnp.asarray(cd_sg),
-                 "cd_asg": jnp.asarray(cd_asg)}
+                 "cd_asg": jnp.asarray(cd_asg),
+                 "gen": jnp.asarray(0, jnp.int32)}
         static = {k: jnp.asarray(getattr(t, k))
                   for k in ("alloc", "maxpods", "valid", "taint_mask",
                             "label_mask", "key_mask", "dom_sg", "dom_asg")}
@@ -664,7 +666,7 @@ class TestTailCompaction:
         buf = pack_pod_batch(batch, spec, *empty)
         _state, rd = fn(state, static, jnp.asarray(buf))
         r = np.asarray(rd)
-        assignments = r[:-1]
+        assignments = r[:-2]  # result tail: | waves | gen
         assert (assignments >= 0).all(), assignments
         assert len(set(assignments.tolist())) == P  # one per node
 

@@ -26,6 +26,9 @@ Annotation conventions (documented in README "Static analysis"):
   # process-local: <why>                     declare a module-level
       mutable singleton safe across fork/spawn boundaries — each OS
       process gets (and wants) its own copy (process-safe-state rule)
+  # patch-ok: <why>                          authorize a direct
+      ClusterTensors array-field write outside the patch/compaction
+      API (tensor-patch-discipline rule)
 
 Findings are deterministic and ordered; a baseline file (JSON list of
 fingerprints) lets pre-existing accepted findings ride without blocking
@@ -46,7 +49,7 @@ _DISABLE_RE = re.compile(r"#\s*ktpulint:\s*disable=([\w,\- ]+)")
 _DISABLE_FILE_RE = re.compile(r"#\s*ktpulint:\s*disable-file=([\w,\- ]+)")
 _ANNOTATION_RE = re.compile(
     r"#\s*(sync-point|compile-cached|guarded-by|replicated-ok|"
-    r"process-local)\b")
+    r"process-local|patch-ok)\b")
 
 
 @dataclasses.dataclass(frozen=True)

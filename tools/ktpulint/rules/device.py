@@ -243,3 +243,128 @@ class RecompileHazardRule(Rule):
                         view, n.lineno,
                         f"unhashable literal for static arg {kw.arg!r} of "
                         f"{n.func.id} retraces on every call")
+
+
+# every numpy array field of ops/flatten.ClusterTensors whose rows the
+# incremental patch path maintains — a write that bypasses the
+# patch/compaction API desynchronizes the resident device copy without
+# bumping the version/patch_gen counters the diff machinery keys off
+_TENSOR_FIELDS = frozenset({
+    "alloc", "used", "used_nz", "npods", "maxpods", "valid",
+    "taint_mask", "label_mask", "key_mask", "port_mask",
+    "dom_sg", "dom_asg", "cnt_sg", "cnt_asg", "gen",
+    "sg_ns_mask", "asg_ns_mask",
+    "vict_prio", "vict_req", "vict_pdb", "vict_over"})
+
+# counters the patch/compaction API must bump so host-side diffing and
+# the epoch fast path observe every mutation
+_GEN_COUNTERS = ("patch_gen", "version", "static_version", "vict_version")
+
+
+def _tensors_base(node: ast.AST) -> bool:
+    """True when `node` names a ClusterTensors instance by this
+    codebase's convention: the local aliases `t`/`tensors` or any
+    attribute chain ending `.tensors` (self.tensors, backend.tensors)."""
+    if isinstance(node, ast.Name):
+        return node.id in ("t", "tensors")
+    return isinstance(node, ast.Attribute) and node.attr == "tensors"
+
+
+def _field_writes(node: ast.AST):
+    """Yield (field, lineno, base) for every array-field store reached
+    from `node`: subscript stores `base.field[...] = ...` (including
+    augmented ones) and whole-array rebinds `base.field = ...`."""
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Assign, ast.AugAssign)):
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for tgt in targets:
+                sub = tgt
+                if isinstance(sub, ast.Subscript):
+                    sub = sub.value
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr in _TENSOR_FIELDS:
+                    yield sub.attr, tgt.lineno if hasattr(tgt, "lineno") \
+                        else n.lineno, sub.value
+
+
+@register
+class TensorPatchDisciplineRule(Rule):
+    """The incremental-flatten invariant: resident ClusterTensors array
+    fields change ONLY through the patch/compaction API (patch_node /
+    patch_remove / compact / the flattener's own encoders), and every
+    public patch entry point bumps a generation counter (patch_gen /
+    version) so the device diff machinery observes the mutation.
+
+    Two checks: (a) outside ops/flatten.py, a direct store through
+    `t.<field>[...]` / `tensors.<field>` / `*.tensors.<field>` is a
+    finding unless annotated `# patch-ok: <why>`; (b) inside any file
+    defining class ClusterTensors, a `patch_*`/`compact` method that
+    writes array fields (or encodes rows) without bumping one of the
+    generation counters is a finding."""
+
+    name = "tensor-patch-discipline"
+    doc = "ClusterTensors writes ride the patch API and bump patch_gen"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if view.tree is None:
+            return
+        pkg = ctx.package_name
+        if not view.rel.startswith(f"{pkg}/"):
+            return
+        defines_tensors = any(
+            isinstance(n, ast.ClassDef) and n.name == "ClusterTensors"
+            for n in ast.walk(view.tree))
+        if defines_tensors:
+            yield from self._check_api(view)
+        else:
+            yield from self._check_outside_writes(view)
+
+    def _check_outside_writes(self, view: FileView):
+        for field, line, base in _field_writes(view.tree):
+            if not _tensors_base(base):
+                continue
+            if view.line_has_annotation(line, "patch-ok"):
+                continue
+            yield self.finding(
+                view, line,
+                f"direct write to ClusterTensors.{field} bypasses the "
+                "patch/compaction API (patch_node/patch_remove/compact); "
+                "the resident device copy desynchronizes silently — route "
+                "through the API or annotate # patch-ok: <why>")
+
+    def _check_api(self, view: FileView):
+        for n in ast.walk(view.tree):
+            if not (isinstance(n, ast.ClassDef)
+                    and n.name == "ClusterTensors"):
+                continue
+            for fn in n.body:
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                if not (fn.name.startswith("patch_")
+                        or fn.name == "compact"):
+                    continue
+                writes = any(isinstance(b, ast.Name) and b.id == "self"
+                             for _f, _l, b in _field_writes(fn))
+                encodes = any(isinstance(c, ast.Call)
+                              and isinstance(c.func, ast.Attribute)
+                              and c.func.attr in ("_encode_node",
+                                                  "_release_row")
+                              for c in ast.walk(fn))
+                if not (writes or encodes):
+                    continue
+                bumps = any(
+                    isinstance(b, (ast.Assign, ast.AugAssign))
+                    and any(isinstance(t2, ast.Attribute)
+                            and t2.attr in _GEN_COUNTERS
+                            for t2 in ((b.targets if isinstance(
+                                b, ast.Assign) else [b.target])))
+                    for b in ast.walk(fn))
+                if bumps or view.line_has_annotation(fn.lineno, "patch-ok"):
+                    continue
+                yield self.finding(
+                    view, fn.lineno,
+                    f"ClusterTensors.{fn.name} mutates array fields but "
+                    "never bumps a generation counter "
+                    f"({'/'.join(_GEN_COUNTERS[:2])}); the device diff "
+                    "machinery will miss the patch — bump patch_gen or "
+                    "annotate # patch-ok: <why>")
