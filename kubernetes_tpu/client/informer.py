@@ -65,6 +65,13 @@ class Informer:
         # thundering-herd it with simultaneous LISTs; the factory sets
         # this to a fixed offset derived from the instance index
         self.relist_stagger = 0.0
+        # warm-start seed (prime()): consumed ONCE in place of the first
+        # LIST, so a checkpointed restart replays only the watch delta
+        # since the checkpoint's resourceVersion.  last_rv tracks the
+        # newest revision applied (list rv, then per watch batch) — the
+        # value a checkpoint records so the next restart can prime.
+        self._warm: tuple[list, int] | None = None
+        self.last_rv = 0
 
     # -- lister ----------------------------------------------------------
 
@@ -121,6 +128,17 @@ class Informer:
                     objs = list(self._indexer.values())
                 if objs:
                     handler([(kv.ADDED, obj, None) for obj in objs])
+
+    def prime(self, objs: list, rv: int) -> None:
+        """Warm-start seed: the reflector's next cycle consumes (objs,
+        rv) in place of its initial LIST and opens the watch at `rv`, so
+        a process restarting from a checkpoint replays only the delta
+        since it — deletions included, as ordinary DELETED events.  The
+        seed is one-shot: if the watch window at `rv` has been compacted
+        (TooOldError) the normal relist recovery does a REAL list, so a
+        stale seed costs one extra round trip, never wrong state.  Call
+        before start()."""
+        self._warm = (list(objs), int(rv))
 
     def start(self) -> None:
         if self._thread is not None:
@@ -186,7 +204,12 @@ class Informer:
         return out
 
     def _list_and_watch(self) -> None:
-        items, rv = self.client.list(self.resource)
+        warm, self._warm = self._warm, None
+        if warm is not None:
+            items, rv = warm
+        else:
+            items, rv = self.client.list(self.resource)
+        self.last_rv = rv
         fresh = {meta.namespaced_name(o): o for o in items}
         # Each event: indexer update + handler calls under _dispatch_lock
         # (atomic wrt handler registration); the indexer write itself under
@@ -228,6 +251,7 @@ class Informer:
                 with self._dispatch_lock:
                     with self._lock:
                         triples = fasthost.watch_apply(evs, self._indexer)
+                    self.last_rv = evs[-1].revision
                     self._dispatch_all(triples)
         finally:
             w.stop()

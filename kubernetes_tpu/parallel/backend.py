@@ -245,6 +245,8 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
 
     def dispatch(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot):
         with self._lock:
+            if self._warm_pending:
+                self._warm_sweep(snapshot)
             # epoch fast path (see ops/backend.py dispatch): unchanged
             # cache epoch == all changes since last sync were our own
             # replayed binds — skip the O(nodes) re-encode + diff

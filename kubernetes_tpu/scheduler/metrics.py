@@ -251,6 +251,15 @@ class Metrics:
             "scheduler_tpu_tensor_tombstones",
             "Node-tensor row slots released by node deletion but not yet "
             "reclaimed by compaction (tombstoned rows).")
+        # zero-downtime-operations additions: config hot-reload outcomes
+        # (SIGHUP / supervisor RPC re-reading the dynamic stanzas; a
+        # rejected reload keeps the old config live)
+        self.config_reload_total = cbm.Counter(
+            "scheduler_config_reload_total",
+            "Config hot-reload attempts, by result (applied = dynamic "
+            "stanzas installed, rejected = validation failed and the old "
+            "config stayed live).",
+            labels=("result",))
         r.must_register(
             self.schedule_attempts, self.scheduling_attempt_duration,
             self.scheduling_algorithm_duration, self.pod_scheduling_duration,
@@ -273,7 +282,7 @@ class Metrics:
             self.tpu_step_hbm_bytes, self.host_stage_seconds,
             self.slo_latency_ms, self.slo_burn_rate,
             self.tpu_tensor_waves, self.tpu_tensor_occupancy,
-            self.tpu_tensor_tombstones)
+            self.tpu_tensor_tombstones, self.config_reload_total)
 
     def expose(self) -> str:
         return self.registry.expose()

@@ -17,8 +17,10 @@ process-true:
                 scheduler: its own informers over HTTP, its own backend,
                 its own Lease — configured purely through the existing
                 `scaleOut:` stanza.  Readiness is a stdout handshake
-                (KTPU_SCHED_READY line) + a per-child /healthz; liveness
-                is the child's lease (self_live) behind /healthz.
+                (KTPU_SCHED_READY line) + a per-child /readyz (503 while
+                draining or lease-fenced); /healthz is pure process
+                liveness.  rolling_restart() composes drain/respawn/
+                readiness into the zero-downtime upgrade.
   child_main    the child entrypoint: SIGTERM triggers a graceful drain
                 (retire the lease -> fence binds -> flush/requeue ->
                 exit 0); SIGKILL is the crash path the churn chaos uses
@@ -42,6 +44,7 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -63,8 +66,10 @@ def _free_port() -> int:
 
 class _ChildHTTP(http.server.BaseHTTPRequestHandler):
     """Per-child observability endpoint: /metrics (Prometheus text the
-    supervisor federates) and /healthz (liveness = the scale-out lease;
-    a fenced/retired child answers 503 so a probe restarts it)."""
+    supervisor federates), /healthz (pure liveness: the process is up
+    and serving — restart probes key off this) and /readyz (readiness:
+    503 while draining or lease-fenced, so a rolling upgrade skips the
+    instance without a liveness probe killing it mid-drain)."""
 
     sched = None  # class attribute, set per server instance below
 
@@ -76,9 +81,16 @@ class _ChildHTTP(http.server.BaseHTTPRequestHandler):
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4")
         elif self.path == "/healthz":
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+        elif self.path == "/readyz":
             so = sched.scaleout
-            ok = so is None or so.self_live
-            body = b"ok" if ok else b"fenced"
+            draining = getattr(self.server, "draining", False)
+            fenced = so is not None and not so.self_live
+            ok = not draining and not fenced
+            body = (b"ok" if ok
+                    else b"draining" if draining else b"fenced")
             self.send_response(200 if ok else 503)
             self.send_header("Content-Type", "text/plain")
         else:
@@ -135,7 +147,15 @@ def _install_race_probes(client) -> None:
 def child_main(args) -> int:
     """One scheduler instance as an OS process.  Everything it knows
     about the topology comes from the scaleOut: stanza; everything it
-    knows about the cluster comes over the wire."""
+    knows about the cluster comes over the wire.
+
+    Zero-downtime hooks: --warm-dir arms checkpointed warm-start (load
+    the mirror checkpoint + prime the informers at its resourceVersions
+    on boot; write a fresh checkpoint on SIGTERM drain), --config names
+    a KubeSchedulerConfiguration whose DYNAMIC stanzas apply at boot and
+    re-apply on SIGHUP (Scheduler.reload_config: invalid files are
+    rejected with the old config kept live)."""
+    from ..client.clientset import NODES, PODS
     from ..client.http_client import HTTPClient
     from ..client.informer import SharedInformerFactory
     from .config import load_config, scheduler_from_config
@@ -159,6 +179,7 @@ def child_main(args) -> int:
             "renewIntervalSeconds": args.renew_interval,
         }
     sched = scheduler_from_config(client, factory, load_config(stanza))
+    backend = None
     if args.backend != "none":
         # the harness half of the backend: stanza contract — construct
         # the device backend the config named and hang it on the profile
@@ -172,33 +193,98 @@ def child_main(args) -> int:
         profile.batch_backend = backend
         profile.batch_size = args.batch_size
         sched.pipeline_depth = 2
+    if args.config:
+        # boot-time config: same validation as the SIGHUP path; a bad
+        # file fails the boot loudly instead of running half-configured
+        sched.reload_config(args.config)
+
+    # checkpointed warm-start: install the mirror BEFORE informers start
+    # so the primed replay's events land on adoption-pending rows
+    warm_path = None
+    if args.warm_dir and backend is not None \
+            and hasattr(backend, "warm_start"):
+        from ..ops.backend import CheckpointError
+        warm_path = os.path.join(args.warm_dir,
+                                 f"sched-{args.instance_index}.ckpt")
+        if os.path.exists(warm_path):
+            try:
+                warm = backend.warm_start(warm_path)
+            except CheckpointError as e:
+                logger.warning("checkpoint %s rejected (%s); cold start",
+                               warm_path, e)
+            else:
+                objs = warm.get("objects") or {}
+                rvs = warm.get("resource_versions") or {}
+                for res in (NODES, PODS):
+                    if res in objs and res in rvs:
+                        factory.informer(res).prime(objs[res], rvs[res])
+                logger.info("warm start: %d rows pending adoption from %s",
+                            warm["nodes"], warm_path)
 
     server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _ChildHTTP)
     server.sched = sched  # type: ignore[attr-defined]
+    server.draining = False  # type: ignore[attr-defined]
     threading.Thread(target=server.serve_forever,
                      name="child-metrics", daemon=True).start()
 
     stop = threading.Event()
+    reload_req = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGHUP, lambda *a: reload_req.set())
 
     factory.start()
     if not factory.wait_for_cache_sync(60.0):
         logger.error("cache sync timed out; exiting")
         return 1
+    if backend is not None and hasattr(backend, "warm_align"):
+        # sweep any rows the primed replay's bulk path did not visit
+        # (adopt current ones, drop rows whose node died while we were
+        # down) so the first wave starts from a fully-reconciled mirror
+        backend.warm_align(sched.cache.flatten_view())
     sched.run()
     # readiness handshake: the supervisor tails our stdout for this line
     print(f"{READY_PREFIX} index={args.instance_index} pid={os.getpid()} "
           f"metrics_port={server.server_address[1]}", flush=True)
 
-    stop.wait()
-    # graceful drain (SIGTERM): retire the lease FIRST so the bind fence
-    # rejects any wave still in flight (nothing new reaches the store),
-    # then stop the loop — its shutdown path flushes/requeues in-flight
-    # work so peers absorbing our partition find every pod in the store.
+    while not stop.wait(0.2):
+        if reload_req.is_set():
+            reload_req.clear()
+            if not args.config:
+                logger.warning("SIGHUP ignored: no --config file to reload")
+                continue
+            try:
+                outcome = sched.reload_config(args.config)
+            except Exception as e:  # noqa: BLE001 - keep old config live
+                logger.warning("config reload rejected: %s", e)
+            else:
+                logger.info("config reloaded: %s", outcome)
+    # graceful drain (SIGTERM): fail readiness, then retire the lease
+    # FIRST so the bind fence rejects any wave still in flight (nothing
+    # new reaches the store), then stop the loop — its shutdown path
+    # flushes/requeues in-flight work so peers absorbing our partition
+    # find every pod in the store.
+    server.draining = True  # type: ignore[attr-defined]
     if sched.scaleout is not None:
         sched.scaleout.retire()
     sched.stop()
+    if warm_path is not None:
+        # the loop is quiesced and the informers still hold their last
+        # applied revisions: cut the warm-start checkpoint the respawned
+        # instance resumes from
+        try:
+            nodes_inf = factory.informer(NODES)
+            pods_inf = factory.informer(PODS)
+            cut = backend.checkpoint_mirror(
+                warm_path, snapshot=sched.cache.flatten_view(),
+                resource_versions={NODES: nodes_inf.last_rv,
+                                   PODS: pods_inf.last_rv},
+                objects={NODES: nodes_inf.list(),
+                         PODS: pods_inf.list()})
+            logger.info("checkpointed %d rows (%d bytes) to %s",
+                        cut["nodes"], cut["bytes"], cut["path"])
+        except Exception:  # noqa: BLE001 - drain must still exit 0
+            logger.exception("checkpoint write failed; next start is cold")
     factory.stop()
     server.shutdown()
     return 0
@@ -243,16 +329,24 @@ class ProcCluster:
     victim's lease lapses and survivors absorb its ring slices);
     drain(i) is the graceful path (SIGTERM -> lease retire -> flush ->
     exit 0); respawn(i) brings an instance back with its old identity.
-    shutdown() drains every child then the apiserver.  Context-manager
-    friendly so a failing test can never leak processes (tests add the
-    conftest proc_reaper belt on top)."""
+    rolling_restart() composes them into the zero-downtime upgrade:
+    drain -> respawn -> /readyz, one instance at a time, the PR 7 ring
+    re-homing each drained instance's slices to survivors meanwhile.
+    hot_reload(i) relays SIGHUP (config re-read, requires config_path);
+    handoff_apiserver() replaces the apiserver over its WAL (requires
+    data_dir).  shutdown() drains every child then the apiserver.
+    Context-manager friendly so a failing test can never leak processes
+    (tests add the conftest proc_reaper belt on top)."""
 
     def __init__(self, n_instances: int, *, backend: str = "none",
                  batch_size: int = 1024, nodes: int = 256,
                  lease_duration: float = 1.5, renew_interval: float = 0.25,
                  solo_ownership: bool = False,
                  child_env: dict[int, dict[str, str]] | None = None,
-                 ready_timeout: float = 120.0):
+                 ready_timeout: float = 120.0,
+                 warm_dir: str | None = None,
+                 config_path: str | None = None,
+                 data_dir: str | None = None):
         self.n = n_instances
         self.backend = backend
         self.batch_size = batch_size
@@ -265,9 +359,15 @@ class ProcCluster:
         self.solo = solo_ownership
         self.child_env = child_env or {}
         self.ready_timeout = ready_timeout
+        self.warm_dir = warm_dir      # children checkpoint/warm-start here
+        self.config_path = config_path  # children re-read this on SIGHUP
+        self.data_dir = data_dir      # apiserver WAL dir (handoff needs it)
         self.url: str | None = None
         self.token: str | None = None
+        self.drain_escalations = 0  # SIGTERM hangs escalated to SIGKILL
         self._api: subprocess.Popen | None = None
+        self._api_port: int | None = None
+        self._api_log = None  # captured apiserver stdout/stderr (tempfile)
         self._children: dict[int, _Child] = {}
         self._clients: list = []  # admin HTTPClients handed out
 
@@ -276,30 +376,81 @@ class ProcCluster:
     def _start_apiserver(self) -> None:
         import secrets
 
+        if self.token is None:
+            self.token = secrets.token_urlsafe(16)
+        # A fresh start may retry on a new port: _free_port() closes its
+        # probe socket before the server rebinds the number, so another
+        # process can race it away (EADDRINUSE kills the child before it
+        # serves).  A handoff restart gets NO retry — the children hold
+        # the old URL, so the replacement must win the same port back.
+        fresh = self._api_port is None
+        for attempt in range(3 if fresh else 1):
+            if fresh:
+                self._api_port = _free_port()
+            self.url = f"http://127.0.0.1:{self._api_port}"
+            # AlwaysAllow + no admission: this supervisor exists to measure
+            # the SCHEDULER topology; perf/scheduler_perf.py via_http keeps
+            # the RBAC+admission front-door configuration
+            argv = [sys.executable, "-m", "kubernetes_tpu.cmd.apiserver",
+                    "--secure-port", str(self._api_port),
+                    "--token", self.token]
+            if self.data_dir:
+                argv += ["--data-dir", self.data_dir]
+            self._close_api_log()
+            self._api_log = tempfile.TemporaryFile(mode="w+",
+                                                   encoding="utf-8",
+                                                   errors="replace")
+            self._api = subprocess.Popen(
+                argv, stdout=self._api_log, stderr=subprocess.STDOUT,
+                cwd=_REPO_ROOT)
+            try:
+                self._wait_apiserver_healthy(60.0)
+                return
+            except RuntimeError:
+                died = self._api.poll() is not None
+                if not (fresh and died and attempt < 2):
+                    self.shutdown()
+                    raise
+                logger.warning("apiserver died during start (port race?),"
+                               " retrying on a fresh port")
+                self._api_port = None
+
+    def _api_log_tail(self, limit: int = 2000) -> str:
+        log = getattr(self, "_api_log", None)
+        if log is None:
+            return ""
+        try:
+            log.seek(0)
+            return log.read()[-limit:]
+        except (OSError, ValueError):
+            return ""
+
+    def _close_api_log(self) -> None:
+        log = getattr(self, "_api_log", None)
+        if log is not None:
+            try:
+                log.close()
+            except OSError:
+                pass
+            self._api_log = None
+
+    def _wait_apiserver_healthy(self, timeout: float) -> None:
         from ..client.http_client import HTTPClient
-        port = _free_port()
-        self.token = secrets.token_urlsafe(16)
-        self.url = f"http://127.0.0.1:{port}"
-        # AlwaysAllow + no admission: this supervisor exists to measure
-        # the SCHEDULER topology; perf/scheduler_perf.py via_http keeps
-        # the RBAC+admission front-door configuration
-        self._api = subprocess.Popen(
-            [sys.executable, "-m", "kubernetes_tpu.cmd.apiserver",
-             "--secure-port", str(port), "--token", self.token],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            cwd=_REPO_ROOT)
         client = HTTPClient.from_url(self.url, token=self.token)
-        deadline = time.monotonic() + 30
+        deadline = time.monotonic() + timeout
         while True:
             try:
                 client._request("GET", "/healthz")
                 return
             except Exception:  # noqa: BLE001 - still starting
-                if self._api.poll() is not None \
-                        or time.monotonic() > deadline:
-                    self.shutdown()
-                    raise RuntimeError(
-                        "apiserver process failed to start") from None
+                died = self._api.poll() is not None
+                if died or time.monotonic() > deadline:
+                    tail = self._api_log_tail()
+                    why = ("apiserver died during start" if died else
+                           f"apiserver not healthy after {timeout:.0f}s")
+                    if tail:
+                        why += f"; last output:\n{tail}"
+                    raise RuntimeError(why) from None
                 time.sleep(0.1)
 
     def admin_client(self):
@@ -328,6 +479,10 @@ class ProcCluster:
                 "--nodes", str(self.nodes),
                 "--lease-duration", str(self.lease_duration),
                 "--renew-interval", str(self.renew_interval)]
+        if self.warm_dir:
+            argv += ["--warm-dir", self.warm_dir]
+        if self.config_path:
+            argv += ["--config", self.config_path]
         child.proc = subprocess.Popen(
             argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=_REPO_ROOT, env=env)
@@ -382,8 +537,11 @@ class ProcCluster:
 
     def drain(self, index: int, timeout: float = 20.0) -> int | None:
         """Graceful path: SIGTERM -> the child retires its lease, flushes
-        in-flight work and exits 0.  Escalates to SIGKILL on a hang so a
-        stuck child can never wedge the caller."""
+        in-flight work and exits 0.  Escalates to SIGKILL on a hang —
+        recorded in scheduler_proc_drain_escalated_total (see
+        supervisor_metrics_text) — so a stuck child can never wedge a
+        rolling upgrade: failover proceeds, the victim's lease lapses
+        and survivors absorb its slices exactly as on a crash."""
         c = self._children.get(index)
         if c is None or c.proc is None:
             return None
@@ -395,6 +553,11 @@ class ProcCluster:
             try:
                 c.proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
+                self.drain_escalations += 1
+                logger.warning(
+                    "child %d ignored SIGTERM for %.1fs; escalating to "
+                    "SIGKILL (drain_escalations=%d)", index, timeout,
+                    self.drain_escalations)
                 c.proc.kill()
                 c.proc.wait()
         c.ready.clear()
@@ -406,6 +569,118 @@ class ProcCluster:
         self._spawn(index)
         if wait_ready:
             self.wait_ready([index])
+
+    # -- zero-downtime operations ----------------------------------------
+
+    def wait_child_ready(self, index: int, timeout: float = 60.0) -> None:
+        """Block until child `index` answers /readyz 200 — the HTTP half
+        of readiness on top of the stdout handshake (a fenced or
+        draining instance answers 503 there while still live)."""
+        import urllib.error
+        import urllib.request
+        c = self._children[index]
+        deadline = time.monotonic() + timeout
+        while True:
+            if c.metrics_port is not None:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{c.metrics_port}/readyz",
+                            timeout=5.0) as resp:
+                        if resp.status == 200:
+                            return
+                except (urllib.error.URLError, OSError):
+                    pass
+            if not self.alive(index):
+                raise RuntimeError(
+                    f"child {index} died while waiting for /readyz; "
+                    f"tail: {c.tail()}")
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"child {index} not ready after {timeout}s; "
+                    f"tail: {c.tail()}")
+            time.sleep(0.05)
+
+    def rolling_restart(self, *, drain_timeout: float = 20.0,
+                        ready_timeout: float = 60.0) -> list[int]:
+        """Zero-downtime upgrade of the scheduler topology: cycle every
+        live child through drain -> respawn -> readiness, never taking
+        more than one instance out at a time.  During each drain window
+        the PR 7 ring re-homes the drained instance's slices to
+        survivors (lease retire on SIGTERM), so pods keep binding
+        throughout; with warm_dir set each child checkpoints its mirror
+        on the way down and warm-starts on the way up.  Returns the
+        indices rolled, in order."""
+        if self.config_path:
+            # Pre-flight the config file: a respawned child fail-fasts on
+            # an unparseable --config, so starting the roll would drain a
+            # HEALTHY replica and then fail to bring its successor up —
+            # the classic bad-config-plus-restart outage.  Refuse before
+            # any drain instead (the running children keep their last
+            # good config either way).
+            from .config import ConfigError, load_config
+            try:
+                load_config(self.config_path)
+            except ConfigError as e:
+                raise RuntimeError(
+                    f"refusing rolling restart: {self.config_path} would "
+                    f"kill respawned children: {e}") from e
+        rolled: list[int] = []
+        for i in sorted(self._children):
+            if not self.alive(i):
+                continue
+            self.drain(i, timeout=drain_timeout)
+            self.respawn(i, wait_ready=True)
+            self.wait_child_ready(i, timeout=ready_timeout)
+            rolled.append(i)
+        return rolled
+
+    def hot_reload(self, index: int | None = None) -> list[int]:
+        """Relay SIGHUP to one child (or every live child): each re-reads
+        config_path and applies the dynamic stanzas without restarting;
+        an invalid file is rejected child-side with the old config kept
+        live.  Returns the indices signalled."""
+        if not self.config_path:
+            raise RuntimeError("hot_reload requires config_path")
+        targets = ([index] if index is not None
+                   else [i for i in sorted(self._children) if self.alive(i)])
+        signalled = []
+        for i in targets:
+            c = self._children.get(i)
+            if c is None or c.proc is None or c.proc.poll() is not None:
+                continue
+            c.proc.send_signal(signal.SIGHUP)
+            signalled.append(i)
+        return signalled
+
+    def handoff_apiserver(self, timeout: float = 30.0) -> None:
+        """Replace the apiserver process over its durable store: SIGTERM
+        the old one (its shutdown fsyncs the WAL), start the replacement
+        on the SAME port + token + data dir, and wait for /healthz.  WAL
+        recovery restores every object and the revision counter, so the
+        children never need repointing: their HTTP clients reconnect
+        per-request, and their watches — whose windows died with the old
+        process — raise TooOld and relist through the normal recovery
+        path.  Requires data_dir (an in-memory store cannot hand off)."""
+        if not self.data_dir:
+            raise RuntimeError("handoff_apiserver requires data_dir")
+        if self._api is not None:
+            self._api.terminate()
+            try:
+                self._api.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self._api.kill()
+                self._api.wait()
+            self._api = None
+        self._start_apiserver()
+
+    def supervisor_metrics_text(self) -> str:
+        """Supervisor-side counters in exposition format — appended to
+        the children's federated texts by the bench/ops tooling.  These
+        are process-management tallies the children cannot see (they are
+        the ones being SIGKILLed)."""
+        return ("# TYPE scheduler_proc_drain_escalated_total counter\n"
+                f"scheduler_proc_drain_escalated_total "
+                f"{float(self.drain_escalations)}\n")
 
     def metrics_texts(self) -> list[str]:
         """One /metrics pull per live child — the raw exposition bodies
@@ -440,6 +715,7 @@ class ProcCluster:
                 self._api.kill()
                 self._api.wait()
             self._api = None
+        self._close_api_log()
 
     def __enter__(self) -> "ProcCluster":
         return self.start()
@@ -458,15 +734,46 @@ class WireBindLedger:
     def __init__(self, client):
         self.nodes_seen: dict[str, set[str]] = {}
         from ..client.clientset import PODS
+        self._pods = PODS
+        self._client = client
         self._watch = client.watch(PODS, since_rv=0)
 
+    def _record(self, obj) -> None:
+        md = obj.get("metadata") or {}
+        key = f"{md.get('namespace')}/{md.get('name')}"
+        node = (obj.get("spec") or {}).get("nodeName")
+        if node:
+            self.nodes_seen.setdefault(key, set()).add(node)
+
+    def _rearm(self) -> None:
+        """The streaming watch EOFs when the apiserver hands off to a
+        WAL-recovered replacement.  Re-arm against the successor: rv=0
+        replay when the history still reaches back that far, else LIST
+        (each pod's current nodeName is still a bind record) and watch
+        from the list revision — reflector.go's relist-on-TooOld,
+        applied to the test oracle.  A refused connection (mid-handoff
+        gap) leaves the ledger stopped; the next drain retries."""
+        from ..store import kv
+        try:
+            self._watch = self._client.watch(self._pods, since_rv=0)
+            return
+        except kv.TooOldError:
+            pass
+        except OSError:
+            return
+        try:
+            items, rv = self._client.list(self._pods)
+            for obj in items:
+                self._record(obj)
+            self._watch = self._client.watch(self._pods, since_rv=rv)
+        except (kv.TooOldError, OSError):
+            return
+
     def drain(self, timeout: float = 0.05):
+        if getattr(self._watch, "stopped", False):
+            self._rearm()
         for ev in self._watch.next_batch(timeout=timeout):
-            md = ev.object.get("metadata") or {}
-            key = f"{md.get('namespace')}/{md.get('name')}"
-            node = (ev.object.get("spec") or {}).get("nodeName")
-            if node:
-                self.nodes_seen.setdefault(key, set()).add(node)
+            self._record(ev.object)
         return self.nodes_seen
 
     def bound_total(self) -> int:
@@ -498,6 +805,12 @@ def main(argv=None) -> None:
                     help="expected node count (backend capacity sizing)")
     ap.add_argument("--lease-duration", type=float, default=1.5)
     ap.add_argument("--renew-interval", type=float, default=0.25)
+    ap.add_argument("--warm-dir", default="",
+                    help="checkpoint dir: write the mirror checkpoint on "
+                         "drain, warm-start from it on boot")
+    ap.add_argument("--config", default="",
+                    help="KubeSchedulerConfiguration file whose dynamic "
+                         "stanzas apply at boot and re-apply on SIGHUP")
     args = ap.parse_args(argv)
     if not args.child:
         ap.error("supervisor mode is library-only: use ProcCluster; "

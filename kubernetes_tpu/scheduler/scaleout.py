@@ -199,11 +199,14 @@ class ScaleOutCoordinator:
         if not self._retired:
             try:
                 self.heartbeat(client, now)
-            except kv.StoreError:
-                # fenced / read-only / partitioned store: we cannot renew,
-                # so the sweep below will eventually drop us from live
+            except (kv.StoreError, OSError):
+                # fenced / read-only / partitioned store, or an apiserver
+                # mid-handoff (connection refused): we cannot renew, so
+                # the sweep below will eventually drop us from live.  An
+                # exception here must never kill the scheduling loop —
+                # the lease protocol already handles a silent instance.
                 pass
         try:
             return self.sweep(client, now)
-        except kv.StoreError:
+        except (kv.StoreError, OSError):
             return False

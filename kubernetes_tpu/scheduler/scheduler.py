@@ -22,6 +22,7 @@ handling):
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import threading
@@ -530,6 +531,77 @@ class Scheduler:
         self._profiler = profiler
         self._slo = slo
         self._census_wanted = bool(census)
+
+    # stanzas reload_config can apply to a running scheduler; everything
+    # else in a KubeSchedulerConfiguration (plugin pipelines, scaleOut
+    # identity, extenders, remoteSeam deadlines, parallelism, queue
+    # backoff) is wired at construction time and needs a process restart
+    DYNAMIC_STANZAS = ("overload", "tracing", "profiling", "backend")
+
+    def reload_config(self, source) -> dict:
+        """Config hot-reload (SIGHUP / supervisor RPC): re-parse `source`
+        (path, YAML text or dict) and apply the dynamic stanzas to the
+        live scheduler.  Validation is all-or-nothing and happens before
+        anything is touched — a ConfigError propagates to the caller and
+        the old config stays live in full.  Returns {"applied": [...],
+        "restart_only": [...]} naming the dynamic stanzas installed and
+        any requested changes that need a restart (backend kind swap)."""
+        from ..component_base import profiling, tracing
+        from .config import load_config
+        try:
+            cfg = load_config(source)
+        except Exception:
+            self.metrics.prom.config_reload_total.inc(1.0, "rejected")
+            raise
+        restart_only: list[str] = []
+        self.configure_overload(cfg.overload if cfg.overload.enabled
+                                else None)
+        if cfg.tracing.enabled:
+            tracing.default_tracer_provider.configure(
+                sampling_rate_per_million=(
+                    cfg.tracing.sampling_rate_per_million),
+                max_spans=cfg.tracing.max_spans,
+                max_traces=cfg.tracing.max_traces)
+            self.configure_tracing(tracing.default_tracer_provider)
+        else:
+            self.configure_tracing(None)
+        if cfg.profiling.enabled or cfg.profiling.census:
+            profiler = None
+            if cfg.profiling.enabled:
+                profiler = profiling.default_host_profiler
+                profiler.interval = cfg.profiling.sample_interval_ms / 1e3
+                profiler.max_stacks = cfg.profiling.max_stacks
+                profiler.start()
+            elif (self._profiler is profiling.default_host_profiler
+                    and self._profiler is not None):
+                self._profiler.stop()
+            slo = profiling.SLOTracker(
+                target_ms=cfg.profiling.slo_target_ms,
+                objective=cfg.profiling.slo_objective,
+                windows=cfg.profiling.burn_windows_s)
+            self.configure_profiling(profiler, slo,
+                                     census=cfg.profiling.census)
+        else:
+            if (self._profiler is not None
+                    and self._profiler is profiling.default_host_profiler):
+                self._profiler.stop()
+            self.configure_profiling(None, None)
+        # backend knobs: batch size retunes the next dispatch wave; a
+        # KIND swap means a different compiled kernel + device residency
+        # — that is a restart, not a reload
+        applied = ["overload", "tracing", "profiling"]
+        if cfg.backend.kind != self.backend_policy.kind:
+            restart_only.append("backend.kind")
+        if cfg.backend.batch_size > 0:
+            for profile in self.profiles.values():
+                if profile.batch_backend is not None:
+                    profile.batch_size = cfg.backend.batch_size
+                    applied.append("backend.batchSize")
+                    break
+        self.backend_policy = dataclasses.replace(
+            cfg.backend, kind=self.backend_policy.kind)
+        self.metrics.prom.config_reload_total.inc(1.0, "applied")
+        return {"applied": applied, "restart_only": restart_only}
 
     def run_device_census(self) -> dict:
         """In-band device cost census: ask the batch backend to lower
@@ -1350,12 +1422,21 @@ class Scheduler:
             current = self.client.get(PODS, meta.namespace(qpi.pod), meta.name(qpi.pod))
         except kv.NotFoundError:
             return
+        except (kv.StoreError, OSError):
+            # apiserver unreachable (mid-handoff gap): requeue with the
+            # pod we already have — the retry re-resolves against the
+            # real state, and the store's compare-and-bind keeps
+            # exactly-once even if the pod was bound elsewhere meanwhile
+            current = qpi.pod
         if meta.pod_node_name(current):
             return  # got bound elsewhere
         qpi.pod_info.update(current)
         self.queue.add_unschedulable_if_not_present(qpi, cycle)
-        self.client.create_event(qpi.pod, "FailedScheduling", s.message(),
-                                 type_="Warning")
+        try:
+            self.client.create_event(qpi.pod, "FailedScheduling", s.message(),
+                                     type_="Warning")
+        except (kv.StoreError, OSError):
+            pass  # events are best-effort; the requeue above already landed
         # patch status condition (schedule_one.go:918)
         try:
             def patch(p: Obj) -> Obj:
@@ -1366,7 +1447,7 @@ class Scheduler:
                 return p
             self.client.guaranteed_update(PODS, meta.namespace(qpi.pod),
                                           meta.name(qpi.pod), patch)
-        except kv.StoreError:
+        except (kv.StoreError, OSError):
             pass
 
     def _batch_preempt(self, profile: Profile, fw: Framework,
@@ -2211,7 +2292,7 @@ class Scheduler:
                         outcome = "lost_to_peer"
                 except kv.NotFoundError:
                     outcome = "lost_to_peer"  # bound by a peer, then deleted
-                except kv.StoreError:
+                except (kv.StoreError, OSError):
                     pass  # cannot tell: requeue is the safe side
             outcomes[outcome] = outcomes.get(outcome, 0) + 1
             if outcome != "lost_to_peer":

@@ -66,6 +66,12 @@ def pytest_configure(config):
         "scheduler OS processes (scheduler/procrun.py); every such test "
         "takes the proc_reaper fixture so a hung child can never wedge "
         "tier-1")
+    config.addinivalue_line(
+        "markers",
+        "upgrade: zero-downtime-operations tests (rolling restart, "
+        "checkpointed warm-start, config hot-reload); tier-1 runs the "
+        "shrunk 2-process rolling-restart pass, the full churn matrix "
+        "is additionally marked slow")
 
 
 @pytest.fixture

@@ -4,6 +4,7 @@ Behavioral contracts from staging/src/k8s.io/kube-aggregator and
 staging/src/k8s.io/kms + apiserver/pkg/storage/value/encrypt/envelope.
 """
 
+import importlib.util
 import json
 import threading
 import time
@@ -19,6 +20,10 @@ from kubernetes_tpu.store import kv
 from kubernetes_tpu.store.encryption import (
     ENVELOPE_KEY, DecryptError, EnvelopeTransformer, LocalKMS,
 )
+
+requires_crypto = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="AES-GCM sealing needs the cryptography package")
 
 
 def http(method, url, body=None):
@@ -165,6 +170,7 @@ class TestAggregator:
             server.stop()
 
 
+@requires_crypto
 class TestEnvelopeEncryption:
     def _store(self):
         kms = LocalKMS()

@@ -12,6 +12,9 @@ import time
 
 import pytest
 
+pytest.importorskip("cryptography",
+                    reason="ClusterCA/TLS need the cryptography package")
+
 from kubernetes_tpu.api import meta
 from kubernetes_tpu.apiserver import APIServer
 from kubernetes_tpu.apiserver import authn as authnlib

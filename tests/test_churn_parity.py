@@ -327,6 +327,246 @@ def test_seam_backend_churn_parity():
         worker.stop()
 
 
+# -- checkpointed warm-start: restart mid-stream, resume bit-identical ----
+
+def _split_at_wave(ops: list, wave_idx: int) -> tuple[list, list]:
+    """Split the op stream at the wave_idx'th wave boundary: everything
+    before it runs pre-restart, everything after resumes post-restart."""
+    first, rest, seen = [], [], 0
+    for op in ops:
+        (first if seen < wave_idx else rest).append(op)
+        if op[0] == "wave":
+            seen += 1
+    assert seen > wave_idx, "scenario has too few waves"
+    return first, rest
+
+
+def _run_tracked(backend, cache: Cache, ops, store: dict) -> list:
+    """run_scenario's body, additionally maintaining `store` — the
+    objects a restarted informer would be primed with: the latest node
+    object per live node plus every bound pod object."""
+    waves = []
+    for op in ops:
+        if op[0] == "event":
+            kind, node = op[1], op[2]
+            name = meta.name(node)
+            if kind == "DELETED":
+                store["nodes"].pop(name, None)
+            else:
+                store["nodes"][name] = node
+            _apply_event(cache, backend, kind, node)
+        elif op[0] == "compact":
+            with backend._lock:
+                backend.tensors.compact()
+        else:
+            pod_objs = [copy.deepcopy(p) for p in op[1]]
+            infos = [PodInfo(p) for p in pod_objs]
+            resolve = backend.dispatch(infos, cache.flatten_view())
+            assert resolve is not FLUSH_FIRST
+            for kind, _t, node in op[2]:
+                name = meta.name(node)
+                if kind == "DELETED":
+                    store["nodes"].pop(name, None)
+                else:
+                    store["nodes"][name] = node
+                _apply_event(cache, backend, kind, node)
+            results = resolve()
+            w = []
+            for pod, (name, status) in zip(pod_objs, results):
+                w.append((name, None if status is None else status.code))
+                if name:
+                    bound = copy.deepcopy(pod)
+                    bound.setdefault("spec", {})["nodeName"] = name
+                    cache.add_pod(bound)
+                    store["pods"].append(bound)
+            waves.append(w)
+    return waves
+
+
+def _restart_through_checkpoint(make_backend, ops, split_wave: int,
+                                path: str):
+    """Run `ops` up to split_wave on one backend, checkpoint_mirror,
+    then resume the remainder on a FRESH backend + FRESH cache primed
+    from the checkpoint's objects — the restarted process's informer
+    replay.  Returns (warm backend, its cache, all waves)."""
+    first, rest = _split_at_wave(ops, split_wave)
+    a = make_backend()
+    cache_a = Cache()
+    store: dict = {"nodes": {}, "pods": []}
+    waves = _run_tracked(a, cache_a, first, store)
+    info = a.checkpoint_mirror(
+        path, snapshot=cache_a.flatten_view(),
+        resource_versions={"nodes": 1, "pods": 1},
+        objects={"nodes": [copy.deepcopy(n)
+                           for n in store["nodes"].values()],
+                 "pods": [copy.deepcopy(p) for p in store["pods"]]})
+    b = make_backend()
+    warm = b.warm_start(path)
+    cache_b = Cache()
+    for n in warm["objects"]["nodes"]:
+        cache_b.add_node(n)
+    for p in warm["objects"]["pods"]:
+        cache_b.add_pod(p)
+    b.warm_align(cache_b.flatten_view())
+    # every checkpointed row's content digest matches the primed replay,
+    # so every row is adopted verbatim — zero re-encodes on restart
+    assert b.stats.get("warm_adopted", 0) == info["nodes"]
+    assert b.stats.get("warm_starts", 0) == 1
+    waves += _run_tracked(b, cache_b, rest, store)
+    return b, cache_b, waves
+
+
+@pytest.mark.upgrade
+def test_warm_start_parity_single_chip(tmp_path):
+    """checkpoint_mirror -> warm_start mid-stream: the restarted
+    single-chip backend must place every remaining wave bit-identically
+    to the never-restarted control, and the from-scratch re-encode
+    oracle must agree with its adopted tensors."""
+    ops = build_ops(42, rounds=3, base_nodes=9, constraint_pods=True)
+    control = TPUBatchBackend(small_caps(), batch_size=16)
+    _, control_waves = run_scenario(control, ops)
+
+    make = lambda: TPUBatchBackend(small_caps(), batch_size=16)  # noqa: E731
+    b, cache_b, waves = _restart_through_checkpoint(
+        make, ops, 2, str(tmp_path / "single.ckpt"))
+    assert waves == control_waves
+    assert_full_reencode_parity(b, cache_b)
+
+
+@pytest.mark.upgrade
+@pytest.mark.slow
+def test_warm_start_parity_sharded(tmp_path):
+    """The same restart contract on the sharded lineage (per-lineage
+    control: equal-score ties break differently across lineages)."""
+    from kubernetes_tpu.parallel.backend import ShardedTPUBatchBackend
+
+    ops = build_ops(17, rounds=5, base_nodes=10)
+    control = ShardedTPUBatchBackend(small_caps(), batch_size=16)
+    _, control_waves = run_scenario(control, ops)
+
+    make = lambda: ShardedTPUBatchBackend(small_caps(), batch_size=16)  # noqa: E731
+    b, cache_b, waves = _restart_through_checkpoint(
+        make, ops, 2, str(tmp_path / "sharded.ckpt"))
+    assert waves == control_waves
+    assert_full_reencode_parity(b, cache_b)
+
+
+@pytest.mark.upgrade
+@pytest.mark.slow
+def test_warm_start_parity_seam(tmp_path):
+    """The grpc-seam lineage: the restarted client warm-starts its host
+    mirror from the checkpoint and rebuilds the (fresh) worker's device
+    state from it — against a control that never restarted and a
+    DIFFERENT worker process, so nothing can leak through the seam."""
+    from kubernetes_tpu.ops.remote import DeviceWorker, RemoteTPUBatchBackend
+
+    ops = build_ops(29, rounds=5, base_nodes=10)
+    control = TPUBatchBackend(small_caps(), batch_size=16)
+    _, control_waves = run_scenario(control, ops)
+
+    workers = []
+
+    def make():
+        w = DeviceWorker().start()
+        workers.append(w)
+        return RemoteTPUBatchBackend(w.url, small_caps(), batch_size=16)
+
+    try:
+        b, cache_b, waves = _restart_through_checkpoint(
+            make, ops, 2, str(tmp_path / "seam.ckpt"))
+        assert waves == control_waves
+        assert_full_reencode_parity(b, cache_b)
+    finally:
+        for w in workers:
+            w.stop()
+
+
+@pytest.mark.upgrade
+@pytest.mark.slow
+def test_warm_start_portable_across_lineages(tmp_path):
+    """The checkpoint payload is host-only (device state rebuilds
+    per-lineage), so a single-chip checkpoint warm-starts a sharded
+    backend: every row adopts by content digest and the from-scratch
+    oracle agrees with the adopted tensors."""
+    from kubernetes_tpu.parallel.backend import ShardedTPUBatchBackend
+
+    ops = build_ops(8, rounds=4, base_nodes=10)
+    first, rest = _split_at_wave(ops, 2)
+    a = TPUBatchBackend(small_caps(), batch_size=16)
+    cache_a = Cache()
+    store: dict = {"nodes": {}, "pods": []}
+    _run_tracked(a, cache_a, first, store)
+    path = str(tmp_path / "cross.ckpt")
+    info = a.checkpoint_mirror(
+        path, snapshot=cache_a.flatten_view(),
+        objects={"nodes": [copy.deepcopy(n)
+                           for n in store["nodes"].values()],
+                 "pods": [copy.deepcopy(p) for p in store["pods"]]})
+    b = ShardedTPUBatchBackend(small_caps(), batch_size=16)
+    warm = b.warm_start(path)
+    assert warm["lineage"] == "tpu"  # informational, not a gate
+    cache_b = Cache()
+    for n in warm["objects"]["nodes"]:
+        cache_b.add_node(n)
+    for p in warm["objects"]["pods"]:
+        cache_b.add_pod(p)
+    b.warm_align(cache_b.flatten_view())
+    assert b.stats.get("warm_adopted", 0) == info["nodes"]
+    _run_tracked(b, cache_b, rest, store)
+    assert_full_reencode_parity(b, cache_b)
+
+
+@pytest.mark.upgrade
+def test_checkpoint_rejects_never_corrupts(tmp_path):
+    """Stale, corrupt or mismatched checkpoints raise CheckpointError
+    BEFORE any backend state moves: the cold start that follows places
+    bit-identically to a backend that never saw a checkpoint."""
+    from kubernetes_tpu.ops.backend import (
+        CHECKPOINT_MAGIC, CHECKPOINT_SCHEMA_VERSION, CheckpointError)
+    from kubernetes_tpu.ops.flatten import Caps
+
+    ops = build_ops(3, rounds=3, base_nodes=8)
+    control = TPUBatchBackend(small_caps(), batch_size=16)
+    _, control_waves = run_scenario(control, ops)
+
+    donor = TPUBatchBackend(small_caps(), batch_size=16)
+    cache, _ = run_scenario(donor, ops)[0], None
+    path = str(tmp_path / "donor.ckpt")
+    donor.checkpoint_mirror(path, snapshot=cache.flatten_view())
+    raw = open(path, "rb").read()
+    hlen = len(CHECKPOINT_MAGIC) + 8
+
+    cases = {
+        "bad magic": b"NOTACKPT" + raw[len(CHECKPOINT_MAGIC):],
+        "schema bump": (CHECKPOINT_MAGIC
+                        + (CHECKPOINT_SCHEMA_VERSION + 1).to_bytes(4, "big")
+                        + raw[len(CHECKPOINT_MAGIC) + 4:]),
+        "crc corrupt": raw[:-8] + bytes(8),
+        "truncated": raw[:hlen - 2],
+    }
+    for label, blob in cases.items():
+        bad = str(tmp_path / "bad.ckpt")
+        with open(bad, "wb") as f:
+            f.write(blob)
+        victim = TPUBatchBackend(small_caps(), batch_size=16)
+        with pytest.raises(CheckpointError):
+            victim.warm_start(bad)
+        assert not victim._warm_pending, label
+        _, waves = run_scenario(victim, ops)
+        assert waves == control_waves, f"{label}: cold fallback diverged"
+
+    # caps mismatch: same container shape class, different capacity
+    other = TPUBatchBackend(
+        Caps(n_cap=64, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+             s_cap=2, sg_cap=8, asg_cap=8), batch_size=16)
+    with pytest.raises(CheckpointError):
+        other.warm_start(path)
+    # missing file: plain cold-start error, no state touched
+    with pytest.raises(CheckpointError):
+        TPUBatchBackend(small_caps(), batch_size=16).warm_start(
+            str(tmp_path / "nope.ckpt"))
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", [101, 202, 303])
 def test_churn_parity_large_tier(seed):

@@ -7,6 +7,7 @@ certificates,ttl,nodeipam,bootstrap,volume}.
 """
 
 import base64
+import importlib.util
 import time
 
 import pytest
@@ -21,6 +22,10 @@ from kubernetes_tpu.client.clientset import (
 from kubernetes_tpu.controllers import ControllerManager
 from kubernetes_tpu.store import kv
 from kubernetes_tpu.testing import make_node, make_pod, wait_for
+
+requires_crypto = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="CSR signing/root CA need the cryptography package")
 
 
 @pytest.fixture
@@ -132,6 +137,7 @@ class TestReplicationController:
             if meta.deletion_timestamp(p) is None]) == 1)
 
 
+@requires_crypto
 class TestCertificates:
     def _make_csr_pem(self):
         from cryptography import x509
@@ -190,6 +196,7 @@ class TestTTLAndRootCA:
                                  .get("annotations") or {})
                         .get("node.alpha.kubernetes.io/ttl") == "0")
 
+    @requires_crypto
     def test_root_ca_configmap_published(self, cluster):
         _, client, _ = cluster
         client.create(NAMESPACES, meta.new_object("Namespace", "team-a", None))

@@ -913,3 +913,46 @@ def test_unknown_rule_name_raises(tmp_path):
     ctx = make_ctx(tmp_path, {"a.py": "X = 1\n"})
     with pytest.raises(KeyError):
         run_lint(ctx, rule_names=["no-such-rule"])
+
+
+def test_checkpoint_versioned_fires_and_clean(tmp_path):
+    import zlib
+    digest_ab = zlib.crc32(b"a,b")
+
+    ctx = make_ctx(tmp_path / "novers", {"a.py": """\
+        CHECKPOINT_FIELDS = ("a", "b")
+        """})
+    found = run_rule(ctx, "checkpoint-versioned")
+    assert len(found) == 1 and "version-gated" in found[0].message
+
+    ctx = make_ctx(tmp_path / "noann", {"a.py": """\
+        CHECKPOINT_FIELDS = ("a", "b")
+        CHECKPOINT_SCHEMA_VERSION = 1
+        """})
+    found = run_rule(ctx, "checkpoint-versioned")
+    assert len(found) == 1 and "schema-digest" in found[0].message
+
+    # fields edited without a version bump: the digest no longer matches
+    ctx = make_ctx(tmp_path / "stale", {"a.py": f"""\
+        CHECKPOINT_FIELDS = ("a", "b", "c")
+        # schema-digest: {digest_ab}@v1
+        CHECKPOINT_SCHEMA_VERSION = 1
+        """})
+    found = run_rule(ctx, "checkpoint-versioned")
+    assert len(found) == 1 and "bump" in found[0].message
+
+    # version constant moved but the annotation wasn't refreshed
+    ctx = make_ctx(tmp_path / "vmismatch", {"a.py": f"""\
+        CHECKPOINT_FIELDS = ("a", "b")
+        # schema-digest: {digest_ab}@v1
+        CHECKPOINT_SCHEMA_VERSION = 2
+        """})
+    found = run_rule(ctx, "checkpoint-versioned")
+    assert len(found) == 1 and "refresh" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {"a.py": f"""\
+        CHECKPOINT_FIELDS = ("a", "b")
+        # schema-digest: {digest_ab}@v1
+        CHECKPOINT_SCHEMA_VERSION = 1
+        """})
+    assert run_rule(ctx, "checkpoint-versioned") == []

@@ -8,6 +8,9 @@ real TLS wire with real client certs.
 
 import pytest
 
+pytest.importorskip("cryptography",
+                    reason="ClusterCA/TLS need the cryptography package")
+
 from kubernetes_tpu.api import meta
 from kubernetes_tpu.apiserver import APIServer
 from kubernetes_tpu.apiserver import authn as authnlib

@@ -8,6 +8,7 @@ Reference semantics:
   (tools/cache/reflector.go:256).
 """
 
+import importlib.util
 import json
 import os
 import signal
@@ -20,6 +21,10 @@ import pytest
 from kubernetes_tpu.api import meta
 from kubernetes_tpu.store import kv, wal
 from kubernetes_tpu.testing import make_node, make_pod
+
+requires_crypto = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="KMS sealing needs the cryptography package")
 
 
 def reopen(tmp_path, **kw):
@@ -136,6 +141,7 @@ class TestWALRecovery:
         r = reopen(tmp_path)
         assert r.count("nodes") == 1
 
+    @requires_crypto
     def test_kms_keys_survive_restart_with_key_file(self, tmp_path):
         from kubernetes_tpu.store.encryption import (EnvelopeTransformer,
                                                      LocalKMS)
@@ -169,6 +175,7 @@ class TestWALRecovery:
         r = reopen(tmp_path)
         assert r.count("nodes") == 2
 
+    @requires_crypto
     def test_encrypted_resources_stay_sealed_on_disk(self, tmp_path):
         from kubernetes_tpu.store.encryption import (EnvelopeTransformer,
                                                      LocalKMS)

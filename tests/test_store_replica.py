@@ -8,6 +8,7 @@ every acknowledged write is present; informers pointed at the follower
 relist and resume.
 """
 
+import importlib.util
 import threading
 
 import pytest
@@ -17,6 +18,10 @@ from kubernetes_tpu.client import LocalClient, SharedInformerFactory
 from kubernetes_tpu.store import kv
 from kubernetes_tpu.store.replica import FollowerStore, ReplicationHub
 from kubernetes_tpu.testing import make_pod, wait_for
+
+requires_crypto = pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="KMS sealing needs the cryptography package")
 
 
 def mkpair(**hub_kw):
@@ -182,6 +187,7 @@ class TestFailover:
         assert "post-promote" in names
         assert {f"dur-{i}" for i in range(25)} <= names
 
+    @requires_crypto
     def test_sealed_resource_tombstones_ship_metadata_only(self):
         """Deleting an encrypted-at-rest resource must not ship its
         plaintext body over the replication link."""

@@ -1,0 +1,254 @@
+"""Zero-downtime operations: rolling upgrades of the live topology,
+the /readyz-vs-/healthz split, drain escalation, and config hot-reload.
+
+The process-true counterpart of the checkpoint parity tests in
+test_churn_parity.py: a real apiserver process plus scheduler children,
+cycled drain -> respawn -> readiness by the seeded UpgradeSchedule while
+pods stream over the wire — exactly-once binding proved by the
+WireBindLedger through every roll, including one sabotaged with a
+mid-drain SIGKILL (the hung child the drain escalation must absorb).
+
+Tier-1 runs the shrunk 2-process pass; the full matrix (3 children +
+warm-start checkpoints + apiserver handoff over the WAL) is slow.
+"""
+
+import http.server
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.client.clientset import NODES, PODS
+from kubernetes_tpu.ops.faults import (
+    ROLL_INSTANCE, UpgradeDriver, UpgradeSchedule)
+from kubernetes_tpu.scheduler.procrun import (
+    ProcCluster, WireBindLedger, _ChildHTTP)
+from kubernetes_tpu.testing import make_node, make_pod
+
+pytestmark = pytest.mark.upgrade
+
+
+def wait_for(pred, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def fill_cluster(admin, nodes: int):
+    for i in range(nodes):
+        admin.create(NODES, make_node(f"n{i}")
+                     .capacity(cpu="16", mem="64Gi", pods=110).build())
+
+
+def submit_pods(admin, count: int, offset: int = 0):
+    for i in range(offset, offset + count):
+        admin.create(PODS, make_pod(f"p{i}")
+                     .req(cpu="100m", mem="128Mi").build())
+
+
+class TestUpgradeSchedule:
+    def test_seeded_stream_stability(self):
+        """Scripted entries win without consuming extra draws, so adding
+        one never shifts the sabotage decisions around it."""
+        plain = UpgradeSchedule(seed=5, instance_count=3,
+                                sabotage_rate=0.5)
+        scripted = UpgradeSchedule(seed=5, instance_count=3,
+                                   sabotage_rate=0.5,
+                                   script={1: (ROLL_INSTANCE, 2, True)})
+        a = [plain.action(i) for i in range(6)]
+        b = [scripted.action(i) for i in range(6)]
+        assert b[1] == (ROLL_INSTANCE, 2, True)
+        assert [x for i, x in enumerate(a) if i != 1] \
+            == [x for i, x in enumerate(b) if i != 1]
+        # round-robin roll order regardless of the draws
+        assert [idx for _, idx, _ in a] == [0, 1, 2, 0, 1, 2]
+
+
+class TestReadyzSplit:
+    """The child endpoint contract, tested against the real handler with
+    a stub scheduler: /healthz is pure liveness (200 while the process
+    serves), /readyz is readiness (503 while draining or fenced)."""
+
+    @pytest.fixture
+    def endpoint(self):
+        class _Scaleout:
+            self_live = True
+
+        class _Sched:
+            scaleout = _Scaleout()
+
+            def expose_metrics(self):
+                return "stub_metric 1\n"
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                 _ChildHTTP)
+        server.sched = _Sched()
+        server.draining = False
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        yield server
+        server.shutdown()
+
+    def _get(self, server, path):
+        url = f"http://127.0.0.1:{server.server_address[1]}{path}"
+        try:
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_live_and_ready(self, endpoint):
+        assert self._get(endpoint, "/healthz") == (200, b"ok")
+        assert self._get(endpoint, "/readyz") == (200, b"ok")
+
+    def test_fenced_fails_readiness_not_liveness(self, endpoint):
+        endpoint.sched.scaleout.self_live = False
+        assert self._get(endpoint, "/readyz") == (503, b"fenced")
+        assert self._get(endpoint, "/healthz") == (200, b"ok")
+
+    def test_draining_fails_readiness_not_liveness(self, endpoint):
+        endpoint.draining = True
+        assert self._get(endpoint, "/readyz") == (503, b"draining")
+        assert self._get(endpoint, "/healthz") == (200, b"ok")
+
+
+@pytest.mark.proc
+class TestRollingUpgrade:
+    def test_rolling_restart_exactly_once(self, proc_reaper):
+        """The tier-1 keeper: roll both children while pods stream, with
+        the first roll sabotaged by a mid-drain SIGKILL.  The escalation
+        counter records it, the roll completes anyway, and every pod —
+        submitted before, during and after the roll — binds exactly
+        once."""
+        cluster = ProcCluster(2, nodes=8,
+                              lease_duration=1.0, renew_interval=0.2)
+        proc_reaper(cluster)
+        cluster.start()
+        admin = cluster.admin_client()
+        fill_cluster(admin, 8)
+        ledger = WireBindLedger(admin)
+        submit_pods(admin, 20)
+        assert wait_for(lambda: ledger.bound_total() >= 10)
+
+        driver = UpgradeDriver(
+            cluster,
+            UpgradeSchedule(seed=11, instance_count=2,
+                            script={0: (ROLL_INSTANCE, 0, True)}),
+            drain_timeout=20.0)
+        assert driver.step() == (ROLL_INSTANCE, 0)  # sabotaged
+        assert cluster.drain_escalations == 1
+        assert ("scheduler_proc_drain_escalated_total 1.0"
+                in cluster.supervisor_metrics_text())
+        submit_pods(admin, 20, offset=20)  # pods stream mid-roll
+        assert driver.step() == (ROLL_INSTANCE, 1)  # graceful
+        assert driver.injected[ROLL_INSTANCE] == 2
+        assert driver.injected["sabotaged"] == 1
+        assert sorted(cluster.live_indices()) == [0, 1]
+
+        submit_pods(admin, 20, offset=40)
+        assert wait_for(lambda: ledger.bound_total() >= 60), \
+            f"only {ledger.bound_total()}/60 bound through the roll"
+        ledger.assert_no_double_binds()
+        assert ledger.bound_total() == 60  # zero lost
+        ledger.stop()
+
+    def test_hot_reload_over_sighup(self, proc_reaper, tmp_path):
+        """SIGHUP makes the child re-read --config: a valid edit applies
+        without restart (the reload counter moves in its /metrics), an
+        invalid one is rejected with the child alive and the old config
+        kept live."""
+        cfg = tmp_path / "sched.yaml"
+        cfg.write_text("kind: KubeSchedulerConfiguration\n"
+                       "overload: {queueCap: 512}\n")
+        cluster = ProcCluster(1, nodes=4, config_path=str(cfg))
+        proc_reaper(cluster)
+        cluster.start()
+
+        def reload_counts():
+            texts = cluster.metrics_texts()
+            out = {"applied": 0, "rejected": 0}
+            for line in "".join(texts).splitlines():
+                if line.startswith("scheduler_config_reload_total{"):
+                    for k in out:
+                        if f'result="{k}"' in line:
+                            out[k] = float(line.rsplit(" ", 1)[1])
+            return out
+
+        assert reload_counts()["applied"] == 1  # boot-time apply
+
+        cfg.write_text("kind: KubeSchedulerConfiguration\n"
+                       "overload: {queueCap: 1024, sloP99Ms: 100}\n")
+        assert cluster.hot_reload() == [0]
+        assert wait_for(lambda: reload_counts()["applied"] >= 2,
+                        timeout=15.0), reload_counts()
+
+        cfg.write_text("kind: KubeSchedulerConfiguration\n"
+                       "overload: {queueCap: -7}\n")
+        cluster.hot_reload()
+        assert wait_for(lambda: reload_counts()["rejected"] >= 1,
+                        timeout=15.0), reload_counts()
+        assert cluster.alive(0)  # rejected reload never kills the child
+        # old config still live: a further valid reload still lands
+        cfg.write_text("kind: KubeSchedulerConfiguration\n"
+                       "overload: {queueCap: 256}\n")
+        cluster.hot_reload()
+        assert wait_for(lambda: reload_counts()["applied"] >= 3,
+                        timeout=15.0), reload_counts()
+        assert cluster.drain(0) == 0
+
+
+@pytest.mark.proc
+@pytest.mark.slow
+class TestFullUpgradeMatrix:
+    def test_warm_roll_with_handoff(self, proc_reaper, tmp_path):
+        """The full matrix: 3 children with a device backend and a warm
+        checkpoint dir over a WAL-backed apiserver.  Roll everything
+        while pods stream, hand the apiserver off mid-stream, roll
+        again (this time warm-starting from the drain checkpoints) —
+        exactly-once through all of it."""
+        cluster = ProcCluster(
+            3, nodes=8, backend="tpu", batch_size=64,
+            lease_duration=1.5, renew_interval=0.25,
+            warm_dir=str(tmp_path / "warm"),
+            data_dir=str(tmp_path / "wal"))
+        import os
+        os.makedirs(cluster.warm_dir, exist_ok=True)
+        proc_reaper(cluster)
+        cluster.start()
+        admin = cluster.admin_client()
+        fill_cluster(admin, 8)
+        ledger = WireBindLedger(admin)
+        submit_pods(admin, 30)
+        assert wait_for(lambda: ledger.bound_total() >= 15)
+
+        driver = UpgradeDriver(
+            cluster, UpgradeSchedule(seed=23, instance_count=3),
+            drain_timeout=30.0, ready_timeout=120.0)
+        rolled = driver.roll_all()
+        assert [idx for _, idx in rolled] == [0, 1, 2]
+        # every drain cut a checkpoint for its successor
+        for i in range(3):
+            assert (tmp_path / "warm" / f"sched-{i}.ckpt").exists()
+
+        submit_pods(admin, 30, offset=30)
+        cluster.handoff_apiserver()
+        assert wait_for(lambda: ledger.bound_total() >= 60, timeout=120.0)
+
+        # second roll warm-starts from the checkpoints the first cut
+        driver.roll_all()
+        warm_logs = [ln for i in range(3)
+                     for ln in cluster._children[i].tail(60)
+                     if "warm start:" in ln]
+        assert warm_logs, "no child logged a warm start on the second roll"
+
+        submit_pods(admin, 30, offset=60)
+        assert wait_for(lambda: ledger.bound_total() >= 90, timeout=120.0), \
+            f"only {ledger.bound_total()}/90 bound"
+        ledger.assert_no_double_binds()
+        assert ledger.bound_total() == 90
+        ledger.stop()

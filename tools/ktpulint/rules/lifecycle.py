@@ -141,3 +141,97 @@ class RetryBackoffRule(Rule):
                         view, n.lineno,
                         "generic-except retry loop without a backoff/sleep "
                         "in the handler")
+
+
+_SCHEMA_DIGEST_RE = re.compile(r"#\s*schema-digest:\s*(\d+)@v(\d+)")
+
+
+@register
+class CheckpointVersionedRule(Rule):
+    """CHECKPOINT_FIELDS and CHECKPOINT_SCHEMA_VERSION must move
+    together: the `# schema-digest: <crc32>@v<version>` annotation above
+    the version constant pins the field tuple's content digest to the
+    version that serializes it.  Editing the fields without bumping the
+    version ships checkpoints that pass the version gate and then
+    deserialize into the wrong slots — the warm-start loader can only
+    fall back to cold when the header version actually changes."""
+
+    name = "checkpoint-versioned"
+    doc = "checkpointed-state field tuples carry a version-pinned schema digest"
+
+    _FIELDS = "CHECKPOINT_FIELDS"
+    _VERSION = "CHECKPOINT_SCHEMA_VERSION"
+
+    @staticmethod
+    def _const_assigns(tree: ast.Module):
+        """(name, value_node, line) for module-level single-Name assigns."""
+        for n in tree.body:
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                yield n.targets[0].id, n.value, n.lineno
+
+    def _annotation(self, view: FileView, line: int):
+        """The schema-digest annotation on `line` or in the contiguous
+        comment block directly above it: (digest, version) or None."""
+        ln = line
+        while 1 <= ln <= len(view.lines):
+            m = _SCHEMA_DIGEST_RE.search(view.lines[ln - 1])
+            if m:
+                return int(m.group(1)), int(m.group(2))
+            ln -= 1
+            if not (1 <= ln <= len(view.lines)) \
+                    or not view.lines[ln - 1].lstrip().startswith("#"):
+                break
+        return None
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if view.tree is None:
+            return
+        fields: dict[str, tuple[tuple[str, ...], int]] = {}
+        versions: dict[str, tuple[int, int]] = {}
+        for name, value, line in self._const_assigns(view.tree):
+            if name.endswith(self._FIELDS) \
+                    and isinstance(value, ast.Tuple) \
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str) for e in value.elts):
+                prefix = name[: -len(self._FIELDS)]
+                fields[prefix] = (
+                    tuple(e.value for e in value.elts), line)
+            elif name.endswith(self._VERSION) \
+                    and isinstance(value, ast.Constant) \
+                    and isinstance(value.value, int):
+                versions[name[: -len(self._VERSION)]] = (value.value, line)
+        import zlib
+        for prefix, (names, line) in fields.items():
+            ver = versions.get(prefix)
+            if ver is None:
+                yield self.finding(
+                    view, line,
+                    f"{prefix}{self._FIELDS} has no matching "
+                    f"{prefix}{self._VERSION} int constant — checkpointed "
+                    "state must be version-gated")
+                continue
+            version, vline = ver
+            want = zlib.crc32(",".join(names).encode())
+            ann = self._annotation(view, vline)
+            if ann is None:
+                yield self.finding(
+                    view, vline,
+                    f"{prefix}{self._VERSION} lacks a `# schema-digest: "
+                    f"{want}@v{version}` annotation pinning the field "
+                    "tuple to this version")
+                continue
+            got_digest, got_version = ann
+            if got_version != version:
+                yield self.finding(
+                    view, vline,
+                    f"schema-digest annotation says v{got_version} but "
+                    f"{prefix}{self._VERSION} is {version} — refresh the "
+                    f"annotation to `# schema-digest: {want}@v{version}`")
+            elif got_digest != want:
+                yield self.finding(
+                    view, vline,
+                    f"{prefix}{self._FIELDS} changed (digest {want}, "
+                    f"annotation pins {got_digest}): bump "
+                    f"{prefix}{self._VERSION} and refresh the annotation "
+                    f"to `# schema-digest: {want}@v{version + 1}`")
