@@ -575,6 +575,82 @@ def run_profile(out_path: str | None = None) -> dict:
     }
 
 
+def run_timeline(out_path: str | None = None) -> dict:
+    """--timeline mode: the wave-timeline observatory read-out.
+
+    One SchedulingBasicLarge pass with `profiling.timeline` armed (the
+    interval ring + per-pod decomposition), then the identical pass with
+    it off to pin the recording overhead honestly (the acceptance bar is
+    ≤5%, enforced by tests/test_timeline.py on a null-device workload).
+    Writes the TIMELINE artifact: the Perfetto-loadable Chrome trace of
+    the ring plus the summary (union-derived device idle share, per-stage
+    overlap ratios, per-segment latency quantiles)."""
+    import copy
+
+    from kubernetes_tpu.component_base import timeline as cb_timeline
+    from kubernetes_tpu.perf import (
+        caps_for_nodes, load_workloads, run_named_workload,
+    )
+    from kubernetes_tpu.perf.scheduler_perf import is_measured
+    from kubernetes_tpu.scheduler.config import ProfilingPolicy
+
+    nodes = int(os.environ.get("BENCH_TIMELINE_NODES", "1000"))
+    pods = int(os.environ.get("BENCH_TIMELINE_PODS", "5000"))
+    batch = int(os.environ.get("BENCH_TIMELINE_BATCH", "1024"))
+    out_path = out_path or os.environ.get(
+        "BENCH_TIMELINE_OUT", "timeline_SchedulingBasicLarge.json")
+
+    def build_cfg() -> dict:
+        cfg = copy.deepcopy(load_workloads()["SchedulingBasicLarge"])
+        tpl = cfg["workloadTemplate"]
+        for op in tpl:
+            if op["opcode"] == "createNodes":
+                op["count"] = nodes
+            elif op["opcode"] == "createPods" and is_measured(op, tpl):
+                op["count"] = pods
+            elif op["opcode"] == "barrier":
+                op["timeout"] = 600.0
+        return cfg
+
+    caps = caps_for_nodes(nodes)
+    policy = ProfilingPolicy(timeline=True)
+    summary_t, stats_t = run_named_workload(
+        build_cfg(), tpu=True, caps=caps, batch_size=batch,
+        pipeline_depth=2, profiling_policy=policy)
+    # snapshot the trace BEFORE the off-side pass disarms the ring
+    trace_doc = cb_timeline.default_timeline.to_chrome_trace()
+    summary_u, _ = run_named_workload(
+        build_cfg(), tpu=True, caps=caps, batch_size=batch,
+        pipeline_depth=2)
+
+    tl = stats_t.get("timeline") or {}
+    e2e = stats_t.get("e2e") or {}
+    row = {
+        "nodes": nodes, "pods": pods, "batch": batch,
+        "pods_per_s": round(summary_t.average, 1),
+        "p50_ms": e2e.get("p50_ms"), "p95_ms": e2e.get("p95_ms"),
+        "p99_ms": e2e.get("p99_ms"),
+        "device_idle_share": tl.get("device_idle_share"),
+        "stage_overlap": tl.get("overlap"),
+        "latency_decomposition": tl.get("segments"),
+        "timeline_intervals": tl.get("intervals"),
+        "pods_decomposed": tl.get("pods_decomposed"),
+    }
+    with open(out_path, "w") as f:
+        json.dump({"rows": [row], "chrome_trace": trace_doc}, f, indent=1)
+
+    timed = summary_t.average
+    untimed = summary_u.average
+    return {
+        **row,
+        "timeline_file": os.path.abspath(out_path),
+        "timed_pods_per_s": round(timed, 1),
+        "untimed_pods_per_s": round(untimed, 1),
+        "overhead_ratio": round(untimed / max(timed, 1e-9), 3),
+        "barrier_ok": stats_t.get("barrier_ok", False),
+    }
+
+
 def run_overload() -> dict:
     """--overload mode: the SchedulingOverloadFlood workload under the
     seeded chaos schedule, A/B WITH the overload policy (bounded
@@ -1051,7 +1127,7 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
              admission_ms: float = 0.0, via_http: bool = False,
              null_device: bool = False, pct_nodes: int = 0,
              overload: bool = False, backend_kind: str = "tpu",
-             census: bool = False) -> dict:
+             census: bool = False, timeline: bool = False) -> dict:
     """One full workload pass in this process; returns the result dict."""
     import copy
 
@@ -1083,12 +1159,14 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
     if overload:
         policy, chaos = _overload_shape(batch)
     profiling_policy = None
-    if census:
+    if census or timeline:
         # census=True arms run_device_census() after warmup so the row
         # carries tpu_wave_collective_bytes — the in-band pin of the
-        # collective-byte budget (bit-for-bit vs tools/collective_census.py)
+        # collective-byte budget (bit-for-bit vs tools/collective_census.py).
+        # timeline=True arms the wave-timeline interval ring so the row
+        # carries device_idle_share + the per-pod latency decomposition.
         from kubernetes_tpu.scheduler.config import ProfilingPolicy
-        profiling_policy = ProfilingPolicy(census=True)
+        profiling_policy = ProfilingPolicy(census=census, timeline=timeline)
     t0 = time.monotonic()
     summary, stats = run_named_workload(cfg, tpu=True, caps=caps,
                                         batch_size=batch,
@@ -1155,6 +1233,15 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
                     "tpu_step_collective_bytes": per_call,
                 }
         detail["tpu_wave_collective_bytes"] = gauges
+    tl_stats = stats.get("timeline")
+    if tl_stats:
+        # wave-timeline read-out: the union-derived idle share (correct
+        # under pipelining, unlike 1 - Σ stage_seconds / wall), per-stage
+        # overlap ratios and the telescoped per-pod segment quantiles
+        detail["device_idle_share"] = tl_stats.get("device_idle_share")
+        detail["stage_overlap"] = tl_stats.get("overlap")
+        detail["latency_decomposition"] = tl_stats.get("segments")
+        detail["timeline_intervals"] = tl_stats.get("intervals")
     return {"value": summary.average, "wall_s": round(wall, 1),
             "detail": detail}
 
@@ -1220,7 +1307,8 @@ def child_main() -> None:
                    pct_nodes=int(os.environ.get("_BENCH_W_PCT", "0")),
                    overload=os.environ.get("_BENCH_W_OVERLOAD") == "1",
                    backend_kind=os.environ.get("_BENCH_W_BACKEND", "tpu"),
-                   census=os.environ.get("_BENCH_W_CENSUS") == "1")
+                   census=os.environ.get("_BENCH_W_CENSUS") == "1",
+                   timeline=os.environ.get("_BENCH_W_TIMELINE") == "1")
     if "error" in res:
         emit(0.0, {"error": res["error"], **res["detail"]})
         sys.exit(1)
@@ -1269,6 +1357,8 @@ def _config_env(c: dict) -> dict:
         env["_BENCH_W_BACKEND"] = c["backend"]
     if c.get("census"):
         env["_BENCH_W_CENSUS"] = "1"
+    if c.get("timeline"):
+        env["_BENCH_W_TIMELINE"] = "1"
     return env
 
 
@@ -1297,6 +1387,16 @@ def main() -> None:
                and not sys.argv[idx + 1].startswith("-") else None)
         res = run_profile(out)
         emit(res["profiled_pods_per_s"], {"mode": "profile", **res})
+        return
+    if "--timeline" in sys.argv:
+        # in-process A/B by design (same trade as --profile): the armed
+        # and disarmed sides share one warmed interpreter + device so
+        # the ring-overhead ratio isn't polluted by a second cold start
+        idx = sys.argv.index("--timeline")
+        out = (sys.argv[idx + 1] if len(sys.argv) > idx + 1
+               and not sys.argv[idx + 1].startswith("-") else None)
+        res = run_timeline(out)
+        emit(res["timed_pods_per_s"], {"mode": "timeline", **res})
         return
     if "--overload" in sys.argv:
         # in-process A/B by design (same trade as --trace): both sides
@@ -1364,7 +1464,8 @@ def main() -> None:
     if n_runs == 1:
         res = run_once("SchedulingBasicLarge", head_nodes, head_pods, BATCH,
                        barrier_timeout=1800.0, depth=DEPTH,
-                       backend_kind=backend_kind, census=True)
+                       backend_kind=backend_kind, census=True,
+                       timeline=True)
         if "error" in res:
             emit(0.0, {"error": res["error"], "nodes": head_nodes,
                        "pods": head_pods, **res["detail"]})
@@ -1380,7 +1481,8 @@ def main() -> None:
     # the 100k tier's budget note on EXTRA_CONFIGS applies doubly here.
     head_cfg = {"workload": "SchedulingBasicLarge", "nodes": head_nodes,
                 "pods": head_pods, "batch": BATCH, "depth": DEPTH,
-                "timeout": 1800.0, "backend": backend_kind, "census": True}
+                "timeout": 1800.0, "backend": backend_kind, "census": True,
+                "timeline": True}
     head = _spawn_child(_config_env(head_cfg), timeout=2100.0)
     if head is None:
         emit(0.0, {"error": "bench headline child failed twice"})

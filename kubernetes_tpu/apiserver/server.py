@@ -652,6 +652,24 @@ class APIServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if path.startswith("/debug/timeline"):
+                    # wave timeline observatory: stage intervals + the
+                    # union-derived idle share from the process-wide ring
+                    # (component_base/timeline.py); ?format=chrome yields
+                    # a Perfetto-loadable Chrome trace, default is JSON.
+                    # Empty/disabled when profiling.timeline is off.
+                    from ..component_base import timeline as cb_timeline
+                    tl = cb_timeline.default_timeline
+                    if r.query.get("format", [""])[0] == "chrome":
+                        body = json.dumps(tl.to_chrome_trace()).encode()
+                    else:
+                        body = tl.debug_json().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if path == "/debug/traces":
                     # recent batch traces from the process-wide flight
                     # recorder (component_base/tracing.py); empty list

@@ -221,6 +221,39 @@ class Histogram(_Metric):
             s[1] += sum(values)
             s[2] += len(values)
 
+    def observe_array(self, values, *label_values: str) -> None:
+        """Vectorized observe_many for numpy arrays (the timeline's
+        per-pod decomposition feeds thousands of samples per wave; a
+        per-value bisect there is the difference between a ≤5% and a
+        ~15% armed-recording overhead).  Plain sequences fall through
+        to observe_many."""
+        try:
+            import numpy as np
+        except ImportError:
+            self.observe_many(list(values), *label_values)
+            return
+        arr = np.asarray(values, dtype=float)
+        if arr.size == 0:
+            return
+        for v in label_values:
+            if type(v) is not str:
+                label_values = tuple(str(x) for x in label_values)
+                break
+        nb = len(self.buckets)
+        # side="left" matches observe_many's bisect_left exactly
+        idx = np.searchsorted(self.buckets, arr, side="left")
+        binc = np.bincount(idx[idx < nb], minlength=nb)
+        total = float(arr.sum())
+        with self._lock:
+            s = self._series.get(label_values)
+            if s is None:
+                s = self._series[label_values] = [[0] * nb, 0.0, 0]
+            counts = s[0]
+            for j in binc.nonzero()[0]:
+                counts[j] += int(binc[j])
+            s[1] += total
+            s[2] += int(arr.size)
+
     def labels(self, *label_values: str) -> "_BoundHistogram":
         return _BoundHistogram(self, tuple(str(v) for v in label_values))
 

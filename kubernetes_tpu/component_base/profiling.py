@@ -230,17 +230,35 @@ _STAGE_PATTERNS: tuple[tuple[str, str], ...] = (
     ("MainThread", "main"),
 )
 _BINDER_FRAMES = frozenset({"_bulk_bind_commit", "_store_bind",
-                            "bind_many", "_finish_batch"})
+                            "bind_many", "_finish_batch",
+                            # PR 13 decoupled binder: the _BinderWorker
+                            # drain loop and its commit variants run on
+                            # "binder<N>" threads, but a sample caught in
+                            # a shared helper (binding_rows builds the
+                            # wire rows, wait_on_permit blocks on the
+                            # flow-control gate) must still attribute to
+                            # binder work whatever thread it lands on
+                            "_binding_cycle_turbo", "_binding_cycle_bulk",
+                            "wait_on_permit", "binding_rows"})
 # incremental flatten: the two halves of host-side tensor maintenance,
 # carved out by frame like binder work.  Patch frames are checked FIRST —
 # patch_node calls _encode_node, and an event patch should attribute to
 # snapshot.patch even when the sample lands inside the shared encoder.
 _PATCH_FRAMES = frozenset({"note_node_event", "patch_node", "patch_remove",
-                           "compact", "_maybe_compact", "run_locked_node"})
+                           "compact", "_maybe_compact", "run_locked_node",
+                           # PR 15 event-driven row maintenance: group-row
+                           # release/probe and the namespace-mask row
+                           # rewrite run only on the patch path
+                           "_release_row", "_probe_bucket",
+                           "_ns_mask_row_update"})
 _FLATTEN_FRAMES = frozenset({"update_from_snapshot_tracked",
                              "_update_from_dirty", "_update_from_nodes_tracked",
                              "_sync_rows", "_encode_node",
-                             "_encode_dynamic_bulk", "_encode_fresh_bulk"})
+                             "_encode_dynamic_bulk", "_encode_fresh_bulk",
+                             # group registration also runs under
+                             # patch_node, where the patch-first check
+                             # order attributes it to snapshot.patch
+                             "register_sg", "register_asg"})
 
 
 def classify_stage(thread_name: str, co_names: Iterable[str]) -> str:

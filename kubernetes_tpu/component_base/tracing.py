@@ -148,6 +148,10 @@ class Span:
         self.span_id = new_span_id()
         self.attributes: Dict[str, Any] = {}
         self.events: List[tuple] = []
+        # recording thread, captured at creation: the Chrome export
+        # names one tid lane per (process, thread) so Perfetto groups
+        # scheduler-loop vs binder-worker vs server threads
+        self.thread = threading.current_thread().name
         now = time.monotonic()
         self.start_time = start if start is not None else now
         # wall anchor back-dated by the same monotonic offset
@@ -302,22 +306,45 @@ class TracerProvider:
         return json.dumps({"traces": self.recent_traces(limit)}, indent=1)
 
 
+def chrome_trace_doc(events: List[Dict[str, Any]],
+                     process_names: Dict[int, str],
+                     thread_names: Dict[tuple, str]) -> Dict[str, Any]:
+    """Assemble a Chrome trace-event document from data events plus
+    lane names: ``M`` (metadata) records declare every pid as
+    ``process_name`` and every (pid, tid) as ``thread_name``, so
+    Perfetto groups lanes by component (scheduler child, binder
+    worker, device worker) instead of showing bare numeric TIDs.
+    Shared by the span export below and timeline.to_chrome_trace."""
+    meta: List[Dict[str, Any]] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": name}}
+        for pid, name in process_names.items()]
+    meta += [
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+         "args": {"name": name}}
+        for (pid, tid), name in thread_names.items()]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
 def to_chrome_trace(spans: List[Span],
                     pid_attr: str = "process") -> Dict[str, Any]:
     """Render spans as Chrome trace-event JSON (Perfetto-loadable).
 
     Complete ("X") events on microsecond wall timestamps; each process
     (span attribute `pid_attr`, default span.attributes["process"]) gets
-    its own pid lane and each trace its own tid, so one batch reads as
-    one horizontal track with the worker-side spans in a second lane.
-    Span events become instant ("i") events on the same track."""
+    its own pid lane and each recording THREAD its own named tid lane
+    (scheduler loop, binder worker, server threads), so parent-child
+    spans nest on the thread that ran them and worker-side spans land
+    in a second process lane on the same wall clock.  Span events
+    become instant ("i") events on the same track."""
     events: List[Dict[str, Any]] = []
     pids: Dict[str, int] = {}
-    tids: Dict[str, int] = {}
+    tids: Dict[tuple, int] = {}
     for s in spans:
         proc = str(s.attributes.get(pid_attr, "scheduler"))
         pid = pids.setdefault(proc, len(pids) + 1)
-        tid = tids.setdefault(s.trace_id, len(tids) + 1)
+        thread = getattr(s, "thread", "MainThread")
+        tid = tids.setdefault((pid, thread), len(tids) + 1)
         ts_us = s.start_wall * 1e6
         events.append({
             "name": s.name, "ph": "X", "cat": "batch",
@@ -335,9 +362,10 @@ def to_chrome_trace(spans: List[Span],
                 "pid": pid, "tid": tid,
                 "args": dict(attrs),
             })
-    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-             "args": {"name": proc}} for proc, pid in pids.items()]
-    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    return chrome_trace_doc(
+        events,
+        {pid: name for name, pid in pids.items()},
+        {(pid, tid): thr for (pid, thr), tid in tids.items()})
 
 
 # -- current-span propagation ----------------------------------------------

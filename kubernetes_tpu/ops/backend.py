@@ -45,6 +45,7 @@ import numpy as np
 
 from ..api import meta
 from ..component_base import tracing
+from ..component_base.timeline import default_timeline
 from ..models.assign import (
     ALL_FEATURES, PLAIN_FEATURES, STATE_KEYS, PackSpec,
     build_packed_assign_fn, pack_pod_batch,
@@ -1336,8 +1337,11 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                         snapshot))
                     dirty |= self._carry_dirty
                     self._last_epoch = epoch
-                    self.stats["flatten_seconds"] += (
-                        time.monotonic() - t_sync)
+                    t_sync_end = time.monotonic()
+                    self.stats["flatten_seconds"] += t_sync_end - t_sync
+                    if default_timeline.enabled:
+                        # wave timeline: host tensor-maintenance leg
+                        default_timeline.record("patch", t_sync, t_sync_end)
                 batch = self.encoder.encode(list(pod_infos))
             except VocabFullError as e:
                 logger.warning("tensorization overflow (%s); batch -> oracle path", e)
@@ -1468,6 +1472,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                         if parent is not None else None)
             h2d_sp = (parent.tracer.start_span("tpu.h2d", parent=solve_sp)
                       if solve_sp is not None else None)
+            t_h2d = time.monotonic()
             if self._needs_full(batch) and n > self.full_cap:
                 # oversized constraint batch: chunk through the capped
                 # full kernel; resident state chains chunk to chunk, so
@@ -1513,6 +1518,12 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     else "plain")
                 h2d_sp.set_attribute("patched_rows", int(len(patches[0])))
                 h2d_sp.end()
+            # wave timeline: pack + upload + kernel enqueue (for the
+            # remote seam this leg carries the wire round trip, which is
+            # why h2d counts as a device stage in the idle-share union)
+            t_launch = time.monotonic()
+            if default_timeline.enabled:
+                default_timeline.record("h2d", t_h2d, t_launch)
             self.stats["batches"] += 1
             holder = object()
             self._unresolved.append(holder)
@@ -1533,6 +1544,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                           if solve_sp is not None else None)
                 raw = []
                 stale = False
+                t_d2h0 = time.monotonic()
                 for rd, _lo, _hi, _variant, _cbuf, expect in chunks:
                     # sync-point: wave resolve — THE pipeline's d2h pull
                     result = jax.device_get(rd)
@@ -1559,6 +1571,15 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                         # sync-point: recovery re-run resolves in line
                         raw.append(jax.device_get(
                             self._device_step(variant, cbuf)))
+                if default_timeline.enabled:
+                    # wave timeline: device-step spans launch -> results
+                    # landed (recovery re-runs included); d2h is the
+                    # blocking pull inside it — nested on purpose, the
+                    # idle-share union collapses the overlap
+                    t_dev_end = time.monotonic()
+                    default_timeline.record("device-step", t_launch,
+                                            t_dev_end)
+                    default_timeline.record("d2h", t_d2h0, t_dev_end)
                 for result, (_rd, lo, hi, *_rest) in zip(raw, chunks):
                     assignments[lo:hi] = result[:-2][:hi - lo]
                     batch_waves += int(result[-2])

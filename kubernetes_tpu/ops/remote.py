@@ -86,6 +86,7 @@ import numpy as np
 
 from ..component_base import metrics as cbm
 from ..component_base import profiling
+from ..component_base import timeline as cb_timeline
 from ..component_base import tracing
 from ..scheduler.config import RemoteSeamPolicy
 from ..scheduler.scheduler import BackendUnavailableError
@@ -230,6 +231,10 @@ class _WorkerCore:
         self._last_resp = None
         self.tracer_provider = tracing.TracerProvider()
         self._tracer = self.tracer_provider.tracer("tpu-worker")
+        # always-on, like the flight recorder: the ring is bounded and
+        # idle when nobody drains it, and the client can't reach across
+        # the process boundary to arm it at config time
+        self.timeline = cb_timeline.Timeline(enabled=True, proc="worker")
 
     def reset(self) -> None:
         """Simulate a crash+restart in place: resident state, kernels and
@@ -273,6 +278,14 @@ class _WorkerCore:
                 # consuming a seq: the breaker's half-open probe
                 return ({"ok": True, "epoch": self._epoch,
                          "initialized": self._backend is not None},
+                        self._epoch)
+            if path == "/timeline":
+                # observability drain, served like /health: before /init,
+                # epoch-blind and without consuming a seq — a restarted
+                # or uninitialized worker still answers (its ring is
+                # simply empty).  Rows are wall-anchored by this
+                # process's own clock, so the client ingests verbatim.
+                return ({"intervals": self.timeline.intervals(drain=True)},
                         self._epoch)
             if seq is not None and seq == self._last_seq \
                     and self._last_resp is not None:
@@ -372,9 +385,14 @@ class _WorkerCore:
             try:
                 import jax
                 buf = np.frombuffer(body, np.float32)
+                t0 = time.monotonic()
                 rd = b._device_step(variant, buf)
                 # sync-point: worker serializes the step result for the wire
-                return jax.device_get(rd).astype(np.int32).tobytes()
+                out = jax.device_get(rd).astype(np.int32).tobytes()
+                # device-step measured at the sync point: the worker's lane
+                # is the true device time the client's wire RT swallows
+                self.timeline.record("device-step", t0, time.monotonic())
+                return out
             except WorkerError:
                 raise
             except (ValueError, TypeError, KeyError, IndexError) as e:
@@ -453,6 +471,13 @@ class DeviceWorker:
                 if self.path == "/debug/traces":
                     self._reply(200, server._core.tracer_provider
                                 .debug_traces_json().encode())
+                elif self.path.startswith("/debug/timeline"):
+                    tl = server._core.timeline
+                    if "chrome" in self.path:
+                        body = json.dumps(tl.to_chrome_trace()).encode()
+                    else:
+                        body = tl.debug_json().encode()
+                    self._reply(200, body)
                 elif self.path == "/debug/profile":
                     self._reply(200, profiling.default_host_profiler
                                 .collapsed().encode(), "text/plain")
@@ -538,6 +563,7 @@ _GRPC_VERBS = {
     "StepFullSmall": "/step?variant=full_small",
     "StepPlain": "/step?variant=plain",
     "Preempt": "/preempt",
+    "Timeline": "/timeline",
     "Health": "/health",
 }
 _GRPC_MSG_CAP = 512 << 20
@@ -1142,6 +1168,16 @@ class RemoteTPUBatchBackend(TPUBatchBackend):
         out = _load_arrays(self._post("/preempt", _dump_arrays(body)))
         return (out["cand"], out["viol"], out["highest"], out["psum"],
                 out["nvic"], out["victims"], out["overflow"])
+
+    def drain_worker_timeline(self) -> list:
+        """Pull (and clear) the worker's timeline ring across the seam.
+
+        Read-only and metrics-path: no seq (nothing to dedup; the worker
+        serves it epoch-blind like /health), no resync — an uninitialized
+        or restarted worker answers with an empty ring, and the caller
+        treats any seam error as an empty drain."""
+        out = self._call("/timeline", b"", None, allow_resync=False)
+        return json.loads(out).get("intervals", [])
 
     def _full_refresh(self, cd_sg: np.ndarray, cd_asg: np.ndarray) -> None:
         t = self.tensors

@@ -29,6 +29,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..component_base.timeline import default_timeline
 from ..ops.backend import (
     FLUSH_FIRST, ResidentHostMirror, decode_results, record_batch_stats,
 )
@@ -266,8 +267,12 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                             self.tensors.update_from_snapshot_tracked(
                                 snapshot))
                     finally:
-                        self.stats["flatten_seconds"] += (
-                            time.monotonic() - t_sync)
+                        t_sync_end = time.monotonic()
+                        self.stats["flatten_seconds"] += t_sync_end - t_sync
+                        if default_timeline.enabled:
+                            # wave timeline: host tensor-maintenance leg
+                            default_timeline.record("patch", t_sync,
+                                                    t_sync_end)
                     dirty |= self._carry_dirty
                     self._last_epoch = epoch
                 batch = self.encoder.encode(list(pod_infos))
@@ -321,8 +326,13 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                        else "waves_patched"] += 1
             self._carry_dirty = set()
 
+            t_h2d = time.monotonic()
             assignments_dev, waves_dev, gen_dev = self._dispatch_locked(
                 batch, prows, pvals)
+            t_launch = time.monotonic()
+            if default_timeline.enabled:
+                # wave timeline: pack + shard upload + kernel enqueue
+                default_timeline.record("h2d", t_h2d, t_launch)
             expect_gen = self._gen
             self.stats["batches"] += 1
             holder = object()
@@ -334,6 +344,7 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         def resolve():
             import jax
             with self._lock:
+                t_d2h0 = time.monotonic()
                 # sync-point: sharded wave resolve — the pipeline's d2h pull
                 assignments, waves, gen = jax.device_get(
                     (assignments_dev, waves_dev, gen_dev))
@@ -353,6 +364,14 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                         batch, prows, pvals)
                     # sync-point: gen-stale recovery replay
                     assignments, waves = jax.device_get((a_dev, w_dev))
+                if default_timeline.enabled:
+                    # wave timeline: device-step launch -> results landed
+                    # (recovery replay included); d2h is the blocking
+                    # pull inside it
+                    t_dev_end = time.monotonic()
+                    default_timeline.record("device-step", t_launch,
+                                            t_dev_end)
+                    default_timeline.record("d2h", t_d2h0, t_dev_end)
                 self.stats["waves"] += int(waves)
                 self._replay(batch, assignments)
                 try:

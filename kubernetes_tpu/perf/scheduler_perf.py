@@ -77,6 +77,7 @@ class ThroughputCollector:
         self._frozen_at = 0.0     # freeze(): end of the measured window
         self._frozen_count = 0
         self._frozen_samples: list[float] = []
+        self._last_sched_at = 0.0  # drain time of the newest bind seen
 
     def scheduled_total(self) -> int:
         """Pods bound since start() (drain-backed; cheap)."""
@@ -110,6 +111,7 @@ class ThroughputCollector:
         if new:
             with self._count_lock:
                 self._count += new
+                self._last_sched_at = time.monotonic()
 
     @property
     def started(self) -> bool:
@@ -148,9 +150,15 @@ class ThroughputCollector:
         draining continues so scheduled_total stays usable for later
         barriers."""
         self._drain()
-        self._frozen_count = self.scheduled_total()
+        with self._count_lock:
+            self._frozen_count = self._count
+            # end the window at the drain that saw the final bind, not
+            # at barrier detection: the barrier polls at 50 ms, so its
+            # detection latency would quantize the window and read as
+            # up to a few percent of phantom throughput loss on short
+            # runs (the --timeline A/B measures exactly this margin)
+            self._frozen_at = self._last_sched_at or time.monotonic()
         self._frozen_samples = list(self.samples)
-        self._frozen_at = time.monotonic()
 
     def _run(self) -> None:
         window_start = time.monotonic()
@@ -386,7 +394,8 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
     if tracing_provider is not None:
         sched.configure_tracing(tracing_provider)
     if profiling_policy is not None and (profiling_policy.enabled
-                                         or profiling_policy.census):
+                                         or profiling_policy.census
+                                         or profiling_policy.timeline):
         # same wiring scheduler_from_config applies for the profiling:
         # stanza — bench --profile reuses the ProfilingPolicy dataclass
         from ..component_base import profiling as cbp
@@ -401,9 +410,21 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
             target_ms=profiling_policy.slo_target_ms,
             objective=profiling_policy.slo_objective,
             windows=profiling_policy.burn_windows_s)
+        timeline = None
+        if profiling_policy.timeline:
+            # arm the process-local interval ring the backends record
+            # into (bench --timeline rides the same switch the
+            # profiling: stanza flips)
+            from ..component_base import timeline as cb_timeline
+            timeline = cb_timeline.default_timeline
+            timeline.configure(enabled=True,
+                               ring=profiling_policy.timeline_ring)
+            timeline.reset()
         sched.configure_profiling(profiler, slo,
-                                  census=profiling_policy.census)
-        sched.run_device_census()
+                                  census=profiling_policy.census,
+                                  timeline=timeline)
+        if profiling_policy.enabled or profiling_policy.census:
+            sched.run_device_census()
     factory.start()
     factory.wait_for_cache_sync()
     sched.run()
@@ -847,6 +868,20 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                 stats["slo"] = {
                     **sched._slo.quantiles(),
                     "burn_rates": sched._slo.burn_rates(),
+                }
+        if profiling_policy is not None and profiling_policy.timeline:
+            # wave-timeline read-out: expose_metrics drains the worker
+            # seam (remote backend) into the ring and refreshes the
+            # union-derived gauges, then the summary + per-segment
+            # quantiles land in the BENCH row
+            sched = cluster.scheduler
+            sched.expose_metrics()
+            tl = sched._timeline
+            if tl is not None:
+                stats["timeline"] = {
+                    **tl.snapshot_summary(),
+                    "pods_decomposed": len(tl.pods()),
+                    "segments": sched.metrics.segment_summary(),
                 }
         if overload is not None:
             cluster.scheduler.expose_metrics()  # drain shed/defer tallies

@@ -278,7 +278,15 @@ class ProfilingPolicy:
                        multi-window burn rates export as
                        scheduler_slo_latency_ms / scheduler_slo_burn_rate
                        (the arm/disarm signal for adaptive overload
-                       engagement)."""
+                       engagement).
+      timeline         wave timeline (component_base/timeline.py): every
+                       pipeline stage records bounded (wave, stage,
+                       start, end, thread) intervals, deriving
+                       scheduler_wave_device_idle_share (interval
+                       union), per-stage overlap ratios, the per-pod
+                       scheduler_pod_latency_ms{segment} decomposition
+                       and /debug/timeline (JSON + Chrome trace).
+      timelineRing     bounded interval-ring capacity per process."""
 
     enabled: bool = False
     census: bool = False
@@ -287,6 +295,8 @@ class ProfilingPolicy:
     slo_target_ms: float = 10.0
     slo_objective: float = 0.99
     burn_windows_s: tuple = (60.0, 300.0, 3600.0)
+    timeline: bool = False
+    timeline_ring: int = 4096
 
 
 # profiling YAML key -> ProfilingPolicy field
@@ -298,6 +308,8 @@ _PROFILING_FIELDS = {
     "sloTargetMs": "slo_target_ms",
     "sloObjective": "slo_objective",
     "burnWindowsSeconds": "burn_windows_s",
+    "timeline": "timeline",
+    "timelineRing": "timeline_ring",
 }
 
 
@@ -322,6 +334,8 @@ def _parse_profiling(data: dict) -> ProfilingPolicy:
     if not policy.burn_windows_s or any(w <= 0
                                         for w in policy.burn_windows_s):
         raise ConfigError("profiling burnWindowsSeconds must be positive")
+    if policy.timeline_ring < 1:
+        raise ConfigError("profiling timelineRing must be >= 1")
     return policy
 
 
@@ -688,22 +702,31 @@ def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
             max_spans=cfg.tracing.max_spans,
             max_traces=cfg.tracing.max_traces)
         sched.configure_tracing(tracing.default_tracer_provider)
-    if cfg.profiling.enabled or cfg.profiling.census:
+    if (cfg.profiling.enabled or cfg.profiling.census
+            or cfg.profiling.timeline):
         # the process-wide profiler backs /debug/profile on the apiserver
         # and device-worker muxes (tracing's default-provider pattern);
         # tests wanting isolation construct their own HostProfiler and
         # call configure_profiling directly.  Default-off: this branch is
-        # the ONLY place the sampler starts or the census arms.
+        # the ONLY place the sampler starts, the census arms, or the
+        # wave timeline's default ring is enabled.
         from ..component_base import profiling
+        from ..component_base import timeline as cb_timeline
         profiler = None
         if cfg.profiling.enabled:
             profiler = profiling.default_host_profiler
             profiler.interval = cfg.profiling.sample_interval_ms / 1000.0
             profiler.max_stacks = cfg.profiling.max_stacks
             profiler.start()
+        timeline = None
+        if cfg.profiling.timeline:
+            timeline = cb_timeline.default_timeline
+            timeline.configure(enabled=True,
+                               ring=cfg.profiling.timeline_ring)
         slo = profiling.SLOTracker(
             target_ms=cfg.profiling.slo_target_ms,
             objective=cfg.profiling.slo_objective,
             windows=cfg.profiling.burn_windows_s)
-        sched.configure_profiling(profiler, slo, census=cfg.profiling.census)
+        sched.configure_profiling(profiler, slo, census=cfg.profiling.census,
+                                  timeline=timeline)
     return sched

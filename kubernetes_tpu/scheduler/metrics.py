@@ -260,6 +260,32 @@ class Metrics:
             "stanzas installed, rejected = validation failed and the old "
             "config stayed live).",
             labels=("result",))
+        # wave-timeline additions (profiling.timeline): interval-union
+        # derived views of the pipeline — idle share and overlap are
+        # point-in-time gauges recomputed from the interval ring at
+        # expose time; the per-pod decomposition histogram is observed
+        # at bind-commit (and therefore only when the timeline is on).
+        self.wave_device_idle_share = cbm.Gauge(
+            "scheduler_wave_device_idle_share",
+            "Wall-clock fraction of the recent timeline window during "
+            "which NO device stage (h2d/device-step/d2h) was in flight, "
+            "computed by interval union over the stage-interval ring — "
+            "correct under wave pipelining, unlike 1 - sum(stages)/wall.")
+        self.stage_overlap_ratio = cbm.Gauge(
+            "scheduler_stage_overlap_ratio",
+            "Per pipeline stage: fraction of the stage's own busy time "
+            "(interval union) during which at least one OTHER stage was "
+            "also in flight. 0 = fully serial; pipelining drives the "
+            "device stages toward 1.",
+            labels=("stage",))
+        self.pod_latency_ms = cbm.Histogram(
+            "scheduler_pod_latency_ms",
+            "Per-pod e2e latency decomposition in milliseconds, by "
+            "telescoped segment (queue/form/device/resolve/bind/watch): "
+            "segment boundaries are wave-timeline wall marks, so the "
+            "segments of one pod sum to its e2e by construction.",
+            labels=("segment",),
+            buckets=cbm.exponential_buckets(0.25, 2, 16))
         r.must_register(
             self.schedule_attempts, self.scheduling_attempt_duration,
             self.scheduling_algorithm_duration, self.pod_scheduling_duration,
@@ -282,7 +308,9 @@ class Metrics:
             self.tpu_step_hbm_bytes, self.host_stage_seconds,
             self.slo_latency_ms, self.slo_burn_rate,
             self.tpu_tensor_waves, self.tpu_tensor_occupancy,
-            self.tpu_tensor_tombstones, self.config_reload_total)
+            self.tpu_tensor_tombstones, self.config_reload_total,
+            self.wave_device_idle_share, self.stage_overlap_ratio,
+            self.pod_latency_ms)
 
     def expose(self) -> str:
         return self.registry.expose()

@@ -84,6 +84,18 @@ class _ChildHTTP(http.server.BaseHTTPRequestHandler):
             body = b"ok"
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")
+        elif self.path.startswith("/debug/timeline"):
+            # per-child wave timeline (the supervisor federates these);
+            # ?format=chrome serves a Perfetto-loadable trace
+            import json as _json
+
+            from ..component_base import timeline as cb_timeline
+            tl = cb_timeline.default_timeline
+            body = (_json.dumps(tl.to_chrome_trace())
+                    if "chrome" in self.path
+                    else tl.debug_json()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
         elif self.path == "/readyz":
             so = sched.scaleout
             draining = getattr(self.server, "draining", False)
@@ -170,6 +182,11 @@ def child_main(args) -> int:
                     "backend": {"kind": args.backend
                                 if args.backend != "none" else "null",
                                 "batchSize": args.batch_size}}
+    if os.environ.get("KTPU_PROC_TIMELINE") == "1":
+        # arm the wave-timeline ring in every child (the supervisor's
+        # federated_timeline()/supervisor_metrics_text() read it back
+        # over /debug/timeline) — same stanza path a --config file uses
+        stanza["profiling"] = {"timeline": True}
     if args.instance_count > 1:
         stanza["scaleOut"] = {
             "instanceCount": args.instance_count,
@@ -677,10 +694,55 @@ class ProcCluster:
         """Supervisor-side counters in exposition format — appended to
         the children's federated texts by the bench/ops tooling.  These
         are process-management tallies the children cannot see (they are
-        the ones being SIGKILLed)."""
-        return ("# TYPE scheduler_proc_drain_escalated_total counter\n"
-                f"scheduler_proc_drain_escalated_total "
-                f"{float(self.drain_escalations)}\n")
+        the ones being SIGKILLed), plus a per-child idle-share line
+        federated from the children's /debug/timeline rings."""
+        lines = ["# TYPE scheduler_proc_drain_escalated_total counter",
+                 f"scheduler_proc_drain_escalated_total "
+                 f"{float(self.drain_escalations)}",
+                 "# TYPE scheduler_proc_wave_device_idle_share gauge"]
+        for i, doc in sorted(self.timeline_snapshots().items()):
+            idle = doc.get("device_idle_share")
+            if idle is not None:
+                lines.append(f'scheduler_proc_wave_device_idle_share'
+                             f'{{instance="{i}"}} {float(idle)}')
+        return "\n".join(lines) + "\n"
+
+    def timeline_snapshots(self) -> dict[int, dict]:
+        """One /debug/timeline pull per live child: instance index ->
+        the child's timeline debug doc (summary + interval rows).  A
+        child with the timeline disabled answers with enabled=false and
+        empty rows — included so the caller sees the full topology."""
+        import json as _json
+        import urllib.request
+        out: dict[int, dict] = {}
+        for i in sorted(self._children):
+            c = self._children[i]
+            if not self.alive(i) or c.metrics_port is None:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{c.metrics_port}"
+                        f"/debug/timeline", timeout=10.0) as resp:
+                    out[i] = _json.loads(resp.read().decode())
+            except (OSError, ValueError):  # died or torn mid-pull: skip
+                continue
+        return out
+
+    def federated_timeline(self):
+        """Merge every live child's interval rows into one supervisor
+        Timeline (rows are wall-anchored by each child's own clock, so
+        the merge is plain concatenation — same contract as the remote
+        seam's worker drain) and return it.  Use .snapshot_summary() for
+        the cluster-wide idle share or .to_chrome_trace() for one
+        Perfetto doc with per-child process lanes."""
+        from ..component_base import timeline as cb_timeline
+        tl = cb_timeline.Timeline(
+            ring=65536, enabled=True, proc="supervisor")
+        for i, doc in sorted(self.timeline_snapshots().items()):
+            rows = doc.get("interval_rows") or []
+            # re-tag the lane so per-child attribution survives the merge
+            tl.ingest([dict(r, proc=f"sched{i}") for r in rows])
+        return tl
 
     def metrics_texts(self) -> list[str]:
         """One /metrics pull per live child — the raw exposition bodies
@@ -733,6 +795,11 @@ class WireBindLedger:
 
     def __init__(self, client):
         self.nodes_seen: dict[str, set[str]] = {}
+        # first wall-clock moment this LEDGER saw each pod carry a
+        # nodeName — the external observation timestamp the timeline's
+        # per-pod `watch` segment is stitched from
+        # (component_base/timeline.stitch_watch_segments)
+        self.observed_at: dict[str, float] = {}
         from ..client.clientset import PODS
         self._pods = PODS
         self._client = client
@@ -744,6 +811,7 @@ class WireBindLedger:
         node = (obj.get("spec") or {}).get("nodeName")
         if node:
             self.nodes_seen.setdefault(key, set()).add(node)
+            self.observed_at.setdefault(key, time.time())
 
     def _rearm(self) -> None:
         """The streaming watch EOFs when the apiserver hands off to a

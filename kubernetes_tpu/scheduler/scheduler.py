@@ -30,12 +30,15 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
 
+import numpy as np
+
 from ..api import meta
 from ..api.meta import Obj
 from ..client.clientset import Client, NAMESPACES, NODES, PDBS, PODS
 from ..client.informer import SharedInformerFactory
 from ..store import kv
 from ..component_base import tracing
+from ..component_base import timeline as cb_timeline
 from ..utils import fasthost, stagelat
 from . import metrics as _metrics
 from .cache import Cache, Snapshot
@@ -75,6 +78,19 @@ class SchedulerMetrics:
         # (p99 <10ms); reference: pod_scheduling_duration_seconds
         # (pkg/scheduler/metrics/metrics.go:55-75)
         self.pod_e2e_latencies: list[float] = []  # guarded-by: lock
+        # per-pod e2e decomposition segments (ms), keyed by segment name
+        # (timeline.POD_SEGMENTS); populated only while profiling.timeline
+        # is armed — the raw series behind latency_decomposition rows.
+        # Held as append-only chunks (the ndarray columns straight off the
+        # bind path) rather than boxed floats: arming must not create
+        # hundreds of thousands of gc-tracked objects per run.  Sample
+        # counts are tracked separately for the watermark contract.
+        self.pod_segment_ms: dict[str, list] = {}  # guarded-by: lock
+        self._pod_segment_n: dict[str, int] = {}  # guarded-by: lock
+        # raw (t_enq, t_bind, marks) wave tuples awaiting derivation:
+        # the bind path defers the clamp chain + histogram ingest to
+        # the first reader (segment_mark/segment_summary/expose)
+        self._pending_segments: list = []  # guarded-by: lock
         self.preemption_attempts = 0
 
     def observe_attempt(self, result: str, latency: float,
@@ -135,6 +151,65 @@ class SchedulerMetrics:
                 "p95_ms": pct(0.95), "p99_ms": pct(0.99),
                 "max_ms": round(1e3 * xs[-1], 2)}
 
+    def observe_segments(self, by_seg: dict) -> None:
+        """Record per-pod telescoped latency decompositions, one column
+        of ms values per segment (the timeline's invariant: the segments
+        of one pod sum to its e2e).  Columns are numpy arrays or lists.
+        Feeds scheduler_pod_latency_ms{segment} and the in-process
+        series segment_summary() reads."""
+        with self.lock:
+            for name, ls in by_seg.items():
+                self.pod_segment_ms.setdefault(name, []).append(ls)
+                self._pod_segment_n[name] = (
+                    self._pod_segment_n.get(name, 0)
+                    + (int(ls.size) if hasattr(ls, "size") else len(ls)))
+        for name, ls in by_seg.items():
+            self.prom.pod_latency_ms.observe_array(ls, name)
+
+    def defer_segments(self, t_enq, t_bind: float, marks) -> None:
+        """Bind-path tap: queue one wave's raw decomposition inputs
+        (enqueue column, bind wall, stage marks) and return.  The clamp
+        chain, the series chunks and the prom histogram ingest all run
+        on the first read (_flush_segments) — the armed bind path must
+        stay one append, or the ≤5% overhead pin breaks."""
+        with self.lock:
+            self._pending_segments.append((t_enq, t_bind, marks))
+
+    def _flush_segments(self) -> None:
+        with self.lock:
+            pend, self._pending_segments = self._pending_segments, []
+        if not pend:
+            return
+        for t_enq, t_bind, marks in pend:
+            self.observe_segments(
+                cb_timeline.derive_segment_cols(t_enq, t_bind, marks))
+
+    def segment_mark(self) -> dict[str, int]:
+        """Per-segment watermark (same contract as e2e_mark)."""
+        self._flush_segments()
+        with self.lock:
+            return dict(self._pod_segment_n)
+
+    def segment_summary(self, since: dict | None = None) -> dict:
+        """p50/p95/p99 (ms) per decomposition segment, past the mark."""
+        self._flush_segments()
+        since = since or {}
+        with self.lock:
+            chunks = {k: list(v) for k, v in self.pod_segment_ms.items()}
+        out: dict[str, dict] = {}
+        for k, cs in chunks.items():
+            flat: list[float] = []
+            for c in cs:
+                flat.extend(c.tolist() if hasattr(c, "tolist") else c)
+            xs = sorted(flat[since.get(k, 0):])
+            if not xs:
+                continue
+            def pct(p: float) -> float:
+                return round(xs[min(int(len(xs) * p), len(xs) - 1)], 3)
+            out[k] = {"count": len(xs), "p50_ms": pct(0.50),
+                      "p95_ms": pct(0.95), "p99_ms": pct(0.99)}
+        return out
+
     def observe_preemption(self, victims: int) -> None:
         with self.lock:
             self.preemption_attempts += 1
@@ -142,6 +217,7 @@ class SchedulerMetrics:
         self.prom.preemption_victims.observe(victims)
 
     def expose(self) -> str:
+        self._flush_segments()  # scrape sees deferred wave decompositions
         return self.prom.expose()
 
 
@@ -459,6 +535,11 @@ class Scheduler:
         self._slo = None
         self._census_wanted = False
         self._census: dict = {}
+        # wave timeline (component_base/timeline.py): None until
+        # configure_profiling attaches a recorder; every hot-path site
+        # checks `tl is not None and tl.enabled` so the default costs
+        # one attribute read
+        self._timeline: cb_timeline.Timeline | None = None
         # last-seen tensor-maintenance wave counts per profile: the
         # backend keeps cumulative tallies, the Prometheus counter is
         # inc-only, so expose time applies deltas
@@ -518,7 +599,8 @@ class Scheduler:
         self.scaleout = so
 
     def configure_profiling(self, profiler, slo=None,
-                            census: bool = False) -> None:
+                            census: bool = False,
+                            timeline=None) -> None:
         """Attach the performance observatory (component_base/profiling):
         `profiler` is a HostProfiler (started by the caller — usually
         scheduler_from_config off the profiling: stanza) whose per-stage
@@ -526,11 +608,15 @@ class Scheduler:
         time; `slo` is an SLOTracker fed submit->bind latencies at the
         bind-commit tail, publishing rolling p50/p95/p99 + burn-rate
         gauges; `census=True` arms run_device_census() so the harness
-        runs it once after backend warmup.  Pass (None, None) to
-        detach."""
+        runs it once after backend warmup; `timeline` is a
+        component_base.timeline.Timeline (usually the armed
+        default_timeline) whose stage intervals the pipeline records and
+        whose union-derived gauges expose_metrics refreshes.  Pass
+        (None, None) to detach."""
         self._profiler = profiler
         self._slo = slo
         self._census_wanted = bool(census)
+        self._timeline = timeline
 
     # stanzas reload_config can apply to a running scheduler; everything
     # else in a KubeSchedulerConfiguration (plugin pipelines, scaleOut
@@ -565,7 +651,15 @@ class Scheduler:
             self.configure_tracing(tracing.default_tracer_provider)
         else:
             self.configure_tracing(None)
-        if cfg.profiling.enabled or cfg.profiling.census:
+        timeline = None
+        if cfg.profiling.timeline:
+            timeline = cb_timeline.default_timeline
+            timeline.configure(enabled=True,
+                               ring=cfg.profiling.timeline_ring)
+        elif self._timeline is cb_timeline.default_timeline:
+            cb_timeline.default_timeline.configure(enabled=False)
+        if (cfg.profiling.enabled or cfg.profiling.census
+                or cfg.profiling.timeline):
             profiler = None
             if cfg.profiling.enabled:
                 profiler = profiling.default_host_profiler
@@ -580,7 +674,8 @@ class Scheduler:
                 objective=cfg.profiling.slo_objective,
                 windows=cfg.profiling.burn_windows_s)
             self.configure_profiling(profiler, slo,
-                                     census=cfg.profiling.census)
+                                     census=cfg.profiling.census,
+                                     timeline=timeline)
         else:
             if (self._profiler is not None
                     and self._profiler is profiling.default_host_profiler):
@@ -711,6 +806,27 @@ class Scheduler:
                 self.metrics.prom.slo_latency_ms.set(q[f"{quant}_ms"], quant)
             for window, burn in self._slo.burn_rates().items():
                 self.metrics.prom.slo_burn_rate.set(burn, window)
+        # wave timeline: pull worker-side intervals over the seam (the
+        # remote backend forwards its ring with epoch/seq framing and
+        # wall-anchored clocks, so ingest is plain concatenation), then
+        # refresh the union-derived gauges at pull time
+        tl = self._timeline
+        if tl is not None and tl.enabled:
+            for profile in self.profiles.values():
+                drain_fn = getattr(profile.batch_backend,
+                                   "drain_worker_timeline", None)
+                if drain_fn is not None:
+                    try:
+                        tl.ingest(drain_fn())
+                    except Exception:  # noqa: BLE001 - seam may be down
+                        pass
+            summary = tl.snapshot_summary()
+            idle = summary.get("device_idle_share")
+            if idle is not None:
+                self.metrics.prom.wave_device_idle_share.set(float(idle))
+            for stage_name, ratio in summary.get("overlap", {}).items():
+                self.metrics.prom.stage_overlap_ratio.set(
+                    float(ratio), stage_name)
         return self.metrics.expose()
 
     # -- event handlers (eventhandlers.go:249) ---------------------------
@@ -754,6 +870,7 @@ class Scheduler:
         """Bulk node-event handler: a registration flood (100k createNodes)
         lands as ADDED bursts — absorb each burst with ONE cache lock
         round and ONE queue move instead of one per node."""
+        t_drain = time.monotonic()
         adds: list[Obj] = []
 
         def flush() -> None:
@@ -774,6 +891,9 @@ class Scheduler:
                 flush()  # preserve same-node event ordering
                 self._on_node_event(t, node, old)
         flush()
+        tl = self._timeline
+        if tl is not None and tl.enabled:
+            tl.record("event-drain", t_drain, time.monotonic())
 
     def _on_pod_events(self, triples: list) -> None:
         """Bulk pod-event handler: the two burst-dominant cases — new
@@ -782,6 +902,7 @@ class Scheduler:
         round per burst instead of one per pod.  Everything else falls
         through to the per-event path, with flush barriers so same-pod
         event order is preserved exactly."""
+        t_drain = time.monotonic()
         queue_adds: list[Obj] = []
         confirms: list[Obj] = []
         peer_bound: list[Obj] = []  # bound on a node a peer instance owns
@@ -834,6 +955,9 @@ class Scheduler:
                 flush()
                 self._on_pod_event(t, pod, old)
         flush()
+        tl = self._timeline
+        if tl is not None and tl.enabled:
+            tl.record("event-drain", t_drain, time.monotonic())
 
     def _responsible_for(self, pod: Obj) -> bool:
         name = (pod.get("spec") or {}).get("schedulerName", "default-scheduler")
@@ -1705,12 +1829,17 @@ class Scheduler:
         if stagelat.ENABLED:
             stagelat.record("queue_wait",
                             sum(start - q.timestamp for q in live) / len(live))
+        tl = self._timeline
         try:
             # the thread-local current span is how the backend (and, via
             # the propagated traceparent, the remote worker) parents its
             # flatten/H2D/solve spans into this batch's trace without
-            # widening the BatchBackend dispatch signature
-            with tracing.use_span(root):
+            # widening the BatchBackend dispatch signature; the
+            # thread-local current wave does the same for the timeline's
+            # patch/h2d/device-step intervals
+            with tracing.use_span(root), \
+                    (tl.use_wave(cycle) if tl is not None and tl.enabled
+                     else cb_timeline.NULL_STAGE):
                 resolve = backend.dispatch([q.pod_info for q in live], view)
                 if resolve is FLUSH_FIRST:
                     # the batch needs device-state repair; drain the
@@ -1730,6 +1859,12 @@ class Scheduler:
                 root.end()
             self._requeue_batch(live, e)
             return None
+        if tl is not None and tl.enabled:
+            # batch-form: queue pop through dispatch handed to the device
+            # (the host-side formation leg of the wave)
+            tl.record("batch-form",
+                      pop_window[0] if pop_window is not None else start,
+                      time.monotonic(), wave=cycle)
         if stagelat.ENABLED:
             # covers the FLUSH_FIRST re-dispatch too (the flush drain time
             # lands here rather than in pipeline_wait)
@@ -1833,11 +1968,15 @@ class Scheduler:
         pol = self.overload_policy
         deadline = pol.wave_deadline if pol is not None else 0.0
         t_enter = time.monotonic()
+        tl = self._timeline
         try:
             # resolve() may retry/resync through the remote seam: the
             # current span makes those show up as events on this batch's
-            # trace rather than orphans (ops/remote.py _seam_event)
-            with tracing.use_span(span):
+            # trace rather than orphans (ops/remote.py _seam_event); the
+            # current wave attributes the backend's d2h interval
+            with tracing.use_span(span), \
+                    (tl.use_wave(cycle) if tl is not None and tl.enabled
+                     else cb_timeline.NULL_STAGE):
                 if deadline > 0.0:
                     results = self._resolve_with_deadline(
                         profile, live, resolve, start, deadline, span)
@@ -1852,6 +1991,9 @@ class Scheduler:
             self._requeue_batch(live, e)
             return
         resolve_block = time.monotonic() - t_enter
+        if tl is not None and tl.enabled:
+            # resolve: blocking on the device result + host decode
+            tl.record("resolve", t_enter, time.monotonic(), wave=cycle)
         # Adapt the eager-retirement flight estimate HERE, whichever
         # path retired the batch (eager gate, depth overflow, queue-empty
         # block, or a flush) — adapting only from the eager loop froze
@@ -2113,6 +2255,7 @@ class Scheduler:
             # this span runs on the binder pool thread
             bind_sp = span.tracer.start_span("bind", parent=span)
             bind_sp.set_attribute("pods", len(ready))
+        t_bind0 = time.monotonic()
         bindings = fasthost.binding_rows(ready)
         t_phase = time.monotonic()
         if self.scaleout is not None and not self.scaleout.self_live:
@@ -2197,6 +2340,36 @@ class Scheduler:
         self.metrics.observe_e2e(
             [(lat, q.attempts)
              for lat, (_, q, _, _) in zip(e2e_lats, bound)])
+        tl = self._timeline
+        if tl is not None and tl.enabled:
+            tl.record("bind-commit", t_bind0, now, wave=cycle)
+            # per-pod e2e decomposition: telescope each pod's enqueue
+            # timestamp through the wave's stage marks to the commit.
+            # Boundaries are clamped monotone non-decreasing, so every
+            # segment is >= 0 and the segments sum EXACTLY to the same
+            # e2e observe_e2e just recorded.
+            marks = tl.wave_marks(cycle)
+            bind_end = tl.wall(now)
+            form_mark = marks.get("batch-form")
+            dev_end = (marks.get("device-step") or (None, None))[1]
+            res_end = (marks.get("resolve") or (None, None))[1]
+            # only the enqueue timestamp varies per pod — the wave's
+            # stage marks are shared — so the wave records as ONE raw
+            # block (keys, enqueue column, bind wall, marks) and the
+            # telescoped clamp chain runs lazily at read time
+            # (derive_segment_cols).  The ≤5% overhead pin rides on
+            # this path staying one fromiter + two appends.
+            n_b = len(bound)
+            t_enq = np.fromiter(
+                (q.initial_attempt_timestamp for _, q, _, _ in bound),
+                np.float64, n_b)
+            t_enq += bind_end - now
+            wave_marks = (form_mark[0] if form_mark else None,
+                          form_mark[1] if form_mark else None,
+                          dev_end, res_end)
+            tl.record_pod_block([q.key for _, q, _, _ in bound], cycle,
+                                t_enq, bind_end, marks=wave_marks)
+            self.metrics.defer_segments(t_enq, bind_end, wave_marks)
         if self._slo is not None:
             # SLO tracker tap: the submit->bind latencies of this wave
             # feed the rolling windows; a wave that lands past the

@@ -43,7 +43,7 @@ def run_rule(ctx: LintContext, name: str) -> list[Finding]:
 
 def test_registry_has_the_full_catalog():
     rules = all_rules()
-    assert len(rules) >= 21
+    assert len(rules) >= 22
     for name, rule in rules.items():
         assert name == rule.name
         assert rule.doc, f"rule {name} has no doc line"
@@ -231,6 +231,37 @@ def test_span_lifecycle_fires_and_clean(tmp_path):
             span.end()
         """})
     assert run_rule(ctx, "span-lifecycle") == []
+
+
+def test_timeline_stage_paired_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {"a.py": """\
+        def leaky(tl):
+            tok = tl.begin("h2d")
+            return tok
+
+        def leaky_attr(self):
+            self._timeline.begin("resolve")
+        """})
+    found = run_rule(ctx, "timeline-stage-paired")
+    assert len(found) == 2
+    assert "leaky" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {"a.py": """\
+        def managed(tl):
+            with tl.begin("h2d"):
+                pass
+
+        def explicit(timeline):
+            tok = timeline.begin("resolve")
+            timeline.end(tok)
+
+        def retroactive(tl, t0, t1):
+            tl.record("d2h", t0, t1)
+
+        def unrelated(db):
+            db.begin("txn")  # not a timeline receiver
+        """})
+    assert run_rule(ctx, "timeline-stage-paired") == []
 
 
 def test_retry_backoff_fires_and_clean(tmp_path):

@@ -255,6 +255,23 @@ class TestHostProfiler:
         # binder frame carve-out wins over the thread-name mapping
         assert classify_stage("sched-loop",
                               ["poll", "_bulk_bind_commit"]) == "binder"
+        # decoupled-binder frames (turbo/bulk cycles, permit waits, row
+        # materialisation) attribute to binder, not the calling thread
+        for frame in ("_binding_cycle_turbo", "_binding_cycle_bulk",
+                      "wait_on_permit", "binding_rows"):
+            assert classify_stage("sched-loop", [frame]) == "binder", frame
+        # event-driven row maintenance attributes to snapshot.patch
+        for frame in ("_release_row", "_probe_bucket",
+                      "_ns_mask_row_update"):
+            assert classify_stage("sched-loop",
+                                  [frame]) == "snapshot.patch", frame
+        # scatter-gather registration is flatten work on its own...
+        assert classify_stage("sched-loop",
+                              ["register_sg"]) == "snapshot.flatten"
+        # ...but under patch_node the patch-first check order wins
+        assert classify_stage("sched-loop",
+                              ["register_sg", "patch_node"]) \
+            == "snapshot.patch"
 
 
 # -- SLO tracker -------------------------------------------------------------

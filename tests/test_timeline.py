@@ -1,0 +1,622 @@
+"""Wave timeline observatory (component_base/timeline.py).
+
+Four layers, innermost out:
+
+1. interval set algebra — the union-derived idle share that stays
+   correct under pipelining (where ``1 - Σ durations / wall`` breaks),
+   overlap ratios, watch-segment stitching;
+2. the recorder — bounded ring, wall anchoring, thread/wave tagging,
+   begin/end pairing, cross-process ingest;
+3. the transports — /debug/timeline on the apiserver and the device
+   worker (JSON + Perfetto-loadable Chrome trace), the remote seam's
+   /timeline drain verb with its clock-merge contract, and procrun
+   cross-process federation under seeded churn;
+4. the pipeline — a real null-device workload with profiling.timeline
+   armed: per-pod segments telescope to e2e within 1%, and the armed
+   overhead stays ≤5% (A/B, best-of-3 per arm).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.component_base import timeline as tlmod
+from kubernetes_tpu.component_base import tracing
+from kubernetes_tpu.component_base.timeline import (
+    NULL_STAGE, Timeline, device_idle_share, interval_union, overlap_ratios,
+    stitch_watch_segments,
+)
+
+
+def iv(stage, t0, t1, wave=None, thread="MainThread", proc="scheduler"):
+    return {"stage": stage, "wave": wave, "t0_unix_s": t0, "t1_unix_s": t1,
+            "thread": thread, "proc": proc}
+
+
+# -- interval set algebra ---------------------------------------------------
+
+
+class TestIntervalAlgebra:
+    def test_union_merges_overlap_and_nesting(self):
+        assert interval_union([(0, 1), (2, 3)]) == pytest.approx(2.0)
+        assert interval_union([(0, 2), (1, 3)]) == pytest.approx(3.0)
+        assert interval_union([(0, 10), (2, 3)]) == pytest.approx(10.0)
+        assert interval_union([]) == 0.0
+        assert interval_union([(1, 1), (2, 1)]) == 0.0  # degenerate rows
+
+    def test_idle_share_serial_waves(self):
+        # device busy [1,2] and [3,4] inside window [0,5]: idle 3/5
+        rows = [iv("batch-form", 0, 1), iv("device-step", 1, 2),
+                iv("resolve", 2, 3), iv("device-step", 3, 4),
+                iv("bind-commit", 4, 5)]
+        assert device_idle_share(rows) == pytest.approx(0.6)
+
+    def test_idle_share_pipelined_vs_naive_sum(self):
+        """The acceptance shape: overlapping device stages (h2d for wave
+        N+1 under device-step for wave N).  The union form counts the
+        overlap once; the naive duration sum double-counts it and
+        reports LESS idle than reality."""
+        rows = [iv("device-step", 0, 4, wave=1),
+                iv("h2d", 3, 5, wave=2),          # overlaps [3,4]
+                iv("device-step", 5, 7, wave=2),
+                iv("event-drain", 7, 10)]          # host tail: honest idle
+        share = device_idle_share(rows)
+        # union busy = [0,7] -> 7; window [0,10] -> idle 0.3
+        assert share == pytest.approx(0.3)
+        naive = 1.0 - (4 + 2 + 2) / 10.0           # 0.2: wrong (overlap
+        assert share > naive                        # double-counted)
+
+    def test_idle_share_window_and_empty(self):
+        assert device_idle_share([]) is None
+        rows = [iv("device-step", 2, 4)]
+        assert device_idle_share(rows, window=(0, 10)) == pytest.approx(0.8)
+        # intervals clamp to the window, never go negative
+        assert device_idle_share(rows, window=(3, 3.5)) == pytest.approx(0.0)
+        assert device_idle_share(rows, window=(5, 5)) is None
+
+    def test_overlap_ratios(self):
+        rows = [iv("device-step", 0, 4), iv("h2d", 3, 5),
+                iv("resolve", 10, 12)]
+        r = overlap_ratios(rows)
+        assert r["device-step"] == pytest.approx(0.25)   # [3,4] of [0,4]
+        assert r["h2d"] == pytest.approx(0.5)            # [3,4] of [3,5]
+        assert r["resolve"] == 0.0                       # fully serial
+
+    def test_stitch_watch_resums_e2e(self):
+        pod = {"key": "default/p0", "wave": 1,
+               "t_enqueue_unix_s": 100.0, "t_bind_unix_s": 100.5,
+               "segments_ms": {"queue": 300.0, "form": 50.0,
+                               "device": 100.0, "resolve": 30.0,
+                               "bind": 20.0, "watch": 0.0},
+               "e2e_ms": 500.0}
+        out = stitch_watch_segments([pod, dict(pod, key="default/p1")],
+                                    {"default/p0": 100.7})
+        assert out[0]["segments_ms"]["watch"] == pytest.approx(200.0)
+        assert out[0]["e2e_ms"] == pytest.approx(700.0)
+        assert sum(out[0]["segments_ms"].values()) == \
+            pytest.approx(out[0]["e2e_ms"])
+        # unobserved pod: watch stays 0 and e2e unchanged
+        assert out[1]["segments_ms"]["watch"] == 0.0
+        assert out[1]["e2e_ms"] == pytest.approx(500.0)
+
+
+# -- the recorder -----------------------------------------------------------
+
+
+class TestRecorder:
+    def test_disabled_is_inert(self):
+        tl = Timeline(enabled=False)
+        tok = tl.begin("h2d")
+        assert tok is NULL_STAGE
+        with tl.stage("resolve"):
+            pass
+        tl.record("device-step", 0.0, 1.0)
+        tl.record_pod("k", {"queue": 1.0}, 0.0, 1.0)
+        assert tl.intervals() == [] and tl.pods() == []
+
+    def test_begin_end_and_cm_commit(self):
+        tl = Timeline(enabled=True)
+        with tl.stage("patch", wave=3):
+            time.sleep(0.002)
+        tok = tl.begin("resolve", wave=3)
+        tl.end(tok)
+        rows = tl.intervals()
+        assert [r["stage"] for r in rows] == ["patch", "resolve"]
+        assert all(r["wave"] == 3 for r in rows)
+        assert all(r["t1_unix_s"] >= r["t0_unix_s"] for r in rows)
+        assert rows[0]["thread"] == threading.current_thread().name
+        assert rows[0]["proc"] == "scheduler"
+
+    def test_wall_anchoring(self):
+        tl = Timeline(enabled=True)
+        t0 = time.monotonic()
+        tl.record("device-step", t0, t0 + 0.1)
+        row = tl.intervals()[0]
+        # the anchored wall timestamp lands on the actual wall clock
+        assert abs(row["t0_unix_s"] - time.time()) < 5.0
+        assert row["t1_unix_s"] - row["t0_unix_s"] == pytest.approx(
+            0.1, abs=1e-6)
+
+    def test_ring_bound_and_drain(self):
+        tl = Timeline(ring=8, enabled=True)
+        for i in range(50):
+            tl.record("patch", float(i), float(i) + 0.5, wave=i)
+        rows = tl.intervals(drain=True)
+        assert len(rows) == 8                      # bounded, oldest evicted
+        assert rows[-1]["wave"] == 49
+        assert tl.intervals() == []                # drained
+
+    def test_no_thread_leak_under_concurrent_commit(self):
+        """N threads hammering one ring: every commit lands (up to the
+        bound), per-thread names tag their own rows, and the thread-local
+        wave scope never crosses threads."""
+        tl = Timeline(ring=4096, enabled=True)
+        errs: list = []
+
+        def work(n):
+            try:
+                with tl.use_wave(n):
+                    for _ in range(100):
+                        assert tl.current_wave() == n
+                        t = time.monotonic()
+                        tl.record("resolve", t, t + 1e-4)
+            except BaseException as e:  # noqa: BLE001 - collect, re-raise
+                errs.append(e)
+
+        threads = [threading.Thread(target=work, args=(n,), name=f"w{n}")
+                   for n in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        rows = tl.intervals()
+        assert len(rows) == 800
+        by_thread = {r["thread"] for r in rows}
+        assert by_thread == {f"w{n}" for n in range(8)}
+        for r in rows:
+            assert r["wave"] == int(r["thread"][1:])  # no cross-tagging
+        assert tl.current_wave() is None               # scope restored
+
+    def test_wave_marks_merge_and_eviction(self):
+        tl = Timeline(enabled=True)
+        tl.record("device-step", 10.0, 11.0, wave=7)
+        tl.record("device-step", 10.5, 12.0, wave=7)   # extends the mark
+        m = tl.wave_marks(7)
+        w0, w1 = m["device-step"]
+        assert w1 - w0 == pytest.approx(2.0)
+        for w in range(Timeline.MAX_WAVE_MARKS + 10):
+            tl.record("patch", float(w), float(w) + 0.1, wave=1000 + w)
+        assert tl.wave_marks(7) == {}                  # evicted, bounded
+
+    def test_ingest_merges_foreign_rows(self):
+        tl = Timeline(enabled=True)
+        n = tl.ingest([iv("device-step", 5.0, 6.0, wave=1, proc="worker"),
+                       iv("h2d", 4.5, 5.2, proc="worker")])
+        assert n == 2
+        rows = tl.intervals()
+        assert {r["proc"] for r in rows} == {"worker"}
+        assert device_idle_share(rows) == pytest.approx(0.0)
+
+    def test_configure_resize_rearms(self):
+        tl = Timeline(ring=4, enabled=True)
+        tl.record("patch", 0.0, 1.0)
+        tl.configure(ring=16)
+        assert tl.intervals() == []                    # resize re-arms
+        for i in range(20):
+            tl.record("patch", float(i), float(i) + 0.1)
+        assert len(tl.intervals()) == 16
+
+
+# -- pod decomposition ------------------------------------------------------
+
+
+class TestPodRows:
+    def test_record_pod_sums_exactly(self):
+        tl = Timeline(enabled=True)
+        seg = {"queue": 3.0, "form": 1.0, "device": 2.0,
+               "resolve": 0.5, "bind": 0.25, "watch": 0.0}
+        tl.record_pod("default/p", seg, 100.0, 100.00675, wave=1)
+        row = tl.pods()[0]
+        assert row["e2e_ms"] == pytest.approx(sum(seg.values()))
+        assert row["key"] == "default/p" and row["wave"] == 1
+
+    def test_pod_ring_bounded(self):
+        tl = Timeline(pod_ring=4, enabled=True)
+        for i in range(10):
+            tl.record_pod(f"d/p{i}", {"queue": 1.0}, 0.0, 0.001)
+        rows = tl.pods(drain=True)
+        assert len(rows) == 4 and rows[-1]["key"] == "d/p9"
+        assert tl.pods() == []
+
+
+# -- chrome trace writers ---------------------------------------------------
+
+
+class TestChromeTrace:
+    def test_timeline_trace_names_processes_and_threads(self):
+        tl = Timeline(enabled=True, proc="scheduler")
+        tl.record("device-step", time.monotonic(), time.monotonic() + 0.01,
+                  wave=5)
+        tl.ingest([iv("device-step", time.time(), time.time() + 0.01,
+                      wave=5, thread="step", proc="worker")])
+        doc = tl.to_chrome_trace()
+        evs = doc["traceEvents"]
+        metas = [e for e in evs if e["ph"] == "M"]
+        xs = [e for e in evs if e["ph"] == "X"]
+        proc_names = {e["args"]["name"] for e in metas
+                      if e["name"] == "process_name"}
+        thr_names = {e["args"]["name"] for e in metas
+                     if e["name"] == "thread_name"}
+        assert {"scheduler", "worker"} <= proc_names
+        assert "step" in thr_names
+        assert len(xs) == 2 and all(e["cat"] == "timeline" for e in xs)
+        assert all(e["args"]["wave"] == 5 for e in xs)
+        json.dumps(doc)  # Perfetto-loadable: plain JSON document
+
+    def test_span_trace_thread_lanes(self):
+        """Satellite of PR 2: the span writer now emits thread_name
+        metadata and lanes tids per (process, thread)."""
+        provider = tracing.TracerProvider(sampling_rate_per_million=10 ** 6)
+        tracer = provider.tracer("t")
+        done = threading.Event()
+
+        def other():
+            with tracer.start_span("wave.other") as sp:
+                sp.set_attribute("process", "scheduler")
+            done.set()
+
+        with tracer.start_span("wave.main") as sp:
+            sp.set_attribute("process", "scheduler")
+        threading.Thread(target=other, name="resolver-1").start()
+        assert done.wait(5.0)
+        doc = tracing.to_chrome_trace(provider.snapshot())
+        thr = {e["args"]["name"]: (e["pid"], e["tid"])
+               for e in doc["traceEvents"]
+               if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert "resolver-1" in thr
+        assert threading.current_thread().name in thr
+        # distinct threads get distinct tid lanes within the process
+        assert len({t for _, t in thr.values()}) == len(thr)
+
+
+# -- endpoints --------------------------------------------------------------
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10.0) as resp:
+        return resp.read()
+
+
+class TestDebugEndpoints:
+    def test_apiserver_debug_timeline(self):
+        from kubernetes_tpu.apiserver import APIServer
+        from kubernetes_tpu.store import kv
+        tl = tlmod.default_timeline
+        tl.configure(enabled=True)
+        try:
+            tl.record("device-step", time.monotonic(),
+                      time.monotonic() + 0.01, wave=2)
+            server = APIServer(kv.MemoryStore()).start()
+            try:
+                doc = json.loads(_get(
+                    f"http://127.0.0.1:{server.port}/debug/timeline"))
+                assert doc["enabled"] is True
+                assert doc["stages"].get("device-step", 0) >= 1
+                assert doc["device_idle_share"] is not None
+                assert doc["interval_rows"]
+                chrome = json.loads(_get(
+                    f"http://127.0.0.1:{server.port}"
+                    "/debug/timeline?format=chrome"))
+                assert any(e["ph"] == "X"
+                           for e in chrome["traceEvents"])
+                assert any(e["ph"] == "M"
+                           and e["name"] == "process_name"
+                           for e in chrome["traceEvents"])
+            finally:
+                server.stop()
+        finally:
+            tl.configure(enabled=False)
+            tl.reset()
+
+    def test_device_worker_debug_timeline(self):
+        from kubernetes_tpu.ops.remote import DeviceWorker
+        w = DeviceWorker().start()
+        try:
+            # the worker ring is always on (like its flight recorder)
+            w._core.timeline.record("device-step", time.monotonic(),
+                                    time.monotonic() + 0.005)
+            doc = json.loads(_get(w.url + "/debug/timeline"))
+            assert doc["enabled"] is True
+            assert doc["proc"] == "worker"
+            assert doc["stages"].get("device-step", 0) >= 1
+            chrome = json.loads(_get(w.url + "/debug/timeline?format=chrome"))
+            names = {e["args"]["name"] for e in chrome["traceEvents"]
+                     if e["ph"] == "M" and e["name"] == "process_name"}
+            assert "worker" in names
+        finally:
+            w.stop()
+
+
+# -- remote seam ------------------------------------------------------------
+
+
+class TestRemoteSeamDrain:
+    def test_timeline_verb_epoch_blind_and_draining(self):
+        """/timeline is served like /health: before /init, epoch-blind,
+        no seq — and it drains (second pull is empty)."""
+        from kubernetes_tpu.ops.remote import _WorkerCore
+        core = _WorkerCore()
+        t = time.monotonic()
+        core.timeline.record("device-step", t, t + 0.01)
+        out, epoch = core.handle("/timeline", b"")
+        assert epoch == core._epoch
+        assert len(out["intervals"]) == 1
+        row = out["intervals"][0]
+        assert row["proc"] == "worker" and row["stage"] == "device-step"
+        out2, _ = core.handle("/timeline", b"")
+        assert out2["intervals"] == []
+
+    def test_clock_merge_round_trip(self):
+        """The full seam: a real batch through RemoteTPUBatchBackend, the
+        worker's device-step intervals drained over /timeline and
+        ingested into a scheduler-side Timeline — merged rows carry
+        coherent wall clocks (both anchors map into the test's own
+        wall-clock window), so union math over the merged set is sane."""
+        from kubernetes_tpu.ops.flatten import Caps
+        from kubernetes_tpu.ops.remote import (
+            DeviceWorker, RemoteTPUBatchBackend,
+        )
+        from kubernetes_tpu.scheduler.cache import Cache, Snapshot
+        from kubernetes_tpu.scheduler.types import PodInfo
+        from kubernetes_tpu.testing import make_node, make_pod
+
+        w = DeviceWorker().start()
+        try:
+            wall_before = time.time()
+            caps = Caps(n_cap=32, l_cap=64, kl_cap=32, t_cap=8, pt_cap=8,
+                        s_cap=2, sg_cap=8, asg_cap=8)
+            remote = RemoteTPUBatchBackend(w.url, caps, batch_size=8)
+            cache = Cache()
+            for i in range(4):
+                cache.add_node(make_node(f"n{i}").capacity(
+                    cpu="4", mem="16Gi").build())
+            snap = cache.update_snapshot(Snapshot())
+            pods = [PodInfo(make_pod(f"p{i}").req(cpu="100m").build())
+                    for i in range(8)]
+            out = remote.assign(pods, snap)
+            assert any(n for n, _ in out)
+            rows = remote.drain_worker_timeline()
+            wall_after = time.time()
+            assert rows, "worker recorded no device-step intervals"
+            assert all(r["stage"] == "device-step" for r in rows)
+            assert all(r["proc"] == "worker" for r in rows)
+            # clock-merge contract: worker rows are wall-anchored by the
+            # worker's own clock and land inside the observed window
+            for r in rows:
+                assert wall_before - 1.0 <= r["t0_unix_s"] \
+                    <= r["t1_unix_s"] <= wall_after + 1.0
+            sched_tl = Timeline(enabled=True, proc="scheduler")
+            assert sched_tl.ingest(rows) == len(rows)
+            merged = sched_tl.intervals()
+            assert device_idle_share(merged) is not None
+            # drained: the seam moves each interval exactly once
+            assert remote.drain_worker_timeline() == []
+        finally:
+            w.stop()
+
+
+# -- the armed pipeline -----------------------------------------------------
+
+
+def _shrunk_basic(nodes: int, pods: int, timeout: float = 120.0) -> dict:
+    import copy
+
+    from kubernetes_tpu.perf import load_workloads
+    from kubernetes_tpu.perf.scheduler_perf import is_measured
+    cfg = copy.deepcopy(load_workloads()["SchedulingBasicLarge"])
+    tpl = cfg["workloadTemplate"]
+    for op in tpl:
+        if op["opcode"] == "createNodes":
+            op["count"] = nodes
+        elif op["opcode"] == "createPods" and is_measured(op, tpl):
+            op["count"] = pods
+        elif op["opcode"] == "barrier":
+            op["timeout"] = timeout
+    return cfg
+
+
+class TestArmedPipeline:
+    def test_decomposition_telescopes_within_one_percent(self):
+        """The acceptance criterion: a real (null-device) workload with
+        profiling.timeline armed yields per-pod segments whose sum equals
+        the pod's e2e within 1%, plus a non-None idle share and segment
+        quantiles covering every bound pod."""
+        from kubernetes_tpu.perf import caps_for_nodes
+        from kubernetes_tpu.perf.scheduler_perf import run_named_workload
+        from kubernetes_tpu.scheduler.config import ProfilingPolicy
+
+        summary, stats = run_named_workload(
+            _shrunk_basic(50, 400), tpu=True, caps=caps_for_nodes(50),
+            batch_size=128, null_device=True,
+            profiling_policy=ProfilingPolicy(timeline=True))
+        assert stats.get("barrier_ok"), stats
+        tl_stats = stats.get("timeline")
+        assert tl_stats, "perf harness did not surface timeline stats"
+        assert tl_stats["device_idle_share"] is not None
+        assert tl_stats["intervals"] > 0
+        stages = set(tl_stats["stages"])
+        assert {"batch-form", "resolve", "bind-commit"} <= stages, stages
+        # per-pod rows: segments telescope to e2e (exact by construction;
+        # the 1% bound is the acceptance ceiling)
+        rows = tlmod.default_timeline.pods()
+        assert rows, "no pods decomposed"
+        for row in rows:
+            seg_sum = sum(row["segments_ms"].values())
+            assert seg_sum == pytest.approx(row["e2e_ms"],
+                                            rel=0.01, abs=1e-6)
+            assert all(v >= 0.0 for v in row["segments_ms"].values())
+        # segment quantiles cover every decomposed pod
+        segsum = tl_stats["segments"]
+        assert segsum and all(
+            s["count"] == len(rows) for s in segsum.values())
+        assert set(segsum) <= set(tlmod.POD_SEGMENTS)
+        # metrics surface: the gauges land on the exposition page
+        tlmod.default_timeline.configure(enabled=False)
+        tlmod.default_timeline.reset()
+
+    def test_default_off_leaves_ring_empty(self):
+        """No profiling stanza -> no intervals, no pod rows, no segment
+        storage: the observatory costs one attribute read when off."""
+        from kubernetes_tpu.perf import caps_for_nodes
+        from kubernetes_tpu.perf.scheduler_perf import run_named_workload
+
+        tlmod.default_timeline.reset()
+        summary, stats = run_named_workload(
+            _shrunk_basic(20, 100), tpu=True, caps=caps_for_nodes(20),
+            batch_size=64, null_device=True)
+        assert stats.get("barrier_ok"), stats
+        assert "timeline" not in stats
+        assert tlmod.default_timeline.intervals() == []
+        assert tlmod.default_timeline.pods() == []
+
+
+@pytest.mark.slow
+class TestOverheadAB:
+    def test_armed_overhead_within_five_percent(self):
+        """The ≤5% pin (ISSUE acceptance): paired rounds of the
+        null-device workload, armed vs off, compared at the median of
+        per-round ratios.  Measurement traps this test deliberately
+        avoids (each produced false >1.05x readings in earlier cuts):
+        BOTH arms get an untimed warmup pass, because the first armed
+        round otherwise pays one-time numpy dispatch / interpreter
+        specialization inside its window; the order within each pair
+        alternates, so allocator/cache position bias can't favor one
+        arm; the window is a couple of seconds, because the harness
+        barrier used to quantize window ends at its 50 ms poll (now
+        fixed in ThroughputCollector.freeze — the window closes at the
+        drain that saw the final bind); and the pin compares a median
+        of PAIRED ratios, because throughput on a loaded 1-CPU runner
+        drifts ±7% over the test's lifetime — pairing cancels the
+        drift, a best-of or mean happily compares an off-arm outlier
+        against a typical armed round.  The product side holds up its
+        end by keeping the armed bind path to one fromiter and two
+        block appends: the clamp chain, histogram ingest and segment
+        series are all derived lazily at read time
+        (timeline.derive_segment_cols / SchedulerMetrics._flush_segments),
+        because an earlier eager cut — even fully vectorized — cost a
+        real ~3%, and a per-pod-boxing cut before that dragged extra
+        gc passes over the whole harness object graph, a ~5% tax the
+        profiler attributed to everything *but* the timeline."""
+        import statistics
+        from kubernetes_tpu.perf import caps_for_nodes
+        from kubernetes_tpu.perf.scheduler_perf import run_named_workload
+        from kubernetes_tpu.scheduler.config import ProfilingPolicy
+
+        caps = caps_for_nodes(500)
+        ARMED = ProfilingPolicy(timeline=True)
+
+        def one(policy):
+            summary, stats = run_named_workload(
+                _shrunk_basic(500, 40000, timeout=300.0), tpu=True,
+                caps=caps, batch_size=512, null_device=True,
+                profiling_policy=policy)
+            assert stats.get("barrier_ok"), stats
+            return summary.average
+
+        one(None)                                   # warmup, untimed,
+        one(ARMED)                                  # for BOTH arms
+        ratios, rounds = [], []
+        for i in range(6):
+            if i % 2 == 0:
+                a = one(ARMED)
+                o = one(None)
+            else:
+                o = one(None)
+                a = one(ARMED)
+            rounds.append((round(a), round(o)))
+            ratios.append(o / max(a, 1e-9))
+        tlmod.default_timeline.configure(enabled=False)
+        tlmod.default_timeline.reset()
+        ratio = statistics.median(ratios)
+        assert ratio <= 1.05, (
+            f"timeline overhead {ratio:.3f}x exceeds the 5% budget "
+            f"(median of paired off/armed ratios "
+            f"{[round(r, 3) for r in ratios]}; (armed, off) pods/s "
+            f"per round: {rounds})")
+
+
+# -- cross-process federation ----------------------------------------------
+
+
+@pytest.mark.proc
+class TestProcFederation:
+    def test_federation_under_seeded_churn(self, proc_reaper):
+        """Two timeline-armed scheduler processes over the wire
+        apiserver: each child's /debug/timeline serves its own ring, the
+        supervisor federates them into one Timeline with per-child proc
+        lanes, supervisor_metrics_text carries per-child idle-share
+        samples — and after the seeded churner SIGKILLs one child, the
+        surviving lane still federates (the dead one is skipped, not
+        fatal)."""
+        from kubernetes_tpu.client.clientset import NODES, PODS
+        from kubernetes_tpu.ops.faults import (
+            KILL_INSTANCE, ProcessChurner, ScaleOutSchedule,
+        )
+        from kubernetes_tpu.scheduler.procrun import (
+            ProcCluster, WireBindLedger,
+        )
+        from kubernetes_tpu.testing import make_node, make_pod
+
+        env = {"KTPU_PROC_TIMELINE": "1"}
+        cluster = ProcCluster(2, backend="null", nodes=8,
+                              child_env={0: env, 1: env})
+        proc_reaper(cluster)
+        cluster.start()
+        admin = cluster.admin_client()
+        for i in range(8):
+            admin.create(NODES, make_node(f"n{i}").capacity(
+                cpu="16", mem="64Gi", pods=110).build())
+        ledger = WireBindLedger(admin)
+        for i in range(40):
+            admin.create(PODS, make_pod(f"p{i}").req(cpu="100m").build())
+
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline and ledger.bound_total() < 40:
+            time.sleep(0.1)
+        assert ledger.bound_total() >= 40
+        # ledger observation wall times back the watch stitching
+        assert len(ledger.observed_at) >= 40
+        assert all(v <= time.time() for v in ledger.observed_at.values())
+
+        snaps = cluster.timeline_snapshots()
+        assert sorted(snaps) == [0, 1]
+        assert all(doc["enabled"] for doc in snaps.values())
+        assert any(doc["interval_rows"] for doc in snaps.values()), \
+            "no child recorded intervals"
+        fed = cluster.federated_timeline()
+        rows = fed.intervals()
+        procs = {r["proc"] for r in rows}
+        assert procs and procs <= {"sched0", "sched1"}
+        assert device_idle_share(rows) is not None
+        text = cluster.supervisor_metrics_text()
+        assert "scheduler_proc_wave_device_idle_share" in text
+
+        # churn: SIGKILL child 0; federation degrades to the survivor
+        churner = ProcessChurner(
+            cluster,
+            ScaleOutSchedule(seed=11, instance_count=2,
+                             script={0: (KILL_INSTANCE, 0)}),
+            min_live=1)
+        assert churner.step() == (KILL_INSTANCE, 0)
+        assert not cluster.alive(0) and cluster.alive(1)
+        snaps = cluster.timeline_snapshots()
+        assert sorted(snaps) == [1]
+        fed = cluster.federated_timeline()
+        assert {r["proc"] for r in fed.intervals()} <= {"sched1"}
+        ledger.stop()

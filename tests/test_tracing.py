@@ -8,6 +8,7 @@ Runs on CPU with 8 virtual devices (tests/conftest.py).
 """
 
 import json
+import threading
 import time
 import urllib.request
 
@@ -208,12 +209,18 @@ class TestChromeExport:
         doc = tracing.to_chrome_trace(provider.snapshot())
         events = doc["traceEvents"]
         metas = [e for e in events if e["ph"] == "M"]
-        assert {m["args"]["name"] for m in metas} == {"scheduler", "worker"}
+        assert {m["args"]["name"] for m in metas
+                if m["name"] == "process_name"} == {"scheduler", "worker"}
+        # every (pid, tid) lane carries the recording thread's name
+        thread_metas = [m for m in metas if m["name"] == "thread_name"]
+        assert {m["args"]["name"] for m in thread_metas} \
+            == {threading.current_thread().name}
         xs = {e["name"]: e for e in events if e["ph"] == "X"}
         assert set(xs) == {"schedule_batch", "worker.step"}
-        # distinct pid lanes per process, one tid per trace
+        # distinct pid lanes per process; tids lane per (pid, thread)
         assert xs["schedule_batch"]["pid"] != xs["worker.step"]["pid"]
-        assert xs["schedule_batch"]["tid"] == xs["worker.step"]["tid"]
+        assert {(m["pid"], m["tid"]) for m in thread_metas} \
+            == {(e["pid"], e["tid"]) for e in xs.values()}
         for e in xs.values():
             assert e["ts"] > 0 and e["dur"] >= 0          # microseconds
         (instant,) = [e for e in events if e["ph"] == "i"]

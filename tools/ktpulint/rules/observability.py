@@ -14,7 +14,9 @@ import ast
 import re
 from typing import Iterator
 
-from ..engine import Finding, LintContext, Rule, register
+from ..engine import (
+    FileView, Finding, LintContext, Rule, register, walk_functions,
+)
 
 _METRIC_KINDS = ("Counter", "Gauge", "Histogram")
 _TABLE_NAME_RE = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`")
@@ -199,3 +201,61 @@ class ProfilingGatedRule(Rule):
                             "profiling-stanza guard (if ...profiling/"
                             "census... :) — the observatory must stay "
                             "default-off")
+
+
+@register
+class TimelineStagePairedRule(Rule):
+    """Every `timeline.begin(stage)` call site is either context-managed
+    (`with tl.begin(...)` / `with tl.stage(...)`) or its enclosing
+    function's subtree also calls `.end(` — the timeline twin of
+    span-lifecycle.  A begun stage that never ends never commits an
+    interval, so the wave silently loses that stage from the idle-share
+    union and the /debug/timeline lanes (worse than a crash: the math
+    still runs, on a hole).  The retroactive `record(t0, t1)` form is
+    exempt — it commits atomically."""
+
+    name = "timeline-stage-paired"
+    doc = "timeline.begin sites are context-managed or .end()ed"
+
+    @staticmethod
+    def _is_timeline_begin(call: ast.Call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "begin"):
+            return False
+        # walk the receiver's dotted path: tl.begin, timeline.begin,
+        # self._timeline.begin, cb_timeline.default_timeline.begin, ...
+        parts: list[str] = []
+        recv = f.value
+        while isinstance(recv, ast.Attribute):
+            parts.append(recv.attr)
+            recv = recv.value
+        if isinstance(recv, ast.Name):
+            parts.append(recv.id)
+        return any(p == "tl" or "timeline" in p.lower() for p in parts)
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if "begin(" not in view.text or view.tree is None:
+            return
+        for fn in walk_functions(view.tree):
+            begins = [n for n in ast.walk(fn)
+                      if isinstance(n, ast.Call)
+                      and self._is_timeline_begin(n)]
+            if not begins:
+                continue
+            managed = any(
+                isinstance(n, ast.With)
+                and any(isinstance(item.context_expr, ast.Call)
+                        and self._is_timeline_begin(item.context_expr)
+                        for item in n.items)
+                for n in ast.walk(fn))
+            ended = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "end"
+                for n in ast.walk(fn))
+            if not (managed or ended):
+                yield self.finding(
+                    view, begins[0].lineno,
+                    f"{fn.name} begins a timeline stage but neither "
+                    "context-manages the token nor .end()s it — the "
+                    "interval never commits")
