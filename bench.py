@@ -44,6 +44,21 @@ import time
 
 BASELINE_PODS_PER_SEC = 300.0
 
+
+def scaled_timeout(pods: int | None, base: float = 900.0) -> float:
+    """Barrier/freeze budget scaled with the measured pod count.
+
+    The flat 900 s default was sized for ~50k-pod tiers; a 200k-pod
+    headline pass under bad tunnel weather can legitimately need more
+    wall than that (the r06 run expired its 1800 s barrier mid-drain),
+    while small paced rows should keep failing fast.  The scale term is
+    ~100 pods/s — the worst healthy whole-run rate observed on the
+    1-CPU box at the 100k tier — plus a fixed setup allowance; `base`
+    stays the floor so no existing config gets a SHORTER budget."""
+    if not pods:
+        return base
+    return max(base, 60.0 + pods / 100.0)
+
 N_NODES = int(os.environ.get("BENCH_NODES", "5000"))
 # 50k pods: at ~10k+ pods/s a 20k-pod run is half pipeline ramp; 50k gives
 # ~5s of steady state under the 1s sampling window
@@ -651,6 +666,80 @@ def run_timeline(out_path: str | None = None) -> dict:
     }
 
 
+def run_pipeline_ab() -> dict:
+    """--pipeline-ab mode: the wave-pipeline acceptance A/B.
+
+    The identical SchedulingBasicLarge pass at depth 1 (serial wave
+    loop, the bit-parity baseline arm — tests/test_churn_parity.py pins
+    that both depths produce identical assignments) and depth 2 (the
+    double-buffered pipeline: host drain/patch/form/h2d of wave N+1
+    overlaps wave N's device step, binds absorbed by the binder
+    worker), both with the timeline ring armed so each arm carries its
+    union-derived device_idle_share and per-stage overlap.  In-process
+    by design (same trade as --timeline): one warmed interpreter +
+    device for both arms.  The acceptance bars ride the small tier,
+    where round 15 measured the device idle 22.8% of the wall:
+    depth-2 idle share < 0.20 and throughput ≥ 1.3x the depth-1 arm."""
+    import copy
+
+    from kubernetes_tpu.perf import (
+        caps_for_nodes, load_workloads, run_named_workload,
+    )
+    from kubernetes_tpu.perf.scheduler_perf import is_measured
+    from kubernetes_tpu.scheduler.config import ProfilingPolicy
+
+    nodes = int(os.environ.get("BENCH_PIPELINE_NODES", "1000"))
+    pods = int(os.environ.get("BENCH_PIPELINE_PODS", "5000"))
+    batch = int(os.environ.get("BENCH_PIPELINE_BATCH", "1024"))
+    # Off-host flight arm (ops/nullbackend.FlightDelayBackend): pins
+    # every wave's device flight to this wall duration at ~zero host
+    # CPU, the shape a real accelerator presents.  On a single-core box
+    # the CPU-simulated device shares the core with the host, so the
+    # depth-2 overlap is physically impossible to measure without it —
+    # 0 keeps the plain CPU-sim arms.
+    flight_ms = float(os.environ.get("BENCH_PIPELINE_FLIGHT_MS", "0"))
+
+    def build_cfg() -> dict:
+        cfg = copy.deepcopy(load_workloads()["SchedulingBasicLarge"])
+        tpl = cfg["workloadTemplate"]
+        for op in tpl:
+            if op["opcode"] == "createNodes":
+                op["count"] = nodes
+            elif op["opcode"] == "createPods" and is_measured(op, tpl):
+                op["count"] = pods
+            elif op["opcode"] == "barrier":
+                op["timeout"] = scaled_timeout(pods, 600.0)
+        return cfg
+
+    caps = caps_for_nodes(nodes)
+    out: dict = {"nodes": nodes, "pods": pods, "batch": batch,
+                 "flight_ms": flight_ms}
+    # depth-2 warm pass (untimed): both arms then run against a warmed
+    # interpreter/jit cache, so the A/B isn't depth-2-pays-compile
+    run_named_workload(build_cfg(), tpu=True, caps=caps, batch_size=batch,
+                       pipeline_depth=2)
+    for tag, depth in (("depth1", 1), ("depth2", 2)):
+        summary, stats = run_named_workload(
+            build_cfg(), tpu=True, caps=caps, batch_size=batch,
+            pipeline_depth=depth,
+            device_flight_s=flight_ms / 1000.0,
+            profiling_policy=ProfilingPolicy(timeline=True))
+        tl = stats.get("timeline") or {}
+        e2e = stats.get("e2e") or {}
+        out[tag] = {
+            "pods_per_s": round(summary.average, 1),
+            "p50_ms": e2e.get("p50_ms"), "p95_ms": e2e.get("p95_ms"),
+            "p99_ms": e2e.get("p99_ms"),
+            "device_idle_share": tl.get("device_idle_share"),
+            "stage_overlap": tl.get("overlap"),
+            "barrier_ok": stats.get("barrier_ok", False),
+        }
+    d1, d2 = out["depth1"], out["depth2"]
+    out["speedup"] = round(
+        d2["pods_per_s"] / max(d1["pods_per_s"], 1e-9), 3)
+    return out
+
+
 def run_overload() -> dict:
     """--overload mode: the SchedulingOverloadFlood workload under the
     seeded chaos schedule, A/B WITH the overload policy (bounded
@@ -1147,10 +1236,17 @@ def run_once(workload: str, nodes: int | None, pods: int | None,
             op["count"] = nodes
         elif op["opcode"] == "createPods" and measured and pods is not None:
             op["count"] = pods
-        elif op["opcode"] == "barrier":
-            op["timeout"] = barrier_timeout
         if op["opcode"] == "createPods" and measured and rate:
             op["ratePerSecond"] = rate
+    # barrier/freeze budget scales with the measured pod count (the
+    # config's timeout stays the floor): set AFTER the count overrides
+    # so the scale sees the pods that will actually be created
+    n_measured = sum(op["count"] for op in tpl
+                     if op["opcode"] == "createPods"
+                     and is_measured(op, tpl))
+    for op in tpl:
+        if op["opcode"] == "barrier":
+            op["timeout"] = scaled_timeout(n_measured, barrier_timeout)
     n_nodes = next(op["count"] for op in cfg["workloadTemplate"]
                    if op["opcode"] == "createNodes")
 
@@ -1398,6 +1494,13 @@ def main() -> None:
         res = run_timeline(out)
         emit(res["timed_pods_per_s"], {"mode": "timeline", **res})
         return
+    if "--pipeline-ab" in sys.argv:
+        # in-process A/B by design (same trade as --timeline): both
+        # depths share one warmed interpreter + device so the pipeline
+        # gap isn't polluted by a second cold start
+        res = run_pipeline_ab()
+        emit(res["depth2"]["pods_per_s"], {"mode": "pipeline_ab", **res})
+        return
     if "--overload" in sys.argv:
         # in-process A/B by design (same trade as --trace): both sides
         # share one warmed interpreter + device so the policy gap isn't
@@ -1483,7 +1586,8 @@ def main() -> None:
                 "pods": head_pods, "batch": BATCH, "depth": DEPTH,
                 "timeout": 1800.0, "backend": backend_kind, "census": True,
                 "timeline": True}
-    head = _spawn_child(_config_env(head_cfg), timeout=2100.0)
+    head = _spawn_child(_config_env(head_cfg),
+                        timeout=scaled_timeout(head_pods, 1800.0) + 300)
     if head is None:
         emit(0.0, {"error": "bench headline child failed twice"})
         sys.exit(1)
@@ -1501,9 +1605,10 @@ def main() -> None:
                  "_BENCH_W_BATCH": str(BATCH),
                  "_BENCH_W_DEPTH": str(DEPTH)}
     for _ in range(n_runs):
-        # margin over the child's 900s barrier so a stuck child still
-        # gets to emit its own error JSON before the parent gives up
-        got = _spawn_child(basic_env, timeout=1200.0)
+        # margin over the child's (pod-scaled) barrier so a stuck child
+        # still gets to emit its own error JSON before the parent gives up
+        got = _spawn_child(basic_env,
+                           timeout=scaled_timeout(N_PODS, 900.0) + 300)
         if got is None:
             emit(0.0, {"error": "bench child failed twice"})
             sys.exit(1)
@@ -1525,7 +1630,9 @@ def main() -> None:
                                   if got else {"error": "failed"})
                 continue
             env = _config_env(c)
-            got = _spawn_child(env, timeout=c.get("timeout", 900.0) + 300)
+            got = _spawn_child(
+                env, timeout=scaled_timeout(
+                    c.get("pods"), c.get("timeout", 900.0)) + 300)
             # best-of-2 for the quick configs that opt in ("two_pass"):
             # the tunnel's round-trip latency drifts 2-3x over minutes,
             # and one pass landing in a bad-weather window misreports

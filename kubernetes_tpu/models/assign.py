@@ -995,8 +995,14 @@ def _bits(mask_2d: np.ndarray) -> np.ndarray:
 
 def pack_pod_batch(batch, spec: PackSpec,
                    patch_rows: np.ndarray | None = None,
-                   patch_vals: np.ndarray | None = None) -> np.ndarray:
-    """PodBatch (+ optional row patches) -> single 1-D f32 buffer."""
+                   patch_vals: np.ndarray | None = None,
+                   out: np.ndarray | None = None) -> np.ndarray:
+    """PodBatch (+ optional row patches) -> single 1-D f32 buffer.
+
+    `out`, when given, is a preallocated f32[spec.total] staging buffer
+    (the backend's ping-pong ring): every slot is overwritten here, so a
+    recycled buffer needs no clearing, and the final concatenate-copy of
+    the allocate-per-wave path is skipped."""
     caps, P, K = spec.caps, spec.p_cap, spec.k_cap
     C, G, KG = caps.c_cap, caps.g_cap, caps.kg_cap
     if spec.plain:
@@ -1011,9 +1017,7 @@ def pack_pod_batch(batch, spec: PackSpec,
             n = min(len(patch_rows), K)
             rows[:n] = patch_rows[:n]
             vals[:n] = patch_vals[:n]
-        return np.concatenate([
-            pf.ravel(), pi.view(np.float32).ravel(),
-            rows.view(np.float32), vals.ravel()]).astype(np.float32)
+        return _pack_out(spec, pf, pi, rows, vals, out)
     # full wire format: materialize any lazy (None == zeros) fields the
     # dense layout ships (see flatten.PodBatch laziness contract)
     for _nm in ("untol_prefer", "ports", "key_forb", "match_asg", "inc_asg",
@@ -1052,9 +1056,23 @@ def pack_pod_batch(batch, spec: PackSpec,
         n = min(len(patch_rows), K)
         rows[:n] = patch_rows[:n]
         vals[:n] = patch_vals[:n]
-    return np.concatenate([
-        pf.ravel(), pi.view(np.float32).ravel(),
-        rows.view(np.float32), vals.ravel()]).astype(np.float32)
+    return _pack_out(spec, pf, pi, rows, vals, out)
+
+
+def _pack_out(spec: PackSpec, pf, pi, rows, vals,
+              out: np.ndarray | None) -> np.ndarray:
+    """Assemble the wire buffer: concatenate (fresh allocation) or fill
+    `out` segment-by-segment — each segment is fully overwritten."""
+    if out is None:
+        return np.concatenate([
+            pf.ravel(), pi.view(np.float32).ravel(),
+            rows.view(np.float32), vals.ravel()]).astype(np.float32)
+    a, b, K = spec.a, spec.b, spec.k_cap
+    out[:a] = pf.ravel()
+    out[a:a + b] = pi.view(np.float32).ravel()
+    out[a + b:a + b + K] = rows.view(np.float32)
+    out[a + b + K:] = vals.ravel()
+    return out
 
 
 def _unpack(buf, spec: PackSpec, features: frozenset = ALL_FEATURES):
@@ -1202,8 +1220,14 @@ def build_packed_assign_fn(caps: Caps, p_cap: int, k_cap: int = 1024,
                            features)
 
     # compile-cached: built once per Caps at backend setup; one resident
-    # jit cache serves every wave against the packed transport
-    @functools.partial(jax.jit, donate_argnums=0)
+    # jit cache serves every wave against the packed transport.  The
+    # packed upload (argnum 2) is donated alongside the resident state:
+    # with two waves in flight the device would otherwise hold both
+    # waves' upload buffers live for the full step — donation lets XLA
+    # reclaim the transport the moment the unpack consumes it, keeping
+    # HBM flat at any pipeline depth (the host keeps its own staging
+    # copy for fenced re-runs, so nothing re-reads the device buffer).
+    @functools.partial(jax.jit, donate_argnums=(0, 2))
     def fn(state, static_node, buf):
         gen = state["gen"] + 1
         dyn = {k: state[k] for k in AGGREGATE_KEYS}

@@ -342,7 +342,9 @@ class ResidentHostMirror:
                     "flatten_seconds", 0.0) + (time.monotonic() - t_sync)
             self._carry_dirty |= dirty
             self._last_epoch = epoch
-            self._maybe_compact()
+            # no compaction here — idle-prefetch timing is wall-clock
+            # driven; reclamation happens at the dispatch gate so row
+            # reuse order is a pure function of the wave/event stream
 
     def _needs_full(self, batch: PodBatch) -> bool:
         """Batches using selectors/constraints/ports/pins need the
@@ -477,12 +479,19 @@ class ResidentHostMirror:
                 self._carry_dirty.add(row)
                 self.stats["event_patches"] = self.stats.get(
                     "event_patches", 0) + 1
-            self._maybe_compact()
+            # NO compaction here: event arrival time relative to the
+            # in-flight window depends on pipeline depth, and compaction
+            # order is visible in row tie-breaks (see the dispatch gate,
+            # which reclaims at the wave boundary deterministically)
 
     def _maybe_compact(self) -> None:
-        """Reclaim tombstoned row slots between waves (caller holds the
-        backend lock).  Skipped while any wave is in flight: an in-flight
-        batch resolves against rows captured by index at dispatch."""
+        """Reclaim tombstoned row slots (caller holds the backend lock).
+        Skipped while any wave is in flight: an in-flight batch resolves
+        against rows captured by index at dispatch.  Only the warm-start
+        sweep calls this (boot-time, before any wave, so deterministic);
+        steady-state reclamation lives in the dispatch gate, where it is
+        anchored to the wave boundary and cannot vary with pipeline
+        depth."""
         t = self.tensors
         if self._unresolved:
             return
@@ -686,6 +695,10 @@ class ResidentHostMirror:
             self._unresolved = []
             self._carry_dirty = set()
             self._last_epoch = None
+            if hasattr(self, "_fence_pending"):
+                self._fence_pending = 0
+            if hasattr(self, "_stage_pins"):
+                self._stage_pins.clear()
             if hasattr(self, "_journal"):
                 # remote seam: the replay journal and the ready-to-post
                 # checkpoint bodies describe the PRE-restart state
@@ -852,6 +865,24 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         # resolve whose result tail disagrees proves the wave chained on
         # state the host never committed (lost patch / restored worker)
         self._gen = 0
+        # steady-state pipeline fence: >0 while a fenced wave (one that
+        # dispatched with mid-pipeline patches deliberately excluded from
+        # its upload) has not yet resolved.  Its first device run is
+        # known-stale by construction — the extra gen bump at dispatch
+        # guarantees the fence trips — and the authoritative result comes
+        # from the mirror-restored re-run at its resolve.  While a fence
+        # is pending, further patch-carrying dispatches FLUSH_FIRST: a
+        # second fence would have to replay against a mirror the pending
+        # one has not finished restoring.
+        self._fence_pending = 0
+        # host staging ring for packed upload buffers: the device copy is
+        # DONATED to the step (HBM stays flat at any pipeline depth), and
+        # the host buffer is recycled wave-to-wave instead of allocated
+        # per dispatch.  Pinned ids are buffers a dispatched-but-
+        # unresolved wave retains for a possible fenced re-run — the ring
+        # never hands those out.
+        self._stage_ring: list[np.ndarray] = []
+        self._stage_pins: set[int] = set()
         self.stats = {"batches": 0, "full_refresh": 0, "patched_rows": 0,
                       "waves": 0, "flush_first": 0, "waves_patched": 0,
                       "waves_reflattened": 0, "event_patches": 0,
@@ -975,6 +1006,24 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             "nom_used": np.zeros((1, c.n_cap, c.r), np.float32),
             "nom_np": np.zeros((1, c.n_cap), np.float32),
             "active": np.zeros(P, bool)})
+
+    def _stage_buf(self, total: int) -> np.ndarray:
+        """Hand out a host staging buffer of `total` f32 slots from the
+        ping-pong ring (caller holds the lock).  The buffer is PINNED
+        until the wave that packed into it resolves or is abandoned: an
+        unresolved wave retains its buffer for a possible fenced re-run,
+        so recycling it early would corrupt the replay.  The ring is
+        bounded — under deep latency-mode pipelines overflow buffers are
+        plain one-shot allocations that die with their wave."""
+        for arr in self._stage_ring:
+            if arr.size == total and id(arr) not in self._stage_pins:
+                self._stage_pins.add(id(arr))
+                return arr
+        arr = np.empty(total, np.float32)
+        if len(self._stage_ring) < 16:
+            self._stage_ring.append(arr)
+        self._stage_pins.add(id(arr))
+        return arr
 
     def _device_step(self, variant: str, buf: np.ndarray):
         """Run one packed batch through the device and return the result
@@ -1229,6 +1278,11 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             self._unresolved.clear()
             self._state = None
             self._last_epoch = None
+            # the dropped chain takes any pending fence and retained
+            # staging buffers with it: orphan resolves are ignored, and
+            # the next dispatch full-refreshes from the cache view anyway
+            self._fence_pending = 0
+            self._stage_pins.clear()
             self.stats["abandoned_waves"] = (
                 self.stats.get("abandoned_waves", 0) + 1)
         finally:
@@ -1299,13 +1353,15 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
 
         The device call is dispatched but NOT waited on; the caller can
         overlap host work with the device round trip and call resolve() when
-        it needs the answers.  Pipelining over an in-flight batch is only
-        allowed when this batch needs NO row patches, no refresh, and no
-        static re-upload: in that state the device chains its own resident
-        accounting (donated state) and the host mirror/authoritative pair
-        agree, so nothing the in-flight batch committed can be clobbered.
-        Otherwise dispatch returns FLUSH_FIRST: the caller must resolve the
-        in-flight batch AND finish its assume tail (so the authoritative
+        it needs the answers.  Pipelining over an in-flight batch is allowed
+        when this batch is clean (no patches, no refresh, no static change —
+        the device chains its own resident accounting via the donated state)
+        OR when it needs only dynamic row patches and no fence is already
+        pending: that wave dispatches FENCED — gen-bumped so its first
+        device run provably goes stale and the authoritative answer comes
+        from the mirror-restored re-run at its resolve.  A full refresh or
+        a static change returns FLUSH_FIRST instead: the caller must resolve
+        the in-flight batch AND finish its assume tail (so the authoritative
         tensors catch up with the mirror), then call dispatch again — the
         dirty rows from this attempt are carried over so no external change
         is lost."""
@@ -1425,6 +1481,22 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 sc_sp.end()
 
             inflight = bool(self._unresolved)
+            # deterministic compaction point: compact() feeds reclaimed
+            # slots to the free list, and free-list order decides which
+            # row the next node add occupies — visible in device argmax
+            # tie-breaks.  Anchoring reclamation to the wave boundary
+            # (draining the pipeline first) keeps depth-1 and depth-2
+            # runs bit-identical; event-time compaction fired only when
+            # the pipeline happened to be idle, which depends on depth.
+            if (self.tensors.tombstone_count() * self.COMPACT_TOMBSTONE_DIV
+                    >= self.caps.n_cap):
+                if inflight:
+                    self._carry_dirty = dirty
+                    self.stats["flush_first"] += 1
+                    return FLUSH_FIRST
+                if self.tensors.compact():
+                    self.stats["compactions"] = self.stats.get(
+                        "compactions", 0) + 1
             static_changed = self._static_version != self.tensors.static_version
             if skip_sync and not static_changed:
                 patches = (np.empty(0, np.int32),
@@ -1439,12 +1511,36 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                         patches = self._diff_patches(sorted(dirty))
                 needs_refresh = self._state is None or patches is None
                 needs_patch = patches is not None and len(patches[0]) > 0
-            if inflight and (static_changed or needs_refresh or needs_patch):
+            # pipeline admission: a full re-encode can never overlap an
+            # in-flight wave (the mirror it would rebuild is mid-replay),
+            # and only ONE fenced wave may ride the pipeline at a time.
+            # A dynamic row patch while clean becomes a FENCED dispatch
+            # instead of a flush: the patch lands in the mirror now, gen
+            # is bumped so this wave's first device run provably trips
+            # the fence, and the authoritative result comes from the
+            # mirror-restored re-run at resolve — bit-identical to
+            # flush-then-redispatch, minus the pipeline stall for every
+            # OTHER wave.  STATIC changes never fence: _upload_static
+            # swaps the resident static arrays, and a predecessor's
+            # fenced/stale RE-RUN at resolve (unlike its first run, which
+            # captured the old refs at the fn call) would read the new
+            # arrays — resolving a past wave against future node state.
+            will_fence = False
+            if inflight and (needs_refresh or static_changed):
                 self._carry_dirty = dirty
                 self.stats["flush_first"] += 1
                 return FLUSH_FIRST
+            if inflight and needs_patch:
+                if self._fence_pending:
+                    self._carry_dirty = dirty
+                    self.stats["flush_first"] += 1
+                    return FLUSH_FIRST
+                will_fence = True
 
             if static_changed:
+                # pipeline is empty here (static change over an in-flight
+                # wave flushed above), so no retained wave can re-run
+                # against these swapped arrays
                 self._upload_static()
             if needs_refresh:
                 self._full_refresh(cd_sg, cd_asg)
@@ -1452,6 +1548,21 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                            np.empty((0, self._spec.f_patch), np.float32))
             elif needs_patch:
                 self._sync_mirror_rows(patches[0])
+            if will_fence:
+                # the patch VALUES travel via the mirror rows just
+                # synced, never via the retained upload buffer: the
+                # in-flight predecessor's replay will ADD its commits
+                # onto those mirror rows before this wave's re-run, and
+                # a buffer-borne patch would SET them back to
+                # pre-predecessor values at the re-run, wiping its
+                # commits.
+                self.stats["patched_rows"] += len(patches[0])
+                patches = (np.empty(0, np.int32),
+                           np.empty((0, self._spec.f_patch), np.float32))
+                self._gen += 1  # guarantee this wave's fence trips
+                self._fence_pending += 1
+                self.stats["fenced_waves"] = self.stats.get(
+                    "fenced_waves", 0) + 1
             # patched-vs-reflattened wave accounting: a wave that kept the
             # resident state (row patches or nothing) vs one that had to
             # rebuild it (the recovery path, not steady state)
@@ -1488,10 +1599,15 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     hi = min(lo + self.full_cap, n)
                     cbuf = pack_pod_batch(
                         slice_pod_batch(batch, lo, hi, self.full_cap),
-                        self._spec_full, p[0], p[1])
+                        self._spec_full, p[0], p[1],
+                        out=self._stage_buf(self._spec_full.total))
                     p = (np.empty(0, np.int32),
                          np.empty((0, self._f_patch), np.float32))
                     chunks.append((self._device_step("full", cbuf),
+                                   # donate-ok: cbuf is the host staging
+                                   # copy; a fenced re-run re-uploads it
+                                   # (the donated transport is the fresh
+                                   # jnp conversion in _device_step)
                                    lo, hi, "full", cbuf, self._gen))
             elif self._needs_full(batch):
                 self._ensure_full()
@@ -1500,16 +1616,24 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 else:
                     cb, hi = slice_pod_batch(batch, 0, n, self.full_cap), n
                 cbuf = pack_pod_batch(cb, self._spec_full, patches[0],
-                                      patches[1])
+                                      patches[1],
+                                      out=self._stage_buf(
+                                          self._spec_full.total))
                 chunks = [(self._device_step("full", cbuf), 0, hi,
+                           # donate-ok: host staging copy retained for
+                           # fenced re-runs; _device_step re-converts
                            "full", cbuf, self._gen)]
             else:
                 self.stats["plain"] = self.stats.get("plain", 0) + 1
                 self._ensure_plain()
                 # plain wire format: ~6x less upload than the full layout
                 buf = pack_pod_batch(batch, self._spec_plain, patches[0],
-                                     patches[1])
+                                     patches[1],
+                                     out=self._stage_buf(
+                                         self._spec_plain.total))
                 chunks = [(self._device_step("plain", buf), 0,
+                           # donate-ok: host staging copy retained for
+                           # fenced re-runs; _device_step re-converts
                            self.batch_size, "plain", buf, self._gen)]
             if h2d_sp is not None:
                 h2d_sp.set_attribute("chunks", len(chunks))
@@ -1535,65 +1659,87 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
         was_full = self._needs_full(batch)
 
         def resolve() -> list[tuple[str | None, Status | None]]:
+            nonlocal will_fence
             import jax
             batch_waves = 0
-            with self._lock:
-                assignments = np.full(self.batch_size, -1, np.int64)
-                d2h_sp = (solve_sp.tracer.start_span("tpu.d2h",
-                                                     parent=solve_sp)
-                          if solve_sp is not None else None)
-                raw = []
-                stale = False
-                t_d2h0 = time.monotonic()
-                for rd, _lo, _hi, _variant, _cbuf, expect in chunks:
-                    # sync-point: wave resolve — THE pipeline's d2h pull
-                    result = jax.device_get(rd)
-                    stale = stale or int(result[-1]) != expect
-                    raw.append(result)
-                if stale:
-                    # generation fence tripped: the device state this
-                    # wave chained on is not the lineage the host
-                    # committed (lost patch / restored worker / chaos).
-                    # Recovery: rebuild the state from the replay mirror
-                    # and re-run the retained chunk buffers in order —
-                    # identical inputs against the authoritative state,
-                    # so the accepted assignments are exactly what a
-                    # healthy wave would have produced.
-                    logger.warning(
-                        "generation-stale wave (device gen mismatch); "
-                        "re-running %d chunk(s) from restored state",
-                        len(chunks))
-                    self.stats["gen_stale_waves"] = self.stats.get(
-                        "gen_stale_waves", 0) + 1
-                    self._restore_state_from_mirror()
+            try:
+                with self._lock:
+                    assignments = np.full(self.batch_size, -1, np.int64)
+                    d2h_sp = (solve_sp.tracer.start_span("tpu.d2h",
+                                                         parent=solve_sp)
+                              if solve_sp is not None else None)
                     raw = []
-                    for _rd, _lo, _hi, variant, cbuf, _expect in chunks:
-                        # sync-point: recovery re-run resolves in line
-                        raw.append(jax.device_get(
-                            self._device_step(variant, cbuf)))
-                if default_timeline.enabled:
-                    # wave timeline: device-step spans launch -> results
-                    # landed (recovery re-runs included); d2h is the
-                    # blocking pull inside it — nested on purpose, the
-                    # idle-share union collapses the overlap
-                    t_dev_end = time.monotonic()
-                    default_timeline.record("device-step", t_launch,
-                                            t_dev_end)
-                    default_timeline.record("d2h", t_d2h0, t_dev_end)
-                for result, (_rd, lo, hi, *_rest) in zip(raw, chunks):
-                    assignments[lo:hi] = result[:-2][:hi - lo]
-                    batch_waves += int(result[-2])
-                if d2h_sp is not None:
-                    d2h_sp.set_attribute("chunks", len(chunks))
-                    d2h_sp.end()
-                self.stats["waves"] += batch_waves
-                self._replay(batch, assignments)
-                if was_full and self.FULL_MAIN_WAVES:
-                    self._retry_stragglers(batch, assignments, n)
-                try:
-                    self._unresolved.remove(holder)
-                except ValueError:  # pragma: no cover - double resolve
-                    pass
+                    # a fenced wave is stale BY CONSTRUCTION (the dispatch
+                    # bumped gen past what its first device run can echo):
+                    # start from the fence flag so the replay below is
+                    # unconditional for it
+                    stale = bool(will_fence)
+                    t_d2h0 = time.monotonic()
+                    for rd, _lo, _hi, _variant, _cbuf, expect in chunks:
+                        # sync-point: wave resolve — THE pipeline's d2h pull
+                        result = jax.device_get(rd)
+                        stale = stale or int(result[-1]) != expect
+                        raw.append(result)
+                    if stale:
+                        # generation fence tripped: the device state this
+                        # wave chained on is not the lineage the host
+                        # committed (mid-pipeline fence / lost patch /
+                        # restored worker / chaos).  Recovery: rebuild the
+                        # state from the replay mirror and re-run the
+                        # retained chunk buffers in order — identical inputs
+                        # against the authoritative state, so the accepted
+                        # assignments are exactly what a healthy wave would
+                        # have produced.  For a fenced wave this IS the
+                        # steady-state pipeline discipline, not an anomaly —
+                        # that wave simply degrades to depth-1.
+                        if will_fence:
+                            self.stats["fence_replays"] = self.stats.get(
+                                "fence_replays", 0) + 1
+                        else:
+                            logger.warning(
+                                "generation-stale wave (device gen mismatch);"
+                                " re-running %d chunk(s) from restored state",
+                                len(chunks))
+                            self.stats["gen_stale_waves"] = self.stats.get(
+                                "gen_stale_waves", 0) + 1
+                        self._restore_state_from_mirror()
+                        raw = []
+                        for _rd, _lo, _hi, variant, cbuf, _expect in chunks:
+                            # sync-point: recovery re-run resolves in line
+                            raw.append(jax.device_get(
+                                self._device_step(variant, cbuf)))
+                    if default_timeline.enabled:
+                        # wave timeline: device-step spans launch -> results
+                        # landed (recovery re-runs included); d2h is the
+                        # blocking pull inside it — nested on purpose, the
+                        # idle-share union collapses the overlap
+                        t_dev_end = time.monotonic()
+                        default_timeline.record("device-step", t_launch,
+                                                t_dev_end)
+                        default_timeline.record("d2h", t_d2h0, t_dev_end)
+                    for result, (_rd, lo, hi, *_rest) in zip(raw, chunks):
+                        assignments[lo:hi] = result[:-2][:hi - lo]
+                        batch_waves += int(result[-2])
+                    if d2h_sp is not None:
+                        d2h_sp.set_attribute("chunks", len(chunks))
+                        d2h_sp.end()
+                    self.stats["waves"] += batch_waves
+                    self._replay(batch, assignments)
+                    if was_full and self.FULL_MAIN_WAVES:
+                        self._retry_stragglers(batch, assignments, n)
+                    try:
+                        self._unresolved.remove(holder)
+                    except ValueError:  # pragma: no cover - double resolve
+                        pass
+            finally:
+                # pins and the fence slot free even when the resolve
+                # fails (seam raise): a fence that never cleared would
+                # wedge every future patch dispatch behind FLUSH_FIRST
+                for _rd, _lo, _hi, _variant, cbuf, _expect in chunks:
+                    self._stage_pins.discard(id(cbuf))
+                if will_fence:
+                    self._fence_pending = max(0, self._fence_pending - 1)
+                    will_fence = False
             if solve_sp is not None:
                 solve_sp.set_attribute("waves", batch_waves)
                 solve_sp.set_attribute("pods", n)
@@ -1658,6 +1804,8 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     self._restore_state_from_mirror()
                     # sync-point: recovery re-run resolves in line
                     res = jax.device_get(
+                        # donate-ok: identical host retry buffer; the
+                        # re-post re-converts and re-donates on device
                         self._device_step("full_small", buf))
                 self.stats["waves"] += int(res[-2])
                 sub = res[:-2]

@@ -31,6 +31,7 @@ control loop from the execution substrate the same way.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Sequence
 
 import numpy as np
@@ -41,6 +42,54 @@ from .backend import decode_results, record_batch_stats
 from .flatten import BatchEncoder, Caps, ClusterTensors, VocabFullError
 
 SKIP_MSG = "null backend: constraint pod -> per-pod oracle"
+
+
+class FlightDelayBackend:
+    """Measurement wrapper (NOT a production backend): pins the device
+    flight of every wave to a minimum wall duration.
+
+    ``dispatch`` starts the flight clock; the returned resolve blocks
+    (GIL released) until ``flight_s`` has elapsed since dispatch, then
+    resolves the inner wave.  This models what a real accelerator is
+    from the host's perspective — a step that takes wall time but ~zero
+    host CPU — which a CPU-simulated device on a single-core box cannot
+    exhibit (host and "device" compete for the same core, so pipeline
+    overlap is physically impossible no matter what the scheduler
+    does).  `bench.py --pipeline-ab` uses this arm
+    (``BENCH_PIPELINE_FLIGHT_MS``) to measure the wave pipeline's
+    overlap in isolation from that box artifact: at depth 2 the flight
+    of wave N+1 runs concurrently with wave N's resolve wait and the
+    host leg forming wave N+2, so steady-state wall per wave drops from
+    ``host + flight`` toward ``max(host, flight)``.
+
+    The wait happens INSIDE the wrapped resolve, before the inner
+    resolve's device pull, so the backend's own timeline record
+    (``device-step``: launch -> results landed) attributes the flight
+    to device time — idle-share and overlap metrics read the same as
+    they would with a genuinely slow device."""
+
+    def __init__(self, inner, flight_s: float):
+        self.inner = inner
+        self.flight_s = float(flight_s)
+
+    def dispatch(self, pod_infos, snapshot):
+        inner_resolve = self.inner.dispatch(pod_infos, snapshot)
+        if not callable(inner_resolve):  # pass-through sentinel / results
+            return inner_resolve
+        t_dispatch = time.monotonic()
+
+        def resolve():
+            remaining = self.flight_s - (time.monotonic() - t_dispatch)
+            if remaining > 0:
+                time.sleep(remaining)
+            return inner_resolve()
+
+        return resolve
+
+    def __getattr__(self, name):
+        # warmup/assign/health/prefetch/abandon_wave/stats/tensors/
+        # supports_pipelining all forward untouched
+        return getattr(self.inner, name)
 
 
 class NullBatchBackend(BatchBackend):

@@ -92,6 +92,10 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         # host expectation of the device state-generation counter (the
         # resolve fence — see ops/backend.py)
         self._gen = 0
+        # steady-state pipeline fence (see ops/backend.py): >0 while a
+        # fenced wave — dispatched with its patches deliberately held
+        # back in the mirror — has not yet resolved and replayed
+        self._fence_pending = 0
         # A/B baseline knob — see ops/backend.py
         self.FORCE_REFLATTEN = bool(os.environ.get("KTPU_FORCE_REFLATTEN"))
         self.stats = {"batches": 0, "waves": 0, "full_refresh": 0,
@@ -134,13 +138,19 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
             # resident state numerically unchanged, and paying the full
             # kernel's multi-second XLA compile here beats paying it
             # inside the first constraint-carrying scheduling cycle
-            pod_arrays = self._pod_arrays(batch)
             prows, pvals = self._empty_patches()
+            # the step DONATES its pod transport (mesh.py): each trace
+            # needs its own freshly-placed pod arrays — reusing the
+            # first call's would read deleted buffers
             self._state, a, _w, _g = self._fn(
-                self._state, self._static_node, pod_arrays, prows, pvals)
+                self._state, self._static_node, self._pod_arrays(batch),
+                prows, pvals)
             self._gen += 1
             self._state, a, _w, _g = self._ensure_plain()(
-                self._state, self._static_node, pod_arrays, prows, pvals)
+                self._state, self._static_node, self._pod_arrays(batch),
+                # donate-ok: host-side np patch arrays; each call's jit
+                # conversion places (and donates) fresh device copies
+                prows, pvals)
             self._gen += 1
             import jax
             # sync-point: warmup barrier — block until the round trips land
@@ -285,6 +295,19 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                 return lambda: results
 
             inflight = bool(self._unresolved)
+            # deterministic compaction point (see ops/backend.py):
+            # tombstone reclamation is anchored to the wave boundary so
+            # free-list order — and therefore row tie-breaks — cannot
+            # depend on pipeline depth
+            if (self.tensors.tombstone_count() * self.COMPACT_TOMBSTONE_DIV
+                    >= self.caps.n_cap):
+                if inflight:
+                    self._carry_dirty = dirty
+                    self.stats["flush_first"] += 1
+                    return FLUSH_FIRST
+                if self.tensors.compact():
+                    self.stats["compactions"] = self.stats.get(
+                        "compactions", 0) + 1
             static_changed = (self._static_version
                               != self.tensors.static_version)
             if skip_sync and not static_changed:
@@ -301,12 +324,33 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                         patches = self._diff_patches(sorted(dirty))
                 needs_refresh = not have_state or patches is None
                 needs_patch = patches is not None and len(patches[0]) > 0
-            if inflight and (static_changed or needs_refresh or needs_patch):
+            # pipeline admission (see ops/backend.py for the full
+            # derivation): a full re-encode or static change never
+            # overlaps an in-flight wave, and only one fenced wave rides
+            # the pipeline at a time.  A dynamic row patch while clean
+            # dispatches FENCED — the patch lands in the mirror, gen is
+            # bumped so this wave's first run provably trips the fence,
+            # and the authoritative result comes from the mirror-restored
+            # replay at its resolve.
+            will_fence = False
+            if inflight and (needs_refresh or static_changed):
+                # static never fences (see ops/backend.py): a retained
+                # wave's re-run at resolve would read the swapped static
+                # arrays — future node state against a past wave
                 self._carry_dirty = dirty
                 self.stats["flush_first"] += 1
                 return FLUSH_FIRST
+            if inflight and needs_patch:
+                if self._fence_pending:
+                    self._carry_dirty = dirty
+                    self.stats["flush_first"] += 1
+                    return FLUSH_FIRST
+                will_fence = True
 
             if static_changed:
+                # pipeline is empty here (static change over an in-flight
+                # wave flushed above): no retained wave can replay
+                # against these swapped arrays
                 self._upload_static()
             if needs_refresh:
                 self._full_refresh(cd_sg, cd_asg)
@@ -314,12 +358,25 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
             elif needs_patch:
                 self._sync_mirror_rows(patches[0])
                 prows, pvals = self._empty_patches()
-                k = len(patches[0])
-                prows[:k] = patches[0]
-                pvals[:k] = patches[1]
-                self.stats["patched_rows"] += k
+                if will_fence:
+                    # patch VALUES travel via the mirror rows just
+                    # synced, never via the retained upload: the
+                    # in-flight predecessor's replay ADDs its commits
+                    # onto those rows before this wave's re-run, and a
+                    # buffer-borne patch would SET them back, wiping it
+                    self.stats["patched_rows"] += len(patches[0])
+                else:
+                    k = len(patches[0])
+                    prows[:k] = patches[0]
+                    pvals[:k] = patches[1]
+                    self.stats["patched_rows"] += k
             else:
                 prows, pvals = self._empty_patches()
+            if will_fence:
+                self._gen += 1  # guarantee this wave's fence trips
+                self._fence_pending += 1
+                self.stats["fenced_waves"] = self.stats.get(
+                    "fenced_waves", 0) + 1
             # tentpole accounting: did this wave ride the patch path or
             # pay a full re-flatten/refresh of the device tensors?
             self.stats["waves_reflattened" if needs_refresh
@@ -342,42 +399,59 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
         n = len(pod_infos)
 
         def resolve():
+            nonlocal will_fence
             import jax
-            with self._lock:
-                t_d2h0 = time.monotonic()
-                # sync-point: sharded wave resolve — the pipeline's d2h pull
-                assignments, waves, gen = jax.device_get(
-                    (assignments_dev, waves_dev, gen_dev))
-                if int(gen) != expect_gen:
-                    # generation fence tripped: the resident lineage this
-                    # wave chained off is not the one the host mirrored.
-                    # Re-seed device state from the mirror and replay the
-                    # batch synchronously on the fresh lineage.
-                    logger.warning(
-                        "sharded state generation mismatch (device %d, "
-                        "expected %d); re-seeding from host mirror",
-                        int(gen), expect_gen)
-                    self.stats["gen_stale_waves"] = (
-                        self.stats.get("gen_stale_waves", 0) + 1)
-                    self._restore_state_from_mirror()
-                    a_dev, w_dev, _g = self._dispatch_locked(
-                        batch, prows, pvals)
-                    # sync-point: gen-stale recovery replay
-                    assignments, waves = jax.device_get((a_dev, w_dev))
-                if default_timeline.enabled:
-                    # wave timeline: device-step launch -> results landed
-                    # (recovery replay included); d2h is the blocking
-                    # pull inside it
-                    t_dev_end = time.monotonic()
-                    default_timeline.record("device-step", t_launch,
-                                            t_dev_end)
-                    default_timeline.record("d2h", t_d2h0, t_dev_end)
-                self.stats["waves"] += int(waves)
-                self._replay(batch, assignments)
-                try:
-                    self._unresolved.remove(holder)
-                except ValueError:  # pragma: no cover - double resolve
-                    pass
+            try:
+                with self._lock:
+                    t_d2h0 = time.monotonic()
+                    # sync-point: sharded wave resolve — the pipeline's
+                    # d2h pull
+                    assignments, waves, gen = jax.device_get(
+                        (assignments_dev, waves_dev, gen_dev))
+                    if int(gen) != expect_gen or will_fence:
+                        # generation fence tripped: the resident lineage
+                        # this wave chained off is not the one the host
+                        # mirrored.  Re-seed device state from the mirror
+                        # and replay the batch synchronously on the fresh
+                        # lineage.  For a fenced wave this IS the
+                        # steady-state discipline (its dispatch held the
+                        # patches back in the mirror on purpose), not an
+                        # anomaly.
+                        if will_fence:
+                            self.stats["fence_replays"] = self.stats.get(
+                                "fence_replays", 0) + 1
+                        else:
+                            logger.warning(
+                                "sharded state generation mismatch "
+                                "(device %d, expected %d); re-seeding "
+                                "from host mirror", int(gen), expect_gen)
+                            self.stats["gen_stale_waves"] = (
+                                self.stats.get("gen_stale_waves", 0) + 1)
+                        self._restore_state_from_mirror()
+                        a_dev, w_dev, _g = self._dispatch_locked(
+                            batch, prows, pvals)
+                        # sync-point: gen-stale recovery replay
+                        assignments, waves = jax.device_get((a_dev, w_dev))
+                    if default_timeline.enabled:
+                        # wave timeline: device-step launch -> results
+                        # landed (recovery replay included); d2h is the
+                        # blocking pull inside it
+                        t_dev_end = time.monotonic()
+                        default_timeline.record("device-step", t_launch,
+                                                t_dev_end)
+                        default_timeline.record("d2h", t_d2h0, t_dev_end)
+                    self.stats["waves"] += int(waves)
+                    self._replay(batch, assignments)
+                    try:
+                        self._unresolved.remove(holder)
+                    except ValueError:  # pragma: no cover - double resolve
+                        pass
+            finally:
+                # the fence slot frees even on a failed resolve, or every
+                # future patch dispatch wedges behind FLUSH_FIRST
+                if will_fence:
+                    self._fence_pending = max(0, self._fence_pending - 1)
+                    will_fence = False
             out = decode_results(
                 assignments, n, self.batch_size, set(batch.escape),
                 row_infos, "no feasible node (sharded batch filter)",
@@ -386,6 +460,24 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
             return out
 
         return resolve
+
+    def abandon_wave(self) -> None:
+        """Stuck-wave watchdog cancel (see ops/backend.py abandon_wave:
+        same best-effort lock and the same safety argument).  Drops the
+        pipeline bookkeeping, the resident sharded state, and any
+        pending fence; the next dispatch full-refreshes from the
+        authoritative cache view."""
+        got = self._lock.acquire(timeout=0.1)
+        try:
+            self._unresolved.clear()
+            self._state = None
+            self._last_epoch = None
+            self._fence_pending = 0
+            self.stats["abandoned_waves"] = (
+                self.stats.get("abandoned_waves", 0) + 1)
+        finally:
+            if got:
+                self._lock.release()
 
     def assign(self, pod_infos: Sequence[PodInfo], snapshot: Snapshot):
         resolve = self.dispatch(pod_infos, snapshot)

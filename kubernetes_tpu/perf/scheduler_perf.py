@@ -246,7 +246,8 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
                   tracing_provider=None,
                   overload=None,
                   chaos_schedule=None,
-                  profiling_policy=None) -> PerfCluster:
+                  profiling_policy=None,
+                  device_flight_s: float = 0.0) -> PerfCluster:
     """mustSetupScheduler (util.go:79): in-proc everything, no kubelet.
 
     pipeline_depth/admission_interval select latency mode (scheduler.py):
@@ -380,6 +381,9 @@ def setup_cluster(tpu: bool = False, caps=None, batch_size: int = 512,
         if chaos_schedule is not None:
             from ..ops.faults import ChaosBatchBackend
             backend = ChaosBatchBackend(backend, chaos_schedule)
+        if device_flight_s > 0:
+            from ..ops.nullbackend import FlightDelayBackend
+            backend = FlightDelayBackend(backend, device_flight_s)
         fw = new_default_framework(client, factory)
         profiles = {"default-scheduler": Profile(
             fw, batch_backend=backend, batch_size=batch_size,
@@ -790,7 +794,8 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                        tracing_provider=None,
                        overload=None,
                        chaos_schedule=None,
-                       profiling_policy=None
+                       profiling_policy=None,
+                       device_flight_s: float = 0.0
                        ) -> tuple[ThroughputSummary, dict]:
     """Run one workload config end to end; returns (throughput, stats)."""
     cluster = setup_cluster(
@@ -802,7 +807,8 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
         remote_seam=remote_seam, backend_kind=backend_kind,
         tracing_provider=tracing_provider,
         overload=overload, chaos_schedule=chaos_schedule,
-        profiling_policy=profiling_policy)
+        profiling_policy=profiling_policy,
+        device_flight_s=device_flight_s)
     collector = ThroughputCollector(cluster.store)
     try:
         ops = config["workloadTemplate"]

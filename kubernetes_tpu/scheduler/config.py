@@ -178,11 +178,19 @@ class BackendPolicy:
     (parallel/backend.py — node tensors live sharded, conflict matrices
     resolve via reduce-scatter), "null" the host-only pipeline with the
     device step nulled.  batchSize/kCap 0 mean "harness default" so the
-    stanza can pin just the kind."""
+    stanza can pin just the kind.
+
+    pipeline_depth (the nested `pipeline: {depth: N}` sub-stanza) sets
+    how many waves may be in flight at once: 2 (the default) overlaps
+    wave N's resolve/bind with wave N+1's device step; 1 is the strictly
+    serial arm kept as the bit-parity A/B baseline.  Hot-reloadable via
+    SIGHUP — lowering the depth drains excess in-flight waves on the
+    next cycle rather than cancelling them."""
 
     kind: str = "tpu"
     batch_size: int = 0
     k_cap: int = 0
+    pipeline_depth: int = 2
 
     @property
     def selected(self) -> bool:
@@ -202,6 +210,17 @@ BACKEND_KINDS = ("tpu", "sharded", "null")
 def _parse_backend(data: dict) -> BackendPolicy:
     kwargs = {}
     for key, value in (data or {}).items():
+        if key == "pipeline":
+            if not isinstance(value, dict):
+                raise ConfigError("backend pipeline must be a mapping")
+            for pk, pv in value.items():
+                if pk != "depth":
+                    raise ConfigError(f"unknown backend pipeline key {pk!r}")
+                if pv not in (1, 2):
+                    raise ConfigError(
+                        f"backend pipeline depth must be 1 or 2; got {pv!r}")
+                kwargs["pipeline_depth"] = pv
+            continue
         if key not in _BACKEND_FIELDS:
             raise ConfigError(f"unknown backend key {key!r}")
         kwargs[_BACKEND_FIELDS[key]] = value
@@ -682,6 +701,7 @@ def scheduler_from_config(client, informer_factory, cfg: SchedulerConfig,
     # backend the harness should build (ops/backend.make_batch_backend),
     # construction stays with bench/perf/tests
     sched.backend_policy = cfg.backend
+    sched.pipeline_depth = max(1, cfg.backend.pipeline_depth)
     if cfg.overload.enabled:
         sched.configure_overload(cfg.overload)
     if cfg.scale_out.enabled:

@@ -504,6 +504,12 @@ class Scheduler:
         # time-gate eager batch retirement (see schedule_step); starts at
         # the tunneled chip's typical ~2x round-trip flight
         self._flight_est = 0.25
+        # when the HEAD of _pending last retired: the stuck-wave watchdog
+        # budgets each pipelined wave from the moment it reaches the head
+        # of the device queue, not from its dispatch — a slow-but-healthy
+        # wave N must not eat wave N+1's deadline (see
+        # _resolve_with_deadline)
+        self._last_retire_t = 0.0
         self.pipeline_depth = max(1, pipeline_depth)
         self.admission_interval = admission_interval
         self._deferred: list[QueuedPodInfo] = []  # per-pod pods awaiting a quiescent cache
@@ -693,6 +699,14 @@ class Scheduler:
                     profile.batch_size = cfg.backend.batch_size
                     applied.append("backend.batchSize")
                     break
+        # pipeline depth applies live: raising it lets the next cycle
+        # dispatch ahead; lowering it drains excess in-flight waves on
+        # the next schedule_step (the trim loop retires oldest-first) —
+        # nothing is cancelled
+        depth = max(1, cfg.backend.pipeline_depth)
+        if depth != self.pipeline_depth:
+            self.pipeline_depth = depth
+            applied.append("backend.pipeline")
         self.backend_policy = dataclasses.replace(
             cfg.backend, kind=self.backend_policy.kind)
         self.metrics.prom.config_reload_total.inc(1.0, "applied")
@@ -1905,18 +1919,25 @@ class Scheduler:
                                start: float, deadline: float,
                                span: tracing.Span | None):
         """Stuck-wave watchdog (overload: waveDeadlineSeconds): resolve()
-        with a hard wall measured from DISPATCH.  A wave whose results
-        have not landed by the deadline is cancelled — the backend
-        abandons its in-flight bookkeeping (abandon_wave) and the pods
-        requeue through the BackendUnavailableError path, exactly as if
-        the seam had failed.  Returns the results, or None after a
-        cancel.
+        with a hard wall measured PER WAVE.  A wave whose results have
+        not landed by the deadline is cancelled — the backend abandons
+        its in-flight bookkeeping (abandon_wave) and the pods requeue
+        through the BackendUnavailableError path, exactly as if the seam
+        had failed.  Returns the results, or None after a cancel.
+
+        Per-wave means the clock starts when the wave reached the HEAD
+        of the device queue (its predecessor retired), not at dispatch:
+        a pipelined wave N+1 spends part of its residency parked behind
+        wave N's device step, and budgeting that parked time against it
+        would let one slow-but-healthy wave falsely cancel every healthy
+        successor behind it.
 
         The overrunning resolve keeps running on an orphan daemon thread
         (there is no portable way to interrupt a device pull); its late
         mutations are harmless because abandon_wave dropped the pipeline
         chain and forced a full state refresh for the next dispatch."""
-        remaining = deadline - (time.monotonic() - start)
+        remaining = deadline - (time.monotonic()
+                                - max(start, self._last_retire_t))
         if remaining > 0.0:
             out: list = []
             done = threading.Event()
@@ -1949,6 +1970,20 @@ class Scheduler:
             span.end()
         self._requeue_batch(live, BackendUnavailableError(
             f"wave exceeded watchdog deadline ({deadline:.1f}s)"))
+        # abandon_wave dropped the whole resident-state chain, so any
+        # pipelined successors still in _pending were dispatched against
+        # state that no longer exists — cancel them through the same
+        # requeue path instead of letting their resolves land on a dead
+        # chain (their orphan device results are ignored the same way
+        # this wave's are)
+        if self._pending:
+            orphans, self._pending = self._pending, []
+            for _sp, s_live, _sr, _sc, _ss, s_span in orphans:
+                if s_span is not None:
+                    s_span.add_event("watchdog_cancel_successor")
+                    s_span.end()
+                self._requeue_batch(s_live, BackendUnavailableError(
+                    "pipelined predecessor exceeded watchdog deadline"))
         return None
 
     def _finish_batch(self, profile: Profile, live: list[QueuedPodInfo],
@@ -1990,6 +2025,11 @@ class Scheduler:
                 span.end()
             self._requeue_batch(live, e)
             return
+        finally:
+            # the head slot is free (results landed, wave cancelled, or
+            # the chain failed): successors budget their per-wave
+            # watchdog deadline from this instant
+            self._last_retire_t = time.monotonic()
         resolve_block = time.monotonic() - t_enter
         if tl is not None and tl.enabled:
             # resolve: blocking on the device result + host decode

@@ -72,6 +72,11 @@ def pytest_configure(config):
         "checkpointed warm-start, config hot-reload); tier-1 runs the "
         "shrunk 2-process rolling-restart pass, the full churn matrix "
         "is additionally marked slow")
+    config.addinivalue_line(
+        "markers",
+        "pipeline: depth-2 wave-pipeline tests (fenced dispatch, "
+        "pipelined churn parity, per-wave watchdog deadlines, timeline "
+        "overhead with overlapping waves)")
 
 
 @pytest.fixture

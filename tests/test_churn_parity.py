@@ -140,6 +140,53 @@ def inject_before_wave(ops: list, wave_idx: int, op: tuple) -> list:
     return out
 
 
+def build_fence_ops(seed: int, rounds: int = 6, base_nodes: int = 12) -> list:
+    """Stream whose between-wave churn is EXTERNAL POD BINDS — another
+    scheduler committing pods onto shared nodes.  That is a dynamic row
+    mutation with no static change: the one churn class the depth-2
+    pipeline absorbs as a FENCED dispatch (mirror-borne patch + gen bump
+    + replay at resolve) instead of a flush.  Two rounds mix in node
+    adds so fenced and flushed waves interleave in one stream.
+
+      ("xpod", pod_obj)   externally-bound pod lands in the cache
+    """
+    rng = random.Random(seed)
+    ops: list = []
+    live: list[str] = []
+
+    def new_node(i: int):
+        node = make_node(f"fence{seed}-n{i}").zone(
+            "abc"[rng.randrange(3)]).capacity(cpu="8", mem="32Gi").build()
+        live.append(meta.name(node))
+        return ("event", "ADDED", node)
+
+    for i in range(base_nodes):
+        ops.append(new_node(i))
+    pod_serial = 0
+    xpod_serial = 0
+    for r in range(rounds):
+        if r in (2, 4):
+            # static churn round: the pipelined arm must flush here,
+            # fence on the xpod rounds, and still match bit for bit
+            ops.append(new_node(base_nodes + r))
+        else:
+            for _ in range(rng.randint(1, 2)):
+                xp = make_pod(f"fence{seed}-x{xpod_serial}").req(
+                    cpu=rng.choice(("250m", "500m", "1")),
+                    mem="512Mi").node(
+                        live[rng.randrange(len(live))]).build()
+                xpod_serial += 1
+                ops.append(("xpod", xp))
+        pods = []
+        for _ in range(rng.randint(3, 6)):
+            pods.append(make_pod(f"fence{seed}-p{pod_serial}").req(
+                cpu=rng.choice(("100m", "250m", "500m")),
+                mem="256Mi").build())
+            pod_serial += 1
+        ops.append(("wave", pods, []))
+    return ops
+
+
 # -- scenario driver -------------------------------------------------------
 
 def _apply_event(cache: Cache, backend, kind: str, node) -> None:
@@ -182,6 +229,10 @@ def run_scenario(backend, ops):
         elif op[0] == "compact":
             with backend._lock:
                 backend.tensors.compact()
+        elif op[0] == "xpod":
+            # externally-bound pod (another scheduler's commit): dynamic
+            # row churn only — the next dispatch diffs it as a patch
+            cache.add_pod(copy.deepcopy(op[1]))
         elif op[0] == "gen_skew":
             # desynchronize the host generation expectation: the next
             # wave's resolve must trip the fence and take the
@@ -565,6 +616,212 @@ def test_checkpoint_rejects_never_corrupts(tmp_path):
     with pytest.raises(CheckpointError):
         TPUBatchBackend(small_caps(), batch_size=16).warm_start(
             str(tmp_path / "nope.ckpt"))
+
+
+# -- pipelined churn: depth-2 dispatch with churn landing mid-pipeline ----
+
+def run_scenario_pipelined(backend, ops, depth=2):
+    """Depth-`depth` scheduler-style driver: up to `depth` waves ride
+    the device queue at once, retired oldest-first (the exact protocol
+    of scheduler.schedule_step: dispatch, append, trim to depth).  A
+    wave op's mid events land BETWEEN this wave's dispatch and the next
+    dispatch, so churn hits with a wave in flight — the fenced-dispatch
+    path.  FLUSH_FIRST drains the pipeline then re-dispatches, exactly
+    like scheduler._dispatch_batch."""
+    cache = Cache()
+    waves: list = []
+    pending: list = []  # (resolve, pod_objs), oldest first
+
+    def finish():
+        resolve, pod_objs = pending.pop(0)
+        results = resolve()
+        w = []
+        for pod, (name, status) in zip(pod_objs, results):
+            w.append((name, None if status is None else status.code))
+            if name:
+                bound = copy.deepcopy(pod)
+                bound.setdefault("spec", {})["nodeName"] = name
+                cache.add_pod(bound)
+        waves.append(w)
+
+    for op in ops:
+        if op[0] == "event":
+            _apply_event(cache, backend, op[1], op[2])
+        elif op[0] == "compact":
+            while pending:   # compaction needs a quiescent device chain
+                finish()
+            with backend._lock:
+                backend.tensors.compact()
+        elif op[0] == "xpod":
+            # lands while wave N is in flight: wave N+1's dispatch sees
+            # a dynamic-only diff and must ride the pipeline FENCED
+            cache.add_pod(copy.deepcopy(op[1]))
+        elif op[0] == "gen_skew":
+            backend._gen += 3
+        else:
+            pod_objs = [copy.deepcopy(p) for p in op[1]]
+            infos = [PodInfo(p) for p in pod_objs]
+            resolve = backend.dispatch(infos, cache.flatten_view())
+            if resolve is FLUSH_FIRST:
+                while pending:
+                    finish()
+                resolve = backend.dispatch(infos, cache.flatten_view())
+                assert resolve is not FLUSH_FIRST, \
+                    "backend demanded flush with empty pipeline"
+            pending.append((resolve, pod_objs))
+            for kind, _t, node in op[2]:
+                _apply_event(cache, backend, kind, node)
+            while len(pending) > depth:
+                finish()
+    while pending:
+        finish()
+    return cache, waves
+
+
+@pytest.mark.pipeline
+@pytest.mark.parametrize("seed", [7, 23, 5])
+def test_pipelined_churn_parity_single_chip(seed):
+    """Node deletes/relabels landing between wave N's dispatch and wave
+    N+1's dispatch must produce assignments bit-identical to the serial
+    depth-1 run: the fenced dispatch holds the patches back in the
+    mirror and the fenced wave replays from restored state at resolve.
+    The fenced path must actually run (fence_replays > 0) and the
+    from-scratch re-encode oracle must agree with the patched tensors."""
+    ops = build_ops(seed, rounds=5, base_nodes=10, constraint_pods=True)
+    serial = TPUBatchBackend(small_caps(), batch_size=16)
+    _, serial_waves = run_scenario(serial, ops)
+
+    piped = TPUBatchBackend(small_caps(), batch_size=16)
+    cache, piped_waves = run_scenario_pipelined(piped, ops, depth=2)
+    assert piped_waves == serial_waves
+    # node churn is STATIC change, which never rides the pipeline — the
+    # depth-2 arm must drain (flush) at those boundaries, not resolve a
+    # retained wave against swapped static arrays
+    assert piped.stats.get("flush_first", 0) >= 1
+    assert piped.stats.get("fenced_waves", 0) == piped.stats.get(
+        "fence_replays", 0)
+    assert piped.stats.get("gen_stale_waves", 0) == 0
+    assert piped._fence_pending == 0
+    assert not piped._stage_pins
+    assert_full_reencode_parity(piped, cache)
+
+
+@pytest.mark.pipeline
+@pytest.mark.parametrize("seed", [11, 42])
+def test_pipelined_fence_external_binds(seed):
+    """External pod binds (dynamic row churn, no static change) landing
+    between wave N's dispatch and wave N+1's dispatch: wave N+1 must
+    ride the pipeline FENCED — mirror-borne patch, gen bump, replay at
+    resolve — and still match the serial arm bit for bit."""
+    ops = build_fence_ops(seed)
+    serial = TPUBatchBackend(small_caps(), batch_size=16)
+    _, serial_waves = run_scenario(serial, ops)
+
+    piped = TPUBatchBackend(small_caps(), batch_size=16)
+    cache, piped_waves = run_scenario_pipelined(piped, ops, depth=2)
+    assert piped_waves == serial_waves
+    assert piped.stats.get("fence_replays", 0) >= 1
+    assert piped.stats.get("fenced_waves", 0) == piped.stats.get(
+        "fence_replays", 0)
+    assert piped.stats.get("gen_stale_waves", 0) == 0
+    assert piped._fence_pending == 0
+    assert not piped._stage_pins
+    assert_full_reencode_parity(piped, cache)
+
+
+@pytest.mark.pipeline
+def test_pipelined_churn_parity_with_gen_skew():
+    """Forced gen-skew recovery inside the pipelined run: the fence
+    machinery must recover mid-pipeline and still match the serial
+    depth-1 arm bit for bit."""
+    ops = build_ops(31, rounds=5, base_nodes=10)
+    skewed_ops = inject_before_wave(ops, 2, ("gen_skew",))
+
+    serial = TPUBatchBackend(small_caps(), batch_size=16)
+    _, serial_waves = run_scenario(serial, ops)
+
+    piped = TPUBatchBackend(small_caps(), batch_size=16)
+    cache, piped_waves = run_scenario_pipelined(piped, ops, depth=2)
+    assert piped_waves == serial_waves
+
+    skewed = TPUBatchBackend(small_caps(), batch_size=16)
+    _, skewed_waves = run_scenario_pipelined(skewed, skewed_ops, depth=2)
+    assert skewed.stats.get("gen_stale_waves", 0) >= 1
+    assert skewed.stats["gen_recoveries"] >= 1
+    assert skewed_waves == serial_waves
+    assert_full_reencode_parity(piped, cache)
+
+
+@pytest.mark.pipeline
+def test_pipelined_churn_parity_sharded():
+    """The sharded lineage under the same depth-2 driver (per-lineage
+    control: equal-score ties break differently across lineages)."""
+    from kubernetes_tpu.parallel.backend import ShardedTPUBatchBackend
+
+    ops = build_ops(9, rounds=4, base_nodes=10)
+    serial = ShardedTPUBatchBackend(small_caps(), batch_size=16)
+    _, serial_waves = run_scenario(serial, ops)
+
+    piped = ShardedTPUBatchBackend(small_caps(), batch_size=16)
+    cache, piped_waves = run_scenario_pipelined(piped, ops, depth=2)
+    assert piped_waves == serial_waves
+    assert piped._fence_pending == 0
+
+    skewed_ops = inject_before_wave(ops, 1, ("gen_skew",))
+    skewed = ShardedTPUBatchBackend(small_caps(), batch_size=16)
+    _, skewed_waves = run_scenario_pipelined(skewed, skewed_ops, depth=2)
+    assert skewed.stats.get("gen_stale_waves", 0) >= 1
+    assert skewed_waves == serial_waves
+    assert_full_reencode_parity(piped, cache)
+
+    # fenced path on the sharded lineage: external binds between waves
+    fops = build_fence_ops(9, rounds=4)
+    fserial = ShardedTPUBatchBackend(small_caps(), batch_size=16)
+    _, fserial_waves = run_scenario(fserial, fops)
+    fpiped = ShardedTPUBatchBackend(small_caps(), batch_size=16)
+    fcache, fpiped_waves = run_scenario_pipelined(fpiped, fops, depth=2)
+    assert fpiped_waves == fserial_waves
+    assert fpiped.stats.get("fence_replays", 0) >= 1
+    assert fpiped._fence_pending == 0
+    assert_full_reencode_parity(fpiped, fcache)
+
+
+@pytest.mark.pipeline
+def test_pipelined_churn_parity_seam():
+    """The grpc-seam lineage: fenced dispatches ride the wire (the
+    fenced replay goes through a mirror /refresh resync on the worker)
+    and must still match the in-process serial arm, including a forced
+    gen-skew wave."""
+    from kubernetes_tpu.ops.remote import DeviceWorker, RemoteTPUBatchBackend
+
+    ops = build_ops(13, rounds=4, base_nodes=10)
+    local = TPUBatchBackend(small_caps(), batch_size=16)
+    _, local_waves = run_scenario(local, ops)
+    fops = build_fence_ops(13, rounds=4)
+    flocal = TPUBatchBackend(small_caps(), batch_size=16)
+    _, flocal_waves = run_scenario(flocal, fops)
+
+    worker = DeviceWorker().start()
+    try:
+        remote = RemoteTPUBatchBackend(worker.url, small_caps(),
+                                       batch_size=16)
+        skewed_ops = inject_before_wave(ops, 2, ("gen_skew",))
+        cache, remote_waves = run_scenario_pipelined(remote, skewed_ops,
+                                                     depth=2)
+        assert remote.stats.get("gen_stale_waves", 0) >= 1
+        assert remote_waves == local_waves
+        assert_full_reencode_parity(remote, cache)
+
+        # fenced dispatches over the wire: external binds between waves
+        fremote = RemoteTPUBatchBackend(worker.url, small_caps(),
+                                        batch_size=16)
+        fcache, fremote_waves = run_scenario_pipelined(fremote, fops,
+                                                       depth=2)
+        assert fremote_waves == flocal_waves
+        assert fremote.stats.get("fence_replays", 0) >= 1
+        assert_full_reencode_parity(fremote, fcache)
+    finally:
+        worker.stop()
 
 
 @pytest.mark.slow

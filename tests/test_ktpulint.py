@@ -43,7 +43,7 @@ def run_rule(ctx: LintContext, name: str) -> list[Finding]:
 
 def test_registry_has_the_full_catalog():
     rules = all_rules()
-    assert len(rules) >= 22
+    assert len(rules) >= 23
     for name, rule in rules.items():
         assert name == rule.name
         assert rule.doc, f"rule {name} has no doc line"
@@ -765,6 +765,97 @@ def test_tensor_patch_discipline_real_tree_is_clean():
     repo = pathlib.Path(__file__).resolve().parents[1]
     ctx = LintContext(repo)
     assert run_rule(ctx, "tensor-patch-discipline") == []
+
+
+def test_donated_buffer_reuse_fires_and_clean(tmp_path):
+    ctx = make_ctx(tmp_path, {f"{PKG}/ops/hot.py": """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0, 2))
+        def step(state, static, buf):
+            return state, buf * 2
+
+        def drive(state, static, buf):
+            state, out = step(state, static, buf)
+            return buf.sum(), out
+        """})
+    found = run_rule(ctx, "donated-buffer-reuse")
+    assert len(found) == 1
+    assert "buf was donated" in found[0].message
+
+    ctx = make_ctx(tmp_path / "ok", {f"{PKG}/ops/hot.py": """\
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, donate_argnums=(0, 2))
+        def step(state, static, buf):
+            return state, buf * 2
+
+        def drive(state, static, buf, make_buf):
+            # the resident-state idiom: the donated input is rebound
+            # from the call's output, so later reads see a live buffer
+            state, out = step(state, static, buf)
+            buf = make_buf()
+            total = buf.sum()
+            return state, out, total
+
+        def drive_wrapped(state, static, buf):
+            # wrapped arg: the donated buffer is the fresh conversion,
+            # not the host array — buf stays readable
+            state, out = step(state, static, jnp.asarray(buf))
+            return buf.sum(), out
+
+        def drive_annotated(state, static, buf):
+            state, out = step(state, static, buf)
+            # donate-ok: host staging copy; the seam re-converts it
+            return buf.sum(), out
+        """})
+    assert run_rule(ctx, "donated-buffer-reuse") == []
+
+
+def test_donated_buffer_reuse_builders_and_closures(tmp_path):
+    # builder-bound callables (compile_sharded / build_sharded_step_fn /
+    # build_packed_assign_fn) register their donated argnums, the
+    # builder CALL itself donates nothing, and a read inside a nested
+    # resolve() closure counts — that's the retained-reference hazard
+    ctx = make_ctx(tmp_path, {f"{PKG}/parallel/hot.py": """\
+        class Backend:
+            def setup(self, caps, mesh, weights):
+                self._fn = build_sharded_step_fn(caps, mesh, weights)
+                self._fn_full, self._spec = build_packed_assign_fn(caps)
+
+            def dispatch(self, pods, prows, pvals):
+                self._state, a, w, g = self._fn(
+                    self._state, self._static, pods, prows, pvals)
+
+                def resolve():
+                    return a, pvals.sum()
+                return resolve
+        """})
+    found = run_rule(ctx, "donated-buffer-reuse")
+    assert len(found) == 1
+    assert "pvals was donated" in found[0].message
+
+    # _device_step convention: buf feeds the donated packed transport
+    ctx = make_ctx(tmp_path / "seam", {f"{PKG}/ops/hot.py": """\
+        class Backend:
+            def dispatch(self, batch):
+                buf = self.pack(batch)
+                rd = self._device_step("full", buf)
+                self.retained = buf
+                return rd
+        """})
+    found = run_rule(ctx, "donated-buffer-reuse")
+    assert len(found) == 1 and "buf was donated" in found[0].message
+
+
+def test_donated_buffer_reuse_real_tree_is_clean():
+    import pathlib
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    ctx = LintContext(repo)
+    assert run_rule(ctx, "donated-buffer-reuse") == []
 
 
 # -- thread rules ----------------------------------------------------------

@@ -333,6 +333,82 @@ class TestStuckWaveWatchdogE2E:
             factory.stop()
 
 
+@pytest.mark.pipeline
+class TestPipelinedWatchdogE2E:
+    """Per-wave watchdog semantics under depth-2 pipelining: the deadline
+    clock for wave N+1 starts when wave N retires (head-of-queue), not at
+    dispatch — so a slow-but-within-deadline wave never falsely cancels
+    the healthy wave pipelined behind it, while a genuinely stuck wave
+    cancels its successors too (their resident-state chain is gone)."""
+
+    def test_slow_waves_within_deadline_no_false_cancel(self):
+        """Waves 0 and 1 each resolve 0.6s late against a 0.9s deadline.
+        Budgeted per wave both pass; budgeted from dispatch, pipelined
+        wave 1 would have only ~0.3s left when it reached the head and
+        would be falsely cancelled.  Assert zero cancels and that both
+        slow resolves really ran back-to-back (elapsed > 1.1s)."""
+        chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
+            script={0: SLOW, 1: SLOW}, slow_s=0.6))
+        policy = OverloadPolicy(wave_deadline=0.9)
+        client, factory, sched = build_harness(chaos, policy, batch_size=2)
+        sched.pipeline_depth = 2
+        try:
+            client.create(NODES, make_node("ov-0")
+                          .capacity(cpu="8", mem="32Gi").build())
+            for i in range(4):
+                client.create(PODS, make_pod(f"pipedl{i}")
+                              .req(cpu="100m").build())
+            assert wait_for(lambda: sched.queue.stats()["active"] == 4,
+                            timeout=10)
+            t0 = time.time()
+            sched.run()
+            assert wait_for(lambda: all_bound(client), timeout=30)
+            prom = sched.metrics.prom
+            assert prom.overload_wave_cancel_total.value("deadline") == 0.0
+            assert prom.tpu_seam_events.value("requeued_pods") == 0.0
+            assert chaos.injected[SLOW] == 2
+            # both 0.6s resolves actually happened (serial at the device
+            # head): proof the waves were live-but-late, not fast
+            assert time.time() - t0 > 1.1
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_stuck_wave_cancels_pipelined_successor(self):
+        """Wave 0 is stuck (2.0s against a 0.2s deadline) with healthy
+        wave 1 pipelined behind it.  The watchdog cancels wave 0 AND
+        requeues wave 1 — its dispatch rode a resident-state chain that
+        abandon_wave just dropped — then the calm retry waves bind all
+        four pods well before the stuck resolve would have returned."""
+        chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
+            script={0: SLOW}, slow_s=2.0))
+        policy = OverloadPolicy(wave_deadline=0.2)
+        client, factory, sched = build_harness(chaos, policy, batch_size=2)
+        sched.pipeline_depth = 2
+        try:
+            client.create(NODES, make_node("ov-0")
+                          .capacity(cpu="8", mem="32Gi").build())
+            for i in range(4):
+                client.create(PODS, make_pod(f"pipstk{i}")
+                              .req(cpu="100m").build())
+            assert wait_for(lambda: sched.queue.stats()["active"] == 4,
+                            timeout=10)
+            t0 = time.time()
+            sched.run()
+            assert wait_for(lambda: all_bound(client), timeout=30)
+            prom = sched.metrics.prom
+            # exactly ONE deadline cancel: the successor is torn down via
+            # the requeue path, not double-counted as its own cancel
+            assert prom.overload_wave_cancel_total.value("deadline") == 1.0
+            assert prom.tpu_seam_events.value("requeued_pods") >= 4
+            # the cancel path returned immediately; nothing waited out
+            # the 2.0s stuck resolve
+            assert time.time() - t0 < 1.5
+        finally:
+            sched.stop()
+            factory.stop()
+
+
 class TestSeededOverloadChaos:
     def test_flooded_pipeline_stays_live_and_protects_priority(self):
         """The acceptance scenario: a pod flood against a cap-32 queue

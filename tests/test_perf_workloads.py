@@ -178,3 +178,58 @@ def test_mixed_escapes_reports_nonzero_escape_rate():
         null_device=True)
     assert stats.get("barrier_ok"), stats
     assert stats.get("escape_rate", 0) > 0
+
+
+def test_flight_delay_backend_pins_wave_wall_and_credits_overlap():
+    """FlightDelayBackend (bench --pipeline-ab's off-host-device arm):
+    the flight clock starts at DISPATCH, so host work done between
+    dispatch and resolve is credited against the flight — the property
+    that lets the A/B measure pipeline overlap on a box whose
+    CPU-simulated device shares cores with the host."""
+    import time
+
+    from kubernetes_tpu.ops.nullbackend import FlightDelayBackend
+
+    class _Stub:
+        supports_pipelining = True
+        stats = {"batches": 0}
+
+        def dispatch(self, pods, snapshot):
+            self.stats["batches"] += 1
+            return lambda: ["ok"] * len(pods)
+
+        def warmup(self):
+            self.warmed = True
+
+    stub = _Stub()
+    fb = FlightDelayBackend(stub, flight_s=0.2)
+    # attribute forwarding (scheduler reads these off the backend)
+    assert fb.supports_pipelining is True
+    fb.warmup()
+    assert stub.warmed
+
+    # cold resolve pays the full flight
+    t0 = time.monotonic()
+    resolve = fb.dispatch([1, 2], None)
+    out = resolve()
+    full = time.monotonic() - t0
+    assert out == ["ok", "ok"]
+    assert full >= 0.2
+
+    # host work between dispatch and resolve is credited: sleeping
+    # 150ms of a 200ms flight leaves <~50ms of blocking in resolve
+    resolve = fb.dispatch([1], None)
+    time.sleep(0.15)
+    t0 = time.monotonic()
+    resolve()
+    blocked = time.monotonic() - t0
+    assert blocked < 0.15, blocked
+
+    # non-callable dispatch returns (flush sentinel / inline results)
+    # pass through untouched
+    class _Inline:
+        def dispatch(self, pods, snapshot):
+            return [("n0", None)]
+
+    assert FlightDelayBackend(_Inline(), 0.2).dispatch([1], None) == [
+        ("n0", None)]

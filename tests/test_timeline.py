@@ -487,6 +487,7 @@ class TestArmedPipeline:
 
 
 @pytest.mark.slow
+@pytest.mark.pipeline
 class TestOverheadAB:
     def test_armed_overhead_within_five_percent(self):
         """The ≤5% pin (ISSUE acceptance): paired rounds of the
@@ -522,10 +523,14 @@ class TestOverheadAB:
         ARMED = ProfilingPolicy(timeline=True)
 
         def one(policy):
+            # depth-2 so the pin covers the timeline under OVERLAPPING
+            # waves (use_wave stages interleave across two in-flight
+            # cycles — the wave pipeline's steady state, and the shape
+            # an eager per-record cut would tax hardest)
             summary, stats = run_named_workload(
                 _shrunk_basic(500, 40000, timeout=300.0), tpu=True,
                 caps=caps, batch_size=512, null_device=True,
-                profiling_policy=policy)
+                pipeline_depth=2, profiling_policy=policy)
             assert stats.get("barrier_ok"), stats
             return summary.average
 

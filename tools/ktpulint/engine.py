@@ -29,6 +29,9 @@ Annotation conventions (documented in README "Static analysis"):
   # patch-ok: <why>                          authorize a direct
       ClusterTensors array-field write outside the patch/compaction
       API (tensor-patch-discipline rule)
+  # donate-ok: <why>                         authorize reading a host
+      reference to an array after it was passed into a donating
+      compiled call (donated-buffer-reuse rule)
 
 Findings are deterministic and ordered; a baseline file (JSON list of
 fingerprints) lets pre-existing accepted findings ride without blocking
@@ -49,7 +52,7 @@ _DISABLE_RE = re.compile(r"#\s*ktpulint:\s*disable=([\w,\- ]+)")
 _DISABLE_FILE_RE = re.compile(r"#\s*ktpulint:\s*disable-file=([\w,\- ]+)")
 _ANNOTATION_RE = re.compile(
     r"#\s*(sync-point|compile-cached|guarded-by|replicated-ok|"
-    r"process-local|patch-ok)\b")
+    r"process-local|patch-ok|donate-ok)\b")
 
 
 @dataclasses.dataclass(frozen=True)

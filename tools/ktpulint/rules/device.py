@@ -16,8 +16,8 @@ from __future__ import annotations
 
 import ast
 
-from ..engine import FileView, LintContext, Rule, dotted, register, \
-    walk_functions
+from ..engine import FileView, LintContext, Rule, call_name, dotted, \
+    register, walk_functions
 
 _JIT_NAMES = ("jax.jit", "jit", "jax.pjit", "pjit")
 
@@ -368,3 +368,207 @@ class TensorPatchDisciplineRule(Rule):
                     f"({'/'.join(_GEN_COUNTERS[:2])}); the device diff "
                     "machinery will miss the patch — bump patch_gen or "
                     "annotate # patch-ok: <why>")
+
+
+# codebase-convention donators the registry is seeded with: seam
+# methods whose argument feeds a donated device buffer at the CALL site
+# (_device_step's buf becomes the donated packed transport)
+_KNOWN_DONATORS = {
+    "_device_step": (1,),
+}
+# builder helpers whose RETURNED callable donates fixed argnums (the
+# donation contract lives in parallel/mesh.py); the builder call itself
+# donates nothing
+_KNOWN_BUILDERS = {
+    "build_sharded_step_fn": (0, 2, 3, 4),
+}
+# builders returning (fn, spec): only the FIRST unpack target is the
+# donating callable
+_KNOWN_BUILDER_TUPLES = {
+    "build_packed_assign_fn": (0, 2),
+}
+
+
+def _jit_donate_nums(call: ast.Call) -> tuple[int, ...] | None:
+    """If `call` wraps jax.jit/pjit (directly, via partial, or via the
+    compile_sharded helper) with donate_argnums, return those argnums."""
+    target = dotted(call.func)
+    if target in ("partial", "functools.partial") and call.args:
+        if dotted(call.args[0]) not in _JIT_NAMES:
+            return None
+    elif target not in _JIT_NAMES and not target.endswith("compile_sharded"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums = tuple(sorted(
+                c.value for c in ast.walk(kw.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, int)))
+            return nums or None
+    return None
+
+
+def _donation_registry(view: FileView) -> dict[str, tuple[int, ...]]:
+    """name -> donated positional indexes, for every callable this file
+    binds that donates input buffers: jit wrappings with donate_argnums,
+    compile_sharded results, known builder helpers, and simple aliases
+    of any of those (x = self._fn)."""
+    reg: dict[str, tuple[int, ...]] = dict(_KNOWN_DONATORS)
+
+    def targets_of(n: ast.Assign):
+        for t in n.targets:
+            if isinstance(t, ast.Name):
+                yield t.id
+            elif isinstance(t, ast.Attribute):
+                yield t.attr
+
+    for _ in range(2):  # second pass resolves aliases of later bindings
+        for n in ast.walk(view.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in n.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        nums = _jit_donate_nums(dec)
+                        if nums:
+                            reg[n.name] = nums
+                continue
+            if not isinstance(n, ast.Assign):
+                continue
+            v = n.value
+            if isinstance(v, ast.Call):
+                nums = _jit_donate_nums(v)
+                cname = call_name(v)
+                if nums is None and cname in _KNOWN_BUILDERS:
+                    nums = _KNOWN_BUILDERS[cname]
+                if nums:
+                    for name in targets_of(n):
+                        reg[name] = nums
+                elif cname in _KNOWN_BUILDER_TUPLES:
+                    # (fn, spec) = build_...(...): first target donates
+                    for t in n.targets:
+                        if isinstance(t, ast.Tuple) and t.elts:
+                            first = t.elts[0]
+                            if isinstance(first, ast.Name):
+                                reg[first.id] = \
+                                    _KNOWN_BUILDER_TUPLES[cname]
+                            elif isinstance(first, ast.Attribute):
+                                reg[first.attr] = \
+                                    _KNOWN_BUILDER_TUPLES[cname]
+            elif isinstance(v, (ast.Name, ast.Attribute)):
+                alias = v.id if isinstance(v, ast.Name) else v.attr
+                if alias in reg:
+                    for name in targets_of(n):
+                        reg[name] = reg[alias]
+    return reg
+
+
+def _host_ref_key(node: ast.AST) -> str | None:
+    """Identity of a host reference a donated arg may travel under: a
+    bare local name, or a self attribute.  Wrapped args (jnp.asarray(x))
+    are NOT tracked — the donated buffer there is the fresh conversion,
+    not the host array."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+@register
+class DonatedBufferReuseRule(Rule):
+    """Donation (donate_argnums) hands an input buffer's memory to XLA:
+    after the compiled call dispatches, the donated device array is DEAD
+    and any host reference to it reads deleted memory (jax raises on
+    CPU; on a real TPU the failure mode is silent garbage mid-pipeline).
+    The double-buffered wave pipeline leans on donation to keep HBM flat
+    — which makes a retained reference the easiest way to corrupt wave
+    N+1 with wave N's reclaimed transport.
+
+    Within a function, reading a name (or self attribute) AFTER it was
+    passed at a donated position of a donating compiled call is a
+    finding, unless the name was rebound in between (the resident-state
+    idiom: state, out = fn(state, ...)) or the read is annotated
+    `# donate-ok: <why>` (e.g. the reference is a host-side staging
+    copy that the seam re-converts per call)."""
+
+    name = "donated-buffer-reuse"
+    doc = "no host reads of buffers already donated to a compiled call"
+
+    def check_file(self, view: FileView, ctx: LintContext):
+        if not hot_path(view, ctx) or view.tree is None:
+            return
+        reg = _donation_registry(view)
+        # analyze OUTERMOST function scopes with their nested closures
+        # included: a resolve() closure shares the dispatching frame's
+        # variables, and a buffer retained across that boundary is
+        # exactly the hazard (wave N's reclaimed transport read at wave
+        # N's resolve, after wave N+1 dispatched)
+        for fn in self._outer_functions(view.tree):
+            yield from self._check_fn(view, fn, reg)
+
+    @staticmethod
+    def _outer_functions(tree: ast.AST):
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield child
+                else:
+                    yield from visit(child)
+        yield from visit(tree)
+
+    def _check_fn(self, view: FileView, fn: ast.AST,
+                  reg: dict[str, tuple[int, ...]]):
+        # (key, donation line, call-subtree node ids) for every donated
+        # host reference; the subtree ids exclude the donating call's
+        # own (possibly multiline) arguments from the read scan
+        donated: list[tuple[str, int, frozenset[int]]] = []
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            callee = call_name(n)
+            if callee not in reg:
+                continue
+            own = frozenset(id(c) for c in ast.walk(n))
+            for idx in reg[callee]:
+                if idx < len(n.args):
+                    key = _host_ref_key(n.args[idx])
+                    if key is not None:
+                        donated.append((key, n.lineno, own))
+        if not donated:
+            return
+        # rebind lines per key: a rebind between donation and read
+        # makes the read safe (fresh buffer under the same name)
+        rebinds: dict[str, list[int]] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.For)):
+                tgts = (n.targets if isinstance(n, ast.Assign)
+                        else [n.target])
+                for t in tgts:
+                    for el in ast.walk(t):
+                        key = _host_ref_key(el)
+                        if key is not None and isinstance(
+                                el.ctx, (ast.Store, ast.Del)):
+                            rebinds.setdefault(key, []).append(el.lineno)
+        seen: set[tuple[str, int]] = set()
+        for key, dline, own in donated:
+            for n in ast.walk(fn):
+                if not isinstance(n, (ast.Name, ast.Attribute)):
+                    continue
+                if _host_ref_key(n) != key \
+                        or not isinstance(n.ctx, ast.Load):
+                    continue
+                line = n.lineno
+                if line <= dline or id(n) in own \
+                        or (key, line) in seen:
+                    continue
+                if any(dline <= r < line for r in rebinds.get(key, ())):
+                    continue
+                if view.line_has_annotation(line, "donate-ok"):
+                    continue
+                seen.add((key, line))
+                yield self.finding(
+                    view, line,
+                    f"{key} was donated to a compiled call at line "
+                    f"{dline} and its buffer may already be reclaimed; "
+                    "rebind it from the call's output or annotate "
+                    "# donate-ok: <why>")
