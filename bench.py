@@ -248,6 +248,10 @@ def _overload_shape(batch: int):
     from kubernetes_tpu.scheduler.config import OverloadPolicy
 
     policy = OverloadPolicy(
+        # the chaos arm pins always-on: the A/B measures the PROTECTION
+        # LAYERS against the storm, not the engagement controller's
+        # detection latency (the healthy arm measures that)
+        engagement="always",
         queue_cap=int(os.environ.get("BENCH_OVERLOAD_CAP", str(4 * batch))),
         shed_protect_priority=1000,   # the workload's hipri- pods
         shed_protect_age=30.0,
@@ -795,6 +799,36 @@ def run_overload() -> dict:
     wp, np_ = out["with_policy"], out["without_policy"]
     out["policy_speedup"] = round(
         wp["pods_per_s"] / max(np_["pods_per_s"], 1e-9), 2)
+    # healthy-box parity: the SAME flood, NO chaos, the DEFAULT policy
+    # (auto engagement) vs no policy at all.  This is the on-by-default
+    # headline — a disengaged controller must cost nothing measurable,
+    # so healthy_parity should sit within a few percent of 1.0.  Both
+    # shapes get an untimed warmup pass first (the --trace/--timeline
+    # A/B discipline): the chaos arms above leave allocator/JIT state
+    # that otherwise lands entirely on whichever healthy arm runs first
+    # and read as a ~3x phantom gap.
+    from kubernetes_tpu.scheduler.config import OverloadPolicy
+    for pol in (None, OverloadPolicy()):
+        run_named_workload(build_cfg(), tpu=True, caps=caps,
+                           batch_size=batch, pipeline_depth=2,
+                           overload=pol)
+    for tag, pol in (("healthy_default", OverloadPolicy()),
+                     ("healthy_no_policy", None)):
+        summary, stats = run_named_workload(
+            build_cfg(), tpu=True, caps=caps, batch_size=batch,
+            pipeline_depth=2, overload=pol)
+        e2e = stats.get("e2e") or {}
+        side = {"pods_per_s": round(summary.average, 1),
+                "p50_ms": e2e.get("p50_ms"),
+                "p99_ms": e2e.get("p99_ms"),
+                "barrier_ok": stats.get("barrier_ok", False)}
+        if "overload" in stats:
+            side["engagement"] = stats["overload"].get("engagement")
+            side["transitions"] = stats["overload"].get("transitions")
+        out[tag] = side
+    out["healthy_parity"] = round(
+        out["healthy_default"]["pods_per_s"]
+        / max(out["healthy_no_policy"]["pods_per_s"], 1e-9), 3)
     return out
 
 

@@ -108,6 +108,11 @@ class Client:
         the precondition to compare-and-bind on the pod's resourceVersion.
         """
         ns, nm = meta.namespace(pod), meta.name(pod)
+        if not node_name:
+            # an empty nodeName stores as "unbound" to every reader: the
+            # pod would be silently lost.  Same guard as the store's
+            # bulk bind_many — refuse loudly so the caller requeues.
+            raise kv.StoreError(f"bind {ns}/{nm}: empty node name refused")
 
         def apply(cur: Obj) -> Obj:
             if cur["spec"].get("nodeName"):

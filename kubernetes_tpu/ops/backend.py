@@ -247,13 +247,17 @@ def _trace_parent():
 
 
 def decode_results(assignments, n: int, batch_size: int, escapes: set,
-                   row_infos: list, no_fit_msg: str,
+                   row_names: list, no_fit_msg: str,
                    nofit_escapes: set | None = None
                    ) -> list[tuple[str | None, Status | None]]:
     """Shared assignment decode (single-chip + sharded backends): map each
-    pod slot to (node_name, status).  `row_infos` is the node_infos list
-    CAPTURED AT DISPATCH — a later dispatch may recycle rows, so names must
-    resolve against the batch's own view.
+    pod slot to (node_name, status).  `row_names` is the tensors' row_names
+    list CAPTURED AT DISPATCH — a later dispatch may recycle rows, so names
+    must resolve against the batch's own view.  It is a list of STRINGS on
+    purpose: the zero-copy cache view shares live NodeInfos whose .node the
+    cache nulls in place when a drained node still holds pods, so resolving
+    NodeInfo.name across the dispatch->resolve gap can yield "" — and a
+    bind to nodeName "" is a silently lost pod (nothing ever requeues it).
 
     `nofit_escapes`: pods whose constraints rode COLLIDED (shared)
     selector-group buckets — for them a no-fit verdict is an upper-bound
@@ -276,17 +280,17 @@ def decode_results(assignments, n: int, batch_size: int, escapes: set,
         if row < 0:
             results.append((None, Status(UNSCHEDULABLE, no_fit_msg)))
             continue
-        ni = row_infos[row]
-        if ni is None:
+        name = row_names[row]
+        if not name:
             # invariant violation (device placed onto an invalid row):
             # surface it loudly — the device-side capacity claim is now
             # phantom until the next refresh, and silently reporting
             # "no feasible node" would mask the encoding bug
             results.append((None, Status(
-                ERROR, f"device assigned row {row} with no NodeInfo "
+                ERROR, f"device assigned row {row} with no node name "
                        "(encoder/valid-mask bug)")))
         else:
-            results.append((ni.name, None))
+            results.append((name, None))
     return results
 
 
@@ -1651,10 +1655,12 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
             self.stats["batches"] += 1
             holder = object()
             self._unresolved.append(holder)
-            # row->NodeInfo view AT DISPATCH: a later dispatch may recycle
+            # row->name view AT DISPATCH: a later dispatch may recycle
             # rows (node deleted, slot reused), so resolve() must not read
-            # the live tensors
-            row_infos = list(self.tensors.node_infos)
+            # the live tensors.  Names, not NodeInfos: the zero-copy cache
+            # view shares live NodeInfos and a churn drain nulls .node in
+            # place mid-wave, which would decode as nodeName ""
+            row_names = list(self.tensors.row_names)
 
         was_full = self._needs_full(batch)
 
@@ -1745,7 +1751,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                 solve_sp.set_attribute("pods", n)
                 solve_sp.end()
             out = decode_results(assignments, n, self.batch_size,
-                                 set(batch.escape), row_infos,
+                                 set(batch.escape), row_names,
                                  "no feasible node (TPU batch filter)",
                                  nofit_escapes=set(batch.nofit_oracle))
             self._tally_batch_escapes(batch, n, assignments)
@@ -1884,8 +1890,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     rv = self._req_vec(vp.request)
                     reclaim[gmask, row] += rv
                     reclaim_np[gmask, row] += 1.0
-            row_names = [ni.name if ni is not None else None
-                         for ni in t.node_infos]
+            row_names = list(t.row_names)
             alloc, used = t.alloc.copy(), t.used.copy()
             npods, maxpods = t.npods.copy(), t.maxpods.copy()
             valid = t.valid.copy()
@@ -2029,8 +2034,7 @@ class TPUBatchBackend(ResidentHostMirror, BatchBackend):
                     row = t.row_of.get(name)
                     if row is not None and t.valid[row]:
                         node_ord[row] = pos
-                row_names = [ni.name if ni is not None else None
-                             for ni in t.node_infos]
+                row_names = list(t.row_names)
                 vict_keys = [list(ks) if ks else [] for ks in t.vict_keys]
                 # host copies for the post-claim feasibility bound; on
                 # the in-process backend these are the arrays the kernel

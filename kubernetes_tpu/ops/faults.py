@@ -523,3 +523,158 @@ class UpgradeDriver:
             if applied is not None:
                 out.append(applied)
         return out
+
+
+# -- churn-storm chaos (signal-driven engagement PR) -----------------------
+#
+# The chaos above stresses the transport, the device path and the
+# MEMBERSHIP; the storm below stresses the CLUSTER TOPOLOGY itself —
+# flooding node adds, drains and relabels through the informer while pod
+# floods are in flight, so the backend's row patches, between-wave
+# compaction and pipelined generation fences absorb real event pressure
+# with SLOs asserted on top.  Seeded + scriptable under the same
+# one-draw-per-step stream-stability rule as every schedule above, so
+# tests/test_churn_storm.py and bench.py replay identical storms.
+
+NODE_ADD = "node_add"
+NODE_DRAIN = "node_drain"
+NODE_RELABEL = "node_relabel"
+
+
+class ChurnStormSchedule:
+    """Seeded, reproducible per-step node-churn decisions.
+
+    One rng draw per step regardless of the script; the single draw
+    decides BOTH the action and the victim — its position inside the
+    action's probability band re-scales to a victim fraction (the
+    ScaleOutSchedule idiom), so adding a scripted step never shifts the
+    stream of the steps around it.  Scripted entries are
+    (action, victim_fraction) pairs and win."""
+
+    def __init__(self, seed: int = 0, add_rate: float = 0.0,
+                 drain_rate: float = 0.0, relabel_rate: float = 0.0,
+                 script: dict[int, tuple[str, float]] | None = None):
+        self.rng = random.Random(seed)
+        self.add_rate = add_rate
+        self.drain_rate = drain_rate
+        self.relabel_rate = relabel_rate
+        self.script = dict(script or {})
+
+    def action(self, step_index: int) -> tuple[str, float]:
+        u = self.rng.random()
+        scripted = self.script.get(step_index)
+        if scripted is not None:
+            return scripted
+        if self.add_rate and u < self.add_rate:
+            return (NODE_ADD, u / self.add_rate)
+        lo = self.add_rate
+        if self.drain_rate and u < lo + self.drain_rate:
+            return (NODE_DRAIN, (u - lo) / self.drain_rate)
+        lo += self.drain_rate
+        if self.relabel_rate and u < lo + self.relabel_rate:
+            return (NODE_RELABEL, (u - lo) / self.relabel_rate)
+        return (NONE, 0.0)
+
+
+class NodeStormDriver:
+    """Applies ChurnStormSchedule actions to a live cluster store.
+
+    NODE_ADD      -> create a fresh schedulable node (storm-N); lands as
+                     an informer add -> backend row patch / gen bump.
+    NODE_DRAIN    -> delete the victim node outright (the storm models
+                     abrupt capacity loss, not cordon+wait): its row is
+                     tombstoned, bound pods' accounting unwinds, and any
+                     in-flight wave dispatched against the old topology
+                     must gen-fence.  A min_nodes floor refuses drains
+                     that would leave the flood nowhere to land (chaos
+                     must not deadlock the run).
+    NODE_RELABEL  -> bump a storm epoch label on the victim via
+                     guaranteed_update; an update event that changes
+                     labels invalidates selector caches without touching
+                     capacity — the cheap-patch path under pressure.
+
+    Victims are picked from the driver's live-name view (base nodes +
+    storm adds - drains); `injected` counts applied actions and `log`
+    records (step, action, node) for deterministic assertions."""
+
+    def __init__(self, client, schedule: ChurnStormSchedule,
+                 base_nodes, min_nodes: int = 1, max_nodes: int = 0,
+                 cpu: str = "32", mem: str = "256Gi", pods: int = 110,
+                 rack_labels: int = 0, name_prefix: str = "storm-"):
+        self.client = client
+        self.schedule = schedule
+        self.min_nodes = max(1, min_nodes)
+        # ceiling symmetrical to the floor: unbounded adds would grow the
+        # cluster past the backend's tensor caps (n_cap) and stall every
+        # wave; 0 = no ceiling (unit tests), harness default is 2x base
+        self.max_nodes = max_nodes
+        self.cpu, self.mem, self.pods = cpu, mem, pods
+        self.rack_labels = rack_labels
+        self.name_prefix = name_prefix
+        self._names = list(base_nodes)
+        self._next_id = 0
+        self.steps = 0
+        self.injected = {NODE_ADD: 0, NODE_DRAIN: 0, NODE_RELABEL: 0}
+        self.log: list[tuple[int, str, str]] = []
+        self._lock = threading.Lock()
+
+    def _build_node(self, name: str, epoch: int):
+        from ..testing import make_node
+        w = make_node(name).capacity(cpu=self.cpu, mem=self.mem,
+                                     pods=self.pods)
+        labels = {"kubernetes.io/hostname": name,
+                  "ktpu.io/storm-epoch": str(epoch)}
+        if self.rack_labels:
+            labels["ktpu.io/rack"] = str(epoch % self.rack_labels)
+        w.labels(**labels)
+        return w.build()
+
+    def step(self) -> tuple[str, str] | None:
+        """Consult the schedule once; returns the applied (action, node)
+        or None when the step was a no-op (NONE draw or floor refusal)."""
+        from ..client.clientset import NODES
+        from ..store import kv
+        with self._lock:
+            i = self.steps
+            self.steps += 1
+            act, frac = self.schedule.action(i)
+            if act == NODE_ADD:
+                if self.max_nodes and len(self._names) >= self.max_nodes:
+                    return None
+                name = f"{self.name_prefix}{self._next_id}"
+                node = self._build_node(name, self._next_id)
+                self._next_id += 1
+                try:
+                    self.client.create(NODES, node)
+                except kv.StoreError:
+                    return None
+                self._names.append(name)
+            elif act == NODE_DRAIN:
+                if len(self._names) <= self.min_nodes:
+                    return None
+                name = self._names.pop(
+                    min(int(frac * len(self._names)),
+                        len(self._names) - 1))
+                try:
+                    self.client.delete(NODES, "", name)
+                except kv.StoreError:
+                    return None
+            elif act == NODE_RELABEL:
+                if not self._names:
+                    return None
+                name = self._names[min(int(frac * len(self._names)),
+                                       len(self._names) - 1)]
+
+                def bump(cur, i=i):
+                    cur["metadata"].setdefault("labels", {})[
+                        "ktpu.io/storm-epoch"] = str(i)
+                    return cur
+                try:
+                    self.client.guaranteed_update(NODES, "", name, bump)
+                except kv.StoreError:
+                    return None
+            else:
+                return None
+            self.injected[act] += 1
+            self.log.append((i, act, name))
+            return (act, name)

@@ -274,6 +274,13 @@ class ClusterTensors:
 
         self.row_of: dict[str, int] = {}
         self.node_infos: list[NodeInfo | None] = [None] * c.n_cap
+        # registration-time name per row.  Dispatch snapshots THIS list to
+        # resolve assignments, never NodeInfo.name: the zero-copy cache
+        # view (CacheFlattenView) shares LIVE NodeInfos, and the cache
+        # nulls .node in place when a drained node still holds pods — a
+        # wave resolving across that mutation would read name "" and bind
+        # pods to an empty nodeName (silently lost; nothing requeues them)
+        self.row_names: list[str | None] = [None] * c.n_cap
         self.gen = np.zeros(c.n_cap, np.int64)
         self.node_gen = np.full(c.n_cap, -1, np.int64)  # last static encode
         self._free = list(range(c.n_cap - 1, -1, -1))
@@ -768,6 +775,7 @@ class ClusterTensors:
                         f"node capacity {self.caps.n_cap} exceeded")
                 row = self._free.pop()
                 row_of[name] = row
+                self.row_names[row] = name
                 gen[row] = -1
             if gen[row] != ni.generation:
                 if (bulk_ok and valid[row]
@@ -867,6 +875,7 @@ class ClusterTensors:
             return None
         self.valid[row] = False
         self.node_infos[row] = None
+        self.row_names[row] = None
         self.node_gen[row] = -1
         self._dyn_digest[row] = None
         self._tombstones.add(row)
@@ -926,6 +935,7 @@ class ClusterTensors:
                     f"node capacity {self.caps.n_cap} exceeded")
             row = self._free.pop()
             self.row_of[name] = row
+            self.row_names[row] = name
             self.gen[row] = -1
         elif self.gen[row] == ni.generation:
             return None

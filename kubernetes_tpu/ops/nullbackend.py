@@ -237,7 +237,7 @@ class NullBatchBackend(BatchBackend):
             if extra_escapes:
                 assignments[list(extra_escapes)] = -1
             self._replay_claims(batch, assignments, n)
-            row_infos = list(self.tensors.node_infos)
+            row_names = list(self.tensors.row_names)
             self.stats["batches"] += 1
             self.stats["epoch_skips"] = self.stats.get(
                 "epoch_skips", 0) + (1 if skip_sync else 0)
@@ -245,7 +245,7 @@ class NullBatchBackend(BatchBackend):
 
         def resolve():
             out = decode_results(assignments, n, self.batch_size, escapes,
-                                 row_infos, "no feasible node (null backend)",
+                                 row_names, "no feasible node (null backend)",
                                  nofit_escapes=set(batch.nofit_oracle))
             record_batch_stats(self.stats, self._lock, out, n)
             return out

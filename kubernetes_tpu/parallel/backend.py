@@ -394,7 +394,9 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
             self.stats["batches"] += 1
             holder = object()
             self._unresolved.append(holder)
-            row_infos = list(self.tensors.node_infos)  # view at dispatch
+            # names, not NodeInfos: live NodeInfos can have .node nulled
+            # in place mid-wave (cache drain of a node still holding pods)
+            row_names = list(self.tensors.row_names)  # view at dispatch
 
         n = len(pod_infos)
 
@@ -454,7 +456,7 @@ class ShardedTPUBatchBackend(ResidentHostMirror, BatchBackend):
                     will_fence = False
             out = decode_results(
                 assignments, n, self.batch_size, set(batch.escape),
-                row_infos, "no feasible node (sharded batch filter)",
+                row_names, "no feasible node (sharded batch filter)",
                 nofit_escapes=set(batch.nofit_oracle))
             record_batch_stats(self.stats, self._lock, out, n)
             return out

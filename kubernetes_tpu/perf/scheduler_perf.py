@@ -611,6 +611,7 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
     expected_scheduled = 0
     stats: dict[str, Any] = {}
     churn_stop: list[threading.Event] = []
+    storm_drivers: list = []
     for op in ops:
         opcode = op["opcode"]
         if opcode == "createNodes":
@@ -774,12 +775,54 @@ def run_workload(cluster: PerfCluster, ops: list[dict],
                     i += 1
 
             threading.Thread(target=churn_loop, daemon=True).start()
+        elif opcode == "nodeStorm":
+            # seeded topology churn (ChurnStormSchedule + NodeStormDriver):
+            # floods node adds / drains / relabels through the informer
+            # while pod floods are in flight, stressing the backend's row
+            # patches, between-wave compaction and pipelined gen fences.
+            # Background thread like churn; stepped at a fixed interval,
+            # stopped at end-of-workload with the same stop-event list.
+            from ..ops.faults import ChurnStormSchedule, NodeStormDriver
+            storm_sched = ChurnStormSchedule(
+                seed=op.get("seed", 0),
+                add_rate=op.get("addRate", 0.0),
+                drain_rate=op.get("drainRate", 0.0),
+                relabel_rate=op.get("relabelRate", 0.0))
+            prefix = op.get("nodeNamePrefix", "node-")
+            driver = NodeStormDriver(
+                cluster.client, storm_sched,
+                [f"{prefix}{i}" for i in range(created_nodes)],
+                min_nodes=op.get("minNodes", max(1, created_nodes // 2)),
+                max_nodes=op.get("maxNodes", max(4, created_nodes * 2)),
+                cpu=op.get("cpu", "32"), mem=op.get("memory", "256Gi"),
+                rack_labels=op.get("rackLabels", 0))
+            storm_drivers.append(driver)
+            ev = threading.Event()
+            churn_stop.append(ev)
+            interval = op.get("intervalMilliseconds", 50) / 1000.0
+            max_steps = op.get("steps", 0)
+
+            def storm_loop(ev=ev, driver=driver, interval=interval,
+                           max_steps=max_steps):
+                while not ev.wait(interval):
+                    if max_steps and driver.steps >= max_steps:
+                        return
+                    driver.step()
+
+            threading.Thread(target=storm_loop, daemon=True).start()
         else:
             raise ValueError(f"unknown opcode {opcode!r}")
     for ev in churn_stop:
         ev.set()
     stats["created_pods"] = created_pods
     stats["created_nodes"] = created_nodes
+    if storm_drivers:
+        stats["storm"] = {
+            "steps": sum(d.steps for d in storm_drivers),
+            "injected": {k: sum(d.injected[k] for d in storm_drivers)
+                         for k in storm_drivers[0].injected},
+            "live_nodes": sum(len(d._names) for d in storm_drivers),
+        }
     return stats
 
 
@@ -902,6 +945,10 @@ def run_named_workload(config: dict, tpu: bool = False, caps=None,
                     prom.overload_wave_cancel_total.values().values()),
                 "final_wave": (tuner.current() if tuner is not None
                                else batch_size),
+                "engagement": cluster.scheduler.overload_engagement,
+                "transitions": {
+                    f"{f}->{t}/{r}": v for (f, t, r), v
+                    in prom.overload_transition_total.values().items()},
             }
         return summary, stats
     finally:

@@ -362,10 +362,20 @@ def _parse_profiling(data: dict) -> ProfilingPolicy:
 class OverloadPolicy:
     """Closed-loop overload protection for the batch pipeline.
 
-    Configured via the `overload:` stanza; every knob defaults OFF so an
-    unconfigured scheduler behaves exactly as before.  Four independent
-    layers (in the spirit of Borg's overload-tolerant admission and the
-    stability patterns in ops/failover.py):
+    Configured via the `overload:` stanza and ON BY DEFAULT since the
+    signal-driven engagement controller landed: an unconfigured
+    scheduler carries protective defaults for every layer, but the
+    layers only ACT while the engagement state machine (`engagement:
+    auto`, scheduler._EngagementController) is engaged — armed by the
+    SLO burn-rate breach signal with queue-depth growth as the
+    secondary trigger, released with dwell-time hysteresis.  A healthy
+    box therefore pays a few branch checks per wave, not the ~3x
+    throughput cost the always-on policy used to charge.
+    `engagement: always` restores the legacy behavior (every layer
+    active whenever its knob is non-zero); `engagement: off` disables
+    the stanza entirely.  Four independent layers (in the spirit of
+    Borg's overload-tolerant admission and the stability patterns in
+    ops/failover.py):
 
       queue_cap        bounded admission — activeQ depth cap; excess pods
                        are shed lowest-priority-first (youngest first
@@ -391,23 +401,31 @@ class OverloadPolicy:
                        pods requeue through the BackendUnavailableError
                        path."""
 
-    queue_cap: int = 0                  # 0 = unbounded (admission off)
+    queue_cap: int = 16384              # 0 = unbounded (admission off)
     shed_protect_priority: int = 1000   # >= this priority: never shed
     shed_protect_age: float = 30.0      # queued longer than this: never shed
-    slo_p99_ms: float = 0.0             # 0 = adaptive wave sizing off
+    slo_p99_ms: float = 250.0           # 0 = adaptive wave sizing off
     wave_min: int = 16                  # AIMD floor for the wave size
     wave_increase: int = 32             # additive increase per good wave
     wave_decrease: float = 0.5          # multiplicative decrease on breach
-    escape_rate_threshold: float = 0.0  # 0 = escape-storm breaker off
-    escape_min_batch: int = 8           # smaller batches never count as storms
+    escape_rate_threshold: float = 0.5  # 0 = escape-storm breaker off
+    escape_min_batch: int = 64          # smaller batches never count as storms
     breaker_threshold: int = 3          # consecutive storm batches to open
     breaker_probe_interval: float = 5.0  # seconds between probe batches
-    wave_deadline: float = 0.0          # 0 = stuck-wave watchdog off
+    wave_deadline: float = 120.0        # 0 = stuck-wave watchdog off
+    # -- engagement state machine (scheduler._EngagementController) -------
+    engagement: str = "auto"            # auto | always | off
+    arm_samples: int = 2                # consecutive pressure waves to engage
+    engage_dwell: float = 5.0           # min calm seconds before cooling
+    cool_dwell: float = 10.0            # cooling seconds before disengaging
+    queue_growth_factor: float = 2.0    # depth > factor*wave AND growing
 
     @property
     def enabled(self) -> bool:
-        return (self.queue_cap > 0 or self.slo_p99_ms > 0
-                or self.escape_rate_threshold > 0 or self.wave_deadline > 0)
+        return (self.engagement != "off"
+                and (self.queue_cap > 0 or self.slo_p99_ms > 0
+                     or self.escape_rate_threshold > 0
+                     or self.wave_deadline > 0))
 
 
 # overload YAML key -> OverloadPolicy field
@@ -424,6 +442,11 @@ _OVERLOAD_FIELDS = {
     "breakerThreshold": "breaker_threshold",
     "breakerProbeIntervalSeconds": "breaker_probe_interval",
     "waveDeadlineSeconds": "wave_deadline",
+    "engagement": "engagement",
+    "armSamples": "arm_samples",
+    "engageDwellSeconds": "engage_dwell",
+    "coolDwellSeconds": "cool_dwell",
+    "queueGrowthFactor": "queue_growth_factor",
 }
 
 
@@ -451,6 +474,16 @@ def _parse_overload(data: dict) -> OverloadPolicy:
         raise ConfigError("overload breakerThreshold must be >= 1")
     if policy.breaker_probe_interval <= 0:
         raise ConfigError("overload breakerProbeIntervalSeconds must be positive")
+    if policy.engagement not in ("auto", "always", "off"):
+        raise ConfigError(
+            "overload engagement must be auto, always or off")
+    if policy.arm_samples < 1:
+        raise ConfigError("overload armSamples must be >= 1")
+    if policy.engage_dwell < 0 or policy.cool_dwell < 0:
+        raise ConfigError(
+            "overload engageDwellSeconds/coolDwellSeconds must be >= 0")
+    if policy.queue_growth_factor <= 0:
+        raise ConfigError("overload queueGrowthFactor must be positive")
     return policy
 
 

@@ -167,6 +167,21 @@ class Metrics:
         self.overload_breaker_open = cbm.Gauge(
             "scheduler_overload_breaker_open",
             "Escape-storm breaker state (1 = open: escapes deferred).")
+        # signal-driven engagement (overload: engagement): the hysteresis
+        # state machine that decides WHEN the four layers above act.
+        # Transitions are counted at the edge (scheduler loop thread is
+        # the only writer); the gauge is refreshed at expose time.
+        self.overload_engaged = cbm.Gauge(
+            "scheduler_overload_engaged",
+            "Overload engagement state (1 = engaged or cooling: the "
+            "admission/AIMD/breaker/watchdog layers are active; 0 = "
+            "disengaged or arming: quiescent).")
+        self.overload_transition_total = cbm.Counter(
+            "scheduler_overload_transition_total",
+            "Engagement state-machine transitions, by from/to state and "
+            "trigger reason (slo_burn, queue_growth, blip, calm, "
+            "re_pressure, cooled, config).",
+            labels=("from", "to", "reason"))
         # scale-out additions (scaleOut: stanza): optimistic-bind races
         # between cooperating scheduler instances, resolved at commit time
         # (Omega shared-state model).  The loser classifies each conflicted
@@ -302,7 +317,8 @@ class Metrics:
             self.tpu_batch_waves, self.tpu_victim_occupancy,
             self.queue_shed_total, self.overload_deferred_total,
             self.overload_wave_cancel_total, self.overload_wave_size,
-            self.overload_breaker_open, self.bind_conflict_total,
+            self.overload_breaker_open, self.overload_engaged,
+            self.overload_transition_total, self.bind_conflict_total,
             self.informer_relist_total, self.tpu_wave_collective_bytes,
             self.tpu_step_collective_bytes, self.tpu_wave_flops,
             self.tpu_step_hbm_bytes, self.host_stage_seconds,

@@ -105,6 +105,10 @@ class _ChildHTTP(http.server.BaseHTTPRequestHandler):
                     else b"draining" if draining else b"fenced")
             self.send_response(200 if ok else 503)
             self.send_header("Content-Type", "text/plain")
+            # engagement posture rides a header, not the body: probes
+            # and the rolling upgrade pin the (status, body) contract
+            self.send_header("X-Overload-Engagement",
+                             getattr(sched, "overload_engagement", "off"))
         else:
             body = b"not found"
             self.send_response(404)

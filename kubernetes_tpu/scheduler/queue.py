@@ -282,6 +282,13 @@ class SchedulingQueue:
         self._shed_protect_priority = shed_protect_priority
         self._shed_protect_age = shed_protect_age
         self._shed_pending: dict[tuple[str, str], int] = {}  # guarded-by: _lock|_cond
+        # engagement gate (overload: engagement): when the scheduler's
+        # engagement controller drives this queue it parks the cap until
+        # the pipeline is actually drowning — the disengaged hot path
+        # then costs one attribute read in _shed_over_cap_locked.
+        # Default True: a cap set without a controller (tests, legacy
+        # `engagement: always`) enforces immediately, as it always has.
+        self._overload_engaged = True
 
     # -- backoff ---------------------------------------------------------
 
@@ -308,6 +315,21 @@ class SchedulingQueue:
             self._queue_cap = queue_cap
             self._shed_protect_priority = shed_protect_priority
             self._shed_protect_age = shed_protect_age
+
+    def set_overload_engaged(self, engaged: bool) -> None:
+        """Gate bounded admission on the engagement state machine.  While
+        False the cap is dormant (adds never shed); flipping True does
+        NOT retro-shed — call enforce_cap() for that."""
+        with self._lock:
+            self._overload_engaged = engaged
+
+    def enforce_cap(self) -> None:
+        """One-shot cap enforcement against the CURRENT queue: the
+        engagement controller calls this at the disengaged->engaged edge
+        so backlog admitted while dormant is shed immediately instead of
+        waiting for the next add/event to trip the cap."""
+        with self._lock:
+            self._shed_over_cap_locked("engaged")
 
     def _priority_band(self, priority: int) -> str:
         if priority >= SYSTEM_PRIORITY_BAND:
@@ -343,7 +365,7 @@ class SchedulingQueue:
             shed loop: every pod's age only grows, so eventual admission
             is guaranteed."""
         cap = self._queue_cap
-        if cap <= 0:
+        if cap <= 0 or not self._overload_engaged:
             return
         excess = len(self._active) - cap
         if excess <= 0:

@@ -302,6 +302,21 @@ class _WaveTuner:
             self._wave = min(self._cap,
                              self._wave + max(1, self._increase // 4))
 
+    def reclamp(self, wave_cap: int) -> None:
+        """Re-clamp the AIMD bounds to a new batch size (config
+        hot-reload): without this a reload that SHRINKS backend.batchSize
+        leaves the ceiling — and possibly the live wave — above the new
+        batch size until a process restart."""
+        self._cap = max(1, wave_cap)
+        self._min = min(self._min, self._cap)
+        self._wave = min(self._wave, self._cap)
+
+    def reset(self) -> None:
+        """Back to the full wave (the disengaged->engaged boundary: an
+        engagement spell must not inherit the previous storm's shrunken
+        wave, and a disengaged pipeline always dispatches full waves)."""
+        self._wave = self._cap
+
 
 class _OverloadBreaker:
     """Escape-storm circuit breaker: consecutive-failure open, probe-based
@@ -344,6 +359,133 @@ class _OverloadBreaker:
     def probe_due(self) -> bool:
         return (self.opened_at is not None
                 and self._now() - self.opened_at >= self.probe_interval)
+
+
+# Engagement transition taxonomy.  The README "Overload protections"
+# table and the ktpu-lint taxonomy-sync rule both pin these exact
+# tokens, so a new state or reason must land in code and README
+# together; _EngagementController asserts it never emits outside them.
+_ENGAGEMENT_STATES = ("disengaged", "arming", "engaged", "cooling")
+_ENGAGEMENT_REASONS = ("slo_burn", "queue_growth", "blip", "calm",
+                       "re_pressure", "cooled", "config")
+
+
+class _EngagementController:
+    """Hysteresis state machine gating the overload layers (overload:
+    engagement auto — the shipping default).
+
+        disengaged -> arming       first pressure wave (slo_burn /
+                                   queue_growth)
+        arming     -> engaged      arm_samples consecutive pressure waves
+        arming     -> disengaged   pressure vanished unconfirmed (blip)
+        engaged    -> cooling      engage_dwell calm seconds (calm)
+        cooling    -> engaged      pressure returned (re_pressure)
+        cooling    -> disengaged   cool_dwell calm seconds (cooled)
+
+    The protections are active in engaged AND cooling: the cooling tier
+    is the release-side hysteresis, so a flapping storm re-engages a
+    still-armed machine instead of thrashing admission open/closed.
+    The primary arm signal is the SRE multi-window burn-rate breach
+    (SLOTracker.breached(): the two shortest windows both burning >1.0
+    — fast window to react, slower window to confirm); the secondary is
+    queue-depth growth (backlog beyond queue_growth_factor nominal
+    waves AND still growing — catches a flood whose latency damage
+    hasn't reached the bind tail yet).  One on_wave() per retired wave;
+    the quiescent cost is the two pressure predicates.  All clocks are
+    time.monotonic (injectable for tests); wall jumps change nothing.
+    """
+
+    DISENGAGED, ARMING, ENGAGED, COOLING = _ENGAGEMENT_STATES
+
+    def __init__(self, policy, slo, now_fn=time.monotonic):
+        self.policy = policy
+        self.slo = slo  # component_base.profiling.SLOTracker
+        self._now = now_fn
+        self.state = self.DISENGAGED
+        self._arm_count = 0
+        self._last_depth = 0
+        self._last_pressure_t = float("-inf")
+        self._state_t = now_fn()
+
+    @property
+    def engaged(self) -> bool:
+        return self.state in (self.ENGAGED, self.COOLING)
+
+    def reconfigure(self, policy) -> None:
+        """Config hot-reload (SIGHUP): swap knobs in place but KEEP the
+        state and dwell clocks — a reload mid-incident must not drop an
+        engaged shield or reset a cooling dwell."""
+        self.policy = policy
+        self.slo.target_s = (policy.slo_p99_ms or 250.0) / 1e3
+
+    def note_latencies(self, latencies_s, now=None) -> None:
+        """Feed submit->bind latencies (the bind-commit tail calls this
+        every wave, profiling stanza or not — the arm signal must not
+        depend on the observatory being on)."""
+        self.slo.observe(latencies_s, now=now)
+
+    def _pressure(self, queue_depth: int, nominal_wave: int,
+                  now: float) -> str | None:
+        if self.slo.breached(now):
+            return "slo_burn"
+        growing = queue_depth > self._last_depth
+        self._last_depth = queue_depth
+        if growing and queue_depth > (self.policy.queue_growth_factor
+                                      * max(1, nominal_wave)):
+            return "queue_growth"
+        return None
+
+    def on_wave(self, queue_depth: int, nominal_wave: int,
+                now: float | None = None) -> list[tuple[str, str, str]]:
+        """Advance one retired wave; returns the transition edges taken
+        (the scheduler counts each into overload_transition_total and
+        applies the queue/tuner side effects)."""
+        now = self._now() if now is None else now
+        why = self._pressure(queue_depth, nominal_wave, now)
+        if why is not None:
+            self._last_pressure_t = now
+        edges: list[tuple[str, str, str]] = []
+
+        def move(to: str, reason: str) -> None:
+            assert to in _ENGAGEMENT_STATES \
+                and reason in _ENGAGEMENT_REASONS
+            edges.append((self.state, to, reason))
+            self.state = to
+            self._state_t = now
+
+        if self.state == self.DISENGAGED:
+            if why is not None:
+                self._arm_count = 1
+                move(self.ARMING, why)
+                if self._arm_count >= self.policy.arm_samples:
+                    move(self.ENGAGED, why)
+        elif self.state == self.ARMING:
+            if why is None:
+                move(self.DISENGAGED, "blip")
+            else:
+                self._arm_count += 1
+                if self._arm_count >= self.policy.arm_samples:
+                    move(self.ENGAGED, why)
+        elif self.state == self.ENGAGED:
+            if (why is None and now - self._last_pressure_t
+                    >= self.policy.engage_dwell):
+                move(self.COOLING, "calm")
+        elif self.state == self.COOLING:
+            if why is not None:
+                move(self.ENGAGED, "re_pressure")
+            elif now - self._state_t >= self.policy.cool_dwell:
+                move(self.DISENGAGED, "cooled")
+        return edges
+
+    def detach(self) -> list[tuple[str, str, str]]:
+        """configure_overload swapping to always/off/None: drop to
+        disengaged, counting the edge so transition totals never lie."""
+        if self.state == self.DISENGAGED:
+            return []
+        edge = (self.state, self.DISENGAGED, "config")
+        self.state = self.DISENGAGED
+        self._arm_count = 0
+        return [edge]
 
 
 class Profile:
@@ -525,10 +667,15 @@ class Scheduler:
         self.tracer_provider: tracing.TracerProvider | None = None
         self._tracer: tracing.Tracer | None = None
         # overload protection (config.py OverloadPolicy): None until
-        # configure_overload attaches a policy; every layer defaults off
+        # configure_overload attaches a policy.  The policy now ships
+        # enabled by default (engagement: auto) — _engagement holds the
+        # hysteresis controller that decides when the shed/tuner/breaker
+        # machinery actually bites; None means legacy always-on
+        # (engagement: always) or everything off
         self.overload_policy = None
         self._wave_tuner: _WaveTuner | None = None
         self._escape_breaker: _OverloadBreaker | None = None
+        self._engagement: _EngagementController | None = None
         # horizontal scale-out (config.py ScaleOutPolicy): None until
         # configure_scaleout attaches a coordinator; single-instance
         # schedulers skip every ownership check
@@ -568,11 +715,16 @@ class Scheduler:
     def configure_overload(self, policy) -> None:
         """Attach a config.OverloadPolicy: bounded admission on the queue,
         AIMD wave sizing, the escape-storm breaker and the stuck-wave
-        watchdog (each layer only active when its knob is non-zero).
-        Pass None to detach everything."""
+        watchdog.  The policy ships enabled by default with
+        ``engagement: auto`` — the layers are built here but only BITE
+        while the hysteresis controller is engaged; ``always`` is the
+        legacy always-on behaviour; pass None (or ``engagement: off``)
+        to detach everything."""
         self.overload_policy = policy
         if policy is None or not policy.enabled:
+            self._detach_engagement()
             self.queue.set_overload_policy(0)
+            self.queue.set_overload_engaged(True)
             self._wave_tuner = None
             self._escape_breaker = None
             return
@@ -582,14 +734,80 @@ class Scheduler:
         batch_profile = next((p for p in self.profiles.values()
                               if p.batch_backend is not None), None)
         wave_cap = batch_profile.batch_size if batch_profile else 256
+        old_tuner = self._wave_tuner
         self._wave_tuner = (
             _WaveTuner(wave_cap, policy.slo_p99_ms / 1e3, policy.wave_min,
                        policy.wave_increase, policy.wave_decrease)
             if policy.slo_p99_ms > 0 else None)
+        if old_tuner is not None and self._wave_tuner is not None:
+            # hot-reload mid-incident: keep the AIMD position (a reload
+            # must not blow a ratcheted-down wave back to full size),
+            # re-clamped against the possibly-new batch-size ceiling
+            self._wave_tuner._wave = old_tuner.current()
+            self._wave_tuner.reclamp(wave_cap)
+        # monotonic now_fn is the contract here: probe_due and the queue's
+        # shed-age exemption must shrug off NTP wall-clock steps
         self._escape_breaker = (
             _OverloadBreaker(policy.breaker_threshold,
-                             policy.breaker_probe_interval)
+                             policy.breaker_probe_interval,
+                             now_fn=time.monotonic)
             if policy.escape_rate_threshold > 0 else None)
+        if policy.engagement == "auto":
+            if self._engagement is not None:
+                # SIGHUP reload mid-incident: swap knobs, keep the state
+                self._engagement.reconfigure(policy)
+            else:
+                from ..component_base.profiling import SLOTracker
+                # own tracker: arming must not depend on the profiling
+                # stanza being configured
+                self._engagement = _EngagementController(
+                    policy,
+                    SLOTracker(target_ms=policy.slo_p99_ms or 250.0,
+                               objective=0.99))
+            self.queue.set_overload_engaged(self._engagement.engaged)
+        else:  # "always": legacy semantics, protections bite from wave 0
+            self._detach_engagement()
+            self.queue.set_overload_engaged(True)
+
+    def _detach_engagement(self) -> None:
+        if self._engagement is not None:
+            self._apply_engagement_edges(self._engagement.detach())
+            self._engagement = None
+
+    def _apply_engagement_edges(
+            self, edges: list[tuple[str, str, str]]) -> None:
+        """Count each state-machine edge and apply its side effects.
+        Only the scheduling-loop thread (and configure/reload, which run
+        before/between loops) calls this, so the counter sees a single
+        writer; the engaged gauge itself is refreshed at expose time."""
+        if not edges:
+            return
+        eng = self._engagement
+        for frm, to, reason in edges:
+            self.metrics.prom.overload_transition_total.inc(1.0, frm, to,
+                                                            reason)
+            logger.info("overload engagement %s -> %s (%s)", frm, to, reason)
+            if to == "engaged" and frm in ("arming", "disengaged"):
+                # engage edge: the cap starts biting NOW — shed any
+                # backlog already over it, and restart AIMD from the top
+                # so the tuner reacts to live latency, not stale history
+                if self._wave_tuner is not None:
+                    self._wave_tuner.reset()
+                self.queue.set_overload_engaged(True)
+                self.queue.enforce_cap()
+        if eng is not None:
+            self.queue.set_overload_engaged(eng.engaged)
+
+    @property
+    def overload_engagement(self) -> str:
+        """Engagement posture for /readyz and tests: the controller state
+        when auto, else "always" (legacy always-on) or "off"."""
+        if self._engagement is not None:
+            return self._engagement.state
+        pol = self.overload_policy
+        if pol is not None and pol.enabled:
+            return "always"
+        return "off"
 
     def configure_scaleout(self, policy_or_coordinator) -> None:
         """Attach the horizontal scale-out layer (scaleout.py): ownership
@@ -646,6 +864,21 @@ class Scheduler:
             self.metrics.prom.config_reload_total.inc(1.0, "rejected")
             raise
         restart_only: list[str] = []
+        applied = ["overload", "tracing", "profiling"]
+        # backend knobs land FIRST: the overload AIMD tuner clamps to the
+        # live profile batch size, so a reload that shrinks batchSize must
+        # apply it before configure_overload re-clamps the tuner —
+        # otherwise the AIMD ceiling stays above the new wave cap until
+        # restart.  A backend KIND swap means a different compiled kernel
+        # + device residency — that is a restart, not a reload.
+        if cfg.backend.kind != self.backend_policy.kind:
+            restart_only.append("backend.kind")
+        if cfg.backend.batch_size > 0:
+            for profile in self.profiles.values():
+                if profile.batch_backend is not None:
+                    profile.batch_size = cfg.backend.batch_size
+                    applied.append("backend.batchSize")
+                    break
         self.configure_overload(cfg.overload if cfg.overload.enabled
                                 else None)
         if cfg.tracing.enabled:
@@ -687,18 +920,6 @@ class Scheduler:
                     and self._profiler is profiling.default_host_profiler):
                 self._profiler.stop()
             self.configure_profiling(None, None)
-        # backend knobs: batch size retunes the next dispatch wave; a
-        # KIND swap means a different compiled kernel + device residency
-        # — that is a restart, not a reload
-        applied = ["overload", "tracing", "profiling"]
-        if cfg.backend.kind != self.backend_policy.kind:
-            restart_only.append("backend.kind")
-        if cfg.backend.batch_size > 0:
-            for profile in self.profiles.values():
-                if profile.batch_backend is not None:
-                    profile.batch_size = cfg.backend.batch_size
-                    applied.append("backend.batchSize")
-                    break
         # pipeline depth applies live: raising it lets the next cycle
         # dispatch ahead; lowering it drains excess in-flight waves on
         # the next schedule_step (the trim loop retires oldest-first) —
@@ -808,6 +1029,12 @@ class Scheduler:
         if self._escape_breaker is not None:
             self.metrics.prom.overload_breaker_open.set(
                 1.0 if self._escape_breaker.is_open else 0.0)
+        # engagement gauge refreshed at expose time (the counter tracks
+        # edges; the gauge is derived state): 1 while the protections
+        # bite — engaged/cooling under auto, or legacy always-on
+        posture = self.overload_engagement
+        self.metrics.prom.overload_engaged.set(
+            1.0 if posture in ("engaged", "cooling", "always") else 0.0)
         # performance observatory: drain per-stage host seconds from the
         # sampling profiler (inc-only deltas) and refresh the SLO
         # rolling-window quantile + burn-rate gauges
@@ -1121,8 +1348,13 @@ class Scheduler:
                 t = 0.0
             # AIMD wave sizing (overload: sloP99Ms): the tuner shrinks the
             # wave when the last waves blew the latency SLO and grows it
-            # back while under — static batch_size otherwise
-            wave = (self._wave_tuner.current() if self._wave_tuner is not None
+            # back while under — static batch_size while disengaged or
+            # untuned (engagement gating: disengaged pipelines dispatch
+            # full waves at zero overload cost)
+            eng = self._engagement
+            wave = (self._wave_tuner.current()
+                    if self._wave_tuner is not None
+                    and (eng is None or eng.engaged)
                     else batch_profile.batch_size)
             t_pop0 = time.monotonic()
             batch = self.queue.pop_batch(wave, t)
@@ -1134,6 +1366,12 @@ class Scheduler:
                     (mine if self._profile_for(q.pod) is batch_profile
                      else perpod).append(q)
             if not batch and not self._pending and not self._deferred:
+                if eng is not None:
+                    # idle tick: dwell clocks must keep running so an
+                    # engaged/cooling machine can stand down after the
+                    # storm drains, even with no waves retiring
+                    self._apply_engagement_edges(
+                        eng.on_wave(0, batch_profile.batch_size))
                 # truly idle: let the backend absorb node churn into its
                 # host tensors now, so a later dispatch doesn't pay the
                 # whole re-encode (at 100k nodes the creation flood costs
@@ -2001,7 +2239,12 @@ class Scheduler:
         guaranteed-update per pod."""
         fw = profile.framework
         pol = self.overload_policy
-        deadline = pol.wave_deadline if pol is not None else 0.0
+        eng = self._engagement
+        # quiescent cost of engagement: this bool — None means legacy
+        # always-on (engagement: always) or no policy at all
+        shielded = eng is None or eng.engaged
+        deadline = (pol.wave_deadline
+                    if pol is not None and shielded else 0.0)
         t_enter = time.monotonic()
         tl = self._timeline
         try:
@@ -2066,7 +2309,16 @@ class Scheduler:
             stagelat.record("pipeline_wait", t_enter - start)
             stagelat.record("resolve_block", resolve_block)
         escapes = self._drain_backend_telemetry(profile.batch_backend)
-        if self._wave_tuner is not None:
+        if eng is not None:
+            # advance the hysteresis machine one retired wave (burn-rate
+            # breach primary, queue-depth growth secondary); this loop
+            # thread is the only transition writer, so the counter and
+            # the queue's engaged flag see a single mutator
+            self._apply_engagement_edges(
+                eng.on_wave(self.queue.stats()["active"],
+                            profile.batch_size))
+            shielded = eng.engaged
+        if self._wave_tuner is not None and shielded:
             # wave latency = dispatch -> results in hand; queue depth tells
             # the tuner whether growing the wave is worth anything
             self._wave_tuner.observe(time.monotonic() - start,
@@ -2077,7 +2329,7 @@ class Scheduler:
         # any other state routes to the oracle as usual and the batch's
         # storm/calm verdict drives open/re-close.
         defer_escapes = False
-        br = self._escape_breaker
+        br = self._escape_breaker if shielded else None
         if (br is not None and pol is not None
                 and len(live) >= pol.escape_min_batch):
             n_skip = sum(1 for node_name, s in results
@@ -2380,6 +2632,11 @@ class Scheduler:
         self.metrics.observe_e2e(
             [(lat, q.attempts)
              for lat, (_, q, _, _) in zip(e2e_lats, bound)])
+        eng = self._engagement
+        if eng is not None:
+            # arm-signal feed: the controller owns its SLOTracker so the
+            # burn-rate breach fires with or without a profiling: stanza
+            eng.note_latencies(e2e_lats, now=now)
         tl = self._timeline
         if tl is not None and tl.enabled:
             tl.record("bind-commit", t_bind0, now, wave=cycle)

@@ -577,6 +577,15 @@ class MemoryStore:
                 ns, nm, node = entry[0], entry[1], entry[2]
                 expect_rv = entry[3] if len(entry) > 3 else None
                 key = f"{ns}/{nm}" if ns else nm
+                if not node:
+                    # a falsy nodeName would store a bind that every
+                    # reader treats as "unbound" — the pod is silently
+                    # lost (seen under churn when a caller resolves a
+                    # name across a node's in-place removal).  Refuse
+                    # loudly; the scheduler's failure path requeues.
+                    out.append((None, StoreError(
+                        f"bind {key!r}: empty node name refused")))
+                    continue
                 cur = table.get(key)
                 if cur is None:
                     out.append((None, NotFoundError(

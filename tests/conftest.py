@@ -77,6 +77,12 @@ def pytest_configure(config):
         "pipeline: depth-2 wave-pipeline tests (fenced dispatch, "
         "pipelined churn parity, per-wave watchdog deadlines, timeline "
         "overhead with overlapping waves)")
+    config.addinivalue_line(
+        "markers",
+        "storm: churn-storm chaos tier (tests/test_churn_storm.py; "
+        "seeded node add/drain/relabel floods mid-wave with a bind "
+        "ledger on top); tier-1 runs the shrunk storm, the full-size "
+        "run is additionally marked slow")
 
 
 @pytest.fixture

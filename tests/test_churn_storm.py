@@ -1,0 +1,400 @@
+"""Churn-storm chaos tier: topology churn flooding the informer mid-wave.
+
+The engagement PR's proving ground — a seeded node add/drain/relabel
+storm (ops/faults.py ChurnStormSchedule + NodeStormDriver) runs
+CONCURRENTLY with a pod flood on the depth-2 pipelined path, stressing
+the backend's row patches, between-wave compaction and pipelined
+gen-fence recovery while the on-by-default engagement controller decides
+when the overload machinery earns its keep.  A store-watch bind ledger
+sits on top asserting the invariants chaos must not break:
+
+  - exactly-once binds: a pod's nodeName, once set, never moves
+  - zero lost pods: the closing barrier sees every flood pod bound
+  - zero system/high-band sheds, storm or not
+  - bounded engagement transitions (hysteresis holds under churn)
+
+Schedule/driver unit tests pin seeded determinism and the one-draw
+stream-stability rule so bench.py and this tier replay IDENTICAL storms.
+Tier-1 runs the shrunk storm; the full-size workload is also slow.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.client.clientset import LocalClient, NODES, PODS
+from kubernetes_tpu.ops.faults import (
+    ChurnStormSchedule, NodeStormDriver, NODE_ADD, NODE_DRAIN, NODE_RELABEL,
+)
+from kubernetes_tpu.perf import caps_for_nodes, load_workloads
+from kubernetes_tpu.perf.scheduler_perf import (
+    ThroughputCollector, run_workload, setup_cluster,
+)
+from kubernetes_tpu.store import kv
+from kubernetes_tpu.testing import make_node
+
+pytestmark = pytest.mark.storm
+
+
+def _schedule(**kw) -> ChurnStormSchedule:
+    base = dict(seed=7, add_rate=0.3, drain_rate=0.2, relabel_rate=0.3)
+    base.update(kw)
+    return ChurnStormSchedule(**base)
+
+
+class TestChurnStormSchedule:
+    def test_seeded_determinism(self):
+        sa, sb = _schedule(), _schedule()
+        a = [sa.action(i) for i in range(50)]
+        b = [sb.action(i) for i in range(50)]
+        assert a == b
+
+    def test_one_draw_stream_stability(self):
+        """Scripting a step must not shift the seeded stream around it:
+        every step consumes exactly one draw, scripted or not."""
+        sp = _schedule()
+        plain = [sp.action(i) for i in range(30)]
+        scripted = _schedule(script={11: (NODE_DRAIN, 0.5)})
+        got = [scripted.action(i) for i in range(30)]
+        assert got[11] == (NODE_DRAIN, 0.5)
+        assert got[:11] == plain[:11]
+        assert got[12:] == plain[12:]
+
+    def test_zero_rates_are_quiet(self):
+        s = ChurnStormSchedule(seed=3)
+        assert all(s.action(i)[0] == "none" for i in range(20))
+
+    def test_bands_partition_and_fractions_cover(self):
+        """Rates partition the unit interval; the victim fraction is the
+        draw re-scaled within its band, so it spans [0, 1)."""
+        s = _schedule(seed=1, add_rate=0.4, drain_rate=0.3,
+                      relabel_rate=0.3)
+        seen = {NODE_ADD: [], NODE_DRAIN: [], NODE_RELABEL: []}
+        for i in range(3000):
+            act, frac = s.action(i)
+            assert act in seen  # rates sum to 1.0: never "none"
+            assert 0.0 <= frac < 1.0
+            seen[act].append(frac)
+        for act, fracs in seen.items():
+            assert fracs, f"band {act} never drawn"
+            assert min(fracs) < 0.1 and max(fracs) > 0.9, \
+                f"band {act} fractions don't cover the unit interval"
+
+
+class TestNodeStormDriver:
+    def _cluster(self, n=4):
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        names = []
+        for i in range(n):
+            name = f"base-{i}"
+            client.create(NODES, make_node(name)
+                          .capacity(cpu="8", mem="32Gi").build())
+            names.append(name)
+        return store, client, names
+
+    def test_adds_create_schedulable_nodes(self):
+        store, client, names = self._cluster()
+        drv = NodeStormDriver(client, _schedule(
+            add_rate=1.0, drain_rate=0.0, relabel_rate=0.0),
+            names, rack_labels=3)
+        for _ in range(5):
+            assert drv.step()[0] == NODE_ADD
+        items, _ = client.list(NODES, "")
+        assert len(items) == 4 + 5
+        added = {o["metadata"]["name"]: o for o in items
+                 if o["metadata"]["name"].startswith("storm-")}
+        assert set(added) == {f"storm-{i}" for i in range(5)}
+        for o in added.values():
+            assert o["metadata"]["labels"]["ktpu.io/rack"] in "012"
+        assert drv.injected[NODE_ADD] == 5
+
+    def test_drains_respect_min_nodes_floor(self):
+        store, client, names = self._cluster(n=4)
+        drv = NodeStormDriver(client, _schedule(
+            add_rate=0.0, drain_rate=1.0, relabel_rate=0.0),
+            names, min_nodes=2)
+        results = [drv.step() for _ in range(10)]
+        applied = [r for r in results if r is not None]
+        assert len(applied) == 2  # 4 nodes, floor 2: only 2 drains land
+        items, _ = client.list(NODES, "")
+        assert len(items) == 2
+        assert drv.injected[NODE_DRAIN] == 2
+        # refused steps still consumed a draw (stream stability)
+        assert drv.steps == 10
+
+    def test_adds_respect_max_nodes_ceiling(self):
+        """Unbounded adds would grow the cluster past the backend's
+        tensor caps and stall every wave; the ceiling refuses them."""
+        store, client, names = self._cluster(n=4)
+        drv = NodeStormDriver(client, _schedule(
+            add_rate=1.0, drain_rate=0.0, relabel_rate=0.0),
+            names, max_nodes=6)
+        results = [drv.step() for _ in range(10)]
+        assert sum(1 for r in results if r is not None) == 2
+        items, _ = client.list(NODES, "")
+        assert len(items) == 6
+        assert drv.steps == 10  # refusals still consume draws
+
+    def test_relabels_bump_epoch_via_guaranteed_update(self):
+        store, client, names = self._cluster(n=3)
+        drv = NodeStormDriver(client, _schedule(
+            add_rate=0.0, drain_rate=0.0, relabel_rate=1.0), names)
+        applied = [drv.step() for _ in range(6)]
+        assert all(r is not None and r[0] == NODE_RELABEL
+                   for r in applied)
+        items, _ = client.list(NODES, "")
+        bumped = [o for o in items if "ktpu.io/storm-epoch"
+                  in o["metadata"].get("labels", {})]
+        assert bumped, "no node carries the storm epoch label"
+        assert drv.injected[NODE_RELABEL] == 6
+        # log records (step, action, victim) for deterministic replay
+        assert [e[0] for e in drv.log] == list(range(6))
+
+    def test_drain_victims_tracked_not_redrained(self):
+        """The driver's live-name view shrinks with each drain; a later
+        drain never targets an already-deleted node (which would be a
+        silent no-op masquerading as churn)."""
+        store, client, names = self._cluster(n=6)
+        drv = NodeStormDriver(client, _schedule(
+            add_rate=0.4, drain_rate=0.6, relabel_rate=0.0),
+            names, min_nodes=1)
+        for _ in range(40):
+            drv.step()
+        drained = [n for (_, a, n) in drv.log if a == NODE_DRAIN]
+        assert len(drained) == len(set(drained))
+
+
+class TestGhostNodeRace:
+    """The storm-tier bug this PR's chaos runs caught: the zero-copy
+    cache view shares LIVE NodeInfos with the tensors, and
+    Cache.remove_node nulls .node IN PLACE when a drained node still
+    holds pods — a wave resolving across that mutation used to read
+    NodeInfo.name == "" and bind its pods to an empty nodeName, which
+    every reader treats as "unbound".  The pods were silently lost
+    (condition PodScheduled=True, no nodeName, absent from every queue
+    tier).  Dispatch now snapshots the tensors' row_names (strings) and
+    the store refuses empty-node binds outright."""
+
+    def _tensors_with_node(self):
+        from kubernetes_tpu.ops.flatten import Caps, ClusterTensors
+        from kubernetes_tpu.scheduler.cache import Cache
+
+        cache = Cache()
+        node = make_node("churn-0").capacity(cpu="32", mem="128Gi").build()
+        cache.add_node(node)
+        pod = {"metadata": {"name": "rider", "namespace": "default"},
+               "spec": {"nodeName": "churn-0",
+                        "containers": [{"name": "c", "resources": {
+                            "requests": {"cpu": "1"}}}]}}
+        cache.add_pod(pod)  # a resident pod keeps the NodeInfo on drain
+        caps = Caps(n_cap=8, l_cap=16, kl_cap=8, t_cap=4, pt_cap=4,
+                    s_cap=2, sg_cap=4, asg_cap=4, c_cap=2)
+        t = ClusterTensors(caps)
+        t.update_from_snapshot_tracked(cache.flatten_view())
+        return cache, node, t
+
+    def test_row_names_survive_inplace_node_removal(self):
+        """The dispatch-time row_names snapshot must keep resolving the
+        registration-time name after the cache nulls the shared
+        NodeInfo's .node mid-wave."""
+        import numpy as np
+
+        from kubernetes_tpu.ops.backend import decode_results
+
+        cache, node, t = self._tensors_with_node()
+        row = t.row_of["churn-0"]
+        assert t.row_names[row] == "churn-0"
+        row_names = list(t.row_names)  # what dispatch captures
+        live_ni = t.node_infos[row]
+        cache.remove_node(node)  # resident pod -> in-place .node = None
+        assert live_ni.node is None and live_ni.name == "", \
+            "hazard precondition changed: cache no longer nulls in place"
+        out = decode_results(np.asarray([row]), 1, 8, set(),
+                             row_names, "no fit")
+        assert out == [("churn-0", None)]
+
+    def test_decode_refuses_unnamed_rows(self):
+        """A free/tombstoned row in the captured view decodes to a loud
+        ERROR (requeue), never a falsy node name."""
+        import numpy as np
+
+        from kubernetes_tpu.ops.backend import decode_results
+
+        for ghost in (None, ""):
+            out = decode_results(np.asarray([3]), 1, 8, set(),
+                                 [None, None, None, ghost], "no fit")
+            (name, status), = out
+            assert name is None
+            assert status is not None and not status.is_success()
+            assert "no node name" in status.message()
+
+    def test_store_refuses_empty_node_bind(self):
+        """Belt-and-suspenders: a bind carrying an empty nodeName is
+        refused at the store, leaving the pod untouched (no phantom
+        PodScheduled condition)."""
+        store = kv.MemoryStore()
+        client = LocalClient(store)
+        client.create(PODS, {"metadata": {"name": "p0",
+                                          "namespace": "default"},
+                             "spec": {}})
+        (obj, err), = client.bind_many([("default", "p0", "")])
+        assert obj is None and isinstance(err, kv.StoreError)
+        with pytest.raises(kv.StoreError):
+            client.bind({"metadata": {"name": "p0",
+                                      "namespace": "default"}}, "")
+        cur = store.get(PODS, "default", "p0")
+        assert "nodeName" not in cur["spec"]
+        assert not (cur.get("status") or {}).get("conditions")
+
+
+class BindLedger:
+    """Store-watch exactly-once ledger: replays the pods watch stream and
+    flags any pod whose nodeName, once set, changes to a different node —
+    the double-bind a gen-fence failure or a stale-row patch would
+    produce under topology churn.  Drained once after the run (the store
+    watch buffers unboundedly)."""
+
+    def __init__(self, store: kv.MemoryStore):
+        self._watch = store.watch(PODS)
+        self.bound: dict[str, str] = {}
+        self.rebinds: list[tuple[str, str, str]] = []
+
+    def drain(self) -> None:
+        while True:
+            evs = self._watch.next_batch(timeout=0.0)
+            if not evs:
+                break
+            for ev in evs:
+                o = ev.object
+                md = o["metadata"]
+                k = f"{md.get('namespace', '')}/{md['name']}"
+                if ev.type == kv.DELETED:
+                    self.bound.pop(k, None)
+                    continue
+                node = (o.get("spec") or {}).get("nodeName")
+                if not node:
+                    continue
+                prev = self.bound.get(k)
+                if prev is None:
+                    self.bound[k] = node
+                elif prev != node:
+                    self.rebinds.append((k, prev, node))
+
+    def stop(self) -> None:
+        self._watch.stop()
+
+
+def _shrunk_storm(nodes: int, pods: int, timeout: float = 180.0) -> dict:
+    cfg = copy.deepcopy(load_workloads()["SchedulingChurnStorm"])
+    for op in cfg["workloadTemplate"]:
+        if op["opcode"] == "createNodes":
+            op["count"] = nodes
+            op["rackLabels"] = min(op.get("rackLabels", 0), nodes)
+        elif op["opcode"] == "createPods":
+            if op.get("collectMetrics"):
+                op["count"] = max(8, pods)
+                # pace the flood over a couple of seconds so the storm
+                # genuinely overlaps in-flight waves (a full-backlog
+                # dump binds before the first drain lands)
+                op["ratePerSecond"] = max(100, pods // 3)
+            else:
+                op["count"] = max(8, pods // 20)
+        elif op["opcode"] == "barrier":
+            op["timeout"] = timeout
+        elif op["opcode"] == "nodeStorm":
+            op["minNodes"] = max(2, nodes // 2)
+            op["intervalMilliseconds"] = 10
+    return cfg
+
+
+def _run_storm(nodes: int, pods: int, timeout: float = 180.0):
+    """Shared e2e body: shrunk SchedulingChurnStorm on the depth-2
+    pipelined TPU path with the DEFAULT (auto-engagement) overload
+    policy, a bind ledger on the store, and the storm stats returned for
+    assertions."""
+    from kubernetes_tpu.scheduler.config import OverloadPolicy
+
+    cfg = _shrunk_storm(nodes, pods, timeout)
+    # the REAL tensor backend (not null_device): the storm's value is
+    # driving row patches / compaction / gen fences, which only the
+    # resident-mirror backend carries; jax runs them on CPU here
+    cluster = setup_cluster(tpu=True, caps=caps_for_nodes(nodes + 64),
+                            batch_size=64, pipeline_depth=2,
+                            overload=OverloadPolicy())
+    ledger = BindLedger(cluster.store)
+    collector = ThroughputCollector(cluster.store)
+    try:
+        stats = run_workload(cluster, cfg["workloadTemplate"], collector)
+        collector.stop()
+        ledger.drain()
+        sched = cluster.scheduler
+        sched.expose_metrics()
+        prom = sched.metrics.prom
+        stats["sheds"] = dict(prom.queue_shed_total.values())
+        stats["transitions"] = dict(
+            prom.overload_transition_total.values())
+        stats["engagement"] = sched.overload_engagement
+        stats["max_active"] = sched.queue.stats()["active"]
+        for p in sched.profiles.values():
+            if p.batch_backend is not None:
+                stats["backend_stats"] = dict(p.batch_backend.stats)
+                maint = getattr(p.batch_backend, "maintenance_snapshot",
+                                None)
+                if maint is not None:
+                    stats["tensor_maintenance"] = maint()
+                break
+        return stats, ledger
+    finally:
+        ledger.stop()
+        cluster.shutdown()
+
+
+def _assert_invariants(stats, ledger, pods: int):
+    assert stats.get("barrier_ok"), \
+        f"lost pods: flood never fully bound ({stats})"
+    assert not ledger.rebinds, \
+        f"exactly-once violated: {ledger.rebinds[:5]}"
+    # the barrier proved every flood pod bound; the ledger saw them all
+    assert len(ledger.bound) >= pods
+    for (reason, band), n in stats["sheds"].items():
+        assert band not in ("system", "high"), \
+            f"shed {n} {band} pods (reason={reason})"
+    # hysteresis holds under oscillating churn: the controller may
+    # engage and disengage, but it must not flap per-wave
+    assert sum(stats["transitions"].values()) <= 12, stats["transitions"]
+    # topology churn reached the backend: the storm applied real
+    # adds/drains/relabels and the maintenance path saw node events
+    storm = stats["storm"]
+    assert storm["injected"][NODE_ADD] > 0
+    assert storm["injected"][NODE_DRAIN] > 0
+    assert storm["injected"][NODE_RELABEL] > 0
+    maint = stats.get("tensor_maintenance")
+    if maint is not None:
+        # gen-fence recovery observables exist and never went negative;
+        # patched/reflattened wave counts account for the churn
+        assert maint["gen_stale_waves"] >= 0
+        assert maint["waves_patched"] + maint["waves_reflattened"] > 0
+
+
+class TestChurnStormE2E:
+    def test_shrunk_storm_depth2(self):
+        """Tier-1: the shrunk storm over the depth-2 pipelined path with
+        the DEFAULT config (engagement auto, on by default)."""
+        stats, ledger = _run_storm(nodes=24, pods=600)
+        _assert_invariants(stats, ledger, 600)
+
+    @pytest.mark.slow
+    def test_full_storm_depth2(self):
+        """The full-tier storm: closer to the YAML's published shape."""
+        stats, ledger = _run_storm(nodes=120, pods=6000, timeout=420.0)
+        _assert_invariants(stats, ledger, 6000)
+        # at this scale the drain/relabel pressure must actually exercise
+        # the gen-fence / patch machinery, not just coexist with it
+        maint = stats.get("tensor_maintenance")
+        assert maint is not None
+        assert maint["event_patches"] > 0

@@ -273,7 +273,8 @@ class TestEscapeStormBreakerE2E:
         the breaker and every pod binds."""
         chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
             script={0: ALL_ESCAPE}))
-        policy = OverloadPolicy(escape_rate_threshold=0.5,
+        policy = OverloadPolicy(engagement="always",
+                                escape_rate_threshold=0.5,
                                 escape_min_batch=1,
                                 breaker_threshold=1,
                                 breaker_probe_interval=0.05)
@@ -309,7 +310,7 @@ class TestStuckWaveWatchdogE2E:
         them — well before the slow resolve would have returned."""
         chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
             script={0: SLOW}, slow_s=1.0))
-        policy = OverloadPolicy(wave_deadline=0.15)
+        policy = OverloadPolicy(engagement="always", wave_deadline=0.15)
         client, factory, sched = build_harness(chaos, policy)
         try:
             client.create(NODES, make_node("ov-0")
@@ -349,7 +350,7 @@ class TestPipelinedWatchdogE2E:
         slow resolves really ran back-to-back (elapsed > 1.1s)."""
         chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
             script={0: SLOW, 1: SLOW}, slow_s=0.6))
-        policy = OverloadPolicy(wave_deadline=0.9)
+        policy = OverloadPolicy(engagement="always", wave_deadline=0.9)
         client, factory, sched = build_harness(chaos, policy, batch_size=2)
         sched.pipeline_depth = 2
         try:
@@ -382,7 +383,7 @@ class TestPipelinedWatchdogE2E:
         four pods well before the stuck resolve would have returned."""
         chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
             script={0: SLOW}, slow_s=2.0))
-        policy = OverloadPolicy(wave_deadline=0.2)
+        policy = OverloadPolicy(engagement="always", wave_deadline=0.2)
         client, factory, sched = build_harness(chaos, policy, batch_size=2)
         sched.pipeline_depth = 2
         try:
@@ -417,7 +418,8 @@ class TestSeededOverloadChaos:
         bounded, and never shed a system/high-priority pod."""
         chaos = ChaosBatchBackend(_StubRung(), OverloadSchedule(
             seed=7, slow_rate=0.1, slow_s=0.03, all_escape_rate=0.2))
-        policy = OverloadPolicy(queue_cap=32,
+        policy = OverloadPolicy(engagement="always",
+                                queue_cap=32,
                                 shed_protect_priority=1000,
                                 shed_protect_age=30.0,
                                 slo_p99_ms=200.0,
@@ -541,12 +543,47 @@ class TestOverloadConfig:
         assert ov.breaker_probe_interval == 1.5
         assert ov.wave_deadline == 30.0
 
-    def test_absent_stanza_disables_everything(self):
+    def test_absent_stanza_is_on_by_default(self):
+        """No overload: stanza no longer means unprotected — the policy
+        ships enabled with engagement: auto, so the machinery exists but
+        only bites when the hysteresis controller engages."""
         cfg = load_config({
             "apiVersion": "kubescheduler.config.k8s.io/v1",
             "kind": "KubeSchedulerConfiguration",
         })
+        ov = cfg.overload
+        assert ov.enabled
+        assert ov.engagement == "auto"
+        assert ov.queue_cap > 0
+        assert ov.slo_p99_ms > 0
+        assert ov.wave_deadline > 0
+
+    def test_engagement_off_disables_everything(self):
+        cfg = load_config({
+            "apiVersion": "kubescheduler.config.k8s.io/v1",
+            "kind": "KubeSchedulerConfiguration",
+            "overload": {"engagement": "off"},
+        })
         assert not cfg.overload.enabled
+
+    def test_engagement_knobs_parse(self):
+        cfg = load_config({
+            "apiVersion": "kubescheduler.config.k8s.io/v1",
+            "kind": "KubeSchedulerConfiguration",
+            "overload": {
+                "engagement": "always",
+                "armSamples": 3,
+                "engageDwellSeconds": 7.5,
+                "coolDwellSeconds": 20,
+                "queueGrowthFactor": 4,
+            },
+        })
+        ov = cfg.overload
+        assert ov.engagement == "always"
+        assert ov.arm_samples == 3
+        assert ov.engage_dwell == 7.5
+        assert ov.cool_dwell == 20.0
+        assert ov.queue_growth_factor == 4.0
 
     @pytest.mark.parametrize("stanza", [
         {"queueCap": -1},
@@ -558,6 +595,10 @@ class TestOverloadConfig:
         {"breakerThreshold": 0},
         {"shedProtectAgeSeconds": 0},
         {"nope": 1},
+        {"engagement": "sometimes"},
+        {"armSamples": 0},
+        {"engageDwellSeconds": -1},
+        {"queueGrowthFactor": 0},
     ])
     def test_bad_stanza_rejected(self, stanza):
         with pytest.raises(ConfigError):
@@ -566,3 +607,343 @@ class TestOverloadConfig:
                 "kind": "KubeSchedulerConfiguration",
                 "overload": stanza,
             })
+
+
+# -- tentpole (ISSUE 20): engagement controller ---------------------------
+
+
+from kubernetes_tpu.component_base.profiling import SLOTracker  # noqa: E402
+from kubernetes_tpu.scheduler.config import BackendPolicy  # noqa: E402
+from kubernetes_tpu.scheduler.scheduler import (  # noqa: E402
+    _ENGAGEMENT_REASONS, _ENGAGEMENT_STATES, _EngagementController)
+
+
+def make_controller(clock, **kw):
+    kw.setdefault("engagement", "auto")
+    kw.setdefault("arm_samples", 2)
+    kw.setdefault("engage_dwell", 5.0)
+    kw.setdefault("cool_dwell", 10.0)
+    policy = OverloadPolicy(**kw)
+    slo = SLOTracker(target_ms=policy.slo_p99_ms, objective=0.99,
+                     windows=(10.0, 30.0), time_fn=lambda: clock[0])
+    return _EngagementController(policy, slo, now_fn=lambda: clock[0])
+
+
+def burn(eng, clock, n=10):
+    """Feed latencies far over target so both burn windows breach."""
+    eng.note_latencies([eng.slo.target_s * 4] * n, now=clock[0])
+
+
+class TestEngagementController:
+    def test_starts_disengaged_and_stays_quiescent(self):
+        clock = [0.0]
+        eng = make_controller(clock)
+        assert eng.state == "disengaged" and not eng.engaged
+        for _ in range(50):
+            clock[0] += 1.0
+            assert eng.on_wave(0, 256) == []
+        assert eng.state == "disengaged"
+
+    def test_slo_burn_arms_then_engages(self):
+        clock = [0.0]
+        eng = make_controller(clock, arm_samples=2)
+        burn(eng, clock)
+        assert eng.on_wave(0, 256) == [("disengaged", "arming", "slo_burn")]
+        assert not eng.engaged  # arming is not engaged
+        clock[0] += 1.0
+        burn(eng, clock)
+        assert eng.on_wave(0, 256) == [("arming", "engaged", "slo_burn")]
+        assert eng.engaged
+
+    def test_arm_samples_one_engages_in_a_single_wave(self):
+        clock = [0.0]
+        eng = make_controller(clock, arm_samples=1)
+        burn(eng, clock)
+        assert eng.on_wave(0, 256) == [
+            ("disengaged", "arming", "slo_burn"),
+            ("arming", "engaged", "slo_burn")]
+
+    def test_blip_disarms_without_engaging(self):
+        clock = [0.0]
+        eng = make_controller(clock, arm_samples=3)
+        burn(eng, clock)
+        eng.on_wave(0, 256)
+        assert eng.state == "arming"
+        # pressure gone before arm_samples confirmed: back to disengaged
+        clock[0] += 60.0  # burn samples age out of both windows
+        assert eng.on_wave(0, 256) == [("arming", "disengaged", "blip")]
+
+    def test_queue_growth_secondary_trigger(self):
+        clock = [0.0]
+        eng = make_controller(clock, arm_samples=2, queue_growth_factor=2.0)
+        # no SLO samples at all: backlog over 2x nominal AND growing
+        assert eng.on_wave(600, 256) == [
+            ("disengaged", "arming", "queue_growth")]
+        clock[0] += 1.0
+        assert eng.on_wave(700, 256) == [
+            ("arming", "engaged", "queue_growth")]
+
+    def test_queue_deep_but_draining_is_not_pressure(self):
+        clock = [0.0]
+        eng = make_controller(clock)
+        eng.on_wave(900, 256)   # growing from 0: pressure
+        assert eng.state == "arming"
+        clock[0] += 60.0        # pressure samples gone
+        eng.on_wave(0, 256)     # blip back down; _last_depth now 0... 
+        assert eng.state == "disengaged"
+        # re-prime the depth watermark high, then present a DRAINING deep
+        # queue: depth over the factor but shrinking wave over wave
+        eng._last_depth = 2000
+        for depth in (1500, 1200, 900):
+            clock[0] += 1.0
+            assert eng.on_wave(depth, 256) == []
+        assert eng.state == "disengaged"
+
+    def test_engage_dwell_then_cooling_then_cooled(self):
+        clock = [0.0]
+        eng = make_controller(clock, arm_samples=1,
+                              engage_dwell=5.0, cool_dwell=10.0)
+        # engage via queue growth (no SLO samples: calm is then purely
+        # clock-driven, which is what this test times)
+        assert eng.on_wave(600, 256) == [
+            ("disengaged", "arming", "queue_growth"),
+            ("arming", "engaged", "queue_growth")]
+        # calm but inside engage_dwell: still engaged
+        clock[0] = 2.0
+        assert eng.on_wave(0, 256) == []
+        assert eng.state == "engaged"
+        # past the dwell since last pressure: cooling (still shielded)
+        clock[0] = 6.0
+        assert eng.on_wave(0, 256) == [("engaged", "cooling", "calm")]
+        assert eng.engaged  # cooling keeps the protections on
+        # inside cool_dwell: still cooling
+        clock[0] = 15.0
+        assert eng.on_wave(0, 256) == []
+        assert eng.state == "cooling"
+        # cool_dwell of calm: stand down
+        clock[0] = 16.5
+        assert eng.on_wave(0, 256) == [("cooling", "disengaged", "cooled")]
+        assert not eng.engaged
+
+    def test_cooling_reengages_on_pressure(self):
+        clock = [0.0]
+        eng = make_controller(clock, arm_samples=1, engage_dwell=1.0)
+        burn(eng, clock)
+        eng.on_wave(0, 256)
+        clock[0] += 60.0
+        eng.on_wave(0, 256)  # calm past dwell -> cooling
+        assert eng.state == "cooling"
+        burn(eng, clock)
+        assert eng.on_wave(0, 256) == [("cooling", "engaged", "re_pressure")]
+
+    def test_oscillating_pressure_bounded_transitions(self):
+        """The flapping-storm guarantee: pressure toggling every wave
+        must NOT toggle engagement every wave — after the first engage
+        the machine rides engaged/cooling (dwell hysteresis), so the
+        transition count stays far below the wave count."""
+        clock = [0.0]
+        eng = make_controller(clock, arm_samples=1,
+                              engage_dwell=5.0, cool_dwell=10.0)
+        edges = []
+        for i in range(200):
+            clock[0] += 0.5
+            if i % 2 == 0:
+                burn(eng, clock, n=3)
+            edges += eng.on_wave(0, 256)
+        # 200 waves of 1Hz-flapping load: engage once, never stand down
+        assert len(edges) <= 4, edges
+        assert eng.engaged
+
+    def test_reconfigure_keeps_state(self):
+        clock = [0.0]
+        eng = make_controller(clock, arm_samples=1)
+        burn(eng, clock)
+        eng.on_wave(0, 256)
+        assert eng.state == "engaged"
+        eng.reconfigure(OverloadPolicy(engagement="auto", slo_p99_ms=500.0))
+        assert eng.state == "engaged"          # reload keeps the shield
+        assert eng.slo.target_s == pytest.approx(0.5)
+
+    def test_detach_counts_config_edge(self):
+        clock = [0.0]
+        eng = make_controller(clock, arm_samples=1)
+        assert eng.detach() == []              # disengaged: no edge
+        burn(eng, clock)
+        eng.on_wave(0, 256)
+        assert eng.detach() == [("engaged", "disengaged", "config")]
+
+    def test_taxonomy_closed(self):
+        """Every emittable edge uses tokens from the pinned taxonomy
+        (the README table + ktpu-lint sync rule ride on these)."""
+        assert set(_ENGAGEMENT_STATES) == {
+            "disengaged", "arming", "engaged", "cooling"}
+        assert set(_ENGAGEMENT_REASONS) == {
+            "slo_burn", "queue_growth", "blip", "calm", "re_pressure",
+            "cooled", "config"}
+
+
+class TestEngagementE2E:
+    def test_default_policy_healthy_run_stays_disengaged(self):
+        """The on-by-default acceptance shape: an unconfigured scheduler
+        now carries the full overload policy, yet a healthy run never
+        engages — no sheds, no wave shrink, every pod binds."""
+        cfg = load_config({
+            "apiVersion": "kubescheduler.config.k8s.io/v1",
+            "kind": "KubeSchedulerConfiguration",
+        })
+        client, factory, sched = build_harness(_StubRung(),
+                                               cfg.overload)
+        try:
+            assert sched._engagement is not None
+            assert sched.overload_engagement == "disengaged"
+            client.create(NODES, make_node("ov-0")
+                          .capacity(cpu="8", mem="32Gi").build())
+            for i in range(12):
+                client.create(PODS, make_pod(f"calm{i}")
+                              .req(cpu="100m").build())
+            sched.run()
+            assert wait_for(lambda: all_bound(client), timeout=30)
+            sched.expose_metrics()
+            prom = sched.metrics.prom
+            assert prom.overload_engaged.value() == 0.0
+            assert prom.overload_transition_total.values() == {}
+            assert sched.queue.drain_shed_total() == {}
+            assert sched.overload_engagement == "disengaged"
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_engage_edge_enforces_cap_and_sheds_engaged(self):
+        """Flip a live scheduler's controller to engaged: the queue cap
+        starts biting immediately (backlog over the cap sheds with
+        reason 'engaged') and the transition counter carries the edge."""
+        policy = OverloadPolicy(queue_cap=4, arm_samples=1)
+        client, factory, sched = build_harness(_StubRung(), policy)
+        try:
+            for i in range(10):
+                sched.queue.add(prio_pod(f"q{i}", 0))
+            assert sched.queue.stats()["active"] == 10  # disengaged: no cap
+            eng = sched._engagement
+            # feed breaching latencies on the controller's own (real
+            # monotonic) clock, then advance one wave
+            eng.note_latencies([eng.slo.target_s * 4] * 10)
+            sched._apply_engagement_edges(eng.on_wave(0, 8))
+            assert eng.engaged
+            st = sched.queue.stats()
+            assert st["active"] == 4                    # cap bites now
+            sheds = sched.queue.drain_shed_total()
+            assert sheds == {("engaged", "best_effort"): 6}
+            sched.expose_metrics()
+            prom = sched.metrics.prom
+            assert prom.overload_engaged.value() == 1.0
+            totals = prom.overload_transition_total.values()
+            assert totals[("disengaged", "arming", "slo_burn")] == 1.0
+            assert totals[("arming", "engaged", "slo_burn")] == 1.0
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+# -- satellite: SIGHUP reload re-clamps the live wave tuner ----------------
+
+
+class TestReloadReclampsTuner:
+    def _reload(self, sched, batch_size, overload=None):
+        cfg = {"apiVersion": "kubescheduler.config.k8s.io/v1",
+               "kind": "KubeSchedulerConfiguration",
+               "backend": {"kind": "null", "batchSize": batch_size}}
+        if overload is not None:
+            cfg["overload"] = overload
+        return sched.reload_config(cfg)
+
+    def test_shrinking_batch_size_reclamps_ceiling(self):
+        """The satellite bug: before the reorder, reload rebuilt the
+        tuner from the OLD profile batch size, leaving the AIMD ceiling
+        above the new one until restart."""
+        policy = OverloadPolicy(engagement="always", slo_p99_ms=100.0)
+        client, factory, sched = build_harness(_StubRung(), policy,
+                                               batch_size=256)
+        sched.backend_policy = BackendPolicy(kind="null")
+        try:
+            assert sched._wave_tuner.current() == 256
+            out = self._reload(sched, 64)
+            assert "backend.batchSize" in out["applied"]
+            assert sched._wave_tuner.current() <= 64
+            assert sched._wave_tuner._cap == 64
+            for _ in range(50):  # AIMD growth can never exceed the new cap
+                sched._wave_tuner.observe(0.001, 10_000)
+            assert sched._wave_tuner.current() == 64
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_reload_keeps_ratcheted_wave_position(self):
+        """A reload mid-incident must not blow a ratcheted-down wave
+        back to the full cap."""
+        policy = OverloadPolicy(engagement="always", slo_p99_ms=100.0)
+        client, factory, sched = build_harness(_StubRung(), policy,
+                                               batch_size=256)
+        sched.backend_policy = BackendPolicy(kind="null")
+        try:
+            for _ in range(3):
+                sched._wave_tuner.observe(0.5, 1000)  # breach: halve
+            ratcheted = sched._wave_tuner.current()
+            assert ratcheted < 256
+            self._reload(sched, 256)
+            assert sched._wave_tuner.current() == ratcheted
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_reload_overload_off_detaches(self):
+        policy = OverloadPolicy(engagement="always", slo_p99_ms=100.0)
+        client, factory, sched = build_harness(_StubRung(), policy)
+        sched.backend_policy = BackendPolicy(kind="null")
+        try:
+            assert sched._wave_tuner is not None
+            self._reload(sched, 8, overload={"engagement": "off"})
+            assert sched._wave_tuner is None
+            assert sched.overload_engagement == "off"
+        finally:
+            sched.stop()
+            factory.stop()
+
+
+# -- satellite: monotonic clock contract -----------------------------------
+
+
+class TestMonotonicClockContract:
+    def test_breaker_probe_survives_wall_clock_jump(self, monkeypatch):
+        """configure_overload builds the breaker on time.monotonic: an
+        NTP step on the wall clock must neither hold the breaker's probe
+        window open forever nor fire it early."""
+        policy = OverloadPolicy(engagement="always",
+                                escape_rate_threshold=0.5,
+                                breaker_threshold=1,
+                                breaker_probe_interval=30.0)
+        client, factory, sched = build_harness(_StubRung(), policy)
+        try:
+            br = sched._escape_breaker
+            assert br._now is time.monotonic
+            assert br.record_storm() is True  # opens
+            real_time = time.time
+            monkeypatch.setattr(time, "time",
+                                lambda: real_time() + 3600.0)
+            assert not br.probe_due()  # wall jump did not elapse the window
+        finally:
+            sched.stop()
+            factory.stop()
+
+    def test_shed_age_exemption_survives_wall_clock_jump(self, monkeypatch):
+        """The queue's shed-age exemption ages pods on the monotonic
+        clock: a +1h wall step must not age-exempt a fresh pod (which
+        would make the cap unenforceable for the storm's duration)."""
+        q = new_queue(cap=1, protect_age=30.0)
+        q.set_overload_engaged(True)
+        q.add(prio_pod("victim", -1))   # lowest priority: the victim pick
+        real_time = time.time
+        monkeypatch.setattr(time, "time", lambda: real_time() + 3600.0)
+        q.add(prio_pod("fresh", 0))
+        # the wall jump must NOT have exempted the victim from shedding
+        assert q.stats()["active"] == 1
+        assert q.drain_shed_total() == {("admission", "best_effort"): 1}

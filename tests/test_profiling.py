@@ -321,6 +321,49 @@ class TestSLOTracker:
         with pytest.raises(ValueError):
             SLOTracker(objective=1.0)
 
+    def test_empty_windows_at_startup(self):
+        """A fresh tracker must read as healthy, not breached: every
+        window burns 0.0 with no samples (the engagement controller
+        polls breached() from wave 0, so startup must not arm)."""
+        slo = self.make()
+        rates = slo.burn_rates()
+        assert rates == {"60s": 0.0, "300s": 0.0, "3600s": 0.0}
+        assert not slo.breached()
+        assert slo.quantiles()["count"] == 0
+
+    def test_sparse_shortest_window(self):
+        """One sample in the shortest window is enough to swing its burn
+        between 0 and 100x budget — the multi-window AND is what keeps a
+        sparse spike from arming on its own."""
+        slo = self.make()
+        slo.observe([0.001], now=0.0)          # single good sample
+        assert slo.burn_rates(now=1.0)["60s"] == 0.0
+        assert not slo.breached(now=1.0)
+        slo2 = self.make()
+        slo2.observe([0.05], now=0.0)          # single bad sample
+        # 1/1 over budget 0.01: both short windows see the same lone
+        # sample, so a single breach DOES arm — sparse windows are
+        # high-variance by design; arm_samples hysteresis absorbs it
+        assert slo2.burn_rates(now=1.0)["60s"] == pytest.approx(100.0)
+        assert slo2.breached(now=1.0)
+
+    def test_breach_exactly_at_two_window_boundary(self):
+        """breached() is the AND of the two shortest windows, each
+        strictly > 1.0: the confirming window burning at EXACTLY the
+        sustainable rate must not arm."""
+        slo = self.make()
+        # 99 good samples age out of the 60s window but stay in 300s
+        slo.observe([0.001] * 99, now=0.0)
+        # one bad sample inside both windows at now=100
+        slo.observe([0.05], now=95.0)
+        rates = slo.burn_rates(now=100.0)
+        assert rates["60s"] == pytest.approx(100.0)    # 1/1 over
+        assert rates["300s"] == pytest.approx(1.0)     # 1/100 over: AT budget
+        assert not slo.breached(now=100.0)             # strict >
+        slo.observe([0.05], now=96.0)                  # 2/101: over budget
+        assert slo.burn_rates(now=100.0)["300s"] > 1.0
+        assert slo.breached(now=100.0)
+
 
 # -- cross-process metrics federation ----------------------------------------
 

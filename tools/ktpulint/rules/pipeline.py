@@ -271,9 +271,14 @@ class TaxonomySyncRule(Rule):
                                  for t in n.targets}
                     # escape_reasons[...] = ("Plugin", "reason"),
                     # escapes[...] = "reason", reason = "..." / IfExp,
-                    # outcome = "..." (bind-conflict taxonomy)
+                    # outcome = "..." (bind-conflict taxonomy),
+                    # _ENGAGEMENT_STATES/_ENGAGEMENT_REASONS = (...) — the
+                    # engagement transition taxonomy is emitted through
+                    # variables (overload_transition_total.inc(1, frm, to,
+                    # r)), so the pinned tuples are the emit site
                     if tgt_names & {"escape_reasons", "escapes", "reason",
-                                    "outcome"}:
+                                    "outcome", "_ENGAGEMENT_STATES",
+                                    "_ENGAGEMENT_REASONS"}:
                         for c in strings_in(n.value):
                             note(c.value, view.rel, c.lineno)
                 # {i: "reason" ...} dict-comps (failover bulk escapes)
